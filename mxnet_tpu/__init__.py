@@ -1,0 +1,44 @@
+"""mxnet_tpu: a TPU-native deep-learning framework with MXNet's capabilities.
+
+Built from scratch on jax/XLA/pallas: imperative NDArray + autograd, Gluon-style
+blocks with hybridize→XLA JIT, optimizers/metrics/initializers, KVStore semantics
+over XLA collectives, Mesh-based dp/fsdp/tp/sp/pp parallelism, data pipeline with
+a native C++ host engine. See SURVEY.md for the component map to the reference
+(Apache MXNet / TEChopra1000/incubator-mxnet).
+"""
+__version__ = "0.1.0"
+
+from . import base
+from .base import MXNetError
+from .context import Context, cpu, cpu_pinned, gpu, tpu, num_gpus, num_tpus, current_context
+from . import ops
+from . import ndarray
+from . import nd
+from .ndarray import NDArray, waitall
+from . import autograd
+from . import random
+from . import _trace
+
+# extended stack (populated across build rounds)
+from . import initializer
+from . import init  # alias module
+from . import optimizer
+from . import lr_scheduler
+from . import metric
+from . import gluon
+from . import kvstore
+from . import io
+from . import recordio
+from . import image
+from . import symbol
+from . import sym
+from . import engine
+from . import profiler
+from . import amp
+from . import checkpoint
+from . import parallel
+from . import module
+from . import sparse
+from . import models
+
+__all__ = ["nd", "gluon", "autograd", "cpu", "gpu", "tpu", "Context", "NDArray"]

@@ -1,0 +1,97 @@
+"""Trace context + functional facade for the compiled (hybridized) path.
+
+This is the TPU-native replacement for MXNet's ``F`` duality: in MXNet a
+HybridBlock's ``hybrid_forward(F, ...)`` receives ``F = mx.nd`` (imperative) or
+``F = mx.sym`` (graph capture → CachedOp, ref: python/mxnet/gluon/block.py:1094).
+Here the captured path is a jax.jit trace: ``F`` is this module's ``TracedF``
+facade, whose ops are the pure functions from the registry operating on traced
+arrays. RNG keys and the train flag — which MXNet threads through implicit
+device/engine state — are carried by an explicit TraceContext so the resulting
+XLA program is pure: the base key is a traced input, dropout sites derive
+per-site keys with ``fold_in`` on a Python-level counter.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from .base import OP_REGISTRY, resolve_dtype
+
+_tls = threading.local()
+
+
+class TraceContext:
+    def __init__(self, key, training):
+        self.key = key
+        self.training = training
+        self.counter = 0
+        self.state_updates = {}  # param full-name -> new value (BN running stats)
+
+    def next_key(self):
+        self.counter += 1
+        return jax.random.fold_in(self.key, self.counter)
+
+
+def current_trace():
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+@contextlib.contextmanager
+def trace_scope(key, training):
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    ctx = TraceContext(key, training)
+    _tls.stack.append(ctx)
+    try:
+        yield ctx
+    finally:
+        _tls.stack.pop()
+
+
+class _TracedF:
+    """Functional namespace over raw jax arrays; mirrors the ``nd`` API."""
+
+    def __getattr__(self, name):
+        opdef = OP_REGISTRY.get(name)
+        if opdef is None:
+            raise AttributeError("no op %r in registry" % name)
+
+        def f(*args, **kwargs):
+            ctx = current_trace()
+            if opdef.needs_training and "training" not in kwargs:
+                kwargs["training"] = ctx.training if ctx is not None else False
+            if opdef.needs_rng and "key" not in kwargs and kwargs.get("training", False):
+                kwargs["key"] = ctx.next_key() if ctx is not None else jax.random.PRNGKey(0)
+            return opdef.fn(*args, **kwargs)
+
+        f.__name__ = name
+        object.__setattr__(self, name, f)
+        return f
+
+    # creation helpers usable inside traces
+    @staticmethod
+    def zeros(shape, dtype=None, ctx=None):
+        return jnp.zeros(shape, resolve_dtype(dtype) or jnp.float32)
+
+    @staticmethod
+    def ones(shape, dtype=None, ctx=None):
+        return jnp.ones(shape, resolve_dtype(dtype) or jnp.float32)
+
+    @staticmethod
+    def full(shape, val, dtype=None, ctx=None):
+        return jnp.full(shape, val, resolve_dtype(dtype) or jnp.float32)
+
+    @staticmethod
+    def arange(start, stop=None, step=1, dtype=None, ctx=None):
+        return jnp.arange(start, stop, step, dtype=resolve_dtype(dtype) or jnp.float32)
+
+    @staticmethod
+    def array(obj, dtype=None, ctx=None):
+        return jnp.asarray(obj, dtype=resolve_dtype(dtype))
+
+
+F = _TracedF()

@@ -1,0 +1,71 @@
+"""Automatic mixed precision (ref: python/mxnet/amp/amp.py).
+
+MXNet AMP casts whitelisted ops to fp16 with dynamic loss scaling. On TPU the
+native format is bfloat16: same exponent range as fp32, so **no loss scaling is
+needed** — AMP reduces to (1) casting matmul/conv-heavy params+activations to
+bf16 and (2) keeping normalization params, reductions and optimizer master
+weights in fp32 (optimizer multi_precision=True).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_initialized = False
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    global _initialized
+    _initialized = True
+
+
+def init_trainer(trainer):
+    trainer.optimizer.multi_precision = True
+    return trainer
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16", cast_optional_params=False):
+    """Cast a Gluon block's params to bf16, keeping norm/stat params fp32
+    (the standard TPU recipe)."""
+    block.cast(target_dtype)
+    _fix_norms(block)
+    return block
+
+
+convert_model = convert_hybrid_block
+
+
+def _fix_norms(block):
+    from .gluon.nn.basic_layers import BatchNorm, LayerNorm, InstanceNorm, GroupNorm
+
+    if isinstance(block, (BatchNorm, LayerNorm, InstanceNorm, GroupNorm)):
+        for p in block._reg_params.values():
+            p.cast(jnp.float32)
+    for child in block._children.values():
+        _fix_norms(child)
+
+
+class LossScaler:
+    """API-compat only: bf16 needs no loss scaling (exponent range == fp32)."""
+
+    def __init__(self, init_scale=1.0, **kwargs):
+        self.loss_scale = 1.0
+
+    def scale(self, loss):
+        return loss
+
+    def unscale(self, grads):
+        return grads
+
+    def update(self, overflow=False):
+        pass
+
+
+def scale_loss(loss, trainer):
+    import contextlib
+
+    @contextlib.contextmanager
+    def ctx():
+        yield loss if not isinstance(loss, (list, tuple)) else loss
+
+    return ctx()

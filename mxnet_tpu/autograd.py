@@ -1,0 +1,183 @@
+"""Imperative tape autograd.
+
+TPU-native equivalent of MXNet's imperative autograd (ref:
+python/mxnet/autograd.py, src/imperative/imperative.cc:Imperative::Backward).
+MXNet records op invocations under ``record()`` and builds an nnvm backward
+graph on ``backward()``. Here every recorded op invocation stores the
+``jax.vjp`` closure of its pure functional body; ``backward()`` walks the tape
+in reverse execution order accumulating cotangents. The hybridized/compiled
+path (gluon HybridBlock, parallel.build_train_step) instead uses whole-program
+``jax.grad`` — that is the performance path; this tape is the define-by-run
+parity path.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+_tls = threading.local()
+
+
+def _st():
+    if not hasattr(_tls, "recording"):
+        _tls.recording = False
+        _tls.training = False
+        _tls.tape = []
+    return _tls
+
+
+class TapeNode:
+    __slots__ = ("inputs", "outputs", "vjp_fn", "out_treedef")
+
+    def __init__(self, inputs, outputs, vjp_fn):
+        self.inputs = inputs    # list[NDArray] (diff args, in vjp order)
+        self.outputs = outputs  # list[NDArray]
+        self.vjp_fn = vjp_fn
+
+
+def _tape() -> List[TapeNode]:
+    return _st().tape
+
+
+def append_node(node: TapeNode):
+    _st().tape.append(node)
+
+
+class _RecordScope:
+    def __init__(self, recording, training):
+        self._rec = recording
+        self._train = training
+
+    def __enter__(self):
+        st = _st()
+        self._prev = (st.recording, st.training)
+        if self._rec is not None:
+            if self._rec and not st.recording:
+                st.tape = []  # fresh tape per outermost record scope
+            st.recording = self._rec
+        if self._train is not None:
+            st.training = self._train
+        return self
+
+    def __exit__(self, *a):
+        st = _st()
+        st.recording, st.training = self._prev
+
+    def __call__(self, fn):
+        def wrapped(*args, **kwargs):
+            with self.__class__(self._rec, self._train):
+                return fn(*args, **kwargs)
+
+        return wrapped
+
+
+def record(train_mode=True):
+    return _RecordScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordScope(None, True)
+
+
+def predict_mode():
+    return _RecordScope(None, False)
+
+
+def is_recording():
+    return _st().recording
+
+
+def is_training():
+    return _st().training
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad = g
+        v._grad_req = req
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Accumulate gradients of ``heads`` into every array that called
+    ``attach_grad()`` (ref: python/mxnet/autograd.py:backward)."""
+    from .ndarray import NDArray
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+
+    cot = {}  # id(NDArray) -> jax array cotangent
+    keep = {}  # id -> NDArray (keep objects alive during walk)
+    for h, hg in zip(heads, head_grads):
+        g = jnp.ones(h.shape, h.dtype) if hg is None else hg._data
+        _accum(cot, keep, h, g)
+
+    tape = _tape()
+    for node in reversed(tape):
+        if not any(id(o) in cot for o in node.outputs):
+            continue
+        out_cots = tuple(
+            cot.get(id(o), jnp.zeros(o.shape, o.dtype)) for o in node.outputs
+        )
+        in_cots = node.vjp_fn(out_cots if len(out_cots) > 1 else out_cots[0])
+        for inp, g in zip(node.inputs, in_cots):
+            if g is None or (hasattr(g, "dtype") and g.dtype == jax.float0):
+                continue
+            _accum(cot, keep, inp, g)
+
+    for arr in keep.values():
+        if getattr(arr, "_grad", None) is not None and id(arr) in cot:
+            req = getattr(arr, "_grad_req", "write")
+            if req == "null":
+                continue
+            g = cot[id(arr)]
+            if req == "add":
+                arr._grad._data = arr._grad._data + g
+            else:
+                arr._grad._data = g
+
+    if not retain_graph:
+        _st().tape = []
+
+
+def _accum(cot, keep, arr, g):
+    k = id(arr)
+    keep[k] = arr
+    if k in cot:
+        cot[k] = cot[k] + g
+    else:
+        cot[k] = g
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):
+    """Compute grads of heads w.r.t. variables without touching .grad
+    (ref: python/mxnet/autograd.py:grad)."""
+    from .ndarray import NDArray
+
+    if isinstance(variables, NDArray):
+        variables = [variables]
+    saved = [(getattr(v, "_grad", None), getattr(v, "_grad_req", "write")) for v in variables]
+    for v in variables:
+        v.attach_grad()
+    backward(heads, head_grads, retain_graph=bool(retain_graph), train_mode=train_mode)
+    outs = [v.grad.copy() if v.grad is not None else None for v in variables]
+    for v, (g, req) in zip(variables, saved):
+        v._grad, v._grad_req = g, req
+    return outs
+
+
+def get_symbol(x):  # MXNet API parity; no nnvm graph here
+    raise NotImplementedError("use mxnet_tpu.symbol for graph capture")

@@ -1,0 +1,97 @@
+"""Shared utilities: dtype handling, jit cache, op registry.
+
+The op registry is the TPU-native analogue of MXNet's operator registration
+(ref: nnvm/src/core/op.cc, src/operator/*-inl.h NNVM_REGISTER_OP): every pure
+functional op registers once and both front-ends (imperative ``nd`` and the
+traced/hybridized path) are generated from it.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, NamedTuple
+
+import jax
+import numpy as np
+
+string_types = (str,)
+
+_DTYPE_ALIASES = {
+    "float16": np.float16,
+    "bfloat16": jax.numpy.bfloat16,
+    "float32": np.float32,
+    "float64": np.float64,
+    "int8": np.int8,
+    "uint8": np.uint8,
+    "int16": np.int16,
+    "int32": np.int32,
+    "int64": np.int64,
+    "bool": np.bool_,
+}
+
+
+def resolve_dtype(dtype):
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        return _DTYPE_ALIASES.get(dtype, np.dtype(dtype).type)
+    return dtype
+
+
+def _freeze(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    if isinstance(v, np.ndarray):
+        return ("__np__", v.shape, str(v.dtype), v.tobytes())
+    return v
+
+
+_JIT_CACHE: Dict = {}
+
+
+def jitted(fn: Callable, static_kwargs: dict, device=None):
+    """Return a cached jitted callable of ``fn`` with the given static kwargs
+    closed over. Equivalent role to MXNet's cached op handles for imperative
+    invocation (ref: src/imperative/imperative.cc:InvokeOp)."""
+    key = (fn, _freeze(static_kwargs), device)
+    cached = _JIT_CACHE.get(key)
+    if cached is None:
+        f = functools.partial(fn, **static_kwargs) if static_kwargs else fn
+        cached = jax.jit(f, device=device) if device is not None else jax.jit(f)
+        _JIT_CACHE[key] = cached
+    return cached
+
+
+class OpDef(NamedTuple):
+    name: str
+    fn: Callable
+    # kwargs listed here are array-valued (traced); everything else static
+    array_kwargs: tuple = ()
+    # ops that need an rng key get one injected as kwarg `key`
+    needs_rng: bool = False
+    # ops that need the training flag get kwarg `training`
+    needs_training: bool = False
+    # number of outputs that are differentiable (None = all)
+    nondiff: bool = False
+
+
+OP_REGISTRY: Dict[str, OpDef] = {}
+
+
+def register_op(name=None, array_kwargs=(), needs_rng=False, needs_training=False, nondiff=False):
+    def deco(fn):
+        opname = name or fn.__name__
+        OP_REGISTRY[opname] = OpDef(opname, fn, tuple(array_kwargs), needs_rng, needs_training, nondiff)
+        return fn
+
+    return deco
+
+
+class MXNetError(RuntimeError):
+    pass
+
+
+def check_call(ret):
+    if ret != 0:
+        raise MXNetError("native call failed with code %d" % ret)

@@ -1,0 +1,130 @@
+"""Device context model.
+
+TPU-native equivalent of MXNet's ``Context`` (ref: include/mxnet/base.h:87,
+python/mxnet/context.py). In MXNet a Context names a device (cpu/gpu) and every
+NDArray lives on one; kernels are launched by the ThreadedEngine onto that
+device's stream. Here a Context maps onto a ``jax.Device``; ordering/async
+semantics are delegated to XLA's per-device program order.
+
+``mx.gpu()`` is kept as an alias for the accelerator so reference user code
+ports unchanged; ``mx.tpu()`` is the first-class accelerator context.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_tls = threading.local()
+
+
+def _accel_devices():
+    for plat in ("tpu", "axon", "gpu"):
+        try:
+            devs = jax.devices(plat)
+            if devs:
+                return devs
+        except RuntimeError:
+            continue
+    return jax.devices()
+
+
+class Context:
+    devtype2str = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 4: "tpu"}
+    devstr2type = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "tpu": 4}
+
+    def __init__(self, device_type, device_id=0):
+        if isinstance(device_type, Context):
+            device_type, device_id = device_type.device_type, device_type.device_id
+        if isinstance(device_type, int):
+            device_type = self.devtype2str[device_type]
+        if device_type not in self.devstr2type:
+            raise ValueError("unknown device type %r" % (device_type,))
+        self.device_type = device_type
+        self.device_id = device_id
+
+    @property
+    def device_typeid(self):
+        return self.devstr2type[self.device_type]
+
+    def jax_device(self):
+        """Resolve to a concrete jax.Device."""
+        if self.device_type in ("cpu", "cpu_pinned"):
+            try:
+                devs = jax.devices("cpu")
+            except RuntimeError:
+                devs = jax.devices()
+        else:  # gpu/tpu both mean "the accelerator" on this stack
+            devs = _accel_devices()
+        return devs[min(self.device_id, len(devs) - 1)]
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Context)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self):
+        return "%s(%d)" % (self.device_type, self.device_id)
+
+    def __enter__(self):
+        if not hasattr(_tls, "stack"):
+            _tls.stack = []
+        _tls.stack.append(self)
+        return self
+
+    def __exit__(self, *a):
+        _tls.stack.pop()
+
+    @classmethod
+    def default_ctx(cls):
+        stack = getattr(_tls, "stack", None)
+        if stack:
+            return stack[-1]
+        return _default
+
+
+def cpu(device_id=0):
+    return Context("cpu", device_id)
+
+
+def cpu_pinned(device_id=0):
+    return Context("cpu_pinned", device_id)
+
+
+def gpu(device_id=0):
+    return Context("gpu", device_id)
+
+
+def tpu(device_id=0):
+    return Context("tpu", device_id)
+
+
+def num_gpus():
+    try:
+        return len(_accel_devices())
+    except RuntimeError:
+        return 0
+
+
+def num_tpus():
+    return num_gpus()
+
+
+def current_context():
+    return Context.default_ctx()
+
+
+def context_from_device(dev) -> Context:
+    if dev.platform == "cpu":
+        return cpu(dev.id)
+    return tpu(dev.id)
+
+
+# Default context: the accelerator if present, else cpu — unlike MXNet (cpu
+# default) because on this stack there is always exactly one sensible device.
+_default = Context("tpu", 0) if jax.default_backend() != "cpu" else Context("cpu", 0)

@@ -1,0 +1,127 @@
+"""Execution engine facade (ref: src/engine/threaded_engine_perdevice.cc).
+
+Device-side ordering/async is XLA's job (per-device program order; dispatch is
+asynchronous — MXNet's ThreadedEngine exists to do exactly this for CUDA
+streams). What remains for a host engine is the *host-side* pipeline: decode,
+augment, batching, file IO. That runs on the native C++ dependency engine
+(src/engine_cc/dep_engine.cc) with per-variable RW dependency tracking,
+mirroring ThreadedEngine's Push(fn, const_vars, mutable_vars) API, with a
+Python thread-pool fallback when the .so isn't built.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+def set_bulk_size(size):
+    """XLA fuses inside jit; bulking is a no-op (ref: engine.cc:SetBulkSize)."""
+    return size
+
+
+_lib = None
+_lib_tried = False
+
+
+def _native():
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    so = os.path.join(os.path.dirname(__file__), "..", "src", "engine_cc", "libmxtpu.so")
+    so = os.path.abspath(so)
+    if os.path.exists(so):
+        try:
+            lib = ctypes.CDLL(so)
+            lib.mxtpu_engine_create.restype = ctypes.c_void_p
+            lib.mxtpu_engine_create.argtypes = [ctypes.c_int]
+            lib.mxtpu_engine_push.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.POINTER(ctypes.c_long), ctypes.c_int,
+                ctypes.POINTER(ctypes.c_long), ctypes.c_int]
+            lib.mxtpu_engine_wait_all.argtypes = [ctypes.c_void_p]
+            lib.mxtpu_engine_destroy.argtypes = [ctypes.c_void_p]
+            _lib = lib
+        except OSError:
+            _lib = None
+    return _lib
+
+
+_CALLBACK = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+
+class NativeEngine:
+    """Dependency-tracked host task engine. Push(fn, const_vars, mutable_vars)
+    runs fn once all writes to const_vars and all accesses to mutable_vars
+    before it are done — MXNet's exact dependency rule
+    (ref: include/mxnet/engine.h:PushAsync)."""
+
+    def __init__(self, num_threads=4):
+        lib = _native()
+        self._lib = lib
+        self._keep = []
+        if lib:
+            self._h = lib.mxtpu_engine_create(num_threads)
+        else:
+            self._h = None
+            self._pool = ThreadPoolExecutor(num_threads)
+            self._var_locks = {}
+            self._guard = threading.Lock()
+            self._futures = []
+
+    def new_variable(self):
+        if self._h:
+            return len(self._keep) + 1000  # ids are arbitrary tokens
+        with self._guard:
+            vid = len(self._var_locks)
+            self._var_locks[vid] = threading.Lock()
+            return vid
+
+    def push(self, fn, const_vars=(), mutable_vars=()):
+        if self._h:
+            cb = _CALLBACK(lambda _: fn())
+            self._keep.append(cb)
+            cv = (ctypes.c_long * len(const_vars))(*const_vars)
+            mv = (ctypes.c_long * len(mutable_vars))(*mutable_vars)
+            self._lib.mxtpu_engine_push(self._h, ctypes.cast(cb, ctypes.c_void_p),
+                                        cv, len(const_vars), mv, len(mutable_vars))
+        else:
+            locks = [self._var_locks[v] for v in mutable_vars]
+
+            def task():
+                for lk in locks:
+                    lk.acquire()
+                try:
+                    fn()
+                finally:
+                    for lk in reversed(locks):
+                        lk.release()
+
+            self._futures.append(self._pool.submit(task))
+
+    def wait_all(self):
+        if self._h:
+            self._lib.mxtpu_engine_wait_all(self._h)
+        else:
+            for f in self._futures:
+                f.result()
+            self._futures = []
+
+    def __del__(self):
+        try:
+            if self._h and self._lib:
+                self._lib.mxtpu_engine_destroy(self._h)
+        except Exception:
+            pass
+
+
+_default_engine = None
+
+
+def default_engine():
+    global _default_engine
+    if _default_engine is None:
+        _default_engine = NativeEngine()
+    return _default_engine

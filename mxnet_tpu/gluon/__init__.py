@@ -1,0 +1,12 @@
+"""Gluon API (ref: python/mxnet/gluon/__init__.py)."""
+from . import parameter
+from .parameter import Parameter, ParameterDict, Constant
+from . import block
+from .block import Block, HybridBlock, SymbolBlock
+from . import nn
+from . import rnn
+from . import loss
+from .trainer import Trainer
+from . import utils
+from . import data
+from . import model_zoo

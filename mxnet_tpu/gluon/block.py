@@ -1,0 +1,369 @@
+"""Block / HybridBlock (ref: python/mxnet/gluon/block.py).
+
+MXNet's HybridBlock.hybridize() traces ``hybrid_forward(F, ...)`` with F=mx.sym
+into an nnvm graph executed by CachedOp (ref: gluon/block.py:1094,
+src/imperative/cached_op.cc). The TPU-native equivalent traces the same
+``hybrid_forward`` with F = the functional facade (mxnet_tpu/_trace.py) under
+``jax.jit``: the whole subtree becomes ONE XLA program — fused, MXU-tiled,
+async. Train-mode, RNG keys, and BatchNorm running-stat updates are threaded
+explicitly so the program stays pure:
+
+    pure(param_arrays, key, *inputs) -> (outputs, state_updates)
+
+Under ``autograd.record()`` the compiled call is recorded as a single tape node
+whose backward is the jitted VJP — so imperative-style training loops get
+compiled gradients (MXNet: Imperative::Backward over the CachedOp graph).
+"""
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+
+from .. import autograd, random as _random
+from .. import _trace
+from ..ndarray import NDArray
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+_naming = threading.local()
+
+
+def _auto_name(hint):
+    if not hasattr(_naming, "counters"):
+        _naming.counters = {}
+    cnt = _naming.counters.get(hint, 0)
+    _naming.counters[hint] = cnt + 1
+    return "%s%d_" % (hint, cnt)
+
+
+class _BlockScope:
+    _tls = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+
+    @staticmethod
+    def current():
+        stack = getattr(_BlockScope._tls, "stack", None)
+        return stack[-1] if stack else None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = _BlockScope.current()
+        if current is None:
+            if prefix is None:
+                prefix = _auto_name(hint)
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params._prefix, params)
+            return prefix, params
+        if prefix is None:
+            cnt = current._counter.get(hint, 0)
+            current._counter[hint] = cnt + 1
+            prefix = "%s%d_" % (hint, cnt)
+        full_prefix = current._block.prefix + prefix
+        if params is None:
+            params = ParameterDict(full_prefix)
+        else:
+            params = ParameterDict(params._prefix, params)
+        return full_prefix, params
+
+    def __enter__(self):
+        if not hasattr(_BlockScope._tls, "stack"):
+            _BlockScope._tls.stack = []
+        _BlockScope._tls.stack.append(self)
+        return self
+
+    def __exit__(self, *a):
+        _BlockScope._tls.stack.pop()
+
+
+class Block:
+    """(ref: gluon/block.py:Block)"""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = OrderedDict()
+        self._reg_params = OrderedDict()
+        self._forward_hooks = []
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def params(self):
+        return self._params
+
+    def name_scope(self):
+        return self._scope
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = self.__dict__.get("_children")
+            if existing is not None:
+                existing[name] = value
+        elif isinstance(value, Parameter):
+            reg = self.__dict__.get("_reg_params")
+            if reg is not None:
+                reg[name] = value
+        super().__setattr__(name, value)
+
+    def register_child(self, block, name=None):
+        self._children[name or str(len(self._children))] = block
+        return block
+
+    def collect_params(self, select=None):
+        ret = ParameterDict(self._params._prefix)
+        if select:
+            pattern = re.compile(select)
+            ret.update({k: v for k, v in self._own_items() if pattern.match(k)})
+        else:
+            ret.update(dict(self._own_items()))
+        for child in self._children.values():
+            ret.update(child.collect_params(select=select)._params)
+        return ret
+
+    def _own_items(self):
+        items = list(self._params.items())
+        seen = {id(p) for _, p in items}
+        for p in self._reg_params.values():
+            if id(p) not in seen:
+                items.append((p.name, p))
+        return items
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for p in self._reg_params.values():
+            p.cast(dtype)
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def save_parameters(self, filename, deduplicate=False):
+        self.collect_params().save(filename, strip_prefix=self.prefix)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False, dtype_source="current"):
+        self.collect_params().load(filename, ctx, allow_missing, ignore_extra,
+                                   restore_prefix=self.prefix)
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+
+    def __call__(self, *args, **kwargs):
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        out = self(*inputs)
+        n_params = builtins_sum(int(jnp.size(p.data()._data))
+                                for p in self.collect_params().values()
+                                if p._data is not None)
+        print("Total params: %d" % n_params)
+        return out
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)" if self._children else "{name}()"
+        modstr = "\n".join("  ({key}): {block}".format(key=k, block=_indent(repr(b)))
+                           for k, b in self._children.items())
+        return s.format(name=self.__class__.__name__, modstr=modstr)
+
+
+def builtins_sum(it):
+    tot = 0
+    for v in it:
+        tot += v
+    return tot
+
+
+def _indent(s):
+    return s.replace("\n", "\n  ")
+
+
+class HybridBlock(Block):
+    """(ref: gluon/block.py:HybridBlock)"""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_execs = {}  # training(bool) -> (jitted, plist)
+
+    def hybridize(self, active=True, **kwargs):
+        self._active = active
+        self._cached_execs = {}
+        super().hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        self._cached_execs = {}
+        super().cast(dtype)
+
+    def infer_shape(self, *args):
+        """Layer hook: set deferred param shapes from input shapes."""
+
+    def _ensure_params(self, *args):
+        need = [p for p in self._reg_params.values() if p._data is None]
+        if need:
+            shaped = [a for a in args if isinstance(a, NDArray)]
+            self.infer_shape(*shaped)
+            for p in need:
+                if p._deferred_init is not None and p._shape_known():
+                    p._finish_deferred_init()
+
+    def __call__(self, *args, **kwargs):
+        tctx = _trace.current_trace()
+        if tctx is not None and getattr(tctx, "param_store", None) is not None:
+            return self._call_traced(*args, **kwargs)
+        return super().__call__(*args, **kwargs)
+
+    # ------------------------------------------------------------ imperative
+    def forward(self, *args, **kwargs):
+        from .. import nd as _nd
+
+        self._ensure_params(*args)
+        if self._active:
+            try:
+                return self._call_compiled(*args)
+            except _NotReady:
+                pass  # fall through: imperative warmup materializes deferred params
+        pkwargs = {n: p.data() for n, p in self._reg_params.items()}
+        return self.hybrid_forward(_nd, *args, **pkwargs, **kwargs)
+
+    def hybrid_forward(self, F, *args, **kwargs):
+        raise NotImplementedError
+
+    # ------------------------------------------------------------ traced
+    def _call_traced(self, *args, **kwargs):
+        tctx = _trace.current_trace()
+        pkwargs = {n: tctx.param_store[id(p)] for n, p in self._reg_params.items()}
+        return self.hybrid_forward(_trace.F, *args, **pkwargs, **kwargs)
+
+    # ------------------------------------------------------------ compiled
+    def _get_exec(self, training, plist):
+        cached = self._cached_execs.get(training)
+        if cached is not None:
+            return cached
+
+        def pure(pa, key, *xs):
+            with _trace.trace_scope(key, training) as tctx:
+                tctx.param_store = {id(p): a for p, a in zip(plist, pa)}
+                out = self._call_traced(*xs)
+                upd = [tctx.state_updates.get(id(p)) for p in plist]
+            return out, upd
+
+        fn = jax.jit(pure)
+        self._cached_execs[training] = (fn, plist)
+        return fn, plist
+
+    def _call_compiled(self, *args):
+        params = self.collect_params()
+        plist = list(params.values())
+        for p in plist:
+            if p._data is None:
+                if p._deferred_init is not None and p._shape_known():
+                    p._finish_deferred_init()
+                else:
+                    raise _NotReady()
+        training = autograd.is_training()
+        fn, plist = self._get_exec(training, plist)
+        pa = [p._data._data for p in plist]
+        xs = [a._data if isinstance(a, NDArray) else a for a in args]
+        key = _random.next_key()
+
+        if autograd.is_recording():
+            def f(pa_, *xs_):
+                out, upd = fn(pa_, key, *xs_)
+                return out, upd
+
+            out, vjp_fn, upd = jax.vjp(f, pa, *xs, has_aux=True)
+            outs_flat, treedef = jax.tree_util.tree_flatten(out)
+            wrapped = [NDArray(o) for o in outs_flat]
+            node_inputs = [p._data for p in plist] + [a for a in args if isinstance(a, NDArray)]
+            nd_arg_pos = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
+
+            def flat_vjp(cot, _treedef=treedef, _n=len(outs_flat)):
+                cot_tree = jax.tree_util.tree_unflatten(
+                    _treedef, list(cot) if isinstance(cot, tuple) else [cot])
+                pa_cots, *x_cots = vjp_fn(cot_tree)
+                sel = [x_cots[i] for i in nd_arg_pos]
+                return tuple(pa_cots) + tuple(sel)
+
+            autograd.append_node(autograd.TapeNode(node_inputs, wrapped, flat_vjp))
+            result = jax.tree_util.tree_unflatten(treedef, wrapped)
+        else:
+            out, upd = fn(pa, key, *xs)
+            result = jax.tree_util.tree_map(NDArray, out)
+
+        for p, u in zip(plist, upd):
+            if u is not None:
+                val = u if isinstance(u, jax.Array) else jnp.asarray(u)
+                p._data._data = val
+        return result
+
+
+class _NotReady(Exception):
+    pass
+
+
+def param_value(param):
+    """Mode-aware access to a Parameter's value: raw traced array inside a
+    hybridize trace, NDArray imperatively. Used for weight tying across
+    blocks (e.g. BERT's MLM decoder tied to word_embed)."""
+    tctx = _trace.current_trace()
+    if tctx is not None and getattr(tctx, "param_store", None) is not None:
+        return tctx.param_store[id(param)]
+    return param.data()
+
+
+class SymbolBlock(HybridBlock):
+    """Construct a block from a Symbol graph (ref: gluon/block.py:SymbolBlock)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix="", params=params)
+        self._outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        self._inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+
+    def forward(self, *args):
+        from ..symbol import _eval_symbols
+
+        feed = {s.name: (a._data if isinstance(a, NDArray) else a)
+                for s, a in zip(self._inputs, args)}
+        for name, p in self.collect_params().items():
+            feed[name] = p.data()._data
+        outs = _eval_symbols(self._outputs, feed)
+        outs = [NDArray(o) for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+    def hybrid_forward(self, F, *args, **kwargs):
+        raise RuntimeError("SymbolBlock executes its graph directly")

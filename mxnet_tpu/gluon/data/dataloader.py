@@ -1,0 +1,85 @@
+"""DataLoader with background prefetch (ref: python/mxnet/gluon/data/dataloader.py).
+
+MXNet uses multiprocessing workers feeding a queue. Host-side batching here is
+numpy (cheap); the important TPU-side property is keeping the device fed:
+the loader prefetches batches on a thread pool (the C++ host engine in
+src/engine_cc provides the dependency-tracked task queue when built) and the
+training loop overlaps host batching with device compute thanks to async
+dispatch.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from ...ndarray import NDArray, array
+from .dataset import Dataset
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """(ref: dataloader.py:default_batchify_fn)"""
+    if isinstance(data[0], NDArray):
+        return array(np.stack([d.asnumpy() for d in data]))
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(list(i)) for i in data]
+    arr = np.asarray(data)
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    return array(arr)
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None, thread_pool=True):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size required when batch_sampler is None")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must be False with custom sampler")
+            batch_sampler = BatchSampler(sampler, batch_size, last_batch or "keep")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = num_workers
+        self._prefetch = max(0, prefetch if prefetch is not None else 2 * max(num_workers, 1))
+
+    def _make_batch(self, indices):
+        return self._batchify_fn([self._dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for indices in self._batch_sampler:
+                yield self._make_batch(indices)
+            return
+        yield from self._prefetch_iter()
+
+    def _prefetch_iter(self):
+        q = queue.Queue(maxsize=self._prefetch)
+        sentinel = object()
+
+        def worker():
+            try:
+                for indices in self._batch_sampler:
+                    q.put(self._make_batch(indices))
+            finally:
+                q.put(sentinel)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is sentinel:
+                break
+            yield item
+
+    def __len__(self):
+        return len(self._batch_sampler)

@@ -1,0 +1,146 @@
+"""Vision datasets (ref: python/mxnet/gluon/data/vision/datasets.py).
+
+Download is unavailable in this environment (zero egress): datasets read local
+files in the standard formats when present, else fall back to deterministic
+synthetic data (``synthetic=True`` by default when files are absent) so
+training pipelines and benchmarks run self-contained.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ..dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100", "ImageFolderDataset"]
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, train, transform):
+        self._root = os.path.expanduser(root)
+        self._train = train
+        self._transform = transform
+        self._data = None
+        self._label = None
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(self._data[idx], self._label[idx])
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+
+class MNIST(_DownloadedDataset):
+    """(ref: datasets.py:MNIST); idx-gz files if present, else synthetic."""
+
+    _shape = (28, 28, 1)
+    _classes = 10
+
+    def __init__(self, root="~/.mxnet/datasets/mnist", train=True, transform=None,
+                 synthetic_size=1024):
+        self._synthetic_size = synthetic_size
+        super().__init__(root, train, transform)
+
+    def _file_names(self):
+        if self._train:
+            return "train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz"
+        return "t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz"
+
+    def _get_data(self):
+        img_f, lbl_f = self._file_names()
+        img_p = os.path.join(self._root, img_f)
+        lbl_p = os.path.join(self._root, lbl_f)
+        if os.path.exists(img_p) and os.path.exists(lbl_p):
+            with gzip.open(lbl_p, "rb") as f:
+                struct.unpack(">II", f.read(8))
+                label = np.frombuffer(f.read(), dtype=np.uint8).astype(np.int32)
+            with gzip.open(img_p, "rb") as f:
+                _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                data = np.frombuffer(f.read(), dtype=np.uint8).reshape(n, rows, cols, 1)
+            self._data, self._label = data, label
+        else:
+            rng = np.random.RandomState(0 if self._train else 1)
+            n = self._synthetic_size
+            self._data = rng.randint(0, 256, (n,) + self._shape, dtype=np.uint8)
+            self._label = rng.randint(0, self._classes, n).astype(np.int32)
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root="~/.mxnet/datasets/fashion-mnist", train=True,
+                 transform=None, synthetic_size=1024):
+        super().__init__(root, train, transform, synthetic_size)
+
+
+class CIFAR10(_DownloadedDataset):
+    """(ref: datasets.py:CIFAR10); binary batches if present, else synthetic."""
+
+    _shape = (32, 32, 3)
+    _classes = 10
+
+    def __init__(self, root="~/.mxnet/datasets/cifar10", train=True, transform=None,
+                 synthetic_size=1024):
+        self._synthetic_size = synthetic_size
+        super().__init__(root, train, transform)
+
+    def _get_data(self):
+        files = (["data_batch_%d.bin" % i for i in range(1, 6)]
+                 if self._train else ["test_batch.bin"])
+        paths = [os.path.join(self._root, "cifar-10-batches-bin", f) for f in files]
+        if all(os.path.exists(p) for p in paths):
+            data, label = [], []
+            for p in paths:
+                raw = np.frombuffer(open(p, "rb").read(), dtype=np.uint8).reshape(-1, 3073)
+                label.append(raw[:, 0].astype(np.int32))
+                data.append(raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+            self._data = np.concatenate(data)
+            self._label = np.concatenate(label)
+        else:
+            rng = np.random.RandomState(2 if self._train else 3)
+            n = self._synthetic_size
+            self._data = rng.randint(0, 256, (n,) + self._shape, dtype=np.uint8)
+            self._label = rng.randint(0, self._classes, n).astype(np.int32)
+
+
+class CIFAR100(CIFAR10):
+    _classes = 100
+
+    def __init__(self, root="~/.mxnet/datasets/cifar100", fine_label=False,
+                 train=True, transform=None, synthetic_size=1024):
+        super().__init__(root, train, transform, synthetic_size)
+
+
+class ImageFolderDataset(Dataset):
+    """(ref: datasets.py:ImageFolderDataset) — folder-per-class layout."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._transform = transform
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(self._root)):
+            path = os.path.join(self._root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                if filename.lower().endswith((".jpg", ".jpeg", ".png", ".npy")):
+                    self.items.append((os.path.join(path, filename), label))
+
+    def __getitem__(self, idx):
+        from ....image import imread_np
+
+        path, label = self.items[idx]
+        img = np.load(path) if path.endswith(".npy") else imread_np(path)
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)

@@ -1,0 +1,122 @@
+"""Vision transforms (ref: python/mxnet/gluon/data/vision/transforms.py).
+
+Transforms run on host numpy (per-sample, pre-batch) — on TPU the batch-level
+augmentation belongs in the compiled step where possible; these provide MXNet
+API parity for per-sample pipelines.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ....ndarray import NDArray, array
+from ...block import Block
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom"]
+
+
+def _np(x):
+    return x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+
+
+class Compose:
+    def __init__(self, transforms):
+        self._transforms = transforms
+
+    def __call__(self, x):
+        for t in self._transforms:
+            x = t(x)
+        return x
+
+
+class Cast:
+    def __init__(self, dtype="float32"):
+        self._dtype = dtype
+
+    def __call__(self, x):
+        return array(_np(x).astype(self._dtype), dtype=self._dtype)
+
+
+class ToTensor:
+    """HWC uint8 [0,255] → CHW float32 [0,1] (ref: transforms.py:ToTensor)."""
+
+    def __call__(self, x):
+        a = _np(x).astype(np.float32) / 255.0
+        if a.ndim == 3:
+            a = a.transpose(2, 0, 1)
+        return array(a)
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0):
+        self._mean = np.asarray(mean, np.float32).reshape(-1, 1, 1)
+        self._std = np.asarray(std, np.float32).reshape(-1, 1, 1)
+
+    def __call__(self, x):
+        return array((_np(x) - self._mean) / self._std)
+
+
+def _resize(img, size):
+    from ....image import imresize_np
+
+    return imresize_np(img, size[0], size[1])
+
+
+class Resize:
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        self._size = (size, size) if isinstance(size, int) else size
+
+    def __call__(self, x):
+        return array(_resize(_np(x), self._size))
+
+
+class CenterCrop:
+    def __init__(self, size, interpolation=1):
+        self._size = (size, size) if isinstance(size, int) else size
+
+    def __call__(self, x):
+        a = _np(x)
+        h, w = a.shape[:2]
+        tw, th = self._size
+        x0 = max((w - tw) // 2, 0)
+        y0 = max((h - th) // 2, 0)
+        return array(a[y0:y0 + th, x0:x0 + tw])
+
+
+class RandomResizedCrop:
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3), interpolation=1):
+        self._size = (size, size) if isinstance(size, int) else size
+        self._scale = scale
+        self._ratio = ratio
+
+    def __call__(self, x):
+        a = _np(x)
+        h, w = a.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = np.random.uniform(*self._scale) * area
+            aspect = np.random.uniform(*self._ratio)
+            nw = int(round(np.sqrt(target_area * aspect)))
+            nh = int(round(np.sqrt(target_area / aspect)))
+            if nw <= w and nh <= h:
+                x0 = np.random.randint(0, w - nw + 1)
+                y0 = np.random.randint(0, h - nh + 1)
+                crop = a[y0:y0 + nh, x0:x0 + nw]
+                return array(_resize(crop, self._size))
+        return array(_resize(a, self._size))
+
+
+class RandomFlipLeftRight:
+    def __call__(self, x):
+        a = _np(x)
+        if np.random.rand() < 0.5:
+            a = a[:, ::-1].copy()
+        return array(a)
+
+
+class RandomFlipTopBottom:
+    def __call__(self, x):
+        a = _np(x)
+        if np.random.rand() < 0.5:
+            a = a[::-1].copy()
+        return array(a)

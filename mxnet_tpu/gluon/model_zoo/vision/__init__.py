@@ -1,0 +1,35 @@
+"""Vision model zoo (ref: python/mxnet/gluon/model_zoo/vision/__init__.py)."""
+from .resnet import (ResNetV1, ResNetV2, resnet18_v1, resnet34_v1,  # noqa: F401
+                     resnet50_v1, resnet101_v1, resnet152_v1, resnet18_v2,
+                     resnet34_v2, resnet50_v2, resnet101_v2, resnet152_v2,
+                     get_resnet)
+
+_models = {}
+
+
+def get_model(name, **kwargs):
+    """(ref: model_zoo/vision/__init__.py:get_model)"""
+    from . import resnet, vgg, alexnet, mobilenet, squeezenet, densenet, inception
+
+    registry = {
+        "resnet18_v1": resnet.resnet18_v1, "resnet34_v1": resnet.resnet34_v1,
+        "resnet50_v1": resnet.resnet50_v1, "resnet101_v1": resnet.resnet101_v1,
+        "resnet152_v1": resnet.resnet152_v1,
+        "resnet18_v2": resnet.resnet18_v2, "resnet34_v2": resnet.resnet34_v2,
+        "resnet50_v2": resnet.resnet50_v2, "resnet101_v2": resnet.resnet101_v2,
+        "resnet152_v2": resnet.resnet152_v2,
+        "vgg11": vgg.vgg11, "vgg13": vgg.vgg13, "vgg16": vgg.vgg16,
+        "vgg19": vgg.vgg19, "vgg11_bn": vgg.vgg11_bn, "vgg13_bn": vgg.vgg13_bn,
+        "vgg16_bn": vgg.vgg16_bn, "vgg19_bn": vgg.vgg19_bn,
+        "alexnet": alexnet.alexnet,
+        "mobilenet1.0": mobilenet.mobilenet1_0, "mobilenet0.5": mobilenet.mobilenet0_5,
+        "mobilenet0.25": mobilenet.mobilenet0_25,
+        "mobilenetv2_1.0": mobilenet.mobilenet_v2_1_0,
+        "squeezenet1.0": squeezenet.squeezenet1_0,
+        "squeezenet1.1": squeezenet.squeezenet1_1,
+        "densenet121": densenet.densenet121, "densenet169": densenet.densenet169,
+        "inceptionv3": inception.inception_v3,
+    }
+    if name.lower() not in registry:
+        raise ValueError("model %s not found; available: %s" % (name, sorted(registry)))
+    return registry[name.lower()](**kwargs)

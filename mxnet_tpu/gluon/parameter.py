@@ -1,0 +1,264 @@
+"""Parameter / ParameterDict (ref: python/mxnet/gluon/parameter.py).
+
+A Parameter owns one NDArray (plus grad). Deferred initialization works as in
+MXNet: unknown dims are 0 until the first forward infers them. On TPU the
+interesting additions are ``sharding`` (a PartitionSpec hint consumed by
+mxnet_tpu.parallel when building compiled distributed train steps) and bf16
+casting for AMP.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import initializer as init_mod
+from ..base import resolve_dtype
+from ..context import current_context
+from ..ndarray import NDArray, zeros
+
+
+class DeferredInitializationError(RuntimeError):
+    pass
+
+
+class Parameter:
+    def __init__(self, name, grad_req="write", shape=None, dtype="float32",
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default",
+                 sharding=None):
+        self.name = name
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = resolve_dtype(dtype)
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self.grad_req = grad_req if differentiable else "null"
+        self._differentiable = differentiable
+        self.sharding = sharding  # PartitionSpec hint for mxnet_tpu.parallel
+        self._data = None  # NDArray
+        self._deferred_init = None  # (init, ctx)
+
+    # ------------------------------------------------------------- shape
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        assert len(self._shape) == len(new_shape) and all(
+            s == 0 or s == n for s, n in zip(self._shape, new_shape)
+        ), "inferred shape %s incompatible with declared %s for %s" % (
+            new_shape, self._shape, self.name)
+        self._shape = tuple(new_shape)
+
+    def _shape_known(self):
+        return self._shape is not None and all(s > 0 for s in self._shape)
+
+    # ------------------------------------------------------------- init
+    def initialize(self, init=None, ctx=None, default_init=None, force_reinit=False):
+        if self._data is not None and not force_reinit:
+            return
+        default_init = default_init or init_mod.Uniform()
+        self._deferred_init = (init or self.init or default_init, ctx or current_context())
+        if self._shape_known():
+            self._finish_deferred_init()
+        elif not self.allow_deferred_init:
+            raise ValueError("shape of Parameter %s unknown and deferred init not allowed"
+                             % self.name)
+
+    def _finish_deferred_init(self):
+        if self._deferred_init is None:
+            return
+        initializer, ctx = self._deferred_init
+        arr = zeros(self._shape, ctx=ctx, dtype=self.dtype)
+        if isinstance(initializer, str):
+            initializer = init_mod.create(initializer)
+        initializer(init_mod.InitDesc(self.name), arr)
+        arr._data = arr._data.astype(self.dtype)
+        self._data = arr
+        self._deferred_init = None
+        if self.grad_req != "null":
+            self._data.attach_grad(self.grad_req)
+
+    def _maybe_finish(self):
+        if self._data is None:
+            if self._deferred_init is not None and self._shape_known():
+                self._finish_deferred_init()
+            else:
+                raise DeferredInitializationError(
+                    "Parameter %s not initialized (call .initialize(), and ensure "
+                    "shape is inferable)" % self.name)
+
+    # ------------------------------------------------------------- access
+    def data(self, ctx=None):
+        self._maybe_finish()
+        return self._data
+
+    def list_data(self):
+        return [self.data()]
+
+    def set_data(self, data):
+        if not isinstance(data, NDArray):
+            data = NDArray(jnp.asarray(data, dtype=self.dtype))
+        if self._data is None:
+            self._shape = tuple(data.shape)
+            self._data = data
+            if self.grad_req != "null":
+                self._data.attach_grad(self.grad_req)
+            self._deferred_init = None
+        else:
+            self._data._data = data._data.astype(self.dtype)
+
+    def grad(self, ctx=None):
+        self._maybe_finish()
+        return self._data.grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def zero_grad(self):
+        if self._data is not None and self._data.grad is not None:
+            self._data.grad._data = jnp.zeros_like(self._data.grad._data)
+
+    def list_ctx(self):
+        return [self.data().context] if self._data is not None else []
+
+    def reset_ctx(self, ctx):
+        if self._data is not None:
+            self._data = self._data.as_in_context(ctx)
+
+    def cast(self, dtype):
+        self.dtype = resolve_dtype(dtype)
+        if self._data is not None:
+            g = self._data.grad
+            self._data._data = self._data._data.astype(self.dtype)
+            if g is not None:
+                self._data._grad = NDArray(jnp.zeros(self._data.shape, self.dtype))
+
+    def var(self):
+        from ..symbol import Symbol, var
+
+        return var(self.name, shape=self.shape, dtype=self.dtype)
+
+    def __repr__(self):
+        return "Parameter %s (shape=%s, dtype=%s)" % (self.name, self._shape, self.dtype)
+
+
+class Constant(Parameter):
+    """Non-differentiable constant parameter (ref: gluon/parameter.py:Constant)."""
+
+    def __init__(self, name, value):
+        value = np.asarray(value)
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype, differentiable=False)
+        self._value = value
+        self.init = init_mod.Constant(0.0)
+
+    def initialize(self, init=None, ctx=None, default_init=None, force_reinit=False):
+        self._data = NDArray(jnp.asarray(self._value))
+
+
+class ParameterDict:
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = OrderedDict()
+        self._shared = shared
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __contains__(self, key):
+        return key in self._params
+
+    def __len__(self):
+        return len(self._params)
+
+    def get(self, name, **kwargs):
+        """Create-or-retrieve (ref: gluon/parameter.py:ParameterDict.get)."""
+        name = self._prefix + name
+        if name in self._params:
+            param = self._params[name]
+            for k, v in kwargs.items():
+                if k == "shape" and v is not None and param._shape is not None:
+                    param.shape = tuple(v)
+            return param
+        if self._shared is not None and name in self._shared:
+            self._params[name] = self._shared[name]
+            return self._shared[name]
+        param = Parameter(name, **kwargs)
+        self._params[name] = param
+        return param
+
+    def get_constant(self, name, value=None):
+        name = self._prefix + name
+        if name not in self._params:
+            self._params[name] = Constant(name, value)
+        return self._params[name]
+
+    def update(self, other):
+        for k, v in other.items():
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        for p in self.values():
+            p.initialize(init=None, ctx=ctx, default_init=init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for p in self.values():
+            p.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for p in self.values():
+            p.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for p in self.values():
+            setattr(p, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        arg = {}
+        for name, p in self.items():
+            if p._data is None:
+                continue
+            n = name[len(strip_prefix):] if name.startswith(strip_prefix) else name
+            arg[n] = np.asarray(p.data().asnumpy())
+        np.savez(filename, **arg)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        loaded = np.load(filename if filename.endswith(".npz") else filename, allow_pickle=False)
+        loaded = {restore_prefix + k: v for k, v in loaded.items()}
+        for name, p in self.items():
+            if name in loaded:
+                p.set_data(NDArray(jnp.asarray(loaded[name])))
+            elif not allow_missing:
+                raise KeyError("Parameter %s missing in file" % name)
+        if not ignore_extra:
+            extra = set(loaded) - set(self._params)
+            if extra:
+                raise KeyError("Extra parameters in file: %s" % sorted(extra))
+
+    def __repr__(self):
+        return "ParameterDict(%s)\n" % self._prefix + "\n".join(repr(p) for p in self.values())

@@ -1,0 +1,263 @@
+"""Single-step recurrent cells (ref: python/mxnet/gluon/rnn/rnn_cell.py).
+
+Cells are HybridBlocks: a Python unroll of a cell inside a hybridized parent
+compiles to a fully unrolled XLA program; for long sequences prefer the fused
+layers (rnn_layer.py) which use lax.scan.
+"""
+from __future__ import annotations
+
+from ... import ndarray as _ndarray
+from ..block import HybridBlock
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "BidirectionalCell", "DropoutCell",
+           "ResidualCell", "ZoneoutCell"]
+
+
+class RecurrentCell(HybridBlock):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._modified = False
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, ctx=None, **kwargs):
+        func = func or _ndarray.zeros
+        return [func(info["shape"], ctx=ctx, **kwargs) for info in self.state_info(batch_size)]
+
+    def reset(self):
+        pass
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """(ref: rnn_cell.py:RecurrentCell.unroll)"""
+        from ... import nd
+
+        axis = layout.find("T")
+        if begin_state is None:
+            batch = inputs.shape[layout.find("N")]
+            begin_state = self.begin_state(batch)
+        states = begin_state
+        outputs = []
+        for t in range(length):
+            x_t = inputs.slice_axis(axis, t, t + 1).squeeze(axis)
+            out, states = self(x_t, states)
+            outputs.append(out)
+        if merge_outputs is None or merge_outputs:
+            outputs = nd.stack(*outputs, axis=axis)
+        return outputs, states
+
+
+class RNNCell(RecurrentCell):
+    def __init__(self, hidden_size, activation="tanh", input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros", **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        with self.name_scope():
+            self.i2h_weight = self.params.get("i2h_weight", shape=(hidden_size, input_size),
+                                              init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get("h2h_weight", shape=(hidden_size, hidden_size),
+                                              init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get("i2h_bias", shape=(hidden_size,),
+                                            init=i2h_bias_initializer)
+            self.h2h_bias = self.params.get("h2h_bias", shape=(hidden_size,),
+                                            init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias, num_hidden=self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias, num_hidden=self._hidden_size)
+        out = F.Activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class LSTMCell(RecurrentCell):
+    """Gate order [i, f, g, o] (ref: rnn_cell.py:LSTMCell)."""
+
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros", **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get("i2h_weight", shape=(4 * hidden_size, input_size),
+                                              init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get("h2h_weight", shape=(4 * hidden_size, hidden_size),
+                                              init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get("i2h_bias", shape=(4 * hidden_size,),
+                                            init=i2h_bias_initializer)
+            self.h2h_bias = self.params.get("h2h_bias", shape=(4 * hidden_size,),
+                                            init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)},
+                {"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        nh = self._hidden_size
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias, num_hidden=4 * nh)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias, num_hidden=4 * nh)
+        gates = i2h + h2h
+        i = F.sigmoid(F.slice_axis(gates, axis=-1, begin=0, end=nh))
+        f = F.sigmoid(F.slice_axis(gates, axis=-1, begin=nh, end=2 * nh))
+        g = F.tanh(F.slice_axis(gates, axis=-1, begin=2 * nh, end=3 * nh))
+        o = F.sigmoid(F.slice_axis(gates, axis=-1, begin=3 * nh, end=4 * nh))
+        c = f * states[1] + i * g
+        h = o * F.tanh(c)
+        return h, [h, c]
+
+
+class GRUCell(RecurrentCell):
+    """Gate order [r, z, n] (ref: rnn_cell.py:GRUCell)."""
+
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros", **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get("i2h_weight", shape=(3 * hidden_size, input_size),
+                                              init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get("h2h_weight", shape=(3 * hidden_size, hidden_size),
+                                              init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get("i2h_bias", shape=(3 * hidden_size,),
+                                            init=i2h_bias_initializer)
+            self.h2h_bias = self.params.get("h2h_bias", shape=(3 * hidden_size,),
+                                            init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (3 * self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        nh = self._hidden_size
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias, num_hidden=3 * nh)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias, num_hidden=3 * nh)
+        xr = F.slice_axis(i2h, axis=-1, begin=0, end=nh)
+        xz = F.slice_axis(i2h, axis=-1, begin=nh, end=2 * nh)
+        xn = F.slice_axis(i2h, axis=-1, begin=2 * nh, end=3 * nh)
+        hr = F.slice_axis(h2h, axis=-1, begin=0, end=nh)
+        hz = F.slice_axis(h2h, axis=-1, begin=nh, end=2 * nh)
+        hn = F.slice_axis(h2h, axis=-1, begin=2 * nh, end=3 * nh)
+        r = F.sigmoid(xr + hr)
+        z = F.sigmoid(xz + hz)
+        n = F.tanh(xn + r * hn)
+        h = (1 - z) * n + z * states[0]
+        return h, [h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        out = []
+        for cell in self._children.values():
+            out.extend(cell.state_info(batch_size))
+        return out
+
+    def __len__(self):
+        return len(self._children)
+
+    def hybrid_forward(self, F, inputs, states):
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            inputs, st = cell(inputs, states[p:p + n])
+            next_states.extend(st)
+            p += n
+        return inputs, next_states
+
+
+class BidirectionalCell(RecurrentCell):
+    def __init__(self, l_cell, r_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.l_cell = l_cell
+        self.r_cell = r_cell
+
+    def state_info(self, batch_size=0):
+        return self.l_cell.state_info(batch_size) + self.r_cell.state_info(batch_size)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        from ... import nd
+
+        axis = layout.find("T")
+        batch = inputs.shape[layout.find("N")]
+        if begin_state is None:
+            begin_state = self.begin_state(batch)
+        nl = len(self.l_cell.state_info())
+        l_out, l_states = self.l_cell.unroll(length, inputs, begin_state[:nl], layout, True)
+        rev = inputs.__class__(inputs._data[::-1] if axis == 0 else inputs._data[:, ::-1])
+        r_out, r_states = self.r_cell.unroll(length, rev, begin_state[nl:], layout, True)
+        r_out = r_out.__class__(r_out._data[::-1] if axis == 0 else r_out._data[:, ::-1])
+        out = nd.concat(l_out, r_out, dim=2)
+        return out, l_states + r_states
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def hybrid_forward(self, F, inputs, states):
+        if self._rate > 0:
+            inputs = F.Dropout(inputs, p=self._rate)
+        return inputs, states
+
+
+class ResidualCell(RecurrentCell):
+    def __init__(self, base_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def hybrid_forward(self, F, inputs, states):
+        out, states = self.base_cell(inputs, states)
+        return out + inputs, states
+
+
+class ZoneoutCell(RecurrentCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+        self._zo = zoneout_outputs
+        self._zs = zoneout_states
+        self._prev_output = None
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def hybrid_forward(self, F, inputs, states):
+        out, new_states = self.base_cell(inputs, states)
+        if self._zs > 0:
+            new_states = [s_old + F.Dropout(s_new - s_old, p=self._zs)
+                          for s_old, s_new in zip(states, new_states)]
+        if self._zo > 0:
+            out = F.Dropout(out, p=self._zo) if self._prev_output is None else out
+        return out, new_states

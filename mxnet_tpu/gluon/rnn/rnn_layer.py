@@ -1,0 +1,122 @@
+"""Multi-layer RNN/LSTM/GRU layers (ref: python/mxnet/gluon/rnn/rnn_layer.py).
+
+These wrap the fused scan op (mxnet_tpu/ops/rnn.py) — the analogue of MXNet's
+``_rnn_layer`` calling the fused cuDNN RNN operator.
+"""
+from __future__ import annotations
+
+from ... import ndarray as _ndarray
+from ...ndarray import NDArray
+from ..block import HybridBlock
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout, bidirectional,
+                 input_size, mode, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC"), "layout must be TNC or NTC"
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._mode = mode
+        self._gates = {"lstm": 4, "gru": 3, "rnn_tanh": 1, "rnn_relu": 1}[mode]
+        ng, nh = self._gates, hidden_size
+        with self.name_scope():
+            for layer in range(num_layers):
+                for d, suffix in zip(range(self._dir), ["l", "r"]):
+                    in_sz = input_size if layer == 0 else hidden_size * self._dir
+                    for name, shape, init in [
+                        ("i2h_weight", (ng * nh, in_sz if input_size else 0), i2h_weight_initializer),
+                        ("h2h_weight", (ng * nh, nh), h2h_weight_initializer),
+                        ("i2h_bias", (ng * nh,), i2h_bias_initializer),
+                        ("h2h_bias", (ng * nh,), h2h_bias_initializer),
+                    ]:
+                        pname = "%s%d_%s" % (suffix, layer, name)
+                        p = self.params.get(pname, shape=shape, init=init,
+                                            allow_deferred_init=True, dtype=dtype)
+                        setattr(self, pname, p)
+
+    def _weight_names(self):
+        names = []
+        for layer in range(self._num_layers):
+            for suffix in ["l", "r"][:self._dir]:
+                for nm in ("i2h_weight", "h2h_weight", "i2h_bias", "h2h_bias"):
+                    names.append("%s%d_%s" % (suffix, layer, nm))
+        return names
+
+    def infer_shape(self, x, *args):
+        in_sz = x.shape[-1]
+        for layer in range(self._num_layers):
+            for suffix in ["l", "r"][:self._dir]:
+                p = getattr(self, "%s%d_i2h_weight" % (suffix, layer))
+                this_in = in_sz if layer == 0 else self._hidden_size * self._dir
+                p.shape = (self._gates * self._hidden_size, this_in)
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        if self._mode == "lstm":
+            return [{"shape": shape}, {"shape": shape}]
+        return [{"shape": shape}]
+
+    def begin_state(self, batch_size=0, func=None, ctx=None, **kwargs):
+        func = func or _ndarray.zeros
+        return [func(info["shape"], ctx=ctx, **kwargs) for info in self.state_info(batch_size)]
+
+    def hybrid_forward(self, F, inputs, states=None, **params):
+        nt = self._layout == "NTC"
+        x = F.swapaxes(inputs, dim1=0, dim2=1) if nt else inputs
+        batch = x.shape[1]
+        return_states = states is not None
+        if states is None:
+            states = [F.zeros((self._num_layers * self._dir, batch, self._hidden_size))
+                      for _ in range(2 if self._mode == "lstm" else 1)]
+        if self._mode == "lstm":
+            h0, c0 = states
+        else:
+            h0 = states[0] if isinstance(states, (list, tuple)) else states
+            c0 = F.zeros_like(h0)
+        weights = [params[n] for n in self._weight_names()]
+        out, hn, cn = F.RNN(x, h0, c0, *weights, mode=self._mode,
+                            num_layers=self._num_layers,
+                            bidirectional=self._dir == 2, p=self._dropout)
+        if nt:
+            out = F.swapaxes(out, dim1=0, dim2=1)
+        if not return_states:
+            return out
+        new_states = [hn, cn] if self._mode == "lstm" else [hn]
+        return out, new_states
+
+
+class RNN(_RNNLayer):
+    """(ref: rnn_layer.py:RNN)"""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu", layout="TNC",
+                 dropout=0, bidirectional=False, input_size=0, **kwargs):
+        mode = "rnn_relu" if activation == "relu" else "rnn_tanh"
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, mode, **kwargs)
+
+
+class LSTM(_RNNLayer):
+    """(ref: rnn_layer.py:LSTM; cuDNN LSTM → lax.scan fused op)"""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, "lstm", **kwargs)
+
+
+class GRU(_RNNLayer):
+    """(ref: rnn_layer.py:GRU)"""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout, bidirectional,
+                         input_size, "gru", **kwargs)

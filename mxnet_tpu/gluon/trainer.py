@@ -1,0 +1,119 @@
+"""gluon.Trainer (ref: python/mxnet/gluon/trainer.py).
+
+MXNet's Trainer pushes grads into KVStore ('device'/'nccl' → allreduce) and
+applies optimizer updates per parameter. Here:
+
+- single-device: per-param jit-fused updates (each is one XLA kernel);
+- in-mesh data parallel: gradients already arrive psum-reduced when the
+  forward/backward ran under ``parallel.build_train_step`` (the compiled path);
+  Trainer.step also supports an explicit ``kvstore`` for API parity.
+"""
+from __future__ import annotations
+
+import pickle
+
+from .. import optimizer as opt
+from ..ndarray import NDArray
+from .parameter import Parameter, ParameterDict
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError("params must be a ParameterDict or list of Parameters")
+        self._all_params = list(params)
+        self._params = [p for p in self._all_params if p.grad_req != "null"]
+        optimizer_params = optimizer_params or {}
+        if isinstance(optimizer, opt.Optimizer):
+            self._optimizer = optimizer
+        else:
+            self._optimizer = opt.create(optimizer, **optimizer_params)
+        self._optimizer.idx2name = {i: p.name for i, p in enumerate(self._params)}
+        self._states = {}
+        self._scale = self._optimizer.rescale_grad
+        self._kvstore = None
+        if isinstance(kvstore, str) and kvstore not in ("device", "local", None):
+            from ..kvstore import create as kv_create
+
+            self._kvstore = kv_create(kvstore)
+        elif not isinstance(kvstore, str) and kvstore is not None:
+            self._kvstore = kvstore
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def allreduce_grads(self):
+        """Aggregate gradients across devices. In-mesh DP sums inside the
+        compiled step via lax.psum (ref kvstore 'device' path:
+        src/kvstore/kvstore_local.h); with an explicit dist kvstore, push/pull."""
+        if self._kvstore is not None:
+            for i, p in enumerate(self._params):
+                if p._data is None or p.grad() is None:
+                    continue
+                self._kvstore.push(i, p.grad())
+                self._kvstore.pull(i, out=p.grad())
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        self.allreduce_grads()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        for i, p in enumerate(self._params):
+            if p._data is None:
+                continue
+            g = p.grad()
+            if g is None:
+                if ignore_stale_grad:
+                    continue
+                raise RuntimeError("gradient of %s not attached; call attach_grad/initialize"
+                                   % p.name)
+            if i not in self._states:
+                self._states[i] = self._optimizer.create_state(i, p.data())
+            self._states[i] = self._optimizer.update(i, p.data(), g, self._states[i])
+
+    def zero_grad(self):
+        for p in self._params:
+            p.zero_grad()
+
+    def save_states(self, fname):
+        import numpy as np
+        import jax
+
+        flat, _ = jax.tree_util.tree_flatten(self._states)
+        with open(fname, "wb") as f:
+            pickle.dump({"num_update": self._optimizer.num_update,
+                         "update_count": self._optimizer._index_update_count,
+                         "arrays": [np.asarray(a) for a in flat]}, f)
+
+    def load_states(self, fname):
+        import jax
+        import jax.numpy as jnp
+
+        with open(fname, "rb") as f:
+            blob = pickle.load(f)
+        # rebuild state structure from current params, then fill arrays
+        for i, p in enumerate(self._params):
+            if i not in self._states and p._data is not None:
+                self._states[i] = self._optimizer.create_state(i, p.data())
+        flat, treedef = jax.tree_util.tree_flatten(self._states)
+        assert len(flat) == len(blob["arrays"]), "optimizer state mismatch"
+        self._states = jax.tree_util.tree_unflatten(
+            treedef, [jnp.asarray(a) for a in blob["arrays"]])
+        self._optimizer.num_update = blob["num_update"]
+        self._optimizer._index_update_count = blob["update_count"]

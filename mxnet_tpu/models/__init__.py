@@ -1,0 +1,7 @@
+"""Flagship model families beyond the vision zoo (bench configs #2-#5):
+BERT (GluonNLP parity), LSTM LM (PTB), Transformer NMT (Sockeye parity),
+SSD detection (GluonCV parity)."""
+from . import bert  # noqa: F401
+from . import lstm_lm  # noqa: F401
+from . import transformer  # noqa: F401
+from . import ssd  # noqa: F401

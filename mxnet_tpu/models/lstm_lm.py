@@ -1,0 +1,56 @@
+"""LSTM PTB language model (bench config #3; ref: incubator-mxnet
+example/gluon/word_language_model/model.py → cuDNN RNN replaced by the fused
+lax.scan op)."""
+from __future__ import annotations
+
+from ..gluon import nn, rnn
+from ..gluon.block import HybridBlock, param_value
+
+__all__ = ["RNNModel", "lstm_ptb"]
+
+
+class RNNModel(HybridBlock):
+    def __init__(self, mode="lstm", vocab_size=10000, num_embed=650, num_hidden=650,
+                 num_layers=2, dropout=0.5, tie_weights=False, **kwargs):
+        super().__init__(**kwargs)
+        self._num_hidden = num_hidden
+        self._tie = tie_weights and num_embed == num_hidden
+        with self.name_scope():
+            self.drop = nn.Dropout(dropout)
+            self.embed = nn.Embedding(vocab_size, num_embed, prefix="word_embed_")
+            if mode == "lstm":
+                self.rnn = rnn.LSTM(num_hidden, num_layers, dropout=dropout,
+                                    input_size=num_embed)
+            elif mode == "gru":
+                self.rnn = rnn.GRU(num_hidden, num_layers, dropout=dropout,
+                                   input_size=num_embed)
+            else:
+                self.rnn = rnn.RNN(num_hidden, num_layers, dropout=dropout,
+                                   input_size=num_embed)
+            if not self._tie:
+                self.decoder = nn.Dense(vocab_size, flatten=False, in_units=num_hidden)
+
+    def begin_state(self, batch_size, **kwargs):
+        return self.rnn.begin_state(batch_size, **kwargs)
+
+    def hybrid_forward(self, F, inputs, states=None):
+        """inputs: (T, N) int token ids."""
+        emb = self.drop(self.embed(inputs))
+        if states is None:
+            out = self.rnn(emb)
+            states = None
+        else:
+            out, states = self.rnn(emb, states)
+        out = self.drop(out)
+        if self._tie:
+            w = param_value(self.embed.weight)
+            T, N, H = out.shape
+            logits = F.dot(F.reshape(out, shape=(T * N, H)), F.transpose(w))
+            logits = F.reshape(logits, shape=(T, N, -1))
+        else:
+            logits = self.decoder(out)
+        return (logits, states) if states is not None else logits
+
+
+def lstm_ptb(vocab_size=10000, tie_weights=True, **kwargs):
+    return RNNModel("lstm", vocab_size=vocab_size, tie_weights=tie_weights, **kwargs)

@@ -1,0 +1,121 @@
+"""SSD object detection (bench config #4; GluonCV parity — ref: gluon-cv
+gluoncv/model_zoo/ssd/ssd.py, anchors/NMS from
+src/operator/contrib/multibox_*.cc).
+
+Multi-scale feature maps with per-scale class + box heads; anchors from
+``multibox_prior``; training targets from ``multibox_target``; inference
+through the on-device jittable NMS (``multibox_detection``) — no host round
+trip, unlike the reference's CPU NMS fallback.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+
+__all__ = ["SSD", "ssd_512", "SSDLoss"]
+
+
+def _vgg_base(filters=(64, 128, 256, 512)):
+    net = nn.HybridSequential(prefix="base_")
+    with net.name_scope():
+        for i, f in enumerate(filters):
+            net.add(nn.Conv2D(f, 3, padding=1, activation="relu"))
+            net.add(nn.Conv2D(f, 3, padding=1, activation="relu"))
+            net.add(nn.BatchNorm())
+            net.add(nn.MaxPool2D(2))
+    return net
+
+
+class _DownBlock(HybridBlock):
+    def __init__(self, channels, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.body = nn.HybridSequential(prefix="")
+            self.body.add(nn.Conv2D(channels // 2, 1, activation="relu"))
+            self.body.add(nn.Conv2D(channels, 3, strides=2, padding=1, activation="relu"))
+            self.body.add(nn.BatchNorm())
+
+    def hybrid_forward(self, F, x):
+        return self.body(x)
+
+
+class SSD(HybridBlock):
+    def __init__(self, num_classes=20, image_size=512,
+                 sizes=((0.1, 0.141), (0.2, 0.272), (0.37, 0.447),
+                        (0.54, 0.619), (0.71, 0.79)),
+                 ratios=((1, 2, 0.5),) * 5, **kwargs):
+        super().__init__(**kwargs)
+        self._num_classes = num_classes
+        self._sizes = sizes
+        self._ratios = ratios
+        num_scales = len(sizes)
+        with self.name_scope():
+            self.base = _vgg_base()
+            self.downs = nn.HybridSequential(prefix="down_")
+            for _ in range(num_scales - 1):
+                self.downs.add(_DownBlock(512))
+            self.cls_heads = nn.HybridSequential(prefix="cls_")
+            self.box_heads = nn.HybridSequential(prefix="box_")
+            for i in range(num_scales):
+                a = len(sizes[i]) + len(ratios[i]) - 1
+                self.cls_heads.add(nn.Conv2D(a * (num_classes + 1), 3, padding=1))
+                self.box_heads.add(nn.Conv2D(a * 4, 3, padding=1))
+
+    def hybrid_forward(self, F, x):
+        feats = [self.base(x)]
+        for down in self.downs:
+            feats.append(down(feats[-1]))
+        cls_preds, box_preds, anchors = [], [], []
+        for i, feat in enumerate(feats):
+            cp = self.cls_heads[i](feat)  # (B, A*(C+1), H, W)
+            bp = self.box_heads[i](feat)
+            B = cp.shape[0]
+            cp = F.reshape(F.transpose(cp, axes=(0, 2, 3, 1)),
+                           shape=(B, -1, self._num_classes + 1))
+            bp = F.reshape(F.transpose(bp, axes=(0, 2, 3, 1)), shape=(B, -1))
+            cls_preds.append(cp)
+            box_preds.append(bp)
+            anchors.append(F.multibox_prior(feat, sizes=tuple(self._sizes[i]),
+                                            ratios=tuple(self._ratios[i])))
+        cls_preds = F.concat(*cls_preds, dim=1)  # (B, N, C+1)
+        box_preds = F.concat(*box_preds, dim=1)  # (B, N*4)
+        anchors = F.concat(*anchors, dim=1)      # (1, N, 4)
+        return cls_preds, box_preds, anchors
+
+    def detect(self, x, nms_thresh=0.45, score_thresh=0.01):
+        from .. import nd
+
+        cls_preds, box_preds, anchors = self(x)
+        cls_prob = nd.softmax(cls_preds, axis=-1)
+        cls_prob = nd.transpose(cls_prob, axes=(0, 2, 1))  # (B, C+1, N)
+        return nd.multibox_detection(cls_prob, box_preds, anchors,
+                                     nms_threshold=nms_thresh,
+                                     threshold=score_thresh)
+
+
+class SSDLoss(HybridBlock):
+    """Cls CE + smooth-L1 box loss over multibox targets
+    (ref: gluoncv ssd/target.py + train script)."""
+
+    def __init__(self, num_classes, **kwargs):
+        super().__init__(**kwargs)
+        self._num_classes = num_classes
+
+    def hybrid_forward(self, F, cls_preds, box_preds, labels, anchors):
+        cls_prob_t = F.transpose(F.softmax(cls_preds, axis=-1), axes=(0, 2, 1))
+        box_t, box_m, cls_t = F.multibox_target(anchors, labels, cls_prob_t)
+        # classification: CE where cls_t >= 0
+        logp = F.log_softmax(cls_preds, axis=-1)
+        picked = F.pick(logp, F.maximum(cls_t, 0.0), axis=-1)
+        valid = F.cast(F.greater_equal(cls_t, 0.0), dtype="float32")
+        cls_loss = -F.sum(picked * valid, axis=1) / F.maximum(F.sum(valid, axis=1), 1.0)
+        # box: smooth l1 on positives
+        box_l = F.smooth_l1(box_preds - box_t, scalar=1.0) * box_m
+        box_loss = F.sum(box_l, axis=1) / F.maximum(F.sum(box_m, axis=1), 1.0)
+        return cls_loss + box_loss
+
+
+def ssd_512(num_classes=20, **kwargs):
+    return SSD(num_classes=num_classes, image_size=512, **kwargs)

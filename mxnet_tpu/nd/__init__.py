@@ -1,0 +1,40 @@
+"""``mx.nd`` parity namespace: imperative ops over NDArray.
+
+Generated from the functional op registry (ref: python/mxnet/ndarray/register.py
+which code-gens the nd namespace from NNVM op registration — same idea, one
+source of truth, two front-ends).
+"""
+from __future__ import annotations
+
+import sys as _sys
+
+from ..base import OP_REGISTRY as _REG
+from ..ndarray import (NDArray, array, zeros, ones, full, empty, arange,  # noqa: F401
+                       linspace, eye, concat, stack, waitall, invoke)
+from . import random  # noqa: F401
+from . import contrib  # noqa: F401
+
+_mod = _sys.modules[__name__]
+
+
+def _make(opname):
+    def f(*args, **kwargs):
+        return invoke(opname, args, kwargs)
+
+    f.__name__ = opname
+    f.__qualname__ = opname
+    f.__doc__ = (_REG[opname].fn.__doc__ or "") + "\n(imperative wrapper)"
+    return f
+
+
+for _name in list(_REG):
+    if not hasattr(_mod, _name):
+        setattr(_mod, _name, _make(_name))
+
+
+def __getattr__(name):  # ops registered later (e.g. pallas-backed) resolve lazily
+    if name in _REG:
+        f = _make(name)
+        setattr(_mod, name, f)
+        return f
+    raise AttributeError(name)

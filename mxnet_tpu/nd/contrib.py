@@ -1,0 +1,23 @@
+"""``mx.nd.contrib`` parity: control flow + detection ops.
+
+(ref: python/mxnet/ndarray/contrib.py, src/operator/contrib/*)
+"""
+from __future__ import annotations
+
+from ..ndarray import invoke
+from ..ops.control_flow import cond, foreach, while_loop  # noqa: F401
+
+
+def _wrap(opname):
+    def f(*args, **kwargs):
+        return invoke(opname, args, kwargs)
+
+    f.__name__ = opname
+    return f
+
+
+box_iou = _wrap("box_iou")
+box_nms = _wrap("box_nms")
+MultiBoxPrior = multibox_prior = _wrap("multibox_prior")
+MultiBoxTarget = multibox_target = _wrap("multibox_target")
+MultiBoxDetection = multibox_detection = _wrap("multibox_detection")

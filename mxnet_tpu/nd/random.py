@@ -1,0 +1,103 @@
+"""``mx.nd.random`` parity: stateful sampling ops.
+
+(ref: python/mxnet/ndarray/random.py, src/operator/random/sample_op.cc).
+Sampling is eager and nondifferentiable; keys come from the global threefry
+chain in mxnet_tpu.random.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import random as _rng
+from ..base import resolve_dtype
+from ..context import current_context
+from ..ndarray import NDArray
+
+
+def _finish(data, ctx):
+    ctx = ctx or current_context()
+    return NDArray(jax.device_put(data, ctx.jax_device()))
+
+
+def uniform(low=0.0, high=1.0, shape=(1,), dtype=None, ctx=None, out=None):
+    dtype = resolve_dtype(dtype) or np.float32
+    r = jax.random.uniform(_rng.next_key(), tuple(shape), dtype, low, high)
+    res = _finish(r, ctx)
+    if out is not None:
+        out._data = res._data
+        return out
+    return res
+
+
+def normal(loc=0.0, scale=1.0, shape=(1,), dtype=None, ctx=None, out=None):
+    dtype = resolve_dtype(dtype) or np.float32
+    r = jax.random.normal(_rng.next_key(), tuple(shape), dtype) * scale + loc
+    res = _finish(r, ctx)
+    if out is not None:
+        out._data = res._data
+        return out
+    return res
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype=None, ctx=None):
+    return normal(loc, scale, shape or (1,), dtype, ctx)
+
+
+def randint(low, high, shape=(1,), dtype="int32", ctx=None):
+    r = jax.random.randint(_rng.next_key(), tuple(shape), low, high,
+                           dtype=resolve_dtype(dtype))
+    return _finish(r, ctx)
+
+
+def exponential(scale=1.0, shape=(1,), dtype=None, ctx=None):
+    dtype = resolve_dtype(dtype) or np.float32
+    r = jax.random.exponential(_rng.next_key(), tuple(shape), dtype) * scale
+    return _finish(r, ctx)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=(1,), dtype=None, ctx=None):
+    dtype = resolve_dtype(dtype) or np.float32
+    r = jax.random.gamma(_rng.next_key(), alpha, tuple(shape), dtype) * beta
+    return _finish(r, ctx)
+
+
+def poisson(lam=1.0, shape=(1,), dtype=None, ctx=None):
+    r = jax.random.poisson(_rng.next_key(), lam, tuple(shape))
+    dtype = resolve_dtype(dtype) or np.float32
+    return _finish(r.astype(dtype), ctx)
+
+
+def negative_binomial(k=1, p=1.0, shape=(1,), dtype=None, ctx=None):
+    g = jax.random.gamma(_rng.next_key(), k, tuple(shape)) * (1 - p) / p
+    r = jax.random.poisson(_rng.next_key(), g, tuple(shape))
+    dtype = resolve_dtype(dtype) or np.float32
+    return _finish(r.astype(dtype), ctx)
+
+
+def multinomial(data, shape=1, get_prob=False, dtype="int32"):
+    logits = jnp.log(jnp.maximum(data._data, 1e-30))
+    n = shape if isinstance(shape, int) else int(np.prod(shape))
+    ks = jax.random.split(_rng.next_key(), n)
+    if logits.ndim == 1:
+        samp = jnp.stack([jax.random.categorical(k, logits) for k in ks])
+        samp = samp if n > 1 else samp[0]
+    else:
+        samp = jnp.stack([jax.random.categorical(k, logits, axis=-1) for k in ks], axis=-1)
+        samp = samp if n > 1 else samp[..., 0]
+    out = NDArray(samp.astype(resolve_dtype(dtype)))
+    if get_prob:
+        lp = jax.nn.log_softmax(logits, axis=-1)
+        probs = jnp.take_along_axis(lp, jnp.atleast_2d(samp.astype(jnp.int32)), axis=-1)
+        return out, NDArray(probs)
+    return out
+
+
+def shuffle(data):
+    perm = jax.random.permutation(_rng.next_key(), data.shape[0])
+    return NDArray(data._data[perm])
+
+
+def seed(s, ctx=None):
+    _rng.seed(s, ctx)

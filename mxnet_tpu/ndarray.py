@@ -1,0 +1,547 @@
+"""NDArray: imperative, mutable, asynchronous arrays on TPU.
+
+TPU-native equivalent of MXNet's NDArray (ref: include/mxnet/ndarray.h,
+src/ndarray/ndarray.cc, python/mxnet/ndarray/ndarray.py). Key mapping:
+
+- MXNet's ThreadedEngine async execution → JAX/XLA async dispatch: every op
+  returns immediately with a future-backed ``jax.Array``; ``wait_to_read`` is
+  ``block_until_ready``. Per-device program order gives MXNet's write/read
+  dependency guarantees without a host-side scheduler.
+- Imperative kernels → cached ``jax.jit`` executables per (op, static attrs,
+  input signature), the analogue of MXNet's cached imperative op handles
+  (ref: src/imperative/imperative.cc:InvokeOp).
+- Mutability (``x += 1``, ``x[...] = v``) is implemented by rebinding the
+  underlying immutable buffer — the functional core stays pure for XLA.
+- Under ``autograd.record()`` each invocation stores its ``jax.vjp`` closure on
+  the tape (see mxnet_tpu/autograd.py).
+"""
+from __future__ import annotations
+
+import numbers
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import autograd, random
+from .base import OP_REGISTRY, jitted, resolve_dtype
+from .context import Context, current_context
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "linspace", "eye", "concat", "stack", "waitall", "invoke"]
+
+
+class NDArray:
+    __slots__ = ("_data", "_grad", "_grad_req", "__weakref__")
+
+    def __init__(self, data, ctx=None):
+        if isinstance(data, NDArray):
+            data = data._data
+        if not isinstance(data, jax.Array):
+            data = jnp.asarray(data)
+        if ctx is not None:
+            dev = Context(ctx).jax_device() if not isinstance(ctx, Context) else ctx.jax_device()
+            if data.device != dev:
+                data = jax.device_put(data, dev)
+        self._data = data
+        self._grad = None
+        self._grad_req = "write"
+
+    # ------------------------------------------------------------ properties
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self):
+        from .context import context_from_device
+
+        try:
+            return context_from_device(self._data.device)
+        except Exception:
+            return current_context()
+
+    ctx = context
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    @property
+    def grad(self):
+        return self._grad
+
+    # ------------------------------------------------------------ data access
+    def asnumpy(self):
+        return np.asarray(self._data)
+
+    def asscalar(self):
+        return self.asnumpy().item()
+
+    def item(self):
+        return self.asscalar()
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __bool__(self):
+        return bool(self.asnumpy().all()) if self.size == 1 else self._raise_ambiguous()
+
+    def _raise_ambiguous(self):
+        raise ValueError("The truth value of an NDArray with multiple elements is ambiguous.")
+
+    def __len__(self):
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(self.shape[0]):
+            yield self[i]
+
+    def wait_to_read(self):
+        self._data.block_until_ready()
+
+    # ------------------------------------------------------------ conversion
+    def astype(self, dtype, copy=True):
+        return invoke("cast", (self,), {"dtype": dtype})
+
+    def copy(self):
+        return NDArray(jnp.array(self._data))
+
+    def copyto(self, other):
+        if isinstance(other, NDArray):
+            other._data = jax.device_put(self._data, other._data.device)
+            return other
+        if isinstance(other, Context):
+            return NDArray(self._data, ctx=other)
+        raise TypeError("copyto target must be NDArray or Context")
+
+    def as_in_context(self, ctx):
+        if ctx == self.context:
+            return self
+        return NDArray(self._data, ctx=ctx)
+
+    as_in_ctx = as_in_context
+
+    def detach(self):
+        out = NDArray(self._data)
+        return out
+
+    # ------------------------------------------------------------ autograd
+    def attach_grad(self, grad_req="write"):
+        self._grad = NDArray(jnp.zeros(self.shape, self.dtype))
+        self._grad_req = grad_req
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        autograd.backward([self], [out_grad] if out_grad is not None else None,
+                          retain_graph=retain_graph, train_mode=train_mode)
+
+    # ------------------------------------------------------------ indexing
+    def __getitem__(self, key):
+        return _getitem(self, key)
+
+    def __setitem__(self, key, value):
+        v = value._data if isinstance(value, NDArray) else value
+        k = _normalize_key(key)
+        self._data = self._data.at[k].set(v)
+
+    # ------------------------------------------------------------ arithmetic
+    def __add__(self, o):
+        return invoke("add", (self, o), {})
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return invoke("subtract", (self, o), {})
+
+    def __rsub__(self, o):
+        return invoke("subtract", (o, self), {})
+
+    def __mul__(self, o):
+        return invoke("multiply", (self, o), {})
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return invoke("divide", (self, o), {})
+
+    def __rtruediv__(self, o):
+        return invoke("divide", (o, self), {})
+
+    def __mod__(self, o):
+        return invoke("mod", (self, o), {})
+
+    def __rmod__(self, o):
+        return invoke("mod", (o, self), {})
+
+    def __pow__(self, o):
+        return invoke("power", (self, o), {})
+
+    def __rpow__(self, o):
+        return invoke("power", (o, self), {})
+
+    def __neg__(self):
+        return invoke("negative", (self,), {})
+
+    def __abs__(self):
+        return invoke("abs", (self,), {})
+
+    def __matmul__(self, o):
+        return invoke("matmul", (self, o), {})
+
+    def __iadd__(self, o):
+        self._data = (self + o)._data
+        return self
+
+    def __isub__(self, o):
+        self._data = (self - o)._data
+        return self
+
+    def __imul__(self, o):
+        self._data = (self * o)._data
+        return self
+
+    def __itruediv__(self, o):
+        self._data = (self / o)._data
+        return self
+
+    def __eq__(self, o):
+        return invoke("equal", (self, o), {})
+
+    def __ne__(self, o):
+        return invoke("not_equal", (self, o), {})
+
+    def __gt__(self, o):
+        return invoke("greater", (self, o), {})
+
+    def __ge__(self, o):
+        return invoke("greater_equal", (self, o), {})
+
+    def __lt__(self, o):
+        return invoke("lesser", (self, o), {})
+
+    def __le__(self, o):
+        return invoke("lesser_equal", (self, o), {})
+
+    __hash__ = object.__hash__
+
+    # ------------------------------------------------------------ methods
+    def reshape(self, *shape, **kwargs):
+        if "shape" in kwargs:
+            shape = kwargs["shape"]
+        elif len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return invoke("reshape", (self,), {"shape": tuple(shape)})
+
+    def flatten(self):
+        return invoke("flatten", (self,), {})
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return invoke("transpose", (self,), {"axes": axes or None})
+
+    def swapaxes(self, dim1, dim2):
+        return invoke("swapaxes", (self,), {"dim1": dim1, "dim2": dim2})
+
+    def expand_dims(self, axis):
+        return invoke("expand_dims", (self,), {"axis": axis})
+
+    def squeeze(self, axis=None):
+        return invoke("squeeze", (self,), {"axis": axis})
+
+    def broadcast_to(self, shape):
+        return invoke("broadcast_to", (self,), {"shape": tuple(shape)})
+
+    def sum(self, axis=None, keepdims=False):
+        return invoke("sum", (self,), {"axis": axis, "keepdims": keepdims})
+
+    def mean(self, axis=None, keepdims=False):
+        return invoke("mean", (self,), {"axis": axis, "keepdims": keepdims})
+
+    def max(self, axis=None, keepdims=False):
+        return invoke("max", (self,), {"axis": axis, "keepdims": keepdims})
+
+    def min(self, axis=None, keepdims=False):
+        return invoke("min", (self,), {"axis": axis, "keepdims": keepdims})
+
+    def prod(self, axis=None, keepdims=False):
+        return invoke("prod", (self,), {"axis": axis, "keepdims": keepdims})
+
+    def argmax(self, axis=None):
+        return invoke("argmax", (self,), {"axis": axis})
+
+    def argmin(self, axis=None):
+        return invoke("argmin", (self,), {"axis": axis})
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return invoke("norm", (self,), {"ord": ord, "axis": axis, "keepdims": keepdims})
+
+    def abs(self):
+        return invoke("abs", (self,), {})
+
+    def sqrt(self):
+        return invoke("sqrt", (self,), {})
+
+    def exp(self):
+        return invoke("exp", (self,), {})
+
+    def log(self):
+        return invoke("log", (self,), {})
+
+    def clip(self, a_min, a_max):
+        return invoke("clip", (self,), {"a_min": a_min, "a_max": a_max})
+
+    def sigmoid(self):
+        return invoke("sigmoid", (self,), {})
+
+    def tanh(self):
+        return invoke("tanh", (self,), {})
+
+    def relu(self):
+        return invoke("relu", (self,), {})
+
+    def softmax(self, axis=-1):
+        return invoke("softmax", (self,), {"axis": axis})
+
+    def one_hot(self, depth, **kw):
+        return invoke("one_hot", (self,), {"depth": depth, **kw})
+
+    def take(self, indices, axis=0):
+        return invoke("take", (self, indices), {"axis": axis})
+
+    def tile(self, reps):
+        return invoke("tile", (self,), {"reps": reps})
+
+    def repeat(self, repeats, axis=None):
+        return invoke("repeat", (self,), {"repeats": repeats, "axis": axis})
+
+    def slice_axis(self, axis, begin, end):
+        return invoke("slice_axis", (self,), {"axis": axis, "begin": begin, "end": end})
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return invoke("split", (self,), {"num_outputs": num_outputs, "axis": axis,
+                                         "squeeze_axis": squeeze_axis})
+
+    def zeros_like(self):
+        return invoke("zeros_like", (self,), {})
+
+    def ones_like(self):
+        return invoke("ones_like", (self,), {})
+
+    def tostype(self, stype):
+        return self  # dense-only fast path; sparse in mxnet_tpu.sparse
+
+    def __repr__(self):
+        return "%s\n<NDArray %s @%s>" % (
+            np.array2string(self.asnumpy(), threshold=20),
+            "x".join(str(s) for s in self.shape), self.context)
+
+
+# ---------------------------------------------------------------- dispatch
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, NDArray) else x
+
+
+def _is_diff(x):
+    return isinstance(x, NDArray) and jnp.issubdtype(x.dtype, jnp.inexact)
+
+
+def invoke(opname, args, kwargs):
+    """Imperative op invocation: unwrap → (record vjp | cached jit) → wrap."""
+    opdef = OP_REGISTRY[opname]
+    fn = opdef.fn
+    kwargs = dict(kwargs)
+    out_arr = kwargs.pop("out", None)
+
+    static = {}
+    traced_kw = {}
+    for k, v in kwargs.items():
+        if isinstance(v, (NDArray, jax.Array)) or k in opdef.array_kwargs:
+            traced_kw[k] = v
+        else:
+            static[k] = v
+    if opdef.needs_training and "training" not in static:
+        static["training"] = autograd.is_training()
+    if opdef.needs_rng and "key" not in traced_kw and static.get("training", True):
+        traced_kw["key"] = random.next_key()
+
+    recording = (autograd.is_recording() and not opdef.nondiff
+                 and (any(_is_diff(a) for a in args) or any(_is_diff(v) for v in traced_kw.values())))
+
+    if recording:
+        diff_pos = [i for i, a in enumerate(args) if _is_diff(a)]
+        diff_kw = [k for k, v in traced_kw.items() if _is_diff(v)]
+
+        def g(*xs):
+            new_args = list(map(_unwrap, args))
+            for j, i in enumerate(diff_pos):
+                new_args[i] = xs[j]
+            kw = {k: _unwrap(v) for k, v in traced_kw.items()}
+            for j, k in enumerate(diff_kw):
+                kw[k] = xs[len(diff_pos) + j]
+            return fn(*new_args, **kw, **static)
+
+        primals = [args[i]._data for i in diff_pos] + [traced_kw[k]._data for k in diff_kw]
+        out, vjp_fn = jax.vjp(g, *primals)
+        outs_flat, treedef = jax.tree_util.tree_flatten(out)
+        wrapped = [NDArray(o) for o in outs_flat]
+        inputs = [args[i] for i in diff_pos] + [traced_kw[k] for k in diff_kw]
+        autograd.append_node(autograd.TapeNode(inputs, wrapped, vjp_fn))
+        result = jax.tree_util.tree_unflatten(treedef, wrapped)
+    else:
+        f = jitted(fn, static)
+        out = f(*map(_unwrap, args), **{k: _unwrap(v) for k, v in traced_kw.items()})
+        result = jax.tree_util.tree_map(NDArray, out)
+
+    if out_arr is not None:
+        src = result if isinstance(result, NDArray) else result[0]
+        out_arr._data = src._data
+        return out_arr
+    return result
+
+
+def _normalize_key(key):
+    if isinstance(key, NDArray):
+        return key._data.astype(jnp.int32)
+    if isinstance(key, tuple):
+        return tuple(_normalize_key(k) if isinstance(k, NDArray) else k for k in key)
+    return key
+
+
+def _getitem(x, key):
+    nk = _normalize_key(key)
+    has_array = any(isinstance(k, jax.Array) for k in (nk if isinstance(nk, tuple) else (nk,)))
+    if not has_array:
+        # static basic indexing: jit-cacheable by key
+        return invoke("_basic_index", (x,), {"key": _hashable_key(nk)})
+    # advanced indexing with array indices: eager (still recorded via take path)
+    if isinstance(nk, jax.Array):
+        return invoke("take", (x, NDArray(nk)), {"axis": 0, "mode": "clip"})
+    out = NDArray(x._data[nk])
+    return out
+
+
+def _hashable_key(key):
+    def conv(k):
+        if isinstance(k, slice):
+            return ("s", k.start, k.stop, k.step)
+        if k is Ellipsis:
+            return ("e",)
+        if k is None:
+            return ("n",)
+        return ("i", int(k))
+
+    if isinstance(key, tuple):
+        return ("t",) + tuple(conv(k) for k in key)
+    return conv(key)
+
+
+def _unhash_key(hk):
+    def unconv(t):
+        if t[0] == "s":
+            return slice(t[1], t[2], t[3])
+        if t[0] == "e":
+            return Ellipsis
+        if t[0] == "n":
+            return None
+        return t[1]
+
+    if hk[0] == "t":
+        return tuple(unconv(t) for t in hk[1:])
+    return unconv(hk)
+
+
+from .base import register_op  # noqa: E402
+
+
+@register_op("_basic_index")
+def _basic_index(x, *, key):
+    return x[_unhash_key(key)]
+
+
+# ---------------------------------------------------------------- creation
+
+
+def _ctx_dtype(ctx, dtype, default=np.float32):
+    ctx = ctx or current_context()
+    dtype = resolve_dtype(dtype) or default
+    return ctx, dtype
+
+
+def array(source_array, ctx=None, dtype=None):
+    if isinstance(source_array, NDArray):
+        source_array = source_array.asnumpy()
+    a = np.asarray(source_array, dtype=resolve_dtype(dtype))
+    if a.dtype == np.float64 and dtype is None:
+        a = a.astype(np.float32)  # MXNet default float32
+    ctx = ctx or current_context()
+    return NDArray(jax.device_put(a, ctx.jax_device()))
+
+
+def zeros(shape, ctx=None, dtype=None):
+    ctx, dtype = _ctx_dtype(ctx, dtype)
+    return NDArray(jax.device_put(jnp.zeros(shape, dtype), ctx.jax_device()))
+
+
+def ones(shape, ctx=None, dtype=None):
+    ctx, dtype = _ctx_dtype(ctx, dtype)
+    return NDArray(jax.device_put(jnp.ones(shape, dtype), ctx.jax_device()))
+
+
+def full(shape, val, ctx=None, dtype=None):
+    ctx, dtype = _ctx_dtype(ctx, dtype)
+    return NDArray(jax.device_put(jnp.full(shape, val, dtype), ctx.jax_device()))
+
+
+def empty(shape, ctx=None, dtype=None):
+    return zeros(shape, ctx, dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None):
+    ctx, dtype = _ctx_dtype(ctx, dtype)
+    a = jnp.arange(start, stop, step, dtype=dtype)
+    if repeat > 1:
+        a = jnp.repeat(a, repeat)
+    return NDArray(jax.device_put(a, ctx.jax_device()))
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype=None):
+    ctx, dtype = _ctx_dtype(ctx, dtype)
+    return NDArray(jax.device_put(jnp.linspace(start, stop, num, endpoint=endpoint, dtype=dtype),
+                                  ctx.jax_device()))
+
+
+def eye(N, M=None, k=0, ctx=None, dtype=None):
+    ctx, dtype = _ctx_dtype(ctx, dtype)
+    return NDArray(jax.device_put(jnp.eye(N, M, k, dtype=dtype), ctx.jax_device()))
+
+
+def concat(*arrays, dim=1):
+    return invoke("concat", arrays, {"dim": dim})
+
+
+def stack(*arrays, axis=0):
+    return invoke("stack", arrays, {"axis": axis})
+
+
+def waitall():
+    """Block until all launched computations finish (ref:
+    python/mxnet/ndarray/ndarray.py:waitall → engine WaitForAll)."""
+    (jax.device_put(0.0) + 0).block_until_ready()

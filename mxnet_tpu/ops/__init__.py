@@ -1,0 +1,6 @@
+"""Functional op library; see functional.py for the registry."""
+from . import functional  # noqa: F401  (populates OP_REGISTRY)
+from . import detection  # noqa: F401
+from . import control_flow  # noqa: F401
+from . import attention  # noqa: F401
+from .functional import *  # noqa: F401,F403

@@ -1,0 +1,51 @@
+"""Attention ops.
+
+Single functional seam for all transformer models: models call
+``F.scaled_dot_attention``; the implementation dispatches to the Pallas flash
+kernel on TPU (mxnet_tpu/ops/pallas/flash_attention.py) and to a reference
+jnp implementation elsewhere (CPU tests, interpret mode). This replaces the
+reference's unfused softmax(QK^T)V graph (MXNet had no flash attention;
+ref: gluonnlp attention_cell.py:DotProductAttentionCell).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import register_op
+
+_FLASH_MIN_LEN = 256  # below this, XLA's fused unblocked attention wins
+
+
+def _reference_attention(q, k, v, mask=None, *, causal=False, scale=None):
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32) * scale, k.astype(jnp.float32))
+    if mask is not None:
+        s = jnp.where(mask.astype(bool), s, -1e30)
+    if causal:
+        T, S = s.shape[-2], s.shape[-1]
+        cm = jnp.arange(T)[:, None] >= jnp.arange(S)[None, :]
+        s = jnp.where(cm[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+@register_op("scaled_dot_attention")
+def scaled_dot_attention(q, k, v, mask=None, *, causal=False, scale=None):
+    """q,k,v: (B, H, T, D); mask broadcastable to (B, H, Tq, Tk), 1=keep."""
+    if jax.default_backend() == "tpu" and q.shape[2] >= _FLASH_MIN_LEN and mask is None:
+        try:
+            from .pallas.flash_attention import flash_attention
+
+            return flash_attention(q, k, v, causal=causal, scale=scale)
+        except Exception:
+            pass
+    return _reference_attention(q, k, v, mask, causal=causal, scale=scale)
+
+
+@register_op("masked_softmax")
+def masked_softmax(x, mask=None, *, axis=-1):
+    if mask is not None:
+        x = jnp.where(mask.astype(bool), x, -1e30)
+    return jax.nn.softmax(x, axis=axis)

@@ -1,0 +1,103 @@
+"""Control-flow ops: foreach / while_loop / cond.
+
+TPU-native equivalents of MXNet contrib control-flow operators (ref:
+src/operator/control_flow.cc, python/mxnet/ndarray/contrib.py:foreach). These
+lower directly onto lax.scan / lax.while_loop / lax.cond so loops stay inside
+one compiled XLA program — the whole point of compiler-friendly control flow on
+TPU (the reference unrolls imperative loops or uses its own subgraph ops).
+
+These take Python callables so they are library functions, not registry ops;
+they work on raw jax arrays and on NDArray (unwrapped transparently).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _unwrap(x):
+    from ..ndarray import NDArray
+
+    return jax.tree_util.tree_map(
+        lambda v: v._data if isinstance(v, NDArray) else v, x,
+        is_leaf=lambda v: isinstance(v, NDArray))
+
+
+def _wrap_like(template_is_nd, x):
+    if not template_is_nd:
+        return x
+    from ..ndarray import NDArray
+
+    return jax.tree_util.tree_map(NDArray, x)
+
+
+def _any_nd(x):
+    from ..ndarray import NDArray
+
+    found = [False]
+
+    def chk(v):
+        if isinstance(v, NDArray):
+            found[0] = True
+        return v
+
+    jax.tree_util.tree_map(chk, x, is_leaf=lambda v: isinstance(v, NDArray))
+    return found[0]
+
+
+def foreach(body, data, init_states):
+    """scan `body(slice, states) -> (out, new_states)` over axis 0 of data."""
+    is_nd = _any_nd(data) or _any_nd(init_states)
+    data = _unwrap(data)
+    init_states = _unwrap(init_states)
+
+    def step(states, xs):
+        out, new_states = body(xs, states)
+        return _unwrap(new_states), _unwrap(out)
+
+    final_states, outs = lax.scan(step, init_states, data)
+    return _wrap_like(is_nd, outs), _wrap_like(is_nd, final_states)
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """(ref: python/mxnet/ndarray/contrib.py:while_loop). `func` returns
+    (step_output, new_loop_vars); outputs are stacked to max_iterations."""
+    is_nd = _any_nd(loop_vars)
+    loop_vars = _unwrap(loop_vars)
+    if max_iterations is None:
+        # pure while loop, no per-step outputs
+        def c(vs):
+            return jnp.asarray(_unwrap(cond(vs))).reshape(())
+
+        def b(vs):
+            _, new = func(vs)
+            return _unwrap(new)
+
+        out = lax.while_loop(lambda vs: c(vs).astype(bool), b, loop_vars)
+        return None, _wrap_like(is_nd, out)
+
+    # bounded loop with stacked outputs via scan + predicate masking
+    probe_out, _ = func(loop_vars)
+    probe_out = _unwrap(probe_out)
+
+    def step(carry, _):
+        vs, active = carry
+        pred = jnp.asarray(_unwrap(cond(vs))).reshape(()).astype(bool) & active
+        out, new_vs = func(vs)
+        out, new_vs = _unwrap(out), _unwrap(new_vs)
+        vs2 = jax.tree_util.tree_map(lambda n, o: jnp.where(pred, n, o), new_vs, vs)
+        out = jax.tree_util.tree_map(lambda o: jnp.where(pred, o, jnp.zeros_like(o)), out)
+        return (vs2, pred), out
+
+    (final_vars, _), outs = lax.scan(step, (loop_vars, jnp.asarray(True)), None,
+                                     length=max_iterations)
+    return _wrap_like(is_nd, outs), _wrap_like(is_nd, final_vars)
+
+
+def cond(pred, then_func, else_func, inputs=()):
+    is_nd = _any_nd(inputs) or _any_nd(pred)
+    p = jnp.asarray(_unwrap(pred)).reshape(()).astype(bool)
+    inputs = _unwrap(inputs)
+    out = lax.cond(p, lambda xs: _unwrap(then_func(*xs)), lambda xs: _unwrap(else_func(*xs)), inputs)
+    return _wrap_like(is_nd, out)

@@ -1,0 +1,205 @@
+"""Detection ops: anchors, IoU, NMS, multibox target/detection.
+
+TPU-native equivalents of MXNet/GluonCV contrib detection ops (ref:
+src/operator/contrib/bounding_box.cc, multibox_prior.cc, multibox_target.cc,
+multibox_detection.cc). The CUDA kernels are replaced with jittable XLA code:
+NMS is the classic O(N^2)-IoU + fori_loop greedy suppression, which XLA
+vectorizes on the VPU — fixed shapes, no dynamic output sizes (suppressed boxes
+are masked with score -1, matching MXNet's convention).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import register_op
+
+
+def _iou_corner(a, b):
+    """a: (..., M, 4), b: (..., N, 4) corner format -> (..., M, N)."""
+    tl = jnp.maximum(a[..., :, None, :2], b[..., None, :, :2])
+    br = jnp.minimum(a[..., :, None, 2:], b[..., None, :, 2:])
+    wh = jnp.clip(br - tl, 0.0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.clip(a[..., 2] - a[..., 0], 0, None) * jnp.clip(a[..., 3] - a[..., 1], 0, None)
+    area_b = jnp.clip(b[..., 2] - b[..., 0], 0, None) * jnp.clip(b[..., 3] - b[..., 1], 0, None)
+    union = area_a[..., :, None] + area_b[..., None, :] - inter
+    return inter / jnp.maximum(union, 1e-12)
+
+
+@register_op("box_iou")
+def box_iou(lhs, rhs, *, format="corner"):
+    if format == "center":
+        lhs = _center_to_corner(lhs)
+        rhs = _center_to_corner(rhs)
+    return _iou_corner(lhs, rhs)
+
+
+def _center_to_corner(b):
+    xy, wh = b[..., :2], b[..., 2:]
+    return jnp.concatenate([xy - wh / 2, xy + wh / 2], axis=-1)
+
+
+def _nms_single(boxes, scores, ids, overlap_thresh, valid_thresh, force_suppress):
+    n = scores.shape[0]
+    order = jnp.argsort(-scores)
+    b = boxes[order]
+    s = scores[order]
+    c = ids[order]
+    iou = _iou_corner(b, b)
+    same_cls = (c[:, None] == c[None, :]) | force_suppress
+    valid = s > valid_thresh
+
+    def body(i, keep):
+        sup = (iou[i] > overlap_thresh) & same_cls[i] & (jnp.arange(n) > i)
+        return jnp.where(keep[i], keep & ~sup, keep)
+
+    keep = lax.fori_loop(0, n, body, valid)
+    s = jnp.where(keep, s, -1.0)
+    inv = jnp.argsort(order)
+    return b[inv], s[inv], c[inv]
+
+
+@register_op("box_nms", nondiff=True)
+def box_nms(data, *, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=0, force_suppress=False,
+            in_format="corner", out_format="corner"):
+    """data: (B, N, 6) [id, score, x1,y1,x2,y2] -> same shape, suppressed
+    entries get score -1 (ref: src/operator/contrib/bounding_box.cc:BoxNMS)."""
+    squeeze = data.ndim == 2
+    if squeeze:
+        data = data[None]
+
+    def one(d):
+        boxes = lax.dynamic_slice_in_dim(d, coord_start, 4, axis=1)
+        if in_format == "center":
+            boxes = _center_to_corner(boxes)
+        scores = d[:, score_index]
+        ids = d[:, id_index] if id_index >= 0 else jnp.zeros_like(scores)
+        b, s, c = _nms_single(boxes, scores, ids, overlap_thresh, valid_thresh,
+                              force_suppress or id_index < 0)
+        out = d.at[:, score_index].set(s)
+        return out
+
+    out = jax.vmap(one)(data)
+    return out[0] if squeeze else out
+
+
+@register_op("multibox_prior", nondiff=True)
+def multibox_prior(data, *, sizes=(1.0,), ratios=(1.0,), steps=(-1.0, -1.0),
+                   offsets=(0.5, 0.5), clip=False):
+    """Anchor boxes per feature-map pixel, corner format, normalized to [0,1]
+    (ref: src/operator/contrib/multibox_prior.cc). Output (1, H*W*A, 4)."""
+    h, w = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h) + offsets[0]) * step_y
+    cx = (jnp.arange(w) + offsets[1]) * step_x
+    cy, cx = jnp.meshgrid(cy, cx, indexing="ij")
+    centers = jnp.stack([cx, cy], axis=-1).reshape(-1, 2)  # (HW, 2)
+    whs = []
+    for i, s in enumerate(sizes):
+        r = ratios[0] if len(ratios) else 1.0
+        whs.append((s * jnp.sqrt(r), s / jnp.sqrt(r)))
+    for r in ratios[1:]:
+        s = sizes[0]
+        whs.append((s * jnp.sqrt(r), s / jnp.sqrt(r)))
+    wh = jnp.array(whs)  # (A, 2)
+    a = wh.shape[0]
+    ctr = jnp.repeat(centers[:, None, :], a, axis=1)  # (HW, A, 2)
+    half = wh[None, :, :] / 2
+    boxes = jnp.concatenate([ctr - half, ctr + half], axis=-1).reshape(1, -1, 4)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    return boxes
+
+
+@register_op("multibox_target")
+def multibox_target(anchors, labels, cls_preds, *, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=3.0,
+                    negative_mining_thresh=0.5, variances=(0.1, 0.1, 0.2, 0.2)):
+    """Match anchors to GT, encode regression targets
+    (ref: src/operator/contrib/multibox_target.cc).
+    anchors (1, N, 4) corner; labels (B, M, 5) [cls, x1,y1,x2,y2] (cls<0 = pad);
+    cls_preds (B, num_cls+1, N).
+    Returns (box_target (B, N*4), box_mask (B, N*4), cls_target (B, N))."""
+    anc = anchors[0]  # (N, 4)
+
+    def one(lab, cls_pred):
+        gt_valid = lab[:, 0] >= 0
+        gt_boxes = lab[:, 1:5]
+        iou = _iou_corner(anc, gt_boxes)  # (N, M)
+        iou = jnp.where(gt_valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)
+        best_iou = jnp.max(iou, axis=1)
+        # force-match: each gt's best anchor is positive
+        best_anchor_per_gt = jnp.argmax(iou, axis=0)  # (M,)
+        forced = jnp.zeros(anc.shape[0], bool)
+        forced = forced.at[best_anchor_per_gt].set(gt_valid)
+        gt_for_forced = jnp.zeros(anc.shape[0], jnp.int32).at[best_anchor_per_gt].set(
+            jnp.arange(lab.shape[0], dtype=jnp.int32))
+        pos = (best_iou >= overlap_threshold) | forced
+        matched_gt = jnp.where(forced, gt_for_forced, best_gt.astype(jnp.int32))
+        mb = gt_boxes[matched_gt]  # (N, 4)
+        # encode: center-offset with variances
+        acx = (anc[:, 0] + anc[:, 2]) / 2
+        acy = (anc[:, 1] + anc[:, 3]) / 2
+        aw = jnp.maximum(anc[:, 2] - anc[:, 0], 1e-8)
+        ah = jnp.maximum(anc[:, 3] - anc[:, 1], 1e-8)
+        gcx = (mb[:, 0] + mb[:, 2]) / 2
+        gcy = (mb[:, 1] + mb[:, 3]) / 2
+        gw = jnp.maximum(mb[:, 2] - mb[:, 0], 1e-8)
+        gh = jnp.maximum(mb[:, 3] - mb[:, 1], 1e-8)
+        tx = (gcx - acx) / aw / variances[0]
+        ty = (gcy - acy) / ah / variances[1]
+        tw = jnp.log(gw / aw) / variances[2]
+        th = jnp.log(gh / ah) / variances[3]
+        bt = jnp.stack([tx, ty, tw, th], axis=1)
+        bt = jnp.where(pos[:, None], bt, 0.0)
+        bm = jnp.where(pos[:, None], 1.0, 0.0)
+        cls_t = jnp.where(pos, lab[matched_gt, 0] + 1.0, 0.0)
+        # hard negative mining: keep top (ratio * npos) negatives by max prob of non-bg
+        npos = jnp.sum(pos)
+        neg_score = jnp.max(cls_pred[1:], axis=0)  # (N,)
+        neg_score = jnp.where(pos, -jnp.inf, neg_score)
+        k = jnp.minimum(npos * negative_mining_ratio, anc.shape[0] - 1).astype(jnp.int32)
+        order = jnp.argsort(-neg_score)
+        rank = jnp.argsort(order)
+        keep_neg = rank < k
+        cls_t = jnp.where(pos | keep_neg, cls_t, ignore_label)
+        return bt.reshape(-1), bm.reshape(-1), cls_t
+
+    return jax.vmap(one)(labels, cls_preds)
+
+
+@register_op("multibox_detection", nondiff=True)
+def multibox_detection(cls_prob, loc_pred, anchors, *, clip=True, threshold=0.01,
+                       nms_threshold=0.5, force_suppress=False, nms_topk=400,
+                       variances=(0.1, 0.1, 0.2, 0.2)):
+    """Decode predictions + per-class NMS → (B, N, 6) [id, score, x1,y1,x2,y2]
+    (ref: src/operator/contrib/multibox_detection.cc)."""
+    anc = anchors[0]
+    acx = (anc[:, 0] + anc[:, 2]) / 2
+    acy = (anc[:, 1] + anc[:, 3]) / 2
+    aw = anc[:, 2] - anc[:, 0]
+    ah = anc[:, 3] - anc[:, 1]
+
+    def one(cp, lp):
+        lp = lp.reshape(-1, 4)
+        cx = lp[:, 0] * variances[0] * aw + acx
+        cy = lp[:, 1] * variances[1] * ah + acy
+        w = jnp.exp(lp[:, 2] * variances[2]) * aw
+        h = jnp.exp(lp[:, 3] * variances[3]) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        scores = jnp.max(cp[1:], axis=0)
+        ids = jnp.argmax(cp[1:], axis=0).astype(jnp.float32)
+        ids = jnp.where(scores > threshold, ids, -1.0)
+        scores = jnp.where(scores > threshold, scores, -1.0)
+        det = jnp.concatenate([ids[:, None], scores[:, None], boxes], axis=1)
+        return box_nms(det, overlap_thresh=nms_threshold, valid_thresh=threshold,
+                       force_suppress=force_suppress)
+
+    return jax.vmap(one)(cls_prob, loc_pred)

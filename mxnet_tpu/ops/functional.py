@@ -1,0 +1,812 @@
+"""Pure functional op library (the kernel registry).
+
+TPU-native replacement for MXNet's operator library (ref: src/operator/tensor/*,
+src/operator/nn/*, registered via NNVM_REGISTER_OP). Every op here is a pure
+function over ``jax.Array`` built on jax.numpy / lax so XLA can fuse and tile it
+onto the MXU/VPU; the imperative ``nd`` namespace and the traced (hybridize)
+path are both generated from this registry (see mxnet_tpu/ndarray.py and
+mxnet_tpu/_trace.py). Static configuration is keyword-only; positional args are
+traced arrays.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.scipy import special as jsp
+
+from ..base import register_op, resolve_dtype
+
+# ---------------------------------------------------------------- unary
+
+
+def _u(name, f, nondiff=False):
+    register_op(name, nondiff=nondiff)(f)
+    return f
+
+
+abs = _u("abs", lambda x: jnp.abs(x))
+sign = _u("sign", jnp.sign)
+ceil = _u("ceil", jnp.ceil, nondiff=True)
+floor = _u("floor", jnp.floor, nondiff=True)
+trunc = _u("trunc", jnp.trunc, nondiff=True)
+round = _u("round", jnp.round, nondiff=True)
+rint = _u("rint", jnp.rint, nondiff=True)
+fix = _u("fix", jnp.fix, nondiff=True)
+exp = _u("exp", jnp.exp)
+expm1 = _u("expm1", jnp.expm1)
+log = _u("log", jnp.log)
+log1p = _u("log1p", jnp.log1p)
+log2 = _u("log2", jnp.log2)
+log10 = _u("log10", jnp.log10)
+sqrt = _u("sqrt", jnp.sqrt)
+rsqrt = _u("rsqrt", lambda x: lax.rsqrt(x))
+cbrt = _u("cbrt", jnp.cbrt)
+rcbrt = _u("rcbrt", lambda x: 1.0 / jnp.cbrt(x))
+square = _u("square", jnp.square)
+reciprocal = _u("reciprocal", lambda x: 1.0 / x)
+negative = _u("negative", jnp.negative)
+sin = _u("sin", jnp.sin)
+cos = _u("cos", jnp.cos)
+tan = _u("tan", jnp.tan)
+arcsin = _u("arcsin", jnp.arcsin)
+arccos = _u("arccos", jnp.arccos)
+arctan = _u("arctan", jnp.arctan)
+sinh = _u("sinh", jnp.sinh)
+cosh = _u("cosh", jnp.cosh)
+tanh = _u("tanh", jnp.tanh)
+arcsinh = _u("arcsinh", jnp.arcsinh)
+arccosh = _u("arccosh", jnp.arccosh)
+arctanh = _u("arctanh", jnp.arctanh)
+degrees = _u("degrees", jnp.degrees)
+radians = _u("radians", jnp.radians)
+erf = _u("erf", jsp.erf)
+erfinv = _u("erfinv", jsp.erfinv)
+gammaln = _u("gammaln", jsp.gammaln)
+gamma = _u("gamma", lambda x: jnp.exp(jsp.gammaln(x)))
+sigmoid = _u("sigmoid", jax.nn.sigmoid)
+softsign = _u("softsign", jax.nn.soft_sign)
+relu = _u("relu", jax.nn.relu)
+logical_not = _u("logical_not", jnp.logical_not, nondiff=True)
+isnan = _u("isnan", jnp.isnan, nondiff=True)
+isinf = _u("isinf", jnp.isinf, nondiff=True)
+isfinite = _u("isfinite", jnp.isfinite, nondiff=True)
+
+
+@register_op("softrelu")
+def softrelu(x):
+    return jax.nn.softplus(x)
+
+
+@register_op("clip")
+def clip(x, *, a_min, a_max):
+    return jnp.clip(x, a_min, a_max)
+
+
+@register_op("cast", nondiff=False)
+def cast(x, *, dtype):
+    return x.astype(resolve_dtype(dtype))
+
+
+# ---------------------------------------------------------------- binary
+
+add = _u("add", jnp.add)
+subtract = _u("subtract", jnp.subtract)
+multiply = _u("multiply", jnp.multiply)
+divide = _u("divide", jnp.divide)
+mod = _u("mod", jnp.mod)
+power = _u("power", jnp.power)
+maximum = _u("maximum", jnp.maximum)
+minimum = _u("minimum", jnp.minimum)
+hypot = _u("hypot", jnp.hypot)
+arctan2 = _u("arctan2", jnp.arctan2)
+equal = _u("equal", lambda a, b: (a == b).astype(jnp.result_type(a)), nondiff=True)
+not_equal = _u("not_equal", lambda a, b: (a != b).astype(jnp.result_type(a)), nondiff=True)
+greater = _u("greater", lambda a, b: (a > b).astype(jnp.result_type(a)), nondiff=True)
+greater_equal = _u("greater_equal", lambda a, b: (a >= b).astype(jnp.result_type(a)), nondiff=True)
+lesser = _u("lesser", lambda a, b: (a < b).astype(jnp.result_type(a)), nondiff=True)
+lesser_equal = _u("lesser_equal", lambda a, b: (a <= b).astype(jnp.result_type(a)), nondiff=True)
+logical_and = _u("logical_and", lambda a, b: jnp.logical_and(a, b).astype(jnp.float32), nondiff=True)
+logical_or = _u("logical_or", lambda a, b: jnp.logical_or(a, b).astype(jnp.float32), nondiff=True)
+logical_xor = _u("logical_xor", lambda a, b: jnp.logical_xor(a, b).astype(jnp.float32), nondiff=True)
+
+# MXNet broadcast_* aliases (broadcasting is implicit in jnp)
+for _n, _f in [
+    ("broadcast_add", jnp.add), ("broadcast_sub", jnp.subtract),
+    ("broadcast_mul", jnp.multiply), ("broadcast_div", jnp.divide),
+    ("broadcast_mod", jnp.mod), ("broadcast_power", jnp.power),
+    ("broadcast_maximum", jnp.maximum), ("broadcast_minimum", jnp.minimum),
+    ("broadcast_hypot", jnp.hypot),
+]:
+    register_op(_n)(_f)
+
+for _n, _f in [
+    ("broadcast_equal", equal), ("broadcast_not_equal", not_equal),
+    ("broadcast_greater", greater), ("broadcast_greater_equal", greater_equal),
+    ("broadcast_lesser", lesser), ("broadcast_lesser_equal", lesser_equal),
+    ("broadcast_logical_and", logical_and), ("broadcast_logical_or", logical_or),
+    ("broadcast_logical_xor", logical_xor),
+]:
+    register_op(_n, nondiff=True)(_f)
+
+
+@register_op("where")
+def where(condition, x, y):
+    return jnp.where(condition.astype(bool), x, y)
+
+
+@register_op("smooth_l1")
+def smooth_l1(x, *, scalar=1.0):
+    s2 = scalar * scalar
+    return jnp.where(jnp.abs(x) < 1.0 / s2, 0.5 * s2 * x * x, jnp.abs(x) - 0.5 / s2)
+
+
+# ---------------------------------------------------------------- reductions
+
+
+@register_op("sum")
+def sum(x, *, axis=None, keepdims=False):
+    return jnp.sum(x, axis=axis, keepdims=keepdims)
+
+
+@register_op("nansum")
+def nansum(x, *, axis=None, keepdims=False):
+    return jnp.nansum(x, axis=axis, keepdims=keepdims)
+
+
+@register_op("mean")
+def mean(x, *, axis=None, keepdims=False):
+    return jnp.mean(x, axis=axis, keepdims=keepdims)
+
+
+@register_op("prod")
+def prod(x, *, axis=None, keepdims=False):
+    return jnp.prod(x, axis=axis, keepdims=keepdims)
+
+
+@register_op("nanprod")
+def nanprod(x, *, axis=None, keepdims=False):
+    return jnp.nanprod(x, axis=axis, keepdims=keepdims)
+
+
+@register_op("max")
+def max(x, *, axis=None, keepdims=False):
+    return jnp.max(x, axis=axis, keepdims=keepdims)
+
+
+@register_op("min")
+def min(x, *, axis=None, keepdims=False):
+    return jnp.min(x, axis=axis, keepdims=keepdims)
+
+
+@register_op("var")
+def var(x, *, axis=None, keepdims=False):
+    return jnp.var(x, axis=axis, keepdims=keepdims)
+
+
+@register_op("std")
+def std(x, *, axis=None, keepdims=False):
+    return jnp.std(x, axis=axis, keepdims=keepdims)
+
+
+@register_op("argmax", nondiff=True)
+def argmax(x, *, axis=None, keepdims=False):
+    r = jnp.argmax(x, axis=axis)
+    if keepdims and axis is not None:
+        r = jnp.expand_dims(r, axis)
+    return r.astype(jnp.float32)  # MXNet returns float indices
+
+
+@register_op("argmin", nondiff=True)
+def argmin(x, *, axis=None, keepdims=False):
+    r = jnp.argmin(x, axis=axis)
+    if keepdims and axis is not None:
+        r = jnp.expand_dims(r, axis)
+    return r.astype(jnp.float32)
+
+
+@register_op("norm")
+def norm(x, *, ord=2, axis=None, keepdims=False):
+    if ord == 2:
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdims))
+    if ord == 1:
+        return jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims)
+    raise ValueError("norm only supports ord 1/2 (ref: src/operator/tensor/broadcast_reduce_op_value.cc)")
+
+
+@register_op("cumsum")
+def cumsum(x, *, axis=None, dtype=None):
+    return jnp.cumsum(x, axis=axis, dtype=resolve_dtype(dtype))
+
+
+@register_op("L2Normalization")
+def L2Normalization(x, *, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        ax = tuple(range(1, x.ndim))
+    elif mode == "channel":
+        ax = (1,)
+    else:  # spatial
+        ax = tuple(range(2, x.ndim))
+    return x / jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=True) + eps)
+
+
+@register_op("topk", nondiff=True)
+def topk(x, *, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    xm = jnp.moveaxis(x, axis, -1)
+    vals, idx = lax.top_k(-xm if is_ascend else xm, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis).astype(resolve_dtype(dtype))
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idx
+    return idx
+
+
+@register_op("sort")
+def sort(x, *, axis=-1, is_ascend=True):
+    s = jnp.sort(x, axis=axis)
+    return s if is_ascend else jnp.flip(s, axis=axis)
+
+
+@register_op("argsort", nondiff=True)
+def argsort(x, *, axis=-1, is_ascend=True, dtype="float32"):
+    i = jnp.argsort(x, axis=axis)
+    if not is_ascend:
+        i = jnp.flip(i, axis=axis)
+    return i.astype(resolve_dtype(dtype))
+
+
+# ---------------------------------------------------------------- shape ops
+
+
+@register_op("reshape")
+def reshape(x, *, shape):
+    # MXNet magic values: 0 copy dim, -1 infer (ref: src/operator/tensor/matrix_op.cc)
+    out = []
+    for i, s in enumerate(shape):
+        out.append(x.shape[i] if s == 0 else s)
+    return jnp.reshape(x, tuple(out))
+
+
+@register_op("flatten")
+def flatten(x):
+    return jnp.reshape(x, (x.shape[0], -1))
+
+
+@register_op("transpose")
+def transpose(x, *, axes=None):
+    return jnp.transpose(x, axes=axes)
+
+
+@register_op("swapaxes")
+def swapaxes(x, *, dim1=0, dim2=0):
+    return jnp.swapaxes(x, dim1, dim2)
+
+
+@register_op("expand_dims")
+def expand_dims(x, *, axis):
+    return jnp.expand_dims(x, axis)
+
+
+@register_op("squeeze")
+def squeeze(x, *, axis=None):
+    return jnp.squeeze(x, axis=axis)
+
+
+@register_op("broadcast_to")
+def broadcast_to(x, *, shape):
+    shape = tuple(x.shape[i] if s == 0 else s for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, shape)
+
+
+@register_op("broadcast_like")
+def broadcast_like(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+@register_op("tile")
+def tile(x, *, reps):
+    return jnp.tile(x, reps)
+
+
+@register_op("repeat")
+def repeat(x, *, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register_op("pad")
+def pad(x, *, mode="constant", pad_width=None, constant_value=0.0):
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(len(pad_width) // 2)]
+    if mode == "constant":
+        return jnp.pad(x, pw, mode="constant", constant_values=constant_value)
+    if mode == "edge":
+        return jnp.pad(x, pw, mode="edge")
+    return jnp.pad(x, pw, mode="reflect")
+
+
+@register_op("flip")
+def flip(x, *, axis):
+    return jnp.flip(x, axis=axis)
+
+
+reverse = register_op("reverse")(lambda x, *, axis: jnp.flip(x, axis=axis))
+
+
+@register_op("concat")
+def concat(*xs, dim=1):
+    return jnp.concatenate(xs, axis=dim)
+
+
+@register_op("stack")
+def stack(*xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+@register_op("split")
+def split(x, *, num_outputs, axis=1, squeeze_axis=False):
+    parts = jnp.split(x, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register_op("slice")
+def slice(x, *, begin, end, step=None):
+    import builtins
+
+    step = step or [None] * len(begin)
+    sl = tuple(builtins.slice(b, e, s) for b, e, s in zip(begin, end, step))
+    return x[sl]
+
+
+@register_op("slice_axis")
+def slice_axis(x, *, axis, begin, end):
+    import builtins
+
+    idx = [builtins.slice(None)] * x.ndim
+    if end is None:
+        end = x.shape[axis]
+    idx[axis] = builtins.slice(begin, end)
+    return x[tuple(idx)]
+
+
+@register_op("slice_like")
+def slice_like(x, y, *, axes=None):
+    import builtins
+
+    idx = [builtins.slice(None)] * x.ndim
+    axes = axes if axes is not None else range(x.ndim)
+    for ax in axes:
+        idx[ax] = builtins.slice(0, y.shape[ax])
+    return x[tuple(idx)]
+
+
+@register_op("take")
+def take(x, indices, *, axis=0, mode="clip"):
+    return jnp.take(x, indices.astype(jnp.int32), axis=axis, mode=mode)
+
+
+@register_op("pick")
+def pick(x, index, *, axis=-1, keepdims=False):
+    idx = jnp.expand_dims(index.astype(jnp.int32), axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return out if keepdims else jnp.squeeze(out, axis=axis)
+
+
+@register_op("gather_nd")
+def gather_nd(data, indices):
+    # indices: (M, ...) selecting along the first M dims (ref: src/operator/tensor/indexing_op.cc)
+    idx = tuple(indices[i].astype(jnp.int32) for i in range(indices.shape[0]))
+    return data[idx]
+
+
+@register_op("scatter_nd")
+def scatter_nd(data, indices, *, shape):
+    idx = tuple(indices[i].astype(jnp.int32) for i in range(indices.shape[0]))
+    return jnp.zeros(shape, data.dtype).at[idx].set(data)
+
+
+@register_op("one_hot", nondiff=True)
+def one_hot(indices, *, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=resolve_dtype(dtype))
+    return oh * (on_value - off_value) + off_value
+
+
+@register_op("diag")
+def diag(x, *, k=0):
+    return jnp.diag(x, k=k) if x.ndim <= 2 else jnp.diagonal(x, offset=k)
+
+
+@register_op("depth_to_space")
+def depth_to_space(x, *, block_size):
+    n, c, h, w = x.shape
+    b = block_size
+    y = x.reshape(n, b, b, c // (b * b), h, w)
+    y = y.transpose(0, 3, 4, 1, 5, 2)
+    return y.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register_op("space_to_depth")
+def space_to_depth(x, *, block_size):
+    n, c, h, w = x.shape
+    b = block_size
+    y = x.reshape(n, c, h // b, b, w // b, b)
+    y = y.transpose(0, 3, 5, 1, 2, 4)
+    return y.reshape(n, c * b * b, h // b, w // b)
+
+
+@register_op("zeros_like")
+def zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+@register_op("ones_like")
+def ones_like(x):
+    return jnp.ones_like(x)
+
+
+@register_op("shape_array", nondiff=True)
+def shape_array(x):
+    return jnp.array(x.shape, dtype=jnp.int64)
+
+
+@register_op("size_array", nondiff=True)
+def size_array(x):
+    return jnp.array([x.size], dtype=jnp.int64)
+
+
+@register_op("BlockGrad")
+def BlockGrad(x):
+    return lax.stop_gradient(x)
+
+
+stop_gradient = BlockGrad
+
+
+# ---------------------------------------------------------------- linalg
+
+
+@register_op("dot")
+def dot(a, b, *, transpose_a=False, transpose_b=False):
+    """MXNet dot: contract last axis of a with first axis of b
+    (ref: src/operator/tensor/dot-inl.h)."""
+    if transpose_a:
+        a = jnp.moveaxis(a, 0, -1) if a.ndim > 1 else a
+    if transpose_b:
+        b = jnp.moveaxis(b, -1, 0) if b.ndim > 1 else b
+    return jnp.tensordot(a, b, axes=1) if (a.ndim > 1 or b.ndim > 1) else jnp.dot(a, b)
+
+
+@register_op("batch_dot")
+def batch_dot(a, b, *, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+@register_op("matmul")
+def matmul(a, b):
+    return jnp.matmul(a, b)
+
+
+@register_op("linalg_gemm2")
+def linalg_gemm2(a, b, *, transpose_a=False, transpose_b=False, alpha=1.0):
+    return alpha * batch_dot(a, b, transpose_a=transpose_a, transpose_b=transpose_b)
+
+
+@register_op("khatri_rao")
+def khatri_rao(*xs):
+    out = xs[0]
+    for m in xs[1:]:
+        out = jnp.einsum("ir,jr->ijr", out, m).reshape(-1, out.shape[1])
+    return out
+
+
+# ---------------------------------------------------------------- neural net
+
+
+@register_op("FullyConnected")
+def FullyConnected(x, weight, bias=None, *, num_hidden=None, no_bias=False, flatten=True):
+    """y = x @ W^T + b, weight (num_hidden, in) as in MXNet
+    (ref: src/operator/nn/fully_connected.cc). Maps straight onto the MXU."""
+    if flatten and x.ndim > 2:
+        x = jnp.reshape(x, (x.shape[0], -1))
+    y = jnp.matmul(x, weight.T)
+    if bias is not None and not no_bias:
+        y = y + bias
+    return y
+
+
+def _pair(v, n=2):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+@register_op("Convolution")
+def Convolution(x, weight, bias=None, *, kernel=None, stride=1, pad=0, dilate=1,
+                num_group=1, no_bias=False, layout="NCHW"):
+    """N-d convolution via lax.conv_general_dilated (ref:
+    src/operator/nn/convolution.cc; cuDNN path replaced by XLA:TPU which tiles
+    convs onto the MXU)."""
+    nd = x.ndim - 2
+    stride = _pair(stride, nd)
+    pad = _pair(pad, nd)
+    dilate = _pair(dilate, nd)
+    spatial = "DHW"[-nd:] if nd <= 3 else None
+    lhs = "NC" + spatial
+    rhs = "OI" + spatial
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape, (lhs, rhs, lhs))
+    y = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn, feature_group_count=num_group,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
+    )
+    y = y.astype(x.dtype)
+    if bias is not None and not no_bias:
+        y = y + bias.reshape((1, -1) + (1,) * nd)
+    return y
+
+
+@register_op("Deconvolution")
+def Deconvolution(x, weight, bias=None, *, kernel=None, stride=1, pad=0, dilate=1,
+                  num_group=1, adj=0, no_bias=False, layout="NCHW"):
+    nd = x.ndim - 2
+    stride = _pair(stride, nd)
+    pad = _pair(pad, nd)
+    adj = _pair(adj, nd)
+    spatial = "DHW"[-nd:]
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape, ("NC" + spatial, "IO" + spatial, "NC" + spatial))
+    k = weight.shape[2:]
+    padding = [(ki - 1 - p, ki - 1 - p + a) for ki, p, a in zip(k, pad, adj)]
+    y = lax.conv_general_dilated(
+        x, jnp.flip(weight, axis=tuple(range(2, 2 + nd))),
+        window_strides=(1,) * nd, padding=padding, lhs_dilation=stride,
+        dimension_numbers=dn, feature_group_count=num_group,
+    )
+    if bias is not None and not no_bias:
+        y = y + bias.reshape((1, -1) + (1,) * nd)
+    return y
+
+
+@register_op("Pooling")
+def Pooling(x, *, kernel=1, pool_type="max", stride=None, pad=0,
+            global_pool=False, count_include_pad=True):
+    """max/avg/sum pooling via lax.reduce_window (ref: src/operator/nn/pooling.cc)."""
+    nd = x.ndim - 2
+    if global_pool:
+        ax = tuple(range(2, x.ndim))
+        if pool_type == "max":
+            return jnp.max(x, axis=ax, keepdims=True)
+        if pool_type == "sum":
+            return jnp.sum(x, axis=ax, keepdims=True)
+        return jnp.mean(x, axis=ax, keepdims=True)
+    kernel = _pair(kernel, nd)
+    stride = _pair(stride if stride is not None else kernel, nd)
+    pad = _pair(pad, nd)
+    dims = (1, 1) + kernel
+    strides = (1, 1) + stride
+    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, init, lax.max, dims, strides, padding)
+    s = lax.reduce_window(x, 0.0, lax.add, dims, strides, padding)
+    if pool_type == "sum":
+        return s
+    if count_include_pad:
+        return s / math.prod(kernel)
+    ones = jnp.ones(x.shape[2:], x.dtype)[None, None]
+    cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strides, padding)
+    return s / jnp.maximum(cnt, 1.0)
+
+
+@register_op("BatchNorm", needs_training=True)
+def BatchNorm(x, gamma, beta, moving_mean, moving_var, *, eps=1e-5, momentum=0.9,
+              fix_gamma=False, use_global_stats=False, axis=1, training=False):
+    """Returns (y, new_moving_mean, new_moving_var)
+    (ref: src/operator/nn/batch_norm.cc)."""
+    if fix_gamma:
+        gamma = jnp.ones_like(gamma)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    shape = tuple(shape)
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    if training and not use_global_stats:
+        m = jnp.mean(x, axis=red)
+        v = jnp.var(x, axis=red)
+        new_mean = momentum * moving_mean + (1 - momentum) * m
+        new_var = momentum * moving_var + (1 - momentum) * v
+    else:
+        m, v = moving_mean, moving_var
+        new_mean, new_var = moving_mean, moving_var
+    inv = lax.rsqrt(v.astype(jnp.float32) + eps).astype(x.dtype)
+    y = (x - m.reshape(shape).astype(x.dtype)) * inv.reshape(shape) * gamma.reshape(shape).astype(x.dtype) \
+        + beta.reshape(shape).astype(x.dtype)
+    return y, lax.stop_gradient(new_mean), lax.stop_gradient(new_var)
+
+
+@register_op("LayerNorm")
+def LayerNorm(x, gamma, beta, *, axis=-1, eps=1e-5):
+    """(ref: src/operator/nn/layer_norm.cc). Computed in fp32 for bf16 inputs —
+    the standard TPU recipe; XLA fuses the whole thing into one kernel."""
+    xf = x.astype(jnp.float32)
+    m = jnp.mean(xf, axis=axis, keepdims=True)
+    v = jnp.var(xf, axis=axis, keepdims=True)
+    y = (xf - m) * lax.rsqrt(v + eps)
+    return (y.astype(x.dtype)) * gamma + beta
+
+
+@register_op("InstanceNorm")
+def InstanceNorm(x, gamma, beta, *, eps=1e-5):
+    red = tuple(range(2, x.ndim))
+    m = jnp.mean(x, axis=red, keepdims=True)
+    v = jnp.var(x, axis=red, keepdims=True)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return (x - m) * lax.rsqrt(v + eps) * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register_op("GroupNorm")
+def GroupNorm(x, gamma, beta, *, num_groups=1, eps=1e-5):
+    n, c = x.shape[:2]
+    xr = x.reshape((n, num_groups, c // num_groups) + x.shape[2:])
+    red = tuple(range(2, xr.ndim))
+    m = jnp.mean(xr, axis=red, keepdims=True)
+    v = jnp.var(xr, axis=red, keepdims=True)
+    xr = (xr - m) * lax.rsqrt(v + eps)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    return xr.reshape(x.shape) * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register_op("Dropout", needs_rng=True, needs_training=True)
+def Dropout(x, *, p=0.5, training=False, key=None, mode="training"):
+    if not training or p <= 0.0 or key is None:
+        return x
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+@register_op("Activation")
+def Activation(x, *, act_type="relu"):
+    if act_type == "relu":
+        return jax.nn.relu(x)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(x)
+    if act_type == "tanh":
+        return jnp.tanh(x)
+    if act_type == "softrelu":
+        return jax.nn.softplus(x)
+    if act_type == "softsign":
+        return jax.nn.soft_sign(x)
+    if act_type == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    if act_type == "gelu_tanh":
+        return jax.nn.gelu(x, approximate=True)
+    if act_type == "swish" or act_type == "silu":
+        return jax.nn.silu(x)
+    raise ValueError("unknown act_type %r" % act_type)
+
+
+@register_op("LeakyReLU")
+def LeakyReLU(x, gamma=None, *, act_type="leaky", slope=0.25, lower_bound=0.125,
+              upper_bound=0.334, key=None):
+    if act_type == "leaky":
+        return jnp.where(x >= 0, x, slope * x)
+    if act_type == "prelu":
+        g = gamma
+        if g.ndim == 1 and x.ndim > 1:
+            g = g.reshape((1, -1) + (1,) * (x.ndim - 2))
+        return jnp.where(x >= 0, x, g * x)
+    if act_type == "elu":
+        return jnp.where(x >= 0, x, slope * (jnp.exp(x) - 1))
+    if act_type == "selu":
+        return jax.nn.selu(x)
+    if act_type == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    raise ValueError("unknown act_type %r" % act_type)
+
+
+@register_op("softmax")
+def softmax(x, *, axis=-1, temperature=None):
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register_op("log_softmax")
+def log_softmax(x, *, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register_op("softmax_cross_entropy")
+def softmax_cross_entropy(logits, labels):
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(lp, labels.astype(jnp.int32)[:, None], axis=-1)
+    return jnp.sum(nll)
+
+
+@register_op("SoftmaxOutput")
+def SoftmaxOutput(x, label=None, *, grad_scale=1.0, ignore_label=-1,
+                  use_ignore=False, preserve_shape=False, multi_output=False):
+    return jax.nn.softmax(x, axis=-1)
+
+
+@register_op("Embedding")
+def Embedding(indices, weight, *, input_dim=None, output_dim=None, dtype=None,
+              sparse_grad=False):
+    """(ref: src/operator/tensor/indexing_op.cc:Embedding). Gather tiles well on
+    TPU when the table's trailing dim is a multiple of 128."""
+    return jnp.take(weight, indices.astype(jnp.int32), axis=0)
+
+
+@register_op("SequenceMask")
+def SequenceMask(x, sequence_length=None, *, use_sequence_length=False, value=0.0, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return x
+    T = x.shape[axis]
+    pos = jnp.arange(T)
+    shape = [1] * x.ndim
+    shape[axis] = T
+    pos = pos.reshape(shape)
+    lshape = [1] * x.ndim
+    batch_axis = 1 if axis == 0 else 0
+    lshape[batch_axis] = x.shape[batch_axis]
+    mask = pos < sequence_length.reshape(lshape)
+    return jnp.where(mask, x, value).astype(x.dtype)
+
+
+@register_op("SequenceLast")
+def SequenceLast(x, sequence_length=None, *, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        import builtins
+
+        idx = [builtins.slice(None)] * x.ndim
+        idx[axis] = -1
+        return x[tuple(idx)]
+    last = (sequence_length.astype(jnp.int32) - 1)
+    return jnp.take_along_axis(
+        jnp.moveaxis(x, axis, 0), last[None, :, None] if x.ndim > 2 else last[None, :], axis=0
+    )[0]
+
+
+@register_op("SequenceReverse")
+def SequenceReverse(x, sequence_length=None, *, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(x, axis=axis)
+    T = x.shape[axis]
+    xm = jnp.moveaxis(x, axis, 0)
+    pos = jnp.arange(T)[:, None]
+    L = sequence_length.astype(jnp.int32)[None, :]
+    src = jnp.where(pos < L, L - 1 - pos, pos)
+    out = jnp.take_along_axis(xm, src.reshape(src.shape + (1,) * (xm.ndim - 2)).astype(jnp.int32), axis=0)
+    return jnp.moveaxis(out, 0, axis)
+
+
+@register_op("LRN")
+def LRN(x, *, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    """Local response norm across channels (ref: src/operator/nn/lrn.cc)."""
+    sq = jnp.square(x)
+    s = lax.reduce_window(sq, 0.0, lax.add, (1, nsize, 1, 1), (1, 1, 1, 1),
+                          ((0, 0), (nsize // 2, nsize // 2), (0, 0), (0, 0)))
+    return x / jnp.power(knorm + (alpha / nsize) * s, beta)
+
+
+@register_op("UpSampling")
+def UpSampling(x, *, scale=2, sample_type="nearest"):
+    n, c, h, w = x.shape
+    if sample_type == "nearest":
+        return jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
+    return jax.image.resize(x, (n, c, h * scale, w * scale), method="bilinear")
+
+
+@register_op("BilinearResize2D")
+def BilinearResize2D(x, *, height, width):
+    n, c = x.shape[:2]
+    return jax.image.resize(x, (n, c, height, width), method="bilinear")

@@ -1,0 +1,364 @@
+"""Optimizers, MXNet API surface, jit-fused TPU updates.
+
+(ref: python/mxnet/optimizer/optimizer.py, src/operator/optimizer_op.cc).
+MXNet fuses updates in handwritten CUDA kernels (sgd_mom_update, adam_update…);
+here each optimizer defines a pure ``_step(w, g, state, lr, wd) -> (w, state)``
+that XLA fuses into a single kernel per parameter. ``lr`` and ``wd`` are traced
+scalars so LR schedules never retrace. Multi-precision keeps an fp32 master
+copy in state when weights are bf16/fp16 (the AMP recipe on TPU).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import jitted
+from .ndarray import NDArray
+
+__all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "AdaGrad", "AdaDelta",
+           "RMSProp", "Ftrl", "LAMB", "Signum", "SGLD", "create", "register"]
+
+_REGISTRY = {}
+
+
+def register(klass):
+    _REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(name, **kwargs):
+    return _REGISTRY[name.lower()](**kwargs)
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.01, wd=0.0, rescale_grad=1.0,
+                 clip_gradient=None, lr_scheduler=None, param_idx2name=None,
+                 begin_num_update=0, multi_precision=False, **kwargs):
+        self.lr = learning_rate
+        self.wd = wd
+        self.rescale_grad = rescale_grad
+        self.clip_gradient = clip_gradient
+        self.lr_scheduler = lr_scheduler
+        self.num_update = begin_num_update
+        self.begin_num_update = begin_num_update
+        self.multi_precision = multi_precision
+        self.idx2name = param_idx2name or {}
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self._index_update_count = {}
+
+    # --------------------------------------------------------- MXNet surface
+    def set_learning_rate(self, lr):
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        self._index_update_count.setdefault(index, self.begin_num_update)
+        self._index_update_count[index] += 1
+        self.num_update = max(self.num_update, self._index_update_count[index])
+
+    def _get_lr(self, index):
+        lr = self.learning_rate
+        name = self.idx2name.get(index, index)
+        return lr * self.lr_mult.get(name, self.lr_mult.get(index, 1.0))
+
+    def _get_wd(self, index):
+        name = self.idx2name.get(index, index)
+        wd = self.wd * self.wd_mult.get(name, self.wd_mult.get(index, 1.0))
+        return wd
+
+    # --------------------------------------------------------- functional core
+    def create_state(self, index, weight):
+        state = self.init_state(weight._data if isinstance(weight, NDArray) else weight)
+        if self.multi_precision and weight.dtype in (jnp.bfloat16, jnp.float16):
+            master = (weight._data if isinstance(weight, NDArray) else weight).astype(jnp.float32)
+            return {"master": master, "state": state}
+        return state
+
+    def init_state(self, w):
+        return ()
+
+    def _step(self, w, g, state, lr, wd, t):
+        raise NotImplementedError
+
+    def _preprocess_grad(self, g):
+        g = g * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g
+
+    def _stepper(self):
+        def step(w, g, state, lr, wd, t):
+            g = self._preprocess_grad(g)
+            if isinstance(state, dict) and "master" in state:
+                m = state["master"]
+                new_m, new_s = self._step(m, g.astype(jnp.float32), state["state"], lr, wd, t)
+                return new_m.astype(w.dtype), {"master": new_m, "state": new_s}
+            return self._step(w, g, state, lr, wd, t)
+
+        return step
+
+    def update(self, index, weight, grad, state):
+        """In-place MXNet-style update (ref: optimizer.py:Optimizer.update)."""
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        f = getattr(self, "_jit_step", None)
+        if f is None:
+            f = self._jit_step = jax.jit(self._stepper())
+        new_w, new_state = f(weight._data, grad._data if isinstance(grad, NDArray) else grad,
+                             state, jnp.float32(lr), jnp.float32(wd), jnp.int32(t))
+        weight._data = new_w
+        return new_state
+
+    def update_multi_precision(self, index, weight, grad, state):
+        return self.update(index, weight, grad, state)
+
+
+@register
+class SGD(Optimizer):
+    """(ref: src/operator/optimizer_op.cc:sgd_mom_update)"""
+
+    def __init__(self, momentum=0.0, lazy_update=False, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def init_state(self, w):
+        return jnp.zeros_like(w, dtype=jnp.float32) if self.momentum else ()
+
+    def _step(self, w, g, state, lr, wd, t):
+        g = g + wd * w
+        if self.momentum:
+            mom = self.momentum * state - lr * g
+            return w + mom.astype(w.dtype), mom
+        return w - (lr * g).astype(w.dtype), state
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (ref: optimizer.py:NAG)."""
+
+    def _step(self, w, g, state, lr, wd, t):
+        g = g + wd * w
+        if self.momentum:
+            mom = self.momentum * state - lr * g
+            return w + (self.momentum * mom - lr * g).astype(w.dtype), mom
+        return w - (lr * g).astype(w.dtype), state
+
+
+@register
+class Adam(Optimizer):
+    """(ref: src/operator/optimizer_op.cc:adam_update)"""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def init_state(self, w):
+        z = jnp.zeros_like(w, dtype=jnp.float32)
+        return (z, z)
+
+    def _step(self, w, g, state, lr, wd, t):
+        m, v = state
+        g = g.astype(jnp.float32) + wd * w.astype(jnp.float32)
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        tf = t.astype(jnp.float32)
+        mhat = m / (1 - self.beta1 ** tf)
+        vhat = v / (1 - self.beta2 ** tf)
+        upd = lr * mhat / (jnp.sqrt(vhat) + self.epsilon)
+        return (w.astype(jnp.float32) - upd).astype(w.dtype), (m, v)
+
+
+@register
+class AdamW(Adam):
+    """Decoupled weight decay (ref: python/mxnet/contrib/optimizer.py? + AdamW paper)."""
+
+    def _step(self, w, g, state, lr, wd, t):
+        m, v = state
+        g = g.astype(jnp.float32)
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        tf = t.astype(jnp.float32)
+        mhat = m / (1 - self.beta1 ** tf)
+        vhat = v / (1 - self.beta2 ** tf)
+        upd = lr * (mhat / (jnp.sqrt(vhat) + self.epsilon) + wd * w.astype(jnp.float32))
+        return (w.astype(jnp.float32) - upd).astype(w.dtype), (m, v)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def init_state(self, w):
+        return jnp.zeros_like(w, dtype=jnp.float32)
+
+    def _step(self, w, g, state, lr, wd, t):
+        g = g + wd * w
+        hist = state + jnp.square(g)
+        return (w - lr * g / (jnp.sqrt(hist) + self.float_stable_eps)).astype(w.dtype), hist
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.9, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def init_state(self, w):
+        z = jnp.zeros_like(w, dtype=jnp.float32)
+        return (z, z)
+
+    def _step(self, w, g, state, lr, wd, t):
+        acc_g, acc_d = state
+        g = g + wd * w
+        acc_g = self.rho * acc_g + (1 - self.rho) * jnp.square(g)
+        d = jnp.sqrt(acc_d + self.epsilon) / jnp.sqrt(acc_g + self.epsilon) * g
+        acc_d = self.rho * acc_d + (1 - self.rho) * jnp.square(d)
+        return (w - d).astype(w.dtype), (acc_g, acc_d)
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9, epsilon=1e-8,
+                 centered=False, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2, self.epsilon, self.centered = gamma1, gamma2, epsilon, centered
+
+    def init_state(self, w):
+        z = jnp.zeros_like(w, dtype=jnp.float32)
+        return (z, z, z) if self.centered else (z,)
+
+    def _step(self, w, g, state, lr, wd, t):
+        g = g + wd * w
+        if self.centered:
+            n, mg, mom = state
+            n = self.gamma1 * n + (1 - self.gamma1) * jnp.square(g)
+            mg = self.gamma1 * mg + (1 - self.gamma1) * g
+            mom = self.gamma2 * mom - lr * g / jnp.sqrt(n - jnp.square(mg) + self.epsilon)
+            return (w + mom).astype(w.dtype), (n, mg, mom)
+        (n,) = state
+        n = self.gamma1 * n + (1 - self.gamma1) * jnp.square(g)
+        return (w - lr * g / (jnp.sqrt(n) + self.epsilon)).astype(w.dtype), (n,)
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def init_state(self, w):
+        z = jnp.zeros_like(w, dtype=jnp.float32)
+        return (z, z)
+
+    def _step(self, w, g, state, lr, wd, t):
+        z, n = state
+        sigma = (jnp.sqrt(n + jnp.square(g)) - jnp.sqrt(n)) / lr
+        z = z + g - sigma * w
+        n = n + jnp.square(g)
+        new_w = jnp.where(
+            jnp.abs(z) > self.lamda1,
+            -(z - jnp.sign(z) * self.lamda1) / ((self.beta + jnp.sqrt(n)) / lr + wd),
+            0.0,
+        )
+        return new_w.astype(w.dtype), (z, n)
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise adaptive moments for large-batch BERT (ref: contrib LAMB)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-6,
+                 lower_bound=None, upper_bound=None, bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound, self.upper_bound = lower_bound, upper_bound
+        self.bias_correction = bias_correction
+
+    def init_state(self, w):
+        z = jnp.zeros_like(w, dtype=jnp.float32)
+        return (z, z)
+
+    def _step(self, w, g, state, lr, wd, t):
+        m, v = state
+        g = g.astype(jnp.float32)
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        if self.bias_correction:
+            tf = t.astype(jnp.float32)
+            mhat = m / (1 - self.beta1 ** tf)
+            vhat = v / (1 - self.beta2 ** tf)
+        else:
+            mhat, vhat = m, v
+        r = mhat / (jnp.sqrt(vhat) + self.epsilon) + wd * w.astype(jnp.float32)
+        wnorm = jnp.linalg.norm(w.astype(jnp.float32))
+        rnorm = jnp.linalg.norm(r)
+        ratio = jnp.where((wnorm > 0) & (rnorm > 0), wnorm / rnorm, 1.0)
+        if self.lower_bound is not None:
+            ratio = jnp.maximum(ratio, self.lower_bound)
+        if self.upper_bound is not None:
+            ratio = jnp.minimum(ratio, self.upper_bound)
+        return (w.astype(jnp.float32) - lr * ratio * r).astype(w.dtype), (m, v)
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum, self.wd_lh = momentum, wd_lh
+
+    def init_state(self, w):
+        return jnp.zeros_like(w, dtype=jnp.float32)
+
+    def _step(self, w, g, state, lr, wd, t):
+        mom = self.momentum * state + (1 - self.momentum) * (g + wd * w)
+        return (w * (1 - lr * self.wd_lh) - lr * jnp.sign(mom)).astype(w.dtype), mom
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (ref: optimizer.py:SGLD)."""
+
+    def init_state(self, w):
+        return jnp.zeros((2,), jnp.uint32)  # fold counter as pseudo-state
+
+    def _step(self, w, g, state, lr, wd, t):
+        g = g + wd * w
+        key = jax.random.fold_in(jax.random.PRNGKey(0), t)
+        noise = jax.random.normal(key, w.shape, jnp.float32) * jnp.sqrt(lr)
+        return (w - 0.5 * lr * g + noise.astype(w.dtype)).astype(w.dtype), state
+
+
+class Updater:
+    """(ref: optimizer.py:Updater) — kvstore-side updater closure."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state(index, weight)
+        self.states[index] = self.optimizer.update(index, weight, grad, self.states[index])
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

@@ -1,0 +1,10 @@
+"""Distributed training over device meshes (see SURVEY.md §3.5)."""
+from .mesh import (make_mesh, named_sharding, replicated, use_mesh,  # noqa: F401
+                   current_mesh, shard_array, get_shard_map, P, AXES)
+from .data_parallel import (build_train_step, tree_optimizer_step,  # noqa: F401
+                            replicate_params, shard_batch, block_loss_fn)
+from . import tensor_parallel  # noqa: F401
+from .tensor_parallel import shard_params, param_specs, constrain  # noqa: F401
+from .ring_attention import ring_attention, full_attention  # noqa: F401
+from .pipeline import pipeline_apply, stack_stage_params  # noqa: F401
+from .resilience import Heartbeat, ResumableLoop  # noqa: F401

@@ -1,0 +1,77 @@
+"""Pipeline parallelism over the 'pp' mesh axis (GPipe schedule).
+
+The reference has no pipeline engine (MXNet model-parallel was manual
+ctx-placement); required here for pod-scale models. Implementation: every
+device holds ONE stage's params (sharded over 'pp'); activations flow around
+the ring with ``lax.ppermute`` inside a ``lax.scan`` over
+n_micro + n_stages - 1 ticks — the canonical JAX SPMD pipeline pattern.
+Microbatch i enters stage 0 at tick i; outputs collect on the last stage and
+are psum-broadcast back (cheap relative to the steady-state compute).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .mesh import get_shard_map
+
+
+def pipeline_apply(stage_fn, stage_params, microbatches, mesh, axis_name="pp"):
+    """stage_fn(params, x) -> y, same activation shape across stages.
+
+    stage_params: pytree whose leaves have a leading 'stages' dim sharded over
+    `axis_name` (leaf shape (n_stages, ...)).
+    microbatches: (n_micro, mb, ...) replicated input.
+    Returns (n_micro, mb, ...) outputs (replicated).
+    """
+    sm = get_shard_map()
+
+    def local(params, xs):
+        # params leaves: (1, ...) local stage slice; xs: full (n_micro, ...)
+        params = jax.tree_util.tree_map(lambda a: a[0], params)
+        n_stages = lax.psum(1, axis_name)
+        stage = lax.axis_index(axis_name)
+        n_micro = xs.shape[0]
+        ticks = n_micro + n_stages - 1
+        perm = [(j, (j + 1) % n_stages) for j in range(n_stages)]
+
+        state = jnp.zeros_like(xs[0])
+        outputs = jnp.zeros((n_micro,) + xs.shape[1:], xs.dtype)
+
+        def tick(carry, t):
+            state, outputs = carry
+            # stage 0 ingests microbatch t (if in range), others use incoming state
+            inject = jnp.where(t < n_micro, t, 0)
+            x_in = jnp.where(stage == 0, xs[inject], state)
+            y = stage_fn(params, x_in)
+            # last stage writes its result for microbatch (t - (n_stages-1))
+            out_idx = t - (n_stages - 1)
+            write = (stage == n_stages - 1) & (out_idx >= 0)
+            outputs = lax.cond(
+                write,
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, y.astype(o.dtype), jnp.maximum(out_idx, 0), 0),
+                lambda o: o, outputs)
+            state_next = lax.ppermute(y, axis_name, perm)
+            return (state_next, outputs), None
+
+        (_, outputs), _ = lax.scan(tick, (state, outputs), jnp.arange(ticks))
+        # broadcast final outputs from last stage to all (psum of masked)
+        mask = (stage == n_stages - 1).astype(outputs.dtype)
+        outputs = lax.psum(outputs * mask, axis_name)
+        return outputs
+
+    pspec = jax.tree_util.tree_map(lambda _: P(axis_name), stage_params,
+                                   is_leaf=lambda a: hasattr(a, "shape"))
+    f = sm(local, mesh, in_specs=(pspec, P()), out_specs=P())
+    return f(stage_params, microbatches)
+
+
+def stack_stage_params(per_stage_params):
+    """list of per-stage pytrees (same structure/shapes) → stacked pytree with
+    leading stage dim, ready to shard over 'pp'."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_stage_params)

@@ -1,0 +1,86 @@
+"""Tensor parallelism: sharding rules + activation constraints.
+
+Replaces nothing in the reference (MXNet 1.x had no TP) but is required for
+the v5e-64-scale north star: attention heads and MLP hidden dims shard over
+'tp'; XLA inserts the all-reduces (Megatron pattern: column-parallel then
+row-parallel → one psum per block) riding ICI.
+"""
+from __future__ import annotations
+
+import re
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+# BERT/Transformer sharding rules: param-name regex → PartitionSpec.
+# Dense weights are (out, in) as in MXNet FullyConnected.
+TRANSFORMER_RULES = [
+    (r".*(query|key|value|qkv).*weight", P("tp", None)),   # column parallel
+    (r".*attn_out.*weight", P(None, "tp")),                # row parallel
+    (r".*(query|key|value|qkv).*bias", P("tp")),
+    (r".*ffn_1.*weight", P("tp", None)),                   # up-proj column
+    (r".*ffn_2.*weight", P(None, "tp")),                   # down-proj row
+    (r".*ffn_1.*bias", P("tp")),
+    (r".*word_embed.*weight", P("tp", None)),              # vocab sharded
+    (r".*embed.*weight", P()),
+    (r".*", P()),                                          # default: replicate
+]
+
+FSDP_RULES = [
+    (r".*", "fsdp_largest"),  # shard largest divisible dim over 'fsdp'
+]
+
+
+def spec_for(name, shape, rules, mesh):
+    for pattern, spec in rules:
+        if re.match(pattern, name):
+            if spec == "fsdp_largest":
+                return _fsdp_spec(shape, mesh)
+            if _fits(spec, shape, mesh):
+                return spec
+            return P()
+    return P()
+
+
+def _fits(spec, shape, mesh):
+    for dim, axis in enumerate(spec):
+        if axis is None:
+            continue
+        if dim >= len(shape) or shape[dim] % mesh.shape[axis] != 0:
+            return False
+    return True
+
+
+def _fsdp_spec(shape, mesh):
+    n = mesh.shape.get("fsdp", 1)
+    if n <= 1:
+        return P()
+    for dim, s in sorted(enumerate(shape), key=lambda t: -t[1]):
+        if s % n == 0:
+            spec = [None] * len(shape)
+            spec[dim] = "fsdp"
+            return P(*spec)
+    return P()
+
+
+def shard_params(named_arrays, mesh, rules=TRANSFORMER_RULES):
+    """named_arrays: list[(name, jax.Array)] → list placed with NamedSharding."""
+    out = []
+    for name, a in named_arrays:
+        spec = spec_for(name, a.shape, rules, mesh)
+        out.append(jax.device_put(a, NamedSharding(mesh, spec)))
+    return out
+
+
+def param_specs(named_shapes, mesh, rules=TRANSFORMER_RULES):
+    return [spec_for(name, shape, rules, mesh) for name, shape in named_shapes]
+
+
+def constrain(x, *spec):
+    """with_sharding_constraint for activations inside jit."""
+    from .mesh import current_mesh
+
+    mesh = current_mesh()
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*spec)))

@@ -1,0 +1,100 @@
+"""Profiler (ref: src/profiler/profiler.cc, python/mxnet/profiler.py).
+
+Wraps jax.profiler (XLA/TPU traces viewable in TensorBoard/Perfetto) and adds
+host-side named scopes with wall timers, mirroring MXNet's
+profiler.set_config/start/stop/dumps API.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+
+import jax
+
+_config = {"profile_all": False, "filename": "profile.json"}
+_running = False
+_records = []
+
+
+def set_config(profile_all=False, profile_symbolic=True, profile_imperative=True,
+               profile_memory=True, profile_api=True, filename="profile.json",
+               aggregate_stats=False, **kwargs):
+    _config.update(profile_all=profile_all, filename=filename)
+
+
+def set_state(state="stop", profile_process="worker"):
+    if state == "run":
+        start()
+    else:
+        stop()
+
+
+def start(profile_process="worker"):
+    global _running
+    if _running:
+        return
+    _running = True
+    logdir = _config["filename"].rsplit(".", 1)[0] + "_trace"
+    try:
+        jax.profiler.start_trace(logdir)
+    except Exception:
+        pass
+
+
+def stop(profile_process="worker"):
+    global _running
+    if not _running:
+        return
+    _running = False
+    try:
+        jax.profiler.stop_trace()
+    except Exception:
+        pass
+
+
+def pause(profile_process="worker"):
+    stop()
+
+
+def resume(profile_process="worker"):
+    start()
+
+
+def dumps(reset=False):
+    out = json.dumps(_records, indent=2)
+    if reset:
+        _records.clear()
+    return out
+
+
+def dump(finished=True, profile_process="worker"):
+    with open(_config["filename"], "w") as f:
+        f.write(dumps())
+
+
+@contextlib.contextmanager
+def scope(name="<unk>"):
+    t0 = time.perf_counter()
+    with jax.profiler.TraceAnnotation(name):
+        yield
+    _records.append({"name": name, "dur_ms": (time.perf_counter() - t0) * 1e3})
+
+
+class Task:
+    def __init__(self, domain=None, name="task"):
+        self.name = name
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self):
+        if self._t0 is not None:
+            _records.append({"name": self.name,
+                             "dur_ms": (time.perf_counter() - self._t0) * 1e3})
+
+
+Frame = Task
+Event = Task
+Counter = Task

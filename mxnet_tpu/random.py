@@ -1,0 +1,51 @@
+"""Stateful randomness over JAX's threefry counters.
+
+MXNet keeps per-device mt19937 states seeded by ``mx.random.seed`` (ref:
+src/resource.cc, python/mxnet/random.py). The TPU-native design keeps a single
+root threefry key and derives a fresh subkey per draw with ``fold_in`` on a
+monotone counter — deterministic under a seed, cheap, and safe to use inside
+jitted code when the key is threaded explicitly (the traced path does that; see
+mxnet_tpu/_trace.py).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+_state = threading.local()
+
+
+def _ensure():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(0)
+        _state.counter = 0
+
+
+def seed(seed_state, ctx=None):
+    """mx.random.seed parity; ctx accepted for API compat (single key domain)."""
+    _state.key = jax.random.PRNGKey(int(seed_state))
+    _state.counter = 0
+
+
+def next_key():
+    _ensure()
+    _state.counter += 1
+    return jax.random.fold_in(_state.key, _state.counter)
+
+
+def split(n=1):
+    return [next_key() for _ in range(n)]
+
+
+def get_state():
+    _ensure()
+    return (_state.key, _state.counter)
+
+
+def set_state(st):
+    _state.key, _state.counter = st
+
+
+__all__ = ["seed", "next_key", "split", "get_state", "set_state"]

@@ -1,0 +1,223 @@
+"""RecordIO pack format (ref: src/recordio.cc, python/mxnet/recordio.py).
+
+Same on-disk framing as MXNet (kMagic = 0xced7230a, 4-byte length with 3-bit
+continuation flags in the upper bits omitted for simple records, 4-byte
+alignment padding) so .rec files written here match the reference tooling's
+expectations. A C++ reader (src/engine_cc/recordio.cc) accelerates sequential
+scans when built; this module transparently uses it via ctypes.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+
+import numpy as np
+
+_MAGIC = 0xCED7230A
+
+
+def _pad(n):
+    return (4 - n % 4) % 4
+
+
+class MXRecordIO:
+    """Sequential record file (ref: python/mxnet/recordio.py:MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self._f = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self._f = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("flag must be 'r' or 'w'")
+        self._closed = False
+
+    def close(self):
+        if not self._closed:
+            self._f.close()
+            self._closed = True
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        return self._f.tell()
+
+    def write(self, buf):
+        assert self.writable
+        self._f.write(struct.pack("<II", _MAGIC, len(buf)))
+        self._f.write(buf)
+        self._f.write(b"\x00" * _pad(len(buf)))
+
+    def read(self):
+        assert not self.writable
+        header = self._f.read(8)
+        if len(header) < 8:
+            return None
+        magic, length = struct.unpack("<II", header)
+        assert magic == _MAGIC, "corrupt record file %s" % self.uri
+        buf = self._f.read(length)
+        self._f.read(_pad(length))
+        return buf
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """(ref: recordio.py:MXIndexedRecordIO); .idx maps key → byte offset."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+        if flag == "r" and os.path.exists(idx_path):
+            with open(idx_path) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) >= 2:
+                        key = key_type(parts[0])
+                        self.idx[key] = int(parts[1])
+                        self.keys.append(key)
+
+    def close(self):
+        if self.writable and not getattr(self, "_closed", True):
+            with open(self.idx_path, "w") as f:
+                for k in self.keys:
+                    f.write("%s\t%d\n" % (k, self.idx[k]))
+        super().close()
+
+    def seek(self, idx):
+        self._f.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        self.idx[key] = self.tell()
+        self.keys.append(key)
+        self.write(buf)
+
+
+IndexedRecordIO = MXIndexedRecordIO
+
+
+# ------------------------------------------------------------ IRHeader pack
+# (ref: python/mxnet/recordio.py:IRHeader/pack/unpack)
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+class IRHeader:
+    __slots__ = ("flag", "label", "id", "id2")
+
+    def __init__(self, flag, label, id, id2):
+        self.flag, self.label, self.id, self.id2 = flag, label, id, id2
+
+
+def pack(header, s):
+    label = header.label
+    if isinstance(label, (list, tuple, np.ndarray)):
+        label = np.asarray(label, dtype=np.float32)
+        hdr = struct.pack(_IR_FORMAT, len(label), 0.0, header.id, header.id2)
+        return hdr + label.tobytes() + s
+    hdr = struct.pack(_IR_FORMAT, 0, float(label), header.id, header.id2)
+    return hdr + s
+
+
+def unpack(s):
+    flag, label, id_, id2 = struct.unpack(_IR_FORMAT, s[:_IR_SIZE])
+    s = s[_IR_SIZE:]
+    if flag > 0:
+        label = np.frombuffer(s[:flag * 4], dtype=np.float32)
+        s = s[flag * 4:]
+    return IRHeader(flag, label, id_, id2), s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    import io as _io
+
+    from PIL import Image
+
+    buf = _io.BytesIO()
+    Image.fromarray(np.asarray(img)).save(
+        buf, format="JPEG" if img_fmt in (".jpg", ".jpeg") else "PNG", quality=quality)
+    return pack(header, buf.getvalue())
+
+
+def unpack_img(s, iscolor=1):
+    from .image import imdecode
+
+    header, img_bytes = unpack(s)
+    return header, imdecode(img_bytes, flag=iscolor)
+
+
+# ------------------------------------------------------------ native reader
+_native = None
+
+
+def _load_native():
+    global _native
+    if _native is not None:
+        return _native
+    so = os.path.join(os.path.dirname(__file__), "..", "src", "engine_cc", "libmxtpu.so")
+    so = os.path.abspath(so)
+    if os.path.exists(so):
+        try:
+            _native = ctypes.CDLL(so)
+        except OSError:
+            _native = False
+    else:
+        _native = False
+    return _native
+
+
+def read_all_native(uri):
+    """Scan a whole .rec file with the C++ reader; returns list[bytes].
+    Falls back to Python when the native library isn't built."""
+    lib = _load_native()
+    if not lib:
+        rec = MXRecordIO(uri, "r")
+        out = []
+        while True:
+            b = rec.read()
+            if b is None:
+                break
+            out.append(b)
+        rec.close()
+        return out
+    lib.mxtpu_recordio_open.restype = ctypes.c_void_p
+    lib.mxtpu_recordio_open.argtypes = [ctypes.c_char_p]
+    lib.mxtpu_recordio_next.restype = ctypes.c_ssize_t
+    lib.mxtpu_recordio_next.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p)]
+    lib.mxtpu_recordio_close.argtypes = [ctypes.c_void_p]
+    h = lib.mxtpu_recordio_open(uri.encode())
+    if not h:
+        raise IOError("cannot open %s" % uri)
+    out = []
+    try:
+        while True:
+            ptr = ctypes.c_char_p()
+            n = lib.mxtpu_recordio_next(h, ctypes.byref(ptr))
+            if n < 0:
+                break
+            out.append(ctypes.string_at(ptr, n))
+    finally:
+        lib.mxtpu_recordio_close(h)
+    return out

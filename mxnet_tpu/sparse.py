@@ -1,0 +1,109 @@
+"""Sparse arrays: CSR and RowSparse (ref: src/ndarray/ndarray.cc sparse paths,
+python/mxnet/ndarray/sparse.py).
+
+Design note: XLA:TPU has no native sparse kernels — the MXU wants dense tiles.
+MXNet uses sparse mainly for (a) huge embedding gradients (row_sparse) and
+(b) CSR feature matrices. The TPU-native stance: keep storage-format parity
+and convert at the op boundary; row_sparse gradients are carried as
+(indices, values) and applied with scatter-add (XLA fuses this well), which is
+what lazy_update SGD does on the reference.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .ndarray import NDArray, invoke
+
+__all__ = ["CSRNDArray", "RowSparseNDArray", "csr_matrix", "row_sparse_array",
+           "dot"]
+
+
+class CSRNDArray:
+    stype = "csr"
+
+    def __init__(self, data, indices, indptr, shape):
+        self.data = data if isinstance(data, NDArray) else NDArray(jnp.asarray(data))
+        self.indices = indices if isinstance(indices, NDArray) else NDArray(jnp.asarray(indices, jnp.int32))
+        self.indptr = indptr if isinstance(indptr, NDArray) else NDArray(jnp.asarray(indptr, jnp.int32))
+        self.shape = tuple(shape)
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def asnumpy(self):
+        return self.todense().asnumpy()
+
+    def todense(self):
+        n, m = self.shape
+        indptr = self.indptr._data
+        # row id per nnz via searchsorted on indptr
+        nnz = self.data.shape[0]
+        rows = jnp.searchsorted(indptr, jnp.arange(nnz), side="right") - 1
+        dense = jnp.zeros(self.shape, self.data.dtype)
+        dense = dense.at[rows, self.indices._data].add(self.data._data)
+        return NDArray(dense)
+
+    tostype = lambda self, stype: self.todense() if stype == "default" else self
+
+
+class RowSparseNDArray:
+    stype = "row_sparse"
+
+    def __init__(self, data, indices, shape):
+        self.data = data if isinstance(data, NDArray) else NDArray(jnp.asarray(data))
+        self.indices = indices if isinstance(indices, NDArray) else NDArray(jnp.asarray(indices, jnp.int32))
+        self.shape = tuple(shape)
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def asnumpy(self):
+        return self.todense().asnumpy()
+
+    def todense(self):
+        dense = jnp.zeros(self.shape, self.data.dtype)
+        dense = dense.at[self.indices._data].add(self.data._data)
+        return NDArray(dense)
+
+    def tostype(self, stype):
+        return self.todense() if stype == "default" else self
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        return CSRNDArray(data, indices, indptr, shape)
+    a = np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray) else arg1)
+    indptr = [0]
+    indices = []
+    data = []
+    for row in a:
+        nz = np.nonzero(row)[0]
+        indices.extend(nz.tolist())
+        data.extend(row[nz].tolist())
+        indptr.append(len(indices))
+    return CSRNDArray(np.asarray(data, a.dtype), np.asarray(indices, np.int32),
+                      np.asarray(indptr, np.int32), a.shape)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        return RowSparseNDArray(data, indices, shape)
+    a = np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray) else arg1)
+    rows = np.nonzero(a.any(axis=tuple(range(1, a.ndim))))[0]
+    return RowSparseNDArray(a[rows], rows.astype(np.int32), a.shape)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """csr × dense → dense (ref: src/operator/tensor/dot.cc sparse kernels).
+    Converts at the boundary — dense matmul rides the MXU."""
+    if isinstance(lhs, CSRNDArray):
+        lhs = lhs.todense()
+    if isinstance(rhs, (CSRNDArray, RowSparseNDArray)):
+        rhs = rhs.todense()
+    return invoke("dot", (lhs, rhs), {"transpose_a": transpose_a,
+                                      "transpose_b": transpose_b})

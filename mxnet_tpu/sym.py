@@ -1,0 +1,34 @@
+"""``mx.sym`` parity namespace: symbol-building ops generated from the registry
+(ref: python/mxnet/symbol/register.py)."""
+from __future__ import annotations
+
+import sys as _sys
+
+from .base import OP_REGISTRY as _REG
+from .symbol import Symbol, var, Variable, Group, _make  # noqa: F401
+
+_mod = _sys.modules[__name__]
+
+
+def _builder(opname):
+    def f(*args, name=None, **kwargs):
+        sym_kwargs = {k: v for k, v in kwargs.items() if isinstance(v, Symbol)}
+        attrs = {k: v for k, v in kwargs.items() if not isinstance(v, Symbol)}
+        inputs = list(args) + list(sym_kwargs.values())
+        return _make(opname, *inputs, name=name, **attrs)
+
+    f.__name__ = opname
+    return f
+
+
+for _name in list(_REG):
+    if not hasattr(_mod, _name):
+        setattr(_mod, _name, _builder(_name))
+
+
+def __getattr__(name):
+    if name in _REG:
+        f = _builder(name)
+        setattr(_mod, name, f)
+        return f
+    raise AttributeError(name)

@@ -1,0 +1,322 @@
+"""Symbolic graph API (ref: python/mxnet/symbol/symbol.py, nnvm graph).
+
+MXNet builds an nnvm DAG, plans memory, and executes via GraphExecutor
+(ref: src/executor/graph_executor.cc). TPU-natively the DAG is *lowered to one
+XLA computation*: binding a Symbol jits a pure function of its arguments —
+XLA then does scheduling/fusion/memory-planning (the jobs of nnvm's passes).
+Shape/type inference is ``jax.eval_shape`` over the same function.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import OP_REGISTRY, resolve_dtype
+from .context import current_context
+from .ndarray import NDArray
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "Executor"]
+
+
+class Symbol:
+    def __init__(self, op=None, inputs=(), attrs=None, name=None, shape=None,
+                 dtype=None, out_index=None, n_outputs=1):
+        self._op = op  # registry op name, None for variables, "_group" for groups
+        self._inputs = list(inputs)
+        self._attrs = dict(attrs or {})
+        self.name = name or (op if op else "var")
+        self._shape = tuple(shape) if shape is not None else None
+        self._dtype = resolve_dtype(dtype)
+        self._out_index = out_index
+        self._n_outputs = n_outputs
+
+    # ------------------------------------------------------------- structure
+    def is_var(self):
+        return self._op is None
+
+    def list_arguments(self):
+        """Free variables, depth-first order (ref: symbol.py:list_arguments)."""
+        seen = set()
+        out = []
+
+        def walk(s):
+            if id(s) in seen:
+                return
+            seen.add(id(s))
+            if s.is_var():
+                if s.name not in [o.name for o in out]:
+                    out.append(s)
+                return
+            for i in s._inputs:
+                walk(i)
+
+        walk(self)
+        return [s.name for s in out]
+
+    def _arg_symbols(self):
+        seen = set()
+        out = OrderedDict()
+
+        def walk(s):
+            if id(s) in seen:
+                return
+            seen.add(id(s))
+            if s.is_var():
+                out.setdefault(s.name, s)
+                return
+            for i in s._inputs:
+                walk(i)
+
+        walk(self)
+        return list(out.values())
+
+    def list_outputs(self):
+        if self._op == "_group":
+            return [i.name + "_output" for i in self._inputs]
+        return [self.name + "_output"]
+
+    def get_internals(self):
+        return self
+
+    def __getitem__(self, index):
+        if self._op == "_group":
+            return self._inputs[index]
+        return Symbol("_item", [self], {"index": index}, name="%s%d" % (self.name, index))
+
+    def attr(self, key):
+        return self._attrs.get(key)
+
+    # ------------------------------------------------------------- build ops
+    def __add__(self, o):
+        return _make("add", self, o)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return _make("subtract", self, o)
+
+    def __rsub__(self, o):
+        return _make("subtract", o, self)
+
+    def __mul__(self, o):
+        return _make("multiply", self, o)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return _make("divide", self, o)
+
+    def __rtruediv__(self, o):
+        return _make("divide", o, self)
+
+    def __pow__(self, o):
+        return _make("power", self, o)
+
+    def __neg__(self):
+        return _make("negative", self)
+
+    # ------------------------------------------------------------- evaluate
+    def _build_fn(self):
+        """Return (fn(feed_dict values in arg order) -> outputs, arg names)."""
+        args = self._arg_symbols()
+        names = [a.name for a in args]
+
+        def fn(*values):
+            env = dict(zip(names, values))
+            return _eval(self, env, {})
+
+        return fn, names
+
+    def eval(self, ctx=None, **kwargs):
+        fn, names = self._build_fn()
+        vals = [kwargs[n]._data if isinstance(kwargs[n], NDArray) else jnp.asarray(kwargs[n])
+                for n in names]
+        out = jax.jit(fn)(*vals)
+        out = out if isinstance(out, (list, tuple)) else [out]
+        return [NDArray(o) for o in out]
+
+    def infer_shape(self, **kwargs):
+        fn, names = self._build_fn()
+        specs = []
+        for n in names:
+            if n in kwargs:
+                specs.append(jax.ShapeDtypeStruct(tuple(kwargs[n]), jnp.float32))
+            else:
+                s = next(a for a in self._arg_symbols() if a.name == n)._shape
+                if s is None:
+                    raise ValueError("shape of %s unknown" % n)
+                specs.append(jax.ShapeDtypeStruct(s, jnp.float32))
+        out = jax.eval_shape(fn, *specs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return ([tuple(s.shape) for s in specs], [tuple(o.shape) for o in outs], [])
+
+    def infer_type(self, **kwargs):
+        return ([np.float32] * len(self.list_arguments()), [np.float32], [])
+
+    # ------------------------------------------------------------- binding
+    def simple_bind(self, ctx=None, grad_req="write", **shapes):
+        args = {}
+        for name in self.list_arguments():
+            shape = shapes.get(name)
+            if shape is None:
+                raise ValueError("shape for %s required in simple_bind" % name)
+            args[name] = NDArray(jnp.zeros(shape, jnp.float32))
+        grads = {n: NDArray(jnp.zeros_like(a._data)) for n, a in args.items()} \
+            if grad_req != "null" else None
+        return Executor(self, ctx or current_context(), args, grads, grad_req)
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write", **kwargs):
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(self.list_arguments(), args))
+        if isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(self.list_arguments(), args_grad))
+        return Executor(self, ctx or current_context(), args, args_grad, grad_req)
+
+    def tojson(self):
+        import json
+
+        def ser(s, nodes, index):
+            if id(s) in index:
+                return index[id(s)]
+            nid = len(nodes)
+            index[id(s)] = nid
+            nodes.append({"op": s._op or "null", "name": s.name,
+                          "attrs": {k: str(v) for k, v in s._attrs.items()},
+                          "inputs": [ser(i, nodes, index) for i in s._inputs]})
+            return nid
+
+        nodes = []
+        ser(self, nodes, {})
+        return json.dumps({"nodes": nodes}, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    def __repr__(self):
+        return "<Symbol %s>" % self.name
+
+
+def _eval(sym, env, cache):
+    if id(sym) in cache:
+        return cache[id(sym)]
+    if sym.is_var():
+        if sym.name not in env:
+            raise KeyError("unbound variable %s" % sym.name)
+        val = env[sym.name]
+    elif sym._op == "_group":
+        val = [_eval(i, env, cache) for i in sym._inputs]
+    elif sym._op == "_item":
+        parent = _eval(sym._inputs[0], env, cache)
+        val = parent[sym._attrs["index"]]
+    else:
+        ins = [_eval(i, env, cache) for i in sym._inputs]
+        val = OP_REGISTRY[sym._op].fn(*ins, **sym._attrs)
+    cache[id(sym)] = val
+    return val
+
+
+def _eval_symbols(outputs, feed):
+    cache = {}
+    outs = []
+    for s in outputs:
+        o = _eval(s, feed, cache)
+        outs.extend(o if isinstance(o, list) else [o])
+    return outs
+
+
+_make_counter = {}
+
+
+def _make(op, *args, name=None, **attrs):
+    inputs = []
+    for a in args:
+        if a is None:
+            continue
+        if isinstance(a, Symbol):
+            inputs.append(a)
+        else:
+            inputs.append(Symbol("_const", [], {"value": float(a)}, name="const"))
+    if name is None:
+        cnt = _make_counter.get(op, 0)
+        _make_counter[op] = cnt + 1
+        name = "%s%d" % (op.lower(), cnt)
+    return Symbol(op, inputs, attrs, name=name)
+
+
+# const evaluation support
+from .base import register_op  # noqa: E402
+
+
+@register_op("_const")
+def _const(*, value):
+    return jnp.asarray(value, jnp.float32)
+
+
+@register_op("_item")
+def _item(x, *, index):
+    return x[index]
+
+
+def var(name, shape=None, dtype=None, **kwargs):
+    return Symbol(None, name=name, shape=shape, dtype=dtype)
+
+
+Variable = var
+
+
+def Group(symbols):
+    return Symbol("_group", list(symbols), name="group")
+
+
+def load(fname):
+    raise NotImplementedError("symbol json load lands with the ONNX round (r3)")
+
+
+class Executor:
+    """(ref: src/executor/graph_executor.cc → one jitted XLA callable +
+    its jitted VJP)."""
+
+    def __init__(self, sym, ctx, args, args_grad, grad_req):
+        self._sym = sym
+        self._ctx = ctx
+        self.arg_dict = args
+        self.grad_dict = args_grad or {}
+        self._grad_req = grad_req
+        fn, names = sym._build_fn()
+        self._names = names
+        self._fn = jax.jit(fn)
+        self._vjp = None
+        self.outputs = []
+
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            self.arg_dict[k] = v if isinstance(v, NDArray) else NDArray(jnp.asarray(v))
+        vals = [self.arg_dict[n]._data for n in self._names]
+        if is_train:
+            out, self._vjp = jax.vjp(lambda *v: self._fn(*v), *vals)
+        else:
+            out = self._fn(*vals)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        self.outputs = [NDArray(o) for o in outs]
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        assert self._vjp is not None, "call forward(is_train=True) first"
+        if out_grads is None:
+            cots = [jnp.ones(o.shape, o.dtype) for o in self.outputs]
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cots = [g._data for g in out_grads]
+        # cotangent must match the primal output structure (list for groups)
+        grads = self._vjp(list(cots) if self._sym._op == "_group" else cots[0])
+        for n, g in zip(self._names, grads):
+            if n in self.grad_dict and self.grad_dict[n] is not None:
+                if self._grad_req == "add":
+                    self.grad_dict[n]._data = self.grad_dict[n]._data + g
+                else:
+                    self.grad_dict[n]._data = g

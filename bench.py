@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Headline benchmark: BERT-base pretraining throughput, single TPU chip.
+
+Matches BASELINE.md config #2: seq 128, bf16 compute + fp32 master weights,
+MLM (20 masked positions) + NSP loss, Adam. The entire step — forward,
+backward, optimizer — is ONE donated-buffer XLA program (the path MXNet
+approximates with fused optimizer kernels + CachedOp; see SURVEY.md §3.4).
+
+Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BASELINE_SAMPLES_PER_SEC = 250.0  # MXNet+A100 BERT-base phase-1 (BASELINE.md)
+
+BATCH = 32
+SEQ = 128
+MASKED = 20
+VOCAB = 30522
+
+
+def build():
+    import mxnet_tpu as mx
+    from mxnet_tpu import _trace, amp
+    from mxnet_tpu.models.bert import bert_base
+    from mxnet_tpu.parallel import tree_optimizer_step
+
+    bert = bert_base(dropout=0.1, max_length=SEQ)
+    bert.initialize()
+    amp.convert_hybrid_block(bert, "bfloat16")
+
+    plist = list(bert.collect_params().values())
+    opt = mx.optimizer.Adam(learning_rate=1e-4, multi_precision=True)
+    init_states, apply_opt = tree_optimizer_step(opt)
+
+    def loss_fn(param_arrays, batch, key):
+        tok, tt, vl, mp, mlm_y, nsp_y = batch
+        with _trace.trace_scope(key, True) as t:
+            t.param_store = {id(p): a for p, a in zip(plist, param_arrays)}
+            seq, pooled, nsp_logits, mlm_logits = bert._call_traced(tok, tt, vl, mp)
+        mlm_lp = jax.nn.log_softmax(mlm_logits.astype(jnp.float32), axis=-1)
+        mlm_nll = -jnp.take_along_axis(mlm_lp, mlm_y[..., None], axis=-1)
+        nsp_lp = jax.nn.log_softmax(nsp_logits.astype(jnp.float32), axis=-1)
+        nsp_nll = -jnp.take_along_axis(nsp_lp, nsp_y[:, None], axis=-1)
+        return jnp.mean(mlm_nll) + jnp.mean(nsp_nll)
+
+    params = [p.data()._data for p in plist]
+    states = init_states(params)
+
+    @jax.jit
+    def step(params, states, t, key, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, key)
+        new_p, new_s = apply_opt(params, grads, states, jnp.float32(1e-4),
+                                 jnp.float32(0.01), t)
+        return new_p, new_s, loss
+
+    return step, params, states
+
+
+def make_batch(rng):
+    tok = jnp.asarray(rng.integers(0, VOCAB, (BATCH, SEQ)), jnp.int32)
+    tt = jnp.zeros((BATCH, SEQ), jnp.int32)
+    vl = jnp.full((BATCH,), SEQ, jnp.float32)
+    mp = jnp.asarray(rng.integers(0, SEQ, (BATCH, MASKED)), jnp.int32)
+    mlm_y = jnp.asarray(rng.integers(0, VOCAB, (BATCH, MASKED)), jnp.int32)
+    nsp_y = jnp.asarray(rng.integers(0, 2, (BATCH,)), jnp.int32)
+    return tok, tt, vl, mp, mlm_y, nsp_y
+
+
+def main():
+    rng = np.random.default_rng(0)
+    step, params, states = build()
+    batch = make_batch(rng)
+    key = jax.random.PRNGKey(0)
+
+    # warmup / compile
+    params, states, loss = step(params, states, jnp.int32(1), key, batch)
+    jax.block_until_ready(loss)
+
+    iters = 20
+    t0 = time.perf_counter()
+    for i in range(iters):
+        params, states, loss = step(params, states, jnp.int32(i + 2), key, batch)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = BATCH * iters / dt
+    print(json.dumps({
+        "metric": "bert_base_pretrain_samples_per_sec_per_chip",
+        "value": round(samples_per_sec, 2),
+        "unit": "samples/s",
+        "vs_baseline": round(samples_per_sec / BASELINE_SAMPLES_PER_SEC, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -21,6 +21,20 @@ def set_bulk_size(size):
     return size
 
 
+def native_lib_path():
+    """Path to libmxtpu.so, building it with make on first use if possible."""
+    d = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "src", "engine_cc"))
+    so = os.path.join(d, "libmxtpu.so")
+    if not os.path.exists(so) and os.path.exists(os.path.join(d, "Makefile")):
+        import subprocess
+
+        try:
+            subprocess.run(["make", "-C", d], capture_output=True, timeout=120)
+        except Exception:
+            pass
+    return so
+
+
 _lib = None
 _lib_tried = False
 
@@ -30,8 +44,7 @@ def _native():
     if _lib_tried:
         return _lib
     _lib_tried = True
-    so = os.path.join(os.path.dirname(__file__), "..", "src", "engine_cc", "libmxtpu.so")
-    so = os.path.abspath(so)
+    so = native_lib_path()
     if os.path.exists(so):
         try:
             lib = ctypes.CDLL(so)

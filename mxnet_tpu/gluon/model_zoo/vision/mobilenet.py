@@ -1,0 +1,112 @@
+"""MobileNet v1/v2 (ref: python/mxnet/gluon/model_zoo/vision/mobilenet.py).
+
+Depthwise convs map to XLA's grouped convolution; on TPU these are
+bandwidth-bound — XLA fuses the pointwise+BN+relu chains.
+"""
+from __future__ import annotations
+
+from ...block import HybridBlock
+from ... import nn
+
+__all__ = ["MobileNet", "MobileNetV2", "mobilenet1_0", "mobilenet0_75",
+           "mobilenet0_5", "mobilenet0_25", "mobilenet_v2_1_0", "mobilenet_v2_0_5"]
+
+
+def _conv_block(out, channels, kernel=3, stride=1, pad=1, num_group=1, active=True):
+    out.add(nn.Conv2D(channels, kernel, stride, pad, groups=num_group, use_bias=False))
+    out.add(nn.BatchNorm())
+    if active:
+        out.add(nn.Activation("relu"))
+
+
+def _dw_block(out, dw_channels, channels, stride):
+    _conv_block(out, dw_channels, stride=stride, num_group=dw_channels)
+    _conv_block(out, channels, kernel=1, pad=0)
+
+
+class MobileNet(HybridBlock):
+    def __init__(self, multiplier=1.0, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="")
+            _conv_block(self.features, int(32 * multiplier), stride=2)
+            dw_channels = [int(x * multiplier) for x in
+                           [32, 64] + [128] * 2 + [256] * 2 + [512] * 6 + [1024]]
+            channels = [int(x * multiplier) for x in
+                        [64] + [128] * 2 + [256] * 2 + [512] * 6 + [1024] * 2]
+            strides = [1, 2] * 3 + [1] * 5 + [2, 1]
+            for dwc, c, s in zip(dw_channels, channels, strides):
+                _dw_block(self.features, dwc, c, s)
+            self.features.add(nn.GlobalAvgPool2D())
+            self.features.add(nn.Flatten())
+            self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        return self.output(self.features(x))
+
+
+class LinearBottleneck(HybridBlock):
+    def __init__(self, in_channels, channels, t, stride, **kwargs):
+        super().__init__(**kwargs)
+        self.use_shortcut = stride == 1 and in_channels == channels
+        with self.name_scope():
+            self.out = nn.HybridSequential()
+            _conv_block(self.out, in_channels * t, kernel=1, pad=0)
+            _conv_block(self.out, in_channels * t, stride=stride, num_group=in_channels * t)
+            _conv_block(self.out, channels, kernel=1, pad=0, active=False)
+
+    def hybrid_forward(self, F, x):
+        out = self.out(x)
+        if self.use_shortcut:
+            out = out + x
+        return out
+
+
+class MobileNetV2(HybridBlock):
+    def __init__(self, multiplier=1.0, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="features_")
+            _conv_block(self.features, int(32 * multiplier), stride=2)
+            in_c = [int(multiplier * x) for x in
+                    [32] + [16] + [24] * 2 + [32] * 3 + [64] * 4 + [96] * 3 + [160] * 3]
+            channels = [int(multiplier * x) for x in
+                        [16] + [24] * 2 + [32] * 3 + [64] * 4 + [96] * 3 + [160] * 3 + [320]]
+            ts = [1] + [6] * 16
+            strides = [1, 2] * 2 + [1, 1, 2] + [1] * 6 + [2] + [1] * 3
+            for ic, c, t, s in zip(in_c, channels, ts, strides):
+                self.features.add(LinearBottleneck(ic, c, t, s))
+            last = int(1280 * multiplier) if multiplier > 1.0 else 1280
+            _conv_block(self.features, last, kernel=1, pad=0)
+            self.features.add(nn.GlobalAvgPool2D())
+            self.output = nn.Conv2D(classes, 1, use_bias=False, prefix="pred_")
+            self.flat = nn.Flatten()
+
+    def hybrid_forward(self, F, x):
+        x = self.features(x)
+        x = self.output(x)
+        return self.flat(x)
+
+
+def mobilenet1_0(**kw):
+    return MobileNet(1.0, **kw)
+
+
+def mobilenet0_75(**kw):
+    return MobileNet(0.75, **kw)
+
+
+def mobilenet0_5(**kw):
+    return MobileNet(0.5, **kw)
+
+
+def mobilenet0_25(**kw):
+    return MobileNet(0.25, **kw)
+
+
+def mobilenet_v2_1_0(**kw):
+    return MobileNetV2(1.0, **kw)
+
+
+def mobilenet_v2_0_5(**kw):
+    return MobileNetV2(0.5, **kw)

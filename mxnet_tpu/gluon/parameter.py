@@ -244,11 +244,12 @@ class ParameterDict:
                 continue
             n = name[len(strip_prefix):] if name.startswith(strip_prefix) else name
             arg[n] = np.asarray(p.data().asnumpy())
-        np.savez(filename, **arg)
+        with open(filename, "wb") as f:  # exact filename (np.savez would add .npz)
+            np.savez(f, **arg)
 
     def load(self, filename, ctx=None, allow_missing=False,
              ignore_extra=False, restore_prefix=""):
-        loaded = np.load(filename if filename.endswith(".npz") else filename, allow_pickle=False)
+        loaded = np.load(filename, allow_pickle=False)
         loaded = {restore_prefix + k: v for k, v in loaded.items()}
         for name, p in self.items():
             if name in loaded:

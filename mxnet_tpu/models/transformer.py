@@ -123,11 +123,11 @@ class TransformerModel(HybridBlock):
             self.enc_cells = nn.HybridSequential(prefix="enc_")
             for i in range(num_layers):
                 self.enc_cells.add(EncoderCell(units, hidden, num_heads, dropout,
-                                               prefix="layer%d_" % i))
+                                               prefix="enc_layer%d_" % i))
             self.dec_cells = nn.HybridSequential(prefix="dec_")
             for i in range(num_layers):
                 self.dec_cells.add(DecoderCell(units, hidden, num_heads, dropout,
-                                               prefix="layer%d_" % i))
+                                               prefix="dec_layer%d_" % i))
             self.proj = nn.Dense(tgt_vocab, flatten=False, in_units=units,
                                  prefix="proj_")
             self.dropout = nn.Dropout(dropout) if dropout else None

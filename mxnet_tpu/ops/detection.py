@@ -157,7 +157,7 @@ def multibox_target(anchors, labels, cls_preds, *, overlap_threshold=0.5,
         th = jnp.log(gh / ah) / variances[3]
         bt = jnp.stack([tx, ty, tw, th], axis=1)
         bt = jnp.where(pos[:, None], bt, 0.0)
-        bm = jnp.where(pos[:, None], 1.0, 0.0)
+        bm = jnp.broadcast_to(pos[:, None], bt.shape).astype(bt.dtype)
         cls_t = jnp.where(pos, lab[matched_gt, 0] + 1.0, 0.0)
         # hard negative mining: keep top (ratio * npos) negatives by max prob of non-bg
         npos = jnp.sum(pos)

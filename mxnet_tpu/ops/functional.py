@@ -732,10 +732,29 @@ def softmax_cross_entropy(logits, labels):
     return jnp.sum(nll)
 
 
+@jax.custom_vjp
+def _softmax_output_passthrough(x):
+    return jax.nn.softmax(x, axis=-1)
+
+
+def _so_fwd(x):
+    return jax.nn.softmax(x, axis=-1), None
+
+
+def _so_bwd(_, g):
+    # MXNet SoftmaxOutput semantics (ref: src/operator/softmax_output-inl.h):
+    # the incoming gradient is delivered to the LOGITS unchanged — the layer's
+    # backward is (prob - one_hot), which callers (Module) supply directly.
+    return (g,)
+
+
+_softmax_output_passthrough.defvjp(_so_fwd, _so_bwd)
+
+
 @register_op("SoftmaxOutput")
 def SoftmaxOutput(x, label=None, *, grad_scale=1.0, ignore_label=-1,
                   use_ignore=False, preserve_shape=False, multi_output=False):
-    return jax.nn.softmax(x, axis=-1)
+    return _softmax_output_passthrough(x)
 
 
 @register_op("Embedding")

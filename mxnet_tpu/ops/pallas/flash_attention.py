@@ -1,0 +1,152 @@
+"""Flash attention Pallas kernel for TPU.
+
+Replaces the reference's unfused softmax(QK^T)V chain (three HBM round trips
+for the T×T score matrix) with a blockwise kernel: Q blocks stay resident in
+VMEM while K/V blocks stream through, online-softmax accumulating in fp32
+scratch — O(T) HBM traffic instead of O(T^2). Grid (B*H, Tq/bq, Tk/bk) with
+the K dimension innermost ("arbitrary" semantics) so the accumulator carries
+across K steps. Custom VJP recomputes attention blockwise in the backward
+(flash-attention-2 style) so no T×T tensor ever materializes.
+
+Pattern source: /opt/skills/guides/pallas_guide.md (double-buffered matmul,
+custom-VJP kernels). Falls back to the jnp reference off-TPU (ops/attention.py).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+                *, scale, causal, bq, bk):
+    kv_idx = pl.program_id(2)
+    q_idx = pl.program_id(1)
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    run = True
+    if causal:
+        # skip fully-masked K blocks: first query row of this Q block is
+        # q_idx*bq; block contributes iff kv_idx*bk <= q_idx*bq + bq - 1
+        run = kv_idx * bk <= q_idx * bq + bq - 1
+
+    @pl.when(run if causal else True)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale  # (bq, d)
+        k = k_ref[0].astype(jnp.float32)          # (bk, d)
+        v = v_ref[0].astype(jnp.float32)          # (bk, d)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # (bq, bk)
+        if causal:
+            rows = q_idx * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = kv_idx * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_prev = m_ref[:]                       # (bq, 128) broadcast lanes
+        m_cur = jnp.max(s, axis=1, keepdims=True)  # (bq, 1)
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, :1])           # (bq, bk)
+        l_ref[:] = l_ref[:] * corr + jnp.broadcast_to(
+            jnp.sum(p, axis=1, keepdims=True), m_prev.shape)
+        acc_ref[:] = acc_ref[:] * corr[:, :1] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+
+    @pl.when(kv_idx == pl.num_programs(2) - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, scale, causal, bq, bk):
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    bq = min(bq, Tq)
+    bk = min(bk, Tk)
+    qr = q.reshape(B * H, Tq, D)
+    kr = k.reshape(B * H, Tk, D)
+    vr = v.reshape(B * H, Tk, D)
+    grid = (B * H, Tq // bq, Tk // bk)
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),  # running max (lane-broadcast)
+            pltpu.VMEM((bq, 128), jnp.float32),  # running denom
+            pltpu.VMEM((bq, D), jnp.float32),    # output accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(qr, kr, vr)
+    return out.reshape(B, H, Tq, D)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, scale, causal, bq, bk):
+    return _flash_fwd(q, k, v, scale, causal, bq, bk)
+
+
+def _flash_vjp_fwd(q, k, v, scale, causal, bq, bk):
+    o = _flash_fwd(q, k, v, scale, causal, bq, bk)
+    return o, (q, k, v, o)
+
+
+def _flash_vjp_bwd(scale, causal, bq, bk, res, do):
+    # Blockwise recompute backward in plain XLA (fused well by Mosaic/XLA);
+    # a dedicated pallas backward kernel is an r2 perf item.
+    q, k, v, o = res
+    qf = q.astype(jnp.float32) * scale
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    of = o.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf)
+    if causal:
+        T, S = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(T)[:, None] >= jnp.arange(S)[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vf)
+    delta = jnp.sum(of * dof, axis=-1, keepdims=True)
+    ds = p * (dp - delta)
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf) * scale
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=256, block_k=512):
+    """q,k,v: (B, H, T, D). D should be a multiple of 128 lanes ideally;
+    T must be divisible by the chosen blocks (callers pad)."""
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    Tq, Tk = q.shape[2], k.shape[2]
+    bq = _largest_divisor_block(Tq, block_q)
+    bk = _largest_divisor_block(Tk, block_k)
+    return _flash(q, k, v, float(scale), bool(causal), bq, bk)
+
+
+def _largest_divisor_block(t, prefer):
+    b = min(prefer, t)
+    while t % b:
+        b //= 2
+    return max(b, 1)

@@ -170,8 +170,8 @@ class Adam(Optimizer):
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
 
     def init_state(self, w):
-        z = jnp.zeros_like(w, dtype=jnp.float32)
-        return (z, z)
+        return (jnp.zeros_like(w, dtype=jnp.float32),
+                jnp.zeros_like(w, dtype=jnp.float32))
 
     def _step(self, w, g, state, lr, wd, t):
         m, v = state
@@ -223,8 +223,8 @@ class AdaDelta(Optimizer):
         self.rho, self.epsilon = rho, epsilon
 
     def init_state(self, w):
-        z = jnp.zeros_like(w, dtype=jnp.float32)
-        return (z, z)
+        return (jnp.zeros_like(w, dtype=jnp.float32),
+                jnp.zeros_like(w, dtype=jnp.float32))
 
     def _step(self, w, g, state, lr, wd, t):
         acc_g, acc_d = state
@@ -243,8 +243,8 @@ class RMSProp(Optimizer):
         self.gamma1, self.gamma2, self.epsilon, self.centered = gamma1, gamma2, epsilon, centered
 
     def init_state(self, w):
-        z = jnp.zeros_like(w, dtype=jnp.float32)
-        return (z, z, z) if self.centered else (z,)
+        mk = lambda: jnp.zeros_like(w, dtype=jnp.float32)
+        return (mk(), mk(), mk()) if self.centered else (mk(),)
 
     def _step(self, w, g, state, lr, wd, t):
         g = g + wd * w
@@ -266,8 +266,8 @@ class Ftrl(Optimizer):
         self.lamda1, self.beta = lamda1, beta
 
     def init_state(self, w):
-        z = jnp.zeros_like(w, dtype=jnp.float32)
-        return (z, z)
+        return (jnp.zeros_like(w, dtype=jnp.float32),
+                jnp.zeros_like(w, dtype=jnp.float32))
 
     def _step(self, w, g, state, lr, wd, t):
         z, n = state
@@ -294,8 +294,8 @@ class LAMB(Optimizer):
         self.bias_correction = bias_correction
 
     def init_state(self, w):
-        z = jnp.zeros_like(w, dtype=jnp.float32)
-        return (z, z)
+        return (jnp.zeros_like(w, dtype=jnp.float32),
+                jnp.zeros_like(w, dtype=jnp.float32))
 
     def _step(self, w, g, state, lr, wd, t):
         m, v = state

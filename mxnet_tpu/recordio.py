@@ -176,8 +176,9 @@ def _load_native():
     global _native
     if _native is not None:
         return _native
-    so = os.path.join(os.path.dirname(__file__), "..", "src", "engine_cc", "libmxtpu.so")
-    so = os.path.abspath(so)
+    from .engine import native_lib_path
+
+    so = native_lib_path()
     if os.path.exists(so):
         try:
             _native = ctypes.CDLL(so)

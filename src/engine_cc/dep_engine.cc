@@ -1,0 +1,181 @@
+// Host-side threaded dependency engine.
+//
+// TPU-native counterpart of MXNet's ThreadedEngine (ref:
+// src/engine/threaded_engine.cc, include/mxnet/engine.h). Device-side op
+// ordering belongs to XLA; this engine schedules *host* tasks (decode,
+// augment, batching, file IO) with MXNet's exact dependency rule:
+// Push(fn, const_vars, mutable_vars) runs fn once every earlier write to a
+// const var and every earlier access to a mutable var has completed. Readers
+// of a var run concurrently; writers are exclusive — the same RW queue
+// semantics as ThreadedEngine's VersionedVarBlock chain.
+//
+// Exposed as a C ABI for ctypes (see mxnet_tpu/engine.py).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Op;
+
+struct Entry {
+  Op* op;
+  bool write;
+};
+
+struct Var {
+  std::deque<Entry> q;
+  int active_readers = 0;
+  bool active_writer = false;
+};
+
+typedef void (*Callback)(void*);
+
+struct Op {
+  Callback fn;
+  void* arg;
+  std::atomic<int> pending{0};
+  std::vector<int64_t> cvars;
+  std::vector<int64_t> mvars;
+};
+
+class Engine {
+ public:
+  explicit Engine(int nthreads) {
+    for (int i = 0; i < nthreads; ++i) {
+      workers_.emplace_back([this] { WorkerLoop(); });
+    }
+  }
+
+  ~Engine() {
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto& t : workers_) t.join();
+  }
+
+  void Push(Callback fn, void* arg, const int64_t* cvars, int ncv,
+            const int64_t* mvars, int nmv) {
+    Op* op = new Op();
+    op->fn = fn;
+    op->arg = arg;
+    op->cvars.assign(cvars, cvars + ncv);
+    op->mvars.assign(mvars, mvars + nmv);
+    op->pending.store(ncv + nmv + 1);  // +1 guards against premature fire
+
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      ++outstanding_;
+      for (int64_t v : op->cvars) vars_[v].q.push_back({op, false});
+      for (int64_t v : op->mvars) vars_[v].q.push_back({op, true});
+      for (int64_t v : op->cvars) ScheduleVar(&vars_[v]);
+      for (int64_t v : op->mvars) ScheduleVar(&vars_[v]);
+      DecPending(op);  // release the guard
+    }
+    cv_.notify_all();
+  }
+
+  void WaitAll() {
+    std::unique_lock<std::mutex> lk(mu_);
+    done_cv_.wait(lk, [this] { return outstanding_ == 0; });
+  }
+
+ private:
+  // mu_ held.
+  void ScheduleVar(Var* v) {
+    while (!v->q.empty()) {
+      Entry e = v->q.front();
+      if (e.write) {
+        if (v->active_readers == 0 && !v->active_writer) {
+          v->active_writer = true;
+          v->q.pop_front();
+          DecPending(e.op);
+        } else {
+          break;
+        }
+      } else {
+        if (!v->active_writer) {
+          ++v->active_readers;
+          v->q.pop_front();
+          DecPending(e.op);
+        } else {
+          break;
+        }
+      }
+    }
+  }
+
+  // mu_ held.
+  void DecPending(Op* op) {
+    if (op->pending.fetch_sub(1) == 1) {
+      ready_.push(op);
+    }
+  }
+
+  void WorkerLoop() {
+    for (;;) {
+      Op* op = nullptr;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [this] { return stop_ || !ready_.empty(); });
+        if (stop_ && ready_.empty()) return;
+        op = ready_.front();
+        ready_.pop();
+      }
+      op->fn(op->arg);
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        for (int64_t vid : op->cvars) {
+          Var* v = &vars_[vid];
+          --v->active_readers;
+          ScheduleVar(v);
+        }
+        for (int64_t vid : op->mvars) {
+          Var* v = &vars_[vid];
+          v->active_writer = false;
+          ScheduleVar(v);
+        }
+        --outstanding_;
+        if (outstanding_ == 0) done_cv_.notify_all();
+      }
+      cv_.notify_all();
+      delete op;
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_, done_cv_;
+  std::unordered_map<int64_t, Var> vars_;
+  std::queue<Op*> ready_;
+  std::vector<std::thread> workers_;
+  int outstanding_ = 0;
+  bool stop_ = false;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* mxtpu_engine_create(int nthreads) { return new Engine(nthreads); }
+
+void mxtpu_engine_push(void* h, void* fn, const int64_t* cvars, int ncv,
+                       const int64_t* mvars, int nmv) {
+  static_cast<Engine*>(h)->Push(reinterpret_cast<Callback>(fn), nullptr, cvars,
+                                ncv, mvars, nmv);
+}
+
+void mxtpu_engine_wait_all(void* h) { static_cast<Engine*>(h)->WaitAll(); }
+
+void mxtpu_engine_destroy(void* h) { delete static_cast<Engine*>(h); }
+
+}  // extern "C"

@@ -1,0 +1,25 @@
+"""Test harness: force a virtual 8-device CPU mesh BEFORE jax initializes.
+
+The axon sitecustomize registers the TPU backend and pins jax_platforms; an
+empty PALLAS_AXON_POOL_IPS disables it so tests run on
+--xla_force_host_platform_device_count=8 CPU devices (SURVEY.md §4).
+"""
+import os
+
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(0)
+    import mxnet_tpu as mx
+
+    mx.random.seed(0)
+    yield

@@ -1,0 +1,97 @@
+"""Imperative autograd (mirrors reference tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def test_simple_grad():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy(), rtol=1e-6)
+
+
+def test_chain_and_broadcast():
+    x = nd.array(np.random.randn(3, 4).astype(np.float32))
+    w = nd.array(np.random.randn(4, 2).astype(np.float32))
+    w.attach_grad()
+    with autograd.record():
+        y = nd.dot(x, w)
+        z = nd.sum(nd.sigmoid(y))
+    z.backward()
+    # finite difference check on one element
+    eps = 1e-3
+    wn = w.asnumpy().copy()
+    def f(wv):
+        return 1 / (1 + np.exp(-(x.asnumpy() @ wv)))
+    wp = wn.copy(); wp[0, 0] += eps
+    wm = wn.copy(); wm[0, 0] -= eps
+    fd = (f(wp).sum() - f(wm).sum()) / (2 * eps)
+    assert abs(w.grad.asnumpy()[0, 0] - fd) < 1e-2
+
+
+def test_head_grads():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+    y.backward(nd.array([1.0, 10.0, 100.0]))
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0, 20.0, 200.0])
+
+
+def test_grad_add_req():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = (x * x).sum()
+        y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 3 * 2 * x.asnumpy(), rtol=1e-6)
+
+
+def test_pause_and_modes():
+    x = nd.array([1.0])
+    x.attach_grad()
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+        y = x * 3
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [3.0])
+
+
+def test_detach_blocks_grad():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = nd.BlockGrad(y) + x
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [1.0])
+
+
+def test_autograd_grad_api():
+    x = nd.array([3.0])
+    with autograd.record():
+        y = x * x * x
+    (g,) = autograd.grad(y, [x])
+    np.testing.assert_allclose(g.asnumpy(), [27.0], rtol=1e-6)
+
+
+def test_multi_output_op_grads():
+    x = nd.array(np.random.randn(2, 6).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        parts = nd.split(x, num_outputs=2, axis=1)
+        y = (parts[0] * 2 + parts[1] * 3).sum()
+    y.backward()
+    g = x.grad.asnumpy()
+    assert (g[:, :3] == 2).all() and (g[:, 3:] == 3).all()

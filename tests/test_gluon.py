@@ -1,0 +1,166 @@
+"""Gluon blocks: layers, hybridize parity, BN/Dropout modes, params
+(mirrors reference tests/python/unittest/test_gluon.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+def _x(*shape):
+    return nd.array(np.random.randn(*shape).astype(np.float32))
+
+
+def test_dense_shapes_and_deferred_init():
+    d = nn.Dense(8)
+    d.initialize()
+    out = d(_x(4, 16))
+    assert out.shape == (4, 8)
+    assert d.weight.shape == (8, 16)
+    d2 = nn.Dense(3, flatten=False)
+    d2.initialize()
+    assert d2(_x(2, 5, 7)).shape == (2, 5, 3)
+
+
+def test_hybridize_matches_imperative():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.BatchNorm(), nn.Dense(4))
+    net.initialize()
+    x = _x(8, 16)
+    ref = net(x).asnumpy()
+    net.hybridize()
+    out = net(x).asnumpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_conv_pool_shapes():
+    c = nn.Conv2D(8, 3, padding=1)
+    c.initialize()
+    assert c(_x(2, 3, 16, 16)).shape == (2, 8, 16, 16)
+    assert c.weight.shape == (8, 3, 3, 3)
+    p = nn.MaxPool2D(2)
+    assert p(_x(2, 3, 16, 16)).shape == (2, 3, 8, 8)
+    g = nn.GlobalAvgPool2D()
+    assert g(_x(2, 3, 16, 16)).shape == (2, 3, 1, 1)
+    t = nn.Conv2DTranspose(4, 2, strides=2)
+    t.initialize()
+    assert t(_x(2, 8, 8, 8)).shape == (2, 4, 16, 16)
+    c1 = nn.Conv1D(6, 3)
+    c1.initialize()
+    assert c1(_x(2, 4, 10)).shape == (2, 6, 8)
+
+
+def test_conv_matches_numpy():
+    c = nn.Conv2D(1, 3, use_bias=False, in_channels=1)
+    c.initialize()
+    w = np.ones((1, 1, 3, 3), np.float32)
+    c.weight.set_data(nd.array(w))
+    x = np.ones((1, 1, 5, 5), np.float32)
+    out = c(nd.array(x)).asnumpy()
+    assert out.shape == (1, 1, 3, 3)
+    np.testing.assert_allclose(out, np.full((1, 1, 3, 3), 9.0))
+
+
+def test_batchnorm_train_vs_eval():
+    bn = nn.BatchNorm()
+    bn.initialize()
+    x = _x(8, 4, 5, 5)
+    with autograd.record():
+        y_train = bn(x)
+    y_eval = bn(x)
+    # train uses batch stats (normalized ≈ 0 mean), eval uses running stats
+    assert abs(float(y_train.mean().asscalar())) < 1e-2
+    assert not np.allclose(y_train.asnumpy(), y_eval.asnumpy())
+    # running stats moved toward batch stats
+    assert abs(bn.running_mean.data().asnumpy()).sum() > 0
+
+
+def test_dropout_modes():
+    do = nn.Dropout(0.5)
+    x = nd.ones((100, 100))
+    y_eval = do(x)
+    np.testing.assert_array_equal(y_eval.asnumpy(), x.asnumpy())
+    with autograd.record():
+        y_train = do(x)
+    zeros = (y_train.asnumpy() == 0).mean()
+    assert 0.3 < zeros < 0.7
+
+
+def test_embedding_layernorm():
+    e = nn.Embedding(10, 4)
+    e.initialize()
+    out = e(nd.array([[1, 2], [3, 4]], dtype="int32"))
+    assert out.shape == (2, 2, 4)
+    ln = nn.LayerNorm()
+    ln.initialize()
+    y = ln(_x(3, 8)).asnumpy()
+    np.testing.assert_allclose(y.mean(-1), 0, atol=1e-5)
+    np.testing.assert_allclose(y.std(-1), 1, atol=1e-2)
+
+
+def test_activations():
+    x = _x(3, 4)
+    for act in [nn.LeakyReLU(0.1), nn.PReLU(), nn.ELU(), nn.SELU(), nn.GELU(),
+                nn.Swish()]:
+        act.initialize()
+        assert act(x).shape == x.shape
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential(prefix="net_")
+    with net.name_scope():
+        net.add(nn.Dense(8, in_units=4), nn.Dense(2, in_units=8))
+    net.initialize()
+    f = str(tmp_path / "w.npz")
+    net.save_parameters(f)
+    ref = net(_x(2, 4)).asnumpy()
+    net2 = nn.HybridSequential(prefix="net_")
+    with net2.name_scope():
+        net2.add(nn.Dense(8, in_units=4), nn.Dense(2, in_units=8))
+    net2.initialize()
+    net2.load_parameters(f)
+    x = _x(2, 4)
+    np.testing.assert_allclose(net(x).asnumpy(), net2(x).asnumpy(), rtol=1e-6)
+
+
+def test_collect_params_select():
+    net = nn.HybridSequential(prefix="s_")
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=4), nn.BatchNorm())
+    net.initialize()
+    all_p = net.collect_params()
+    assert len(all_p) == 6  # W, b, gamma, beta, mean, var
+    only_w = net.collect_params(".*weight")
+    assert len(only_w) == 1
+
+
+def test_grad_through_hybridized():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(1))
+    net.initialize()
+    net.hybridize()
+    x = _x(4, 8)
+    with autograd.record():
+        y = net(x).sum()
+    y.backward()
+    w = list(net.collect_params().values())[0]
+    assert w.grad() is not None
+    assert float(abs(w.grad().asnumpy()).sum()) > 0
+
+
+def test_sequential_indexing():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(5), nn.Dense(6))
+    assert len(net) == 3
+    assert isinstance(net[1], nn.Dense)
+
+
+def test_cast_bf16():
+    net = nn.Dense(4, in_units=4)
+    net.initialize()
+    net.cast("bfloat16")
+    out = net(nd.ones((2, 4)).astype("bfloat16"))
+    assert "bfloat16" in str(out.dtype)

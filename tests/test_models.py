@@ -1,0 +1,156 @@
+"""Model-level tests: forward shapes + short training convergence
+(SURVEY.md §4 model-level strategy)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+
+def _rand(*shape):
+    return nd.array(np.random.randn(*shape).astype(np.float32))
+
+
+def test_resnet_variants_forward():
+    from mxnet_tpu.gluon.model_zoo.vision import get_model
+
+    x = _rand(1, 3, 32, 32)
+    for name in ["resnet18_v1", "resnet18_v2"]:
+        net = get_model(name, classes=10)
+        net.initialize()
+        assert net(x).shape == (1, 10)
+
+
+def test_resnet50_forward():
+    net = gluon.model_zoo.vision.resnet50_v1(classes=100)
+    net.initialize()
+    assert net(_rand(1, 3, 64, 64)).shape == (1, 100)
+
+
+def test_mlp_trains_to_fit():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu"), gluon.nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    X = np.random.randn(64, 8).astype(np.float32)
+    Y = (X[:, 0] > 0).astype(np.float32)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 0.01})
+    first = None
+    for i in range(30):
+        with autograd.record():
+            loss = loss_fn(net(nd.array(X)), nd.array(Y)).mean()
+        loss.backward()
+        trainer.step(64)
+        if first is None:
+            first = float(loss.asscalar())
+    assert float(loss.asscalar()) < first * 0.5
+
+
+def test_bert_forward_and_mlm():
+    from mxnet_tpu.models.bert import BERTModel
+
+    model = BERTModel(vocab_size=500, units=32, hidden_size=64, num_layers=2,
+                      num_heads=2, max_length=32, dropout=0.0)
+    model.initialize()
+    tok = nd.array(np.random.randint(0, 500, (2, 12)), dtype="int32")
+    tt = nd.zeros((2, 12), dtype="int32")
+    vl = nd.array([12, 8], dtype="float32")
+    mp = nd.array([[0, 1], [2, 3]], dtype="int32")
+    seq, pooled, nsp, mlm = model(tok, tt, vl, mp)
+    assert seq.shape == (2, 12, 32)
+    assert pooled.shape == (2, 32)
+    assert nsp.shape == (2, 2)
+    assert mlm.shape == (2, 2, 500)
+
+
+def test_bert_mask_effect():
+    from mxnet_tpu.models.bert import BERTModel
+
+    model = BERTModel(vocab_size=100, units=16, hidden_size=32, num_layers=1,
+                      num_heads=2, max_length=16, dropout=0.0,
+                      use_decoder=False, use_classifier=False, use_pooler=False)
+    model.initialize()
+    tok = nd.array(np.random.randint(0, 100, (1, 8)), dtype="int32")
+    vl_full = nd.array([8], dtype="float32")
+    vl_half = nd.array([4], dtype="float32")
+    s1 = model(tok, None, vl_full).asnumpy()
+    s2 = model(tok, None, vl_half).asnumpy()
+    assert not np.allclose(s1[:, :4], s2[:, :4])  # masking changes attention
+
+
+def test_lstm_lm_trains():
+    from mxnet_tpu.models.lstm_lm import RNNModel
+
+    model = RNNModel(vocab_size=50, num_embed=16, num_hidden=16, num_layers=1,
+                     dropout=0.0)
+    model.initialize()
+    T, N = 8, 4
+    data = nd.array(np.random.randint(0, 50, (T, N)), dtype="int32")
+    target = nd.array(np.random.randint(0, 50, (T, N)), dtype="float32")
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(model.collect_params(), "adam", {"learning_rate": 0.01})
+    losses = []
+    for _ in range(8):
+        with autograd.record():
+            logits = model(data)
+            L = loss_fn(logits.reshape(T * N, 50),
+                        target.reshape(T * N)).mean()
+        L.backward()
+        trainer.step(N)
+        losses.append(float(L.asscalar()))
+    assert losses[-1] < losses[0]
+
+
+def test_transformer_forward_and_translate():
+    from mxnet_tpu.models.transformer import TransformerModel
+
+    model = TransformerModel(src_vocab=60, tgt_vocab=60, units=16, hidden=32,
+                             num_layers=1, num_heads=2, max_len=32, dropout=0.0)
+    model.initialize()
+    src = nd.array(np.random.randint(4, 60, (2, 7)), dtype="int32")
+    tgt = nd.array(np.random.randint(4, 60, (2, 5)), dtype="int32")
+    logits = model(src, tgt)
+    assert logits.shape == (2, 5, 60)
+    out = model.translate(src, max_len=6)
+    assert out.shape[0] == 2 and out.shape[1] <= 6
+    beam = model.translate(src[0:1], max_len=6, beam=3)
+    assert beam.shape[0] == 1
+
+
+def test_ssd_forward_and_loss():
+    from mxnet_tpu.models.ssd import SSD, SSDLoss
+
+    net = SSD(num_classes=3, sizes=((0.2, 0.3), (0.5, 0.6)),
+              ratios=((1, 2),) * 2)
+    net.initialize()
+    x = _rand(2, 3, 64, 64)
+    cls_preds, box_preds, anchors = net(x)
+    N = anchors.shape[1]
+    assert cls_preds.shape == (2, N, 4)
+    assert box_preds.shape == (2, N * 4)
+    labels = nd.array(np.array([[[0, 0.1, 0.1, 0.4, 0.4]],
+                                [[1, 0.5, 0.5, 0.9, 0.9]]], np.float32))
+    loss = SSDLoss(3)(cls_preds, box_preds, labels, anchors)
+    assert loss.shape == (2,)
+    assert np.isfinite(loss.asnumpy()).all()
+    det = net.detect(x)
+    assert det.shape[0] == 2 and det.shape[2] == 6
+
+
+def test_detection_ops():
+    # IoU of identical boxes = 1
+    b = nd.array([[0.0, 0.0, 1.0, 1.0], [0.5, 0.5, 1.0, 1.0]])
+    iou = nd.contrib.box_iou(b, b).asnumpy()
+    np.testing.assert_allclose(np.diag(iou), [1.0, 1.0], rtol=1e-5)
+    # NMS suppresses the overlapping lower-score box
+    dets = nd.array([[[0, 0.9, 0.0, 0.0, 1.0, 1.0],
+                      [0, 0.8, 0.05, 0.05, 1.0, 1.0],
+                      [0, 0.7, 2.0, 2.0, 3.0, 3.0]]])
+    out = nd.contrib.box_nms(dets, overlap_thresh=0.5).asnumpy()
+    assert out[0, 0, 1] > 0 and out[0, 2, 1] > 0
+    assert out[0, 1, 1] == -1.0
+    # anchors
+    feat = nd.zeros((1, 8, 4, 4))
+    anchors = nd.contrib.MultiBoxPrior(feat, sizes=(0.5,), ratios=(1.0, 2.0))
+    assert anchors.shape == (1, 4 * 4 * 2, 4)

@@ -1,0 +1,154 @@
+"""NDArray op parity vs numpy (SURVEY.md §4: op-level numerical tests;
+mirrors tests/python/unittest/test_ndarray.py in the reference)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _a(*shape):
+    return np.random.randn(*shape).astype(np.float32)
+
+
+def test_creation():
+    assert nd.zeros((2, 3)).asnumpy().sum() == 0
+    assert nd.ones((2, 3)).asnumpy().sum() == 6
+    assert nd.full((2, 2), 7).asnumpy().max() == 7
+    np.testing.assert_allclose(nd.arange(5).asnumpy(), np.arange(5, dtype=np.float32))
+    e = nd.eye(3)
+    assert e.asnumpy().trace() == 3
+
+
+def test_arithmetic():
+    x, y = _a(3, 4), _a(3, 4)
+    a, b = nd.array(x), nd.array(y)
+    np.testing.assert_allclose((a + b).asnumpy(), x + y, rtol=1e-6)
+    np.testing.assert_allclose((a - b).asnumpy(), x - y, rtol=1e-6)
+    np.testing.assert_allclose((a * b).asnumpy(), x * y, rtol=1e-6)
+    np.testing.assert_allclose((a / (b + 10)).asnumpy(), x / (y + 10), rtol=1e-5)
+    np.testing.assert_allclose((a + 1.5).asnumpy(), x + 1.5, rtol=1e-6)
+    np.testing.assert_allclose((2 - a).asnumpy(), 2 - x, rtol=1e-6)
+    np.testing.assert_allclose((-a).asnumpy(), -x)
+    np.testing.assert_allclose(abs(a).asnumpy(), np.abs(x))
+    # scalar op preserves dtype
+    h = nd.array(x).astype("bfloat16")
+    assert (h * 0.5).dtype == h.dtype
+
+
+def test_inplace_and_indexing():
+    a = nd.array(_a(4, 4))
+    orig = a.asnumpy().copy()
+    a += 1
+    np.testing.assert_allclose(a.asnumpy(), orig + 1, rtol=1e-6)
+    a[0] = 0.0
+    assert a.asnumpy()[0].sum() == 0
+    row = a[1]
+    np.testing.assert_allclose(row.asnumpy(), (orig + 1)[1], rtol=1e-6)
+    sub = a[1:3, :2]
+    assert sub.shape == (2, 2)
+
+
+def test_reductions():
+    x = _a(3, 4, 5)
+    a = nd.array(x)
+    np.testing.assert_allclose(nd.sum(a).asnumpy(), x.sum(), rtol=1e-5)
+    np.testing.assert_allclose(nd.mean(a, axis=1).asnumpy(), x.mean(1), rtol=1e-5)
+    np.testing.assert_allclose(nd.max(a, axis=(0, 2)).asnumpy(), x.max((0, 2)), rtol=1e-6)
+    np.testing.assert_allclose(nd.argmax(a, axis=2).asnumpy(), x.argmax(2))
+    np.testing.assert_allclose(nd.norm(a).asnumpy(), np.linalg.norm(x.ravel()), rtol=1e-5)
+
+
+def test_shape_ops():
+    x = _a(2, 3, 4)
+    a = nd.array(x)
+    assert a.reshape(6, 4).shape == (6, 4)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert nd.transpose(a).shape == (4, 3, 2)
+    assert nd.expand_dims(a, axis=1).shape == (2, 1, 3, 4)
+    assert nd.concat(a, a, dim=2).shape == (2, 3, 8)
+    assert nd.stack(a, a, axis=0).shape == (2, 2, 3, 4)
+    parts = nd.split(a, num_outputs=3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 1, 4)
+    assert nd.flip(a, axis=2).asnumpy()[0, 0, 0] == x[0, 0, 3]
+    assert nd.tile(a, reps=(1, 2, 1)).shape == (2, 6, 4)
+
+
+def test_dot():
+    x, y = _a(3, 4), _a(4, 5)
+    np.testing.assert_allclose(nd.dot(nd.array(x), nd.array(y)).asnumpy(),
+                               x @ y, rtol=1e-5)
+    bx, by = _a(2, 3, 4), _a(2, 4, 5)
+    np.testing.assert_allclose(nd.batch_dot(nd.array(bx), nd.array(by)).asnumpy(),
+                               bx @ by, rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.dot(nd.array(x), nd.array(y.T), transpose_b=True).asnumpy(), x @ y, rtol=1e-5)
+
+
+def test_take_pick_gather():
+    x = _a(5, 6)
+    a = nd.array(x)
+    idx = nd.array([0, 2, 4], dtype="int32")
+    np.testing.assert_allclose(nd.take(a, idx).asnumpy(), x[[0, 2, 4]], rtol=1e-6)
+    pk = nd.pick(a, nd.array([1, 2, 3, 0, 5], dtype="float32"), axis=1)
+    np.testing.assert_allclose(pk.asnumpy(), x[np.arange(5), [1, 2, 3, 0, 5]], rtol=1e-6)
+    oh = nd.one_hot(nd.array([0, 2], dtype="int32"), depth=4)
+    assert oh.asnumpy().tolist() == [[1, 0, 0, 0], [0, 0, 1, 0]]
+
+
+def test_topk_sort():
+    x = _a(4, 10)
+    a = nd.array(x)
+    v, i = nd.topk(a, k=3, ret_typ="both")
+    ref = np.sort(x, axis=-1)[:, ::-1][:, :3]
+    np.testing.assert_allclose(v.asnumpy(), ref, rtol=1e-6)
+    s = nd.sort(a, is_ascend=False)
+    np.testing.assert_allclose(s.asnumpy(), np.sort(x, -1)[:, ::-1], rtol=1e-6)
+
+
+def test_unary_math():
+    x = np.abs(_a(3, 3)) + 0.1
+    a = nd.array(x)
+    np.testing.assert_allclose(nd.sqrt(a).asnumpy(), np.sqrt(x), rtol=1e-6)
+    np.testing.assert_allclose(nd.log(a).asnumpy(), np.log(x), rtol=1e-5)
+    np.testing.assert_allclose(nd.exp(a).asnumpy(), np.exp(x), rtol=1e-5)
+    np.testing.assert_allclose(nd.sigmoid(a).asnumpy(), 1 / (1 + np.exp(-x)), rtol=1e-5)
+    np.testing.assert_allclose(nd.clip(a, a_min=0.2, a_max=0.5).asnumpy(),
+                               np.clip(x, 0.2, 0.5), rtol=1e-6)
+
+
+def test_random_determinism():
+    mx.random.seed(42)
+    a = nd.random.uniform(shape=(4, 4)).asnumpy()
+    mx.random.seed(42)
+    b = nd.random.uniform(shape=(4, 4)).asnumpy()
+    np.testing.assert_array_equal(a, b)
+    c = nd.random.normal(0, 2.0, shape=(1000,)).asnumpy()
+    assert abs(c.std() - 2.0) < 0.3
+    r = nd.random.randint(0, 10, shape=(100,)).asnumpy()
+    assert r.min() >= 0 and r.max() < 10
+
+
+def test_context():
+    a = nd.zeros((2, 2), ctx=mx.cpu())
+    assert a.context.device_type in ("cpu", "tpu")
+    b = a.as_in_context(mx.cpu(0))
+    assert b.shape == (2, 2)
+    with mx.Context("cpu", 0):
+        c = nd.ones((1,))
+        assert c.asnumpy()[0] == 1
+
+
+def test_astype_cast():
+    a = nd.array([[1.5, 2.5]])
+    assert a.astype("int32").dtype == np.int32
+    assert a.astype("bfloat16").astype("float32").asnumpy()[0, 0] == 1.5
+
+
+def test_where_comparison():
+    x, y = _a(3, 3), _a(3, 3)
+    a, b = nd.array(x), nd.array(y)
+    m = a > b
+    np.testing.assert_allclose(m.asnumpy(), (x > y).astype(np.float32))
+    w = nd.where(m, a, b)
+    np.testing.assert_allclose(w.asnumpy(), np.where(x > y, x, y), rtol=1e-6)

@@ -1,0 +1,202 @@
+"""RNN layers/cells, losses, optimizers, schedulers, metrics, initializers."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn, rnn
+
+
+def _x(*shape):
+    return nd.array(np.random.randn(*shape).astype(np.float32))
+
+
+# ------------------------------------------------------------------ RNN
+def test_lstm_gru_rnn_shapes():
+    for layer, nstates in [(rnn.LSTM(16, 2), 2), (rnn.GRU(16, 2), 1),
+                           (rnn.RNN(16, 1), 1)]:
+        layer.initialize()
+        out = layer(_x(7, 3, 8))
+        assert out.shape == (7, 3, 16)
+        states = layer.begin_state(3)
+        out, st = layer(_x(7, 3, 8), states)
+        assert len(st) == nstates and st[0].shape == (layer._num_layers * 1, 3, 16)
+
+
+def test_bidirectional_lstm():
+    layer = rnn.LSTM(8, 1, bidirectional=True)
+    layer.initialize()
+    out = layer(_x(5, 2, 4))
+    assert out.shape == (5, 2, 16)
+
+
+def test_ntc_layout():
+    layer = rnn.LSTM(8, 1, layout="NTC")
+    layer.initialize()
+    assert layer(_x(2, 5, 4)).shape == (2, 5, 8)
+
+
+def test_lstm_grad_flows():
+    layer = rnn.LSTM(8, 1, input_size=4)
+    layer.initialize()
+    x = _x(5, 2, 4)
+    with autograd.record():
+        y = layer(x).sum()
+    y.backward()
+    p = layer.l0_i2h_weight
+    assert float(abs(p.grad().asnumpy()).sum()) > 0
+
+
+def test_lstm_cell_unroll_matches_layer():
+    cell = rnn.LSTMCell(8, input_size=4)
+    cell.initialize()
+    x = _x(2, 5, 4)  # NTC
+    out, states = cell.unroll(5, x, layout="NTC")
+    assert out.shape == (2, 5, 8)
+
+
+def test_cells():
+    for cell in [rnn.RNNCell(6, input_size=4), rnn.GRUCell(6, input_size=4)]:
+        cell.initialize()
+        out, st = cell(_x(3, 4), cell.begin_state(3))
+        assert out.shape == (3, 6)
+
+
+# ------------------------------------------------------------------ Loss
+def test_losses():
+    pred, label = _x(4, 5), _x(4, 5)
+    for L in [gluon.loss.L2Loss(), gluon.loss.L1Loss(), gluon.loss.HuberLoss()]:
+        out = L(pred, label)
+        assert out.shape == (4,)
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    out = sce(_x(4, 10), nd.array([1, 2, 3, 4], dtype="float32"))
+    assert out.shape == (4,)
+    # dense label
+    sce2 = gluon.loss.SoftmaxCrossEntropyLoss(sparse_label=False)
+    onehot = nd.one_hot(nd.array([1, 2, 3, 4], dtype="int32"), depth=10)
+    np.testing.assert_allclose(sce2(_x(4, 10) * 0, onehot).asnumpy(),
+                               np.full(4, np.log(10)), rtol=1e-4)
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+    assert bce(_x(4, 3), nd.ones((4, 3))).shape == (4,)
+    kl = gluon.loss.KLDivLoss()
+    assert kl(nd.log_softmax(_x(4, 5)), nd.softmax(_x(4, 5))).shape == (4,)
+
+
+def test_softmax_ce_value():
+    logits = nd.array([[10.0, 0.0], [0.0, 10.0]])
+    labels = nd.array([0.0, 1.0])
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()(logits, labels)
+    assert float(loss.mean().asscalar()) < 1e-3
+
+
+# ------------------------------------------------------------------ Optimizers
+@pytest.mark.parametrize("name,kw,iters", [
+    ("sgd", {"learning_rate": 0.1}, 60),
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}, 60),
+    ("nag", {"learning_rate": 0.1, "momentum": 0.9}, 60),
+    ("adam", {"learning_rate": 0.3}, 100),
+    ("adamw", {"learning_rate": 0.3, "wd": 0.01}, 100),
+    ("adagrad", {"learning_rate": 0.5}, 100),
+    ("adadelta", {"learning_rate": 1.0}, 400),
+    ("rmsprop", {"learning_rate": 0.1}, 100),
+    ("lamb", {"learning_rate": 0.1}, 100),
+    ("signum", {"learning_rate": 0.1}, 100),
+    ("ftrl", {"learning_rate": 0.5}, 100),
+])
+def test_optimizer_minimizes_quadratic(name, kw, iters):
+    w = nd.array([5.0, -3.0])
+    w.attach_grad()
+    trainer = gluon.Trainer([_param_of(w, name)], name, kw)
+    initial = float((w * w).sum().asscalar())
+    for _ in range(iters):
+        with autograd.record():
+            loss = (w * w).sum()
+        loss.backward()
+        trainer.step(1)
+    final = float((w * w).sum().asscalar())
+    assert final < initial * 0.3, (name, final)
+
+
+def _param_of(arr, name):
+    from mxnet_tpu.gluon.parameter import Parameter
+
+    p = Parameter("w_" + name, shape=arr.shape)
+    p._data = arr
+    return p
+
+
+def test_multi_precision():
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9, multi_precision=True)
+    w = nd.array([1.0, 2.0]).astype("bfloat16")
+    g = nd.array([0.1, 0.1]).astype("bfloat16")
+    state = opt.create_state(0, w)
+    assert "master" in state
+    opt.update(0, w, g, state)
+    assert "bfloat16" in str(w.dtype)
+
+
+def test_lr_schedulers():
+    s = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(0) == 1.0 and s(10) == 0.5 and s(20) == 0.25
+    m = mx.lr_scheduler.MultiFactorScheduler(step=[5, 10], factor=0.1, base_lr=1.0)
+    assert abs(m(7) - 0.1) < 1e-9 and abs(m(11) - 0.01) < 1e-9
+    c = mx.lr_scheduler.CosineScheduler(100, base_lr=1.0, final_lr=0.0)
+    assert c(0) == 1.0 and abs(c(100)) < 1e-6
+    w = mx.lr_scheduler.PolyScheduler(100, base_lr=1.0, warmup_steps=10)
+    assert w(5) < 1.0
+
+
+def test_trainer_learning_rate_and_states(tmp_path):
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 0.01})
+    x = _x(4, 2)
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    tr.step(4)
+    assert tr.learning_rate == 0.01
+    tr.set_learning_rate(0.5)
+    assert tr.learning_rate == 0.5
+    f = str(tmp_path / "st.bin")
+    tr.save_states(f)
+    tr.load_states(f)
+
+
+# ------------------------------------------------------------------ Metric
+def test_metrics():
+    acc = mx.metric.Accuracy()
+    acc.update(nd.array([1, 0, 1]), nd.array([[0.2, 0.8], [0.9, 0.1], [0.3, 0.7]]))
+    assert acc.get()[1] == 1.0
+    top = mx.metric.TopKAccuracy(top_k=2)
+    top.update(nd.array([2]), nd.array([[0.4, 0.3, 0.35]]))
+    assert top.get()[1] == 1.0
+    mae = mx.metric.MAE()
+    mae.update(nd.array([1.0, 2.0]), nd.array([1.5, 2.5]))
+    assert abs(mae.get()[1] - 0.5) < 1e-6
+    comp = mx.metric.CompositeEvalMetric(["accuracy", "mae"])
+    names, vals = comp.get()
+    assert len(names) == 2
+    ppl = mx.metric.Perplexity()
+    ppl.update(nd.array([0]), nd.array([[1.0, 0.0]]))
+    assert abs(ppl.get()[1] - 1.0) < 1e-6
+
+
+# ------------------------------------------------------------------ Initializer
+def test_initializers():
+    from mxnet_tpu import init
+
+    arr = nd.zeros((100, 50))
+    init.Xavier()( init.InitDesc("fc_weight"), arr)
+    a = arr.asnumpy()
+    assert a.std() > 0 and abs(a.mean()) < 0.05
+    b = nd.zeros((10,))
+    init.Xavier()(init.InitDesc("fc_bias"), b)
+    assert b.asnumpy().sum() == 0  # bias → zero by naming convention
+    c = nd.zeros((8,))
+    init.create("lstmbias")(init.InitDesc("h2h_bias"), c)
+    assert c.asnumpy()[2:4].sum() == 2.0  # forget gates
+    o = nd.zeros((6, 6))
+    init.Orthogonal()(init.InitDesc("w"), o)
+    q = o.asnumpy()
+    np.testing.assert_allclose(q @ q.T, np.eye(6) * (q @ q.T)[0, 0], atol=1e-4)

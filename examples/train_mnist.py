@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""MNIST training example (gluon imperative + hybridize), mirroring the
+reference's example/gluon/mnist.py. Uses synthetic data when the dataset files
+are absent (zero-egress environment)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon.data.vision import transforms
+
+
+def main(epochs=2, batch_size=64, lr=0.01):
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(128, activation="relu"),
+            gluon.nn.Dense(64, activation="relu"),
+            gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+
+    to_tensor = transforms.ToTensor()
+    train_ds = gluon.data.vision.MNIST(train=True).transform_first(
+        lambda im: to_tensor(im))
+    loader = gluon.data.DataLoader(train_ds, batch_size=batch_size, shuffle=True,
+                                   num_workers=1)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": lr, "momentum": 0.9})
+    metric = mx.metric.Accuracy()
+
+    for epoch in range(epochs):
+        metric.reset()
+        for data, label in loader:
+            data = data.reshape(data.shape[0], -1)
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label.astype("float32"))
+            loss.backward()
+            trainer.step(data.shape[0])
+            metric.update(label, out)
+        print("epoch %d %s=%.4f" % (epoch, *metric.get()))
+
+
+if __name__ == "__main__":
+    main()

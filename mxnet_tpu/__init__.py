@@ -39,6 +39,7 @@ from . import checkpoint
 from . import parallel
 from . import module
 from . import sparse
+from . import quantization
 from . import models
 
 __all__ = ["nd", "gluon", "autograd", "cpu", "gpu", "tpu", "Context", "NDArray"]

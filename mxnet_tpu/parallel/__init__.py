@@ -7,4 +7,5 @@ from . import tensor_parallel  # noqa: F401
 from .tensor_parallel import shard_params, param_specs, constrain  # noqa: F401
 from .ring_attention import ring_attention, full_attention  # noqa: F401
 from .pipeline import pipeline_apply, stack_stage_params  # noqa: F401
+from .expert_parallel import moe_ffn  # noqa: F401
 from .resilience import Heartbeat, ResumableLoop  # noqa: F401

@@ -1,0 +1,91 @@
+"""int8 quantization (ref: src/operator/quantization/*.cc, python/mxnet/
+contrib/quantization.py).
+
+MXNet's int8 path targets MKLDNN/TensorRT kernels with calibrated ranges.
+TPU-native: symmetric per-channel int8 weights + dynamic per-tensor int8
+activations, accumulating in int32 on the MXU (``preferred_element_type``),
+rescaled in fp32 — the standard XLA int8 inference recipe. ``quantize_model``
+swaps eligible Dense layers in-place for inference.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .base import register_op
+from .gluon import nn
+from .gluon.block import HybridBlock
+from .ndarray import NDArray
+
+__all__ = ["quantize", "dequantize", "quantized_fully_connected",
+           "QuantizedDense", "quantize_model"]
+
+
+@register_op("contrib_quantize", nondiff=True)
+def quantize(x, *, axis=None):
+    """Symmetric int8: returns (q, scale). axis=None → per-tensor;
+    axis=i → per-slice along dim i (ref: quantize_v2-inl.h)."""
+    if axis is None:
+        amax = jnp.max(jnp.abs(x))
+    else:
+        red = tuple(d for d in range(x.ndim) if d != axis)
+        amax = jnp.max(jnp.abs(x), axis=red, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+@register_op("contrib_dequantize", nondiff=True)
+def dequantize(q, scale):
+    return q.astype(jnp.float32) * scale
+
+
+@register_op("quantized_fully_connected", nondiff=True)
+def quantized_fully_connected(x, qweight, w_scale, bias=None):
+    """x fp → dynamic int8; int8×int8 matmul accumulated in int32 on the MXU.
+    qweight: (out, in) int8; w_scale: (out, 1) fp32."""
+    qx, x_scale = quantize(x)
+    acc = jax.lax.dot_general(
+        qx, qweight, (((qx.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    y = acc.astype(jnp.float32) * (x_scale * w_scale.reshape(-1))
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+class QuantizedDense(HybridBlock):
+    """Inference-only Dense with pre-quantized int8 weights."""
+
+    def __init__(self, dense: nn.Dense, **kwargs):
+        super().__init__(prefix=dense.prefix, **kwargs)
+        w = dense.weight.data()._data.astype(jnp.float32)
+        qw, ws = quantize(w, axis=0)
+        self._qw = jnp.asarray(qw)
+        self._ws = jnp.asarray(ws)
+        self._bias = (dense.bias.data()._data.astype(jnp.float32)
+                      if hasattr(dense, "bias") and dense.bias is not None else None)
+        self._flatten = dense._flatten
+        self._act = dense.act
+
+    def hybrid_forward(self, F, x):
+        # raw jnp weights pass through both facades unchanged
+        y = F.quantized_fully_connected(x, self._qw, self._ws, self._bias)
+        if self._act is not None:
+            y = self._act(y)
+        return y
+
+
+def quantize_model(block, exclude=()):
+    """Replace Dense children with QuantizedDense (in place), skipping names
+    matching any substring in `exclude` (ref: contrib/quantization.py:
+    quantize_model)."""
+    for name, child in list(block._children.items()):
+        if isinstance(child, nn.Dense) and not any(e in child.prefix for e in exclude):
+            q = QuantizedDense(child)
+            block._children[name] = q
+            if hasattr(block, name):
+                object.__setattr__(block, name, q)
+        else:
+            quantize_model(child, exclude)
+    return block

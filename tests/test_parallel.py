@@ -90,6 +90,27 @@ def test_pipeline_matches_sequential():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+def test_moe_expert_parallel_matches_reference():
+    from mxnet_tpu.parallel.expert_parallel import moe_ffn
+
+    mesh = parallel.make_mesh({"ep": 8})
+    T, C, H, E = 64, 16, 32, 8
+    ks = jax.random.split(jax.random.PRNGKey(0), 4)
+    x = jax.random.normal(ks[0], (T, C))
+    rw = jax.random.normal(ks[1], (C, E)) * 0.5
+    w1 = jax.random.normal(ks[2], (E, C, H)) * 0.3
+    w2 = jax.random.normal(ks[3], (E, H, C)) * 0.3
+    xs = parallel.shard_array(x, mesh, "ep")
+    y, aux = moe_ffn(xs, rw, w1, w2, mesh, capacity_factor=float(E))
+    p = jax.nn.softmax(x @ rw, -1)
+    e = jnp.argmax(p, -1)
+    g = jnp.max(p, -1)
+    ref = jnp.stack([g[t] * (jax.nn.relu(x[t] @ w1[e[t]]) @ w2[e[t]])
+                     for t in range(T)])
+    assert float(jnp.abs(np.asarray(y) - ref).max()) < 1e-4
+    assert float(aux) > 0
+
+
 def test_kvstore_local_push_pull():
     kv = mx.kvstore.create("local")
     kv.init(3, nd.ones((2, 2)))

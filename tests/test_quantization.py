@@ -1,0 +1,19 @@
+"""int8 quantization path."""
+import numpy as np
+
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.quantization import quantize_model
+
+
+def test_quantized_dense_close_to_fp32():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu", in_units=16),
+            gluon.nn.Dense(8, in_units=32))
+    net.initialize()
+    x = nd.array(np.random.randn(4, 16).astype(np.float32))
+    ref = net(x).asnumpy()
+    quantize_model(net)
+    out = net(x).asnumpy()
+    # int8 dynamic quantization: relative error within a few percent
+    denom = np.abs(ref).max() + 1e-6
+    assert np.abs(out - ref).max() / denom < 0.1

@@ -3,4 +3,5 @@ from . import functional  # noqa: F401  (populates OP_REGISTRY)
 from . import detection  # noqa: F401
 from . import control_flow  # noqa: F401
 from . import attention  # noqa: F401
+from . import ctc  # noqa: F401
 from .functional import *  # noqa: F401,F403

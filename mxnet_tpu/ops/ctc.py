@@ -1,0 +1,81 @@
+"""CTC loss (ref: src/operator/contrib/ctc_loss.cc — warp-ctc CUDA replaced by
+a lax.scan forward algorithm in log space; XLA keeps the whole recursion in
+one compiled loop, gradients come from autodiff of the scan)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import register_op
+
+NEG = -1e30
+
+
+def _logsumexp3(a, b, c):
+    m = jnp.maximum(jnp.maximum(a, b), c)
+    m_safe = jnp.where(m > NEG / 2, m, 0.0)
+    # bound every exponent so grads stay finite when a branch is -inf-like
+    sa = jnp.where(a > NEG / 2, a - m_safe, -40.0)
+    sb = jnp.where(b > NEG / 2, b - m_safe, -40.0)
+    sc = jnp.where(c > NEG / 2, c - m_safe, -40.0)
+    out = m_safe + jnp.log(jnp.exp(sa) + jnp.exp(sb) + jnp.exp(sc))
+    return jnp.where(m > NEG / 2, out, NEG)
+
+
+@register_op("CTCLoss")
+def CTCLoss(pred, label, pred_lengths=None, label_lengths=None, *, blank=0):
+    """pred: (N, T, V) unnormalized; label: (N, L) int (padded with -1 or any
+    value beyond label_lengths); returns per-sample loss (N,).
+    Follows mx.gluon.loss.CTCLoss semantics with blank_label='first'."""
+    N, T, V = pred.shape
+    L = label.shape[1]
+    S = 2 * L + 1
+    logp = jax.nn.log_softmax(pred.astype(jnp.float32), axis=-1)
+    label = label.astype(jnp.int32)
+    if pred_lengths is None:
+        pred_lengths = jnp.full((N,), T, jnp.int32)
+    else:
+        pred_lengths = pred_lengths.astype(jnp.int32)
+    if label_lengths is None:
+        label_lengths = jnp.full((N,), L, jnp.int32)
+    else:
+        label_lengths = label_lengths.astype(jnp.int32)
+
+    # extended label sequence: blank, l1, blank, l2, ..., blank
+    s_idx = jnp.arange(S)
+    ext = jnp.where(s_idx % 2 == 0, blank,
+                    jnp.take_along_axis(label, jnp.maximum((s_idx[None, :] - 1) // 2, 0),
+                                        axis=1))  # (N, S) via broadcast
+    ext = jnp.broadcast_to(ext, (N, S))
+    # allow skip transition s-2 → s when ext[s] != ext[s-2] and ext[s] != blank
+    ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=blank)[:, :S]
+    can_skip = (ext != blank) & (ext != ext_m2)
+
+    alpha0 = jnp.full((N, S), NEG)
+    alpha0 = alpha0.at[:, 0].set(logp[:, 0, blank])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.take_along_axis(logp[:, 0], ext[:, 1:2], axis=1)[:, 0])
+
+    def step(alpha, t):
+        lp_t = jnp.take_along_axis(logp[:, t], ext, axis=1)  # (N, S)
+        a1 = jnp.pad(alpha, ((0, 0), (1, 0)), constant_values=NEG)[:, :S]
+        a2 = jnp.pad(alpha, ((0, 0), (2, 0)), constant_values=NEG)[:, :S]
+        a2 = jnp.where(can_skip, a2, NEG)
+        new = _logsumexp3(alpha, a1, a2) + lp_t
+        # freeze past each sample's input length
+        active = (t < pred_lengths)[:, None]
+        new = jnp.where(active, new, alpha)
+        return new, None
+
+    alpha, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+    # final: logsumexp of positions 2*label_len and 2*label_len - 1
+    end = 2 * label_lengths
+    a_end = jnp.take_along_axis(alpha, end[:, None], axis=1)[:, 0]
+    a_end1 = jnp.take_along_axis(alpha, jnp.maximum(end - 1, 0)[:, None], axis=1)[:, 0]
+    m = jnp.maximum(a_end, a_end1)
+    m_safe = jnp.where(m > NEG / 2, m, 0.0)
+    se = jnp.where(a_end > NEG / 2, a_end - m_safe, -40.0)
+    se1 = jnp.where(a_end1 > NEG / 2, a_end1 - m_safe, -40.0)
+    ll = m_safe + jnp.log(jnp.exp(se) + jnp.exp(se1))
+    return -ll

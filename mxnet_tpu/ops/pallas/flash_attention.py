@@ -67,7 +67,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
         o_ref[0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
 
 
-def _flash_fwd(q, k, v, scale, causal, bq, bk):
+def _flash_fwd(q, k, v, scale, causal, bq, bk, interpret=False):
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     bq = min(bq, Tq)
@@ -78,6 +78,7 @@ def _flash_fwd(q, k, v, scale, causal, bq, bk):
     grid = (B * H, Tq // bq, Tk // bk)
     out = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk),
+        interpret=interpret,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),

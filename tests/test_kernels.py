@@ -1,0 +1,62 @@
+"""Pallas flash attention (interpret mode on CPU) + CTC loss."""
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.ops.pallas.flash_attention import _flash_fwd
+from mxnet_tpu.parallel import full_attention
+
+
+def test_flash_attention_interpret_matches_reference():
+    B, H, T, D = 1, 2, 256, 64
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (B, H, T, D), jnp.float32) for kk in ks)
+    for causal in (False, True):
+        out = _flash_fwd(q, k, v, 1.0 / D ** 0.5, causal, 128, 128, interpret=True)
+        ref = full_attention(q, k, v, causal=causal)
+        assert float(jnp.abs(out - ref).max()) < 1e-4, causal
+
+
+def test_ctc_loss_brute_force():
+    from mxnet_tpu.ops.ctc import CTCLoss
+
+    rng = np.random.default_rng(0)
+    T, V = 5, 4
+    pred = jnp.asarray(rng.normal(size=(1, T, V)).astype(np.float32))
+    label = jnp.asarray([[1, 2]], jnp.int32)
+    loss = float(CTCLoss(pred, label)[0])
+
+    lp = np.asarray(jax.nn.log_softmax(pred[0], axis=-1))
+
+    def collapse(path):
+        out, prev = [], None
+        for s in path:
+            if s != prev and s != 0:
+                out.append(s)
+            prev = s
+        return out
+
+    tot = -np.inf
+    for path in itertools.product(range(V), repeat=T):
+        if collapse(path) == [1, 2]:
+            tot = np.logaddexp(tot, sum(lp[t, s] for t, s in enumerate(path)))
+    assert abs(loss - (-tot)) < 1e-4
+
+
+def test_ctc_gluon_block_and_grad():
+    from mxnet_tpu import autograd
+
+    loss_fn = gluon.loss.CTCLoss()
+    pred = nd.array(np.random.randn(2, 8, 5).astype(np.float32))
+    label = nd.array(np.array([[1, 2, 3], [2, 4, 4]], np.float32))
+    pred.attach_grad()
+    with autograd.record():
+        loss = loss_fn(pred, label)
+    assert loss.shape == (2,)
+    assert np.isfinite(loss.asnumpy()).all()
+    loss.backward()
+    g = pred.grad.asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0

@@ -40,6 +40,8 @@ from . import parallel
 from . import module
 from . import sparse
 from . import quantization
+from . import numpy_api
+from . import numpy_api as np  # mx.np parity (ref: python/mxnet/numpy)
 from . import models
 
 __all__ = ["nd", "gluon", "autograd", "cpu", "gpu", "tpu", "Context", "NDArray"]

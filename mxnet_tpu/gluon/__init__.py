@@ -10,3 +10,4 @@ from .trainer import Trainer
 from . import utils
 from . import data
 from . import model_zoo
+from . import contrib

@@ -1,0 +1,3 @@
+"""gluon.contrib (ref: python/mxnet/gluon/contrib)."""
+from . import estimator  # noqa: F401
+from . import nn  # noqa: F401

@@ -1,0 +1,111 @@
+"""Estimator: Keras-style fit loop (ref: python/mxnet/gluon/contrib/estimator).
+
+Wraps the imperative record/backward/step loop with metric tracking and event
+handlers (checkpointing, logging, early stopping).
+"""
+from __future__ import annotations
+
+import time
+
+from ... import autograd
+from ... import metric as metric_mod
+from ..trainer import Trainer
+
+__all__ = ["Estimator", "LoggingHandler", "CheckpointHandler", "EarlyStoppingHandler"]
+
+
+class _Event:
+    def __init__(self, estimator):
+        self.estimator = estimator
+        self.epoch = 0
+        self.batch = 0
+        self.stop = False
+
+
+class LoggingHandler:
+    def __init__(self, log_interval=50):
+        self.log_interval = log_interval
+
+    def batch_end(self, ev):
+        if ev.batch % self.log_interval == 0:
+            vals = ", ".join("%s=%.4f" % (n, v)
+                             for n, v in ev.estimator.train_metrics.get_name_value())
+            print("epoch %d batch %d: %s" % (ev.epoch, ev.batch, vals))
+
+    def epoch_end(self, ev):
+        vals = ", ".join("%s=%.4f" % (n, v)
+                         for n, v in ev.estimator.train_metrics.get_name_value())
+        print("epoch %d done: %s" % (ev.epoch, vals))
+
+
+class CheckpointHandler:
+    def __init__(self, model_dir, model_prefix="model", save_best=False):
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+
+    def epoch_end(self, ev):
+        import os
+
+        os.makedirs(self.model_dir, exist_ok=True)
+        ev.estimator.net.save_parameters(
+            "%s/%s-epoch%d.params" % (self.model_dir, self.model_prefix, ev.epoch))
+
+
+class EarlyStoppingHandler:
+    def __init__(self, monitor="loss", patience=3, mode="min"):
+        self.patience = patience
+        self.mode = mode
+        self.best = None
+        self.waiting = 0
+
+    def epoch_end(self, ev):
+        pairs = ev.estimator.train_metrics.get_name_value()
+        val = pairs[0][1]
+        better = self.best is None or (val < self.best if self.mode == "min" else val > self.best)
+        if better:
+            self.best = val
+            self.waiting = 0
+        else:
+            self.waiting += 1
+            if self.waiting >= self.patience:
+                ev.stop = True
+
+
+class Estimator:
+    def __init__(self, net, loss, train_metrics=None, trainer=None, context=None):
+        self.net = net
+        self.loss = loss
+        self.train_metrics = metric_mod.CompositeEvalMetric(
+            train_metrics if isinstance(train_metrics, (list, tuple))
+            else [train_metrics] if train_metrics else ["accuracy"])
+        self.trainer = trainer or Trainer(net.collect_params(), "adam")
+
+    def fit(self, train_data, val_data=None, epochs=1, event_handlers=()):
+        ev = _Event(self)
+        for epoch in range(epochs):
+            ev.epoch = epoch
+            self.train_metrics.reset()
+            for i, (data, label) in enumerate(train_data):
+                ev.batch = i
+                with autograd.record():
+                    out = self.net(data)
+                    loss = self.loss(out, label)
+                loss.backward()
+                self.trainer.step(data.shape[0])
+                self.train_metrics.update(label, out)
+                for h in event_handlers:
+                    if hasattr(h, "batch_end"):
+                        h.batch_end(ev)
+            for h in event_handlers:
+                if hasattr(h, "epoch_end"):
+                    h.epoch_end(ev)
+            if ev.stop:
+                break
+        return self.train_metrics.get_name_value()
+
+    def evaluate(self, val_data, metrics=None):
+        m = metric_mod.CompositeEvalMetric(metrics or ["accuracy"])
+        for data, label in val_data:
+            out = self.net(data)
+            m.update(label, out)
+        return m.get_name_value()

@@ -1,0 +1,56 @@
+"""contrib layers (ref: python/mxnet/gluon/contrib/nn/basic_layers.py)."""
+from __future__ import annotations
+
+from ..block import HybridBlock
+from ..nn import BatchNorm
+
+__all__ = ["Identity", "SparseEmbedding", "SyncBatchNorm", "HybridConcurrent", "Concurrent"]
+
+
+class Identity(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device BatchNorm (ref: contrib/nn:SyncBatchNorm). On an in-mesh
+    dp step, XLA's SPMD partitioner computes batch stats over the full global
+    batch automatically (the mean/var reductions get psum'd), so this is the
+    plain BatchNorm under a sharded jit — kept as a distinct class for API
+    parity."""
+
+    def __init__(self, in_channels=0, num_devices=None, **kwargs):
+        super().__init__(in_channels=in_channels, **kwargs)
+
+
+class SparseEmbedding(HybridBlock):
+    """row_sparse-gradient embedding; dense on TPU (see mxnet_tpu/sparse.py
+    design note), API parity only."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32", **kwargs):
+        super().__init__(**kwargs)
+        from ..nn import Embedding
+
+        with self.name_scope():
+            self.embed = Embedding(input_dim, output_dim, dtype=dtype)
+
+    def hybrid_forward(self, F, x):
+        return self.embed(x)
+
+
+class HybridConcurrent(HybridBlock):
+    """Parallel branches concatenated (ref: contrib/nn:HybridConcurrent)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._axis = axis
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+
+    def hybrid_forward(self, F, x):
+        return F.concat(*[b(x) for b in self._children.values()], dim=self._axis)
+
+
+Concurrent = HybridConcurrent

@@ -1,0 +1,208 @@
+"""NumPy-compatible array API (ref: python/mxnet/numpy/multiarray.py — MXNet
+2.x's ``mx.np``). Thin numpy-style signatures over the same NDArray/registry
+machinery; exposed as ``mxnet_tpu.np``."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as _onp
+
+from .ndarray import (NDArray, array, invoke, zeros, ones, full, arange,  # noqa: F401
+                      linspace, eye)
+from .nd import random  # noqa: F401
+
+newaxis = None
+pi = _onp.pi
+e = _onp.e
+inf = _onp.inf
+nan = _onp.nan
+float32 = _onp.float32
+float64 = _onp.float64
+int32 = _onp.int32
+int64 = _onp.int64
+bfloat16 = jnp.bfloat16
+
+
+def _ax(fn_name):
+    def f(a, axis=None, keepdims=False):
+        return invoke(fn_name, (a,), {"axis": axis, "keepdims": keepdims})
+
+    f.__name__ = fn_name
+    return f
+
+
+sum = _ax("sum")
+mean = _ax("mean")
+prod = _ax("prod")
+max = _ax("max")
+min = _ax("min")
+var = _ax("var")
+std = _ax("std")
+amax = max
+amin = min
+
+
+def argmax(a, axis=None):
+    return invoke("argmax", (a,), {"axis": axis})
+
+
+def argmin(a, axis=None):
+    return invoke("argmin", (a,), {"axis": axis})
+
+
+def _u(fn_name):
+    def f(a):
+        return invoke(fn_name, (a,), {})
+
+    f.__name__ = fn_name
+    return f
+
+
+abs = _u("abs")
+exp = _u("exp")
+expm1 = _u("expm1")
+log = _u("log")
+log1p = _u("log1p")
+log2 = _u("log2")
+log10 = _u("log10")
+sqrt = _u("sqrt")
+cbrt = _u("cbrt")
+square = _u("square")
+sign = _u("sign")
+ceil = _u("ceil")
+floor = _u("floor")
+sin = _u("sin")
+cos = _u("cos")
+tan = _u("tan")
+arcsin = _u("arcsin")
+arccos = _u("arccos")
+arctan = _u("arctan")
+sinh = _u("sinh")
+cosh = _u("cosh")
+tanh = _u("tanh")
+negative = _u("negative")
+reciprocal = _u("reciprocal")
+
+
+def _b(fn_name):
+    def f(a, b):
+        return invoke(fn_name, (a, b), {})
+
+    f.__name__ = fn_name
+    return f
+
+
+add = _b("add")
+subtract = _b("subtract")
+multiply = _b("multiply")
+divide = _b("divide")
+true_divide = divide
+mod = _b("mod")
+power = _b("power")
+maximum = _b("maximum")
+minimum = _b("minimum")
+hypot = _b("hypot")
+arctan2 = _b("arctan2")
+equal = _b("equal")
+not_equal = _b("not_equal")
+greater = _b("greater")
+greater_equal = _b("greater_equal")
+less = _b("lesser")
+less_equal = _b("lesser_equal")
+logical_and = _b("logical_and")
+logical_or = _b("logical_or")
+logical_xor = _b("logical_xor")
+dot = _b("matmul")
+matmul = _b("matmul")
+
+
+def where(cond, x, y):
+    return invoke("where", (cond, x, y), {})
+
+
+def clip(a, a_min, a_max):
+    return invoke("clip", (a,), {"a_min": a_min, "a_max": a_max})
+
+
+def reshape(a, newshape):
+    return invoke("reshape", (a,), {"shape": tuple(newshape) if not isinstance(newshape, int) else (newshape,)})
+
+
+def transpose(a, axes=None):
+    return invoke("transpose", (a,), {"axes": tuple(axes) if axes else None})
+
+
+def swapaxes(a, a1, a2):
+    return invoke("swapaxes", (a,), {"dim1": a1, "dim2": a2})
+
+
+def expand_dims(a, axis):
+    return invoke("expand_dims", (a,), {"axis": axis})
+
+
+def squeeze(a, axis=None):
+    return invoke("squeeze", (a,), {"axis": axis})
+
+
+def concatenate(seq, axis=0):
+    return invoke("concat", tuple(seq), {"dim": axis})
+
+
+def stack(seq, axis=0):
+    return invoke("stack", tuple(seq), {"axis": axis})
+
+
+def split(a, indices_or_sections, axis=0):
+    return invoke("split", (a,), {"num_outputs": indices_or_sections, "axis": axis})
+
+
+def tile(a, reps):
+    return invoke("tile", (a,), {"reps": reps})
+
+
+def repeat(a, repeats, axis=None):
+    return invoke("repeat", (a,), {"repeats": repeats, "axis": axis})
+
+
+def flip(a, axis):
+    return invoke("flip", (a,), {"axis": axis})
+
+
+def broadcast_to(a, shape):
+    return invoke("broadcast_to", (a,), {"shape": tuple(shape)})
+
+
+def cumsum(a, axis=None):
+    return invoke("cumsum", (a,), {"axis": axis})
+
+
+def sort(a, axis=-1):
+    return invoke("sort", (a,), {"axis": axis})
+
+
+def argsort(a, axis=-1):
+    return invoke("argsort", (a,), {"axis": axis})
+
+
+def take(a, indices, axis=0):
+    return invoke("take", (a, indices), {"axis": axis})
+
+
+def einsum(subscripts, *operands):
+    vals = [o._data if isinstance(o, NDArray) else jnp.asarray(o) for o in operands]
+    return NDArray(jnp.einsum(subscripts, *vals))
+
+
+def asarray(a, dtype=None):
+    return array(a, dtype=dtype)
+
+
+def asnumpy(a):
+    return a.asnumpy() if isinstance(a, NDArray) else _onp.asarray(a)
+
+
+def zeros_like(a):
+    return invoke("zeros_like", (a,), {})
+
+
+def ones_like(a):
+    return invoke("ones_like", (a,), {})

@@ -634,8 +634,20 @@ def BatchNorm(x, gamma, beta, moving_mean, moving_var, *, eps=1e-5, momentum=0.9
 
 @register_op("LayerNorm")
 def LayerNorm(x, gamma, beta, *, axis=-1, eps=1e-5):
-    """(ref: src/operator/nn/layer_norm.cc). Computed in fp32 for bf16 inputs —
-    the standard TPU recipe; XLA fuses the whole thing into one kernel."""
+    """(ref: src/operator/nn/layer_norm.cc). fp32 statistics (the standard TPU
+    recipe); last-axis LN at MXU-aligned widths takes the fused pallas kernel
+    (ops/pallas/layernorm.py), one VMEM pass per row block."""
+    last = axis in (-1, x.ndim - 1)
+    if (jax.default_backend() == "tpu" and last and x.ndim >= 2
+            and x.shape[-1] % 128 == 0 and gamma.ndim == 1):
+        try:
+            from .pallas.layernorm import layernorm as _fused
+
+            lead = x.shape[:-1]
+            y = _fused(x.reshape(-1, x.shape[-1]), gamma, beta, eps)
+            return y.reshape(lead + (x.shape[-1],))
+        except Exception:
+            pass
     xf = x.astype(jnp.float32)
     m = jnp.mean(xf, axis=axis, keepdims=True)
     v = jnp.var(xf, axis=axis, keepdims=True)

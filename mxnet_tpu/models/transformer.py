@@ -56,6 +56,35 @@ class MultiHeadAttention(HybridBlock):
         out = F.reshape(F.transpose(out, axes=(0, 2, 1, 3)), shape=(B, Tq, C))
         return self.attn_out(out)
 
+    def project_kv(self, kv_in):
+        """Precompute K/V heads for incremental decoding (sockeye-style cache;
+        ref: sockeye/transformer.py attention state)."""
+        from .. import nd
+
+        k = self._split(nd, self.key(kv_in))
+        v = self._split(nd, self.value(kv_in))
+        return {"k": k, "v": v}
+
+    def step(self, q_in, cache):
+        """q_in: (B, 1, C); cache holds accumulated K/V (B, H, t, D).
+        Appends this step's K/V (self-attention) unless the cache is static
+        (cross-attention over encoder output)."""
+        from .. import nd
+
+        B, _, C = q_in.shape
+        q = self._split(nd, self.query(q_in))
+        if cache.get("static"):
+            k, v = cache["k"], cache["v"]
+        else:
+            k_new = self._split(nd, self.key(q_in))
+            v_new = self._split(nd, self.value(q_in))
+            k = nd.concat(cache["k"], k_new, dim=2) if cache.get("k") is not None else k_new
+            v = nd.concat(cache["v"], v_new, dim=2) if cache.get("v") is not None else v_new
+            cache["k"], cache["v"] = k, v
+        out = nd.scaled_dot_attention(q, k, v)
+        out = nd.reshape(nd.transpose(out, axes=(0, 2, 1, 3)), shape=(B, 1, C))
+        return self.attn_out(out)
+
 
 class FFN(HybridBlock):
     def __init__(self, units, hidden, dropout=0.0, **kwargs):
@@ -101,6 +130,13 @@ class DecoderCell(HybridBlock):
     def hybrid_forward(self, F, x, enc_out, self_mask=None, cross_mask=None):
         x = self.ln1(x + self.self_attn(x, x, self_mask, causal=True))
         x = self.ln2(x + self.cross_attn(x, enc_out, cross_mask))
+        return self.ln3(x + self.ffn(x))
+
+    def step(self, x, cache):
+        """Single-token decode with per-layer KV cache:
+        cache = {"self": {...}, "cross": {"static": True, k, v}}."""
+        x = self.ln1(x + self.self_attn.step(x, cache["self"]))
+        x = self.ln2(x + self.cross_attn.step(x, cache["cross"]))
         return self.ln3(x + self.ffn(x))
 
 
@@ -165,7 +201,27 @@ class TransformerModel(HybridBlock):
         return self.decode(F, tgt, enc_out, pos_enc, cross_mask)
 
     # ------------------------------------------------------- inference
-    def translate(self, src, max_len=64, bos=2, eos=3, beam=1):
+    def init_cache(self, enc_out):
+        caches = []
+        for cell in self.dec_cells:
+            cross = cell.cross_attn.project_kv(enc_out)
+            cross["static"] = True
+            caches.append({"self": {"k": None, "v": None}, "cross": cross})
+        return caches
+
+    def decode_step(self, tok, caches, position):
+        """tok: (B, 1) int32 current token; O(t) per step via KV cache
+        (sockeye's cached decoder vs the reference's full re-forward)."""
+        from .. import nd
+
+        h = self.tgt_embed(tok) * math.sqrt(self._units)
+        pos = self.pos_enc.data().slice_axis(0, position, position + 1)
+        h = h + nd.expand_dims(pos, axis=0)
+        for cell, cache in zip(self.dec_cells, caches):
+            h = cell.step(h, cache)
+        return self.proj(h)  # (B, 1, V)
+
+    def translate(self, src, max_len=64, bos=2, eos=3, beam=1, use_cache=True):
         """Greedy (beam=1) or beam-search decode; imperative."""
         import numpy as np
 
@@ -174,6 +230,18 @@ class TransformerModel(HybridBlock):
         B = src.shape[0]
         if beam <= 1:
             tgt = nd.full((B, 1), bos, dtype="int32")
+            if use_cache:
+                enc_out = self._encode_imperative(src)
+                caches = self.init_cache(enc_out)
+                cur = tgt
+                for t in range(max_len - 1):
+                    logits = self.decode_step(cur, caches, t)
+                    nxt = logits.asnumpy()[:, -1].argmax(-1).astype("int32")
+                    cur = nd.array(nxt[:, None], dtype="int32")
+                    tgt = nd.concat(tgt, cur, dim=1)
+                    if (nxt == eos).all():
+                        break
+                return tgt
             for _ in range(max_len - 1):
                 logits = self(src, tgt)
                 nxt = logits.asnumpy()[:, -1].argmax(-1).astype("int32")
@@ -182,6 +250,12 @@ class TransformerModel(HybridBlock):
                     break
             return tgt
         return self._beam_search(src, max_len, bos, eos, beam)
+
+    def _encode_imperative(self, src):
+        from .. import nd
+
+        pos_enc = self.pos_enc.data()
+        return self.encode(nd, src, pos_enc, None)
 
     def _beam_search(self, src, max_len, bos, eos, beam):
         import numpy as np

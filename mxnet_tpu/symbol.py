@@ -176,21 +176,27 @@ class Symbol:
         return Executor(self, ctx or current_context(), args, args_grad, grad_req)
 
     def tojson(self):
+        """Graph serialization, same spirit as MXNet's symbol json
+        (ref: nnvm/src/core/graph.cc:SaveJSON). Attrs stored as reprs so
+        ``load`` round-trips tuples/numbers exactly."""
         import json
 
         def ser(s, nodes, index):
             if id(s) in index:
                 return index[id(s)]
+            # children first so inputs reference earlier node ids
+            child_ids = [ser(i, nodes, index) for i in s._inputs]
             nid = len(nodes)
             index[id(s)] = nid
             nodes.append({"op": s._op or "null", "name": s.name,
-                          "attrs": {k: str(v) for k, v in s._attrs.items()},
-                          "inputs": [ser(i, nodes, index) for i in s._inputs]})
+                          "attrs": {k: repr(v) for k, v in s._attrs.items()},
+                          "shape": list(s._shape) if s._shape else None,
+                          "inputs": child_ids})
             return nid
 
         nodes = []
         ser(self, nodes, {})
-        return json.dumps({"nodes": nodes}, indent=2)
+        return json.dumps({"nodes": nodes, "head": len(nodes) - 1}, indent=2)
 
     def save(self, fname):
         with open(fname, "w") as f:
@@ -273,7 +279,26 @@ def Group(symbols):
 
 
 def load(fname):
-    raise NotImplementedError("symbol json load lands with the ONNX round (r3)")
+    with open(fname) as f:
+        return loads(f.read())
+
+
+def loads(json_str):
+    """Rebuild a Symbol graph from ``tojson`` output."""
+    import ast
+    import json
+
+    blob = json.loads(json_str)
+    built = []
+    for node in blob["nodes"]:
+        attrs = {k: ast.literal_eval(v) for k, v in node["attrs"].items()}
+        if node["op"] == "null":
+            built.append(Symbol(None, name=node["name"],
+                                shape=node.get("shape")))
+        else:
+            inputs = [built[i] for i in node["inputs"]]
+            built.append(Symbol(node["op"], inputs, attrs, name=node["name"]))
+    return built[blob.get("head", len(built) - 1)]
 
 
 class Executor:

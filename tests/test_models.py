@@ -114,6 +114,9 @@ def test_transformer_forward_and_translate():
     assert logits.shape == (2, 5, 60)
     out = model.translate(src, max_len=6)
     assert out.shape[0] == 2 and out.shape[1] <= 6
+    # KV-cached incremental decode must equal full re-forward decode
+    full = model.translate(src, max_len=6, use_cache=False)
+    np.testing.assert_array_equal(out.asnumpy(), full.asnumpy())
     beam = model.translate(src[0:1], max_len=6, beam=3)
     assert beam.shape[0] == 1
 

@@ -42,6 +42,7 @@ from . import sparse
 from . import quantization
 from . import numpy_api
 from . import numpy_api as np  # mx.np parity (ref: python/mxnet/numpy)
+from . import npx  # mx.npx parity (ref: python/mxnet/numpy_extension)
 from . import models
 
 __all__ = ["nd", "gluon", "autograd", "cpu", "gpu", "tpu", "Context", "NDArray"]

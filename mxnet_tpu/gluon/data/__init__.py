@@ -1,4 +1,5 @@
 from .dataset import Dataset, SimpleDataset, ArrayDataset, RecordFileDataset  # noqa: F401
 from .sampler import Sampler, SequentialSampler, RandomSampler, BatchSampler  # noqa: F401
 from .dataloader import DataLoader  # noqa: F401
+from .prefetcher import DevicePrefetcher  # noqa: F401
 from . import vision  # noqa: F401

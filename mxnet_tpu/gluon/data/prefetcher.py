@@ -1,0 +1,49 @@
+"""Device prefetcher: double-buffer host→HBM transfers.
+
+MXNet hides H2D copies inside the ThreadedEngine's IO streams; with JAX the
+equivalent is issuing ``jax.device_put`` for batch N+1 while the device still
+computes batch N (transfers are async). This wrapper gives any DataLoader that
+overlap with one line.
+"""
+from __future__ import annotations
+
+import jax
+
+from ...ndarray import NDArray
+
+__all__ = ["DevicePrefetcher"]
+
+
+def _put(batch, device):
+    def one(x):
+        if isinstance(x, NDArray):
+            return NDArray(jax.device_put(x._data, device))
+        return x
+
+    if isinstance(batch, (list, tuple)):
+        return type(batch)(one(b) for b in batch)
+    return one(batch)
+
+
+class DevicePrefetcher:
+    def __init__(self, loader, ctx=None):
+        self._loader = loader
+        if ctx is None:
+            self._device = jax.devices()[0]
+        else:
+            self._device = ctx.jax_device()
+
+    def __len__(self):
+        return len(self._loader)
+
+    def __iter__(self):
+        it = iter(self._loader)
+        try:
+            ahead = _put(next(it), self._device)  # transfer starts async
+        except StopIteration:
+            return
+        for batch in it:
+            nxt = _put(batch, self._device)  # overlap with consumer's compute
+            yield ahead
+            ahead = nxt
+        yield ahead

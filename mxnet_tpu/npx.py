@@ -1,0 +1,50 @@
+"""``mx.npx`` parity: neural-net extensions to the numpy namespace
+(ref: python/mxnet/ndarray/numpy_extension)."""
+from __future__ import annotations
+
+from .ndarray import invoke
+
+_np_mode = [False]
+
+
+def set_np(shape=True, array=True):
+    _np_mode[0] = True
+
+
+def reset_np():
+    _np_mode[0] = False
+
+
+def is_np_array():
+    return _np_mode[0]
+
+
+def _op(name):
+    def f(*args, **kwargs):
+        return invoke(name, args, kwargs)
+
+    f.__name__ = name
+    return f
+
+
+softmax = _op("softmax")
+log_softmax = _op("log_softmax")
+relu = _op("relu")
+sigmoid = _op("sigmoid")
+batch_norm = _op("BatchNorm")
+layer_norm = _op("LayerNorm")
+fully_connected = _op("FullyConnected")
+convolution = _op("Convolution")
+pooling = _op("Pooling")
+dropout = _op("Dropout")
+embedding = _op("Embedding")
+one_hot = _op("one_hot")
+pick = _op("pick")
+topk = _op("topk")
+batch_dot = _op("batch_dot")
+gamma = _op("gamma")
+gammaln = _op("gammaln")
+erf = _op("erf")
+erfinv = _op("erfinv")
+smooth_l1 = _op("smooth_l1")
+sequence_mask = _op("SequenceMask")

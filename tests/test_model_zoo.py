@@ -1,0 +1,50 @@
+"""Forward-shape coverage for every vision zoo family (SURVEY §2 #24)."""
+import numpy as np
+import pytest
+
+from mxnet_tpu import nd
+from mxnet_tpu.gluon.model_zoo.vision import get_model
+
+
+def _x(n=1, c=3, s=224):
+    return nd.array(np.random.randn(n, c, s, s).astype(np.float32))
+
+
+@pytest.mark.parametrize("name,size", [
+    ("vgg11", 64),
+    ("alexnet", 224),
+    ("mobilenet0.25", 64),
+    ("mobilenetv2_1.0", 64),
+    ("squeezenet1.1", 96),
+    ("densenet121", 64),
+])
+def test_zoo_forward(name, size):
+    net = get_model(name, classes=10)
+    net.initialize()
+    out = net(_x(1, 3, size))
+    assert out.shape == (1, 10)
+
+
+def test_inception_v3():
+    net = get_model("inceptionv3", classes=7)
+    net.initialize()
+    out = net(_x(1, 3, 299))
+    assert out.shape == (1, 7)
+
+
+def test_resnet_thumbnail():
+    from mxnet_tpu.gluon.model_zoo.vision import get_resnet
+
+    net = get_resnet(1, 18, classes=10, thumbnail=True)
+    net.initialize()
+    out = net(_x(2, 3, 32))
+    assert out.shape == (2, 10)
+
+
+def test_npx_namespace():
+    import mxnet_tpu as mx
+
+    x = nd.array(np.random.randn(2, 5).astype(np.float32))
+    s = mx.npx.softmax(x, axis=-1)
+    np.testing.assert_allclose(s.asnumpy().sum(-1), 1.0, rtol=1e-5)
+    assert mx.npx.relu(x).shape == (2, 5)

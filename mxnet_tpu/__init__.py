@@ -40,6 +40,11 @@ from . import parallel
 from . import module
 from . import sparse
 from . import quantization
+from . import linalg
+from . import test_utils
+from . import callback
+from . import visualization
+from . import visualization as viz
 from . import numpy_api
 from . import numpy_api as np  # mx.np parity (ref: python/mxnet/numpy)
 from . import npx  # mx.npx parity (ref: python/mxnet/numpy_extension)

@@ -1,0 +1,73 @@
+"""Training callbacks (ref: python/mxnet/callback.py)."""
+from __future__ import annotations
+
+import logging
+import time
+
+
+class Speedometer:
+    """(ref: callback.py:Speedometer) — samples/sec logging every N batches."""
+
+    def __init__(self, batch_size, frequent=50, auto_reset=True):
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self.auto_reset = auto_reset
+        self.init = False
+        self.tic = 0.0
+        self.last_count = 0
+
+    def __call__(self, param):
+        count = param.nbatch
+        if self.last_count > count:
+            self.init = False
+        self.last_count = count
+        if self.init:
+            if count % self.frequent == 0:
+                speed = self.frequent * self.batch_size / (time.time() - self.tic)
+                if param.eval_metric is not None:
+                    name_value = param.eval_metric.get_name_value()
+                    if self.auto_reset:
+                        param.eval_metric.reset()
+                    msg = "Epoch[%d] Batch [%d] Speed: %.2f samples/sec %s" % (
+                        param.epoch, count, speed,
+                        " ".join("%s=%f" % nv for nv in name_value))
+                else:
+                    msg = "Epoch[%d] Batch [%d] Speed: %.2f samples/sec" % (
+                        param.epoch, count, speed)
+                logging.info(msg)
+                print(msg)
+                self.tic = time.time()
+        else:
+            self.init = True
+            self.tic = time.time()
+
+
+class BatchEndParam:
+    def __init__(self, epoch, nbatch, eval_metric, locals=None):
+        self.epoch = epoch
+        self.nbatch = nbatch
+        self.eval_metric = eval_metric
+        self.locals = locals
+
+
+def do_checkpoint(prefix, period=1):
+    """(ref: callback.py:do_checkpoint)"""
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            import numpy as np
+
+            arrs = {k: v.asnumpy() for k, v in (arg or {}).items()}
+            with open("%s-%04d.params" % (prefix, iter_no + 1), "wb") as f:
+                np.savez(f, **arrs)
+            if sym is not None:
+                sym.save("%s-symbol.json" % prefix)
+
+    return _callback
+
+
+class LogValidationMetricsCallback:
+    def __call__(self, param):
+        if param.eval_metric is not None:
+            for name, value in param.eval_metric.get_name_value():
+                logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name, value)

@@ -1,0 +1,100 @@
+"""Linear-algebra namespace (ref: src/operator/tensor/la_op.cc — MXNet's
+mx.nd.linalg backed by cuSolver/LAPACK; here XLA's native decompositions)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ndarray import NDArray
+
+__all__ = ["gemm2", "potrf", "potri", "trsm", "trmm", "syrk", "det", "inverse",
+           "cholesky", "qr", "svd", "eigh", "norm", "solve"]
+
+
+def _w(x):
+    return x._data if isinstance(x, NDArray) else jnp.asarray(x)
+
+
+def gemm2(a, b, transpose_a=False, transpose_b=False, alpha=1.0):
+    A, B = _w(a), _w(b)
+    if transpose_a:
+        A = jnp.swapaxes(A, -1, -2)
+    if transpose_b:
+        B = jnp.swapaxes(B, -1, -2)
+    return NDArray(alpha * (A @ B))
+
+
+def potrf(a):
+    """Cholesky factor (lower), MXNet linalg_potrf."""
+    return NDArray(jnp.linalg.cholesky(_w(a)))
+
+
+cholesky = potrf
+
+
+def potri(a):
+    """Inverse from Cholesky factor: (L L^T)^-1 given L."""
+    L = _w(a)
+    inv_l = jnp.linalg.inv(L)
+    return NDArray(jnp.swapaxes(inv_l, -1, -2) @ inv_l)
+
+
+def trsm(a, b, transpose=False, rightside=False, alpha=1.0, lower=True):
+    import jax.scipy.linalg as jsl
+
+    A, B = _w(a), _w(b)
+    if transpose:
+        A = jnp.swapaxes(A, -1, -2)
+        lower = not lower
+    if rightside:
+        X = jnp.swapaxes(
+            jsl.solve_triangular(jnp.swapaxes(A, -1, -2),
+                                 jnp.swapaxes(B, -1, -2), lower=not lower), -1, -2)
+    else:
+        X = jsl.solve_triangular(A, B, lower=lower)
+    return NDArray(alpha * X)
+
+
+def trmm(a, b, transpose=False, rightside=False, alpha=1.0):
+    A, B = _w(a), _w(b)
+    if transpose:
+        A = jnp.swapaxes(A, -1, -2)
+    out = (B @ A) if rightside else (A @ B)
+    return NDArray(alpha * out)
+
+
+def syrk(a, transpose=False, alpha=1.0):
+    A = _w(a)
+    if transpose:
+        A = jnp.swapaxes(A, -1, -2)
+    return NDArray(alpha * (A @ jnp.swapaxes(A, -1, -2)))
+
+
+def det(a):
+    return NDArray(jnp.linalg.det(_w(a)))
+
+
+def inverse(a):
+    return NDArray(jnp.linalg.inv(_w(a)))
+
+
+def solve(a, b):
+    return NDArray(jnp.linalg.solve(_w(a), _w(b)))
+
+
+def qr(a):
+    q, r = jnp.linalg.qr(_w(a))
+    return NDArray(q), NDArray(r)
+
+
+def svd(a):
+    u, s, vt = jnp.linalg.svd(_w(a), full_matrices=False)
+    return NDArray(u), NDArray(s), NDArray(vt)
+
+
+def eigh(a):
+    w, v = jnp.linalg.eigh(_w(a))
+    return NDArray(w), NDArray(v)
+
+
+def norm(a, ord=None, axis=None):
+    return NDArray(jnp.linalg.norm(_w(a), ord=ord, axis=axis))

@@ -1,0 +1,52 @@
+"""Network visualization (ref: python/mxnet/visualization.py)."""
+from __future__ import annotations
+
+from .symbol import Symbol
+
+
+def print_summary(symbol, shape=None, line_length=120):
+    """(ref: visualization.py:print_summary) — tabular layer listing."""
+    rows = []
+    seen = set()
+
+    def walk(s):
+        if id(s) in seen:
+            return
+        seen.add(id(s))
+        for i in s._inputs:
+            walk(i)
+        rows.append((s.name, s._op or "Variable",
+                     ",".join(i.name for i in s._inputs)))
+
+    walk(symbol)
+    header = ("Layer (type)", "Op", "Inputs")
+    widths = (40, 24, 50)
+    line = "  ".join(h.ljust(w) for h, w in zip(header, widths))
+    print(line)
+    print("=" * line_length)
+    for name, op, inputs in rows:
+        print("  ".join(str(c)[:w].ljust(w) for c, w in zip((name, op, inputs), widths)))
+    print("=" * line_length)
+    print("Total nodes: %d" % len(rows))
+    return rows
+
+
+def plot_network(symbol, title="plot", **kwargs):
+    """Graphviz dot source (rendering needs graphviz; we emit the source)."""
+    lines = ["digraph %s {" % title]
+    seen = {}
+
+    def walk(s):
+        if id(s) in seen:
+            return seen[id(s)]
+        nid = "n%d" % len(seen)
+        seen[id(s)] = nid
+        label = "%s\\n%s" % (s.name, s._op or "var")
+        lines.append('  %s [label="%s"];' % (nid, label))
+        for i in s._inputs:
+            lines.append("  %s -> %s;" % (walk(i), nid))
+        return nid
+
+    walk(symbol)
+    lines.append("}")
+    return "\n".join(lines)

@@ -349,6 +349,35 @@ def param_value(param):
 class SymbolBlock(HybridBlock):
     """Construct a block from a Symbol graph (ref: gluon/block.py:SymbolBlock)."""
 
+    @classmethod
+    def imports(cls, symbol_file, input_names, param_file=None, ctx=None):
+        """(ref: gluon/block.py:SymbolBlock.imports) — load a saved symbol
+        graph (+ optional params npz) as an executable block."""
+        from .. import symbol as sym_mod
+        from ..symbol import var
+
+        out = sym_mod.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [var(n) for n in input_names]
+        blk = cls(out, inputs)
+        if param_file is not None:
+            import numpy as np
+
+            import jax.numpy as jnp
+
+            loaded = np.load(param_file, allow_pickle=False)
+            from .parameter import Parameter
+
+            for name in out.list_arguments():
+                if name in input_names:
+                    continue
+                if name in loaded.files:
+                    p = Parameter(name, shape=loaded[name].shape)
+                    p.set_data(jnp.asarray(loaded[name]))
+                    blk._params._params[name] = p
+        return blk
+
     def __init__(self, outputs, inputs, params=None):
         super().__init__(prefix="", params=params)
         self._outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]

@@ -9,3 +9,5 @@ from .ring_attention import ring_attention, full_attention  # noqa: F401
 from .pipeline import pipeline_apply, stack_stage_params  # noqa: F401
 from .expert_parallel import moe_ffn  # noqa: F401
 from .resilience import Heartbeat, ResumableLoop  # noqa: F401
+from . import distributed  # noqa: F401
+from .distributed import init_process_group, global_mesh  # noqa: F401

@@ -1,0 +1,74 @@
+"""Multi-host distributed runtime (ref: src/kvstore/kvstore_dist.h + ps-lite
+Van/Scheduler; also MXNet's horovod integration).
+
+MXNet bootstraps workers/servers through ps-lite environment variables
+(DMLC_ROLE, DMLC_PS_ROOT_URI...). The TPU-native bootstrap is
+``jax.distributed.initialize``: every host joins one JAX runtime, jax.devices()
+becomes the GLOBAL device list, and a Mesh laid out over it gives collectives
+that ride ICI within a slice and DCN across slices. The same env-var contract
+is honored for drop-in launch-script compatibility.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = False
+
+
+def init_process_group(coordinator_address=None, num_processes=None,
+                       process_id=None, local_device_ids=None):
+    """Join the global JAX runtime. Falls back to MXNet/ps-lite env vars:
+    DMLC_PS_ROOT_URI/DMLC_PS_ROOT_PORT → coordinator, DMLC_NUM_WORKER →
+    num_processes, DMLC_WORKER_ID → process_id."""
+    global _initialized
+    if _initialized:
+        return
+    if coordinator_address is None:
+        uri = os.environ.get("DMLC_PS_ROOT_URI")
+        port = os.environ.get("DMLC_PS_ROOT_PORT", "9000")
+        if uri:
+            coordinator_address = "%s:%s" % (uri, port)
+    if num_processes is None:
+        num_processes = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+    if process_id is None:
+        process_id = int(os.environ.get("DMLC_WORKER_ID", "0"))
+    if num_processes > 1:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id,
+                                   local_device_ids=local_device_ids)
+    _initialized = True
+
+
+def rank():
+    return jax.process_index()
+
+
+def size():
+    return jax.process_count()
+
+
+def local_devices():
+    return jax.local_devices()
+
+
+def global_mesh(axes):
+    """Build a mesh over ALL hosts' devices (dp outermost so dp gradients can
+    cross DCN while tp/sp stay on intra-slice ICI)."""
+    from .mesh import make_mesh
+
+    return make_mesh(axes, devices=jax.devices())
+
+
+def barrier():
+    """Cross-host sync: tiny psum over all devices."""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if jax.process_count() == 1:
+        return
+    mesh = global_mesh({"dp": len(jax.devices())})
+    x = jax.device_put(jnp.zeros(len(jax.devices())), NamedSharding(mesh, P("dp")))
+    jnp.sum(x).block_until_ready()

@@ -1,0 +1,35 @@
+"""ROIAlign / ROIPooling."""
+import numpy as np
+
+from mxnet_tpu import autograd, nd
+
+
+def test_roi_align_constant_and_grad():
+    data = nd.ones((1, 2, 16, 16)) * 5.0
+    rois = nd.array(np.array([[0, 0, 0, 8, 8], [0, 4, 4, 12, 12]], np.float32))
+    out = nd.ROIAlign(data, rois, pooled_size=(2, 2), spatial_scale=1.0)
+    assert out.shape == (2, 2, 2, 2)
+    np.testing.assert_allclose(out.asnumpy(), 5.0, rtol=1e-5)
+    data.attach_grad()
+    with autograd.record():
+        s = nd.ROIAlign(data, rois, pooled_size=(2, 2)).sum()
+    s.backward()
+    # each of 2 rois × 2 channels × 4 cells distributes unit weight
+    assert abs(float(data.grad.asnumpy().sum()) - 16.0) < 1e-3
+
+
+def test_roi_align_gradient_structure():
+    data = nd.array(np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8))
+    rois = nd.array(np.array([[0, 2, 2, 6, 6]], np.float32))
+    out = nd.ROIAlign(data, rois, pooled_size=(2, 2))
+    # values inside the roi range
+    assert out.asnumpy().min() >= data.asnumpy()[0, 0, 2:7, 2:7].min() - 1
+    assert out.asnumpy().max() <= data.asnumpy()[0, 0, 2:7, 2:7].max() + 1
+
+
+def test_roi_pooling():
+    data = nd.array(np.random.randn(2, 3, 12, 12).astype(np.float32))
+    rois = nd.array(np.array([[0, 0, 0, 6, 6], [1, 3, 3, 9, 9]], np.float32))
+    out = nd.ROIPooling(data, rois, pooled_size=(3, 3))
+    assert out.shape == (2, 3, 3, 3)
+    assert np.isfinite(out.asnumpy()).all()

@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""PTB LSTM language model (bench config #3; mirrors the reference's
+example/rnn word-lm). Synthetic corpus when the PTB files are absent."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.models.lstm_lm import RNNModel
+
+
+def load_corpus(path="~/.mxnet/datasets/ptb/ptb.train.txt", vocab_size=10000,
+                synthetic_tokens=100000):
+    path = os.path.expanduser(path)
+    if os.path.exists(path):
+        words = open(path).read().replace("\n", " <eos> ").split()
+        vocab = {w: i for i, (w, _) in enumerate(
+            sorted(__import__("collections").Counter(words).items(),
+                   key=lambda kv: -kv[1])[:vocab_size])}
+        data = np.array([vocab.get(w, 0) for w in words], np.int32)
+        return data, len(vocab)
+    rng = np.random.RandomState(0)
+    # synthetic markov-ish stream so the model has learnable structure
+    data = rng.zipf(1.5, synthetic_tokens).clip(0, vocab_size - 1).astype(np.int32)
+    return data, vocab_size
+
+
+def batchify(data, batch_size):
+    n = len(data) // batch_size
+    return data[:n * batch_size].reshape(batch_size, n).T  # (T, N)
+
+
+def main(epochs=1, batch_size=32, bptt=35, lr=1.0, num_hidden=200, max_batches=50):
+    corpus, vocab_size = load_corpus()
+    data = batchify(corpus, batch_size)
+    model = RNNModel("lstm", vocab_size=vocab_size, num_embed=num_hidden,
+                     num_hidden=num_hidden, num_layers=2, dropout=0.2)
+    model.initialize(mx.init.Xavier())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(model.collect_params(), "sgd", {"learning_rate": lr})
+    ppl = mx.metric.Perplexity()
+
+    for epoch in range(epochs):
+        states = model.begin_state(batch_size)
+        ppl.reset()
+        nb = 0
+        for i in range(0, data.shape[0] - 1 - bptt, bptt):
+            x = nd.array(data[i:i + bptt], dtype="int32")
+            y = nd.array(data[i + 1:i + 1 + bptt].astype(np.float32))
+            states = [s.detach() for s in states]
+            with autograd.record():
+                logits, states = model(x, states)
+                L = loss_fn(logits.reshape(-1, vocab_size), y.reshape(-1)).mean()
+            L.backward()
+            gluon.utils.clip_global_norm(
+                [p.grad() for p in model.collect_params().values()
+                 if p.grad_req != "null" and p.grad() is not None], 0.25)
+            trainer.step(1)
+            sm = nd.softmax(logits.reshape(-1, vocab_size))
+            ppl.update(y.reshape(-1), sm)
+            nb += 1
+            if nb >= max_batches:
+                break
+        print("epoch %d %s=%.2f" % (epoch, *ppl.get()))
+
+
+if __name__ == "__main__":
+    main()

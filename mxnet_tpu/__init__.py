@@ -43,6 +43,7 @@ from . import quantization
 from . import linalg
 from . import test_utils
 from . import callback
+from . import monitor
 from . import visualization
 from . import visualization as viz
 from . import numpy_api

@@ -1,0 +1,63 @@
+"""Monitor: inspect intermediate outputs during training
+(ref: python/mxnet/monitor.py)."""
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from .ndarray import NDArray
+
+
+def _stat_norm(x):
+    a = np.asarray(x)
+    return float(np.sqrt((a.astype(np.float64) ** 2).mean()))
+
+
+class Monitor:
+    def __init__(self, interval=1, stat_func=None, pattern=".*", sort=False):
+        self.interval = interval
+        self.stat_func = stat_func or _stat_norm
+        self.pattern = re.compile(pattern)
+        self.queue = []
+        self.step = 0
+        self.activated = False
+        self._hooks = []
+
+    def install(self, block):
+        """Register forward hooks on a gluon block tree."""
+
+        def hook(blk, inputs, output):
+            if not self.activated:
+                return
+            name = blk.name
+            if self.pattern.match(name):
+                outs = output if isinstance(output, (list, tuple)) else [output]
+                for i, o in enumerate(outs):
+                    if isinstance(o, NDArray):
+                        self.queue.append((self.step, "%s_output%d" % (name, i),
+                                           self.stat_func(o.asnumpy())))
+
+        def walk(b):
+            b.register_forward_hook(hook)
+            for c in b._children.values():
+                walk(c)
+
+        walk(block)
+        return self
+
+    def tic(self):
+        if self.step % self.interval == 0:
+            self.activated = True
+            self.queue = []
+
+    def toc(self):
+        self.activated = False
+        self.step += 1
+        res = list(self.queue)
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        for step, name, stat in self.toc():
+            print("Batch %d: %s = %.6f" % (step, name, stat))

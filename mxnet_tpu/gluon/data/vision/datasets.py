@@ -115,6 +115,35 @@ class CIFAR100(CIFAR10):
         super().__init__(root, train, transform, synthetic_size)
 
 
+class ImageRecordDataset(Dataset):
+    """Images packed in RecordIO (ref: datasets.py:ImageRecordDataset)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        from ....recordio import MXRecordIO, unpack_img
+
+        self._records = []
+        rec = MXRecordIO(filename, "r")
+        while True:
+            buf = rec.read()
+            if buf is None:
+                break
+            self._records.append(buf)
+        rec.close()
+        self._flag = flag
+        self._transform = transform
+        self._unpack_img = unpack_img
+
+    def __getitem__(self, idx):
+        header, img = self._unpack_img(self._records[idx], iscolor=self._flag)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self._records)
+
+
 class ImageFolderDataset(Dataset):
     """(ref: datasets.py:ImageFolderDataset) — folder-per-class layout."""
 

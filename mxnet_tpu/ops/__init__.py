@@ -5,4 +5,5 @@ from . import control_flow  # noqa: F401
 from . import attention  # noqa: F401
 from . import ctc  # noqa: F401
 from . import roi  # noqa: F401
+from . import spatial  # noqa: F401
 from .functional import *  # noqa: F401,F403

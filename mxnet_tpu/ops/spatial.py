@@ -1,0 +1,45 @@
+"""Spatial transformer ops (ref: src/operator/spatial_transformer.cc,
+src/operator/bilinear_sampler.cc, src/operator/grid_generator.cc)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import register_op
+from .roi import _bilinear
+
+
+@register_op("GridGenerator")
+def GridGenerator(data, *, transform_type="affine", target_shape=None):
+    """affine: data (N, 6) → sampling grid (N, 2, H, W) in [-1, 1] coords."""
+    H, W = target_shape
+    theta = data.reshape(-1, 2, 3)
+    ys = jnp.linspace(-1.0, 1.0, H)
+    xs = jnp.linspace(-1.0, 1.0, W)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()])  # (3, HW)
+    out = jnp.einsum("nij,jk->nik", theta, base)  # (N, 2, HW)
+    return out.reshape(-1, 2, H, W)
+
+
+@register_op("BilinearSampler")
+def BilinearSampler(data, grid):
+    """data (N, C, H, W); grid (N, 2, Ho, Wo) normalized [-1, 1] (x, y)."""
+    N, C, H, W = data.shape
+
+    def one(img, g):
+        gx = (g[0] + 1.0) * (W - 1) / 2.0
+        gy = (g[1] + 1.0) * (H - 1) / 2.0
+        return _bilinear(img, gy, gx)  # (C, Ho, Wo)
+
+    return jax.vmap(one)(data, grid)
+
+
+@register_op("SpatialTransformer")
+def SpatialTransformer(data, loc, *, target_shape=None, transform_type="affine",
+                       sampler_type="bilinear"):
+    """(ref: src/operator/spatial_transformer.cc) — affine STN."""
+    grid = GridGenerator(loc, transform_type=transform_type,
+                         target_shape=target_shape or data.shape[2:])
+    return BilinearSampler(data, grid)

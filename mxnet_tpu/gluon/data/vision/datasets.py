@@ -116,32 +116,41 @@ class CIFAR100(CIFAR10):
 
 
 class ImageRecordDataset(Dataset):
-    """Images packed in RecordIO (ref: datasets.py:ImageRecordDataset)."""
+    """Images packed in RecordIO, read lazily by byte offset so multi-GB .rec
+    files never load into host memory (ref: datasets.py:ImageRecordDataset,
+    which subclasses the lazy RecordFileDataset). Uses the .idx file when
+    present; otherwise scans the framing once to build offsets in memory."""
 
     def __init__(self, filename, flag=1, transform=None):
-        from ....recordio import MXRecordIO, unpack_img
+        from ....recordio import IndexedRecordIO, MXRecordIO, unpack_img
 
-        self._records = []
-        rec = MXRecordIO(filename, "r")
-        while True:
-            buf = rec.read()
-            if buf is None:
-                break
-            self._records.append(buf)
-        rec.close()
+        idx_path = filename[:filename.rfind(".")] + ".idx"
+        self._rec = MXRecordIO(filename, "r")
+        if os.path.exists(idx_path):
+            idx = IndexedRecordIO(idx_path, filename, "r")
+            self._offsets = [idx.idx[k] for k in idx.keys]
+            idx.close()
+        else:
+            self._offsets = []
+            while True:
+                pos = self._rec.tell()
+                if self._rec.read() is None:
+                    break
+                self._offsets.append(pos)
         self._flag = flag
         self._transform = transform
         self._unpack_img = unpack_img
 
+    def __len__(self):
+        return len(self._offsets)
+
     def __getitem__(self, idx):
-        header, img = self._unpack_img(self._records[idx], iscolor=self._flag)
+        self._rec._f.seek(self._offsets[idx])
+        header, img = self._unpack_img(self._rec.read(), iscolor=self._flag)
         label = header.label
         if self._transform is not None:
             return self._transform(img, label)
         return img, label
-
-    def __len__(self):
-        return len(self._records)
 
 
 class ImageFolderDataset(Dataset):

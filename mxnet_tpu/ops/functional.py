@@ -34,7 +34,7 @@ floor = _u("floor", jnp.floor, nondiff=True)
 trunc = _u("trunc", jnp.trunc, nondiff=True)
 round = _u("round", jnp.round, nondiff=True)
 rint = _u("rint", jnp.rint, nondiff=True)
-fix = _u("fix", jnp.fix, nondiff=True)
+fix = _u("fix", jnp.trunc, nondiff=True)  # alias: round toward zero
 exp = _u("exp", jnp.exp)
 expm1 = _u("expm1", jnp.expm1)
 log = _u("log", jnp.log)

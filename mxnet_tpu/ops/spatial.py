@@ -6,32 +6,73 @@ import jax
 import jax.numpy as jnp
 
 from ..base import register_op
-from .roi import _bilinear
 
 
 @register_op("GridGenerator")
 def GridGenerator(data, *, transform_type="affine", target_shape=None):
-    """affine: data (N, 6) → sampling grid (N, 2, H, W) in [-1, 1] coords."""
-    H, W = target_shape
-    theta = data.reshape(-1, 2, 3)
-    ys = jnp.linspace(-1.0, 1.0, H)
-    xs = jnp.linspace(-1.0, 1.0, W)
-    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
-    ones = jnp.ones_like(gx)
-    base = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()])  # (3, HW)
-    out = jnp.einsum("nij,jk->nik", theta, base)  # (N, 2, HW)
-    return out.reshape(-1, 2, H, W)
+    """affine: data (N, 6) → sampling grid (N, 2, H, W) in [-1, 1] (x, y).
+    warp: data (N, 2, H, W) pixel-space flow field added to the identity grid
+    (ref: src/operator/grid_generator.cc both kTransFormType branches)."""
+    if transform_type == "affine":
+        if target_shape is None:
+            raise ValueError(
+                "GridGenerator(transform_type='affine') requires target_shape=(H, W)")
+        H, W = target_shape
+        theta = data.reshape(-1, 2, 3)
+        ys = jnp.linspace(-1.0, 1.0, H)
+        xs = jnp.linspace(-1.0, 1.0, W)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()])  # (3, HW)
+        out = jnp.einsum("nij,jk->nik", theta, base)  # (N, 2, HW)
+        return out.reshape(-1, 2, H, W)
+    if transform_type == "warp":
+        if data.ndim != 4 or data.shape[1] != 2:
+            raise ValueError("warp flow must have shape (N, 2, H, W), got %s"
+                             % (data.shape,))
+        _, _, H, W = data.shape
+        xs = jnp.arange(W, dtype=data.dtype)
+        ys = jnp.arange(H, dtype=data.dtype)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        x_s = (data[:, 0] + gx) * (2.0 / max(W - 1, 1)) - 1.0
+        y_s = (data[:, 1] + gy) * (2.0 / max(H - 1, 1)) - 1.0
+        return jnp.stack([x_s, y_s], axis=1)
+    raise ValueError("unknown transform_type %r" % (transform_type,))
+
+
+def _bilinear_zero(img, y, x):
+    """Bilinear sample with zero padding outside the image: each of the four
+    corner taps outside [0,H)x[0,W) contributes 0, matching the boundary
+    handling in src/operator/bilinear_sampler.cc (between() guards)."""
+    H, W = img.shape[1], img.shape[2]
+    y0 = jnp.floor(y).astype(jnp.int32)
+    x0 = jnp.floor(x).astype(jnp.int32)
+    y1 = y0 + 1
+    x1 = x0 + 1
+    wy1 = y - y0
+    wx1 = x - x0
+    wy0 = 1.0 - wy1
+    wx0 = 1.0 - wx1
+
+    def tap(yi, xi):
+        ok = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+        v = img[:, jnp.clip(yi, 0, H - 1), jnp.clip(xi, 0, W - 1)]
+        return jnp.where(ok, v, 0.0)
+
+    return (tap(y0, x0) * wy0 * wx0 + tap(y0, x1) * wy0 * wx1
+            + tap(y1, x0) * wy1 * wx0 + tap(y1, x1) * wy1 * wx1)
 
 
 @register_op("BilinearSampler")
 def BilinearSampler(data, grid):
-    """data (N, C, H, W); grid (N, 2, Ho, Wo) normalized [-1, 1] (x, y)."""
+    """data (N, C, H, W); grid (N, 2, Ho, Wo) normalized [-1, 1] (x, y).
+    Out-of-boundary samples are 0 (ref: src/operator/bilinear_sampler.cc)."""
     N, C, H, W = data.shape
 
     def one(img, g):
         gx = (g[0] + 1.0) * (W - 1) / 2.0
         gy = (g[1] + 1.0) * (H - 1) / 2.0
-        return _bilinear(img, gy, gx)  # (C, Ho, Wo)
+        return _bilinear_zero(img, gy, gx)  # (C, Ho, Wo)
 
     return jax.vmap(one)(data, grid)
 

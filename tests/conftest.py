@@ -12,6 +12,14 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
 
+# The env vars above are latched by jax.config at interpreter startup when the
+# axon sitecustomize imports jax — too early for env edits to matter. The
+# config API wins over the latched env, and XLA_FLAGS is still read lazily at
+# backend init, so the 8-device CPU mesh takes effect.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -1,0 +1,82 @@
+"""Spatial transformer ops + ImageRecordDataset lazy reads."""
+import numpy as np
+import pytest
+
+from mxnet_tpu import nd
+
+
+def test_grid_generator_affine_identity():
+    theta = nd.array(np.array([[1, 0, 0, 0, 1, 0]], np.float32))
+    grid = nd.GridGenerator(theta, transform_type="affine", target_shape=(4, 6))
+    g = grid.asnumpy()
+    assert g.shape == (1, 2, 4, 6)
+    np.testing.assert_allclose(g[0, 0, 0], np.linspace(-1, 1, 6), atol=1e-6)
+    np.testing.assert_allclose(g[0, 1, :, 0], np.linspace(-1, 1, 4), atol=1e-6)
+
+
+def test_grid_generator_affine_requires_target_shape():
+    theta = nd.array(np.zeros((1, 6), np.float32))
+    with pytest.raises(ValueError, match="target_shape"):
+        nd.GridGenerator(theta, transform_type="affine")
+
+
+def test_grid_generator_warp_zero_flow_is_identity():
+    flow = nd.array(np.zeros((2, 2, 5, 7), np.float32))
+    grid = nd.GridGenerator(flow, transform_type="warp").asnumpy()
+    assert grid.shape == (2, 2, 5, 7)
+    np.testing.assert_allclose(grid[0, 0, 0], np.linspace(-1, 1, 7), atol=1e-6)
+    np.testing.assert_allclose(grid[0, 1, :, 0], np.linspace(-1, 1, 5), atol=1e-6)
+
+
+def test_grid_generator_warp_pixel_shift():
+    # flow of +1 pixel in x moves the sample grid by 2/(W-1) in normalized coords
+    flow = np.zeros((1, 2, 3, 5), np.float32)
+    flow[:, 0] = 1.0
+    grid = nd.GridGenerator(nd.array(flow), transform_type="warp").asnumpy()
+    np.testing.assert_allclose(grid[0, 0, 0], np.linspace(-1, 1, 5) + 2.0 / 4, atol=1e-6)
+
+
+def test_bilinear_sampler_identity_and_zero_padding():
+    data = np.arange(2 * 1 * 4 * 4, dtype=np.float32).reshape(2, 1, 4, 4)
+    theta = nd.array(np.tile(np.array([[1, 0, 0, 0, 1, 0]], np.float32), (2, 1)))
+    grid = nd.GridGenerator(theta, target_shape=(4, 4))
+    out = nd.BilinearSampler(nd.array(data), grid).asnumpy()
+    np.testing.assert_allclose(out, data, atol=1e-5)
+    # zoomed-out 2x grid: corners sample outside [-1,1] → exact zeros
+    # (MXNet zero-pads out-of-boundary samples; edge-clamping would repeat borders)
+    theta2 = nd.array(np.tile(np.array([[2, 0, 0, 0, 2, 0]], np.float32), (2, 1)))
+    grid2 = nd.GridGenerator(theta2, target_shape=(4, 4))
+    out2 = nd.BilinearSampler(nd.array(data + 1.0), grid2).asnumpy()
+    assert out2[0, 0, 0, 0] == 0.0 and out2[0, 0, -1, -1] == 0.0
+    assert out2[0, 0, 1, 1] > 0.0
+
+
+def test_spatial_transformer_identity():
+    data = np.random.RandomState(0).randn(1, 3, 6, 6).astype(np.float32)
+    loc = nd.array(np.array([[1, 0, 0, 0, 1, 0]], np.float32))
+    out = nd.SpatialTransformer(nd.array(data), loc, target_shape=(6, 6)).asnumpy()
+    np.testing.assert_allclose(out, data, atol=1e-5)
+
+
+def test_image_record_dataset_lazy(tmp_path):
+    from mxnet_tpu import recordio
+    from mxnet_tpu.gluon.data.vision.datasets import ImageRecordDataset
+
+    path = str(tmp_path / "imgs.rec")
+    rec = recordio.MXRecordIO(path, "w")
+    rng = np.random.RandomState(0)
+    imgs = [rng.randint(0, 255, (8, 8, 3), np.uint8) for _ in range(4)]
+    for i, im in enumerate(imgs):
+        rec.write(recordio.pack_img(recordio.IRHeader(0, float(i), i, 0), im,
+                                    img_fmt=".png"))
+    rec.close()
+
+    ds = ImageRecordDataset(path)
+    assert len(ds) == 4
+    # random access works and payloads are not pre-buffered
+    assert not hasattr(ds, "_records")
+    img, label = ds[2]
+    assert label == 2.0
+    np.testing.assert_array_equal(np.asarray(img), imgs[2])
+    img0, label0 = ds[0]
+    assert label0 == 0.0

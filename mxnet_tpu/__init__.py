@@ -38,6 +38,7 @@ from . import amp
 from . import checkpoint
 from . import parallel
 from . import module
+from . import operator
 from . import sparse
 from . import quantization
 from . import linalg

@@ -122,21 +122,10 @@ class ImageRecordDataset(Dataset):
     present; otherwise scans the framing once to build offsets in memory."""
 
     def __init__(self, filename, flag=1, transform=None):
-        from ....recordio import IndexedRecordIO, MXRecordIO, unpack_img
+        from ....recordio import MXRecordIO, load_offsets, unpack_img
 
-        idx_path = filename[:filename.rfind(".")] + ".idx"
         self._rec = MXRecordIO(filename, "r")
-        if os.path.exists(idx_path):
-            idx = IndexedRecordIO(idx_path, filename, "r")
-            self._offsets = [idx.idx[k] for k in idx.keys]
-            idx.close()
-        else:
-            self._offsets = []
-            while True:
-                pos = self._rec.tell()
-                if self._rec.read() is None:
-                    break
-                self._offsets.append(pos)
+        self._offsets = load_offsets(self._rec)
         self._flag = flag
         self._transform = transform
         self._unpack_img = unpack_img
@@ -145,8 +134,8 @@ class ImageRecordDataset(Dataset):
         return len(self._offsets)
 
     def __getitem__(self, idx):
-        self._rec._f.seek(self._offsets[idx])
-        header, img = self._unpack_img(self._rec.read(), iscolor=self._flag)
+        header, img = self._unpack_img(self._rec.read_at(self._offsets[idx]),
+                                       iscolor=self._flag)
         label = header.label
         if self._transform is not None:
             return self._transform(img, label)

@@ -163,22 +163,17 @@ class ImageRecordIter(DataIter):
     def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
                  shuffle=False, rand_crop=False, rand_mirror=False, mean_r=0.0,
                  mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0,
-                 resize=0, **kwargs):
+                 resize=0, path_imgidx=None, **kwargs):
         super().__init__(batch_size)
-        from .recordio import MXRecordIO, unpack
+        from .recordio import MXRecordIO, load_offsets, unpack
 
-        self._records = []
-        rec = MXRecordIO(path_imgrec, "r")
-        while True:
-            buf = rec.read()
-            if buf is None:
-                break
-            self._records.append(buf)
-        rec.close()
+        # lazy by byte offset: multi-GB .rec files never load into host memory
+        self._rec = MXRecordIO(path_imgrec, "r")
+        self._offsets = load_offsets(self._rec, path_imgidx)
         self._unpack = unpack
         self._shape = data_shape
         self._shuffle = shuffle
-        self._order = np.arange(len(self._records))
+        self._order = np.arange(len(self._offsets))
         from .image import CreateAugmenter
 
         self._augs = CreateAugmenter(data_shape, resize=resize, rand_crop=rand_crop,
@@ -193,7 +188,7 @@ class ImageRecordIter(DataIter):
         self._cursor = 0
 
     def iter_next(self):
-        return self._cursor + self.batch_size <= len(self._records)
+        return self._cursor + self.batch_size <= len(self._offsets)
 
     def next(self):
         if not self.iter_next():
@@ -202,7 +197,7 @@ class ImageRecordIter(DataIter):
 
         datas, labels = [], []
         for i in self._order[self._cursor:self._cursor + self.batch_size]:
-            header, img_bytes = self._unpack(self._records[i])
+            header, img_bytes = self._unpack(self._rec.read_at(self._offsets[i]))
             img = imdecode(img_bytes)
             for aug in self._augs:
                 img = aug(img)

@@ -13,6 +13,7 @@ from ..ndarray import (NDArray, array, zeros, ones, full, empty, arange,  # noqa
                        linspace, eye, concat, stack, waitall, invoke)
 from . import random  # noqa: F401
 from . import contrib  # noqa: F401
+from ..operator import Custom  # noqa: F401  (ref: src/operator/custom/custom.cc)
 
 _mod = _sys.modules[__name__]
 

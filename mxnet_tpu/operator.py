@@ -1,0 +1,260 @@
+"""User-defined operators (CustomOp) — MXNet parity + TPU-native paths.
+
+TPU-native equivalent of MXNet's custom operator machinery (ref:
+python/mxnet/operator.py CustomOp/CustomOpProp/register,
+src/operator/custom/custom.cc). Three tiers, fastest first:
+
+1. ``register_jax_op(name, fn, vjp=...)`` — the native path: a pure
+   jax function (optionally with an analytic ``jax.custom_vjp``) registered
+   into the shared op registry, so it appears as ``nd.<name>`` AND fuses into
+   hybridized/jitted programs like any built-in op. This is what MXNet users
+   porting a CUDA custom op should use.
+2. ``CustomOp``/``CustomOpProp``/``register`` + ``nd.Custom`` — API-parity
+   tier: host Python forward/backward over NDArrays, dispatched eagerly and
+   recorded on the autograd tape. Matches MXNet semantics (req write/add,
+   infer_shape/infer_type, need_top_grad).
+3. ``as_jax_fn(op_type)`` — escape hatch embedding tier-2 ops inside traced
+   code via ``jax.pure_callback`` (host roundtrip each call; correctness tool,
+   not a perf path — same caveat as MXNet's warning that Custom breaks fusion).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import register_op
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get", "Custom",
+           "register_jax_op", "as_jax_fn"]
+
+
+class CustomOp:
+    """Base class for user ops (ref: python/mxnet/operator.py:CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Write ``src`` into ``dst`` honoring the write/add/null request."""
+        if req == "null":
+            return
+        from .ndarray import NDArray
+
+        s = src._data if isinstance(src, NDArray) else jnp.asarray(src)
+        if req == "add":
+            dst._data = dst._data + s.astype(dst.dtype)
+        else:  # 'write' / 'inplace'
+            dst._data = s.astype(dst.dtype).reshape(dst.shape)
+
+
+class CustomOpProp:
+    """Op metadata: arity, shapes, types (ref: CustomOpProp upstream)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        t = in_type[0] if in_type else np.float32
+        return in_type, [t] * len(self.list_outputs()), \
+            [t] * len(self.list_auxiliary_states())
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+_CUSTOM_REGISTRY = {}
+
+
+def register(op_type):
+    """Decorator registering a CustomOpProp subclass under ``op_type``
+    (ref: python/mxnet/operator.py:register)."""
+
+    def deco(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise TypeError("register() expects a CustomOpProp subclass")
+        _CUSTOM_REGISTRY[op_type] = prop_cls
+        return prop_cls
+
+    return deco
+
+
+def get(op_type):
+    try:
+        return _CUSTOM_REGISTRY[op_type]
+    except KeyError:
+        raise ValueError("custom op %r is not registered" % (op_type,))
+
+
+def _build(op_type, in_shapes, in_dtypes, kwargs):
+    prop = get(op_type)(**kwargs)
+    n_out = len(prop.list_outputs())
+    n_aux = len(prop.list_auxiliary_states())
+    _, out_shapes, aux_shapes = prop.infer_shape([list(s) for s in in_shapes])
+    _, out_dtypes, aux_dtypes = prop.infer_type(list(in_dtypes))
+    op = prop.create_operator(None, in_shapes, in_dtypes)
+    return (prop, op, [tuple(s) for s in out_shapes[:n_out]], out_dtypes[:n_out],
+            [tuple(s) for s in aux_shapes[:n_aux]], aux_dtypes[:n_aux])
+
+
+def _alloc(shapes, dtypes):
+    from .ndarray import NDArray
+
+    return [NDArray(jnp.zeros(s, d)) for s, d in zip(shapes, dtypes)]
+
+
+def Custom(*data, op_type=None, **kwargs):
+    """Imperative entry point, exposed as ``nd.Custom`` (ref:
+    src/operator/custom/custom.cc registration of op "Custom")."""
+    if op_type is None:
+        raise ValueError("Custom(...) requires op_type=")
+    from . import autograd
+    from .ndarray import NDArray
+
+    in_data = [x if isinstance(x, NDArray) else NDArray(jnp.asarray(x)) for x in data]
+    prop, op, out_shapes, out_dtypes, aux_shapes, aux_dtypes = _build(
+        op_type, [x.shape for x in in_data], [x.dtype for x in in_data], kwargs)
+
+    out_data = _alloc(out_shapes, out_dtypes)
+    aux = _alloc(aux_shapes, aux_dtypes)
+    op.forward(autograd.is_training(), ["write"] * len(out_data), in_data, out_data, aux)
+
+    if autograd.is_recording():
+        n_in = len(in_data)
+
+        def vjp_fn(cots):
+            if not isinstance(cots, tuple):
+                cots = (cots,)
+            # need_top_grad=False ops (loss-style) compute grads without the
+            # head cotangent, as in the reference's CustomOpProp contract
+            out_grad = [NDArray(c) for c in cots] if prop.need_top_grad_ else []
+            in_grad = [NDArray(jnp.zeros(x.shape, x.dtype)) for x in in_data]
+            op.backward(["write"] * n_in, out_grad, in_data, out_data, in_grad, aux)
+            return tuple(g._data for g in in_grad)
+
+        autograd.append_node(autograd.TapeNode(list(in_data), list(out_data), vjp_fn))
+
+    return out_data[0] if len(out_data) == 1 else out_data
+
+
+# ------------------------------------------------------------------ tier 1
+
+
+def register_jax_op(name, fn, vjp=None, fwd=None, **reg_kwargs):
+    """Register a pure-jax function as a first-class op in both front-ends.
+
+    ``fn(*arrays, **static)`` must be pure/jit-able. If ``vjp`` is given it is
+    ``vjp(residuals, cotangent) -> tuple(input_cots)``; ``fwd`` (default: run
+    ``fn`` and keep the primal inputs as residuals) is
+    ``fwd(*arrays) -> (out, residuals)``. The op lands in OP_REGISTRY so it is
+    available as ``nd.<name>``, records on the tape, and inlines into
+    hybridized XLA programs — the TPU-native replacement for writing a CUDA
+    kernel + CustomOpProp pair in the reference.
+    """
+    if vjp is not None:
+        f = jax.custom_vjp(fn)
+        f_fwd = fwd if fwd is not None else (lambda *xs: (fn(*xs), xs))
+        f.defvjp(f_fwd, vjp)
+        functools.update_wrapper(f, fn)
+        target = f
+    else:
+        target = fn
+    register_op(name, **reg_kwargs)(target)
+    return target
+
+
+# ------------------------------------------------------------------ tier 3
+
+
+def as_jax_fn(op_type, **kwargs):
+    """Wrap a registered tier-2 CustomOp as a traceable jax function via
+    ``jax.pure_callback`` (forward AND backward host roundtrips). Use only
+    when the op genuinely needs host Python (I/O, external libs)."""
+    from .ndarray import NDArray
+
+    def run_forward(np_inputs):
+        in_data = [NDArray(jnp.asarray(a)) for a in np_inputs]
+        prop, op, out_shapes, out_dtypes, aux_shapes, aux_dtypes = _build(
+            op_type, [a.shape for a in np_inputs], [a.dtype for a in np_inputs], kwargs)
+        out_data = _alloc(out_shapes, out_dtypes)
+        aux = _alloc(aux_shapes, aux_dtypes)
+        op.forward(False, ["write"] * len(out_data), in_data, out_data, aux)
+        return tuple(np.asarray(o._data) for o in out_data + aux)
+
+    def run_backward(np_cots, np_inputs, np_outputs, np_aux):
+        # the primal pass's outputs AND aux states ride along as residuals —
+        # backward never re-runs forward, so stateful/nondeterministic ops
+        # (dropout-style) see exactly what forward produced
+        in_data = [NDArray(jnp.asarray(a)) for a in np_inputs]
+        prop, op, _, _, _, _ = _build(
+            op_type, [a.shape for a in np_inputs], [a.dtype for a in np_inputs], kwargs)
+        out_data = [NDArray(jnp.asarray(o)) for o in np_outputs]
+        aux = [NDArray(jnp.asarray(a)) for a in np_aux]
+        out_grad = ([NDArray(jnp.asarray(c)) for c in np_cots]
+                    if prop.need_top_grad_ else [])
+        in_grad = [NDArray(jnp.zeros(a.shape, a.dtype)) for a in np_inputs]
+        op.backward(["write"] * len(in_data), out_grad, in_data, out_data, in_grad, aux)
+        return tuple(np.asarray(g._data) for g in in_grad)
+
+    def _result_shapes(xs):
+        _, _, out_shapes, out_dtypes, aux_shapes, aux_dtypes = _build(
+            op_type, [x.shape for x in xs], [x.dtype for x in xs], kwargs)
+        n_out = len(out_shapes)
+        shapes = tuple(jax.ShapeDtypeStruct(s, d) for s, d in
+                       zip(out_shapes + aux_shapes, out_dtypes + aux_dtypes))
+        return shapes, n_out
+
+    @jax.custom_vjp
+    def f(*xs):
+        shapes, n_out = _result_shapes(xs)
+        res = jax.pure_callback(lambda *a: run_forward(a), shapes, *xs,
+                                vmap_method="sequential")
+        outs = res[:n_out]
+        return outs[0] if len(outs) == 1 else outs
+
+    def f_fwd(*xs):
+        shapes, n_out = _result_shapes(xs)
+        res = jax.pure_callback(lambda *a: run_forward(a), shapes, *xs,
+                                vmap_method="sequential")
+        outs, auxs = res[:n_out], res[n_out:]
+        return (outs[0] if len(outs) == 1 else outs), (xs, outs, auxs)
+
+    def f_bwd(res, cots):
+        xs, outs, auxs = res
+        if not isinstance(cots, tuple):
+            cots = (cots,)
+        shapes = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype) for x in xs)
+        n_c, n_x, n_o = len(cots), len(xs), len(outs)
+        grads = jax.pure_callback(
+            lambda *a: run_backward(a[:n_c], a[n_c:n_c + n_x],
+                                    a[n_c + n_x:n_c + n_x + n_o], a[n_c + n_x + n_o:]),
+            shapes, *cots, *xs, *outs, *auxs, vmap_method="sequential")
+        return tuple(grads)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f

@@ -11,6 +11,7 @@ from __future__ import annotations
 import ctypes
 import os
 import struct
+import threading
 
 import numpy as np
 
@@ -38,6 +39,7 @@ class MXRecordIO:
             self.writable = False
         else:
             raise ValueError("flag must be 'r' or 'w'")
+        self._lock = threading.Lock()
         self._closed = False
 
     def close(self):
@@ -75,6 +77,34 @@ class MXRecordIO:
         self._f.read(_pad(length))
         return buf
 
+    def read_at(self, offset):
+        """Atomically seek+read one record at ``offset`` — safe under
+        concurrent consumers (DataLoader prefetch threads share the handle)."""
+        with self._lock:
+            self._f.seek(offset)
+            return self.read()
+
+    def scan_offsets(self):
+        """Byte offset of every record, scanning only the 8-byte headers —
+        the lazy-index fallback when no .idx file exists (multi-GB .rec files
+        never load into host memory)."""
+        assert not self.writable
+        offsets = []
+        with self._lock:
+            saved = self._f.tell()
+            self._f.seek(0)
+            while True:
+                pos = self._f.tell()
+                header = self._f.read(8)
+                if len(header) < 8:
+                    break
+                magic, length = struct.unpack("<II", header)
+                assert magic == _MAGIC, "corrupt record file %s" % self.uri
+                offsets.append(pos)
+                self._f.seek(length + _pad(length), 1)
+            self._f.seek(saved)
+        return offsets
+
 
 class MXIndexedRecordIO(MXRecordIO):
     """(ref: recordio.py:MXIndexedRecordIO); .idx maps key → byte offset."""
@@ -86,13 +116,7 @@ class MXIndexedRecordIO(MXRecordIO):
         self.key_type = key_type
         super().__init__(uri, flag)
         if flag == "r" and os.path.exists(idx_path):
-            with open(idx_path) as f:
-                for line in f:
-                    parts = line.strip().split("\t")
-                    if len(parts) >= 2:
-                        key = key_type(parts[0])
-                        self.idx[key] = int(parts[1])
-                        self.keys.append(key)
+            self.keys, self.idx = _parse_idx(idx_path, key_type)
 
     def close(self):
         if self.writable and not getattr(self, "_closed", True):
@@ -116,6 +140,32 @@ class MXIndexedRecordIO(MXRecordIO):
 
 
 IndexedRecordIO = MXIndexedRecordIO
+
+
+def _parse_idx(idx_path, key_type=int):
+    """Parse a .idx text file → (keys, {key: offset}); skips malformed lines
+    the same way MXIndexedRecordIO does."""
+    idx, keys = {}, []
+    with open(idx_path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) >= 2:
+                key = key_type(parts[0])
+                idx[key] = int(parts[1])
+                keys.append(key)
+    return keys, idx
+
+
+def load_offsets(rec, idx_path=None):
+    """Record byte offsets for an open read-mode MXRecordIO: the .idx file
+    (given, or derived from the .rec path) when present, else a header-only
+    scan. Shared by ImageRecordDataset and io.ImageRecordIter."""
+    if idx_path is None:
+        idx_path = os.path.splitext(rec.uri)[0] + ".idx"
+    if os.path.exists(idx_path):
+        keys, idx = _parse_idx(idx_path)
+        return [idx[k] for k in keys]
+    return rec.scan_offsets()
 
 
 # ------------------------------------------------------------ IRHeader pack

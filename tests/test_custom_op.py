@@ -1,0 +1,158 @@
+"""CustomOp user-op API (ref: tests/python/unittest/test_operator.py:test_custom_op)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.operator import (CustomOp, CustomOpProp, register, register_jax_op,
+                                as_jax_fn)
+
+
+class _Sigmoid(CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0]
+        self.assign(out_data[0], req[0], 1.0 / (1.0 + nd.exp(-x)))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0]
+        self.assign(in_grad[0], req[0], out_grad[0] * y * (1.0 - y))
+
+
+@register("t_sigmoid")
+class _SigmoidProp(CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return _Sigmoid()
+
+
+def test_custom_forward_backward():
+    x = nd.array(np.random.RandomState(0).randn(3, 4).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="t_sigmoid")
+        loss = y.sum()
+    loss.backward()
+    ref = 1.0 / (1.0 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(y.asnumpy(), ref, rtol=1e-5)
+    np.testing.assert_allclose(x.grad.asnumpy(), ref * (1 - ref), rtol=1e-5)
+
+
+class _TwoOut(CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0] * 2.0)
+        self.assign(out_data[1], req[1], in_data[0] + in_data[1])
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], out_grad[0] * 2.0 + out_grad[1])
+        self.assign(in_grad[1], req[1], out_grad[1])
+
+
+@register("t_twoout")
+class _TwoOutProp(CustomOpProp):
+    def list_arguments(self):
+        return ["a", "b"]
+
+    def list_outputs(self):
+        return ["double", "sum"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0], in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return _TwoOut()
+
+
+def test_custom_multi_output():
+    a = nd.array([[1.0, 2.0]])
+    b = nd.array([[10.0, 20.0]])
+    a.attach_grad(); b.attach_grad()
+    with autograd.record():
+        d, s = nd.Custom(a, b, op_type="t_twoout")
+        loss = (d + 3 * s).sum()
+    loss.backward()
+    np.testing.assert_allclose(d.asnumpy(), [[2.0, 4.0]])
+    np.testing.assert_allclose(s.asnumpy(), [[11.0, 22.0]])
+    np.testing.assert_allclose(a.grad.asnumpy(), [[5.0, 5.0]])  # 2*1 + 3
+    np.testing.assert_allclose(b.grad.asnumpy(), [[3.0, 3.0]])
+
+
+def test_register_jax_op_custom_vjp():
+    # straight-through clip: forward clips, gradient passes through
+    register_jax_op(
+        "st_clip",
+        lambda x: jnp.clip(x, -1.0, 1.0),
+        fwd=lambda x: (jnp.clip(x, -1.0, 1.0), None),
+        vjp=lambda res, g: (g,),
+    )
+    x = nd.array([-2.0, 0.5, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.st_clip(x)
+        y.sum().backward()
+    np.testing.assert_allclose(y.asnumpy(), [-1.0, 0.5, 1.0])
+    np.testing.assert_allclose(x.grad.asnumpy(), [1.0, 1.0, 1.0])  # straight-through
+
+
+_FWD_CALLS = {"n": 0}
+
+
+class _CountingSquare(CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        _FWD_CALLS["n"] += 1
+        aux[0][:] = in_data[0]  # stash input in aux state
+        self.assign(out_data[0], req[0], in_data[0] * in_data[0])
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        assert out_grad == []  # need_top_grad=False: no head cotangent passed
+        self.assign(in_grad[0], req[0], 2.0 * aux[0])
+
+
+@register("t_sq_noTop")
+class _CountingSquareProp(CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=False)
+
+    def list_auxiliary_states(self):
+        return ["stash"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], [in_shape[0]]
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return _CountingSquare()
+
+
+def test_custom_aux_and_need_top_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="t_sq_noTop")
+        y.sum().backward()
+    np.testing.assert_allclose(y.asnumpy(), [1.0, 4.0, 9.0])
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0, 4.0, 6.0])
+
+
+def test_as_jax_fn_no_forward_rerun_in_backward():
+    f = as_jax_fn("t_sq_noTop")
+    x = jnp.array([2.0, 3.0], jnp.float32)
+    _FWD_CALLS["n"] = 0
+    g = jax.grad(lambda v: f(v).sum())(x)
+    np.testing.assert_allclose(np.asarray(g), [4.0, 6.0])
+    assert _FWD_CALLS["n"] == 1, "backward must reuse primal outputs, not re-run forward"
+
+
+def test_as_jax_fn_inside_jit():
+    f = as_jax_fn("t_sigmoid")
+    x = jnp.array([0.0, 1.0, -1.0], jnp.float32)
+
+    @jax.jit
+    def loss(x):
+        return f(x).sum()
+
+    ref = 1.0 / (1.0 + np.exp(-np.asarray(x)))
+    np.testing.assert_allclose(np.asarray(loss(x)), ref.sum(), rtol=1e-5)
+    g = jax.jit(jax.grad(loss))(x)
+    np.testing.assert_allclose(np.asarray(g), ref * (1 - ref), rtol=1e-5)

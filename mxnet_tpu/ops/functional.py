@@ -739,6 +739,18 @@ def log_softmax(x, *, axis=-1):
 
 @register_op("softmax_cross_entropy")
 def softmax_cross_entropy(logits, labels):
+    """(ref: src/operator/loss_binary_op.cc). On TPU at MXU-aligned vocab
+    widths the fused pallas kernel (ops/pallas/softmax_xent.py) computes the
+    row NLLs in one HBM pass of the logits instead of three."""
+    # deterministic gate: a trace-time try/except cannot catch Mosaic
+    # compile failures (they surface at jit-compile time), so the fused path
+    # is taken only for configurations the kernel handles by construction
+    # (2-D, lane-aligned V; rows-per-block is VMEM-capped inside the kernel)
+    if (jax.default_backend() == "tpu" and logits.ndim == 2
+            and logits.shape[-1] % 128 == 0):
+        from .pallas.softmax_xent import softmax_xent as _fused
+
+        return jnp.sum(_fused(logits, labels))
     lp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(lp, labels.astype(jnp.int32)[:, None], axis=-1)
     return jnp.sum(nll)

@@ -6,7 +6,8 @@ from .data_parallel import (build_train_step, tree_optimizer_step,  # noqa: F401
 from . import tensor_parallel  # noqa: F401
 from .tensor_parallel import shard_params, param_specs, constrain  # noqa: F401
 from .ring_attention import ring_attention, full_attention  # noqa: F401
-from .pipeline import pipeline_apply, stack_stage_params  # noqa: F401
+from .pipeline import (pipeline_apply, pipeline_train_step_1f1b,  # noqa: F401
+                       stack_stage_params)
 from .expert_parallel import moe_ffn  # noqa: F401
 from .resilience import Heartbeat, ResumableLoop  # noqa: F401
 from . import distributed  # noqa: F401

@@ -75,3 +75,108 @@ def stack_stage_params(per_stage_params):
     """list of per-stage pytrees (same structure/shapes) → stacked pytree with
     leading stage dim, ready to shard over 'pp'."""
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def pipeline_train_step_1f1b(stage_fn, loss_fn, stage_params, microbatches,
+                             targets, mesh, axis_name="pp"):
+    """One-forward-one-backward (PipeDream-flush) pipelined training step.
+
+    Unlike the GPipe schedule above (all forwards, then differentiate through
+    the whole scan — activations for every microbatch live simultaneously),
+    1F1B starts each microbatch's backward as soon as the last stage finishes
+    its forward, so a stage stashes at most ``n_stages`` activations
+    regardless of microbatch count. The reference has no pipeline engine
+    (MXNet model-parallel was manual ctx placement); this is the schedule its
+    large-model users got from DeepSpeed/PipeDream, rebuilt SPMD-style: a
+    global tick clock where every tick has an F-slot (activations ride a
+    +1 ``ppermute`` ring) and a B-slot (cotangents ride a -1 ring), stage 0
+    throttling injection to keep ≤ n_stages microbatches in flight.
+
+    stage_fn(params, x) -> y with y.shape == x.shape (uniform stages);
+    loss_fn(y, target) -> scalar (per-microbatch mean).
+    stage_params: leaves (n_stages, ...) sharded over `axis_name`.
+    microbatches: (n_micro, mb, ...); targets: (n_micro, ...) replicated.
+    Returns (loss, grads) — loss the scalar mean over microbatches, grads
+    stacked (n_stages, ...) like stage_params.
+    """
+    sm = get_shard_map()
+    n_micro = microbatches.shape[0]
+
+    def local(params, xs, tgts):
+        params = jax.tree_util.tree_map(lambda a: a[0], params)
+        n_stages = lax.psum(1, axis_name)
+        stage = lax.axis_index(axis_name)
+        last = stage == n_stages - 1
+        K = int(mesh.shape[axis_name]) + 2  # stash ring capacity (static)
+        ticks = n_micro + 3 * int(mesh.shape[axis_name]) + 3
+        perm_f = [(j, (j + 1) % int(mesh.shape[axis_name]))
+                  for j in range(int(mesh.shape[axis_name]))]
+        perm_b = [(j, (j - 1) % int(mesh.shape[axis_name]))
+                  for j in range(int(mesh.shape[axis_name]))]
+
+        xshape = xs.shape[1:]
+        zero_x = jnp.zeros(xshape, xs.dtype)
+        zero_g = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+        def tick(carry, _):
+            (fx, f_mb, gx, b_mb, stash_x, head, count,
+             n_inj, n_done, loss_sum, gparams) = carry
+
+            # ---- F-slot -------------------------------------------------
+            inject_ok = (stage == 0) & (n_inj < n_micro) & (n_inj - n_done < n_stages)
+            f_valid = jnp.where(stage == 0, inject_ok, f_mb >= 0)
+            mbi = jnp.where(stage == 0, jnp.minimum(n_inj, n_micro - 1),
+                            jnp.maximum(f_mb, 0))
+            x_in = jnp.where(stage == 0, xs[mbi], fx)
+            pos = jnp.mod(head, K)
+            stash_x = jnp.where(f_valid,
+                                lax.dynamic_update_index_in_dim(stash_x, x_in, pos, 0),
+                                stash_x)
+            head = head + f_valid
+            count = count + f_valid
+            n_inj = n_inj + inject_ok
+
+            y = stage_fn(params, x_in)
+            send_mb = jnp.where(f_valid & (stage < n_stages - 1), mbi, -1)
+            fx_next, f_mb_next = lax.ppermute((y, send_mb), axis_name, perm_f)
+
+            # ---- B-slot -------------------------------------------------
+            b_valid = jnp.where(last, f_valid, b_mb >= 0)
+            b_idx = jnp.where(last, mbi, jnp.maximum(b_mb, 0))
+            pop_pos = jnp.mod(head - count, K)
+            x_old = stash_x[pop_pos]
+            count = count - b_valid
+
+            y2, pull = jax.vjp(stage_fn, params, x_old)
+            tgt = tgts[b_idx]
+            loss_val, loss_pull = jax.vjp(lambda yy: loss_fn(yy, tgt), y2)
+            seed = loss_pull(jnp.asarray(1.0 / n_micro, loss_val.dtype))[0]
+            gy = jnp.where(last, seed.astype(gx.dtype), gx)
+            dparams, dx = pull(gy.astype(y2.dtype))
+
+            mask = b_valid.astype(loss_sum.dtype)
+            loss_sum = loss_sum + jnp.where(last & b_valid, loss_val, 0.0)
+            gparams = jax.tree_util.tree_map(
+                lambda acc, d: acc + d * mask.astype(d.dtype), gparams, dparams)
+            n_done = n_done + b_valid
+
+            send_b = jnp.where(b_valid & (stage > 0), b_idx, -1)
+            gx_next, b_mb_next = lax.ppermute((dx, send_b), axis_name, perm_b)
+
+            return (fx_next, f_mb_next, gx_next, b_mb_next, stash_x,
+                    head, count, n_inj, n_done, loss_sum, gparams), None
+
+        init = (zero_x, jnp.int32(-1), zero_x, jnp.int32(-1),
+                jnp.zeros((K,) + xshape, xs.dtype),
+                jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0),
+                jnp.float32(0.0), zero_g)
+        carry, _ = lax.scan(tick, init, None, length=ticks)
+        loss_sum, gparams = carry[-2], carry[-1]
+        loss = lax.psum(loss_sum, axis_name) / n_micro
+        gparams = jax.tree_util.tree_map(lambda g: g[None], gparams)
+        return loss, gparams
+
+    pspec = jax.tree_util.tree_map(lambda _: P(axis_name), stage_params,
+                                   is_leaf=lambda a: hasattr(a, "shape"))
+    f = sm(local, mesh, in_specs=(pspec, P(), P()), out_specs=(P(), pspec))
+    return f(stage_params, microbatches, targets)

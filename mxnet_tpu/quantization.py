@@ -18,7 +18,8 @@ from .gluon.block import HybridBlock
 from .ndarray import NDArray
 
 __all__ = ["quantize", "dequantize", "quantized_fully_connected",
-           "QuantizedDense", "quantize_model"]
+           "quantized_conv", "QuantizedDense", "QuantizedConv2D",
+           "quantize_model"]
 
 
 @register_op("contrib_quantize", nondiff=True)
@@ -54,6 +55,33 @@ def quantized_fully_connected(x, qweight, w_scale, bias=None):
     return y
 
 
+@register_op("quantized_conv", nondiff=True)
+def quantized_conv(x, qweight, w_scale, bias=None, *, stride=1, pad=0, dilate=1,
+                   num_group=1):
+    """int8 convolution (ref: src/operator/quantization/quantized_conv.cc —
+    the cuDNN int8x4 path). Dynamic per-tensor int8 activations ×
+    per-output-channel int8 weights, int32 accumulation on the MXU, fp32
+    rescale. qweight: (O, I, *K) int8; w_scale: (O, 1, 1, ...) fp32."""
+    from .ops.functional import _pair
+
+    nd = x.ndim - 2
+    stride, pad, dilate = _pair(stride, nd), _pair(pad, nd), _pair(dilate, nd)
+    qx, x_scale = quantize(x)
+    spatial = "DHW"[-nd:]
+    lhs = "NC" + spatial
+    dn = jax.lax.conv_dimension_numbers(x.shape, qweight.shape,
+                                        (lhs, "OI" + spatial, lhs))
+    acc = jax.lax.conv_general_dilated(
+        qx, qweight, window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn, feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    oscale = (x_scale * w_scale.reshape(-1)).reshape((1, -1) + (1,) * nd)
+    y = acc.astype(jnp.float32) * oscale
+    if bias is not None:
+        y = y + bias.reshape((1, -1) + (1,) * nd)
+    return y
+
+
 class QuantizedDense(HybridBlock):
     """Inference-only Dense with pre-quantized int8 weights."""
 
@@ -76,13 +104,44 @@ class QuantizedDense(HybridBlock):
         return y
 
 
+class QuantizedConv2D(HybridBlock):
+    """Inference-only Conv2D with pre-quantized per-output-channel int8
+    weights (ref: quantized_conv.cc). Grouped convs keep the same layout."""
+
+    def __init__(self, conv, **kwargs):
+        super().__init__(prefix=conv.prefix, **kwargs)
+        w = conv.weight.data()._data.astype(jnp.float32)
+        qw, ws = quantize(w, axis=0)
+        self._qw = jnp.asarray(qw)
+        self._ws = jnp.asarray(ws)
+        self._bias = (conv.bias.data()._data.astype(jnp.float32)
+                      if getattr(conv, "bias", None) is not None else None)
+        k = conv._kwargs
+        self._conv_kw = dict(stride=k["stride"], pad=k["pad"], dilate=k["dilate"],
+                             num_group=k["num_group"])
+        self._act = conv.act
+
+    def hybrid_forward(self, F, x):
+        y = F.quantized_conv(x, self._qw, self._ws, self._bias, **self._conv_kw)
+        if self._act is not None:
+            y = self._act(y)
+        return y
+
+
 def quantize_model(block, exclude=()):
-    """Replace Dense children with QuantizedDense (in place), skipping names
-    matching any substring in `exclude` (ref: contrib/quantization.py:
-    quantize_model)."""
+    """Replace Dense/Conv2D children with their int8 twins (in place),
+    skipping names matching any substring in `exclude` (ref:
+    contrib/quantization.py:quantize_model)."""
+    from .gluon.nn.conv_layers import Conv2D
+
     for name, child in list(block._children.items()):
-        if isinstance(child, nn.Dense) and not any(e in child.prefix for e in exclude):
-            q = QuantizedDense(child)
+        q = None
+        if not any(e in child.prefix for e in exclude):
+            if isinstance(child, nn.Dense):
+                q = QuantizedDense(child)
+            elif type(child) is Conv2D:
+                q = QuantizedConv2D(child)
+        if q is not None:
             block._children[name] = q
             if hasattr(block, name):
                 object.__setattr__(block, name, q)

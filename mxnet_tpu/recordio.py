@@ -28,6 +28,9 @@ class MXRecordIO:
     def __init__(self, uri, flag):
         self.uri = uri
         self.flag = flag
+        # one lock for the object's lifetime — reset() must not swap it out
+        # from under threads blocked in read_at
+        self._lock = threading.Lock()
         self.open()
 
     def open(self):
@@ -39,7 +42,6 @@ class MXRecordIO:
             self.writable = False
         else:
             raise ValueError("flag must be 'r' or 'w'")
-        self._lock = threading.Lock()
         self._closed = False
 
     def close(self):

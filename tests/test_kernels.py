@@ -81,3 +81,38 @@ def test_ctc_gluon_block_and_grad():
     loss.backward()
     g = pred.grad.asnumpy()
     assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_fused_softmax_xent_interpret_and_grad():
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas.softmax_xent import softmax_xent
+
+    rng = np.random.RandomState(3)
+    N, V = 16, 256
+    logits = jnp.asarray(rng.randn(N, V).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, V, N).astype(np.int32))
+
+    loss = softmax_xent(logits, labels, True)  # interpret mode
+    lp = jax.nn.log_softmax(logits)
+    ref = -np.asarray(lp)[np.arange(N), np.asarray(labels)]
+    np.testing.assert_allclose(np.asarray(loss), ref, rtol=1e-5)
+
+    g = jax.grad(lambda lg: softmax_xent(lg, labels, True).sum())(logits)
+    g_ref = jax.grad(lambda lg: -jnp.take_along_axis(
+        jax.nn.log_softmax(lg), labels[:, None], axis=-1).sum())(logits)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=1e-5)
+
+
+def test_fused_softmax_xent_bf16_logits():
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas.softmax_xent import softmax_xent
+
+    rng = np.random.RandomState(4)
+    logits = jnp.asarray(rng.randn(8, 128).astype(np.float32)).astype(jnp.bfloat16)
+    labels = jnp.asarray(rng.randint(0, 128, 8).astype(np.int32))
+    loss = softmax_xent(logits, labels, True)
+    ref = -jax.nn.log_softmax(logits.astype(jnp.float32))[
+        jnp.arange(8), labels]
+    assert np.abs(np.asarray(loss) - np.asarray(ref)).max() < 0.05

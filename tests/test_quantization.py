@@ -17,3 +17,35 @@ def test_quantized_dense_close_to_fp32():
     # int8 dynamic quantization: relative error within a few percent
     denom = np.abs(ref).max() + 1e-6
     assert np.abs(out - ref).max() / denom < 0.1
+
+
+def test_quantized_conv_close_to_fp32():
+    from mxnet_tpu import gluon, nd
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 3, padding=1, in_channels=3, activation="relu"),
+            gluon.nn.Conv2D(4, 1, in_channels=8))
+    net.initialize()
+    x = nd.array(np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32))
+    ref = net(x).asnumpy()
+    quantize_model(net)
+    out = net(x).asnumpy()
+    denom = np.abs(ref).max() + 1e-6
+    assert np.abs(out - ref).max() / denom < 0.1
+
+
+def test_quantized_conv_grouped_strided():
+    from mxnet_tpu.quantization import quantize, quantized_conv
+    import jax.numpy as jnp
+    from mxnet_tpu.ops import functional as F
+
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 4, 9, 9).astype(np.float32))
+    w = jnp.asarray(rng.randn(8, 2, 3, 3).astype(np.float32))  # groups=2
+    ref = np.asarray(F.Convolution(x, w, None, kernel=(3, 3), stride=2, pad=1,
+                                   num_group=2, no_bias=True))
+    qw, ws = quantize(w, axis=0)
+    out = np.asarray(quantized_conv(x, qw, ws, stride=2, pad=1, num_group=2))
+    denom = np.abs(ref).max() + 1e-6
+    assert out.shape == ref.shape
+    assert np.abs(out - ref).max() / denom < 0.1

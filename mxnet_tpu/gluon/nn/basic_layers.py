@@ -154,7 +154,9 @@ class Embedding(HybridBlock):
         self._output_dim = output_dim
         with self.name_scope():
             self.weight = self.params.get("weight", shape=(input_dim, output_dim),
-                                          init=weight_initializer, dtype=dtype)
+                                          init=weight_initializer, dtype=dtype,
+                                          grad_stype="row_sparse" if sparse_grad
+                                          else "default")
 
     def hybrid_forward(self, F, x, weight):
         return F.Embedding(x, weight, input_dim=self._input_dim,

@@ -37,6 +37,8 @@ class Parameter:
         self.allow_deferred_init = allow_deferred_init
         self.grad_req = grad_req if differentiable else "null"
         self._differentiable = differentiable
+        self._stype = stype
+        self._grad_stype = grad_stype
         self.sharding = sharding  # PartitionSpec hint for mxnet_tpu.parallel
         self._data = None  # NDArray
         self._deferred_init = None  # (init, ctx)

@@ -83,6 +83,14 @@ class Trainer:
                     continue
                 raise RuntimeError("gradient of %s not attached; call attach_grad/initialize"
                                    % p.name)
+            if getattr(p, "_grad_stype", "default") == "row_sparse" and \
+                    not hasattr(g, "stype") and \
+                    getattr(self._optimizer, "lazy_update", True):
+                # Embedding(sparse_grad=True): carry the dense grad as
+                # (rows, values) so the optimizer takes the lazy row path
+                # (ref: gluon/trainer.py sparse pull + SGDUpdateRsp).
+                from ..sparse import dense_to_row_sparse_padded
+                g = dense_to_row_sparse_padded(g)
             if i not in self._states:
                 self._states[i] = self._optimizer.create_state(i, p.data())
             self._states[i] = self._optimizer.update(i, p.data(), g, self._states[i])

@@ -114,6 +114,11 @@ class Optimizer:
 
     def update(self, index, weight, grad, state):
         """In-place MXNet-style update (ref: optimizer.py:Optimizer.update)."""
+        from .sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray):
+            if getattr(self, "lazy_update", True):
+                return self._update_rsp(index, weight, grad, state)
+            grad = grad.todense()
         self._update_count(index)
         t = self._index_update_count[index]
         lr = self._get_lr(index)
@@ -126,6 +131,52 @@ class Optimizer:
         weight._data = new_w
         return new_state
 
+    def _rsp_stepper(self):
+        """Row-lazy update: gather touched rows of weight + row-shaped state
+        leaves, run the dense ``_step`` on just those rows, scatter back
+        (ref: src/operator/optimizer_op.cc SGDUpdateRsp / AdamUpdateRsp —
+        lazy_update touches only rows present in the sparse gradient)."""
+        base = self._stepper()
+
+        def step(w, rows, gvals, state, lr, wd, t):
+            nrows = w.shape[0]
+            # rows may contain nrows (out of bounds) as padding from
+            # sparse.dense_to_row_sparse_padded: gathers fill 0, scatters drop.
+
+            def take(leaf):
+                if hasattr(leaf, "shape") and leaf.shape[:1] == (nrows,) and \
+                        leaf.shape[1:] == w.shape[1:]:
+                    return jnp.take(leaf, rows, axis=0, mode="fill", fill_value=0)
+                return leaf
+
+            sub_state = jax.tree_util.tree_map(take, state)
+            w_rows = jnp.take(w, rows, axis=0, mode="fill", fill_value=0)
+            new_rows, new_sub = base(w_rows, gvals, sub_state, lr, wd, t)
+
+            def put(leaf, new_leaf):
+                if hasattr(leaf, "shape") and leaf.shape[:1] == (nrows,) and \
+                        leaf.shape[1:] == w.shape[1:]:
+                    return leaf.at[rows].set(new_leaf, mode="drop")
+                return new_leaf
+
+            new_state = jax.tree_util.tree_map(put, state, new_sub)
+            return w.at[rows].set(new_rows, mode="drop"), new_state
+
+        return step
+
+    def _update_rsp(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        f = getattr(self, "_jit_rsp_step", None)
+        if f is None:
+            f = self._jit_rsp_step = jax.jit(self._rsp_stepper())
+        new_w, new_state = f(weight._data, grad.indices._data, grad.data._data,
+                             state, jnp.float32(lr), jnp.float32(wd), jnp.int32(t))
+        weight._data = new_w
+        return new_state
+
     def update_multi_precision(self, index, weight, grad, state):
         return self.update(index, weight, grad, state)
 
@@ -134,9 +185,10 @@ class Optimizer:
 class SGD(Optimizer):
     """(ref: src/operator/optimizer_op.cc:sgd_mom_update)"""
 
-    def __init__(self, momentum=0.0, lazy_update=False, **kwargs):
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
+        self.lazy_update = lazy_update
 
     def init_state(self, w):
         return jnp.zeros_like(w, dtype=jnp.float32) if self.momentum else ()

@@ -139,7 +139,7 @@ def quantize_model(block, exclude=()):
         if not any(e in child.prefix for e in exclude):
             if isinstance(child, nn.Dense):
                 q = QuantizedDense(child)
-            elif type(child) is Conv2D:
+            elif isinstance(child, Conv2D):
                 q = QuantizedConv2D(child)
         if q is not None:
             block._children[name] = q

@@ -1,64 +1,142 @@
 """Sparse arrays: CSR and RowSparse (ref: src/ndarray/ndarray.cc sparse paths,
-python/mxnet/ndarray/sparse.py).
+python/mxnet/ndarray/sparse.py, src/operator/tensor/dot.cc).
 
 Design note: XLA:TPU has no native sparse kernels — the MXU wants dense tiles.
 MXNet uses sparse mainly for (a) huge embedding gradients (row_sparse) and
-(b) CSR feature matrices. The TPU-native stance: keep storage-format parity
-and convert at the op boundary; row_sparse gradients are carried as
-(indices, values) and applied with scatter-add (XLA fuses this well), which is
-what lazy_update SGD does on the reference.
+(b) CSR feature matrices. The TPU-native stance:
+
+* storage-format parity at the API level (CSRNDArray / RowSparseNDArray with
+  data/indices/indptr, cast_storage, retain, tostype);
+* csr x dense dot computed sparsely via segment-sum over nnz (no densify) —
+  XLA lowers gather + segment_sum well, and nnz stays static per array so the
+  kernel is jittable;
+* row_sparse gradients carried as (indices, values) and applied with
+  scatter-add / scatter row updates, which is what the reference's
+  lazy_update SGD/Adam do (ref: src/operator/optimizer_op.cc SGDUpdateRsp).
+
+Eager-path ops (cast_storage from dense, elemwise merges) use host nonzero /
+unique — they run outside jit, like MXNet's sparse ops run on CPU.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from .ndarray import NDArray, invoke
 
 __all__ = ["CSRNDArray", "RowSparseNDArray", "csr_matrix", "row_sparse_array",
-           "dot"]
+           "dot", "cast_storage", "retain", "add", "subtract", "multiply",
+           "elemwise_add", "elemwise_sub", "elemwise_mul", "add_n", "zeros",
+           "array"]
+
+
+def _as_jnp(x, dtype=None):
+    if isinstance(x, NDArray):
+        x = x._data
+    a = jnp.asarray(x)
+    return a.astype(dtype) if dtype is not None else a
 
 
 class CSRNDArray:
+    """Compressed sparse row matrix (ref: python/mxnet/ndarray/sparse.py
+    CSRNDArray)."""
+
     stype = "csr"
 
     def __init__(self, data, indices, indptr, shape):
         self.data = data if isinstance(data, NDArray) else NDArray(jnp.asarray(data))
-        self.indices = indices if isinstance(indices, NDArray) else NDArray(jnp.asarray(indices, jnp.int32))
-        self.indptr = indptr if isinstance(indptr, NDArray) else NDArray(jnp.asarray(indptr, jnp.int32))
-        self.shape = tuple(shape)
+        self.indices = indices if isinstance(indices, NDArray) else NDArray(_as_jnp(indices, jnp.int32))
+        self.indptr = indptr if isinstance(indptr, NDArray) else NDArray(_as_jnp(indptr, jnp.int32))
+        self.shape = tuple(int(s) for s in shape)
 
     @property
     def dtype(self):
         return self.data.dtype
+
+    @property
+    def nnz(self):
+        return int(self.data.shape[0])
+
+    def _row_ids(self):
+        """Row id per nnz via searchsorted on indptr — static-shape, jittable."""
+        nnz = self.data.shape[0]
+        return jnp.searchsorted(self.indptr._data, jnp.arange(nnz), side="right") - 1
 
     def asnumpy(self):
         return self.todense().asnumpy()
 
     def todense(self):
-        n, m = self.shape
-        indptr = self.indptr._data
-        # row id per nnz via searchsorted on indptr
-        nnz = self.data.shape[0]
-        rows = jnp.searchsorted(indptr, jnp.arange(nnz), side="right") - 1
         dense = jnp.zeros(self.shape, self.data.dtype)
-        dense = dense.at[rows, self.indices._data].add(self.data._data)
+        dense = dense.at[self._row_ids(), self.indices._data].add(self.data._data)
         return NDArray(dense)
 
-    tostype = lambda self, stype: self.todense() if stype == "default" else self
+    def astype(self, dtype):
+        return CSRNDArray(self.data.astype(dtype), self.indices, self.indptr, self.shape)
+
+    def tostype(self, stype):
+        return cast_storage(self, stype)
+
+    def copyto(self, other):
+        """Write our contents into ``other`` (ref: ndarray.py copyto semantics).
+        Dense targets receive the densified matrix in place."""
+        if getattr(other, "shape", self.shape) != self.shape:
+            raise ValueError("copyto shape mismatch: %s vs %s"
+                             % (self.shape, other.shape))
+        if isinstance(other, CSRNDArray):
+            other.data = NDArray(self.data._data)
+            other.indices = NDArray(self.indices._data)
+            other.indptr = NDArray(self.indptr._data)
+            return other
+        if isinstance(other, NDArray):
+            other._data = self.todense()._data
+            return other
+        raise TypeError("cannot copyto %r" % (type(other),))
+
+    def __getitem__(self, key):
+        """Row slicing (contiguous), as the reference supports for CSR."""
+        if isinstance(key, int):
+            if not -self.shape[0] <= key < self.shape[0]:
+                raise IndexError("row %d out of range for %s" % (key, self.shape))
+            if key < 0:
+                key += self.shape[0]
+            key = slice(key, key + 1)
+        start, stop, step = key.indices(self.shape[0])
+        if step != 1:
+            raise ValueError("CSR slicing requires step 1")
+        if stop < start:
+            raise ValueError("CSR slice %r is reversed/empty for %d rows"
+                             % (key, self.shape[0]))
+        indptr = np.asarray(self.indptr.asnumpy())
+        lo, hi = int(indptr[start]), int(indptr[stop])
+        new_indptr = indptr[start:stop + 1] - lo
+        return CSRNDArray(NDArray(self.data._data[lo:hi]),
+                          NDArray(self.indices._data[lo:hi]),
+                          np.asarray(new_indptr, np.int32),
+                          (stop - start, self.shape[1]))
+
+    def __repr__(self):
+        return "<CSRNDArray %s @%d nnz>" % (self.shape, self.nnz)
 
 
 class RowSparseNDArray:
+    """Row-sparse tensor: a subset of rows is stored densely
+    (ref: python/mxnet/ndarray/sparse.py RowSparseNDArray)."""
+
     stype = "row_sparse"
 
     def __init__(self, data, indices, shape):
         self.data = data if isinstance(data, NDArray) else NDArray(jnp.asarray(data))
-        self.indices = indices if isinstance(indices, NDArray) else NDArray(jnp.asarray(indices, jnp.int32))
-        self.shape = tuple(shape)
+        self.indices = indices if isinstance(indices, NDArray) else NDArray(_as_jnp(indices, jnp.int32))
+        self.shape = tuple(int(s) for s in shape)
 
     @property
     def dtype(self):
         return self.data.dtype
+
+    @property
+    def nnz_rows(self):
+        return int(self.indices.shape[0])
 
     def asnumpy(self):
         return self.todense().asnumpy()
@@ -68,15 +146,26 @@ class RowSparseNDArray:
         dense = dense.at[self.indices._data].add(self.data._data)
         return NDArray(dense)
 
+    def astype(self, dtype):
+        return RowSparseNDArray(self.data.astype(dtype), self.indices, self.shape)
+
     def tostype(self, stype):
-        return self.todense() if stype == "default" else self
+        return cast_storage(self, stype)
+
+    def retain(self, indices):
+        return retain(self, indices)
+
+    def __repr__(self):
+        return "<RowSparseNDArray %s @%d rows>" % (self.shape, self.nnz_rows)
 
 
 def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
     if isinstance(arg1, tuple) and len(arg1) == 3:
         data, indices, indptr = arg1
         return CSRNDArray(data, indices, indptr, shape)
-    a = np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray) else arg1)
+    a = np.asarray(arg1.asnumpy() if isinstance(arg1, (NDArray, CSRNDArray, RowSparseNDArray)) else arg1)
+    if dtype is not None:
+        a = a.astype(dtype)
     indptr = [0]
     indices = []
     data = []
@@ -93,15 +182,186 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
     if isinstance(arg1, tuple) and len(arg1) == 2:
         data, indices = arg1
         return RowSparseNDArray(data, indices, shape)
-    a = np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray) else arg1)
+    a = np.asarray(arg1.asnumpy() if isinstance(arg1, (NDArray, CSRNDArray, RowSparseNDArray)) else arg1)
+    if dtype is not None:
+        a = a.astype(dtype)
     rows = np.nonzero(a.any(axis=tuple(range(1, a.ndim))))[0]
     return RowSparseNDArray(a[rows], rows.astype(np.int32), a.shape)
 
 
+def array(source_array, ctx=None, dtype=None):
+    """sparse.array: preserve the input's storage type (ref:
+    python/mxnet/ndarray/sparse.py array)."""
+    if isinstance(source_array, CSRNDArray):
+        return CSRNDArray(source_array.data, source_array.indices,
+                          source_array.indptr, source_array.shape)
+    if isinstance(source_array, RowSparseNDArray):
+        return RowSparseNDArray(source_array.data, source_array.indices,
+                                source_array.shape)
+    return csr_matrix(source_array, dtype=dtype)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    """All-zero sparse array of the given storage type (ref:
+    python/mxnet/ndarray/sparse.py zeros)."""
+    dtype = dtype or np.float32
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    if stype == "csr":
+        if len(shape) != 2:
+            raise ValueError("csr storage requires a 2-D shape, got %s" % (shape,))
+        return CSRNDArray(np.zeros((0,), dtype), np.zeros((0,), np.int32),
+                          np.zeros((shape[0] + 1,), np.int32), shape)
+    if stype == "row_sparse":
+        return RowSparseNDArray(np.zeros((0,) + shape[1:], dtype),
+                                np.zeros((0,), np.int32), shape)
+    return NDArray(jnp.zeros(shape, dtype))
+
+
+def dense_to_row_sparse_padded(arr):
+    """Device-side dense → row_sparse for gradient carrying.
+
+    Unlike :func:`row_sparse_array` (which pulls the full array to host), only
+    a scalar — the touched-row count — syncs to host; the row list is built on
+    device with ``jnp.nonzero(size=...)`` padded to the next power of two, so
+    the optimizer's jitted lazy step compiles O(log n) distinct shapes instead
+    of one per batch. Padding slots carry row index == nrows (out of bounds):
+    the lazy stepper gathers them as zeros and drops them on scatter.
+    """
+    x = arr._data if isinstance(arr, NDArray) else jnp.asarray(arr)
+    nrows = x.shape[0]
+    rowmask = jnp.any(x != 0, axis=tuple(range(1, x.ndim)))
+    count = int(rowmask.sum())  # single scalar device→host sync
+    size = 1 if count == 0 else 1 << (count - 1).bit_length()
+    size = min(size, nrows)
+    size = max(size, count)
+    (rows,) = jnp.nonzero(rowmask, size=size, fill_value=nrows)
+    rows = rows.astype(jnp.int32)
+    vals = jnp.take(x, rows, axis=0, mode="fill", fill_value=0)
+    return RowSparseNDArray(NDArray(vals), NDArray(rows), x.shape)
+
+
+def cast_storage(arr, stype):
+    """Convert between 'default', 'csr', 'row_sparse'
+    (ref: src/operator/tensor/cast_storage.cc)."""
+    cur = getattr(arr, "stype", "default")
+    if cur == stype:
+        return arr
+    if stype == "default":
+        return arr.todense() if cur != "default" else arr
+    dense = arr.todense() if cur != "default" else arr
+    return csr_matrix(dense) if stype == "csr" else row_sparse_array(dense)
+
+
+def retain(rsp, indices):
+    """Keep only the requested rows of a RowSparseNDArray
+    (ref: src/operator/tensor/sparse_retain.cc)."""
+    if not isinstance(rsp, RowSparseNDArray):
+        raise TypeError("retain expects RowSparseNDArray")
+    want = np.asarray(indices.asnumpy() if isinstance(indices, NDArray) else indices,
+                      np.int64)
+    have = np.asarray(rsp.indices.asnumpy(), np.int64)
+    keep_mask = np.isin(have, want)
+    keep = np.nonzero(keep_mask)[0]
+    return RowSparseNDArray(NDArray(rsp.data._data[keep]),
+                            have[keep].astype(np.int32), rsp.shape)
+
+
+def _merge_rsp(lhs, rhs, op):
+    """Union-merge two RowSparseNDArrays row-wise (eager, host index math)."""
+    li = np.asarray(lhs.indices.asnumpy(), np.int64)
+    ri = np.asarray(rhs.indices.asnumpy(), np.int64)
+    union = np.union1d(li, ri)
+    lpos = np.searchsorted(union, li)
+    rpos = np.searchsorted(union, ri)
+    out = jnp.zeros((len(union),) + lhs.shape[1:], jnp.result_type(lhs.dtype, rhs.dtype))
+    if op == "mul":
+        a = out.at[lpos].add(lhs.data._data)
+        b = jnp.zeros_like(out).at[rpos].add(rhs.data._data)
+        merged = a * b
+    else:
+        merged = out.at[lpos].add(lhs.data._data)
+        rdata = rhs.data._data if op == "add" else -rhs.data._data
+        merged = merged.at[rpos].add(rdata)
+    return RowSparseNDArray(NDArray(merged), union.astype(np.int32), lhs.shape)
+
+
+def elemwise_add(lhs, rhs):
+    """rsp+rsp → rsp; anything involving dense → dense
+    (ref: src/operator/tensor/elemwise_binary_op_basic.cc)."""
+    return _elemwise(lhs, rhs, "add")
+
+
+def elemwise_sub(lhs, rhs):
+    return _elemwise(lhs, rhs, "sub")
+
+
+def elemwise_mul(lhs, rhs):
+    return _elemwise(lhs, rhs, "mul")
+
+
+def _elemwise(lhs, rhs, op):
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray):
+        if lhs.shape != rhs.shape:
+            raise ValueError("shape mismatch %s vs %s" % (lhs.shape, rhs.shape))
+        return _merge_rsp(lhs, rhs, op)
+    ld = lhs.todense() if hasattr(lhs, "todense") else lhs
+    rd = rhs.todense() if hasattr(rhs, "todense") else rhs
+    fn = {"add": lambda a, b: a + b, "sub": lambda a, b: a - b,
+          "mul": lambda a, b: a * b}[op]
+    return fn(ld, rd)
+
+
+add = elemwise_add
+subtract = elemwise_sub
+multiply = elemwise_mul
+
+
+def add_n(*arrs):
+    """Sum of N arrays; stays row_sparse when all inputs are
+    (ref: src/operator/tensor/elemwise_sum.cc)."""
+    arrs = arrs[0] if len(arrs) == 1 and isinstance(arrs[0], (list, tuple)) else arrs
+    out = arrs[0]
+    for a in arrs[1:]:
+        out = elemwise_add(out, a)
+    return out
+
+
+def _csr_dot_dense(csr, rhs, transpose_a=False):
+    """Sparse csr x dense without densifying the lhs.
+
+    Forward: out[r, :] = sum_{nnz in row r} data * rhs[col, :] — a gather over
+    rhs rows followed by segment_sum by row id. transpose_a scatters into
+    out[col, :] instead. Shapes are static in nnz, so both paths jit cleanly.
+    (ref: src/operator/tensor/dot.cc DotCsrDnsDns / DotCsrTDnsDns)
+    """
+    rows = csr._row_ids()
+    cols = csr.indices._data
+    vals = csr.data._data
+    if rhs.ndim == 1:                            # matvec
+        if transpose_a:
+            out = jnp.zeros((csr.shape[1],), jnp.result_type(vals, rhs))
+            return out.at[cols].add(vals * rhs[rows])
+        return jax.ops.segment_sum(vals * rhs[cols], rows,
+                                   num_segments=csr.shape[0])
+    if transpose_a:
+        # (csr.T @ rhs)[c] += v * rhs[r] for each nnz (r, c, v)
+        contrib = vals[:, None] * rhs[rows]      # (nnz, k)
+        out = jnp.zeros((csr.shape[1], rhs.shape[1]), contrib.dtype)
+        return out.at[cols].add(contrib)
+    contrib = vals[:, None] * rhs[cols]          # (nnz, k)
+    return jax.ops.segment_sum(contrib, rows, num_segments=csr.shape[0])
+
+
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):
-    """csr × dense → dense (ref: src/operator/tensor/dot.cc sparse kernels).
-    Converts at the boundary — dense matmul rides the MXU."""
-    if isinstance(lhs, CSRNDArray):
+    """Sparse-aware dot (ref: src/operator/tensor/dot.cc).
+
+    csr x dense runs a true sparse kernel (gather + segment_sum over nnz);
+    other sparse combinations densify at the boundary so the matmul rides
+    the MXU.
+    """
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray) and not transpose_b:
+        return NDArray(_csr_dot_dense(lhs, rhs._data, transpose_a))
+    if isinstance(lhs, (CSRNDArray, RowSparseNDArray)):
         lhs = lhs.todense()
     if isinstance(rhs, (CSRNDArray, RowSparseNDArray)):
         rhs = rhs.todense()

@@ -74,15 +74,20 @@ class OpDef(NamedTuple):
     needs_training: bool = False
     # number of outputs that are differentiable (None = all)
     nondiff: bool = False
+    # tuple-returning ops declare their arity so the symbol builder can
+    # mirror it with _item projections (MXNet: nnvm op num_outputs)
+    n_outputs: int = 1
 
 
 OP_REGISTRY: Dict[str, OpDef] = {}
 
 
-def register_op(name=None, array_kwargs=(), needs_rng=False, needs_training=False, nondiff=False):
+def register_op(name=None, array_kwargs=(), needs_rng=False, needs_training=False, nondiff=False,
+                n_outputs=1):
     def deco(fn):
         opname = name or fn.__name__
-        OP_REGISTRY[opname] = OpDef(opname, fn, tuple(array_kwargs), needs_rng, needs_training, nondiff)
+        OP_REGISTRY[opname] = OpDef(opname, fn, tuple(array_kwargs), needs_rng, needs_training,
+                                    nondiff, n_outputs)
         return fn
 
     return deco

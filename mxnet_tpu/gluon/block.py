@@ -250,6 +250,16 @@ class HybridBlock(Block):
     # ------------------------------------------------------------ imperative
     def forward(self, *args, **kwargs):
         from .. import nd as _nd
+        from ..symbol import Symbol
+
+        if any(isinstance(a, Symbol) for a in args):
+            # Symbol in → Symbol graph out, like MXNet's net(mx.sym.var('data'))
+            # (ref: gluon/block.py HybridBlock._build_cache / symbol tracing).
+            # Parameters become named graph variables; the ONNX exporter and
+            # symbol.bind supply their values by name.
+            from .. import sym as _sym
+            pkwargs = {n: _sym.var(p.name) for n, p in self._reg_params.items()}
+            return self.hybrid_forward(_sym, *args, **pkwargs, **kwargs)
 
         self._ensure_params(*args)
         if self._active:

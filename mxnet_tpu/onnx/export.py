@@ -1,0 +1,438 @@
+"""Symbol graph → ONNX ModelProto (ref: python/mxnet/onnx/mx2onnx/_export_model.py
+and _op_translations — the reference converts nnvm symbol nodes to ONNX nodes
+one converter per op; this does the same over mxnet_tpu's Symbol DAG).
+
+Entry points:
+  export_model(block_or_symbol, params_or_shapes, ..., onnx_file)
+
+A HybridBlock is first traced to a Symbol graph via ``block(sym.var('data'))``;
+each Symbol node is then translated by a converter. Inference semantics:
+BatchNorm exports running-stat normalization, Dropout exports identity-at-eval.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import proto as P
+from ..symbol import Symbol
+
+_CONVERTERS = {}
+
+
+def register_converter(opname):
+    def deco(fn):
+        _CONVERTERS[opname] = fn
+        return fn
+    return deco
+
+
+class _Ctx:
+    """Per-export state: emitted nodes, initializers, name table."""
+
+    def __init__(self, params, opset):
+        self.nodes = []
+        self.initializers = {}
+        self.names = {}     # id(symbol) -> output value name
+        self.params = params
+        self.opset = opset
+        self._uid = 0
+
+    def fresh(self, hint):
+        self._uid += 1
+        return "%s_%d" % (hint, self._uid)
+
+    def emit(self, op_type, inputs, outputs, name=None, attrs=None):
+        self.nodes.append(P.node_proto(op_type, inputs, outputs,
+                                       name or self.fresh(op_type.lower()),
+                                       attrs or {}))
+
+    def const(self, hint, arr):
+        """Add an initializer tensor, return its name."""
+        name = self.fresh(hint)
+        self.initializers[name] = np.asarray(arr)
+        return name
+
+
+def _pair(v, n=2):
+    return list(v) if isinstance(v, (tuple, list)) else [v] * n
+
+
+# ------------------------------------------------------------- op converters
+# Each converter: (ctx, node, in_names) -> out_name (or list of out names).
+
+@register_converter("Convolution")
+def _conv(ctx, s, ins, out):
+    a = s._attrs
+    kernel = _pair(a.get("kernel"))
+    nd = len(kernel)
+    pads = _pair(a.get("pad", 0), nd)
+    attrs = {"kernel_shape": kernel,
+             "strides": _pair(a.get("stride", 1), nd),
+             "pads": pads + pads,   # begin then end
+             "dilations": _pair(a.get("dilate", 1), nd),
+             "group": int(a.get("num_group", 1))}
+    ctx.emit("Conv", ins, [out], attrs=attrs)
+
+
+@register_converter("Deconvolution")
+def _deconv(ctx, s, ins, out):
+    a = s._attrs
+    kernel = _pair(a.get("kernel"))
+    nd = len(kernel)
+    pads = _pair(a.get("pad", 0), nd)
+    attrs = {"kernel_shape": kernel,
+             "strides": _pair(a.get("stride", 1), nd),
+             "pads": pads + pads,
+             "dilations": _pair(a.get("dilate", 1), nd),
+             "group": int(a.get("num_group", 1))}
+    adj = a.get("adj")
+    if adj:
+        attrs["output_padding"] = _pair(adj, nd)
+    ctx.emit("ConvTranspose", ins, [out], attrs=attrs)
+
+
+@register_converter("FullyConnected")
+def _fc(ctx, s, ins, out):
+    a = s._attrs
+    x = ins[0]
+    if a.get("flatten", True):
+        flat = ctx.fresh("flatten")
+        ctx.emit("Flatten", [x], [flat], attrs={"axis": 1})
+        # Gemm: Y = X·Wᵀ + b  (MXNet weight is (num_hidden, in))
+        gemm_in = [flat, ins[1]] + ins[2:3]
+        ctx.emit("Gemm", gemm_in, [out], attrs={"transB": 1, "alpha": 1.0, "beta": 1.0})
+    else:
+        # N-D input: MatMul against Wᵀ then Add bias
+        wt = ctx.fresh("w_t")
+        ctx.emit("Transpose", [ins[1]], [wt], attrs={"perm": [1, 0]})
+        mm = ctx.fresh("matmul") if len(ins) > 2 else out
+        ctx.emit("MatMul", [x, wt], [mm])
+        if len(ins) > 2:
+            ctx.emit("Add", [mm, ins[2]], [out])
+
+
+@register_converter("BatchNorm")
+def _bn(ctx, s, ins, out):
+    a = s._attrs
+    # inputs arrive as (x, gamma, beta, moving_mean, moving_var) = ONNX order
+    ctx.emit("BatchNormalization", ins[:5], [out],
+             attrs={"epsilon": float(a.get("eps", 1e-5)),
+                    "momentum": float(a.get("momentum", 0.9))})
+
+
+@register_converter("LayerNorm")
+def _ln(ctx, s, ins, out):
+    a = s._attrs
+    ctx.emit("LayerNormalization", ins[:3], [out],
+             attrs={"axis": int(a.get("axis", -1)),
+                    "epsilon": float(a.get("eps", 1e-5))})
+
+
+_ACT2ONNX = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+             "softrelu": "Softplus", "softsign": "Softsign"}
+
+
+@register_converter("Activation")
+def _act(ctx, s, ins, out):
+    ctx.emit(_ACT2ONNX[s._attrs.get("act_type", "relu")], ins[:1], [out])
+
+
+@register_converter("LeakyReLU")
+def _leaky(ctx, s, ins, out):
+    a = s._attrs
+    act = a.get("act_type", "leaky")
+    if act == "leaky":
+        ctx.emit("LeakyRelu", ins[:1], [out],
+                 attrs={"alpha": float(a.get("slope", 0.25))})
+    elif act == "elu":
+        ctx.emit("Elu", ins[:1], [out], attrs={"alpha": float(a.get("slope", 0.25))})
+    elif act == "prelu":
+        ctx.emit("PRelu", ins[:2], [out])
+    elif act == "gelu":
+        ctx.emit("Gelu", ins[:1], [out])
+    elif act == "selu":
+        ctx.emit("Selu", ins[:1], [out])
+    else:
+        raise ValueError("cannot export LeakyReLU act_type=%s" % act)
+
+
+@register_converter("Pooling")
+def _pool(ctx, s, ins, out):
+    a = s._attrs
+    ptype = a.get("pool_type", "max")
+    if a.get("global_pool"):
+        op = {"max": "GlobalMaxPool", "avg": "GlobalAveragePool"}[ptype]
+        ctx.emit(op, ins[:1], [out])
+        return
+    kernel = _pair(a.get("kernel"))
+    nd = len(kernel)
+    pads = _pair(a.get("pad", 0), nd)
+    attrs = {"kernel_shape": kernel,
+             "strides": _pair(a.get("stride") or a.get("kernel"), nd),
+             "pads": pads + pads}
+    if ptype == "avg":
+        attrs["count_include_pad"] = int(bool(a.get("count_include_pad", True)))
+        ctx.emit("AveragePool", ins[:1], [out], attrs=attrs)
+    elif ptype == "max":
+        ctx.emit("MaxPool", ins[:1], [out], attrs=attrs)
+    elif ptype == "lp":
+        attrs["p"] = int(a.get("p_value", 2))
+        ctx.emit("LpPool", ins[:1], [out], attrs=attrs)
+    else:
+        raise ValueError("cannot export pool_type=%s" % ptype)
+
+
+@register_converter("Dropout")
+def _dropout(ctx, s, ins, out):
+    ctx.emit("Dropout", ins[:1], [out],
+             attrs={})  # inference: identity; ratio only matters in training
+
+
+@register_converter("Embedding")
+def _embedding(ctx, s, ins, out):
+    # F.Embedding(indices, weight) → Gather(weight, indices)
+    ctx.emit("Gather", [ins[1], ins[0]], [out], attrs={"axis": 0})
+
+
+@register_converter("flatten")
+def _flatten(ctx, s, ins, out):
+    ctx.emit("Flatten", ins, [out], attrs={"axis": 1})
+
+
+@register_converter("softmax")
+def _softmax(ctx, s, ins, out):
+    ctx.emit("Softmax", ins[:1], [out], attrs={"axis": int(s._attrs.get("axis", -1))})
+
+
+@register_converter("log_softmax")
+def _log_softmax(ctx, s, ins, out):
+    ctx.emit("LogSoftmax", ins[:1], [out], attrs={"axis": int(s._attrs.get("axis", -1))})
+
+
+@register_converter("concat")
+def _concat(ctx, s, ins, out):
+    ctx.emit("Concat", ins, [out], attrs={"axis": int(s._attrs.get("dim", 1))})
+
+
+@register_converter("reshape")
+def _reshape(ctx, s, ins, out):
+    shape = ctx.const("shape", np.asarray(s._attrs["shape"], np.int64))
+    ctx.emit("Reshape", [ins[0], shape], [out])
+
+
+@register_converter("transpose")
+def _transpose(ctx, s, ins, out):
+    attrs = {}
+    if s._attrs.get("axes") is not None:
+        attrs["perm"] = list(s._attrs["axes"])
+    ctx.emit("Transpose", ins, [out], attrs=attrs)
+
+
+@register_converter("expand_dims")
+def _expand_dims(ctx, s, ins, out):
+    axes = ctx.const("axes", np.asarray([s._attrs["axis"]], np.int64))
+    ctx.emit("Unsqueeze", [ins[0], axes], [out])
+
+
+@register_converter("squeeze")
+def _squeeze(ctx, s, ins, out):
+    ax = s._attrs.get("axis")
+    if ax is None:
+        ctx.emit("Squeeze", ins, [out])
+    else:
+        ax = [ax] if isinstance(ax, int) else list(ax)
+        axes = ctx.const("axes", np.asarray(ax, np.int64))
+        ctx.emit("Squeeze", [ins[0], axes], [out])
+
+
+@register_converter("clip")
+def _clip(ctx, s, ins, out):
+    lo = ctx.const("min", np.float32(s._attrs["a_min"]))
+    hi = ctx.const("max", np.float32(s._attrs["a_max"]))
+    ctx.emit("Clip", [ins[0], lo, hi], [out])
+
+
+def _reduce(onnx_op):
+    def conv(ctx, s, ins, out):
+        a = s._attrs
+        attrs = {"keepdims": int(bool(a.get("keepdims", False)))}
+        ax = a.get("axis")
+        if ax is not None:
+            attrs["axes"] = [ax] if isinstance(ax, int) else list(ax)
+        ctx.emit(onnx_op, ins[:1], [out], attrs=attrs)
+    return conv
+
+
+for _mx, _onnx in [("mean", "ReduceMean"), ("sum", "ReduceSum"),
+                   ("max", "ReduceMax"), ("min", "ReduceMin"),
+                   ("prod", "ReduceProd")]:
+    register_converter(_mx)(_reduce(_onnx))
+
+
+def _binop(onnx_op):
+    def conv(ctx, s, ins, out):
+        ctx.emit(onnx_op, ins[:2], [out])
+    return conv
+
+
+for _mx, _onnx in [("add", "Add"), ("subtract", "Sub"), ("multiply", "Mul"),
+                   ("divide", "Div"), ("power", "Pow"), ("maximum", "Max"),
+                   ("minimum", "Min"), ("broadcast_add", "Add"),
+                   ("broadcast_sub", "Sub"), ("broadcast_mul", "Mul"),
+                   ("broadcast_div", "Div"), ("broadcast_power", "Pow"),
+                   ("broadcast_maximum", "Max"), ("broadcast_minimum", "Min"),
+                   ("dot", "MatMul"), ("matmul", "MatMul"),
+                   ("batch_dot", "MatMul")]:
+    register_converter(_mx)(_binop(_onnx))
+
+
+def _unop(onnx_op):
+    def conv(ctx, s, ins, out):
+        ctx.emit(onnx_op, ins[:1], [out])
+    return conv
+
+
+for _mx, _onnx in [("relu", "Relu"), ("sigmoid", "Sigmoid"), ("tanh", "Tanh"),
+                   ("exp", "Exp"), ("log", "Log"), ("sqrt", "Sqrt"),
+                   ("negative", "Neg"), ("abs", "Abs"), ("floor", "Floor"),
+                   ("ceil", "Ceil"), ("round", "Round"), ("erf", "Erf"),
+                   ("sin", "Sin"), ("cos", "Cos"), ("tan", "Tan"),
+                   ("reciprocal", "Reciprocal"), ("sign", "Sign"),
+                   ("softsign", "Softsign"), ("softrelu", "Softplus")]:
+    register_converter(_mx)(_unop(_onnx))
+
+
+@register_converter("square")
+def _square(ctx, s, ins, out):
+    two = ctx.const("two", np.float32(2.0))
+    ctx.emit("Pow", [ins[0], two], [out])
+
+
+@register_converter("slice_axis")
+def _slice_axis(ctx, s, ins, out):
+    a = s._attrs
+    end = a.get("end")
+    starts = ctx.const("starts", np.asarray([a["begin"]], np.int64))
+    ends = ctx.const("ends", np.asarray(
+        [end if end is not None else np.iinfo(np.int64).max], np.int64))
+    axes = ctx.const("axes", np.asarray([a["axis"]], np.int64))
+    ctx.emit("Slice", [ins[0], starts, ends, axes], [out])
+
+
+@register_converter("_const")
+def _const_conv(ctx, s, ins, out):
+    val = np.asarray(s._attrs["value"], np.float32)
+    ctx.initializers[out] = val
+
+
+# ------------------------------------------------------------- graph walker
+
+def _toposort(outputs):
+    order, seen = [], set()
+
+    def walk(s):
+        if id(s) in seen:
+            return
+        seen.add(id(s))
+        for i in s._inputs:
+            walk(i)
+        order.append(s)
+
+    for o in outputs:
+        walk(o)
+    return order
+
+
+def symbol_to_onnx(sym_out, params, input_shapes, input_dtypes=None,
+                   graph_name="mxnet_tpu", opset=13):
+    """Convert a Symbol graph (single output or Group) to ModelProto bytes.
+
+    params: {name: np.ndarray} for every non-data variable in the graph.
+    input_shapes: {data_name: shape} for graph inputs.
+    """
+    outputs = sym_out._inputs if sym_out._op == "_group" else [sym_out]
+    order = _toposort(outputs)
+    ctx = _Ctx(params, opset)
+    input_dtypes = input_dtypes or {}
+
+    # name variables; params become initializers, the rest graph inputs
+    graph_inputs = []
+    for s in order:
+        if not s.is_var():
+            continue
+        ctx.names[id(s)] = s.name
+        if s.name in params:
+            ctx.initializers[s.name] = np.asarray(params[s.name])
+        else:
+            if s.name not in input_shapes:
+                raise ValueError("no shape for graph input %r" % s.name)
+            graph_inputs.append(
+                P.value_info(s.name, input_dtypes.get(s.name, np.float32),
+                             input_shapes[s.name]))
+
+    for s in order:
+        if s.is_var():
+            continue
+        if s._op == "_item":
+            # projection of a multi-output op: index 0 is the op's main output
+            parent = s._inputs[0]
+            idx = s._attrs.get("index", 0)
+            if idx == 0:
+                ctx.names[id(s)] = ctx.names[id(parent)]
+            else:
+                ctx.names[id(s)] = "%s_out%d" % (ctx.names[id(parent)], idx)
+            continue
+        ins = [ctx.names[id(i)] for i in s._inputs]
+        out = ctx.fresh(s.name or s._op)
+        ctx.names[id(s)] = out
+        conv = _CONVERTERS.get(s._op)
+        if conv is None:
+            raise ValueError("no ONNX converter for op %r (export coverage "
+                             "mirrors mx2onnx/_op_translations)" % s._op)
+        conv(ctx, s, ins, out)
+
+    out_infos = [P.value_info(ctx.names[id(o)], np.float32, ())
+                 for o in outputs]
+    init_protos = [P.tensor_proto(n, a) for n, a in ctx.initializers.items()]
+    graph = P.graph_proto(graph_name, ctx.nodes, graph_inputs, out_infos,
+                          init_protos)
+    return P.model_proto(graph, opset=opset).tobytes()
+
+
+def export_model(model, params=None, input_shapes=None, input_types=None,
+                 onnx_file=None, input_names=("data",), opset=13):
+    """Export a HybridBlock or Symbol to an ONNX file
+    (ref: python/mxnet/onnx/mx2onnx/_export_model.py:export_model).
+
+    * HybridBlock: traced via block(sym.var(name) for each input_name);
+      parameters are pulled from collect_params().
+    * Symbol: ``params`` must map var name → array.
+    Returns the path written (or the serialized bytes if onnx_file is None).
+    """
+    from .. import sym as _sym
+
+    if input_shapes is None:
+        raise ValueError("input_shapes is required")
+    if not isinstance(input_shapes, dict):
+        input_shapes = dict(zip(input_names, [tuple(s) for s in input_shapes]))
+
+    if isinstance(model, Symbol):
+        sym_out = model
+        params = {k: np.asarray(v.asnumpy() if hasattr(v, "asnumpy") else v)
+                  for k, v in (params or {}).items()}
+    else:
+        data = [_sym.var(n) for n in input_shapes]
+        sym_out = model(*data)
+        if isinstance(sym_out, (list, tuple)):
+            from ..symbol import Group
+            sym_out = Group(list(sym_out))
+        params = {p.name: p.data().asnumpy()
+                  for p in model.collect_params().values()}
+
+    buf = symbol_to_onnx(sym_out, params, input_shapes,
+                         input_dtypes=input_types, opset=opset)
+    if onnx_file is None:
+        return buf
+    with open(onnx_file, "wb") as f:
+        f.write(buf)
+    return onnx_file

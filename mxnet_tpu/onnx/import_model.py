@@ -1,0 +1,426 @@
+"""ONNX ModelProto → Symbol graph + params (ref:
+python/mxnet/onnx/onnx2mx/import_model.py and _op_translations — the
+reference builds an nnvm symbol per ONNX node; this builds mxnet_tpu Symbols).
+
+Coverage mirrors what export.py emits (the model-zoo op set); unknown ops
+raise with the op name so gaps are explicit.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import proto as P
+from ..symbol import Symbol, _make, var
+
+_IMPORTERS = {}
+
+
+def register_importer(onnx_op):
+    def deco(fn):
+        _IMPORTERS[onnx_op] = fn
+        return fn
+    return deco
+
+
+class _Graph:
+    def __init__(self, parsed):
+        self.initializers = parsed["initializers"]  # name -> np array
+        self.syms = {}                              # value name -> Symbol
+        self.used_params = set()
+
+    def inp(self, name):
+        """Symbol for a node input; initializer-backed names become vars."""
+        if name in self.syms:
+            return self.syms[name]
+        if name in self.initializers:
+            self.used_params.add(name)
+            s = var(name)
+            self.syms[name] = s
+            return s
+        raise KeyError("undefined ONNX value %r" % name)
+
+    def const_value(self, name):
+        """Static value of an initializer-fed input (Reshape shape etc.)."""
+        if name not in self.initializers:
+            raise ValueError("input %r must be a constant initializer" % name)
+        return self.initializers[name]
+
+
+def _sym_pair(v):
+    return tuple(v)
+
+
+# ----------------------------------------------------------------- importers
+
+@register_importer("Conv")
+def _conv(g, node):
+    a = node["attrs"]
+    pads = a.get("pads")
+    nd = len(a["kernel_shape"])
+    if pads:
+        begin, end = pads[:nd], pads[nd:]
+        if begin != end:
+            raise ValueError("asymmetric Conv pads unsupported: %s" % pads)
+        pad = tuple(begin)
+    else:
+        pad = (0,) * nd
+    ins = [g.inp(n) for n in node["inputs"]]
+    return _make("Convolution", *ins, kernel=tuple(a["kernel_shape"]),
+                 stride=tuple(a.get("strides", (1,) * nd)), pad=pad,
+                 dilate=tuple(a.get("dilations", (1,) * nd)),
+                 num_group=int(a.get("group", 1)),
+                 no_bias=len(ins) < 3)
+
+
+@register_importer("ConvTranspose")
+def _deconv(g, node):
+    a = node["attrs"]
+    nd = len(a["kernel_shape"])
+    pads = a.get("pads")
+    pad = tuple(pads[:nd]) if pads else (0,) * nd
+    ins = [g.inp(n) for n in node["inputs"]]
+    kw = dict(kernel=tuple(a["kernel_shape"]),
+              stride=tuple(a.get("strides", (1,) * nd)), pad=pad,
+              dilate=tuple(a.get("dilations", (1,) * nd)),
+              num_group=int(a.get("group", 1)), no_bias=len(ins) < 3)
+    if a.get("output_padding"):
+        kw["adj"] = tuple(a["output_padding"])
+    return _make("Deconvolution", *ins, **kw)
+
+
+@register_importer("Gemm")
+def _gemm(g, node):
+    a = node["attrs"]
+    if a.get("transA") or not a.get("transB", 0):
+        raise ValueError("only Gemm(transA=0, transB=1) supported")
+    ins = [g.inp(n) for n in node["inputs"]]
+    w = g.initializers.get(node["inputs"][1])
+    num_hidden = int(w.shape[0]) if w is not None else 0
+    return _make("FullyConnected", *ins, num_hidden=num_hidden,
+                 no_bias=len(ins) < 3, flatten=True)
+
+
+@register_importer("MatMul")
+def _matmul(g, node):
+    return _make("matmul", g.inp(node["inputs"][0]), g.inp(node["inputs"][1]))
+
+
+@register_importer("BatchNormalization")
+def _bn(g, node):
+    a = node["attrs"]
+    ins = [g.inp(n) for n in node["inputs"]]
+    out = _make("BatchNorm", *ins, eps=float(a.get("epsilon", 1e-5)),
+                momentum=float(a.get("momentum", 0.9)),
+                use_global_stats=True)
+    return out[0]
+
+
+@register_importer("LayerNormalization")
+def _ln(g, node):
+    a = node["attrs"]
+    ins = [g.inp(n) for n in node["inputs"]]
+    return _make("LayerNorm", *ins, axis=int(a.get("axis", -1)),
+                 eps=float(a.get("epsilon", 1e-5)))
+
+
+for _onnx, _act in [("Relu", "relu"), ("Sigmoid", "sigmoid"), ("Tanh", "tanh"),
+                    ("Softplus", "softrelu"), ("Softsign", "softsign")]:
+    def _mk_act(act):
+        def imp(g, node):
+            return _make("Activation", g.inp(node["inputs"][0]), act_type=act)
+        return imp
+    register_importer(_onnx)(_mk_act(_act))
+
+
+@register_importer("LeakyRelu")
+def _leaky(g, node):
+    return _make("LeakyReLU", g.inp(node["inputs"][0]), act_type="leaky",
+                 slope=float(node["attrs"].get("alpha", 0.01)))
+
+
+@register_importer("Elu")
+def _elu(g, node):
+    return _make("LeakyReLU", g.inp(node["inputs"][0]), act_type="elu",
+                 slope=float(node["attrs"].get("alpha", 1.0)))
+
+
+@register_importer("PRelu")
+def _prelu(g, node):
+    return _make("LeakyReLU", g.inp(node["inputs"][0]),
+                 g.inp(node["inputs"][1]), act_type="prelu")
+
+
+@register_importer("Selu")
+def _selu(g, node):
+    return _make("LeakyReLU", g.inp(node["inputs"][0]), act_type="selu")
+
+
+@register_importer("Gelu")
+def _gelu(g, node):
+    return _make("LeakyReLU", g.inp(node["inputs"][0]), act_type="gelu")
+
+
+def _pool(ptype):
+    def imp(g, node):
+        a = node["attrs"]
+        nd = len(a["kernel_shape"])
+        pads = a.get("pads")
+        pad = tuple(pads[:nd]) if pads else (0,) * nd
+        kw = dict(kernel=tuple(a["kernel_shape"]),
+                  stride=tuple(a.get("strides", a["kernel_shape"])),
+                  pad=pad, pool_type=ptype)
+        if ptype == "avg":
+            kw["count_include_pad"] = bool(a.get("count_include_pad", 1))
+        if ptype == "lp":
+            kw["p_value"] = int(a.get("p", 2))
+        return _make("Pooling", g.inp(node["inputs"][0]), **kw)
+    return imp
+
+
+register_importer("MaxPool")(_pool("max"))
+register_importer("AveragePool")(_pool("avg"))
+register_importer("LpPool")(_pool("lp"))
+
+
+@register_importer("GlobalAveragePool")
+def _gap(g, node):
+    return _make("Pooling", g.inp(node["inputs"][0]), kernel=(1, 1),
+                 pool_type="avg", global_pool=True)
+
+
+@register_importer("GlobalMaxPool")
+def _gmp(g, node):
+    return _make("Pooling", g.inp(node["inputs"][0]), kernel=(1, 1),
+                 pool_type="max", global_pool=True)
+
+
+@register_importer("Dropout")
+def _dropout(g, node):
+    return g.inp(node["inputs"][0])  # inference: identity
+
+
+@register_importer("Identity")
+def _identity(g, node):
+    return g.inp(node["inputs"][0])
+
+
+@register_importer("Gather")
+def _gather(g, node):
+    axis = int(node["attrs"].get("axis", 0))
+    return _make("take", g.inp(node["inputs"][0]), g.inp(node["inputs"][1]),
+                 axis=axis, mode="clip")
+
+
+@register_importer("Flatten")
+def _flatten(g, node):
+    if int(node["attrs"].get("axis", 1)) != 1:
+        raise ValueError("Flatten axis != 1 unsupported")
+    return _make("flatten", g.inp(node["inputs"][0]))
+
+
+@register_importer("Softmax")
+def _softmax(g, node):
+    return _make("softmax", g.inp(node["inputs"][0]),
+                 axis=int(node["attrs"].get("axis", -1)))
+
+
+@register_importer("LogSoftmax")
+def _log_softmax(g, node):
+    return _make("log_softmax", g.inp(node["inputs"][0]),
+                 axis=int(node["attrs"].get("axis", -1)))
+
+
+@register_importer("Concat")
+def _concat(g, node):
+    ins = [g.inp(n) for n in node["inputs"]]
+    return _make("concat", *ins, dim=int(node["attrs"].get("axis", 1)))
+
+
+@register_importer("Reshape")
+def _reshape(g, node):
+    shape = tuple(int(v) for v in g.const_value(node["inputs"][1]))
+    return _make("reshape", g.inp(node["inputs"][0]), shape=shape)
+
+
+@register_importer("Transpose")
+def _transpose(g, node):
+    perm = node["attrs"].get("perm")
+    return _make("transpose", g.inp(node["inputs"][0]),
+                 axes=tuple(perm) if perm else None)
+
+
+@register_importer("Unsqueeze")
+def _unsqueeze(g, node):
+    if len(node["inputs"]) > 1:
+        axes = [int(v) for v in g.const_value(node["inputs"][1])]
+    else:
+        axes = node["attrs"]["axes"]
+    out = g.inp(node["inputs"][0])
+    for ax in axes:
+        out = _make("expand_dims", out, axis=int(ax))
+    return out
+
+
+@register_importer("Squeeze")
+def _squeeze(g, node):
+    if len(node["inputs"]) > 1:
+        axes = tuple(int(v) for v in g.const_value(node["inputs"][1]))
+    elif "axes" in node["attrs"]:
+        axes = tuple(node["attrs"]["axes"])
+    else:
+        axes = None
+    return _make("squeeze", g.inp(node["inputs"][0]), axis=axes)
+
+
+@register_importer("Clip")
+def _clip(g, node):
+    lo = float(g.const_value(node["inputs"][1])) if len(node["inputs"]) > 1 else -np.inf
+    hi = float(g.const_value(node["inputs"][2])) if len(node["inputs"]) > 2 else np.inf
+    return _make("clip", g.inp(node["inputs"][0]), a_min=lo, a_max=hi)
+
+
+@register_importer("Slice")
+def _slice(g, node):
+    starts = [int(v) for v in g.const_value(node["inputs"][1])]
+    ends = [int(v) for v in g.const_value(node["inputs"][2])]
+    axes = ([int(v) for v in g.const_value(node["inputs"][3])]
+            if len(node["inputs"]) > 3 else list(range(len(starts))))
+    out = g.inp(node["inputs"][0])
+    imax = np.iinfo(np.int64).max
+    for st, en, ax in zip(starts, ends, axes):
+        out = _make("slice_axis", out, axis=ax, begin=st,
+                    end=None if en >= imax else en)
+    return out
+
+
+def _reduce(mx_op):
+    def imp(g, node):
+        a = node["attrs"]
+        axes = a.get("axes")
+        kw = {"keepdims": bool(a.get("keepdims", 1))}
+        if axes is not None:
+            kw["axis"] = tuple(axes) if len(axes) > 1 else int(axes[0])
+        return _make(mx_op, g.inp(node["inputs"][0]), **kw)
+    return imp
+
+
+for _onnx, _mx in [("ReduceMean", "mean"), ("ReduceSum", "sum"),
+                   ("ReduceMax", "max"), ("ReduceMin", "min"),
+                   ("ReduceProd", "prod")]:
+    register_importer(_onnx)(_reduce(_mx))
+
+
+def _binop(mx_op):
+    def imp(g, node):
+        return _make(mx_op, g.inp(node["inputs"][0]), g.inp(node["inputs"][1]))
+    return imp
+
+
+for _onnx, _mx in [("Add", "add"), ("Sub", "subtract"), ("Mul", "multiply"),
+                   ("Div", "divide"), ("Pow", "power")]:
+    register_importer(_onnx)(_binop(_mx))
+
+
+def _minmax(mx_op):
+    def imp(g, node):
+        out = g.inp(node["inputs"][0])
+        for n in node["inputs"][1:]:
+            out = _make(mx_op, out, g.inp(n))
+        return out
+    return imp
+
+
+register_importer("Max")(_minmax("maximum"))
+register_importer("Min")(_minmax("minimum"))
+
+
+def _unop(mx_op):
+    def imp(g, node):
+        return _make(mx_op, g.inp(node["inputs"][0]))
+    return imp
+
+
+for _onnx, _mx in [("Exp", "exp"), ("Log", "log"), ("Sqrt", "sqrt"),
+                   ("Neg", "negative"), ("Abs", "abs"), ("Floor", "floor"),
+                   ("Ceil", "ceil"), ("Round", "round"), ("Erf", "erf"),
+                   ("Sin", "sin"), ("Cos", "cos"), ("Tan", "tan"),
+                   ("Reciprocal", "reciprocal"), ("Sign", "sign")]:
+    register_importer(_onnx)(_unop(_mx))
+
+
+@register_importer("Constant")
+def _constant(g, node):
+    val = node["attrs"].get("value")
+    s = var(node["outputs"][0])
+    g.initializers[node["outputs"][0]] = np.asarray(val)
+    g.used_params.add(node["outputs"][0])
+    return s
+
+
+# ----------------------------------------------------------------- front end
+
+def import_model(model_file):
+    """ONNX file/bytes → (sym, arg_params, aux_params)
+    (ref: python/mxnet/onnx/onnx2mx/import_model.py:import_model).
+
+    aux_params holds BatchNorm running stats (inputs 3/4 of
+    BatchNormalization), matching MXNet's arg/aux split.
+    """
+    if isinstance(model_file, (bytes, bytearray)):
+        buf = bytes(model_file)
+    else:
+        with open(model_file, "rb") as f:
+            buf = f.read()
+    parsed = P.parse_model(buf)
+    graph = parsed["graph"]
+    g = _Graph(graph)
+
+    for vi in graph["inputs"]:
+        if vi["name"] not in g.initializers:
+            g.syms[vi["name"]] = var(vi["name"])
+
+    aux_names = set()
+    for node in graph["nodes"]:
+        if node["op"] == "BatchNormalization":
+            aux_names.update(node["inputs"][3:5])
+
+    for node in graph["nodes"]:
+        imp = _IMPORTERS.get(node["op"])
+        if imp is None:
+            raise ValueError("no importer for ONNX op %r" % node["op"])
+        out = imp(g, node)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for name, s in zip(node["outputs"], outs):
+            s.name = s.name if s.is_var() else name
+            g.syms[name] = s
+
+    out_syms = [g.syms[o["name"]] for o in graph["outputs"]]
+    sym_out = out_syms[0] if len(out_syms) == 1 else __import__(
+        "mxnet_tpu.symbol", fromlist=["Group"]).Group(out_syms)
+
+    arg_params, aux_params = {}, {}
+    for name in g.used_params:
+        arr = g.initializers[name]
+        (aux_params if name in aux_names else arg_params)[name] = arr
+    return sym_out, arg_params, aux_params
+
+
+def import_to_gluon(model_file, ctx=None):
+    """ONNX file → executable SymbolBlock
+    (ref: python/mxnet/onnx/onnx2mx/import_to_gluon.py)."""
+    import jax.numpy as jnp
+
+    from ..gluon.block import SymbolBlock
+    from ..gluon.parameter import Parameter
+
+    sym_out, arg_params, aux_params = import_model(model_file)
+    all_args = set(sym_out.list_arguments())
+    param_names = set(arg_params) | set(aux_params)
+    input_names = [n for n in all_args if n not in param_names]
+    inputs = [var(n) for n in input_names]
+    blk = SymbolBlock(sym_out, inputs)
+    for name, arr in {**arg_params, **aux_params}.items():
+        p = Parameter(name, shape=arr.shape)
+        p.set_data(jnp.asarray(arr))
+        blk._params._params[name] = p
+    return blk

@@ -115,7 +115,7 @@ def multibox_prior(data, *, sizes=(1.0,), ratios=(1.0,), steps=(-1.0, -1.0),
     return boxes
 
 
-@register_op("multibox_target")
+@register_op("multibox_target", n_outputs=3)
 def multibox_target(anchors, labels, cls_preds, *, overlap_threshold=0.5,
                     ignore_label=-1.0, negative_mining_ratio=3.0,
                     negative_mining_thresh=0.5, variances=(0.1, 0.1, 0.2, 0.2)):

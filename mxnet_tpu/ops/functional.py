@@ -607,7 +607,7 @@ def Pooling(x, *, kernel=1, pool_type="max", stride=None, pad=0,
     return s / jnp.maximum(cnt, 1.0)
 
 
-@register_op("BatchNorm", needs_training=True)
+@register_op("BatchNorm", needs_training=True, n_outputs=3)
 def BatchNorm(x, gamma, beta, moving_mean, moving_var, *, eps=1e-5, momentum=0.9,
               fix_gamma=False, use_global_stats=False, axis=1, training=False):
     """Returns (y, new_moving_mean, new_moving_var)

@@ -75,7 +75,7 @@ def _single_direction(x, h0, c0, wih, whh, bih, bhh, mode):
     return ys, h, c0
 
 
-@register_op("RNN", needs_rng=True, needs_training=True)
+@register_op("RNN", needs_rng=True, needs_training=True, n_outputs=3)
 def RNN(x, state_h, state_c, *weights, mode="lstm", num_layers=1,
         bidirectional=False, p=0.0, training=False, key=None):
     """x: (T, N, C); state_h/state_c: (L*D, N, H);

@@ -95,15 +95,19 @@ class Optimizer:
     def _step(self, w, g, state, lr, wd, t):
         raise NotImplementedError
 
-    def _preprocess_grad(self, g):
-        g = g * self.rescale_grad
+    def _preprocess_grad(self, g, rescale=None):
+        # rescale arrives as a traced scalar from update() so that
+        # Trainer.step(batch_size) mutating rescale_grad between steps never
+        # hits a stale compiled constant; compiled-train-step paths that bake
+        # it at build time (fixed batch) pass None and close over the value.
+        g = g * (self.rescale_grad if rescale is None else rescale)
         if self.clip_gradient is not None:
             g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
         return g
 
     def _stepper(self):
-        def step(w, g, state, lr, wd, t):
-            g = self._preprocess_grad(g)
+        def step(w, g, state, lr, wd, t, rescale=None):
+            g = self._preprocess_grad(g, rescale)
             if isinstance(state, dict) and "master" in state:
                 m = state["master"]
                 new_m, new_s = self._step(m, g.astype(jnp.float32), state["state"], lr, wd, t)
@@ -127,7 +131,8 @@ class Optimizer:
         if f is None:
             f = self._jit_step = jax.jit(self._stepper())
         new_w, new_state = f(weight._data, grad._data if isinstance(grad, NDArray) else grad,
-                             state, jnp.float32(lr), jnp.float32(wd), jnp.int32(t))
+                             state, jnp.float32(lr), jnp.float32(wd), jnp.int32(t),
+                             jnp.float32(self.rescale_grad))
         weight._data = new_w
         return new_state
 
@@ -138,7 +143,7 @@ class Optimizer:
         lazy_update touches only rows present in the sparse gradient)."""
         base = self._stepper()
 
-        def step(w, rows, gvals, state, lr, wd, t):
+        def step(w, rows, gvals, state, lr, wd, t, rescale=None):
             nrows = w.shape[0]
             # rows may contain nrows (out of bounds) as padding from
             # sparse.dense_to_row_sparse_padded: gathers fill 0, scatters drop.
@@ -151,7 +156,7 @@ class Optimizer:
 
             sub_state = jax.tree_util.tree_map(take, state)
             w_rows = jnp.take(w, rows, axis=0, mode="fill", fill_value=0)
-            new_rows, new_sub = base(w_rows, gvals, sub_state, lr, wd, t)
+            new_rows, new_sub = base(w_rows, gvals, sub_state, lr, wd, t, rescale)
 
             def put(leaf, new_leaf):
                 if hasattr(leaf, "shape") and leaf.shape[:1] == (nrows,) and \
@@ -173,7 +178,8 @@ class Optimizer:
         if f is None:
             f = self._jit_rsp_step = jax.jit(self._rsp_stepper())
         new_w, new_state = f(weight._data, grad.indices._data, grad.data._data,
-                             state, jnp.float32(lr), jnp.float32(wd), jnp.int32(t))
+                             state, jnp.float32(lr), jnp.float32(wd), jnp.int32(t),
+                             jnp.float32(self.rescale_grad))
         weight._data = new_w
         return new_state
 

@@ -22,7 +22,7 @@ __all__ = ["quantize", "dequantize", "quantized_fully_connected",
            "quantize_model"]
 
 
-@register_op("contrib_quantize", nondiff=True)
+@register_op("contrib_quantize", nondiff=True, n_outputs=2)
 def quantize(x, *, axis=None):
     """Symmetric int8: returns (q, scale). axis=None → per-tensor;
     axis=i → per-slice along dim i (ref: quantize_v2-inl.h)."""

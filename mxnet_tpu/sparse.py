@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .base import register_op
 from .ndarray import NDArray, invoke
 
 __all__ = ["CSRNDArray", "RowSparseNDArray", "csr_matrix", "row_sparse_array",
@@ -326,30 +327,32 @@ def add_n(*arrs):
     return out
 
 
-def _csr_dot_dense(csr, rhs, transpose_a=False):
+@register_op("_csr_dot")
+def _csr_dot(vals, indices, indptr, rhs, *, nrows, ncols, transpose_a=False):
     """Sparse csr x dense without densifying the lhs.
 
     Forward: out[r, :] = sum_{nnz in row r} data * rhs[col, :] — a gather over
     rhs rows followed by segment_sum by row id. transpose_a scatters into
     out[col, :] instead. Shapes are static in nnz, so both paths jit cleanly.
+    Registered as an op so autograd records it: gradients flow to vals and
+    rhs through the gather/segment_sum VJPs.
     (ref: src/operator/tensor/dot.cc DotCsrDnsDns / DotCsrTDnsDns)
     """
-    rows = csr._row_ids()
-    cols = csr.indices._data
-    vals = csr.data._data
+    nnz = vals.shape[0]
+    rows = jnp.searchsorted(indptr, jnp.arange(nnz), side="right") - 1
+    cols = indices
     if rhs.ndim == 1:                            # matvec
         if transpose_a:
-            out = jnp.zeros((csr.shape[1],), jnp.result_type(vals, rhs))
+            out = jnp.zeros((ncols,), jnp.result_type(vals, rhs))
             return out.at[cols].add(vals * rhs[rows])
-        return jax.ops.segment_sum(vals * rhs[cols], rows,
-                                   num_segments=csr.shape[0])
+        return jax.ops.segment_sum(vals * rhs[cols], rows, num_segments=nrows)
     if transpose_a:
         # (csr.T @ rhs)[c] += v * rhs[r] for each nnz (r, c, v)
         contrib = vals[:, None] * rhs[rows]      # (nnz, k)
-        out = jnp.zeros((csr.shape[1], rhs.shape[1]), contrib.dtype)
+        out = jnp.zeros((ncols, rhs.shape[1]), contrib.dtype)
         return out.at[cols].add(contrib)
     contrib = vals[:, None] * rhs[cols]          # (nnz, k)
-    return jax.ops.segment_sum(contrib, rows, num_segments=csr.shape[0])
+    return jax.ops.segment_sum(contrib, rows, num_segments=nrows)
 
 
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):
@@ -360,7 +363,9 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     the MXU.
     """
     if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray) and not transpose_b:
-        return NDArray(_csr_dot_dense(lhs, rhs._data, transpose_a))
+        return invoke("_csr_dot", (lhs.data, lhs.indices, lhs.indptr, rhs),
+                      {"nrows": lhs.shape[0], "ncols": lhs.shape[1],
+                       "transpose_a": transpose_a})
     if isinstance(lhs, (CSRNDArray, RowSparseNDArray)):
         lhs = lhs.todense()
     if isinstance(rhs, (CSRNDArray, RowSparseNDArray)):

@@ -15,7 +15,13 @@ def _builder(opname):
         sym_kwargs = {k: v for k, v in kwargs.items() if isinstance(v, Symbol)}
         attrs = {k: v for k, v in kwargs.items() if not isinstance(v, Symbol)}
         inputs = list(args) + list(sym_kwargs.values())
-        return _make(opname, *inputs, name=name, **attrs)
+        out = _make(opname, *inputs, name=name, **attrs)
+        # tuple-returning ops (OpDef.n_outputs > 1) are mirrored with _item
+        # projections so hybrid_forward unpacking works under symbol tracing
+        arity = _REG[opname].n_outputs if opname in _REG else 1
+        if arity > 1:
+            return tuple(out[i] for i in range(arity))
+        return out
 
     f.__name__ = opname
     return f
@@ -24,6 +30,20 @@ def _builder(opname):
 for _name in list(_REG):
     if not hasattr(_mod, _name):
         setattr(_mod, _name, _builder(_name))
+
+
+# creation ops: not registry entries (nd implements them directly), so the
+# symbol forms are explicit builders over the _filled op
+def zeros(shape, dtype="float32", ctx=None, name=None, **kwargs):
+    return _make("_filled", name=name, shape=tuple(shape), value=0.0, dtype=dtype)
+
+
+def ones(shape, dtype="float32", ctx=None, name=None, **kwargs):
+    return _make("_filled", name=name, shape=tuple(shape), value=1.0, dtype=dtype)
+
+
+def full(shape, val, dtype="float32", ctx=None, name=None, **kwargs):
+    return _make("_filled", name=name, shape=tuple(shape), value=val, dtype=dtype)
 
 
 def __getattr__(name):

@@ -119,6 +119,34 @@ class Symbol:
         return _make("negative", self)
 
     # ------------------------------------------------------------- evaluate
+    @property
+    def shape(self):
+        """Static shape of this symbol's output, inferred through the graph
+        when every argument var declares a shape (jax.eval_shape — the
+        nnvm-infer-shape equivalent). Lets shape-dependent hybrid_forward
+        logic (e.g. rnn_layer's initial-state sizing) trace symbolically
+        when the user supplies sym.var(name, shape=...)."""
+        if self._shape is not None:
+            return self._shape
+        if self.is_var():
+            raise ValueError(
+                "shape of variable %r unknown — declare it: var(%r, shape=...)"
+                % (self.name, self.name))
+        fn, names = self._build_fn()
+        specs = []
+        for a in self._arg_symbols():
+            if a._shape is None:
+                raise ValueError(
+                    "cannot infer shape through %r: variable %r has no "
+                    "declared shape (use var(name, shape=...))"
+                    % (self.name, a.name))
+            specs.append(jax.ShapeDtypeStruct(a._shape, a._dtype or jnp.float32))
+        out = jax.eval_shape(fn, *specs)
+        if isinstance(out, (list, tuple)):
+            out = out[self._out_index or 0]
+        self._shape = tuple(out.shape)
+        return self._shape
+
     def _build_fn(self):
         """Return (fn(feed_dict values in arg order) -> outputs, arg names)."""
         args = self._arg_symbols()
@@ -260,6 +288,11 @@ from .base import register_op  # noqa: E402
 @register_op("_const")
 def _const(*, value):
     return jnp.asarray(value, jnp.float32)
+
+
+@register_op("_filled")
+def _filled(*, shape, value, dtype="float32"):
+    return jnp.full(tuple(shape), value, resolve_dtype(dtype))
 
 
 @register_op("_item")

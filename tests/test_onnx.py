@@ -1,0 +1,111 @@
+"""ONNX export/import roundtrips (mirrors reference tests/python-pytest/onnx).
+No onnx pip package: the wire format is hand-rolled in mxnet_tpu/onnx/proto.py,
+so these tests are also the codec's spec tests."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu import onnx as mxonnx
+from mxnet_tpu.onnx import proto as P
+
+
+def test_proto_codec_roundtrip():
+    t = P.tensor_proto("w", np.arange(12, dtype=np.float32).reshape(3, 4))
+    name, arr = P.parse_tensor(t.tobytes())
+    assert name == "w" and arr.shape == (3, 4)
+    np.testing.assert_array_equal(arr, np.arange(12, dtype=np.float32).reshape(3, 4))
+
+    n = P.node_proto("Conv", ["x", "w"], ["y"], "conv0",
+                     {"kernel_shape": [3, 3], "group": 1, "alpha": 0.5,
+                      "mode": "constant", "axis": -1})
+    d = P.parse_node(n.tobytes())
+    assert d["op"] == "Conv" and d["attrs"]["kernel_shape"] == [3, 3]
+    assert d["attrs"]["axis"] == -1 and abs(d["attrs"]["alpha"] - 0.5) < 1e-6
+
+    g = P.graph_proto("g", [n], [P.value_info("x", np.float32, (1, 3, "H", 224))],
+                      [P.value_info("y", np.float32, (1, 8))], [t])
+    md = P.parse_model(P.model_proto(g, opset=13).tobytes())
+    assert md["opset"] == 13
+    assert md["graph"]["inputs"][0]["shape"] == [1, 3, "H", 224]
+    assert "w" in md["graph"]["initializers"]
+
+
+def test_cnn_roundtrip():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 3, padding=1), gluon.nn.BatchNorm(),
+            gluon.nn.Activation("relu"), gluon.nn.MaxPool2D(2),
+            gluon.nn.Conv2D(16, 3, padding=1), gluon.nn.LeakyReLU(0.1),
+            gluon.nn.GlobalAvgPool2D(), gluon.nn.Flatten(),
+            gluon.nn.Dense(32), gluon.nn.Activation("tanh"),
+            gluon.nn.Dropout(0.5), gluon.nn.Dense(10))
+    net.initialize()
+    x = nd.NDArray(np.random.RandomState(0).randn(2, 3, 16, 16).astype(np.float32))
+    y = net(x)
+    buf = mxonnx.export_model(net, input_shapes={"data": (2, 3, 16, 16)})
+    blk = mxonnx.import_to_gluon(buf)
+    np.testing.assert_allclose(blk(x).asnumpy(), y.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_embedding_layernorm_roundtrip():
+    class Net(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                self.emb = gluon.nn.Embedding(50, 16)
+                self.ln = gluon.nn.LayerNorm()
+                self.fc = gluon.nn.Dense(8, flatten=False)
+
+        def hybrid_forward(self, F, x):
+            return F.softmax(self.fc(self.ln(self.emb(x))), axis=-1)
+
+    net = Net()
+    net.initialize()
+    tok = nd.NDArray(np.random.RandomState(1).randint(0, 50, (4, 7)))
+    y = net(tok)
+    buf = mxonnx.export_model(net, input_shapes={"data": (4, 7)},
+                              input_types={"data": np.int64})
+    blk = mxonnx.import_to_gluon(buf)
+    np.testing.assert_allclose(blk(tok).asnumpy(), y.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_file_io(tmp_path):
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4))
+    net.initialize()
+    x = nd.ones((2, 6))
+    y = net(x)
+    path = str(tmp_path / "model.onnx")
+    out = mxonnx.export_model(net, input_shapes={"data": (2, 6)}, onnx_file=path)
+    assert out == path
+    sym, arg_params, aux_params = mxonnx.import_model(path)
+    assert len(arg_params) == 2 and not aux_params
+    blk = mxonnx.import_to_gluon(path)
+    np.testing.assert_allclose(blk(x).asnumpy(), y.asnumpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_resnet18_roundtrip():
+    from mxnet_tpu.gluon.model_zoo import vision
+    rn = vision.resnet18_v1()
+    rn.initialize()
+    x = nd.NDArray(np.random.RandomState(2).randn(1, 3, 32, 32).astype(np.float32))
+    y = rn(x)
+    buf = mxonnx.export_model(rn, input_shapes={"data": (1, 3, 32, 32)})
+    blk = mxonnx.import_to_gluon(buf)
+    np.testing.assert_allclose(blk(x).asnumpy(), y.asnumpy(), rtol=1e-3, atol=1e-4)
+
+
+def test_symbol_trace_parity():
+    """net(sym.var('data')) returns a Symbol graph evaluating identically."""
+    from mxnet_tpu import sym
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16), gluon.nn.Activation("relu"), gluon.nn.Dense(4))
+    net.initialize()
+    x = nd.NDArray(np.random.RandomState(3).randn(5, 8).astype(np.float32))
+    y = net(x)
+    s = net(sym.var("data"))
+    feed = {"data": x}
+    for p in net.collect_params().values():
+        feed[p.name] = p.data()
+    out = s.eval(**feed)
+    out = out[0] if isinstance(out, (list, tuple)) else out
+    np.testing.assert_allclose(out.asnumpy(), y.asnumpy(), rtol=1e-5, atol=1e-6)

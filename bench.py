@@ -77,16 +77,20 @@ def main():
     batch = make_batch(rng)
     key = jax.random.PRNGKey(0)
 
-    # warmup / compile
+    # warmup / compile. NOTE: under the axon relay block_until_ready can
+    # return before remote execution finishes, so timing is gated by a HOST
+    # TRANSFER of the final loss — step i+1 consumes step i's params, so
+    # fetching loss_N forces the entire chain to have really executed.
     params, states, loss = step(params, states, jnp.int32(1), key, batch)
-    jax.block_until_ready(loss)
+    float(loss)
 
-    iters = 20
+    iters = 50
     t0 = time.perf_counter()
     for i in range(iters):
         params, states, loss = step(params, states, jnp.int32(i + 2), key, batch)
-    jax.block_until_ready(loss)
+    final_loss = float(loss)
     dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss)
 
     samples_per_sec = BATCH * iters / dt
     print(json.dumps({

@@ -64,17 +64,58 @@ def save_sharded(directory, pytree, step=0):
     except Exception:
         os.makedirs(directory, exist_ok=True)
         flat, treedef = jax.tree_util.tree_flatten(pytree)
-        with open(os.path.join(directory, "step_%08d.pkl" % step), "wb") as f:
+        final = os.path.join(directory, "step_%08d.pkl" % step)
+        tmp = final + ".tmp"
+        # write-then-rename so a crash mid-save (the exact event resilience
+        # exists to survive) never leaves a truncated "latest" checkpoint
+        with open(tmp, "wb") as f:
             pickle.dump({"arrays": [np.asarray(a) for a in flat],
                          "treedef": str(treedef)}, f)
+        os.replace(tmp, final)
         return False
 
 
+def restore_sharded(directory, step, like):
+    """Restore a save_sharded checkpoint onto the structure of ``like``
+    (the usual jax restore idiom: the template supplies treedef + dtypes,
+    the checkpoint supplies values)."""
+    flat_like, treedef = jax.tree_util.tree_flatten(like)
+    pkl_path = os.path.join(directory, "step_%08d.pkl" % step)
+    if os.path.exists(pkl_path):
+        with open(pkl_path, "rb") as f:
+            blob = pickle.load(f)
+        flat = blob["arrays"]
+    else:
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.PyTreeCheckpointer()
+        restored = ckptr.restore(os.path.join(directory, "step_%08d" % step))
+        flat = jax.tree_util.tree_leaves(restored)
+    if len(flat) != len(flat_like):
+        raise ValueError("checkpoint has %d leaves, template has %d"
+                         % (len(flat), len(flat_like)))
+    flat = [jax.numpy.asarray(a, dtype=l.dtype) if hasattr(l, "dtype") else a
+            for a, l in zip(flat, flat_like)]
+    return jax.tree_util.tree_unflatten(treedef, flat)
+
+
+_STEP_RE = None
+
+
 def latest_step(directory):
+    """Largest completed step in the directory. Only exact 'step_NNNNNNNN'
+    dirs (orbax) or 'step_NNNNNNNN.pkl' files count — orbax's
+    '...-checkpoint-tmp-*' staging dirs and our '.tmp' files are in-flight
+    saves, not restorable checkpoints."""
+    global _STEP_RE
+    if _STEP_RE is None:
+        import re
+        _STEP_RE = re.compile(r"^step_(\d{8})(\.pkl)?$")
     if not os.path.isdir(directory):
         return None
     steps = []
     for name in os.listdir(directory):
-        if name.startswith("step_"):
-            steps.append(int(name[5:13]))
+        m = _STEP_RE.match(name)
+        if m:
+            steps.append(int(m.group(1)))
     return max(steps) if steps else None

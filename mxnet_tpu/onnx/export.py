@@ -374,13 +374,18 @@ def symbol_to_onnx(sym_out, params, input_shapes, input_dtypes=None,
         if s.is_var():
             continue
         if s._op == "_item":
-            # projection of a multi-output op: index 0 is the op's main output
+            # projection of a multi-output op: index 0 is the op's main
+            # output. Reaching an index>0 projection in the walk means the
+            # graph consumes a secondary output (e.g. BatchNorm's updated
+            # running stats) that no exported ONNX node produces.
             parent = s._inputs[0]
             idx = s._attrs.get("index", 0)
-            if idx == 0:
-                ctx.names[id(s)] = ctx.names[id(parent)]
-            else:
-                ctx.names[id(s)] = "%s_out%d" % (ctx.names[id(parent)], idx)
+            if idx != 0:
+                raise ValueError(
+                    "cannot export: graph consumes output %d of %r — only "
+                    "the primary output of multi-output ops maps to ONNX "
+                    "inference graphs" % (idx, parent._op))
+            ctx.names[id(s)] = ctx.names[id(parent)]
             continue
         ins = [ctx.names[id(i)] for i in s._inputs]
         out = ctx.fresh(s.name or s._op)

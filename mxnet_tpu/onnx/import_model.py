@@ -414,9 +414,10 @@ def import_to_gluon(model_file, ctx=None):
     from ..gluon.parameter import Parameter
 
     sym_out, arg_params, aux_params = import_model(model_file)
-    all_args = set(sym_out.list_arguments())
     param_names = set(arg_params) | set(aux_params)
-    input_names = [n for n in all_args if n not in param_names]
+    # list_arguments() is deterministic depth-first order — input binding in
+    # SymbolBlock.forward is positional, so order must be stable
+    input_names = [n for n in sym_out.list_arguments() if n not in param_names]
     inputs = [var(n) for n in input_names]
     blk = SymbolBlock(sym_out, inputs)
     for name, arr in {**arg_params, **aux_params}.items():

@@ -132,6 +132,27 @@ def unpack_floats(payload):
     return list(struct.unpack("<%df" % (len(payload) // 4), payload))
 
 
+def repeated_ints(raw_values):
+    """Decode a repeated int field that may arrive unpacked (ints) or packed
+    (proto3 default: one length-delimited blob of varints)."""
+    out = []
+    for raw in raw_values:
+        if isinstance(raw, (bytes, bytearray)):
+            out.extend(unpack_varints(raw))
+        else:
+            out.append(signed(raw))
+    return out
+
+
+def repeated_floats(raw_values):
+    """Decode a repeated float field: unpacked entries are 4-byte fixed32
+    chunks, packed entries are one blob of n*4 bytes."""
+    out = []
+    for raw in raw_values:
+        out.extend(unpack_floats(raw))
+    return out
+
+
 # ---------------------------------------------------------------- ONNX types
 
 # onnx.TensorProto.DataType
@@ -186,7 +207,7 @@ def tensor_proto(name, arr):
 def parse_tensor(buf):
     """TensorProto bytes → (name, np.ndarray)."""
     f = parse(buf)
-    dims = [signed(v) for v in f.get(1, [])]
+    dims = repeated_ints(f.get(1, []))
     code = f[2][0]
     name = f.get(8, [b""])[0].decode()
     if 9 in f:  # raw_data
@@ -255,12 +276,9 @@ def parse_attr(buf):
     if atype == ATTR_TENSOR:
         return name, parse_tensor(f[5][0])[1]
     if atype == ATTR_INTS:
-        vals = []
-        for raw in f.get(8, []):
-            vals.append(signed(raw) if isinstance(raw, int) else None)
-        return name, vals
+        return name, repeated_ints(f.get(8, []))
     if atype == ATTR_FLOATS:
-        return name, [struct.unpack("<f", raw)[0] for raw in f.get(7, [])]
+        return name, repeated_floats(f.get(7, []))
     if atype == ATTR_STRINGS:
         return name, [raw.decode() for raw in f.get(9, [])]
     raise ValueError("unsupported attribute type %d for %s" % (atype, name))

@@ -83,10 +83,10 @@ class Optimizer:
 
     # --------------------------------------------------------- functional core
     def create_state(self, index, weight):
-        state = self.init_state(weight._data if isinstance(weight, NDArray) else weight)
+        arr = getattr(weight, "_data", weight)  # NDArray, _Box shim, or raw array
+        state = self.init_state(arr)
         if self.multi_precision and weight.dtype in (jnp.bfloat16, jnp.float16):
-            master = (weight._data if isinstance(weight, NDArray) else weight).astype(jnp.float32)
-            return {"master": master, "state": state}
+            return {"master": arr.astype(jnp.float32), "state": state}
         return state
 
     def init_state(self, w):

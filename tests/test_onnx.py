@@ -30,6 +30,32 @@ def test_proto_codec_roundtrip():
     assert "w" in md["graph"]["initializers"]
 
 
+def test_parse_packed_repeated_fields():
+    """proto3 producers (the official onnx package) pack repeated ints into
+    one length-delimited blob; the parser must accept both encodings."""
+    m = P.Msg()
+    m.packed_varints(1, [2, 3, 4])          # TensorProto.dims, packed
+    m.varint(2, P.FLOAT)
+    m.bytes_(8, "w")
+    m.bytes_(9, np.zeros((2, 3, 4), "<f4").tobytes())
+    name, arr = P.parse_tensor(m.tobytes())
+    assert name == "w" and arr.shape == (2, 3, 4)
+
+    a = P.Msg()
+    a.bytes_(1, "kernel_shape")
+    a.packed_varints(8, [3, 3])             # AttributeProto.ints, packed
+    a.varint(20, P.ATTR_INTS)
+    nm, val = P.parse_attr(a.tobytes())
+    assert nm == "kernel_shape" and val == [3, 3]
+
+    fl = P.Msg()
+    fl.bytes_(1, "scales")
+    fl.packed_floats(7, [1.5, 2.0])         # AttributeProto.floats, packed
+    fl.varint(20, P.ATTR_FLOATS)
+    nm, val = P.parse_attr(fl.tobytes())
+    assert nm == "scales" and val == [1.5, 2.0]
+
+
 def test_cnn_roundtrip():
     net = gluon.nn.HybridSequential()
     net.add(gluon.nn.Conv2D(8, 3, padding=1), gluon.nn.BatchNorm(),

@@ -110,7 +110,7 @@ def latest_step(directory):
     global _STEP_RE
     if _STEP_RE is None:
         import re
-        _STEP_RE = re.compile(r"^step_(\d{8})(\.pkl)?$")
+        _STEP_RE = re.compile(r"^step_(\d{8,})(\.pkl)?$")  # %08d grows past 8 digits
     if not os.path.isdir(directory):
         return None
     steps = []

@@ -363,9 +363,24 @@ def _is_diff(x):
     return isinstance(x, NDArray) and jnp.issubdtype(x.dtype, jnp.inexact)
 
 
+_FAST_JIT = {}  # opname -> jitted fn with no static kwargs
+
+
 def invoke(opname, args, kwargs):
     """Imperative op invocation: unwrap → (record vjp | cached jit) → wrap."""
     opdef = OP_REGISTRY[opname]
+    # fast path: attr-less call outside recording — the per-op hot loop
+    # (MXNet equivalent: cached-op handle lookup skipping full FFI parse).
+    # Skipped for rng/training ops (key injection) and multi-output ops.
+    if (not kwargs and opdef.n_outputs == 1 and not opdef.needs_rng
+            and not opdef.needs_training and not autograd.is_recording()):
+        f = _FAST_JIT.get(opname)
+        if f is None:
+            f = _FAST_JIT[opname] = jax.jit(opdef.fn)
+        out = f(*[a._data if type(a) is NDArray else a for a in args])
+        if isinstance(out, jax.Array):
+            return NDArray(out)
+        return jax.tree_util.tree_map(NDArray, out)
     fn = opdef.fn
     kwargs = dict(kwargs)
     out_arr = kwargs.pop("out", None)
@@ -408,7 +423,8 @@ def invoke(opname, args, kwargs):
     else:
         f = jitted(fn, static)
         out = f(*map(_unwrap, args), **{k: _unwrap(v) for k, v in traced_kw.items()})
-        result = jax.tree_util.tree_map(NDArray, out)
+        result = (NDArray(out) if isinstance(out, jax.Array)
+                  else jax.tree_util.tree_map(NDArray, out))
 
     if out_arr is not None:
         src = result if isinstance(result, NDArray) else result[0]

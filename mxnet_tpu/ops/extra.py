@@ -1,0 +1,310 @@
+"""Remaining MXNet op families: legacy CamelCase aliases, elemwise_* names,
+regression output heads, and assorted tensor ops
+(ref: src/operator/tensor/*.cc, src/operator/regression_output.cc,
+src/operator/correlation.cc — TPU-native rewrites, everything jnp/lax).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import register_op
+from . import functional as F
+
+
+# ---------------------------------------------------------- legacy aliases
+# MXNet's original CamelCase symbol ops (ref: src/operator/tensor/matrix_op.cc
+# registrations keep both names alive; so do we, one fn per name).
+
+register_op("Reshape")(F.reshape)
+register_op("Flatten")(F.flatten)
+register_op("Cast")(F.cast)
+register_op("Concat")(F.concat)
+register_op("SwapAxis")(F.swapaxes)
+
+
+@register_op("elemwise_add")
+def elemwise_add(lhs, rhs):
+    """Same-shape add (ref: elemwise_binary_op_basic.cc). Unlike
+    broadcast_add, shapes must match exactly."""
+    assert lhs.shape == rhs.shape, "elemwise_add requires equal shapes"
+    return lhs + rhs
+
+
+@register_op("elemwise_sub")
+def elemwise_sub(lhs, rhs):
+    assert lhs.shape == rhs.shape, "elemwise_sub requires equal shapes"
+    return lhs - rhs
+
+
+@register_op("elemwise_mul")
+def elemwise_mul(lhs, rhs):
+    assert lhs.shape == rhs.shape, "elemwise_mul requires equal shapes"
+    return lhs * rhs
+
+
+@register_op("elemwise_div")
+def elemwise_div(lhs, rhs):
+    assert lhs.shape == rhs.shape, "elemwise_div requires equal shapes"
+    return lhs / rhs
+
+
+@register_op("add_n")
+def add_n(*args):
+    """Sum of N arrays in one fused kernel (ref: elemwise_sum.cc,
+    ElementWiseSum). XLA fuses the chain into a single loop."""
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+register_op("ElementWiseSum")(add_n)
+
+
+# ------------------------------------------------------------- tensor ops
+
+@register_op("argmax_channel")
+def argmax_channel(x):
+    """argmax over axis 1, squeezed (ref: broadcast_reduce_op_index.cc)."""
+    return jnp.argmax(x, axis=1).astype(jnp.float32)
+
+
+@register_op("batch_take")
+def batch_take(x, indices):
+    """out[i] = x[i, indices[i]] (ref: indexing_op.cc batch_take)."""
+    idx = indices.astype(jnp.int32)
+    return jnp.take_along_axis(x, idx[:, None], axis=1)[:, 0]
+
+
+@register_op("broadcast_axis")
+def broadcast_axis(x, *, axis, size):
+    """Broadcast size-1 axes to the requested sizes
+    (ref: broadcast_reduce_op_value.cc)."""
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    sizes = (size,) if isinstance(size, int) else tuple(size)
+    shape = list(x.shape)
+    for ax, s in zip(axes, sizes):
+        if shape[ax] != 1:
+            raise ValueError("broadcast_axis: axis %d has size %d != 1" % (ax, shape[ax]))
+        shape[ax] = s
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+register_op("broadcast_axes")(broadcast_axis)
+
+
+@register_op("hard_sigmoid")
+def hard_sigmoid(x, *, alpha=0.2, beta=0.5):
+    """(ref: mshadow_op.h hard_sigmoid)"""
+    return jnp.clip(alpha * x + beta, 0.0, 1.0)
+
+
+@register_op("reshape_like")
+def reshape_like(lhs, rhs):
+    return jnp.reshape(lhs, rhs.shape)
+
+
+@register_op("moments", n_outputs=2)
+def moments(x, *, axes=None, keepdims=False):
+    """Returns (mean, var) in one pass (ref: moments.cc)."""
+    ax = tuple(axes) if axes is not None else None
+    return (jnp.mean(x, axis=ax, keepdims=keepdims),
+            jnp.var(x, axis=ax, keepdims=keepdims))
+
+
+@register_op("unravel_index", nondiff=True)
+def unravel_index(indices, *, shape):
+    """Flat → multi index, stacked on a leading axis (ref: ravel.cc)."""
+    coords = jnp.unravel_index(indices.astype(jnp.int32), tuple(shape))
+    return jnp.stack(coords, axis=0)
+
+
+@register_op("ravel_multi_index", nondiff=True)
+def ravel_multi_index(coords, *, shape):
+    """Multi (leading axis) → flat index (ref: ravel.cc)."""
+    shape = tuple(shape)
+    strides = []
+    acc = 1
+    for s in reversed(shape):
+        strides.append(acc)
+        acc *= s
+    strides = jnp.asarray(list(reversed(strides)), coords.dtype)
+    return jnp.tensordot(strides, coords, axes=1)
+
+
+@register_op("SoftmaxActivation")
+def SoftmaxActivation(x, *, mode="instance"):
+    """(ref: softmax_activation.cc): mode='instance' softmaxes the trailing
+    flattened dims per sample; 'channel' softmaxes axis 1."""
+    if mode == "channel":
+        return jax.nn.softmax(x, axis=1)
+    flat = x.reshape(x.shape[0], -1)
+    return jax.nn.softmax(flat, axis=-1).reshape(x.shape)
+
+
+@register_op("shuffle", needs_rng=True, nondiff=True)
+def shuffle(x, *, key=None):
+    """Random permutation along axis 0 (ref: shuffle_op.cc)."""
+    return jax.random.permutation(key, x, axis=0)
+
+
+@register_op("relu6")
+def relu6(x):
+    return jnp.clip(x, 0.0, 6.0)
+
+
+# ------------------------------------------------- training output heads
+# MXNet's *Output ops are identity-like forward with a HARD-CODED backward:
+# d(data) = (out - label) * grad_scale, regardless of any loss applied on top
+# (ref: src/operator/regression_output-inl.h). jax.custom_vjp reproduces that
+# contract exactly.
+
+def _regression_output(transform, grad_fn, opname):
+    @jax.custom_vjp
+    def op(data, label, grad_scale=1.0):
+        return transform(data)
+
+    def fwd(data, label, grad_scale=1.0):
+        out = transform(data)
+        return out, (out, label, grad_scale)
+
+    def bwd(res, g):
+        out, label, grad_scale = res
+        n = label.size // (label.shape[0] if label.ndim else 1) or 1
+        dgrad = grad_fn(out, label.reshape(out.shape)) * grad_scale / n
+        return dgrad.astype(out.dtype), jnp.zeros_like(label), None
+
+    op.defvjp(fwd, bwd)
+
+    def wrapped(data, label, *, grad_scale=1.0):
+        return op(data, label, grad_scale)
+
+    wrapped.__name__ = opname
+    return wrapped
+
+
+register_op("LinearRegressionOutput")(_regression_output(
+    lambda d: d, lambda out, y: out - y, "LinearRegressionOutput"))
+
+register_op("MAERegressionOutput")(_regression_output(
+    lambda d: d, lambda out, y: jnp.sign(out - y), "MAERegressionOutput"))
+
+register_op("LogisticRegressionOutput")(_regression_output(
+    jax.nn.sigmoid, lambda out, y: out - y, "LogisticRegressionOutput"))
+
+
+@register_op("SVMOutput")
+def SVMOutput(data, label, *, margin=1.0, regularization_coefficient=1.0,
+              use_linear=False):
+    """Forward is identity; the SVM hinge gradient lives in backward
+    (ref: src/operator/svm_output-inl.h)."""
+    @jax.custom_vjp
+    def op(d, y):
+        return d
+
+    def fwd(d, y):
+        return d, (d, y)
+
+    def bwd(res, g):
+        d, y = res
+        yi = y.astype(jnp.int32)
+        onehot = jax.nn.one_hot(yi, d.shape[1], dtype=d.dtype)
+        signed = jnp.where(onehot > 0, -1.0, 1.0)
+        viol = (margin + signed * d) > 0
+        if use_linear:
+            grad = jnp.where(viol, signed, 0.0)
+        else:  # squared hinge
+            grad = jnp.where(viol, 2.0 * (margin + signed * d) * signed, 0.0)
+        return (regularization_coefficient * grad).astype(d.dtype), jnp.zeros_like(y)
+
+    op.defvjp(fwd, bwd)
+    return op(data, label)
+
+
+@register_op("MakeLoss")
+def MakeLoss(data, *, grad_scale=1.0, normalization="null", valid_thresh=0.0):
+    """Turn any symbol into a loss head: forward is identity, backward seeds
+    the gradient with grad_scale (ref: src/operator/make_loss.cc).
+    normalization='valid' divides by the count of elements > valid_thresh."""
+    @jax.custom_vjp
+    def op(d):
+        return d
+
+    def fwd(d):
+        return d, d
+
+    def bwd(d, g):
+        scale = jnp.asarray(grad_scale, d.dtype)
+        if normalization == "batch":
+            scale = scale / d.shape[0]
+        elif normalization == "valid":
+            valid = jnp.sum((d > valid_thresh).astype(d.dtype))
+            scale = scale / jnp.maximum(valid, 1)
+        return (jnp.broadcast_to(scale, d.shape).astype(d.dtype),)
+
+    op.defvjp(fwd, bwd)
+    return op(data)
+
+
+@register_op("Correlation")
+def Correlation(f1, f2, *, kernel_size=1, max_displacement=4, stride1=1,
+                stride2=1, pad_size=4, is_multiply=True):
+    """FlowNet-style correlation of two feature maps
+    (ref: src/operator/correlation.cu). TPU-native: every displacement is a
+    shifted elementwise product reduced over channels, then a kernel_size²
+    mean filter (reduce_window) for patch correlation — a static double loop
+    over (2d+1)² displacements that XLA fuses; no explicit patch extraction.
+    Output: (N, D*D, ceil(H/stride1), ceil(W/stride1))."""
+    n, c, h, w = f1.shape
+    d = max_displacement // stride2
+    p = int(pad_size)
+    shift_max = d * stride2
+    if p < shift_max:
+        raise ValueError("pad_size %d < max shift %d" % (p, shift_max))
+    f2p = jnp.pad(f2, ((0, 0), (0, 0), (p, p), (p, p)))
+    k = int(kernel_size)
+    outs = []
+    for dy in range(-d, d + 1):
+        for dx in range(-d, d + 1):
+            oy, ox = (dy * stride2 + p), (dx * stride2 + p)
+            shifted = jax.lax.dynamic_slice(f2p, (0, 0, oy, ox), (n, c, h, w))
+            if is_multiply:
+                corr = jnp.mean(f1 * shifted, axis=1)
+            else:
+                corr = jnp.mean(jnp.abs(f1 - shifted), axis=1)
+            if k > 1:
+                # patch correlation: k×k mean over the product map, SAME pad
+                corr = jax.lax.reduce_window(
+                    corr, 0.0, jax.lax.add, (1, k, k), (1, 1, 1), "SAME") / (k * k)
+            outs.append(corr)
+    out = jnp.stack(outs, axis=1)
+    if stride1 > 1:
+        out = out[:, :, ::stride1, ::stride1]
+    return out
+
+
+@register_op("IdentityAttachKLSparseReg")
+def IdentityAttachKLSparseReg(data, *, sparseness_target=0.1, penalty=0.001,
+                              momentum=0.9):
+    """Identity forward; backward adds the KL sparsity penalty gradient
+    penalty * (-ρ̂/ρ + (1-ρ̂)/(1-ρ)) where ρ is the per-unit batch-mean
+    activation (ref: src/operator/identity_attach_KL_sparse_reg.cc — the
+    reference keeps a momentum-smoothed ρ across batches; the functional
+    form uses the current batch's ρ, the stateless jit-safe equivalent)."""
+    t = sparseness_target
+
+    @jax.custom_vjp
+    def op(d):
+        return d
+
+    def fwd(d):
+        return d, d
+
+    def bwd(d, g):
+        rho = jnp.clip(jnp.mean(d, axis=0, keepdims=True), 1e-6, 1 - 1e-6)
+        kl_grad = penalty * (-t / rho + (1 - t) / (1 - rho))
+        return ((g + kl_grad.astype(d.dtype)),)
+
+    op.defvjp(fwd, bwd)
+    return op(data)

@@ -38,17 +38,24 @@ class Heartbeat:
         (jnp.zeros(()) + 1).block_until_ready()
         return time.time() - t0
 
-    def _run(self):
-        while not self._stop.wait(self.interval_s):
+    def _run(self, stop_evt):
+        while not stop_evt.wait(self.interval_s):
             elapsed = self._tick()
+            if stop_evt.is_set():
+                return  # stopped mid-tick: don't report, just exit
             if elapsed > self.timeout_s:
                 self.on_stall(elapsed)
             else:
                 self.last_ok = time.time()
 
     def start(self):
-        self._stop.clear()  # allow restart after stop() (resume drills)
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        # each start owns a fresh stop event; an old thread that is still
+        # mid-_tick (a device roundtrip — slow exactly when things stall)
+        # holds the previous event and exits on its next check, so restart
+        # never revives or doubles watchdogs
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, args=(self._stop,),
+                                        daemon=True)
         self._thread.start()
         return self
 

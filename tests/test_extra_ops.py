@@ -1,0 +1,122 @@
+"""Extra op families: legacy aliases, elemwise_*, output heads, Correlation
+(mirrors reference tests/python/unittest/test_operator.py coverage)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_legacy_aliases():
+    x = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_array_equal(nd.Reshape(x, shape=(4, 3)).asnumpy(),
+                                  x.asnumpy().reshape(4, 3))
+    np.testing.assert_array_equal(nd.Flatten(x).asnumpy(), x.asnumpy())
+    assert nd.Cast(x, dtype="int32").dtype == np.int32
+    y = nd.SwapAxis(x, dim1=0, dim2=1)
+    assert y.shape == (4, 3)
+    s = nd.ElementWiseSum(x, x, x)
+    np.testing.assert_allclose(s.asnumpy(), 3 * x.asnumpy())
+    np.testing.assert_allclose(nd.add_n(x, x).asnumpy(), 2 * x.asnumpy())
+
+
+def test_elemwise_named():
+    a = nd.array(np.random.RandomState(0).rand(2, 3).astype(np.float32) + 1)
+    b = nd.array(np.random.RandomState(1).rand(2, 3).astype(np.float32) + 1)
+    np.testing.assert_allclose(nd.elemwise_add(a, b).asnumpy(), a.asnumpy() + b.asnumpy())
+    np.testing.assert_allclose(nd.elemwise_sub(a, b).asnumpy(), a.asnumpy() - b.asnumpy())
+    np.testing.assert_allclose(nd.elemwise_mul(a, b).asnumpy(), a.asnumpy() * b.asnumpy())
+    np.testing.assert_allclose(nd.elemwise_div(a, b).asnumpy(), a.asnumpy() / b.asnumpy(),
+                               rtol=1e-6)
+
+
+def test_tensor_ops():
+    x = nd.array(np.random.RandomState(2).randn(2, 5, 3).astype(np.float32))
+    am = nd.argmax_channel(x)
+    np.testing.assert_array_equal(am.asnumpy(), np.argmax(x.asnumpy(), axis=1))
+
+    data = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    idx = nd.array(np.array([0, 2, 1, 0], dtype=np.int64))
+    bt = nd.batch_take(data, idx)
+    np.testing.assert_array_equal(bt.asnumpy(), [0, 5, 7, 9])
+
+    b = nd.broadcast_axis(nd.ones((1, 3, 1)), axis=(0, 2), size=(4, 5))
+    assert b.shape == (4, 3, 5)
+
+    hs = nd.hard_sigmoid(nd.array(np.array([-10.0, 0.0, 10.0], np.float32)))
+    np.testing.assert_allclose(hs.asnumpy(), [0.0, 0.5, 1.0])
+
+    rl = nd.reshape_like(nd.ones((6,)), nd.zeros((2, 3)))
+    assert rl.shape == (2, 3)
+
+    m, v = nd.moments(x, axes=(0, 2))
+    np.testing.assert_allclose(m.asnumpy(), x.asnumpy().mean(axis=(0, 2)), rtol=1e-5)
+    np.testing.assert_allclose(v.asnumpy(), x.asnumpy().var(axis=(0, 2)), rtol=1e-5)
+
+    flat = nd.array(np.array([0, 5, 11], np.int64))
+    multi = nd.unravel_index(flat, shape=(3, 4))
+    np.testing.assert_array_equal(multi.asnumpy(), np.stack(np.unravel_index([0, 5, 11], (3, 4))))
+    back = nd.ravel_multi_index(multi, shape=(3, 4))
+    np.testing.assert_array_equal(back.asnumpy(), [0, 5, 11])
+
+    r6 = nd.relu6(nd.array(np.array([-1.0, 3.0, 9.0], np.float32)))
+    np.testing.assert_allclose(r6.asnumpy(), [0.0, 3.0, 6.0])
+
+    sm = nd.SoftmaxActivation(nd.array(np.random.RandomState(3).randn(2, 4, 3).astype(np.float32)),
+                              mode="channel")
+    np.testing.assert_allclose(sm.asnumpy().sum(axis=1), np.ones((2, 3)), rtol=1e-5)
+
+
+def test_regression_outputs_backward():
+    """The *Output heads hard-code their backward: d(data) = out - label
+    (scaled), regardless of what's applied on top."""
+    rng = np.random.RandomState(4)
+    d = nd.array(rng.randn(4, 3).astype(np.float32))
+    y = nd.array(rng.randn(4, 3).astype(np.float32))
+    d.attach_grad()
+    with autograd.record():
+        out = nd.LinearRegressionOutput(d, y)
+        # arbitrary scaling on top must NOT affect the hard-coded grad
+        loss = (out * 123.0).sum()
+    loss.backward()
+    np.testing.assert_allclose(d.grad.asnumpy(),
+                               (d.asnumpy() - y.asnumpy()) / 3, rtol=1e-5)
+
+    d2 = nd.array(rng.randn(4, 1).astype(np.float32))
+    y2 = nd.array((rng.rand(4, 1) > 0.5).astype(np.float32))
+    d2.attach_grad()
+    with autograd.record():
+        p = nd.LogisticRegressionOutput(d2, y2)
+        p.sum().backward()
+    sig = 1 / (1 + np.exp(-d2.asnumpy()))
+    np.testing.assert_allclose(d2.grad.asnumpy(), sig - y2.asnumpy(), rtol=1e-5)
+
+
+def test_make_loss_grad():
+    d = nd.array(np.random.RandomState(5).randn(2, 3).astype(np.float32))
+    d.attach_grad()
+    with autograd.record():
+        out = nd.MakeLoss(d, grad_scale=2.0)
+    out.backward()
+    np.testing.assert_allclose(d.grad.asnumpy(), np.full((2, 3), 2.0))
+
+
+def test_correlation():
+    rng = np.random.RandomState(6)
+    f1 = rng.randn(1, 4, 6, 6).astype(np.float32)
+    f2 = rng.randn(1, 4, 6, 6).astype(np.float32)
+    out = nd.Correlation(nd.array(f1), nd.array(f2), max_displacement=2,
+                         stride1=1, stride2=1, pad_size=2)
+    assert out.shape == (1, 25, 6, 6)
+    # zero displacement channel (center of 5x5 grid = 12) equals mean over C
+    np.testing.assert_allclose(out.asnumpy()[:, 12], (f1 * f2).mean(axis=1),
+                               rtol=1e-5)
+    # displacement (dy=+1, dx=0) -> index 3*5+2=17: out[h] = f1[h]·f2[h+1]
+    expect = (f1 * np.pad(f2, ((0, 0), (0, 0), (0, 1), (0, 0)))[:, :, 1:7, :]).mean(axis=1)
+    np.testing.assert_allclose(out.asnumpy()[:, 17], expect, rtol=1e-5)
+
+
+def test_shuffle_permutes():
+    x = nd.array(np.arange(10, dtype=np.float32))
+    y = nd.shuffle(x)
+    assert sorted(y.asnumpy().tolist()) == list(range(10))

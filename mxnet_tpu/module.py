@@ -183,11 +183,49 @@ class BucketingModule(Module):
         sym, data_names, label_names = sym_gen(default_bucket_key)
         super().__init__(sym, data_names, label_names, context)
         self._buckets = {}
+        self._curr_module = None
 
     def switch_bucket(self, bucket_key, data_shapes=None):
         if bucket_key not in self._buckets:
             sym, data_names, label_names = self._sym_gen(bucket_key)
             m = Module(sym, data_names, label_names, self._ctx)
-            m._arg_params = self._arg_params  # shared weights across buckets
+            # buckets share weights, optimizer, and optimizer state — one
+            # model, several compiled shapes (ref: bucketing_module.py:
+            # shared_module binding)
+            m._arg_params = self._arg_params
+            m._opt_states = self._opt_states
             self._buckets[bucket_key] = m
-        return self._buckets[bucket_key]
+        m = self._buckets[bucket_key]
+        m._optimizer = getattr(self, "_optimizer", None)
+        self._curr_module = m
+        return m
+
+    def forward(self, data_batch, is_train=None):
+        """Route by the batch's bucket_key; each bucket is a cached compiled
+        executor (ref: bucketing_module.py:forward)."""
+        key = getattr(data_batch, "bucket_key", None)
+        key = self._default_key if key is None else key
+        m = self.switch_bucket(key)
+        return m.forward(data_batch, is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads)
+
+    def update(self):
+        self._curr_module.update()
+
+    def get_outputs(self):
+        return self._curr_module.get_outputs()
+
+    @property
+    def _exec(self):
+        # fit()/metrics read outputs via self._exec — route to the bucket
+        # module currently bound (base __init__'s write lands in __dict__
+        # via the setter below, used only before the first forward)
+        if getattr(self, "_curr_module", None) is not None:
+            return self._curr_module._exec
+        return self.__dict__.get("_exec_base")
+
+    @_exec.setter
+    def _exec(self, v):
+        self.__dict__["_exec_base"] = v

@@ -367,7 +367,17 @@ _FAST_JIT = {}  # opname -> jitted fn with no static kwargs
 
 
 def invoke(opname, args, kwargs):
-    """Imperative op invocation: unwrap → (record vjp | cached jit) → wrap."""
+    """Imperative op invocation: unwrap → (record vjp | cached jit) → wrap.
+    When the profiler runs, each dispatch is recorded as an 'operator' event
+    (ref: MXNet profiler operator events from the engine)."""
+    from . import profiler as _profiler
+    if _profiler._running and _profiler._config["profile_imperative"]:
+        with _profiler.op_scope(opname):
+            return _invoke_impl(opname, args, kwargs)
+    return _invoke_impl(opname, args, kwargs)
+
+
+def _invoke_impl(opname, args, kwargs):
     opdef = OP_REGISTRY[opname]
     # fast path: attr-less call outside recording — the per-op hot loop
     # (MXNet equivalent: cached-op handle lookup skipping full FFI parse).

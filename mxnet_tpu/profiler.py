@@ -2,25 +2,41 @@
 
 Wraps jax.profiler (XLA/TPU traces viewable in TensorBoard/Perfetto) and adds
 host-side named scopes with wall timers, mirroring MXNet's
-profiler.set_config/start/stop/dumps API.
+profiler.set_config/start/stop/dump/dumps API.
+
+Two outputs, like the reference:
+* ``dump()`` → Chrome trace-event JSON (chrome://tracing / Perfetto), host
+  scopes + imperative op dispatches as complete ('X') events;
+* ``dumps(aggregate_stats=True)`` → the MXNet-style aggregate table
+  (count/total/min/max/avg per name).
+The XLA-side trace (device kernels) goes to ``<filename>_trace/`` via
+jax.profiler and is viewable in TensorBoard — that covers what MXNet's
+device-side CUPTI counters report.
 """
 from __future__ import annotations
 
 import contextlib
 import json
+import os
+import threading
 import time
 
 import jax
 
-_config = {"profile_all": False, "filename": "profile.json"}
+_config = {"profile_all": False, "profile_imperative": True,
+           "filename": "profile.json", "aggregate_stats": False}
 _running = False
-_records = []
+_records = []          # {"name", "ts_us", "dur_ms", "cat"}
+_lock = threading.Lock()
+_epoch = time.perf_counter()
 
 
 def set_config(profile_all=False, profile_symbolic=True, profile_imperative=True,
                profile_memory=True, profile_api=True, filename="profile.json",
                aggregate_stats=False, **kwargs):
-    _config.update(profile_all=profile_all, filename=filename)
+    _config.update(profile_all=profile_all, filename=filename,
+                   profile_imperative=profile_imperative,
+                   aggregate_stats=aggregate_stats)
 
 
 def set_state(state="stop", profile_process="worker"):
@@ -28,6 +44,10 @@ def set_state(state="stop", profile_process="worker"):
         start()
     else:
         stop()
+
+
+def is_running():
+    return _running
 
 
 def start(profile_process="worker"):
@@ -61,16 +81,60 @@ def resume(profile_process="worker"):
     start()
 
 
+def _record(name, ts_us, dur_ms, cat="host"):
+    with _lock:
+        _records.append({"name": name, "ts_us": ts_us, "dur_ms": dur_ms,
+                         "cat": cat})
+
+
+def aggregate():
+    """MXNet-style aggregate stats: name → count/total/min/max/avg (ms)."""
+    stats = {}
+    with _lock:
+        recs = list(_records)
+    for r in recs:
+        s = stats.setdefault(r["name"], {"count": 0, "total_ms": 0.0,
+                                         "min_ms": float("inf"), "max_ms": 0.0})
+        s["count"] += 1
+        s["total_ms"] += r["dur_ms"]
+        s["min_ms"] = min(s["min_ms"], r["dur_ms"])
+        s["max_ms"] = max(s["max_ms"], r["dur_ms"])
+    for s in stats.values():
+        s["avg_ms"] = s["total_ms"] / s["count"]
+    return stats
+
+
 def dumps(reset=False):
-    out = json.dumps(_records, indent=2)
+    """Aggregate table when configured (MXNet aggregate_stats=True), else the
+    raw record list."""
+    if _config["aggregate_stats"]:
+        stats = aggregate()
+        lines = ["%-40s %8s %12s %10s %10s %10s" %
+                 ("Name", "Calls", "Total(ms)", "Min(ms)", "Max(ms)", "Avg(ms)")]
+        for name, s in sorted(stats.items(), key=lambda kv: -kv[1]["total_ms"]):
+            lines.append("%-40s %8d %12.3f %10.3f %10.3f %10.3f" %
+                         (name, s["count"], s["total_ms"], s["min_ms"],
+                          s["max_ms"], s["avg_ms"]))
+        out = "\n".join(lines)
+    else:
+        with _lock:
+            out = json.dumps(_records, indent=2)
     if reset:
-        _records.clear()
+        with _lock:
+            _records.clear()
     return out
 
 
 def dump(finished=True, profile_process="worker"):
+    """Write Chrome trace-event JSON (the format MXNet's profiler.dump
+    produces; open in chrome://tracing or Perfetto)."""
+    with _lock:
+        events = [{"name": r["name"], "cat": r.get("cat", "host"), "ph": "X",
+                   "ts": r["ts_us"], "dur": r["dur_ms"] * 1e3,
+                   "pid": os.getpid(), "tid": 0} for r in _records]
     with open(_config["filename"], "w") as f:
-        f.write(dumps())
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return _config["filename"]
 
 
 @contextlib.contextmanager
@@ -78,7 +142,20 @@ def scope(name="<unk>"):
     t0 = time.perf_counter()
     with jax.profiler.TraceAnnotation(name):
         yield
-    _records.append({"name": name, "dur_ms": (time.perf_counter() - t0) * 1e3})
+    t1 = time.perf_counter()
+    _record(name, (t0 - _epoch) * 1e6, (t1 - t0) * 1e3)
+
+
+@contextlib.contextmanager
+def op_scope(name):
+    """Instruments one imperative op dispatch (called from ndarray.invoke when
+    the profiler runs). Host-side cost only — device time is in the XLA trace;
+    dispatch is async so dur ≈ Python+dispatch overhead, like MXNet's
+    operator 'issue' events."""
+    t0 = time.perf_counter()
+    yield
+    t1 = time.perf_counter()
+    _record(name, (t0 - _epoch) * 1e6, (t1 - t0) * 1e3, cat="operator")
 
 
 class Task:
@@ -91,8 +168,9 @@ class Task:
 
     def stop(self):
         if self._t0 is not None:
-            _records.append({"name": self.name,
-                             "dur_ms": (time.perf_counter() - self._t0) * 1e3})
+            t1 = time.perf_counter()
+            _record(self.name, (self._t0 - _epoch) * 1e6,
+                    (t1 - self._t0) * 1e3)
 
 
 Frame = Task

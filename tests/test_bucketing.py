@@ -1,0 +1,72 @@
+"""BucketingModule: per-bucket compiled executors, shared weights/optimizer
+(mirrors reference tests/python/unittest/test_module.py bucketing cases)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym, nd
+from mxnet_tpu.io import DataBatch
+from mxnet_tpu.module import BucketingModule
+
+VOCAB, EMBED, NCLS = 20, 6, 4
+
+
+def _sym_gen(seq_len):
+    data = sym.var("data")
+    label = sym.var("softmax_label")
+    ew = sym.var("embed_weight", shape=(VOCAB, EMBED))
+    emb = sym.Embedding(data, ew, input_dim=VOCAB, output_dim=EMBED)
+    pooled = sym.mean(emb, axis=1)
+    fw = sym.var("fc_weight", shape=(NCLS, EMBED))
+    fb = sym.var("fc_bias", shape=(NCLS,))
+    fc = sym.FullyConnected(pooled, fw, fb, num_hidden=NCLS)
+    out = sym.SoftmaxOutput(fc, label)
+    return out, ("data",), ("softmax_label",)
+
+
+def _batch(seq_len, rng, batch=8):
+    tok = nd.array(rng.integers(0, VOCAB, (batch, seq_len)))
+    lab = nd.array(rng.integers(0, NCLS, (batch,)))
+    return DataBatch([tok], [lab], bucket_key=seq_len)
+
+
+def test_bucketing_module_trains_across_buckets():
+    rng = np.random.default_rng(0)
+    bm = BucketingModule(_sym_gen, default_bucket_key=5)
+    bm.bind([("data", (8, 5))], [("softmax_label", (8,))])
+    bm.init_params()
+    bm.init_optimizer(optimizer="sgd", optimizer_params={"learning_rate": 0.5})
+
+    fixed = {k: _batch(k, rng) for k in (3, 5, 7)}  # memorizable signal
+    first_losses, last_losses = {}, {}
+    for it in range(30):
+        seq_len = (3, 5, 7)[it % 3]
+        b = fixed[seq_len]
+        out = bm.forward(b, is_train=True)
+        probs = out[0].asnumpy()
+        lab = b.label[0].asnumpy().astype(int)
+        nll = -np.log(probs[np.arange(len(lab)), lab] + 1e-9).mean()
+        first_losses.setdefault(seq_len, nll)
+        last_losses[seq_len] = nll
+        bm.backward()
+        bm.update()
+
+    # one executor per distinct bucket, all sharing the same weight dict
+    assert sorted(bm._buckets) == [3, 5, 7]
+    mods = list(bm._buckets.values())
+    assert all(m._arg_params is bm._arg_params for m in mods)
+    assert all(m._opt_states is bm._opt_states for m in mods)
+    # training progressed in every bucket (shared weights learn from all)
+    for k in (3, 5, 7):
+        assert last_losses[k] < first_losses[k], (k, first_losses[k], last_losses[k])
+
+
+def test_bucketing_default_key_when_batch_has_none():
+    rng = np.random.default_rng(1)
+    bm = BucketingModule(_sym_gen, default_bucket_key=4)
+    bm.bind([("data", (8, 4))], [("softmax_label", (8,))])
+    bm.init_params()
+    b = _batch(4, rng)
+    b.bucket_key = None
+    out = bm.forward(b, is_train=False)
+    assert out[0].shape == (8, NCLS)
+    assert list(bm._buckets) == [4]

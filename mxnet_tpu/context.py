@@ -85,7 +85,7 @@ class Context:
         stack = getattr(_tls, "stack", None)
         if stack:
             return stack[-1]
-        return _default
+        return _resolve_default()
 
 
 def cpu(device_id=0):
@@ -127,4 +127,29 @@ def context_from_device(dev) -> Context:
 
 # Default context: the accelerator if present, else cpu — unlike MXNet (cpu
 # default) because on this stack there is always exactly one sensible device.
-_default = Context("tpu", 0) if jax.default_backend() != "cpu" else Context("cpu", 0)
+#
+# Resolution is LAZY (first use, not import): upstream MXNet likewise imports
+# cleanly with zero GPUs (python/mxnet/context.py resolves devices on demand).
+# Probing `jax.default_backend()` at import time turned a transiently
+# unavailable backend into a crash of *every* entry point.
+_default = None
+
+
+def _resolve_default():
+    global _default
+    if _default is None:
+        try:
+            backend = jax.default_backend()
+        except RuntimeError as e:  # backend unavailable: fall back, warn
+            import warnings
+
+            warnings.warn(
+                "mxnet_tpu: accelerator backend unavailable (%s); "
+                "defaulting to cpu for this call"
+                % ((str(e).splitlines() or [""])[0],)
+            )
+            # do NOT cache: a transiently-down backend should not pin the
+            # process to cpu forever; retry resolution on the next call
+            return Context("cpu", 0)
+        _default = Context("cpu", 0) if backend == "cpu" else Context("tpu", 0)
+    return _default

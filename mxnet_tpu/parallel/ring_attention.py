@@ -22,8 +22,14 @@ from jax.sharding import PartitionSpec as P
 from .mesh import get_shard_map
 
 
-def _ring_attn_local(q, k, v, axis_name, causal, scale):
-    n = lax.psum(1, axis_name)
+def _ring_attn_local(q, k, v, axis_name, n, causal, scale):
+    """One device's shard of the ring. ``n`` (ring length) is a STATIC python
+    int — the mesh axis size — so the loop is a ``lax.scan`` of known length
+    and the whole thing is reverse-mode differentiable (``ppermute``
+    transposes to the inverse rotation, so the backward pass is itself a ring
+    in the opposite direction). r1 used ``fori_loop`` with a traced
+    ``psum(1, axis)`` bound, which cannot be transposed.
+    """
     my = lax.axis_index(axis_name)
     Tq = q.shape[2]
     Tk = k.shape[2]
@@ -32,8 +38,14 @@ def _ring_attn_local(q, k, v, axis_name, causal, scale):
     o0 = jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32)
     l0 = jnp.zeros(q.shape[:3], jnp.float32)
     m0 = jnp.full(q.shape[:3], -jnp.inf, jnp.float32)
+    perm = [(j, (j + 1) % n) for j in range(n)]
 
-    def body(i, carry):
+    # checkpoint: backward recomputes the (Tq, Tk) score block per step
+    # instead of saving n of them — avoids the O(T²/n) score residuals; the
+    # scan still saves each step's carry (o/l/m + visiting k/v block), so
+    # activation memory is O(T · D) per device
+    @jax.checkpoint
+    def body(carry, i):
         o, l, m, k_cur, v_cur = carry
         src = (my - i) % n  # which global shard this k/v block came from
         s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_cur.astype(jnp.float32))
@@ -50,23 +62,28 @@ def _ring_attn_local(q, k, v, axis_name, causal, scale):
         corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
         o = o * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32))
         l = l * corr + jnp.sum(p, axis=-1)
-        perm = [(j, (j + 1) % n) for j in range(n)]
         k_next = lax.ppermute(k_cur, axis_name, perm)
         v_next = lax.ppermute(v_cur, axis_name, perm)
-        return o, l, m_new, k_next, v_next
+        return (o, l, m_new, k_next, v_next), None
 
-    o, l, m, _, _ = lax.fori_loop(0, n, body, (o0, l0, m0, k, v))
+    (o, l, m, _, _), _ = lax.scan(body, (o0, l0, m0, k, v), jnp.arange(n))
     out = o / jnp.maximum(l, 1e-30)[..., None]
     return out.astype(q.dtype)
 
 
 def ring_attention(q, k, v, mesh, axis_name="sp", causal=False, scale=None):
-    """q,k,v: (B, H, T, D) with T sharded over `axis_name` on `mesh`."""
+    """q,k,v: (B, H, T, D) with T sharded over `axis_name` on `mesh`.
+
+    Differentiable: gradients flow through the scan + ppermute ring (the
+    transpose rotates cotangents the opposite way around the ring), so this
+    is the training path for sp-sharded long context, not just inference.
+    """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     sm = get_shard_map()
     spec = P(None, None, axis_name, None)
-    f = sm(functools.partial(_ring_attn_local, axis_name=axis_name,
+    n = int(mesh.shape[axis_name])
+    f = sm(functools.partial(_ring_attn_local, axis_name=axis_name, n=n,
                              causal=causal, scale=scale),
            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return f(q, k, v)

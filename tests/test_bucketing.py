@@ -23,20 +23,33 @@ def _sym_gen(seq_len):
     return out, ("data",), ("softmax_label",)
 
 
-def _batch(seq_len, rng, batch=8):
-    tok = nd.array(rng.integers(0, VOCAB, (batch, seq_len)))
-    lab = nd.array(rng.integers(0, NCLS, (batch,)))
-    return DataBatch([tok], [lab], bucket_key=seq_len)
+def _batch(seq_len, rng, batch=8, learnable=False):
+    if learnable:
+        # constant-token rows: pooled embedding == embed[token] for every
+        # seq_len, and label = token % NCLS is the SAME map in every bucket —
+        # shared weights learn a consistent signal (random labels conflict
+        # across buckets, which is what made the r1 assertion flaky)
+        tok_np = np.repeat(rng.integers(0, VOCAB, (batch, 1)), seq_len, axis=1)
+        lab_np = tok_np[:, 0] % NCLS
+    else:
+        tok_np = rng.integers(0, VOCAB, (batch, seq_len))
+        lab_np = rng.integers(0, NCLS, (batch,))
+    return DataBatch([nd.array(tok_np)], [nd.array(lab_np)], bucket_key=seq_len)
 
 
 def test_bucketing_module_trains_across_buckets():
     rng = np.random.default_rng(0)
     bm = BucketingModule(_sym_gen, default_bucket_key=5)
     bm.bind([("data", (8, 5))], [("softmax_label", (8,))])
-    bm.init_params()
+    # Uniform(0.5): the default 0.01 init leaves embeddings ~0, so the model is
+    # bias-only for the first ~100 steps and the shared bias converging to the
+    # AGGREGATE label prior raises the loss of any bucket whose prior deviates
+    # (the r1 flake, verified oracle-exact below). A real init lets the
+    # embedding learn the consistent token→label map in every bucket.
+    bm.init_params(initializer=mx.init.Uniform(0.5))
     bm.init_optimizer(optimizer="sgd", optimizer_params={"learning_rate": 0.5})
 
-    fixed = {k: _batch(k, rng) for k in (3, 5, 7)}  # memorizable signal
+    fixed = {k: _batch(k, rng, learnable=True) for k in (3, 5, 7)}
     first_losses, last_losses = {}, {}
     for it in range(30):
         seq_len = (3, 5, 7)[it % 3]
@@ -58,6 +71,53 @@ def test_bucketing_module_trains_across_buckets():
     # training progressed in every bucket (shared weights learn from all)
     for k in (3, 5, 7):
         assert last_losses[k] < first_losses[k], (k, first_losses[k], last_losses[k])
+
+
+def test_bucketing_matches_numpy_oracle():
+    """Interleaved cross-bucket training tracks a hand-rolled numpy SGD
+    oracle over the same batch sequence: per-step losses within 1e-5 AND
+    final weights within 1e-5 — shared-weight / shared-optimizer-state
+    mechanics have no staleness or aliasing (the r1 'interference' was
+    genuine gradient dynamics, which the oracle reproduces)."""
+    rng = np.random.default_rng(0)
+    bm = BucketingModule(_sym_gen, default_bucket_key=5)
+    bm.bind([("data", (8, 5))], [("softmax_label", (8,))])
+    bm.init_params()
+    bm.init_optimizer(optimizer="sgd", optimizer_params={"learning_rate": 0.5})
+    W = {k: v.asnumpy().copy() for k, v in bm._arg_params.items()}
+    fixed = {k: _batch(k, rng) for k in (3, 5, 7)}  # adversarial random labels
+
+    def oracle_step(b, lr=0.5):
+        tok = b.data[0].asnumpy().astype(int)
+        lab = b.label[0].asnumpy().astype(int)
+        pooled = W["embed_weight"][tok].mean(1)
+        logits = pooled @ W["fc_weight"].T + W["fc_bias"]
+        ex = np.exp(logits - logits.max(1, keepdims=True))
+        p = ex / ex.sum(1, keepdims=True)
+        nll = -np.log(p[np.arange(8), lab] + 1e-9).mean()
+        dlogits = (p - np.eye(NCLS)[lab]) / 8
+        dpooled = dlogits @ W["fc_weight"]
+        gemb = np.zeros_like(W["embed_weight"])
+        for i in range(8):
+            for t in range(tok.shape[1]):
+                gemb[tok[i, t]] += dpooled[i] / tok.shape[1]
+        W["fc_weight"] -= lr * (dlogits.T @ pooled)
+        W["fc_bias"] -= lr * dlogits.sum(0)
+        W["embed_weight"] -= lr * gemb
+        return nll
+
+    for it in range(12):
+        b = fixed[(3, 5, 7)[it % 3]]
+        out = bm.forward(b, is_train=True)
+        probs = out[0].asnumpy()
+        lab = b.label[0].asnumpy().astype(int)
+        nll_mod = -np.log(probs[np.arange(8), lab] + 1e-9).mean()
+        bm.backward()
+        bm.update()
+        nll_orc = oracle_step(b)
+        assert abs(nll_mod - nll_orc) < 1e-5, (it, nll_mod, nll_orc)
+    for k, v in bm._arg_params.items():
+        np.testing.assert_allclose(v.asnumpy(), W[k], atol=1e-5, err_msg=k)
 
 
 def test_bucketing_default_key_when_batch_has_none():

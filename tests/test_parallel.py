@@ -28,6 +28,30 @@ def test_ring_attention_matches_full():
     assert float(jnp.abs(out - ref).max()) < 1e-4
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_gradients_match_full(causal):
+    """sp-sharded BACKWARD parity: grads of ring attention w.r.t. q/k/v match
+    dense attention (long-context training path, VERDICT r1 weak #6)."""
+    mesh = parallel.make_mesh({"sp": 8})
+    B, H, T, D = 2, 2, 64, 8
+    ks = jax.random.split(jax.random.PRNGKey(3), 4)
+    q, k, v = (jax.random.normal(kk, (B, H, T, D)) for kk in ks[:3])
+    ct = jax.random.normal(ks[3], (B, H, T, D))  # random cotangent
+
+    def loss_ref(q, k, v):
+        return jnp.sum(parallel.full_attention(q, k, v, causal=causal) * ct)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(parallel.ring_attention(q, k, v, mesh, causal=causal) * ct)
+
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    sh = lambda x: parallel.shard_array(x, mesh, None, None, "sp", None)
+    gs = jax.grad(loss_ring, argnums=(0, 1, 2))(sh(q), sh(k), sh(v))
+    for a, b, name in zip(gr, gs, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=1e-3, err_msg=name)
+
+
 def test_dp_train_step_matches_single_device():
     """Compiled dp step over 8 devices == single-device step (SURVEY §4)."""
     opt = mx.optimizer.SGD(learning_rate=0.1)

@@ -95,12 +95,22 @@ def make_batch(rng):
 
 
 def main():
+    # Device init over the relay either succeeds in ~seconds or blocks for
+    # many minutes before raising UNAVAILABLE (observed: 25 min). Retry a
+    # couple of times — transient relay outages recover — then fail loudly.
     _log("initializing backend (%s)..." % os.environ.get("JAX_PLATFORMS", "auto"))
-    try:
-        devs = jax.devices()
-    except RuntimeError as e:
-        _log("backend unavailable: %s" % (str(e).splitlines() or [""])[0])
-        raise
+    devs = None
+    for attempt in range(3):
+        try:
+            devs = jax.devices()
+            break
+        except RuntimeError as e:
+            _log("backend init attempt %d failed: %s"
+                 % (attempt + 1, (str(e).splitlines() or [""])[0]))
+            time.sleep(30)
+    if devs is None:
+        _log("backend unavailable after retries; aborting")
+        raise SystemExit(1)
     _log("devices: %s" % (devs,))
 
     rng = np.random.default_rng(0)

@@ -36,18 +36,17 @@ class Module:
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, **kwargs):
+        if self.binded and not force_rebind:
+            return
         shapes = {}
         for name, shape in data_shapes:
-            shapes[name] = shape
+            shapes[name] = tuple(shape)
         for name, shape in (label_shapes or []):
-            shapes[name] = shape
-        arg_names = self._symbol.list_arguments()
-        for n in arg_names:
-            if n not in shapes:
-                # infer param shapes by shape inference over known inputs
-                pass
+            shapes[name] = tuple(shape)
         self._data_shapes = shapes
         self._for_training = for_training
+        self._inputs_need_grad = inputs_need_grad
+        self._exec = None
         self.binded = True
 
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
@@ -70,25 +69,24 @@ class Module:
         self.params_initialized = True
 
     def _infer_param_shapes(self):
-        # run shape inference by providing data/label shapes
-        known = dict(self._data_shapes)
-        fn, names = self._symbol._build_fn()
-        import jax
+        """Infer every argument's shape from the bound data/label shapes —
+        graph shape inference (ref: src/executor/graph_executor.cc infer
+        pass), so params need no declared shape= on their variables."""
+        from .shape_inference import format_infer_errors, infer_shapes_partial
 
-        # iterative: assume unknown params can be resolved only if declared
-        shapes = {}
-        for n in names:
-            if n in known:
-                shapes[n] = known[n]
-            else:
-                s = next(a for a in self._symbol._arg_symbols() if a.name == n)._shape
-                if s is None:
-                    raise ValueError(
-                        "cannot infer shape of %s; declare shape= on the variable" % n)
-                shapes[n] = s
-        return shapes
+        known = dict(self._data_shapes)
+        var_shapes, _, errors = infer_shapes_partial(self._symbol, known)
+        missing = [n for n, s in var_shapes.items() if s is None]
+        if missing:
+            raise ValueError(
+                "shape inference could not determine %s from data shapes %s; "
+                "declare shape= on those variables%s"
+                % (missing, known, format_infer_errors(errors)))
+        return var_shapes
 
     def forward(self, data_batch, is_train=None):
+        if is_train is None:
+            is_train = getattr(self, "_for_training", True)
         feed = {}
         for name, arr in zip(self._data_names, data_batch.data):
             feed[name] = arr
@@ -103,6 +101,11 @@ class Module:
                     args[n] = feed[n]
             grads = {n: NDArray(jnp.zeros_like(a._data))
                      for n, a in self._arg_params.items()}
+            if getattr(self, "_inputs_need_grad", False):
+                for n in self._data_names:
+                    a = feed[n]
+                    d = a._data if isinstance(a, NDArray) else jnp.asarray(a)
+                    grads[n] = NDArray(jnp.zeros_like(d))
             self._exec = self._symbol.bind(self._ctx, args, grads)
         self._exec.forward(is_train=bool(is_train), **feed)
         return self._exec.outputs
@@ -122,6 +125,13 @@ class Module:
 
     def get_outputs(self):
         return self._exec.outputs
+
+    def get_input_grads(self):
+        """(ref: module/base_module.py:get_input_grads) — requires
+        bind(inputs_need_grad=True)."""
+        assert getattr(self, "_inputs_need_grad", False), \
+            "bind with inputs_need_grad=True"
+        return [self._exec.grad_dict[n] for n in self._data_names]
 
     def init_optimizer(self, kvstore="local", optimizer="sgd", optimizer_params=None,
                        force_init=False):

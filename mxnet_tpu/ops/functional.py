@@ -516,6 +516,10 @@ def khatri_rao(*xs):
 def FullyConnected(x, weight, bias=None, *, num_hidden=None, no_bias=False, flatten=True):
     """y = x @ W^T + b, weight (num_hidden, in) as in MXNet
     (ref: src/operator/nn/fully_connected.cc). Maps straight onto the MXU."""
+    if num_hidden is not None and weight.shape[0] != num_hidden:
+        raise ValueError(
+            "FullyConnected: weight rows %d != num_hidden %d (infer-shape "
+            "mismatch)" % (weight.shape[0], num_hidden))
     if flatten and x.ndim > 2:
         x = jnp.reshape(x, (x.shape[0], -1))
     y = jnp.matmul(x, weight.T)
@@ -532,10 +536,14 @@ def _pair(v, n=2):
 
 @register_op("Convolution")
 def Convolution(x, weight, bias=None, *, kernel=None, stride=1, pad=0, dilate=1,
-                num_group=1, no_bias=False, layout="NCHW"):
+                num_group=1, num_filter=None, no_bias=False, layout="NCHW"):
     """N-d convolution via lax.conv_general_dilated (ref:
     src/operator/nn/convolution.cc; cuDNN path replaced by XLA:TPU which tiles
     convs onto the MXU)."""
+    if num_filter is not None and weight.shape[0] != num_filter:
+        raise ValueError(
+            "Convolution: weight out-channels %d != num_filter %d (infer-"
+            "shape mismatch)" % (weight.shape[0], num_filter))
     nd = x.ndim - 2
     stride = _pair(stride, nd)
     pad = _pair(pad, nd)
@@ -557,7 +565,11 @@ def Convolution(x, weight, bias=None, *, kernel=None, stride=1, pad=0, dilate=1,
 
 @register_op("Deconvolution")
 def Deconvolution(x, weight, bias=None, *, kernel=None, stride=1, pad=0, dilate=1,
-                  num_group=1, adj=0, no_bias=False, layout="NCHW"):
+                  num_group=1, num_filter=None, adj=0, no_bias=False, layout="NCHW"):
+    if num_filter is not None and weight.shape[1] * num_group != num_filter:
+        raise ValueError(
+            "Deconvolution: weight out-channels %d != num_filter %d (infer-"
+            "shape mismatch)" % (weight.shape[1] * num_group, num_filter))
     nd = x.ndim - 2
     stride = _pair(stride, nd)
     pad = _pair(pad, nd)

@@ -1,0 +1,232 @@
+"""Graph shape inference with parameter-shape deduction.
+
+MXNet's executor infers every argument's shape from the data shapes alone
+(ref: src/executor/graph_executor.cc infer pass, nnvm's InferShape attribute:
+each op propagates shapes both forward to outputs and backward into unshaped
+weight inputs, iterating to a fixpoint). The TPU-native equivalent:
+forward-propagate shapes through the Symbol DAG with ``jax.eval_shape`` per
+node, apply per-op PARAM rules (the backward direction of nnvm's InferShape)
+to assign still-unknown parameter inputs from op attrs + data-input shapes,
+and repeat passes until no new variable resolves — so resolution does not
+depend on traversal order (a weight may be *used* before the node that
+determines its shape is visited, e.g. weight-decay terms or tied embeddings).
+
+``sym.var("fc_weight")`` therefore needs no ``shape=`` as long as the graph's
+data inputs are shaped — same contract as MXNet's ``simple_bind``.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["infer_shapes_partial", "PARAM_SHAPE_RULES"]
+
+# op name -> fn(node, in_shapes) -> {input_index: shape} for unshaped
+# parameter inputs. Only consulted when at least one input shape is unknown.
+PARAM_SHAPE_RULES = {}
+
+
+def param_rule(op_name):
+    def deco(fn):
+        PARAM_SHAPE_RULES[op_name] = fn
+        return fn
+    return deco
+
+
+def _conv_in_channels(x_shape, layout):
+    # our conv ops keep OIHW weights for every data layout; only the data's
+    # channel position depends on layout
+    return x_shape[1] if (layout or "NCHW").startswith("NC") else x_shape[-1]
+
+
+@param_rule("FullyConnected")
+def _fc_rule(node, ins):
+    x = ins[0]
+    nh = node._attrs.get("num_hidden")
+    if x is None or nh is None:
+        return {}
+    flatten = node._attrs.get("flatten", True)
+    in_dim = math.prod(x[1:]) if (flatten and len(x) > 2) else x[-1]
+    out = {1: (nh, in_dim)}
+    if len(node._inputs) > 2:
+        out[2] = (nh,)
+    return out
+
+
+@param_rule("Convolution")
+def _conv_rule(node, ins):
+    x = ins[0]
+    nf = node._attrs.get("num_filter")
+    kernel = node._attrs.get("kernel")
+    if x is None or nf is None or kernel is None:
+        return {}
+    kernel = (kernel,) if isinstance(kernel, int) else tuple(kernel)
+    ng = node._attrs.get("num_group", 1)
+    c = _conv_in_channels(x, node._attrs.get("layout"))
+    out = {1: (nf, c // ng) + kernel}
+    if len(node._inputs) > 2:
+        out[2] = (nf,)
+    return out
+
+
+@param_rule("Deconvolution")
+def _deconv_rule(node, ins):
+    x = ins[0]
+    nf = node._attrs.get("num_filter")
+    kernel = node._attrs.get("kernel")
+    if x is None or nf is None or kernel is None:
+        return {}
+    kernel = (kernel,) if isinstance(kernel, int) else tuple(kernel)
+    ng = node._attrs.get("num_group", 1)
+    c = _conv_in_channels(x, node._attrs.get("layout"))
+    # MXNet deconv weight layout: (in_channels, num_filter/num_group, *kernel)
+    out = {1: (c, nf // ng) + kernel}
+    if len(node._inputs) > 2:
+        out[2] = (nf,)
+    return out
+
+
+@param_rule("BatchNorm")
+def _bn_rule(node, ins):
+    x = ins[0]
+    if x is None:
+        return {}
+    c = x[node._attrs.get("axis", 1)]
+    return {i: (c,) for i in range(1, len(node._inputs))}
+
+
+@param_rule("InstanceNorm")
+def _in_rule(node, ins):
+    x = ins[0]
+    if x is None:
+        return {}
+    return {i: (x[1],) for i in range(1, len(node._inputs))}
+
+
+@param_rule("LayerNorm")
+def _ln_rule(node, ins):
+    x = ins[0]
+    if x is None:
+        return {}
+    c = x[node._attrs.get("axis", -1)]
+    return {i: (c,) for i in range(1, len(node._inputs))}
+
+
+@param_rule("Embedding")
+def _embed_rule(node, ins):
+    di = node._attrs.get("input_dim")
+    do = node._attrs.get("output_dim")
+    if di is None or do is None:
+        return {}
+    return {1: (di, do)}
+
+
+def _as_shapes(out):
+    if isinstance(out, (list, tuple)):
+        return [tuple(o.shape) for o in out]
+    return tuple(out.shape)
+
+
+def infer_shapes_partial(sym, known, int_vars=()):
+    """Infer shapes through ``sym``'s DAG given ``known`` var-name→shape.
+
+    Returns ``(var_shapes, out_shape, errors)``: ``var_shapes`` maps every
+    free variable to its inferred shape (or None if undeterminable),
+    ``out_shape`` is the output shape (tuple, list for multi-output, or None),
+    and ``errors`` maps node names to the exception text of any per-node
+    ``eval_shape`` failure — so a shape *mismatch* (bad declared shape) is
+    reported with its failing node instead of dissolving into "unknown".
+
+    Runs inference passes to a fixpoint: variables resolved by a param rule
+    in one pass unblock nodes visited earlier in graph order on the next.
+    Vars named in ``int_vars`` are probed as int32; everything else float32.
+    """
+    from .base import OP_REGISTRY
+
+    var_shapes = {}  # survives across passes
+    errors = {}
+
+    def run_pass():
+        shapes = {}  # per-pass node cache
+        progress = [False]
+
+        def get(node):
+            if id(node) in shapes:
+                return shapes[id(node)]
+            s = _get(node)
+            shapes[id(node)] = s
+            return s
+
+        def _get(node):
+            if node.is_var():
+                s = known.get(node.name)
+                if s is None:
+                    s = var_shapes.get(node.name)
+                if s is None:
+                    s = node._shape
+                s = tuple(s) if s is not None else None
+                if var_shapes.get(node.name) is None:
+                    var_shapes[node.name] = s
+                return s
+            if node._op == "_group":
+                return [get(i) for i in node._inputs]
+            if node._op == "_item":
+                p = get(node._inputs[0])
+                if isinstance(p, list):
+                    return p[node._attrs["index"]]
+                return None
+            ins = [get(i) for i in node._inputs]
+            if any(s is None for s in ins):
+                rule = PARAM_SHAPE_RULES.get(node._op)
+                if rule is not None:
+                    for idx, s in (rule(node, ins) or {}).items():
+                        child = node._inputs[idx]
+                        if ins[idx] is None and s is not None and child.is_var():
+                            ins[idx] = tuple(s)
+                            shapes[id(child)] = ins[idx]
+                            var_shapes[child.name] = ins[idx]
+                            progress[0] = True
+            if any(s is None for s in ins):
+                return None
+            entry = OP_REGISTRY.get(node._op)
+            if entry is None:
+                return None
+            specs = []
+            for child, s in zip(node._inputs, ins):
+                if isinstance(s, list):  # multi-output fed directly: unsupported
+                    return None
+                dt = jnp.int32 if (child.is_var() and child.name in int_vars) \
+                    else jnp.float32
+                specs.append(jax.ShapeDtypeStruct(s, dt))
+            try:
+                out = jax.eval_shape(lambda *a: entry.fn(*a, **node._attrs),
+                                     *specs)
+            except Exception as e:  # record the failing node for diagnostics
+                errors[node.name] = "%s(%s): %s" % (
+                    node._op, ", ".join(str(s) for s in ins),
+                    (str(e).splitlines() or [""])[0])
+                return None
+            errors.pop(node.name, None)
+            return _as_shapes(out)
+
+        out = get(sym)
+        return out, progress[0]
+
+    # fixpoint: each pass can resolve vars that unblock earlier-visited nodes;
+    # stop only on a no-progress pass so the final pass computes every node's
+    # output with the complete var set (a pass that RESOLVES the last var can
+    # still carry stale Nones cached before the resolution)
+    for _ in range(len(sym._arg_symbols()) + 2):
+        out, progressed = run_pass()
+        if not progressed:
+            break
+    return var_shapes, out, errors
+
+
+def format_infer_errors(errors):
+    if not errors:
+        return ""
+    return "; node failures: " + "; ".join(
+        "%s -> %s" % (k, v) for k, v in list(errors.items())[:5])

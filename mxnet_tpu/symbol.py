@@ -167,31 +167,40 @@ class Symbol:
         return [NDArray(o) for o in out]
 
     def infer_shape(self, **kwargs):
-        fn, names = self._build_fn()
-        specs = []
-        for n in names:
-            if n in kwargs:
-                specs.append(jax.ShapeDtypeStruct(tuple(kwargs[n]), jnp.float32))
-            else:
-                s = next(a for a in self._arg_symbols() if a.name == n)._shape
-                if s is None:
-                    raise ValueError("shape of %s unknown" % n)
-                specs.append(jax.ShapeDtypeStruct(s, jnp.float32))
-        out = jax.eval_shape(fn, *specs)
-        outs = out if isinstance(out, (list, tuple)) else [out]
-        return ([tuple(s.shape) for s in specs], [tuple(o.shape) for o in outs], [])
+        """Infer all argument + output shapes from the given input shapes.
+        Parameter variables need no declared shape — per-op rules deduce them
+        (ref: nnvm InferShape pass; see shape_inference.py)."""
+        from .shape_inference import format_infer_errors, infer_shapes_partial
+
+        known = {n: tuple(s) for n, s in kwargs.items()}
+        var_shapes, out, errors = infer_shapes_partial(self, known)
+        names = self.list_arguments()
+        missing = [n for n in names if var_shapes.get(n) is None]
+        if missing:
+            raise ValueError("shape of %s could not be inferred%s"
+                             % (missing, format_infer_errors(errors)))
+        outs = out if isinstance(out, list) else [out]
+        if any(o is None for o in outs):
+            raise ValueError("output shape could not be inferred%s"
+                             % format_infer_errors(errors))
+        return ([var_shapes[n] for n in names], [tuple(o) for o in outs], [])
 
     def infer_type(self, **kwargs):
         return ([np.float32] * len(self.list_arguments()), [np.float32], [])
 
     # ------------------------------------------------------------- binding
     def simple_bind(self, ctx=None, grad_req="write", **shapes):
+        """Allocate arguments and bind. Shapes not given are inferred from the
+        given ones through the graph (ref: symbol.py:simple_bind + the
+        executor infer pass; see shape_inference.py)."""
+        names = self.list_arguments()
+        if any(shapes.get(n) is None for n in names):
+            arg_shapes, _, _ = self.infer_shape(
+                **{n: s for n, s in shapes.items() if s is not None})
+            shapes = dict(zip(names, arg_shapes))
         args = {}
-        for name in self.list_arguments():
-            shape = shapes.get(name)
-            if shape is None:
-                raise ValueError("shape for %s required in simple_bind" % name)
-            args[name] = NDArray(jnp.zeros(shape, jnp.float32))
+        for name in names:
+            args[name] = NDArray(jnp.zeros(shapes[name], jnp.float32))
         grads = {n: NDArray(jnp.zeros_like(a._data)) for n, a in args.items()} \
             if grad_req != "null" else None
         return Executor(self, ctx or current_context(), args, grads, grad_req)

@@ -11,13 +11,15 @@ VOCAB, EMBED, NCLS = 20, 6, 4
 
 
 def _sym_gen(seq_len):
+    # NOTE: no shape= on any weight var — graph shape inference derives them
+    # from the bound data shapes (ref: graph_executor.cc infer pass)
     data = sym.var("data")
     label = sym.var("softmax_label")
-    ew = sym.var("embed_weight", shape=(VOCAB, EMBED))
+    ew = sym.var("embed_weight")
     emb = sym.Embedding(data, ew, input_dim=VOCAB, output_dim=EMBED)
     pooled = sym.mean(emb, axis=1)
-    fw = sym.var("fc_weight", shape=(NCLS, EMBED))
-    fb = sym.var("fc_bias", shape=(NCLS,))
+    fw = sym.var("fc_weight")
+    fb = sym.var("fc_bias")
     fc = sym.FullyConnected(pooled, fw, fb, num_hidden=NCLS)
     out = sym.SoftmaxOutput(fc, label)
     return out, ("data",), ("softmax_label",)

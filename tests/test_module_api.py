@@ -1,0 +1,158 @@
+"""Module API: graph shape inference (no declared weight shapes), bind flags
+(for_training, inputs_need_grad), get_input_grads — mirrors the reference's
+tests/python/unittest/test_module.py + executor infer-shape cases."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym, nd
+from mxnet_tpu.io import DataBatch
+from mxnet_tpu.module import Module
+
+
+def _conv_net():
+    data = sym.var("data")
+    label = sym.var("softmax_label")
+    w1 = sym.var("conv_weight")
+    b1 = sym.var("conv_bias")
+    c = sym.Convolution(data, w1, b1, kernel=(3, 3), num_filter=6, pad=1)
+    g = sym.var("bn_gamma")
+    be = sym.var("bn_beta")
+    mm = sym.var("bn_mm")
+    mv = sym.var("bn_mv")
+    bn = sym.BatchNorm(c, g, be, mm, mv)[0]
+    act = sym.relu(bn)
+    p = sym.Pooling(act, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    fw = sym.var("fc_weight")
+    fb = sym.var("fc_bias")
+    fc = sym.FullyConnected(p, fw, fb, num_hidden=5)
+    return sym.SoftmaxOutput(fc, label)
+
+
+def test_infer_shape_no_declared_shapes():
+    net = _conv_net()
+    arg_shapes, out_shapes, _ = net.infer_shape(data=(2, 3, 8, 8),
+                                                softmax_label=(2,))
+    byname = dict(zip(net.list_arguments(), arg_shapes))
+    assert byname["conv_weight"] == (6, 3, 3, 3)
+    assert byname["conv_bias"] == (6,)
+    assert byname["bn_gamma"] == (6,)
+    assert byname["fc_weight"] == (5, 6 * 4 * 4)
+    assert byname["fc_bias"] == (5,)
+    assert out_shapes[0] == (2, 5)
+
+
+def test_module_binds_without_param_shapes():
+    net = _conv_net()
+    m = Module(net, data_names=("data",), label_names=("softmax_label",))
+    m.bind([("data", (2, 3, 8, 8))], [("softmax_label", (2,))])
+    m.init_params()
+    assert m._arg_params["conv_weight"].shape == (6, 3, 3, 3)
+    rng = np.random.default_rng(0)
+    batch = DataBatch([nd.array(rng.normal(size=(2, 3, 8, 8)))],
+                      [nd.array(rng.integers(0, 5, (2,)))])
+    out = m.forward(batch, is_train=False)
+    assert out[0].shape == (2, 5)
+
+
+def test_deconv_embedding_inference():
+    data = sym.var("data")
+    w = sym.var("deconv_weight")
+    y = sym.Deconvolution(data, w, kernel=(2, 2), stride=(2, 2), num_filter=4,
+                          no_bias=True)
+    args, outs, _ = y.infer_shape(data=(1, 3, 5, 5))
+    byname = dict(zip(y.list_arguments(), args))
+    assert byname["deconv_weight"] == (3, 4, 2, 2)
+    assert outs[0] == (1, 4, 10, 10)
+
+    idx = sym.var("idx")
+    ew = sym.var("embed_weight")
+    e = sym.Embedding(idx, ew, input_dim=11, output_dim=7)
+    args, outs, _ = e.infer_shape(idx=(4, 3))
+    assert dict(zip(e.list_arguments(), args))["embed_weight"] == (11, 7)
+    assert outs[0] == (4, 3, 7)
+
+
+def test_inputs_need_grad():
+    data = sym.var("data")
+    label = sym.var("softmax_label")
+    fw = sym.var("fc_weight")
+    fb = sym.var("fc_bias")
+    fc = sym.FullyConnected(data, fw, fb, num_hidden=3)
+    net = sym.SoftmaxOutput(fc, label)
+    m = Module(net)
+    m.bind([("data", (4, 6))], [("softmax_label", (4,))],
+           inputs_need_grad=True)
+    m.init_params(initializer=mx.init.Uniform(0.3))
+    rng = np.random.default_rng(0)
+    batch = DataBatch([nd.array(rng.normal(size=(4, 6)))],
+                      [nd.array(rng.integers(0, 3, (4,)))])
+    m.forward(batch, is_train=True)
+    m.backward()
+    (g,) = m.get_input_grads()
+    assert g.shape == (4, 6)
+    assert float(np.abs(g.asnumpy()).max()) > 0
+
+
+def test_infer_shape_order_independent():
+    """A weight USED (weight-decay term) before the node that determines its
+    shape must still resolve — fixpoint iteration, not single-pass DFS."""
+    data = sym.var("data")
+    w = sym.var("fc_weight")
+    reg = sym.sum(w * w)
+    fc = sym.FullyConnected(data, w, num_hidden=3, no_bias=True)
+    for group in (sym.Group([reg, fc]), sym.Group([fc, reg])):
+        args, outs, _ = group.infer_shape(data=(2, 4))
+        byname = dict(zip(group.list_arguments(), args))
+        assert byname["fc_weight"] == (3, 4)
+
+
+def test_attr_weight_mismatch_raises():
+    data = sym.var("data")
+    w = sym.var("w", shape=(7, 4))
+    fc = sym.FullyConnected(data, w, num_hidden=3, no_bias=True)
+    try:
+        fc.infer_shape(data=(2, 4))
+        assert False, "expected infer-shape mismatch error"
+    except ValueError as e:
+        assert "num_hidden" in str(e)
+
+
+def test_infer_error_names_failing_node():
+    data = sym.var("data")
+    w = sym.var("w2", shape=(3, 5))  # (2,4)@(5,3) mismatch
+    fc = sym.FullyConnected(data, w, num_hidden=3, no_bias=True)
+    try:
+        fc.infer_shape(data=(2, 4))
+        assert False, "expected error"
+    except ValueError as e:
+        assert "FullyConnected" in str(e)
+
+
+def test_nhwc_conv_inference():
+    data = sym.var("data")
+    w = sym.var("w")
+    y = sym.Convolution(data, w, kernel=(3, 3), num_filter=8, layout="NHWC",
+                        no_bias=True)
+    # channel axis is last for NHWC; weight stays OIHW
+    from mxnet_tpu.shape_inference import infer_shapes_partial
+    var_shapes, _, _ = infer_shapes_partial(y, {"data": (2, 8, 8, 3)})
+    assert var_shapes["w"] == (8, 3, 3, 3)
+
+
+def test_simple_bind_infers_param_shapes():
+    net = _conv_net()
+    ex = net.simple_bind(data=(2, 3, 8, 8), softmax_label=(2,))
+    assert ex.arg_dict["conv_weight"].shape == (6, 3, 3, 3)
+    assert ex.arg_dict["fc_weight"].shape == (5, 6 * 4 * 4)
+
+
+def test_for_training_flag_default():
+    data = sym.var("data")
+    w = sym.var("fc_weight")
+    fc = sym.FullyConnected(data, w, num_hidden=2, no_bias=True)
+    m = Module(fc, label_names=())
+    m.bind([("data", (2, 3))], for_training=False)
+    m.init_params()
+    batch = DataBatch([nd.array(np.ones((2, 3)))], None)
+    m.forward(batch)  # is_train defaults to for_training=False
+    assert m._exec._vjp is None

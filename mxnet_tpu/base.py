@@ -29,6 +29,16 @@ _DTYPE_ALIASES = {
 }
 
 
+def is_tpu_backend():
+    """True when the default backend is a TPU — including relayed platforms
+    that expose the chip under a different platform name (e.g. 'axon'), which
+    ``jax.default_backend() == "tpu"`` misses. Used to gate pallas kernels."""
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except RuntimeError:
+        return False
+
+
 def resolve_dtype(dtype):
     if dtype is None:
         return None

@@ -12,7 +12,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..base import register_op
+from ..base import is_tpu_backend, register_op
 
 _FLASH_MIN_LEN = 256  # below this, XLA's fused unblocked attention wins
 
@@ -34,7 +34,7 @@ def _reference_attention(q, k, v, mask=None, *, causal=False, scale=None):
 @register_op("scaled_dot_attention")
 def scaled_dot_attention(q, k, v, mask=None, *, causal=False, scale=None):
     """q,k,v: (B, H, T, D); mask broadcastable to (B, H, Tq, Tk), 1=keep."""
-    if jax.default_backend() == "tpu" and q.shape[2] >= _FLASH_MIN_LEN and mask is None:
+    if is_tpu_backend() and q.shape[2] >= _FLASH_MIN_LEN and mask is None:
         try:
             from .pallas.flash_attention import flash_attention
 

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.scipy import special as jsp
 
-from ..base import register_op, resolve_dtype
+from ..base import is_tpu_backend, register_op, resolve_dtype
 
 # ---------------------------------------------------------------- unary
 
@@ -650,7 +650,7 @@ def LayerNorm(x, gamma, beta, *, axis=-1, eps=1e-5):
     recipe); last-axis LN at MXU-aligned widths takes the fused pallas kernel
     (ops/pallas/layernorm.py), one VMEM pass per row block."""
     last = axis in (-1, x.ndim - 1)
-    if (jax.default_backend() == "tpu" and last and x.ndim >= 2
+    if (is_tpu_backend() and last and x.ndim >= 2
             and x.shape[-1] % 128 == 0 and gamma.ndim == 1):
         try:
             from .pallas.layernorm import layernorm as _fused
@@ -758,7 +758,7 @@ def softmax_cross_entropy(logits, labels):
     # compile failures (they surface at jit-compile time), so the fused path
     # is taken only for configurations the kernel handles by construction
     # (2-D, lane-aligned V; rows-per-block is VMEM-capped inside the kernel)
-    if (jax.default_backend() == "tpu" and logits.ndim == 2
+    if (is_tpu_backend() and logits.ndim == 2
             and logits.shape[-1] % 128 == 0):
         from .pallas.softmax_xent import softmax_xent as _fused
 

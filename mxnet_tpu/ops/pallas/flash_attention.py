@@ -1,12 +1,13 @@
-"""Flash attention Pallas kernel for TPU.
+"""Flash attention Pallas kernels (forward + backward) for TPU.
 
 Replaces the reference's unfused softmax(QK^T)V chain (three HBM round trips
-for the T×T score matrix) with a blockwise kernel: Q blocks stay resident in
+for the T×T score matrix) with blockwise kernels: Q blocks stay resident in
 VMEM while K/V blocks stream through, online-softmax accumulating in fp32
-scratch — O(T) HBM traffic instead of O(T^2). Grid (B*H, Tq/bq, Tk/bk) with
-the K dimension innermost ("arbitrary" semantics) so the accumulator carries
-across K steps. Custom VJP recomputes attention blockwise in the backward
-(flash-attention-2 style) so no T×T tensor ever materializes.
+scratch — O(T) HBM traffic instead of O(T^2), forward AND backward. The
+forward also emits the per-row logsumexp (lane-broadcast, matching the
+(bq, 128) scratch layout Mosaic likes); the backward is the flash-attention-2
+recompute scheme as two kernels — dq over (q-block, k-inner) and dk/dv over
+(k-block, q-inner) — so no T×T tensor ever materializes in either pass.
 
 Pattern source: /opt/skills/guides/pallas_guide.md (double-buffered matmul,
 custom-VJP kernels). Falls back to the jnp reference off-TPU (ops/attention.py).
@@ -21,10 +22,30 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+LANES = 128
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
-                *, scale, causal, bq, bk):
+def _scores(q_ref, k_ref, q_idx, kv_idx, *, scale, causal, bq, bk):
+    """Shared Q·Kᵀ score-block recompute — the ONE definition of scaling and
+    causal masking used by forward and both backward kernels, so their
+    numerics can never desynchronize."""
+    q = q_ref[0].astype(jnp.float32) * scale  # (bq, d)
+    k = k_ref[0].astype(jnp.float32)          # (bk, d)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # (bq, bk)
+    if causal:
+        rows = q_idx * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        cols = kv_idx * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    return s
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale, causal, bq, bk,
+                emit_lse):
+    if emit_lse:
+        lse_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        (m_ref, l_ref, acc_ref), lse_ref = rest, None
     kv_idx = pl.program_id(2)
     q_idx = pl.program_id(1)
 
@@ -42,15 +63,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
 
     @pl.when(run if causal else True)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale  # (bq, d)
-        k = k_ref[0].astype(jnp.float32)          # (bk, d)
         v = v_ref[0].astype(jnp.float32)          # (bk, d)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # (bq, bk)
-        if causal:
-            rows = q_idx * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = kv_idx * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
+        s = _scores(q_ref, k_ref, q_idx, kv_idx, scale=scale, causal=causal,
+                    bq=bq, bk=bk)
         m_prev = m_ref[:]                       # (bq, 128) broadcast lanes
         m_cur = jnp.max(s, axis=1, keepdims=True)  # (bq, 1)
         m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
@@ -65,9 +80,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
     @pl.when(kv_idx == pl.num_programs(2) - 1)
     def _finish():
         o_ref[0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
+        if emit_lse:
+            lse_ref[0] = m_ref[:] + jnp.log(l_ref[:])
 
 
-def _flash_fwd(q, k, v, scale, causal, bq, bk, interpret=False):
+def _flash_fwd(q, k, v, scale, causal, bq, bk, interpret=False,
+               return_lse=False):
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     bq = min(bq, Tq)
@@ -76,8 +94,15 @@ def _flash_fwd(q, k, v, scale, causal, bq, bk, interpret=False):
     kr = k.reshape(B * H, Tk, D)
     vr = v.reshape(B * H, Tk, D)
     grid = (B * H, Tq // bq, Tk // bk)
-    out = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk),
+    out_specs = [pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0))]
+    out_shape = [jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype)]
+    if return_lse:  # inference path skips the lse output entirely — XLA
+        # cannot DCE an output of an opaque pallas_call
+        out_specs.append(pl.BlockSpec((1, bq, LANES), lambda b, i, j: (b, i, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((B * H, Tq, LANES), jnp.float32))
+    res = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
+                          emit_lse=return_lse),
         interpret=interpret,
         grid=grid,
         in_specs=[
@@ -85,57 +110,177 @@ def _flash_fwd(q, k, v, scale, causal, bq, bk, interpret=False):
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
-            pltpu.VMEM((bq, 128), jnp.float32),  # running max (lane-broadcast)
-            pltpu.VMEM((bq, 128), jnp.float32),  # running denom
-            pltpu.VMEM((bq, D), jnp.float32),    # output accumulator
+            pltpu.VMEM((bq, LANES), jnp.float32),  # running max (lane-broadcast)
+            pltpu.VMEM((bq, LANES), jnp.float32),  # running denom
+            pltpu.VMEM((bq, D), jnp.float32),      # output accumulator
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
     )(qr, kr, vr)
-    return out.reshape(B, H, Tq, D)
+    if return_lse:
+        out, lse = res
+        # keep only one lane as the residual (saving the full 128-lane
+        # broadcast would hold 128x the memory across fwd→bwd)
+        return out.reshape(B, H, Tq, D), lse[..., :1]
+    return res[0].reshape(B, H, Tq, D)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, scale, causal, bq, bk):
-    return _flash_fwd(q, k, v, scale, causal, bq, bk)
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_acc, *, scale, causal, bq, bk):
+    kv_idx = pl.program_id(2)
+    q_idx = pl.program_id(1)
 
+    @pl.when(kv_idx == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
 
-def _flash_vjp_fwd(q, k, v, scale, causal, bq, bk):
-    o = _flash_fwd(q, k, v, scale, causal, bq, bk)
-    return o, (q, k, v, o)
-
-
-def _flash_vjp_bwd(scale, causal, bq, bk, res, do):
-    # Blockwise recompute backward in plain XLA (fused well by Mosaic/XLA);
-    # a dedicated pallas backward kernel is an r2 perf item.
-    q, k, v, o = res
-    qf = q.astype(jnp.float32) * scale
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    of = o.astype(jnp.float32)
-    dof = do.astype(jnp.float32)
-    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf)
+    run = True
     if causal:
-        T, S = s.shape[-2], s.shape[-1]
-        mask = jnp.arange(T)[:, None] >= jnp.arange(S)[None, :]
-        s = jnp.where(mask[None, None], s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    dv = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
-    dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vf)
-    delta = jnp.sum(of * dof, axis=-1, keepdims=True)
-    ds = p * (dp - delta)
-    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf) * scale
-    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+        run = kv_idx * bk <= q_idx * bq + bq - 1
+
+    @pl.when(run if causal else True)
+    def _compute():
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = _scores(q_ref, k_ref, q_idx, kv_idx, scale=scale, causal=causal,
+                    bq=bq, bk=bk)
+        p = jnp.exp(s - lse_ref[0][:, :1])                       # (bq, bk)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1])
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(kv_idx == pl.num_programs(2) - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, bq, bk):
+    q_idx = pl.program_id(2)   # inner: sweep q blocks
+    kv_idx = pl.program_id(1)  # outer: this kernel instance's k/v block
+
+    @pl.when(q_idx == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    run = True
+    if causal:
+        # q block contributes iff its last row >= first k row
+        run = q_idx * bq + bq - 1 >= kv_idx * bk
+
+    @pl.when(run if causal else True)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = _scores(q_ref, k_ref, q_idx, kv_idx, scale=scale, causal=causal,
+                    bq=bq, bk=bk)
+        p = jnp.exp(s - lse_ref[0][:, :1])                       # (bq, bk)
+        # dv += p^T @ do
+        dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1])                      # (bq, bk)
+        # dk += ds^T @ q * scale
+        dk_acc[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32) * scale
+
+    @pl.when(q_idx == pl.num_programs(2) - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, scale, causal, bq, bk, interpret=False):
+    B, H, Tq, D = q.shape
+    Tk = k.shape[2]
+    bq = min(bq, Tq)
+    bk = min(bk, Tk)
+    qr = q.reshape(B * H, Tq, D)
+    kr = k.reshape(B * H, Tk, D)
+    vr = v.reshape(B * H, Tk, D)
+    dor = do.reshape(B * H, Tq, D)
+    # delta_i = rowsum(dO ⊙ O); both row stats lane-broadcast to the
+    # (bq, 128) layout transiently (the saved lse residual is 1-lane)
+    delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta.reshape(B * H, Tq, 1), (B * H, Tq, LANES))
+    lse = jnp.broadcast_to(lse, (B * H, Tq, LANES))
+
+    spec_q = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0))
+    spec_kv_in = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0))
+    spec_row = pl.BlockSpec((1, bq, LANES), lambda b, i, j: (b, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk),
+        interpret=interpret,
+        grid=(B * H, Tq // bq, Tk // bk),
+        in_specs=[spec_q, spec_kv_in, spec_kv_in, spec_q, spec_row, spec_row],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(qr, kr, vr, dor, lse, delta)
+
+    # dk/dv: k block is the resident (outer) axis, q blocks stream (inner)
+    spec_q_inner = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, j, 0))
+    spec_kv_outer = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, i, 0))
+    spec_row_inner = pl.BlockSpec((1, bq, LANES), lambda b, i, j: (b, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk),
+        interpret=interpret,
+        grid=(B * H, Tk // bk, Tq // bq),
+        in_specs=[spec_q_inner, spec_kv_outer, spec_kv_outer, spec_q_inner,
+                  spec_row_inner, spec_row_inner],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tk, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, Tk, D), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(qr, kr, vr, dor, lse, delta)
+
+    return (dq.reshape(B, H, Tq, D), dk.reshape(B, H, Tk, D),
+            dv.reshape(B, H, Tk, D))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, bq, bk, interpret=False):
+    return _flash_fwd(q, k, v, scale, causal, bq, bk, interpret=interpret)
+
+
+def _flash_vjp_fwd(q, k, v, scale, causal, bq, bk, interpret=False):
+    o, lse = _flash_fwd(q, k, v, scale, causal, bq, bk, interpret=interpret,
+                        return_lse=True)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_vjp_bwd(scale, causal, bq, bk, interpret, res, do):
+    q, k, v, o, lse = res
+    return _flash_bwd(q, k, v, o, lse, do, scale, causal, bq, bk,
+                      interpret=interpret)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-def flash_attention(q, k, v, causal=False, scale=None, block_q=256, block_k=512):
+def flash_attention(q, k, v, causal=False, scale=None, block_q=256,
+                    block_k=512, interpret=False):
     """q,k,v: (B, H, T, D). D should be a multiple of 128 lanes ideally;
     T must be divisible by the chosen blocks (callers pad)."""
     if scale is None:
@@ -143,7 +288,7 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=256, block_k=512)
     Tq, Tk = q.shape[2], k.shape[2]
     bq = _largest_divisor_block(Tq, block_q)
     bk = _largest_divisor_block(Tk, block_k)
-    return _flash(q, k, v, float(scale), bool(causal), bq, bk)
+    return _flash(q, k, v, float(scale), bool(causal), bq, bk, interpret)
 
 
 def _largest_divisor_block(t, prefer):

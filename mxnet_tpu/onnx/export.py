@@ -257,8 +257,14 @@ def _reduce(onnx_op):
         a = s._attrs
         attrs = {"keepdims": int(bool(a.get("keepdims", False)))}
         ax = a.get("axis")
-        if ax is not None:
-            attrs["axes"] = [ax] if isinstance(ax, int) else list(ax)
+        axes = None if ax is None else ([ax] if isinstance(ax, int) else list(ax))
+        if onnx_op == "ReduceSum" and axes is not None:
+            # opset 13 moved ReduceSum's axes from attribute to input
+            axes_in = ctx.const("axes", np.asarray(axes, np.int64))
+            ctx.emit(onnx_op, [ins[0], axes_in], [out], attrs=attrs)
+            return
+        if axes is not None:
+            attrs["axes"] = axes
         ctx.emit(onnx_op, ins[:1], [out], attrs=attrs)
     return conv
 

@@ -93,6 +93,14 @@ def _gemm(g, node):
     a = node["attrs"]
     if a.get("transA") or not a.get("transB", 0):
         raise ValueError("only Gemm(transA=0, transB=1) supported")
+    alpha, beta = float(a.get("alpha", 1.0)), float(a.get("beta", 1.0))
+    for name, scale in [(node["inputs"][1], alpha)] + (
+            [(node["inputs"][2], beta)] if len(node["inputs"]) > 2 else []):
+        if scale != 1.0:
+            init = g.initializers.get(name)
+            if init is None:
+                raise ValueError("Gemm alpha/beta != 1 on non-initializer input")
+            g.initializers[name] = np.asarray(init) * scale
     ins = [g.inp(n) for n in node["inputs"]]
     w = g.initializers.get(node["inputs"][1])
     num_hidden = int(w.shape[0]) if w is not None else 0
@@ -167,10 +175,10 @@ def _pool(ptype):
         pads = a.get("pads")
         pad = tuple(pads[:nd]) if pads else (0,) * nd
         kw = dict(kernel=tuple(a["kernel_shape"]),
-                  stride=tuple(a.get("strides", a["kernel_shape"])),
+                  stride=tuple(a.get("strides", (1,) * nd)),
                   pad=pad, pool_type=ptype)
         if ptype == "avg":
-            kw["count_include_pad"] = bool(a.get("count_include_pad", 1))
+            kw["count_include_pad"] = bool(a.get("count_include_pad", 0))
         if ptype == "lp":
             kw["p_value"] = int(a.get("p", 2))
         return _make("Pooling", g.inp(node["inputs"][0]), **kw)
@@ -297,6 +305,13 @@ def _reduce(mx_op):
     def imp(g, node):
         a = node["attrs"]
         axes = a.get("axes")
+        if axes is None and len(node["inputs"]) > 1:
+            # opset>=13 ReduceSum: axes is a second (initializer) input
+            ax_init = g.initializers.get(node["inputs"][1])
+            if ax_init is None:
+                raise ValueError("%s: dynamic axes input unsupported"
+                                 % node["op_type"])
+            axes = [int(x) for x in np.asarray(ax_init).reshape(-1)]
         kw = {"keepdims": bool(a.get("keepdims", 1))}
         if axes is not None:
             kw["axis"] = tuple(axes) if len(axes) > 1 else int(axes[0])

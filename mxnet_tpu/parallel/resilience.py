@@ -52,7 +52,11 @@ class Heartbeat:
         # each start owns a fresh stop event; an old thread that is still
         # mid-_tick (a device roundtrip — slow exactly when things stall)
         # holds the previous event and exits on its next check, so restart
-        # never revives or doubles watchdogs
+        # never revives or doubles watchdogs. A live thread from a start()
+        # without an intervening stop() must be signalled through the OLD
+        # event before it becomes unreachable, or it ticks forever.
+        if self._thread is not None and self._thread.is_alive():
+            self._stop.set()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, args=(self._stop,),
                                         daemon=True)

@@ -98,30 +98,391 @@ def color_normalize(src, mean, std=None):
     return array(a)
 
 
-class CreateAugmenter:
-    """Minimal augmenter pipeline factory (ref: image.py:CreateAugmenter)."""
+def resize_short(src, size, interp=2):
+    """Resize so the shorter edge becomes ``size``, keeping aspect ratio
+    (ref: image.py:resize_short)."""
+    a = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    h, w = a.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(h * size / w)
+    else:
+        new_w, new_h = int(w * size / h), size
+    return array(imresize_np(a, new_w, new_h, interp))
 
-    def __new__(cls, data_shape, resize=0, rand_crop=False, rand_mirror=False,
-                mean=None, std=None, **kwargs):
-        augs = []
-        c, h, w = data_shape
 
-        def pipeline(img):
-            a = img.asnumpy() if isinstance(img, NDArray) else np.asarray(img)
-            if resize:
-                a = imresize_np(a, resize, resize)
-            if rand_crop:
-                out, _ = random_crop(a, (w, h))
-                a = out.asnumpy()
-            else:
-                a = imresize_np(a, w, h)
-            if rand_mirror and np.random.rand() < 0.5:
-                a = a[:, ::-1].copy()
-            a = a.astype(np.float32)
-            if mean is not None:
-                a = a - np.asarray(mean, np.float32)
-            if std is not None:
-                a = a / np.asarray(std, np.float32)
-            return array(a.transpose(2, 0, 1))
+def scale_down(src_size, size):
+    """Scale ``size`` down to fit in ``src_size`` keeping aspect
+    (ref: image.py:scale_down)."""
+    w, h = src_size
+    sw, sh = size
+    if sh > h:
+        sw, sh = sw * h // sh, h
+    if sw > w:
+        sw, sh = w, sh * w // sw
+    return sw, sh
 
-        return [pipeline]
+
+def random_size_crop(src, size, area, ratio, interp=2, rng=None):
+    """Random crop with size in ``area`` fraction and aspect in ``ratio``
+    (ref: image.py:random_size_crop — torch-style RandomResizedCrop)."""
+    rng = rng or np.random
+    a = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    h, w = a.shape[:2]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = rng.uniform(area[0], area[1]) * src_area
+        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+        new_ratio = np.exp(rng.uniform(*log_ratio))
+        new_w = int(round(np.sqrt(target_area * new_ratio)))
+        new_h = int(round(np.sqrt(target_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = rng.randint(0, w - new_w + 1)
+            y0 = rng.randint(0, h - new_h + 1)
+            out = fixed_crop(a, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    # fallback: center crop
+    out, rect = center_crop(a, size, interp)
+    return out, rect
+
+
+# ---------------------------------------------------------------------------
+# Augmenter classes (ref: python/mxnet/image/image.py Augmenter family).
+# Host-side numpy transforms: on TPU the augmentation pipeline belongs on the
+# host CPU feeding the device, so these deliberately do NOT trace into XLA.
+# Each random augmenter takes rng= for deterministic pipelines; default is
+# the module-global np.random so mx-style np.random.seed() reproduces runs.
+# ---------------------------------------------------------------------------
+
+def _asnp(img):
+    return img.asnumpy() if isinstance(img, NDArray) else np.asarray(img)
+
+
+class Augmenter:
+    """Image augmenter base (ref: image.py:Augmenter)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class SequentialAug(Augmenter):
+    """Apply a list of augmenters in order (ref: image.py:SequentialAug)."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        for t in self.ts:
+            src = t(src)
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    """Apply augmenters in random order (ref: image.py:RandomOrderAug)."""
+
+    def __init__(self, ts, rng=None):
+        super().__init__()
+        self.ts = ts
+        self.rng = rng or np.random
+
+    def __call__(self, src):
+        order = self.rng.permutation(len(self.ts))
+        for i in order:
+            src = self.ts[int(i)](src)
+        return src
+
+
+class ResizeAug(Augmenter):
+    """Resize shorter edge (ref: image.py:ResizeAug)."""
+
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    """Force resize to (w, h) ignoring aspect (ref: image.py:ForceResizeAug)."""
+
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return array(imresize_np(_asnp(src), self.size[0], self.size[1],
+                                 self.interp))
+
+
+class RandomCropAug(Augmenter):
+    """Random crop to size (ref: image.py:RandomCropAug)."""
+
+    def __init__(self, size, interp=2, rng=None):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+        self.rng = rng or np.random
+
+    def __call__(self, src):
+        a = _asnp(src)
+        h, w = a.shape[:2]
+        tw, th = self.size
+        x0 = self.rng.randint(0, max(w - tw, 0) + 1)
+        y0 = self.rng.randint(0, max(h - th, 0) + 1)
+        return fixed_crop(a, x0, y0, min(tw, w), min(th, h), self.size,
+                          self.interp)
+
+
+class RandomSizedCropAug(Augmenter):
+    """Random area+aspect crop (ref: image.py:RandomSizedCropAug)."""
+
+    def __init__(self, size, area, ratio, interp=2, rng=None):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size, self.area, self.ratio, self.interp = size, area, ratio, interp
+        self.rng = rng or np.random
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp, rng=self.rng)[0]
+
+
+class CenterCropAug(Augmenter):
+    """Center crop (ref: image.py:CenterCropAug)."""
+
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(_asnp(src), self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    """Random horizontal flip (ref: image.py:HorizontalFlipAug)."""
+
+    def __init__(self, p, rng=None):
+        super().__init__(p=p)
+        self.p = p
+        self.rng = rng or np.random
+
+    def __call__(self, src):
+        a = _asnp(src)
+        if self.rng.random_sample() < self.p:
+            a = a[:, ::-1].copy()
+        return array(a)
+
+
+class CastAug(Augmenter):
+    """Cast to float32 (ref: image.py:CastAug)."""
+
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return array(_asnp(src).astype(self.typ))
+
+
+class BrightnessJitterAug(Augmenter):
+    """src *= 1 + U(-b, b) (ref: image.py:BrightnessJitterAug)."""
+
+    def __init__(self, brightness, rng=None):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+        self.rng = rng or np.random
+
+    def __call__(self, src):
+        alpha = 1.0 + self.rng.uniform(-self.brightness, self.brightness)
+        return array(_asnp(src).astype(np.float32) * alpha)
+
+
+_GRAY_COEF = np.array([0.299, 0.587, 0.114], np.float32)
+
+
+class ContrastJitterAug(Augmenter):
+    """Blend with mean gray level (ref: image.py:ContrastJitterAug)."""
+
+    def __init__(self, contrast, rng=None):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+        self.rng = rng or np.random
+
+    def __call__(self, src):
+        a = _asnp(src).astype(np.float32)
+        alpha = 1.0 + self.rng.uniform(-self.contrast, self.contrast)
+        gray = (a * _GRAY_COEF).sum(axis=-1).mean() * (1.0 - alpha)
+        return array(a * alpha + gray)
+
+
+class SaturationJitterAug(Augmenter):
+    """Blend with per-pixel gray (ref: image.py:SaturationJitterAug)."""
+
+    def __init__(self, saturation, rng=None):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+        self.rng = rng or np.random
+
+    def __call__(self, src):
+        a = _asnp(src).astype(np.float32)
+        alpha = 1.0 + self.rng.uniform(-self.saturation, self.saturation)
+        gray = (a * _GRAY_COEF).sum(axis=-1, keepdims=True) * (1.0 - alpha)
+        return array(a * alpha + gray)
+
+
+_TYIQ = np.array([[0.299, 0.587, 0.114],
+                  [0.596, -0.274, -0.321],
+                  [0.211, -0.523, 0.311]], np.float32)
+_ITYIQ = np.array([[1.0, 0.956, 0.621],
+                   [1.0, -0.272, -0.647],
+                   [1.0, -1.107, 1.705]], np.float32)
+
+
+class HueJitterAug(Augmenter):
+    """Rotate hue in YIQ space (ref: image.py:HueJitterAug)."""
+
+    def __init__(self, hue, rng=None):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self.rng = rng or np.random
+
+    def __call__(self, src):
+        a = _asnp(src).astype(np.float32)
+        alpha = self.rng.uniform(-self.hue, self.hue)
+        u, w = np.cos(alpha * np.pi), np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]], np.float32)
+        t = (_ITYIQ @ bt @ _TYIQ).T
+        return array(a @ t)
+
+
+class ColorJitterAug(RandomOrderAug):
+    """Random-order brightness/contrast/saturation (ref: image.py:ColorJitterAug)."""
+
+    def __init__(self, brightness, contrast, saturation, rng=None):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness, rng=rng))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast, rng=rng))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation, rng=rng))
+        super().__init__(ts, rng=rng)
+
+
+# ImageNet PCA eigval/eigvec (the AlexNet lighting constants upstream ships)
+_IMAGENET_EIGVAL = np.array([55.46, 4.794, 1.148], np.float32)
+_IMAGENET_EIGVEC = np.array([[-0.5675, 0.7192, 0.4009],
+                             [-0.5808, -0.0045, -0.8140],
+                             [-0.5836, -0.6948, 0.4203]], np.float32)
+
+
+class LightingAug(Augmenter):
+    """PCA lighting noise (ref: image.py:LightingAug)."""
+
+    def __init__(self, alphastd, eigval=None, eigvec=None, rng=None):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = _IMAGENET_EIGVAL if eigval is None else np.asarray(eigval, np.float32)
+        self.eigvec = _IMAGENET_EIGVEC if eigvec is None else np.asarray(eigvec, np.float32)
+        self.rng = rng or np.random
+
+    def __call__(self, src):
+        a = _asnp(src).astype(np.float32)
+        alpha = self.rng.normal(0, self.alphastd, size=(3,)).astype(np.float32)
+        rgb = self.eigvec @ (self.eigval * alpha)
+        return array(a + rgb)
+
+
+_GRAY_MAT = np.array([[0.21, 0.21, 0.21],
+                      [0.72, 0.72, 0.72],
+                      [0.07, 0.07, 0.07]], np.float32)
+
+
+class RandomGrayAug(Augmenter):
+    """Randomly convert to grayscale (ref: image.py:RandomGrayAug)."""
+
+    def __init__(self, p, rng=None):
+        super().__init__(p=p)
+        self.p = p
+        self.rng = rng or np.random
+
+    def __call__(self, src):
+        a = _asnp(src).astype(np.float32)
+        if self.rng.random_sample() < self.p:
+            a = a @ _GRAY_MAT
+        return array(a)
+
+
+class ColorNormalizeAug(Augmenter):
+    """(src - mean) / std (ref: image.py:ColorNormalizeAug)."""
+
+    def __init__(self, mean, std):
+        super().__init__(mean=mean if mean is None else list(np.ravel(mean)),
+                         std=std if std is None else list(np.ravel(std)))
+        self.mean = None if mean is None else np.asarray(mean, np.float32)
+        self.std = None if std is None else np.asarray(std, np.float32)
+
+    def __call__(self, src):
+        a = _asnp(src).astype(np.float32)
+        if self.mean is not None:
+            a = a - self.mean
+        if self.std is not None:
+            a = a / self.std
+        return array(a)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0, rand_gray=0,
+                    inter_method=2, rng=None):
+    """Build the standard augmenter list (ref: image.py:CreateAugmenter).
+
+    Returns a list of Augmenters producing float32 HWC; the final HWC→CHW
+    transpose is the data iterator's job, matching upstream.
+    """
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method, rng=rng))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method, rng=rng))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5, rng=rng))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation, rng=rng))
+    if hue:
+        auglist.append(HueJitterAug(hue, rng=rng))
+    if pca_noise > 0:
+        auglist.append(LightingAug(pca_noise, rng=rng))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray, rng=rng))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53], np.float32)
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375], np.float32)
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+from . import image_det as _det  # noqa: E402  (detection augmenters)
+from .image_det import (  # noqa: F401,E402
+    DetAugmenter, DetBorrowAug, DetRandomSelectAug, DetHorizontalFlipAug,
+    DetRandomCropAug, DetRandomPadAug, CreateDetAugmenter,
+)

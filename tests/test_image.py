@@ -1,0 +1,247 @@
+"""mx.image augmenter family (ref: tests/python/unittest/test_image.py).
+
+Each random augmenter is checked for (a) semantic correctness against a
+numpy oracle where one exists and (b) determinism: same rng seed → identical
+output, different seed → different output.
+"""
+import numpy as np
+import pytest
+
+from mxnet_tpu import image as I
+from mxnet_tpu import nd
+
+
+def _img(h=40, w=60, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 255, (h, w, 3)).astype(np.uint8)
+
+
+def _rs(seed):
+    return np.random.RandomState(seed)
+
+
+RANDOM_AUGS = [
+    lambda rng: I.RandomCropAug((24, 16), rng=rng),
+    lambda rng: I.RandomSizedCropAug((24, 16), (0.3, 1.0), (0.75, 1.33), rng=rng),
+    lambda rng: I.HorizontalFlipAug(0.5, rng=rng),
+    lambda rng: I.BrightnessJitterAug(0.4, rng=rng),
+    lambda rng: I.ContrastJitterAug(0.4, rng=rng),
+    lambda rng: I.SaturationJitterAug(0.4, rng=rng),
+    lambda rng: I.HueJitterAug(0.4, rng=rng),
+    lambda rng: I.ColorJitterAug(0.3, 0.3, 0.3, rng=rng),
+    lambda rng: I.LightingAug(0.5, rng=rng),
+    lambda rng: I.RandomGrayAug(0.5, rng=rng),
+]
+
+
+@pytest.mark.parametrize("make", RANDOM_AUGS,
+                         ids=[f(None).__class__.__name__ for f in RANDOM_AUGS])
+def test_augmenter_determinism(make):
+    src = _img().astype(np.float32)
+    outs = []
+    for seed in (7, 7, 8):
+        aug = make(_rs(seed))
+        # compare the whole application SEQUENCE: involutions (flip) make a
+        # single final image collide across seeds with prob 1/2
+        seq = []
+        a = src
+        for _ in range(6):
+            a = aug(a).asnumpy().astype(np.float32)
+            if a.shape != src.shape:
+                a = I.imresize_np(a, src.shape[1], src.shape[0])
+            seq.append(a.copy())
+        outs.append(np.stack(seq))
+    np.testing.assert_array_equal(outs[0], outs[1])
+    assert not np.array_equal(outs[0], outs[2])
+
+
+def test_resize_short_keeps_aspect():
+    out = I.resize_short(_img(40, 60), 20).asnumpy()
+    assert out.shape[:2] == (20, 30)
+    out = I.resize_short(_img(60, 40), 20).asnumpy()
+    assert out.shape[:2] == (30, 20)
+
+
+def test_scale_down():
+    assert I.scale_down((60, 40), (80, 80)) == (40, 40)
+    assert I.scale_down((60, 40), (30, 20)) == (30, 20)
+
+
+def test_brightness_oracle():
+    src = _img().astype(np.float32)
+    rng = _rs(3)
+    alpha = 1.0 + np.random.RandomState(3).uniform(-0.4, 0.4)
+    out = I.BrightnessJitterAug(0.4, rng=rng)(src).asnumpy()
+    np.testing.assert_allclose(out, src * alpha, rtol=1e-5)
+
+
+def test_saturation_gray_point():
+    # a gray image is a fixed point of saturation jitter
+    src = np.full((8, 8, 3), 100.0, np.float32)
+    out = I.SaturationJitterAug(0.4, rng=_rs(0))(src).asnumpy()
+    np.testing.assert_allclose(out, src, rtol=1e-4)
+
+
+def test_hue_preserves_luma():
+    src = _img().astype(np.float32)
+    out = I.HueJitterAug(0.4, rng=_rs(1))(src).asnumpy()
+    luma_in = (src * [0.299, 0.587, 0.114]).sum(-1)
+    luma_out = (out * [0.299, 0.587, 0.114]).sum(-1)
+    np.testing.assert_allclose(luma_out, luma_in, rtol=1e-3, atol=1e-2)
+
+
+def test_lighting_zero_std_identity():
+    src = _img().astype(np.float32)
+    out = I.LightingAug(0.0, rng=_rs(0))(src).asnumpy()
+    np.testing.assert_allclose(out, src, atol=1e-5)
+
+
+def test_random_gray_channels_equal():
+    src = _img().astype(np.float32)
+    aug = I.RandomGrayAug(1.1, rng=_rs(0))  # p>1: always gray
+    out = aug(src).asnumpy()
+    np.testing.assert_allclose(out[..., 0], out[..., 1], rtol=1e-5)
+    np.testing.assert_allclose(out[..., 1], out[..., 2], rtol=1e-5)
+
+
+def test_color_normalize_aug():
+    src = _img().astype(np.float32)
+    mean = np.array([10.0, 20.0, 30.0], np.float32)
+    std = np.array([2.0, 4.0, 8.0], np.float32)
+    out = I.ColorNormalizeAug(mean, std)(src).asnumpy()
+    np.testing.assert_allclose(out, (src - mean) / std, rtol=1e-5)
+
+
+def test_create_augmenter_pipeline():
+    augs = I.CreateAugmenter((3, 24, 24), resize=30, rand_crop=True,
+                             rand_mirror=True, mean=True, std=True,
+                             brightness=0.2, contrast=0.2, saturation=0.2,
+                             hue=0.1, pca_noise=0.1, rand_gray=0.1,
+                             rng=_rs(0))
+    a = _img(50, 70)
+    for aug in augs:
+        a = aug(a)
+    a = a.asnumpy()
+    assert a.shape == (24, 24, 3)
+    assert a.dtype == np.float32
+    # normalized output should be roughly centered
+    assert abs(a.mean()) < 3.0
+
+    # kwargs parity: every documented knob creates the matching augmenter
+    names = [type(x).__name__ for x in augs]
+    for expect in ["ResizeAug", "RandomCropAug", "HorizontalFlipAug",
+                   "CastAug", "ColorJitterAug", "HueJitterAug", "LightingAug",
+                   "RandomGrayAug", "ColorNormalizeAug"]:
+        assert expect in names, names
+
+
+def test_create_augmenter_rand_resize():
+    augs = I.CreateAugmenter((3, 16, 16), rand_crop=True, rand_resize=True,
+                             rng=_rs(0))
+    assert any(type(a).__name__ == "RandomSizedCropAug" for a in augs)
+    a = _img()
+    for aug in augs:
+        a = aug(a)
+    assert a.asnumpy().shape == (16, 16, 3)
+
+
+def test_augmenter_dumps():
+    s = I.BrightnessJitterAug(0.25).dumps()
+    assert "brightnessjitteraug" in s and "0.25" in s
+
+
+def test_random_order_aug():
+    calls = []
+
+    class Rec(I.Augmenter):
+        def __init__(self, tag):
+            super().__init__()
+            self.tag = tag
+
+        def __call__(self, src):
+            calls.append(self.tag)
+            return src
+
+    aug = I.RandomOrderAug([Rec(0), Rec(1), Rec(2)], rng=_rs(0))
+    aug(_img())
+    assert sorted(calls) == [0, 1, 2]
+
+
+# --- detection augmenters ---------------------------------------------------
+
+def _det_label():
+    # [cls, xmin, ymin, xmax, ymax]
+    return np.array([[0, 0.1, 0.2, 0.5, 0.6],
+                     [1, 0.6, 0.5, 0.9, 0.95]], np.float32)
+
+
+def test_det_hflip():
+    src = _img()
+    aug = I.DetHorizontalFlipAug(1.1, rng=_rs(0))  # always flip
+    out, lab = aug(src, _det_label())
+    np.testing.assert_array_equal(out.asnumpy(), src[:, ::-1])
+    np.testing.assert_allclose(lab[0, 1:5], [0.5, 0.2, 0.9, 0.6], atol=1e-6)
+    # widths preserved
+    ref = _det_label()
+    np.testing.assert_allclose(lab[:, 3] - lab[:, 1], ref[:, 3] - ref[:, 1],
+                               atol=1e-6)
+
+
+def test_det_random_crop_labels_valid():
+    src = _img(80, 80)
+    aug = I.DetRandomCropAug(min_object_covered=0.5, area_range=(0.3, 1.0),
+                             rng=_rs(0))
+    out, lab = aug(src, _det_label())
+    assert lab.shape[1] == 5 and lab.shape[0] >= 1
+    assert (lab[:, 1:] >= 0).all() and (lab[:, 1:] <= 1).all()
+    assert (lab[:, 3] > lab[:, 1]).all() and (lab[:, 4] > lab[:, 2]).all()
+
+
+def test_det_random_pad_shrinks_boxes():
+    src = _img(40, 40)
+    aug = I.DetRandomPadAug(area_range=(1.5, 3.0), rng=_rs(0))
+    out, lab = aug(src, _det_label())
+    a = out.asnumpy()
+    assert a.shape[0] >= 40 and a.shape[1] >= 40
+    assert a.shape[0] > 40 or a.shape[1] > 40
+    ref = _det_label()
+    # box widths shrink relative to the padded canvas
+    assert ((lab[:, 3] - lab[:, 1]) <= (ref[:, 3] - ref[:, 1]) + 1e-6).all()
+
+
+def test_det_random_select_skip():
+    aug = I.DetRandomSelectAug(
+        [I.DetHorizontalFlipAug(1.1, rng=_rs(0))], skip_prob=1.1, rng=_rs(0))
+    src = _img()
+    out, lab = aug(src, _det_label())
+    np.testing.assert_array_equal(np.asarray(out), src)  # skipped
+
+
+def test_create_det_augmenter_pipeline():
+    augs = I.CreateDetAugmenter((3, 32, 32), rand_crop=0.5, rand_pad=0.5,
+                                rand_mirror=True, mean=True, std=True,
+                                brightness=0.2, contrast=0.2, saturation=0.2,
+                                rng=_rs(4))
+    src, lab = _img(60, 50), _det_label()
+    for aug in augs:
+        src, lab = aug(src, lab)
+    a = src.asnumpy() if hasattr(src, "asnumpy") else np.asarray(src)
+    assert a.shape == (32, 32, 3) and a.dtype == np.float32
+    assert lab.shape[1] == 5 and len(lab) >= 1
+    assert (lab[:, 1:] >= -1e-6).all() and (lab[:, 1:] <= 1 + 1e-6).all()
+
+
+def test_det_augmenter_determinism():
+    outs = []
+    for seed in (5, 5, 6):
+        augs = I.CreateDetAugmenter((3, 32, 32), rand_crop=0.5, rand_pad=0.5,
+                                    rand_mirror=True, rng=_rs(seed))
+        src, lab = _img(60, 50), _det_label()
+        for aug in augs:
+            src, lab = aug(src, lab)
+        outs.append((np.asarray(src.asnumpy() if hasattr(src, "asnumpy")
+                                else src), lab))
+    np.testing.assert_array_equal(outs[0][0], outs[1][0])
+    np.testing.assert_array_equal(outs[0][1], outs[1][1])
+    assert (not np.array_equal(outs[0][0], outs[2][0])
+            or not np.array_equal(outs[0][1], outs[2][1]))

@@ -48,14 +48,19 @@ class Context:
         return self.devstr2type[self.device_type]
 
     def jax_device(self):
-        """Resolve to a concrete jax.Device."""
+        """Resolve to a concrete jax.Device. Device ids are PER-PROCESS like
+        MXNet's (ref: python/mxnet/context.py — gpu(0) is this worker's first
+        GPU): under multi-controller jax, jax.devices() lists every host's
+        devices, so indexing it would hand other ranks a remote device."""
         if self.device_type in ("cpu", "cpu_pinned"):
             try:
-                devs = jax.devices("cpu")
+                devs = jax.local_devices(backend="cpu")
             except RuntimeError:
-                devs = jax.devices()
+                devs = jax.local_devices()
         else:  # gpu/tpu both mean "the accelerator" on this stack
-            devs = _accel_devices()
+            devs = [d for d in _accel_devices()
+                    if d.process_index == jax.process_index()] or \
+                _accel_devices()
         return devs[min(self.device_id, len(devs) - 1)]
 
     def __eq__(self, other):

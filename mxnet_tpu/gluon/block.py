@@ -258,7 +258,12 @@ class HybridBlock(Block):
             # Parameters become named graph variables; the ONNX exporter and
             # symbol.bind supply their values by name.
             from .. import sym as _sym
-            pkwargs = {n: _sym.var(p.name) for n, p in self._reg_params.items()}
+            # declare param shapes when known so shape-dependent trace logic
+            # (rnn state sizing, reshape heads) can use jax.eval_shape
+            pkwargs = {
+                n: _sym.var(p.name,
+                            shape=p.shape if p._shape_known() else None)
+                for n, p in self._reg_params.items()}
             return self.hybrid_forward(_sym, *args, **pkwargs, **kwargs)
 
         self._ensure_params(*args)

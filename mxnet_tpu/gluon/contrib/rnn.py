@@ -1,0 +1,158 @@
+"""Convolutional recurrent cells (ref: python/mxnet/gluon/contrib/rnn/
+conv_rnn_cell.py — Conv{1,2,3}D{RNN,LSTM,GRU}Cell).
+
+State carries spatial structure: h is (batch, hidden_channels, *spatial);
+i2h/h2h are convolutions instead of dense projections. On TPU both convs
+fuse into one XLA program per step (MXU-tiled), and cells compose with the
+standard RecurrentCell machinery (unroll, SequentialRNNCell, ...)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..rnn.rnn_cell import RecurrentCell
+
+__all__ = ["Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
+           "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+           "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell"]
+
+
+def _tup(v, n):
+    return tuple(v) if isinstance(v, (tuple, list)) else (v,) * n
+
+
+class _ConvCellBase(RecurrentCell):
+    """Shared conv-cell machinery (ref: conv_rnn_cell.py:_BaseConvRNNCell)."""
+
+    _num_gates = 1
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad=0, i2h_dilate=1, h2h_dilate=1, activation="tanh",
+                 **kwargs):
+        super().__init__(**kwargs)
+        dims = len(input_shape) - 1
+        self._dims = dims
+        self._input_shape = tuple(input_shape)  # (C, *spatial)
+        self._hidden_channels = hidden_channels
+        self._activation = activation
+        self._i2h_kernel = _tup(i2h_kernel, dims)
+        self._h2h_kernel = _tup(h2h_kernel, dims)
+        for k in self._h2h_kernel:
+            assert k % 2 == 1, ("h2h kernel must be odd to preserve the "
+                                "state's spatial shape, got %r"
+                                % (self._h2h_kernel,))
+        self._i2h_pad = _tup(i2h_pad, dims)
+        self._i2h_dilate = _tup(i2h_dilate, dims)
+        self._h2h_dilate = _tup(h2h_dilate, dims)
+        # SAME padding for the state conv
+        self._h2h_pad = tuple(d * (k - 1) // 2 for k, d in
+                              zip(self._h2h_kernel, self._h2h_dilate))
+        in_c = input_shape[0]
+        gates = self._num_gates
+        self._state_spatial = tuple(
+            (s + 2 * p - d * (k - 1) - 1) + 1
+            for s, p, k, d in zip(input_shape[1:], self._i2h_pad,
+                                  self._i2h_kernel, self._i2h_dilate))
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight",
+                shape=(gates * hidden_channels, in_c) + self._i2h_kernel)
+            self.h2h_weight = self.params.get(
+                "h2h_weight",
+                shape=(gates * hidden_channels, hidden_channels)
+                + self._h2h_kernel)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(gates * hidden_channels,), init="zeros")
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(gates * hidden_channels,), init="zeros")
+
+    def state_info(self, batch_size=0):
+        shape = (batch_size, self._hidden_channels) + self._state_spatial
+        n = 2 if isinstance(self, _ConvLSTMMixin) else 1
+        return [{"shape": shape} for _ in range(n)]
+
+    def _conv_pair(self, F, inputs, h, i2h_weight, h2h_weight, i2h_bias,
+                   h2h_bias):
+        gates = self._num_gates * self._hidden_channels
+        i2h = F.Convolution(inputs, i2h_weight, i2h_bias,
+                            kernel=self._i2h_kernel, pad=self._i2h_pad,
+                            dilate=self._i2h_dilate, num_filter=gates)
+        h2h = F.Convolution(h, h2h_weight, h2h_bias,
+                            kernel=self._h2h_kernel, pad=self._h2h_pad,
+                            dilate=self._h2h_dilate, num_filter=gates)
+        return i2h, h2h
+
+    def _split(self, F, x, k):
+        c = self._hidden_channels
+        return [F.slice_axis(x, axis=1, begin=i * c, end=(i + 1) * c)
+                for i in range(k)]
+
+    def _act(self, F, x):
+        return F.Activation(x, act_type=self._activation)
+
+
+class _ConvRNNMixin:
+    _num_gates = 1
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._conv_pair(F, inputs, states[0], i2h_weight,
+                                   h2h_weight, i2h_bias, h2h_bias)
+        out = self._act(F, i2h + h2h)
+        return out, [out]
+
+
+class _ConvLSTMMixin:
+    _num_gates = 4
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._conv_pair(F, inputs, states[0], i2h_weight,
+                                   h2h_weight, i2h_bias, h2h_bias)
+        gates = i2h + h2h
+        # MXNet gate order [i, f, g, o] (src/operator/rnn-inl.h)
+        i, f, g, o = self._split(F, gates, 4)
+        i, f, o = F.sigmoid(i), F.sigmoid(f), F.sigmoid(o)
+        g = self._act(F, g)
+        c = f * states[1] + i * g
+        h = o * self._act(F, c)
+        return h, [h, c]
+
+
+class _ConvGRUMixin:
+    _num_gates = 3
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h, h2h = self._conv_pair(F, inputs, states[0], i2h_weight,
+                                   h2h_weight, i2h_bias, h2h_bias)
+        # gate order [r, z, n]; reset applied after the recurrent conv
+        ir, iz, inn = self._split(F, i2h, 3)
+        hr, hz, hn = self._split(F, h2h, 3)
+        r = F.sigmoid(ir + hr)
+        z = F.sigmoid(iz + hz)
+        n = self._act(F, inn + r * hn)
+        out = (1 - z) * n + z * states[0]
+        return out, [out]
+
+
+def _cell(name, mixin, dims):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 **kwargs):
+        assert len(input_shape) == dims + 1, (
+            "%s expects input_shape (C,%s), got %r"
+            % (name, ",".join("S" * dims), input_shape))
+        _ConvCellBase.__init__(self, input_shape, hidden_channels,
+                               i2h_kernel, h2h_kernel, **kwargs)
+
+    return type(name, (mixin, _ConvCellBase), {"__init__": __init__})
+
+
+Conv1DRNNCell = _cell("Conv1DRNNCell", _ConvRNNMixin, 1)
+Conv2DRNNCell = _cell("Conv2DRNNCell", _ConvRNNMixin, 2)
+Conv3DRNNCell = _cell("Conv3DRNNCell", _ConvRNNMixin, 3)
+Conv1DLSTMCell = _cell("Conv1DLSTMCell", _ConvLSTMMixin, 1)
+Conv2DLSTMCell = _cell("Conv2DLSTMCell", _ConvLSTMMixin, 2)
+Conv3DLSTMCell = _cell("Conv3DLSTMCell", _ConvLSTMMixin, 3)
+Conv1DGRUCell = _cell("Conv1DGRUCell", _ConvGRUMixin, 1)
+Conv2DGRUCell = _cell("Conv2DGRUCell", _ConvGRUMixin, 2)
+Conv3DGRUCell = _cell("Conv3DGRUCell", _ConvGRUMixin, 3)

@@ -116,13 +116,46 @@ class DistKVStore(KVStore):
                 self._store[k] = agg.copy()
 
 
+_DCN_REDUCER = None
+
+
 def _allreduce_across_hosts(x):
-    devs = jax.devices()
-    if len(devs) <= 1:
+    """SUM of each host's value across all hosts (push semantics are a sum,
+    ref: src/kvstore/kvstore_dist.h — ps-lite servers add worker pushes).
+
+    Every host broadcasts its value onto its local devices and a global psum
+    runs over all devices; that counts each host's contribution
+    local_device_count times, so the result is divided by local_device_count
+    (NOT device_count, which would compute the mean over hosts)."""
+    if jax.process_count() <= 1:
         return x
-    f = jax.pmap(lambda v: jax.lax.psum(v, "i"), axis_name="i")
-    rep = jnp.broadcast_to(x, (jax.local_device_count(),) + x.shape)
-    return f(rep)[0] / jax.device_count()
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    def local_np(a):
+        # multi-controller jit outputs can be global replicated arrays whose
+        # full value is not host-fetchable; the local shard IS the value then
+        if isinstance(a, jax.Array) and not a.is_fully_addressable:
+            return np.asarray(a.addressable_data(0))
+        return np.asarray(a)
+
+    # Global-array reduction over DCN: each process lays its value on its own
+    # devices along a device axis, one jitted sum collapses that axis (XLA
+    # inserts the cross-host all-reduce), result is replicated everywhere.
+    # Each host contributes local_device_count identical rows → divide.
+    global _DCN_REDUCER
+    if _DCN_REDUCER is None:
+        # cached: a fresh lambda per push would recompile every step
+        mesh = Mesh(np.array(jax.devices()), ("p",))
+        _DCN_REDUCER = (mesh, jax.jit(
+            lambda a: jnp.sum(a, axis=0) / jax.local_device_count(),
+            out_shardings=NamedSharding(mesh, PartitionSpec())))
+    mesh, reducer = _DCN_REDUCER
+    rep = np.broadcast_to(local_np(x),
+                          (jax.local_device_count(),) + np.shape(x))
+    garr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, PartitionSpec("p")), rep)
+    return jnp.asarray(local_np(reducer(garr)))
 
 
 def _normalize(key, value):

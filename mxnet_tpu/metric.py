@@ -90,15 +90,15 @@ class TopKAccuracy(EvalMetric):
             self.num_inst += label.shape[0]
 
 
-@register
-class F1(EvalMetric):
-    def __init__(self, name="f1", average="macro", **kwargs):
-        super().__init__(name, **kwargs)
-        self.average = average
+class _ConfusionMetric(EvalMetric):
+    """Accumulates per-class tp/fp/fn (ref: python/mxnet/metric.py
+    _BinaryClassificationMetrics, generalized to multiclass)."""
 
     def reset(self):
         super().reset()
-        self.tp = self.fp = self.fn = 0.0
+        self.tp = {}
+        self.fp = {}
+        self.fn = {}
 
     def update(self, labels, preds):
         if isinstance(labels, (NDArray, np.ndarray)):
@@ -108,16 +108,72 @@ class F1(EvalMetric):
             if pred.ndim > 1:
                 pred = np.argmax(pred, axis=-1)
             pred = pred.astype("int64").ravel()
-            self.tp += float(((pred == 1) & (label == 1)).sum())
-            self.fp += float(((pred == 1) & (label == 0)).sum())
-            self.fn += float(((pred == 0) & (label == 1)).sum())
+            # one-pass confusion matrix; per-class loops would cost O(C)
+            # full-array scans per batch
+            c = int(max(label.max(initial=0), pred.max(initial=0))) + 1
+            cm = np.bincount(label * c + pred,
+                             minlength=c * c).reshape(c, c).astype(np.float64)
+            row = cm.sum(axis=1)  # true class counts
+            col = cm.sum(axis=0)  # predicted class counts
+            diag = np.diag(cm)
+            for k in np.nonzero(row + col)[0]:
+                k = int(k)
+                self.tp[k] = self.tp.get(k, 0.0) + diag[k]
+                self.fp[k] = self.fp.get(k, 0.0) + (col[k] - diag[k])
+                self.fn[k] = self.fn.get(k, 0.0) + (row[k] - diag[k])
             self.num_inst += 1
 
+
+@register
+class F1(_ConfusionMetric):
+    """F1 with micro/macro averaging (ref: python/mxnet/metric.py:F1).
+    For the binary case with average='macro' this reports the class-1 F1,
+    matching the upstream binary F1."""
+
+    def __init__(self, name="f1", average="macro", **kwargs):
+        super().__init__(name, **kwargs)
+        self.average = average
+
+    @staticmethod
+    def _f1(tp, fp, fn):
+        prec = tp / max(tp + fp, 1e-12)
+        rec = tp / max(tp + fn, 1e-12)
+        return 2 * prec * rec / max(prec + rec, 1e-12)
+
     def get(self):
-        prec = self.tp / max(self.tp + self.fp, 1e-12)
-        rec = self.tp / max(self.tp + self.fn, 1e-12)
-        f1 = 2 * prec * rec / max(prec + rec, 1e-12)
-        return self.name, f1
+        classes = sorted(self.tp)
+        if not classes:
+            return self.name, 0.0
+        if self.average == "micro":
+            tp = sum(self.tp.values())
+            fp = sum(self.fp.values())
+            fn = sum(self.fn.values())
+            return self.name, self._f1(tp, fp, fn)
+        if classes == [0, 1] or classes == [1] or classes == [0]:
+            # binary: upstream F1 is the positive-class score
+            return self.name, self._f1(self.tp.get(1, 0.0),
+                                       self.fp.get(1, 0.0),
+                                       self.fn.get(1, 0.0))
+        scores = [self._f1(self.tp[c], self.fp[c], self.fn[c])
+                  for c in classes]
+        return self.name, float(np.mean(scores))
+
+
+@register
+class MCC(_ConfusionMetric):
+    """Matthews correlation coefficient (ref: python/mxnet/metric.py:MCC),
+    binary: (tp·tn − fp·fn) / sqrt((tp+fp)(tp+fn)(tn+fp)(tn+fn))."""
+
+    def __init__(self, name="mcc", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def get(self):
+        tp = self.tp.get(1, 0.0)
+        fp = self.fp.get(1, 0.0)
+        fn = self.fn.get(1, 0.0)
+        tn = self.tp.get(0, 0.0)
+        denom = np.sqrt((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+        return self.name, float((tp * tn - fp * fn) / max(denom, 1e-12))
 
 
 @register

@@ -48,3 +48,31 @@ erf = _op("erf")
 erfinv = _op("erfinv")
 smooth_l1 = _op("smooth_l1")
 sequence_mask = _op("SequenceMask")
+gather_nd = _op("gather_nd")
+scatter_nd = _op("scatter_nd")
+leaky_relu = _op("LeakyReLU")
+activation = _op("Activation")
+rnn = _op("RNN")
+broadcast_like = _op("broadcast_like")
+reshape_like = _op("reshape_like")
+sequence_last = _op("SequenceLast")
+sequence_reverse = _op("SequenceReverse")
+multibox_prior = _op("multibox_prior")
+multibox_detection = _op("multibox_detection")
+box_nms = _op("box_nms")
+box_iou = _op("box_iou")
+ctc_loss = _op("CTCLoss")
+
+
+def __getattr__(name):
+    """Any registry op is reachable as npx.<name> (ref: MXNet 2.x generates
+    mx.npx from the operator registry the same way)."""
+    import sys
+
+    from .base import OP_REGISTRY
+
+    if name in OP_REGISTRY:
+        f = _op(name)
+        setattr(sys.modules[__name__], name, f)
+        return f
+    raise AttributeError("npx has no op %r" % name)

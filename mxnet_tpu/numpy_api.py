@@ -206,3 +206,163 @@ def zeros_like(a):
 
 def ones_like(a):
     return invoke("ones_like", (a,), {})
+
+
+# ---------------------------------------------------------------------------
+# Full-surface delegation (ref: python/mxnet/numpy/multiarray.py — MXNet 2.x
+# implements the numpy API op-by-op in C++; here jax.numpy IS that API on
+# TPU, so any name not explicitly wrapped above delegates to jnp with
+# NDArray unwrap/wrap. Dispatch stays imperative-async: each call is an XLA
+# op launch, exactly like the explicit wrappers.)
+# ---------------------------------------------------------------------------
+
+import types as _types
+
+
+def _unwrap_tree(v):
+    if isinstance(v, NDArray):
+        return v._data
+    if isinstance(v, (list, tuple)):
+        return type(v)(_unwrap_tree(x) for x in v)
+    return v
+
+
+def _wrap_tree(v):
+    if isinstance(v, jnp.ndarray) and not isinstance(v, _onp.ndarray):
+        return NDArray(v)
+    if isinstance(v, tuple):
+        wrapped = [_wrap_tree(x) for x in v]
+        if hasattr(v, "_fields"):  # namedtuple results (SVDResult, EighResult)
+            return type(v)(*wrapped)
+        return tuple(wrapped)
+    if isinstance(v, list):
+        return [_wrap_tree(x) for x in v]
+    return v
+
+
+def _delegate(fn, name):
+    def g(*args, **kwargs):
+        args = [_unwrap_tree(a) for a in args]
+        kwargs = {k: _unwrap_tree(v) for k, v in kwargs.items()}
+        return _wrap_tree(fn(*args, **kwargs))
+
+    g.__name__ = name
+    g.__qualname__ = name
+    g.__doc__ = "mx.np.%s — delegates to jax.numpy.%s (TPU-native)." % (name, name)
+    return g
+
+
+class _DelegatedModule(_types.ModuleType):
+    """Namespace view over a jnp submodule (linalg, fft) with NDArray I/O."""
+
+    def __init__(self, base, name):
+        super().__init__(name)
+        self._base = base
+
+    def __getattr__(self, name):
+        fn = getattr(self._base, name)
+        if not callable(fn):
+            return fn
+        g = _delegate(fn, name)
+        setattr(self, name, g)
+        return g
+
+
+linalg = _DelegatedModule(jnp.linalg, "mxnet_tpu.np.linalg")
+fft = _DelegatedModule(jnp.fft, "mxnet_tpu.np.fft")
+
+
+def __getattr__(name):
+    import sys
+    fn = getattr(jnp, name, None)
+    if fn is None:
+        raise AttributeError("mx.np has no attribute %r" % name)
+    if not callable(fn) or isinstance(fn, type):
+        return fn  # dtypes, constants
+    g = _delegate(fn, name)
+    setattr(sys.modules[__name__], name, g)
+    return g
+
+
+# host-semantics names jnp doesn't carry: delegate to classic numpy where the
+# semantics are host-side anyway (IO, printing, error state), alias the rest
+True_ = _onp.True_
+False_ = _onp.False_
+byte, ubyte, short, ushort = _onp.byte, _onp.ubyte, _onp.short, _onp.ushort
+intc, uintc, intp, uintp = _onp.intc, _onp.uintc, _onp.intp, _onp.uintp
+long, ulong = _onp.int64, _onp.uint64
+longlong, ulonglong = _onp.longlong, _onp.ulonglong
+half, longdouble = _onp.half, _onp.longdouble
+str_, bytes_, void = _onp.str_, _onp.bytes_, _onp.void
+datetime64, timedelta64 = _onp.datetime64, _onp.timedelta64
+little_endian = _onp.little_endian
+
+
+def asanyarray(a, dtype=None):
+    return asarray(a, dtype=dtype)
+
+
+def ascontiguousarray(a, dtype=None):
+    return asarray(a, dtype=dtype)
+
+
+def asfortranarray(a, dtype=None):
+    return asarray(a, dtype=dtype)  # layout is XLA's concern on TPU
+
+
+def asarray_chkfinite(a, dtype=None):
+    out = asarray(a, dtype=dtype)
+    if not _onp.isfinite(out.asnumpy()).all():
+        raise ValueError("array must not contain infs or NaNs")
+    return out
+
+
+def copyto(dst, src):
+    dst._data = asarray(src)._data
+
+
+def in1d(ar1, ar2, **kwargs):
+    return _wrap_tree(jnp.isin(_unwrap_tree(asarray(ar1)._data),
+                               _unwrap_tree(asarray(ar2)._data), **kwargs))
+
+
+def trapz(y, x=None, dx=1.0, axis=-1):
+    return _wrap_tree(jnp.trapezoid(_unwrap_tree(asarray(y)._data),
+                                    None if x is None else asarray(x)._data,
+                                    dx=dx, axis=axis))
+
+
+def row_stack(tup):
+    return _wrap_tree(jnp.vstack([_unwrap_tree(asarray(t)._data)
+                                  for t in tup]))
+
+
+def _host_fn(name):
+    fn = getattr(_onp, name)
+
+    def g(*args, **kwargs):
+        args = [a.asnumpy() if isinstance(a, NDArray) else a for a in args]
+        return fn(*args, **kwargs)
+
+    g.__name__ = name
+    return g
+
+
+# host-side IO / formatting — results feed back through asarray when needed
+loadtxt = _host_fn("loadtxt")
+genfromtxt = _host_fn("genfromtxt")
+savetxt = _host_fn("savetxt")
+savez_compressed = _host_fn("savez_compressed")
+array2string = _host_fn("array2string")
+format_float_positional = _host_fn("format_float_positional")
+format_float_scientific = _host_fn("format_float_scientific")
+base_repr = _host_fn("base_repr")
+binary_repr = _host_fn("binary_repr")
+typename = _host_fn("typename")
+min_scalar_type = _host_fn("min_scalar_type")
+common_type = _host_fn("common_type")
+mintypecode = _host_fn("mintypecode")
+real_if_close = _host_fn("real_if_close")
+errstate = _onp.errstate
+geterr, seterr = _onp.geterr, _onp.seterr
+ndenumerate, ndindex = _onp.ndenumerate, _onp.ndindex

@@ -33,6 +33,7 @@ class _Ctx:
         self.nodes = []
         self.initializers = {}
         self.names = {}     # id(symbol) -> output value name
+        self.multi = {}     # id(symbol) -> [name per output] for multi-output ops
         self.params = params
         self.opset = opset
         self._uid = 0
@@ -331,7 +332,376 @@ def _const_conv(ctx, s, ins, out):
     ctx.initializers[out] = val
 
 
+@register_converter("_filled")
+def _filled_conv(ctx, s, ins, out):
+    a = s._attrs
+    from ..base import resolve_dtype
+    ctx.initializers[out] = np.full(tuple(a["shape"]), a["value"],
+                                    np.dtype(resolve_dtype(a.get("dtype", "float32"))))
+
+
+@register_converter("zeros_like")
+def _zeros_like_conv(ctx, s, ins, out):
+    # Shape→ConstantOfShape, not Mul(x, 0): type-correct for any dtype and
+    # immune to 0·inf = NaN
+    shp = ctx.fresh("shape")
+    ctx.emit("Shape", [ins[0]], [shp])
+    ctx.emit("ConstantOfShape", [shp], [out],
+             attrs={"value": np.zeros(1, np.float32)})
+
+
+@register_converter("multibox_prior")
+def _multibox_prior_conv(ctx, s, ins, out):
+    """Anchors depend only on the (static) feature-map shape and attrs, so
+    they export as a precomputed constant initializer (upstream mx2onnx
+    lowers _contrib_MultiBoxPrior the same way when shapes are static)."""
+    from ..base import OP_REGISTRY
+    import jax
+
+    shape = s._inputs[0].shape  # requires var shapes (set by symbol_to_onnx)
+    a = dict(s._attrs)
+    x = np.zeros(shape, np.float32)
+    anchors = np.asarray(OP_REGISTRY["multibox_prior"].fn(x, **a))
+    ctx.initializers[out] = anchors.astype(np.float32)
+
+
+@register_converter("_onnx_shape")
+def _onnx_shape_conv(ctx, s, ins, out):
+    ctx.emit("Shape", ins[:1], [out])
+
+
+@register_converter("cast")
+def _cast_conv(ctx, s, ins, out):
+    from ..base import resolve_dtype
+    code = P.np_to_onnx_dtype(np.dtype(resolve_dtype(s._attrs["dtype"])))
+    ctx.emit("Cast", ins[:1], [out], attrs={"to": int(code)})
+
+
+@register_converter("UpSampling")
+def _upsampling_conv(ctx, s, ins, out):
+    a = s._attrs
+    scale = float(a.get("scale", 2))
+    scales = ctx.const("scales", np.asarray([1.0, 1.0, scale, scale],
+                                            np.float32))
+    if a.get("sample_type", "nearest") == "nearest":
+        # jnp.repeat == asymmetric coords + floor nearest rounding
+        attrs = {"mode": "nearest",
+                 "coordinate_transformation_mode": "asymmetric",
+                 "nearest_mode": "floor"}
+    else:
+        attrs = {"mode": "linear",
+                 "coordinate_transformation_mode": "half_pixel"}
+    ctx.emit("Resize", [ins[0], "", scales], [out], attrs=attrs)
+
+
+@register_converter("BilinearResize2D")
+def _bilinear_resize_conv(ctx, s, ins, out):
+    a = s._attrs
+    attrs = {"mode": "linear",
+             "coordinate_transformation_mode": "half_pixel"}
+    if a.get("height") is not None:
+        n, c = s._inputs[0].shape[:2]
+        sizes = ctx.const("sizes", np.asarray(
+            [n, c, int(a["height"]), int(a["width"])], np.int64))
+        ctx.emit("Resize", [ins[0], "", "", sizes], [out], attrs=attrs)
+    else:
+        scales = ctx.const("scales", np.asarray(
+            [1.0, 1.0, float(a["scale_height"]), float(a["scale_width"])],
+            np.float32))
+        ctx.emit("Resize", [ins[0], "", scales], [out], attrs=attrs)
+
+
+def _slice_emit(ctx, src, start, end, axis, hint):
+    out = ctx.fresh(hint)
+    ctx.emit("Slice", [src,
+                       ctx.const("starts", np.asarray([start], np.int64)),
+                       ctx.const("ends", np.asarray([end], np.int64)),
+                       ctx.const("axes", np.asarray([axis], np.int64))], [out])
+    return out
+
+
+@register_converter("box_nms")
+def _box_nms_conv(ctx, s, ins, out):
+    """box_nms → NonMaxSuppression + gather/scatter reconstruction.
+
+    MXNet box_nms keeps boxes in place and sets suppressed SCORES to -1
+    (src/operator/contrib/bounding_box.cc), so the ONNX form is: NMS selects
+    surviving (batch, box) pairs; a -1-filled score plane is ScatterND-ed
+    with the surviving scores; ids/boxes columns pass through unchanged."""
+    a = s._attrs
+    if (a.get("coord_start", 2) != 2 or a.get("score_index", 1) != 1
+            or a.get("in_format", "corner") != "corner"):
+        raise ValueError("box_nms export supports the standard "
+                         "[id, score, x1,y1,x2,y2] corner layout only")
+    id_index = a.get("id_index", 0)
+    if id_index >= 0 and not a.get("force_suppress", False):
+        raise ValueError(
+            "box_nms export: per-class suppression (id_index>=0, "
+            "force_suppress=False) cannot map to ONNX NMS, whose classes "
+            "are a static scores axis — use force_suppress=True or "
+            "id_index=-1")
+    in_shape = s._inputs[0].shape
+    if len(in_shape) != 3 or in_shape[-1] != 6:
+        raise ValueError(
+            "box_nms export supports (B, N, 6) data only, got %r — extra "
+            "label columns or 2-D inputs would be silently dropped"
+            % (in_shape,))
+    data = ins[0]
+    N = in_shape[-2]
+    topk = int(a.get("topk", -1))
+    ids = _slice_emit(ctx, data, 0, 1, 2, "nms_ids")             # (B,N,1)
+    scores3 = _slice_emit(ctx, data, 1, 2, 2, "nms_scores")      # (B,N,1)
+    boxes = _slice_emit(ctx, data, 2, 6, 2, "nms_boxes")         # (B,N,4)
+    scoresT = ctx.fresh("nms_scoresT")
+    ctx.emit("Transpose", [scores3], [scoresT], attrs={"perm": [0, 2, 1]})
+    sel = ctx.fresh("nms_sel")
+    ctx.emit("NonMaxSuppression",
+             [boxes, scoresT,
+              ctx.const("max_out", np.asarray(
+                  [topk if topk > 0 else N], np.int64)),
+              ctx.const("iou", np.asarray(
+                  [float(a.get("overlap_thresh", 0.5))], np.float32)),
+              ctx.const("score_th", np.asarray(
+                  [float(a.get("valid_thresh", 0.0))], np.float32))],
+             [sel])                                              # (M,3)
+    bcol = _slice_emit(ctx, sel, 0, 1, 1, "nms_bi")
+    icol = _slice_emit(ctx, sel, 2, 3, 1, "nms_box_i")
+    idx2 = ctx.fresh("nms_idx2")
+    ctx.emit("Concat", [bcol, icol], [idx2], attrs={"axis": 1})  # (M,2)
+    scores2 = ctx.fresh("nms_scores2")
+    ctx.emit("Squeeze", [scores3, ctx.const("axes",
+                                            np.asarray([2], np.int64))],
+             [scores2])                                          # (B,N)
+    kept = ctx.fresh("nms_kept")
+    ctx.emit("GatherND", [scores2, idx2], [kept])                # (M,)
+    z = ctx.fresh("nms_zero")
+    ctx.emit("Mul", [scores2, ctx.const("zero", np.float32(0.0))], [z])
+    neg = ctx.fresh("nms_neg")
+    ctx.emit("Add", [z, ctx.const("negone", np.float32(-1.0))], [neg])
+    new2 = ctx.fresh("nms_new2")
+    ctx.emit("ScatterND", [neg, idx2, kept], [new2])             # (B,N)
+    new3 = ctx.fresh("nms_new3")
+    ctx.emit("Unsqueeze", [new2, ctx.const("axes",
+                                           np.asarray([2], np.int64))],
+             [new3])
+    ctx.emit("Concat", [ids, new3, boxes], [out], attrs={"axis": 2})
+
+
+@register_converter("_onnx_nms")
+def _onnx_nms_conv(ctx, s, ins, out):
+    a = s._attrs
+    # our op treats max_output=0 as "keep all" (K=N); ONNX spec reads a
+    # literal 0 as "select nothing", so absent/0 exports as the box count
+    max_out = int(a.get("max_output_boxes_per_class", 0))
+    if max_out <= 0:
+        max_out = int(s._inputs[0].shape[-2])
+    node_in = [ins[0], ins[1],
+               ctx.const("max_out", np.asarray([max_out], np.int64)),
+               ctx.const("iou", np.asarray(
+                   [float(a.get("iou_threshold", 0.0))], np.float32))]
+    if a.get("score_threshold") is not None:
+        # absent means "no filtering" — omit the optional input rather than
+        # writing 0.0, which would newly drop negative-score boxes
+        node_in.append(ctx.const("score_th", np.asarray(
+            [float(a["score_threshold"])], np.float32)))
+    ctx.emit("NonMaxSuppression", node_in, [out],
+             attrs={"center_point_box": int(a.get("center_point_box", 0))})
+
+
+@register_converter("_onnx_gather_nd")
+def _onnx_gather_nd_conv(ctx, s, ins, out):
+    ctx.emit("GatherND", ins[:2], [out])
+
+
+@register_converter("_onnx_scatter_nd")
+def _onnx_scatter_nd_conv(ctx, s, ins, out):
+    ctx.emit("ScatterND", ins[:3], [out])
+
+
+# ------------------------------------------------------------ recurrent ops
+
+# MXNet gate order: LSTM [i, f, g, o], GRU [r, z, n] (src/operator/rnn-inl.h).
+# ONNX gate order:  LSTM [i, o, f, c], GRU [z, r, h].
+_LSTM_TO_ONNX = [0, 3, 1, 2]
+_GRU_TO_ONNX = [1, 0, 2]
+_LSTM_FROM_ONNX = [0, 2, 3, 1]
+_GRU_FROM_ONNX = [1, 0, 2]
+
+
+def _gate_perm(arr, perm, hidden):
+    g = len(perm)
+    return np.ascontiguousarray(
+        arr.reshape((g, hidden) + arr.shape[1:])[perm].reshape(arr.shape))
+
+
+@register_converter("RNN")
+def _rnn_conv(ctx, s, ins, out):
+    """Fused multi-layer RNN → one ONNX LSTM/GRU/RNN node per layer
+    (ONNX recurrent ops are single-layer; num_directions is the only stacking
+    they support). Weight initializers are re-blocked to ONNX gate order."""
+    a = s._attrs
+    mode = a.get("mode", "lstm")
+    L = int(a.get("num_layers", 1))
+    D = 2 if a.get("bidirectional") else 1
+    onnx_op = {"lstm": "LSTM", "gru": "GRU"}.get(mode, "RNN")
+    perm = {"lstm": _LSTM_TO_ONNX, "gru": _GRU_TO_ONNX}.get(mode, [0])
+
+    def arr_of(name):
+        if name not in ctx.initializers:
+            raise ValueError("RNN export: weight %r must be a parameter" % name)
+        return np.asarray(ctx.initializers[name], np.float32)
+
+    def state_slice(state_name, layer, hint):
+        sl = ctx.fresh(hint)
+        ctx.emit("Slice", [state_name,
+                           ctx.const("starts", np.asarray([layer * D], np.int64)),
+                           ctx.const("ends", np.asarray([(layer + 1) * D], np.int64)),
+                           ctx.const("axes", np.asarray([0], np.int64))], [sl])
+        return sl
+
+    cur = ins[0]
+    wnames = ins[3:]
+    hs, cs = [], []
+    wi = 0
+    for layer in range(L):
+        Ws, Rs, Bs = [], [], []
+        H = None
+        for _ in range(D):
+            wih, whh, bih, bhh = (arr_of(wnames[wi + k]) for k in range(4))
+            wi += 4
+            H = whh.shape[1]
+            Ws.append(_gate_perm(wih, perm, H))
+            Rs.append(_gate_perm(whh, perm, H))
+            Bs.append(np.concatenate([_gate_perm(bih, perm, H),
+                                      _gate_perm(bhh, perm, H)]))
+        W = ctx.const("rnn_W", np.stack(Ws))
+        R = ctx.const("rnn_R", np.stack(Rs))
+        B = ctx.const("rnn_B", np.stack(Bs))
+        node_in = [cur, W, R, B, "", state_slice(ins[1], layer, "rnn_h0")]
+        if mode == "lstm":
+            node_in.append(state_slice(ins[2], layer, "rnn_c0"))
+        attrs = {"hidden_size": H,
+                 "direction": "bidirectional" if D == 2 else "forward"}
+        if mode == "gru":
+            # our GRU applies reset AFTER the recurrent matmul+bias
+            attrs["linear_before_reset"] = 1
+        if onnx_op == "RNN":
+            attrs["activations"] = ["Tanh" if mode == "rnn_tanh" else "Relu"] * D
+        y = ctx.fresh("rnn_Y")
+        yh = ctx.fresh("rnn_Yh")
+        outs = [y, yh]
+        if mode == "lstm":
+            yc = ctx.fresh("rnn_Yc")
+            outs.append(yc)
+            cs.append(yc)
+        ctx.emit(onnx_op, node_in, outs, attrs=attrs)
+        hs.append(yh)
+        # Y (T, D, N, H) → next layer's X (T, N, D*H)
+        tr = ctx.fresh("rnn_tr")
+        ctx.emit("Transpose", [y], [tr], attrs={"perm": [0, 2, 1, 3]})
+        rs = ctx.fresh("rnn_seq")
+        ctx.emit("Reshape", [tr, ctx.const("shape",
+                                           np.asarray([0, 0, -1], np.int64))],
+                 [rs])
+        cur = rs
+
+    def stack_states(names, hint):
+        if len(names) == 1:
+            return names[0]
+        cat = ctx.fresh(hint)
+        ctx.emit("Concat", names, [cat], attrs={"axis": 0})
+        return cat
+
+    h_out = stack_states(hs, "rnn_hn")
+    # non-LSTM modes pass the input cell state through untouched
+    # (ops/rnn.py returns c0) — mirror that, not hn
+    c_out = stack_states(cs, "rnn_cn") if mode == "lstm" else ins[2]
+    ctx.multi[id(s)] = [cur, h_out, c_out]
+    ctx.names[id(s)] = cur
+    return cur
+
+
+@register_converter("_cond")
+def _cond_conv(ctx, s, ins, out):
+    """symbol.cond → ONNX If. Branch subgraphs reference outer-scope values
+    by name (ONNX scoping) — the branch var symbols ARE the outer graph
+    symbols, so their names are already assigned in ctx.names."""
+    a = s._attrs
+    pred = ctx.fresh("cond_pred")
+    ctx.emit("Cast", [ins[0]], [pred], attrs={"to": int(P.BOOL)})
+
+    # names assigned before this node belong to the OUTER scope; anything a
+    # branch adds (including nodes shared between the two branches) must be
+    # re-emitted per branch — ONNX subgraphs can see outer names but never a
+    # sibling subgraph's internals
+    outer_names = dict(ctx.names)
+    outer_multi = dict(ctx.multi)
+
+    def branch_graph(branch_sym, tag):
+        saved = ctx.nodes
+        ctx.nodes = []
+        ctx.names = dict(outer_names)
+        ctx.multi = dict(outer_multi)
+        order = _toposort([branch_sym])
+        for node in order:
+            if node.is_var():
+                if id(node) not in ctx.names:
+                    raise ValueError("If export: branch var %r not in outer "
+                                     "scope" % node.name)
+                continue
+            if id(node) in ctx.names:
+                continue  # emitted in the outer graph, visible by scoping
+            _convert_node(ctx, node)
+        bout = ctx.names[id(branch_sym)]
+        nodes = ctx.nodes
+        ctx.nodes = saved
+        g = P.graph_proto("%s_%s" % (s.name, tag), nodes, [],
+                          [P.value_info(bout, np.float32, ())], [])
+        return P.GraphAttr(g)
+
+    try:
+        then_attr = branch_graph(a["then_sym"], "then")
+        else_attr = branch_graph(a["else_sym"], "else")
+    finally:
+        ctx.names = outer_names
+        ctx.multi = outer_multi
+    ctx.names[id(s)] = out
+
+    ctx.emit("If", [pred], [out],
+             attrs={"then_branch": then_attr, "else_branch": else_attr})
+
+
 # ------------------------------------------------------------- graph walker
+
+def _convert_node(ctx, s):
+    """Translate one non-var Symbol node, registering its output name(s)."""
+    if s._op == "_item":
+        # projection of a multi-output op. Converters that emit every
+        # output (RNN) fill ctx.multi; otherwise only index 0 exists —
+        # consuming a secondary output (e.g. BatchNorm's updated running
+        # stats) has no ONNX inference-graph equivalent.
+        parent = s._inputs[0]
+        idx = s._attrs.get("index", 0)
+        multi = ctx.multi.get(id(parent))
+        if multi is not None:
+            ctx.names[id(s)] = multi[idx]
+            return
+        if idx != 0:
+            raise ValueError(
+                "cannot export: graph consumes output %d of %r — only "
+                "the primary output of multi-output ops maps to ONNX "
+                "inference graphs" % (idx, parent._op))
+        ctx.names[id(s)] = ctx.names[id(parent)]
+        return
+    ins = [ctx.names[id(i)] for i in s._inputs]
+    out = ctx.fresh(s.name or s._op)
+    ctx.names[id(s)] = out
+    conv = _CONVERTERS.get(s._op)
+    if conv is None:
+        raise ValueError("no ONNX converter for op %r (export coverage "
+                         "mirrors mx2onnx/_op_translations)" % s._op)
+    conv(ctx, s, ins, out)
+
 
 def _toposort(outputs):
     order, seen = [], set()
@@ -361,7 +731,10 @@ def symbol_to_onnx(sym_out, params, input_shapes, input_dtypes=None,
     ctx = _Ctx(params, opset)
     input_dtypes = input_dtypes or {}
 
-    # name variables; params become initializers, the rest graph inputs
+    # name variables; params become initializers, the rest graph inputs.
+    # Every var gets its static shape so converters needing shapes (RNN
+    # inter-layer reshapes, multibox_prior constant-folding) can query
+    # Symbol.shape (jax.eval_shape through the graph).
     graph_inputs = []
     for s in order:
         if not s.is_var():
@@ -369,9 +742,13 @@ def symbol_to_onnx(sym_out, params, input_shapes, input_dtypes=None,
         ctx.names[id(s)] = s.name
         if s.name in params:
             ctx.initializers[s.name] = np.asarray(params[s.name])
+            if s._shape is None:
+                s._shape = tuple(np.asarray(params[s.name]).shape)
         else:
             if s.name not in input_shapes:
                 raise ValueError("no shape for graph input %r" % s.name)
+            if s._shape is None:
+                s._shape = tuple(input_shapes[s.name])
             graph_inputs.append(
                 P.value_info(s.name, input_dtypes.get(s.name, np.float32),
                              input_shapes[s.name]))
@@ -379,28 +756,7 @@ def symbol_to_onnx(sym_out, params, input_shapes, input_dtypes=None,
     for s in order:
         if s.is_var():
             continue
-        if s._op == "_item":
-            # projection of a multi-output op: index 0 is the op's main
-            # output. Reaching an index>0 projection in the walk means the
-            # graph consumes a secondary output (e.g. BatchNorm's updated
-            # running stats) that no exported ONNX node produces.
-            parent = s._inputs[0]
-            idx = s._attrs.get("index", 0)
-            if idx != 0:
-                raise ValueError(
-                    "cannot export: graph consumes output %d of %r — only "
-                    "the primary output of multi-output ops maps to ONNX "
-                    "inference graphs" % (idx, parent._op))
-            ctx.names[id(s)] = ctx.names[id(parent)]
-            continue
-        ins = [ctx.names[id(i)] for i in s._inputs]
-        out = ctx.fresh(s.name or s._op)
-        ctx.names[id(s)] = out
-        conv = _CONVERTERS.get(s._op)
-        if conv is None:
-            raise ValueError("no ONNX converter for op %r (export coverage "
-                             "mirrors mx2onnx/_op_translations)" % s._op)
-        conv(ctx, s, ins, out)
+        _convert_node(ctx, s)
 
     out_infos = [P.value_info(ctx.names[id(o)], np.float32, ())
                  for o in outputs]
@@ -432,7 +788,9 @@ def export_model(model, params=None, input_shapes=None, input_types=None,
         params = {k: np.asarray(v.asnumpy() if hasattr(v, "asnumpy") else v)
                   for k, v in (params or {}).items()}
     else:
-        data = [_sym.var(n) for n in input_shapes]
+        # shapes on the trace vars: hybrid_forward code may query x.shape
+        # (rnn state sizing, SSD reshape heads)
+        data = [_sym.var(n, shape=tuple(input_shapes[n])) for n in input_shapes]
         sym_out = model(*data)
         if isinstance(sym_out, (list, tuple)):
             from ..symbol import Group

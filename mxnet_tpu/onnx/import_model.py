@@ -310,7 +310,7 @@ def _reduce(mx_op):
             ax_init = g.initializers.get(node["inputs"][1])
             if ax_init is None:
                 raise ValueError("%s: dynamic axes input unsupported"
-                                 % node["op_type"])
+                                 % node["op"])
             axes = [int(x) for x in np.asarray(ax_init).reshape(-1)]
         kw = {"keepdims": bool(a.get("keepdims", 1))}
         if axes is not None:
@@ -361,6 +361,223 @@ for _onnx, _mx in [("Exp", "exp"), ("Log", "log"), ("Sqrt", "sqrt"),
                    ("Sin", "sin"), ("Cos", "cos"), ("Tan", "tan"),
                    ("Reciprocal", "reciprocal"), ("Sign", "sign")]:
     register_importer(_onnx)(_unop(_mx))
+
+
+@register_importer("Shape")
+def _shape(g, node):
+    return _make("_onnx_shape", g.inp(node["inputs"][0]))
+
+
+@register_importer("ConstantOfShape")
+def _constant_of_shape(g, node):
+    val = node["attrs"].get("value")
+    v = float(np.asarray(val).reshape(-1)[0]) if val is not None else 0.0
+    ins = node["inputs"]
+    if ins[0] in g.initializers:
+        shape = tuple(int(x) for x in np.asarray(g.const_value(ins[0])))
+        return _make("_filled", shape=shape, value=v)
+    src = g.inp(ins[0])
+    if src._op == "_onnx_shape":
+        # ConstantOfShape(Shape(x)) — the zeros_like/full_like lowering
+        base = _make("zeros_like", src._inputs[0])
+        return base if v == 0.0 else _make("add", base, v)
+    raise ValueError("ConstantOfShape: shape input must be a constant or a "
+                     "Shape node")
+
+
+@register_importer("Cast")
+def _cast(g, node):
+    to = int(node["attrs"]["to"])
+    dtype = np.dtype(P.onnx_to_np_dtype(to)).name
+    return _make("cast", g.inp(node["inputs"][0]), dtype=dtype)
+
+
+@register_importer("If")
+def _if(g, node):
+    """ONNX If → symbol.cond (lax.cond). Subgraph nodes may reference
+    outer-scope values by name (ONNX scoping) — they resolve through the
+    shared _Graph symbol table."""
+    a = node["attrs"]
+
+    def build(graphd):
+        # branch scope: names defined inside the subgraph may legally shadow
+        # outer names — restore the outer symbol table afterwards so later
+        # outer nodes don't read branch-internal values
+        saved_syms = dict(g.syms)
+        for k, v in graphd.get("initializers", {}).items():
+            if k in g.initializers and not np.array_equal(
+                    g.initializers[k], v):
+                raise ValueError(
+                    "If import: branch initializer %r shadows an outer "
+                    "initializer with different data" % k)
+            g.initializers[k] = v
+        try:
+            for sub in graphd["nodes"]:
+                imp = _IMPORTERS.get(sub["op"])
+                if imp is None:
+                    raise ValueError("no importer for ONNX op %r (If branch)"
+                                     % sub["op"])
+                out = imp(g, sub)
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                for nm, sy in zip(sub["outputs"], outs):
+                    g.syms[nm] = sy
+            return g.syms[graphd["outputs"][0]["name"]]
+        finally:
+            g.syms = saved_syms
+
+    then_s = build(a["then_branch"])
+    else_s = build(a["else_branch"])
+    from ..symbol import cond
+    return cond(g.inp(node["inputs"][0]), then_s, else_s)
+
+
+@register_importer("NonMaxSuppression")
+def _nms(g, node):
+    ins = node["inputs"]
+    kw = {"center_point_box": int(node["attrs"].get("center_point_box", 0))}
+    if len(ins) > 2 and ins[2]:
+        kw["max_output_boxes_per_class"] = int(
+            np.asarray(g.const_value(ins[2])).reshape(()))
+    if len(ins) > 3 and ins[3]:
+        kw["iou_threshold"] = float(np.asarray(g.const_value(ins[3])).reshape(()))
+    if len(ins) > 4 and ins[4]:
+        kw["score_threshold"] = float(np.asarray(g.const_value(ins[4])).reshape(()))
+    return _make("_onnx_nms", g.inp(ins[0]), g.inp(ins[1]), **kw)
+
+
+@register_importer("GatherND")
+def _gather_nd(g, node):
+    if node["attrs"].get("batch_dims"):
+        raise ValueError("GatherND import: batch_dims unsupported")
+    return _make("_onnx_gather_nd", g.inp(node["inputs"][0]),
+                 g.inp(node["inputs"][1]))
+
+
+@register_importer("ScatterND")
+def _scatter_nd(g, node):
+    return _make("_onnx_scatter_nd", g.inp(node["inputs"][0]),
+                 g.inp(node["inputs"][1]), g.inp(node["inputs"][2]))
+
+
+def _import_resize(g, node, scales, sizes):
+    a = node["attrs"]
+    mode = a.get("mode", "nearest")
+    x = g.inp(node["inputs"][0])
+    if mode == "nearest":
+        if scales is None:
+            raise ValueError("nearest Resize import needs scales")
+        s = [float(v) for v in scales]
+        if s[0] != 1 or s[1] != 1 or s[2] != s[3] or s[2] != int(s[2]):
+            raise ValueError("nearest Resize: only uniform integer spatial "
+                             "scales supported, got %r" % (s,))
+        return _make("UpSampling", x, scale=int(s[2]), sample_type="nearest")
+    if mode != "linear":
+        raise ValueError("Resize mode %r unsupported" % mode)
+    if sizes is not None:
+        return _make("BilinearResize2D", x, height=int(sizes[2]),
+                     width=int(sizes[3]))
+    return _make("BilinearResize2D", x, scale_height=float(scales[2]),
+                 scale_width=float(scales[3]))
+
+
+@register_importer("Resize")
+def _resize(g, node):
+    ins = node["inputs"]
+    scales = sizes = None
+    if len(ins) > 2 and ins[2]:
+        v = np.asarray(g.const_value(ins[2]))
+        scales = v if v.size else None
+    if len(ins) > 3 and ins[3]:
+        sizes = np.asarray(g.const_value(ins[3]))
+    return _import_resize(g, node, scales, sizes)
+
+
+@register_importer("Upsample")
+def _upsample(g, node):
+    # opset-9 deprecated form: scales as input 1 (or attr pre-9)
+    scales = node["attrs"].get("scales")
+    if scales is None:
+        scales = np.asarray(g.const_value(node["inputs"][1]))
+    return _import_resize(g, node, np.asarray(scales, np.float64), None)
+
+
+# ------------------------------------------------------------ recurrent ops
+
+_uid = [0]
+
+
+def _fresh(hint):
+    _uid[0] += 1
+    return "%s_%d" % (hint, _uid[0])
+
+
+def _rnn_import(mode):
+    """ONNX LSTM/GRU/RNN (single layer, 1-2 directions) → fused mx RNN op.
+    Gate blocks are re-ordered from ONNX to MXNet order (see export.py)."""
+    from .export import _GRU_FROM_ONNX, _LSTM_FROM_ONNX, _gate_perm
+
+    def imp(g, node):
+        a = node["attrs"]
+        ins = node["inputs"]
+        W = np.asarray(g.const_value(ins[1]), np.float32)  # (D, G*H, C)
+        R = np.asarray(g.const_value(ins[2]), np.float32)  # (D, G*H, H)
+        D, GH, _ = W.shape
+        H = int(a.get("hidden_size", R.shape[2]))
+        direction = a.get("direction", "forward")
+        if direction == "reverse":
+            raise ValueError("RNN import: direction=reverse unsupported")
+        bi = direction == "bidirectional"
+        mx_mode = mode
+        if mode == "rnn":
+            acts = [s.lower() for s in a.get("activations", ["Tanh"] * D)]
+            if acts[0] not in ("tanh", "relu"):
+                raise ValueError("RNN import: activation %r" % acts[0])
+            mx_mode = "rnn_" + acts[0]
+        if mode == "gru" and not a.get("linear_before_reset", 0):
+            raise ValueError(
+                "GRU import: linear_before_reset=0 (reset before the "
+                "recurrent matmul) has no fused-op equivalent here")
+        inv = {"lstm": _LSTM_FROM_ONNX, "gru": _GRU_FROM_ONNX}.get(mode, [0])
+        if len(ins) > 3 and ins[3]:
+            B = np.asarray(g.const_value(ins[3]), np.float32)
+        else:
+            B = np.zeros((D, 2 * GH), np.float32)
+
+        wsyms = []
+        for d in range(D):
+            for hint, arr in [("i2h_weight", _gate_perm(W[d], inv, H)),
+                              ("h2h_weight", _gate_perm(R[d], inv, H)),
+                              ("i2h_bias", _gate_perm(B[d][:GH], inv, H)),
+                              ("h2h_bias", _gate_perm(B[d][GH:], inv, H))]:
+                name = _fresh("%s_%s" % (node.get("name") or "rnn", hint))
+                g.initializers[name] = arr
+                wsyms.append(g.inp(name))
+
+        x = g.inp(ins[0])
+        if len(ins) > 5 and ins[5]:
+            h0 = g.inp(ins[5])
+        else:
+            h0 = _make("_rnn_init", x, num=D, hidden=H)
+        if mode == "lstm" and len(ins) > 6 and ins[6]:
+            c0 = g.inp(ins[6])
+        else:
+            c0 = _make("_rnn_init", x, num=D, hidden=H)
+        rnn = _make("RNN", x, h0, c0, *wsyms, mode=mx_mode, num_layers=1,
+                    bidirectional=bi)
+        # mx out (T, N, D*H) → ONNX Y (T, D, N, H)
+        y = _make("transpose",
+                  _make("reshape", rnn[0], shape=(0, 0, D, H)),
+                  axes=(0, 2, 1, 3))
+        outs = [y, rnn[1]]
+        if mode == "lstm":
+            outs.append(rnn[2])
+        return outs[:len(node["outputs"])]
+    return imp
+
+
+register_importer("LSTM")(_rnn_import("lstm"))
+register_importer("GRU")(_rnn_import("gru"))
+register_importer("RNN")(_rnn_import("rnn"))
 
 
 @register_importer("Constant")

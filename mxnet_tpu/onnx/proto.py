@@ -184,8 +184,17 @@ def onnx_to_np_dtype(code):
 
 
 # AttributeProto.AttributeType
-ATTR_FLOAT, ATTR_INT, ATTR_STRING, ATTR_TENSOR = 1, 2, 3, 4
+ATTR_FLOAT, ATTR_INT, ATTR_STRING, ATTR_TENSOR, ATTR_GRAPH = 1, 2, 3, 4, 5
 ATTR_FLOATS, ATTR_INTS, ATTR_STRINGS = 6, 7, 8
+
+
+class GraphAttr:
+    """Marker for a subgraph-valued attribute (AttributeProto.g, e.g. the
+    then_branch/else_branch of an If node). Holds encoded GraphProto bytes."""
+
+    def __init__(self, graph_msg):
+        self.data = graph_msg.tobytes() if hasattr(graph_msg, "tobytes") \
+            else bytes(graph_msg)
 
 
 def tensor_proto(name, arr):
@@ -242,6 +251,8 @@ def attr_proto(name, value):
         m.float_(2, value).varint(20, ATTR_FLOAT)
     elif isinstance(value, str):
         m.bytes_(4, value).varint(20, ATTR_STRING)
+    elif isinstance(value, GraphAttr):
+        m.bytes_(6, value.data).varint(20, ATTR_GRAPH)
     elif isinstance(value, np.ndarray):
         m.bytes_(5, tensor_proto("", value)).varint(20, ATTR_TENSOR)
     elif isinstance(value, (list, tuple)):
@@ -275,6 +286,8 @@ def parse_attr(buf):
         return name, f[4][0].decode()
     if atype == ATTR_TENSOR:
         return name, parse_tensor(f[5][0])[1]
+    if atype == ATTR_GRAPH:
+        return name, parse_graph(f[6][0])
     if atype == ATTR_INTS:
         return name, repeated_ints(f.get(8, []))
     if atype == ATTR_FLOATS:

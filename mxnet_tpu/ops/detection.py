@@ -203,3 +203,68 @@ def multibox_detection(cls_prob, loc_pred, anchors, *, clip=True, threshold=0.01
                        force_suppress=force_suppress)
 
     return jax.vmap(one)(cls_prob, loc_pred)
+
+
+# --------------------------------------------------------------- ONNX interop
+
+@register_op("_onnx_nms", nondiff=True)
+def onnx_nms(boxes, scores, *, max_output_boxes_per_class=0,
+             iou_threshold=0.0, score_threshold=None, center_point_box=0):
+    """ONNX NonMaxSuppression semantics on TPU: fixed-shape output.
+
+    boxes (B, N, 4) corner format, scores (B, C, N) → selected indices
+    (B*C*K, 3) rows [batch, class, box], K = min(max_output, N). Invalid
+    rows are padded with -1 — the TPU-native encoding of ONNX's dynamic M
+    (consumers drop pad rows; see _onnx_scatter_nd)."""
+    if center_point_box:
+        boxes = _center_to_corner(boxes)
+    B, N, _ = boxes.shape
+    C = scores.shape[1]
+    K = int(min(max_output_boxes_per_class or N, N))
+    vt = -jnp.inf if score_threshold is None else float(score_threshold)
+
+    def one(bx, sc):  # (N, 4), (N,) → (K,) selected original indices or -1
+        order = jnp.argsort(-sc)
+        b2, s2 = bx[order], sc[order]
+        iou = _iou_corner(b2, b2)
+        valid = s2 > vt
+
+        def body(i, keep):
+            sup = (iou[i] > iou_threshold) & (jnp.arange(N) > i)
+            return jnp.where(keep[i], keep & ~sup, keep)
+
+        keep = lax.fori_loop(0, N, body, valid)
+        rank = jnp.cumsum(keep) - 1
+        take = keep & (rank < K)
+        sel = jnp.where(take, order, -1)
+        comp = jnp.argsort(~take, stable=True)  # taken rows first, in order
+        return sel[comp][:K]
+
+    sel = jax.vmap(jax.vmap(one, in_axes=(None, 0)))(boxes, scores)  # (B,C,K)
+    bi = jnp.broadcast_to(jnp.arange(B)[:, None, None], (B, C, K))
+    ci = jnp.broadcast_to(jnp.arange(C)[None, :, None], (B, C, K))
+    rows = jnp.stack([bi, ci, sel], axis=-1).reshape(B * C * K, 3)
+    return jnp.where(rows[:, 2:3] >= 0, rows, -1).astype(jnp.int32)
+
+
+@register_op("_onnx_gather_nd")
+def onnx_gather_nd(data, indices):
+    """ONNX GatherND (batch_dims=0). Negative (pad) index rows produce
+    arbitrary values — pair with _onnx_scatter_nd, which drops them."""
+    idx = indices.astype(jnp.int32)
+    return data[tuple(jnp.moveaxis(idx, -1, 0))]
+
+
+@register_op("_onnx_scatter_nd")
+def onnx_scatter_nd(data, indices, updates):
+    """ONNX ScatterND; rows of ``indices`` with any negative entry are
+    dropped (the pad encoding used by _onnx_nms)."""
+    idx = indices.astype(jnp.int32)
+    valid = jnp.all(idx >= 0, axis=-1)
+    safe = jnp.where(valid[..., None], idx, 0)
+    coords = tuple(jnp.moveaxis(safe, -1, 0))
+    cur = data[coords]
+    delta = jnp.where(valid, updates - cur, jnp.zeros_like(updates))
+    # add-of-delta instead of set: pad rows all alias index 0 and must not
+    # clobber a real update that also targets it
+    return data.at[coords].add(delta)

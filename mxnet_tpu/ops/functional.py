@@ -440,6 +440,12 @@ def space_to_depth(x, *, block_size):
     return y.reshape(n, c * b * b, h // b, w // b)
 
 
+@register_op("_onnx_shape", nondiff=True)
+def _onnx_shape(x):
+    """ONNX Shape: the (static under jit) shape as an int64 tensor."""
+    return jnp.asarray(x.shape, jnp.int64)
+
+
 @register_op("zeros_like")
 def zeros_like(x):
     return jnp.zeros_like(x)
@@ -862,6 +868,9 @@ def UpSampling(x, *, scale=2, sample_type="nearest"):
 
 
 @register_op("BilinearResize2D")
-def BilinearResize2D(x, *, height, width):
+def BilinearResize2D(x, *, height=None, width=None, scale_height=None,
+                     scale_width=None):
     n, c = x.shape[:2]
-    return jax.image.resize(x, (n, c, height, width), method="bilinear")
+    h = int(height) if height is not None else int(x.shape[2] * scale_height)
+    w = int(width) if width is not None else int(x.shape[3] * scale_width)
+    return jax.image.resize(x, (n, c, h, w), method="bilinear")

@@ -75,6 +75,14 @@ def _single_direction(x, h0, c0, wih, whh, bih, bhh, mode):
     return ys, h, c0
 
 
+@register_op("_rnn_init")
+def _rnn_init(x, *, num, hidden):
+    """Zero initial state (num, N, H) shaped from x (T, N, C) — used by the
+    ONNX importer when a recurrent node omits initial_h/initial_c (shape is
+    static under jit, so this stays XLA-friendly)."""
+    return jnp.zeros((num, x.shape[1], hidden), x.dtype)
+
+
 @register_op("RNN", needs_rng=True, needs_training=True, n_outputs=3)
 def RNN(x, state_h, state_c, *weights, mode="lstm", num_layers=1,
         bidirectional=False, p=0.0, training=False, key=None):

@@ -19,6 +19,7 @@ from .base import jitted
 from .ndarray import NDArray
 
 __all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "AdaGrad", "AdaDelta",
+           "AdaMax", "FTML", "DCASGD", "LARS",
            "RMSProp", "Ftrl", "LAMB", "Signum", "SGLD", "create", "register"]
 
 _REGISTRY = {}
@@ -389,6 +390,108 @@ class Signum(Optimizer):
     def _step(self, w, g, state, lr, wd, t):
         mom = self.momentum * state + (1 - self.momentum) * (g + wd * w)
         return (w * (1 - lr * self.wd_lh) - lr * jnp.sign(mom)).astype(w.dtype), mom
+
+
+@register
+class AdaMax(Optimizer):
+    """Adam variant with infinity-norm second moment
+    (ref: python/mxnet/optimizer/adamax.py)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def init_state(self, w):
+        return (jnp.zeros_like(w, dtype=jnp.float32),
+                jnp.zeros_like(w, dtype=jnp.float32))
+
+    def _step(self, w, g, state, lr, wd, t):
+        m, u = state
+        g = g.astype(jnp.float32) + wd * w.astype(jnp.float32)
+        m = self.beta1 * m + (1 - self.beta1) * g
+        u = jnp.maximum(self.beta2 * u, jnp.abs(g))
+        lr_t = lr / (1 - self.beta1 ** t.astype(jnp.float32))
+        return (w.astype(jnp.float32) - lr_t * m / (u + 1e-8)).astype(w.dtype), (m, u)
+
+
+@register
+class FTML(Optimizer):
+    """Follow the Moving Leader (ref: python/mxnet/optimizer/ftml.py,
+    src/operator/optimizer_op.cc:ftml_update)."""
+
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def init_state(self, w):
+        z = jnp.zeros_like(w, dtype=jnp.float32)
+        return (z, z, z)  # d, v, z
+
+    def _step(self, w, g, state, lr, wd, t):
+        d, v, z = state
+        wf = w.astype(jnp.float32)
+        g = g.astype(jnp.float32) + wd * wf
+        tf = t.astype(jnp.float32)
+        v = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+        d_t = (1 - self.beta1 ** tf) / lr * (
+            jnp.sqrt(v / (1 - self.beta2 ** tf)) + self.epsilon)
+        sigma = d_t - self.beta1 * d
+        z = self.beta1 * z + (1 - self.beta1) * g - sigma * wf
+        return (-z / d_t).astype(w.dtype), (d_t, v, z)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (ref: python/mxnet/optimizer/dcasgd.py):
+    corrects a stale gradient with lambda * g² * (w_now - w_then)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum, self.lamda = momentum, lamda
+
+    def init_state(self, w):
+        return (jnp.zeros_like(w, dtype=jnp.float32),
+                jnp.asarray(w, jnp.float32))  # momentum, previous weight
+
+    def _step(self, w, g, state, lr, wd, t):
+        mom, prev = state
+        wf = w.astype(jnp.float32)
+        g = g.astype(jnp.float32) + wd * wf
+        mom = self.momentum * mom - lr * (
+            g + self.lamda * jnp.square(g) * (wf - prev))
+        # previous_weight records the PRE-update value (upstream dcasgd.py
+        # assigns it before applying mom), so next step's compensation term
+        # sees this step's delta
+        return (wf + mom).astype(w.dtype), (mom, wf)
+
+
+@register
+class LARS(Optimizer):
+    """Layer-wise adaptive rate scaling (ref: python/mxnet/optimizer/lars.py):
+    per-tensor trust ratio eta·||w||/(||g||+wd·||w||) scales the SGD-momentum
+    step — the large-batch vision-training staple."""
+
+    def __init__(self, learning_rate=0.1, momentum=0.9, eta=0.001,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum, self.eta, self.epsilon = momentum, eta, epsilon
+
+    def init_state(self, w):
+        return jnp.zeros_like(w, dtype=jnp.float32)
+
+    def _step(self, w, g, state, lr, wd, t):
+        wf = w.astype(jnp.float32)
+        g = g.astype(jnp.float32)
+        w_norm = jnp.linalg.norm(wf)
+        g_norm = jnp.linalg.norm(g)
+        ratio = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self.eta * w_norm / (g_norm + wd * w_norm + self.epsilon),
+            1.0)
+        g = g + wd * wf
+        mom = self.momentum * state + lr * ratio * g
+        return (wf - mom).astype(w.dtype), mom
 
 
 @register

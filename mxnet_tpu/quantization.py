@@ -97,6 +97,8 @@ class QuantizedDense(HybridBlock):
         self._act = dense.act
 
     def hybrid_forward(self, F, x):
+        if self._flatten:
+            x = F.flatten(x)  # Dense(flatten=True) semantics, e.g. pooled NCHW
         # raw jnp weights pass through both facades unchanged
         y = F.quantized_fully_connected(x, self._qw, self._ws, self._bias)
         if self._act is not None:

@@ -12,13 +12,14 @@ from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 import numpy as np
 
 from .base import OP_REGISTRY, resolve_dtype
 from .context import current_context
 from .ndarray import NDArray
 
-__all__ = ["Symbol", "var", "Variable", "Group", "load", "Executor"]
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "Executor", "cond"]
 
 
 class Symbol:
@@ -307,6 +308,31 @@ def _filled(*, shape, value, dtype="float32"):
 @register_op("_item")
 def _item(x, *, index):
     return x[index]
+
+
+def cond(pred, then_sym, else_sym, name=None):
+    """Symbolic conditional: lowers to lax.cond — both branch subgraphs are
+    traced into ONE compiled program and selected at run time (TPU-native
+    replacement for MXNet's contrib cond subgraph op,
+    src/operator/control_flow.cc). Branch symbols may reference any graph
+    variables; the ONNX exporter maps this to an If node."""
+    seen = {}
+    for branch in (then_sym, else_sym):
+        for a in branch._arg_symbols():
+            seen.setdefault(a.name, a)
+    arg_names = list(seen)
+    return Symbol("_cond", [pred] + [seen[n] for n in arg_names],
+                  {"then_sym": then_sym, "else_sym": else_sym,
+                   "arg_names": arg_names}, name=name or "cond")
+
+
+@register_op("_cond")
+def _cond_op(pred, *vals, then_sym, else_sym, arg_names):
+    env = dict(zip(arg_names, vals))
+    p = jnp.asarray(pred).reshape(()).astype(bool)
+    return lax.cond(p,
+                    lambda e: _eval(then_sym, e, {}),
+                    lambda e: _eval(else_sym, e, {}), env)
 
 
 def var(name, shape=None, dtype=None, **kwargs):

@@ -1,0 +1,76 @@
+"""DistKVStore cross-host semantics via a real two-process jax.distributed
+run on CPU (the DCN path; ref: tests/nightly/dist_sync_kvstore.py).
+
+Each worker pushes rank+1; push semantics are a SUM, so both workers must
+pull back 1+2=3 (a mean — the round-1 bug — would read 1.5)."""
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+_WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+    os.environ.pop("AXON_LOOPBACK_RELAY", None)
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    rank = int(sys.argv[1])
+    jax.distributed.initialize(coordinator_address=sys.argv[2],
+                               num_processes=2, process_id=rank)
+    sys.path.insert(0, sys.argv[3])
+    import numpy as np
+    from mxnet_tpu import nd
+    from mxnet_tpu.kvstore import DistKVStore
+
+    kv = DistKVStore("dist_sync")
+    kv.init("w", nd.array(np.zeros(4, np.float32)))
+    kv.push("w", nd.array(np.full(4, float(rank + 1), np.float32)))
+    out = kv.pull("w").asnumpy()
+    np.testing.assert_allclose(out, np.full(4, 3.0))   # sum, not mean
+    print("RANK%d_OK" % rank, flush=True)
+""")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_dist_kvstore_push_sums_across_processes(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    coord = "127.0.0.1:%d" % _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PALLAS_AXON", "AXON"))}
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [subprocess.Popen([sys.executable, str(script), str(r), coord,
+                               repo],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, env=env, text=True)
+             for r in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            p.kill()
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0 and ("distributed" in out.lower()
+                                  and "unimplemented" in out.lower()):
+            pytest.skip("jax.distributed CPU collectives unavailable: %s"
+                        % out.splitlines()[-1])
+        assert p.returncode == 0, "rank %d failed:\n%s" % (r, out)
+        assert "RANK%d_OK" % r in out

@@ -1,0 +1,246 @@
+"""Long-tail parity: AdaMax/FTML/DCASGD/LARS optimizers, MCC + F1
+micro/macro metrics, gluon.contrib conv-RNN cells
+(ref: tests/python/unittest/test_optimizer.py, test_metric.py,
+test_gluon_contrib.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+
+
+# ------------------------------------------------------------- optimizers
+
+def _run_steps(opt, w0, grads):
+    w = nd.array(w0.copy())
+    state = opt.create_state(0, w)
+    for i, g in enumerate(grads):
+        state = opt.update(0, w, nd.array(g), state)
+    return w.asnumpy()
+
+
+@pytest.mark.parametrize("name", ["adamax", "ftml", "dcasgd", "lars"])
+def test_optimizer_created_by_name(name):
+    opt = mx.optimizer.create(name, learning_rate=0.1)
+    w0 = np.ones(4, np.float32)
+    out = _run_steps(opt, w0, [np.full(4, 0.5, np.float32)] * 3)
+    assert out.shape == (4,)
+    assert np.isfinite(out).all()
+    assert not np.allclose(out, w0)  # it moved
+
+
+def test_adamax_numpy_oracle():
+    lr, b1, b2, eps = 0.002, 0.9, 0.999, 1e-8
+    opt = mx.optimizer.AdaMax(learning_rate=lr, beta1=b1, beta2=b2)
+    rng = np.random.default_rng(0)
+    w0 = rng.normal(size=5).astype(np.float32)
+    grads = [rng.normal(size=5).astype(np.float32) for _ in range(4)]
+    got = _run_steps(opt, w0, grads)
+
+    w, m, u = w0.astype(np.float64), np.zeros(5), np.zeros(5)
+    for t, g in enumerate(grads, 1):
+        g = g.astype(np.float64)
+        m = b1 * m + (1 - b1) * g
+        u = np.maximum(b2 * u, np.abs(g))
+        w = w - (lr / (1 - b1 ** t)) * m / (u + eps)
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_ftml_numpy_oracle():
+    lr, b1, b2, eps = 0.0025, 0.6, 0.999, 1e-8
+    opt = mx.optimizer.FTML(learning_rate=lr, beta1=b1, beta2=b2, epsilon=eps)
+    rng = np.random.default_rng(1)
+    w0 = rng.normal(size=5).astype(np.float32)
+    grads = [rng.normal(size=5).astype(np.float32) for _ in range(4)]
+    got = _run_steps(opt, w0, grads)
+
+    w = w0.astype(np.float64)
+    d = v = z = np.zeros(5)
+    for t, g in enumerate(grads, 1):
+        g = g.astype(np.float64)
+        v = b2 * v + (1 - b2) * g * g
+        d_t = (1 - b1 ** t) / lr * (np.sqrt(v / (1 - b2 ** t)) + eps)
+        sigma = d_t - b1 * d
+        z = b1 * z + (1 - b1) * g - sigma * w
+        w = -z / d_t
+        d = d_t
+    np.testing.assert_allclose(got, w, rtol=1e-4, atol=1e-5)
+
+
+def test_dcasgd_compensation_direction():
+    # with lamda=0 DCASGD(momentum=0) degenerates to plain SGD
+    opt0 = mx.optimizer.DCASGD(learning_rate=0.1, lamda=0.0)
+    w_sgd = _run_steps(opt0, np.ones(3, np.float32),
+                       [np.full(3, 0.5, np.float32)] * 2)
+    np.testing.assert_allclose(w_sgd, 1 - 0.1 * 0.5 * 2, rtol=1e-6)
+    # nonzero lamda after >1 step diverges from plain SGD
+    opt1 = mx.optimizer.DCASGD(learning_rate=0.1, lamda=1.0)
+    w_dc = _run_steps(opt1, np.ones(3, np.float32),
+                      [np.full(3, 0.5, np.float32)] * 2)
+    assert not np.allclose(w_dc, w_sgd)
+
+
+def test_lars_trust_ratio():
+    lr, eta = 0.1, 0.01
+    opt = mx.optimizer.LARS(learning_rate=lr, momentum=0.0, eta=eta, wd=0.0)
+    w0 = np.full(4, 2.0, np.float32)     # ||w|| = 4
+    g = np.full(4, 0.5, np.float32)      # ||g|| = 1
+    got = _run_steps(opt, w0, [g])
+    ratio = eta * 4.0 / (1.0 + 1e-8)
+    np.testing.assert_allclose(got, w0 - lr * ratio * g, rtol=1e-5)
+
+
+def test_lars_zero_grad_ratio_one():
+    opt = mx.optimizer.LARS(learning_rate=0.1, momentum=0.0, eta=0.01)
+    got = _run_steps(opt, np.ones(3, np.float32),
+                     [np.zeros(3, np.float32)])
+    np.testing.assert_allclose(got, np.ones(3), rtol=1e-6)
+
+
+# ------------------------------------------------------------- metrics
+
+def test_f1_binary_matches_sklearn_formula():
+    m = mx.metric.F1()
+    labels = nd.array(np.array([1, 0, 1, 1, 0], np.float32))
+    preds = nd.array(np.array([1, 1, 1, 0, 0], np.float32))
+    m.update(labels, preds)
+    tp, fp, fn = 2, 1, 1
+    prec, rec = tp / (tp + fp), tp / (tp + fn)
+    np.testing.assert_allclose(m.get()[1], 2 * prec * rec / (prec + rec),
+                               rtol=1e-6)
+
+
+def test_f1_micro_macro_multiclass():
+    labels = np.array([0, 1, 2, 0, 1, 2], np.float32)
+    preds = np.array([0, 2, 1, 0, 0, 1], np.float32)
+    macro = mx.metric.F1(average="macro")
+    micro = mx.metric.F1(average="micro")
+    for m in (macro, micro):
+        m.update(nd.array(labels), nd.array(preds))
+    # micro-F1 == accuracy for single-label multiclass
+    np.testing.assert_allclose(micro.get()[1], 2 / 6, rtol=1e-6)
+    # macro: class0 f1 = 2*2/3*1/(2/3+1)... compute directly
+    f1s = []
+    for c in range(3):
+        tp = ((preds == c) & (labels == c)).sum()
+        fp = ((preds == c) & (labels != c)).sum()
+        fn = ((preds != c) & (labels == c)).sum()
+        p = tp / max(tp + fp, 1e-12)
+        r = tp / max(tp + fn, 1e-12)
+        f1s.append(2 * p * r / max(p + r, 1e-12))
+    np.testing.assert_allclose(macro.get()[1], np.mean(f1s), rtol=1e-6)
+
+
+def test_mcc():
+    labels = np.array([1, 1, 1, 0, 0, 0, 1, 0], np.float32)
+    preds = np.array([1, 0, 1, 0, 0, 1, 1, 0], np.float32)
+    m = mx.metric.MCC()
+    m.update(nd.array(labels), nd.array(preds))
+    tp, tn, fp, fn = 3, 3, 1, 1
+    expect = (tp * tn - fp * fn) / np.sqrt(
+        (tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+    np.testing.assert_allclose(m.get()[1], expect, rtol=1e-6)
+
+
+def test_mcc_perfect_is_one():
+    m = mx.metric.MCC()
+    y = np.array([1, 0, 1, 0], np.float32)
+    m.update(nd.array(y), nd.array(y))
+    np.testing.assert_allclose(m.get()[1], 1.0, rtol=1e-6)
+
+
+# ------------------------------------------------------------- conv-RNN cells
+
+@pytest.mark.parametrize("cls,states", [
+    (gluon.contrib.rnn.Conv2DRNNCell, 1),
+    (gluon.contrib.rnn.Conv2DLSTMCell, 2),
+    (gluon.contrib.rnn.Conv2DGRUCell, 1),
+])
+def test_conv2d_cell_shapes_and_unroll(cls, states):
+    cell = cls(input_shape=(2, 8, 8), hidden_channels=4, i2h_kernel=3,
+               h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    x = nd.array(np.random.default_rng(0).normal(size=(3, 2, 8, 8))
+                 .astype(np.float32))
+    begin = cell.begin_state(3)
+    assert len(begin) == states
+    out, new_states = cell(x, begin)
+    assert out.shape == (3, 4, 8, 8)
+    assert len(new_states) == states
+    for s in new_states:
+        assert s.shape == (3, 4, 8, 8)
+
+    seq = nd.array(np.random.default_rng(1).normal(size=(3, 5, 2, 8, 8))
+                   .astype(np.float32))
+    outs, _ = cell.unroll(5, seq, layout="NTC")
+    assert outs.shape == (3, 5, 4, 8, 8)
+
+
+def test_conv1d_lstm_cell_trains():
+    cell = gluon.contrib.rnn.Conv1DLSTMCell(input_shape=(2, 6),
+                                            hidden_channels=3,
+                                            i2h_kernel=3, h2h_kernel=3,
+                                            i2h_pad=1)
+    cell.initialize()
+    from mxnet_tpu import autograd
+    x = nd.array(np.random.default_rng(2).normal(size=(2, 2, 6))
+                 .astype(np.float32))
+    with autograd.record():
+        out, _ = cell(x, cell.begin_state(2))
+        loss = (out * out).sum()
+    loss.backward()
+    gw = cell.i2h_weight.grad()
+    assert np.isfinite(gw.asnumpy()).all()
+    assert np.abs(gw.asnumpy()).sum() > 0
+
+
+def test_conv_cell_odd_kernel_assert():
+    with pytest.raises(AssertionError):
+        gluon.contrib.rnn.Conv2DLSTMCell(input_shape=(2, 8, 8),
+                                         hidden_channels=4,
+                                         i2h_kernel=3, h2h_kernel=2)
+
+
+# ------------------------------------------------------------- np delegation
+
+def test_np_delegation_surface():
+    import mxnet_tpu as mx
+    np_ = mx.np
+    x = np_.asarray(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    # delegated names return NDArray and match numpy
+    np.testing.assert_allclose(np_.tanh(x).asnumpy(), np.tanh(x.asnumpy()),
+                               rtol=1e-6)
+    u, s, vt = np_.linalg.svd(x)
+    ref = np.linalg.svd(x.asnumpy()).S
+    np.testing.assert_allclose(s.asnumpy(), ref, rtol=1e-5)
+    np.testing.assert_allclose(np_.tril(x).asnumpy(),
+                               np.tril(x.asnumpy()), rtol=1e-6)
+    h, edges = np_.histogram(x)
+    assert h.shape == (10,) and edges.shape == (11,)
+    # aliases
+    y = np_.ascontiguousarray([[1, 2]])
+    assert y.shape == (1, 2)
+    with pytest.raises(ValueError):
+        np_.asarray_chkfinite(np.array([np.inf], np.float32))
+
+
+def test_np_parity_checklist_current():
+    """NP_PARITY.md must be regenerated when the surface changes."""
+    import re
+    import subprocess
+    import sys
+    repo = __file__.rsplit("/tests/", 1)[0]
+    with open(repo + "/NP_PARITY.md") as f:
+        head = f.read(600)
+    m = re.search(r"Coverage: (\d+)/(\d+)", head)
+    assert m, "NP_PARITY.md malformed"
+    assert int(m.group(1)) / int(m.group(2)) >= 0.85
+
+
+def test_npx_registry_fallback():
+    import mxnet_tpu as mx
+    x = mx.np.asarray(np.arange(6).astype(np.float32).reshape(2, 3))
+    mean, var = mx.npx.moments(x, axes=(0, 1))   # registry op via fallback
+    np.testing.assert_allclose(float(mean.asnumpy()), 2.5, rtol=1e-6)
+    with pytest.raises(AttributeError):
+        mx.npx.definitely_not_an_op

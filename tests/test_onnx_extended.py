@@ -1,0 +1,193 @@
+"""ONNX breadth: RNN family, Resize/Upsample, NMS, control flow
+(ref: tests/python-pytest/onnx/test_operators.py scope beyond the zoo set)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu import onnx as mxonnx
+from mxnet_tpu import symbol as S
+from mxnet_tpu.onnx import proto as P
+
+
+def _roundtrip(net, x, rtol=2e-3, atol=2e-4):
+    ref = net(nd.array(x)).asnumpy()
+    mb = mxonnx.export_model(net, input_shapes={"data": x.shape})
+    blk = mxonnx.import_to_gluon(mb)
+    got = blk(nd.array(x))
+    got = (got[0] if isinstance(got, (list, tuple)) else got).asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=rtol, atol=atol)
+    return mb
+
+
+@pytest.mark.parametrize("cls,mode", [(gluon.rnn.LSTM, "LSTM"),
+                                      (gluon.rnn.GRU, "GRU"),
+                                      (gluon.rnn.RNN, "RNN")])
+def test_rnn_layer_roundtrip(cls, mode):
+    net = cls(8, num_layers=2, input_size=6)
+    net.initialize()
+    x = np.random.default_rng(0).normal(size=(5, 3, 6)).astype(np.float32)
+    mb = _roundtrip(net, x)
+    ops = [n["op"] for n in P.parse_model(mb)["graph"]["nodes"]]
+    assert ops.count(mode) == 2  # one ONNX node per layer
+
+
+def test_bidirectional_lstm_roundtrip():
+    net = gluon.rnn.LSTM(8, num_layers=1, bidirectional=True, input_size=6)
+    net.initialize()
+    x = np.random.default_rng(1).normal(size=(5, 3, 6)).astype(np.float32)
+    _roundtrip(net, x)
+
+
+def test_lstm_lm_roundtrip():
+    from mxnet_tpu.models.lstm_lm import RNNModel
+    lm = RNNModel(mode="lstm", vocab_size=50, num_embed=16, num_hidden=16,
+                  num_layers=2, dropout=0.0)
+    lm.initialize()
+    tok = np.random.default_rng(1).integers(0, 50, (5, 3)).astype(np.float32)
+    _roundtrip(lm, tok)
+
+
+def test_ssd_roundtrip():
+    from mxnet_tpu.models.ssd import SSD
+    net = SSD(num_classes=3, sizes=((0.2, 0.272), (0.37, 0.447)),
+              ratios=((1, 2, 0.5),) * 2)
+    net.initialize()
+    x = np.random.default_rng(2).normal(size=(1, 3, 64, 64)).astype(np.float32)
+    cls_ref, box_ref, anc_ref = [o.asnumpy() for o in net(nd.array(x))]
+    mb = mxonnx.export_model(net, input_shapes={"data": x.shape})
+    blk = mxonnx.import_to_gluon(mb)
+    outs = [o.asnumpy() for o in blk(nd.array(x))]
+    assert len(outs) == 3
+    np.testing.assert_allclose(outs[0], cls_ref, rtol=2e-3, atol=2e-4)
+    np.testing.assert_allclose(outs[1], box_ref, rtol=2e-3, atol=2e-4)
+    np.testing.assert_allclose(outs[2], anc_ref, rtol=1e-5, atol=1e-6)
+
+
+def test_upsample_nearest_roundtrip():
+    data = S.var("data")
+    out = mx.sym.UpSampling(data, scale=2, sample_type="nearest")
+    x = np.random.default_rng(3).normal(size=(2, 3, 4, 5)).astype(np.float32)
+    mb = mxonnx.export_model(out, params={}, input_shapes={"data": x.shape})
+    nodes = P.parse_model(mb)["graph"]["nodes"]
+    assert any(n["op"] == "Resize" for n in nodes)
+    blk = mxonnx.import_to_gluon(mb)
+    got = blk(nd.array(x)).asnumpy()
+    np.testing.assert_allclose(got, np.repeat(np.repeat(x, 2, 2), 2, 3),
+                               rtol=1e-6)
+
+
+def test_bilinear_resize_roundtrip():
+    data = S.var("data")
+    out = mx.sym.BilinearResize2D(data, height=7, width=9)
+    x = np.random.default_rng(4).normal(size=(2, 3, 4, 5)).astype(np.float32)
+    ref = nd.BilinearResize2D(nd.array(x), height=7, width=9).asnumpy()
+    mb = mxonnx.export_model(out, params={}, input_shapes={"data": x.shape})
+    blk = mxonnx.import_to_gluon(mb)
+    got = blk(nd.array(x)).asnumpy()
+    assert got.shape == (2, 3, 7, 9)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_box_nms_roundtrip():
+    rng = np.random.default_rng(5)
+    # [id, score, x1, y1, x2, y2], overlapping clusters
+    base = rng.uniform(0, 1, (2, 12, 2)).astype(np.float32)
+    wh = rng.uniform(0.1, 0.4, (2, 12, 2)).astype(np.float32)
+    data = np.concatenate([
+        np.zeros((2, 12, 1), np.float32),
+        rng.uniform(0.1, 1, (2, 12, 1)).astype(np.float32),
+        base, base + wh], axis=2)
+    ref = nd.box_nms(nd.array(data), overlap_thresh=0.5,
+                     force_suppress=True).asnumpy()
+
+    sym_data = S.var("data")
+    out = mx.sym.box_nms(sym_data, overlap_thresh=0.5, force_suppress=True)
+    mb = mxonnx.export_model(out, params={}, input_shapes={"data": data.shape})
+    ops = [n["op"] for n in P.parse_model(mb)["graph"]["nodes"]]
+    assert "NonMaxSuppression" in ops and "ScatterND" in ops
+    blk = mxonnx.import_to_gluon(mb)
+    got = blk(nd.array(data)).asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_box_nms_per_class_export_rejected():
+    sym_data = S.var("data")
+    out = mx.sym.box_nms(sym_data, overlap_thresh=0.5)  # per-class default
+    with pytest.raises(ValueError, match="per-class"):
+        mxonnx.export_model(out, params={}, input_shapes={"data": (1, 4, 6)})
+
+
+def test_cond_roundtrip():
+    x = S.var("x")
+    y = S.var("y")
+    # cond is nonzero-is-true (like ONNX Cast-to-bool): relu gates the sign
+    pred = mx.sym.relu(mx.sym.sum(x) - 1.0)
+    c = S.cond(pred, x * 2.0 + y, x - y)
+    xs = np.arange(6, dtype=np.float32).reshape(2, 3)
+    ys = np.ones((2, 3), np.float32)
+    ref_then = c.eval(x=nd.array(xs), y=nd.array(ys))[0].asnumpy()
+    np.testing.assert_allclose(ref_then, xs * 2 + 1)
+    ref_else = c.eval(x=nd.array(-xs), y=nd.array(ys))[0].asnumpy()
+    np.testing.assert_allclose(ref_else, -xs - 1)
+
+    mb = mxonnx.export_model(c, params={}, input_shapes={"x": (2, 3),
+                                                         "y": (2, 3)})
+    nodes = P.parse_model(mb)["graph"]["nodes"]
+    if_nodes = [n for n in nodes if n["op"] == "If"]
+    assert if_nodes and "then_branch" in if_nodes[0]["attrs"]
+    blk = mxonnx.import_to_gluon(mb)
+    got = blk(nd.array(xs), nd.array(ys)).asnumpy()
+    np.testing.assert_allclose(got, ref_then, rtol=1e-6)
+    got = blk(nd.array(-xs), nd.array(ys)).asnumpy()
+    np.testing.assert_allclose(got, ref_else, rtol=1e-6)
+
+
+def test_onnx_nms_padding_semantics():
+    """_onnx_nms pads with -1 rows and _onnx_scatter_nd drops them — even
+    when a real update targets index 0 (the aliasing hazard)."""
+    boxes = nd.array([[[0, 0, 1, 1], [0.05, 0, 1.05, 1], [2, 2, 3, 3]]])
+    scores = nd.array([[[0.9, 0.8, 0.7]]])
+    sel = nd._onnx_nms(boxes, scores, max_output_boxes_per_class=3,
+                       iou_threshold=0.5).asnumpy()
+    assert sel.shape == (3, 3)
+    assert {tuple(r) for r in sel.tolist()} == {(0, 0, 0), (0, 0, 2),
+                                                (-1, -1, -1)}
+    data = nd.array(np.zeros((1, 3), np.float32))
+    idx = nd.array(np.array([[0, 0], [-1, -1]], np.float32))
+    upd = nd.array(np.array([5.0, 99.0], np.float32))
+    out = nd._onnx_scatter_nd(data, idx, upd).asnumpy()
+    np.testing.assert_allclose(out, [[5.0, 0.0, 0.0]])
+
+
+def test_cond_shared_branch_node_roundtrip():
+    """A node used by BOTH branches (but not the outer graph) must be
+    re-emitted inside each subgraph — ONNX scoping cannot see a sibling
+    subgraph's internals."""
+    x = S.var("x")
+    t = x * 2.0  # shared intermediate, lives in no outer path
+    c = S.cond(mx.sym.relu(mx.sym.sum(x)), t + 1.0, t - 1.0)
+    xs = np.arange(4, dtype=np.float32).reshape(2, 2)
+    mb = mxonnx.export_model(c, params={}, input_shapes={"x": (2, 2)})
+    md = P.parse_model(mb)
+    if_node = [n for n in md["graph"]["nodes"] if n["op"] == "If"][0]
+    then_ops = [n["op"] for n in if_node["attrs"]["then_branch"]["nodes"]]
+    else_ops = [n["op"] for n in if_node["attrs"]["else_branch"]["nodes"]]
+    assert "Mul" in then_ops and "Mul" in else_ops  # re-emitted per branch
+    blk = mxonnx.import_to_gluon(mb)
+    np.testing.assert_allclose(blk(nd.array(xs)).asnumpy(), xs * 2 + 1,
+                               rtol=1e-6)
+    np.testing.assert_allclose(blk(nd.array(-xs)).asnumpy(), -xs * 2 - 1,
+                               rtol=1e-6)
+
+
+def test_zeros_like_roundtrip_dtype_safe():
+    x = S.var("x")
+    out = mx.sym.zeros_like(x) + x
+    mb = mxonnx.export_model(out, params={}, input_shapes={"x": (2, 3)})
+    ops = [n["op"] for n in P.parse_model(mb)["graph"]["nodes"]]
+    assert "ConstantOfShape" in ops and "Shape" in ops
+    xs = np.array([[np.inf, 1, 2], [3, 4, 5]], np.float32)
+    got = mxonnx.import_to_gluon(mb)(nd.array(xs)).asnumpy()
+    # Mul(x, 0) lowering would have produced NaN at the inf entry
+    np.testing.assert_array_equal(got, xs)

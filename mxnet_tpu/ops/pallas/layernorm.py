@@ -27,17 +27,17 @@ def _ln_kernel(x_ref, g_ref, b_ref, o_ref, *, eps):
                 b_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def layernorm(x, gamma, beta, eps=1e-5):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def layernorm(x, gamma, beta, eps=1e-5, interpret=False):
     """Differentiable fused LN: pallas forward, analytic XLA backward."""
-    return fused_layernorm(x, gamma, beta, eps)
+    return fused_layernorm(x, gamma, beta, eps, interpret=interpret)
 
 
-def _ln_fwd(x, gamma, beta, eps):
-    return fused_layernorm(x, gamma, beta, eps), (x, gamma)
+def _ln_fwd(x, gamma, beta, eps, interpret):
+    return fused_layernorm(x, gamma, beta, eps, interpret=interpret), (x, gamma)
 
 
-def _ln_bwd(eps, res, dy):
+def _ln_bwd(eps, interpret, res, dy):
     x, gamma = res
     xf = x.astype(jnp.float32)
     dyf = dy.astype(jnp.float32)

@@ -95,22 +95,33 @@ def make_batch(rng):
 
 
 def main():
-    # Device init over the relay either succeeds in ~seconds or blocks for
-    # many minutes before raising UNAVAILABLE (observed: 25 min). Retry a
-    # couple of times — transient relay outages recover — then fail loudly.
-    _log("initializing backend (%s)..." % os.environ.get("JAX_PLATFORMS", "auto"))
-    devs = None
-    for attempt in range(3):
+    # Device init over the relay either succeeds in ~seconds, raises
+    # UNAVAILABLE, or — worst case — BLOCKS indefinitely (observed: >25 min
+    # wedge where jax.devices() never returns). An in-process retry loop
+    # cannot recover from the blocking mode, so first PROBE the backend in a
+    # killable subprocess until it answers, then init in-process.
+    _log("probing backend (%s)..." % os.environ.get("JAX_PLATFORMS", "auto"))
+    import subprocess
+    probe = None
+    for attempt in range(10):
         try:
-            devs = jax.devices()
-            break
-        except RuntimeError as e:
-            _log("backend init attempt %d failed: %s"
-                 % (attempt + 1, (str(e).splitlines() or [""])[0]))
-            time.sleep(30)
-    if devs is None:
-        _log("backend unavailable after retries; aborting")
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0].platform)"],
+                capture_output=True, text=True, timeout=120)
+            if r.returncode == 0:
+                probe = r.stdout.strip().splitlines()[-1]
+                break
+            msg = (r.stderr.strip().splitlines() or [""])[-1]
+        except subprocess.TimeoutExpired:
+            msg = "probe timed out after 120s (relay wedged)"
+        _log("backend probe %d/10 failed: %s" % (attempt + 1, msg))
+        time.sleep(60)
+    if probe is None:
+        _log("backend unavailable after ~12 min of probing; aborting")
         raise SystemExit(1)
+    _log("backend up (%s); initializing in-process..." % probe)
+    devs = jax.devices()
     _log("devices: %s" % (devs,))
 
     rng = np.random.default_rng(0)

@@ -12,7 +12,9 @@ from ....ndarray import NDArray, array
 from ...block import Block
 
 __all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
-           "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom"]
+           "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
+           "RandomBrightness", "RandomContrast", "RandomSaturation",
+           "RandomHue", "RandomColorJitter", "RandomLighting", "RandomGray"]
 
 
 def _np(x):
@@ -120,3 +122,72 @@ class RandomFlipTopBottom:
         if np.random.rand() < 0.5:
             a = a[::-1].copy()
         return array(a)
+
+
+class RandomBrightness:
+    """(ref: transforms.py:RandomBrightness) — delegates to the mx.image
+    augmenter family."""
+
+    def __init__(self, brightness, rng=None):
+        from ....image import BrightnessJitterAug
+        self._aug = BrightnessJitterAug(brightness, rng=rng)
+
+    def __call__(self, x):
+        return self._aug(x)
+
+
+class RandomContrast:
+    def __init__(self, contrast, rng=None):
+        from ....image import ContrastJitterAug
+        self._aug = ContrastJitterAug(contrast, rng=rng)
+
+    def __call__(self, x):
+        return self._aug(x)
+
+
+class RandomSaturation:
+    def __init__(self, saturation, rng=None):
+        from ....image import SaturationJitterAug
+        self._aug = SaturationJitterAug(saturation, rng=rng)
+
+    def __call__(self, x):
+        return self._aug(x)
+
+
+class RandomHue:
+    def __init__(self, hue, rng=None):
+        from ....image import HueJitterAug
+        self._aug = HueJitterAug(hue, rng=rng)
+
+    def __call__(self, x):
+        return self._aug(x)
+
+
+class RandomColorJitter:
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 rng=None):
+        from ....image import ColorJitterAug, HueJitterAug
+        self._aug = ColorJitterAug(brightness, contrast, saturation, rng=rng)
+        self._hue = HueJitterAug(hue, rng=rng) if hue else None
+
+    def __call__(self, x):
+        x = self._aug(x)
+        return self._hue(x) if self._hue is not None else x
+
+
+class RandomLighting:
+    def __init__(self, alpha, rng=None):
+        from ....image import LightingAug
+        self._aug = LightingAug(alpha, rng=rng)
+
+    def __call__(self, x):
+        return self._aug(x)
+
+
+class RandomGray:
+    def __init__(self, p=0.5, rng=None):
+        from ....image import RandomGrayAug
+        self._aug = RandomGrayAug(p, rng=rng)
+
+    def __call__(self, x):
+        return self._aug(x)

@@ -245,3 +245,28 @@ def test_det_augmenter_determinism():
     np.testing.assert_array_equal(outs[0][1], outs[1][1])
     assert (not np.array_equal(outs[0][0], outs[2][0])
             or not np.array_equal(outs[0][1], outs[2][1]))
+
+
+def test_vision_transforms_jitter_family():
+    """transforms.Random* delegate to the augmenter family and compose."""
+    from mxnet_tpu.gluon.data.vision import transforms as T
+
+    tf = T.Compose([
+        T.Resize(20),
+        T.RandomColorJitter(0.3, 0.3, 0.3, 0.1, rng=_rs(0)),
+        T.RandomLighting(0.1, rng=_rs(0)),
+        T.RandomGray(0.2, rng=_rs(0)),
+        T.ToTensor(),
+    ])
+    out = tf(_img(30, 40))
+    a = out.asnumpy()
+    assert a.shape[0] == 3 and a.dtype == np.float32
+    # determinism through the composed pipeline
+    tf2 = T.Compose([
+        T.Resize(20),
+        T.RandomColorJitter(0.3, 0.3, 0.3, 0.1, rng=_rs(0)),
+        T.RandomLighting(0.1, rng=_rs(0)),
+        T.RandomGray(0.2, rng=_rs(0)),
+        T.ToTensor(),
+    ])
+    np.testing.assert_array_equal(tf2(_img(30, 40)).asnumpy(), a)

@@ -116,9 +116,10 @@ def main():
         except subprocess.TimeoutExpired:
             msg = "probe timed out after 120s (relay wedged)"
         _log("backend probe %d/10 failed: %s" % (attempt + 1, msg))
-        time.sleep(60)
+        if attempt < 9:
+            time.sleep(60)
     if probe is None:
-        _log("backend unavailable after ~12 min of probing; aborting")
+        _log("backend unavailable after up to ~30 min of probing; aborting")
         raise SystemExit(1)
     _log("backend up (%s); initializing in-process..." % probe)
     devs = jax.devices()

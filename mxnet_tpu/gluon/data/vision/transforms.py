@@ -124,43 +124,27 @@ class RandomFlipTopBottom:
         return array(a)
 
 
-class RandomBrightness:
-    """(ref: transforms.py:RandomBrightness) — delegates to the mx.image
-    augmenter family."""
+def _jitter_transform(name, aug_name):
+    """Transform class delegating to a mx.image augmenter
+    (ref: transforms.py Random* — upstream also shares the augmenter impls)."""
 
-    def __init__(self, brightness, rng=None):
-        from ....image import BrightnessJitterAug
-        self._aug = BrightnessJitterAug(brightness, rng=rng)
-
-    def __call__(self, x):
-        return self._aug(x)
-
-
-class RandomContrast:
-    def __init__(self, contrast, rng=None):
-        from ....image import ContrastJitterAug
-        self._aug = ContrastJitterAug(contrast, rng=rng)
+    def __init__(self, value, rng=None):
+        from .... import image as _image
+        self._aug = getattr(_image, aug_name)(value, rng=rng)
 
     def __call__(self, x):
         return self._aug(x)
 
-
-class RandomSaturation:
-    def __init__(self, saturation, rng=None):
-        from ....image import SaturationJitterAug
-        self._aug = SaturationJitterAug(saturation, rng=rng)
-
-    def __call__(self, x):
-        return self._aug(x)
+    return type(name, (), {"__init__": __init__, "__call__": __call__,
+                           "__doc__": "Delegates to image.%s." % aug_name})
 
 
-class RandomHue:
-    def __init__(self, hue, rng=None):
-        from ....image import HueJitterAug
-        self._aug = HueJitterAug(hue, rng=rng)
-
-    def __call__(self, x):
-        return self._aug(x)
+RandomBrightness = _jitter_transform("RandomBrightness", "BrightnessJitterAug")
+RandomContrast = _jitter_transform("RandomContrast", "ContrastJitterAug")
+RandomSaturation = _jitter_transform("RandomSaturation", "SaturationJitterAug")
+RandomHue = _jitter_transform("RandomHue", "HueJitterAug")
+RandomLighting = _jitter_transform("RandomLighting", "LightingAug")
+RandomGray = _jitter_transform("RandomGray", "RandomGrayAug")
 
 
 class RandomColorJitter:
@@ -173,21 +157,3 @@ class RandomColorJitter:
     def __call__(self, x):
         x = self._aug(x)
         return self._hue(x) if self._hue is not None else x
-
-
-class RandomLighting:
-    def __init__(self, alpha, rng=None):
-        from ....image import LightingAug
-        self._aug = LightingAug(alpha, rng=rng)
-
-    def __call__(self, x):
-        return self._aug(x)
-
-
-class RandomGray:
-    def __init__(self, p=0.5, rng=None):
-        from ....image import RandomGrayAug
-        self._aug = RandomGrayAug(p, rng=rng)
-
-    def __call__(self, x):
-        return self._aug(x)

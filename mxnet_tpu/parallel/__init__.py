@@ -6,6 +6,7 @@ from .data_parallel import (build_train_step, tree_optimizer_step,  # noqa: F401
 from . import tensor_parallel  # noqa: F401
 from .tensor_parallel import shard_params, param_specs, constrain  # noqa: F401
 from .ring_attention import ring_attention, full_attention  # noqa: F401
+from .ulysses import ulysses_attention  # noqa: F401
 from .pipeline import (pipeline_apply, pipeline_train_step_1f1b,  # noqa: F401
                        stack_stage_params)
 from .expert_parallel import moe_ffn  # noqa: F401

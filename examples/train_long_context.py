@@ -88,7 +88,11 @@ def main():
 
     n = len(jax.devices())
     mesh = parallel.make_mesh({"sp": n})
-    vocab, d, heads, layers = 512, 128, max(8, n), 2
+    # scale width with the mesh so heads==sp divides both d and the ulysses
+    # head requirement for ANY device count (12, 6, ... included)
+    heads = n
+    d = 16 * max(heads, 8)
+    vocab, layers = 512, 2
     T = args.seq or 256 * n
     B = 2
     print("mesh sp=%d  context T=%d  strategy=%s" % (n, T, args.sp_strategy))
@@ -114,7 +118,9 @@ def main():
         nll = -jnp.take_along_axis(lp, target[..., None], -1)
         return nll.mean()
 
-    @jax.jit
+    import functools
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(flat_params, states, t, tok, target):
         loss, grads = jax.value_and_grad(loss_fn)(flat_params, tok, target)
         new_p, new_s = apply_opt(flat_params, grads, states,

@@ -41,7 +41,9 @@ class BERTAttention(HybridBlock):
         q = F.squeeze(F.slice_axis(qkv, axis=0, begin=0, end=1), axis=0)
         k = F.squeeze(F.slice_axis(qkv, axis=0, begin=1, end=2), axis=0)
         v = F.squeeze(F.slice_axis(qkv, axis=0, begin=2, end=3), axis=0)
-        out = F.scaled_dot_attention(q, k, v, mask)  # (B, H, T, D)
+        # BERT's mask is a valid-length prefix → declare it so long
+        # sequences take the O(T)-memory flash path instead of dense T×T
+        out = F.scaled_dot_attention(q, k, v, mask, prefix_mask=True)
         out = F.reshape(F.transpose(out, axes=(0, 2, 1, 3)), shape=(B, T, C))
         out = self.attn_out(out)
         if self.dropout is not None:

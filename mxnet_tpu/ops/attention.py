@@ -32,16 +32,36 @@ def _reference_attention(q, k, v, mask=None, *, causal=False, scale=None):
 
 
 @register_op("scaled_dot_attention")
-def scaled_dot_attention(q, k, v, mask=None, *, causal=False, scale=None):
-    """q,k,v: (B, H, T, D); mask broadcastable to (B, H, Tq, Tk), 1=keep."""
-    if is_tpu_backend() and q.shape[2] >= _FLASH_MIN_LEN and mask is None:
+def scaled_dot_attention(q, k, v, mask=None, *, causal=False, scale=None,
+                         prefix_mask=False):
+    """q,k,v: (B, H, T, D); mask broadcastable to (B, H, Tq, Tk), 1=keep.
+
+    prefix_mask=True is the caller's STATIC declaration that ``mask`` is a
+    key-padding prefix (mask[b, ..., t] = t < valid_len[b], BERT-style) —
+    then the O(T)-memory flash path applies with a per-example valid length
+    recovered as the mask's row sum, instead of falling back to the dense
+    T×T reference the way arbitrary masks must."""
+    if (is_tpu_backend() and q.shape[2] >= _FLASH_MIN_LEN
+            and (mask is None or prefix_mask)):
         try:
             from .pallas.flash_attention import flash_attention
 
-            return flash_attention(q, k, v, causal=causal, scale=scale)
-        except Exception:
-            pass
+            vl = None if mask is None else _prefix_mask_to_valid_len(mask)
+            return flash_attention(q, k, v, causal=causal, scale=scale,
+                                   kv_valid_len=vl)
+        except Exception as e:  # pragma: no cover - depends on backend
+            import warnings
+
+            warnings.warn("flash attention unavailable, using dense "
+                          "reference: %s" % e)
     return _reference_attention(q, k, v, mask, causal=causal, scale=scale)
+
+
+def _prefix_mask_to_valid_len(mask):
+    """(B, ..., Tk) prefix key-padding mask → (B,) valid lengths. A prefix
+    mask is row-constant, so any one row's sum is the length."""
+    return jnp.sum(mask.reshape(mask.shape[0], -1, mask.shape[-1])
+                   [:, 0, :].astype(jnp.int32), axis=-1)
 
 
 @register_op("masked_softmax")

@@ -25,10 +25,12 @@ NEG_INF = -1e30
 LANES = 128
 
 
-def _scores(q_ref, k_ref, q_idx, kv_idx, *, scale, causal, bq, bk):
-    """Shared Q·Kᵀ score-block recompute — the ONE definition of scaling and
-    causal masking used by forward and both backward kernels, so their
-    numerics can never desynchronize."""
+def _scores(q_ref, k_ref, q_idx, kv_idx, *, scale, causal, bq, bk, vl=None):
+    """Shared Q·Kᵀ score-block recompute — the ONE definition of scaling,
+    causal masking, and key-padding masking used by forward and both backward
+    kernels, so their numerics can never desynchronize. ``vl`` is a traced
+    per-example valid K length: columns >= vl are masked (BERT-style prefix
+    padding)."""
     q = q_ref[0].astype(jnp.float32) * scale  # (bq, d)
     k = k_ref[0].astype(jnp.float32)          # (bk, d)
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -37,11 +39,20 @@ def _scores(q_ref, k_ref, q_idx, kv_idx, *, scale, causal, bq, bk):
         rows = q_idx * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         cols = kv_idx * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
         s = jnp.where(rows >= cols, s, NEG_INF)
+    if vl is not None:
+        cols = kv_idx * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        s = jnp.where(cols < vl, s, NEG_INF)
     return s
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale, causal, bq, bk,
-                emit_lse):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, bq, bk,
+                emit_lse, masked):
+    if masked:
+        vl_ref, rest = rest[0], rest[1:]
+        vl = vl_ref[0, 0, 0]
+    else:
+        vl = None
+    o_ref, rest = rest[0], rest[1:]
     if emit_lse:
         lse_ref, m_ref, l_ref, acc_ref = rest
     else:
@@ -60,12 +71,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale, causal, bq, bk,
         # skip fully-masked K blocks: first query row of this Q block is
         # q_idx*bq; block contributes iff kv_idx*bk <= q_idx*bq + bq - 1
         run = kv_idx * bk <= q_idx * bq + bq - 1
+    if masked:
+        # dynamic skip: K blocks entirely past this example's valid length
+        run = jnp.logical_and(run, kv_idx * bk < vl)
 
-    @pl.when(run if causal else True)
+    @pl.when(run)
     def _compute():
         v = v_ref[0].astype(jnp.float32)          # (bk, d)
         s = _scores(q_ref, k_ref, q_idx, kv_idx, scale=scale, causal=causal,
-                    bq=bq, bk=bk)
+                    bq=bq, bk=bk, vl=vl)
         m_prev = m_ref[:]                       # (bq, 128) broadcast lanes
         m_cur = jnp.max(s, axis=1, keepdims=True)  # (bq, 1)
         m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
@@ -79,12 +93,22 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale, causal, bq, bk,
 
     @pl.when(kv_idx == pl.num_programs(2) - 1)
     def _finish():
-        o_ref[0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
+        # max guard: a vl=0 example has an all-masked row (l == 0)
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:, :1], 1e-30)).astype(
+            o_ref.dtype)
         if emit_lse:
-            lse_ref[0] = m_ref[:] + jnp.log(l_ref[:])
+            lse_ref[0] = m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-30))
 
 
-def _flash_fwd(q, k, v, scale, causal, bq, bk, interpret=False,
+def _vl_operand(kv_valid_len, B, H):
+    """valid_len (B,) → (B*H, 1, LANES) int32 VMEM operand (one scalar per
+    grid row, lane-broadcast to the native tile width)."""
+    vl = jnp.broadcast_to(kv_valid_len.astype(jnp.int32)[:, None, None, None],
+                          (B, H, 1, LANES))
+    return vl.reshape(B * H, 1, LANES)
+
+
+def _flash_fwd(q, k, v, kv_valid_len, scale, causal, bq, bk, interpret=False,
                return_lse=False):
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
@@ -93,7 +117,17 @@ def _flash_fwd(q, k, v, scale, causal, bq, bk, interpret=False,
     qr = q.reshape(B * H, Tq, D)
     kr = k.reshape(B * H, Tk, D)
     vr = v.reshape(B * H, Tk, D)
+    masked = kv_valid_len is not None
     grid = (B * H, Tq // bq, Tk // bk)
+    in_specs = [
+        pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+    ]
+    operands = [qr, kr, vr]
+    if masked:
+        in_specs.append(pl.BlockSpec((1, 1, LANES), lambda b, i, j: (b, 0, 0)))
+        operands.append(_vl_operand(kv_valid_len, B, H))
     out_specs = [pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0))]
     out_shape = [jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype)]
     if return_lse:  # inference path skips the lse output entirely — XLA
@@ -102,14 +136,10 @@ def _flash_fwd(q, k, v, scale, causal, bq, bk, interpret=False,
         out_shape.append(jax.ShapeDtypeStruct((B * H, Tq, LANES), jnp.float32))
     res = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
-                          emit_lse=return_lse),
+                          emit_lse=return_lse, masked=masked),
         interpret=interpret,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=[
@@ -119,7 +149,7 @@ def _flash_fwd(q, k, v, scale, causal, bq, bk, interpret=False,
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
-    )(qr, kr, vr)
+    )(*operands)
     if return_lse:
         out, lse = res
         # keep only one lane as the residual (saving the full 128-lane
@@ -128,8 +158,13 @@ def _flash_fwd(q, k, v, scale, causal, bq, bk, interpret=False,
     return res[0].reshape(B, H, Tq, D)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_acc, *, scale, causal, bq, bk):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+               scale, causal, bq, bk, masked):
+    if masked:
+        vl_ref, dq_ref, dq_acc = rest
+        vl = vl_ref[0, 0, 0]
+    else:
+        (dq_ref, dq_acc), vl = rest, None
     kv_idx = pl.program_id(2)
     q_idx = pl.program_id(1)
 
@@ -140,14 +175,16 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     run = True
     if causal:
         run = kv_idx * bk <= q_idx * bq + bq - 1
+    if masked:
+        run = jnp.logical_and(run, kv_idx * bk < vl)
 
-    @pl.when(run if causal else True)
+    @pl.when(run)
     def _compute():
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
         s = _scores(q_ref, k_ref, q_idx, kv_idx, scale=scale, causal=causal,
-                    bq=bq, bk=bk)
+                    bq=bq, bk=bk, vl=vl)
         p = jnp.exp(s - lse_ref[0][:, :1])                       # (bq, bk)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -161,8 +198,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, bq, bk):
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                scale, causal, bq, bk, masked):
+    if masked:
+        vl_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+        vl = vl_ref[0, 0, 0]
+    else:
+        (dk_ref, dv_ref, dk_acc, dv_acc), vl = rest, None
     q_idx = pl.program_id(2)   # inner: sweep q blocks
     kv_idx = pl.program_id(1)  # outer: this kernel instance's k/v block
 
@@ -175,14 +217,17 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     if causal:
         # q block contributes iff its last row >= first k row
         run = q_idx * bq + bq - 1 >= kv_idx * bk
+    if masked:
+        # whole K block past valid length → dk = dv = 0 there
+        run = jnp.logical_and(run, kv_idx * bk < vl)
 
-    @pl.when(run if causal else True)
+    @pl.when(run)
     def _compute():
         q = q_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
         s = _scores(q_ref, k_ref, q_idx, kv_idx, scale=scale, causal=causal,
-                    bq=bq, bk=bk)
+                    bq=bq, bk=bk, vl=vl)
         p = jnp.exp(s - lse_ref[0][:, :1])                       # (bq, bk)
         # dv += p^T @ do
         dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
@@ -200,7 +245,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, do, scale, causal, bq, bk, interpret=False):
+def _flash_bwd(q, k, v, o, lse, do, kv_valid_len, scale, causal, bq, bk,
+               interpret=False):
     B, H, Tq, D = q.shape
     Tk = k.shape[2]
     bq = min(bq, Tq)
@@ -209,6 +255,7 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, bq, bk, interpret=False):
     kr = k.reshape(B * H, Tk, D)
     vr = v.reshape(B * H, Tk, D)
     dor = do.reshape(B * H, Tq, D)
+    masked = kv_valid_len is not None
     # delta_i = rowsum(dO ⊙ O); both row stats lane-broadcast to the
     # (bq, 128) layout transiently (the saved lse residual is 1-lane)
     delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)
@@ -218,29 +265,42 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, bq, bk, interpret=False):
     spec_q = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0))
     spec_kv_in = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0))
     spec_row = pl.BlockSpec((1, bq, LANES), lambda b, i, j: (b, i, 0))
+    spec_vl = pl.BlockSpec((1, 1, LANES), lambda b, i, j: (b, 0, 0))
 
+    dq_in_specs = [spec_q, spec_kv_in, spec_kv_in, spec_q, spec_row, spec_row]
+    dq_operands = [qr, kr, vr, dor, lse, delta]
+    if masked:
+        dq_in_specs.append(spec_vl)
+        dq_operands.append(_vl_operand(kv_valid_len, B, H))
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk),
+        functools.partial(_dq_kernel, scale=scale, causal=causal, bq=bq,
+                          bk=bk, masked=masked),
         interpret=interpret,
         grid=(B * H, Tq // bq, Tk // bk),
-        in_specs=[spec_q, spec_kv_in, spec_kv_in, spec_q, spec_row, spec_row],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
-    )(qr, kr, vr, dor, lse, delta)
+    )(*dq_operands)
 
     # dk/dv: k block is the resident (outer) axis, q blocks stream (inner)
     spec_q_inner = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, j, 0))
     spec_kv_outer = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, i, 0))
     spec_row_inner = pl.BlockSpec((1, bq, LANES), lambda b, i, j: (b, j, 0))
+    dkv_in_specs = [spec_q_inner, spec_kv_outer, spec_kv_outer, spec_q_inner,
+                    spec_row_inner, spec_row_inner]
+    dkv_operands = [qr, kr, vr, dor, lse, delta]
+    if masked:
+        dkv_in_specs.append(spec_vl)
+        dkv_operands.append(_vl_operand(kv_valid_len, B, H))
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk),
+        functools.partial(_dkv_kernel, scale=scale, causal=causal, bq=bq,
+                          bk=bk, masked=masked),
         interpret=interpret,
         grid=(B * H, Tk // bk, Tq // bq),
-        in_specs=[spec_q_inner, spec_kv_outer, spec_kv_outer, spec_q_inner,
-                  spec_row_inner, spec_row_inner],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, i, 0)),
@@ -253,42 +313,50 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, bq, bk, interpret=False):
                         pltpu.VMEM((bk, D), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
-    )(qr, kr, vr, dor, lse, delta)
+    )(*dkv_operands)
 
     return (dq.reshape(B, H, Tq, D), dk.reshape(B, H, Tk, D),
             dv.reshape(B, H, Tk, D))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, causal, bq, bk, interpret=False):
-    return _flash_fwd(q, k, v, scale, causal, bq, bk, interpret=interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, kv_valid_len, scale, causal, bq, bk, interpret=False):
+    return _flash_fwd(q, k, v, kv_valid_len, scale, causal, bq, bk,
+                      interpret=interpret)
 
 
-def _flash_vjp_fwd(q, k, v, scale, causal, bq, bk, interpret=False):
-    o, lse = _flash_fwd(q, k, v, scale, causal, bq, bk, interpret=interpret,
-                        return_lse=True)
-    return o, (q, k, v, o, lse)
+def _flash_vjp_fwd(q, k, v, kv_valid_len, scale, causal, bq, bk,
+                   interpret=False):
+    o, lse = _flash_fwd(q, k, v, kv_valid_len, scale, causal, bq, bk,
+                        interpret=interpret, return_lse=True)
+    return o, (q, k, v, kv_valid_len, o, lse)
 
 
 def _flash_vjp_bwd(scale, causal, bq, bk, interpret, res, do):
-    q, k, v, o, lse = res
-    return _flash_bwd(q, k, v, o, lse, do, scale, causal, bq, bk,
-                      interpret=interpret)
+    q, k, v, kv_valid_len, o, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, kv_valid_len, scale, causal,
+                            bq, bk, interpret=interpret)
+    return dq, dk, dv, None  # int valid-length carries no tangent
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def flash_attention(q, k, v, causal=False, scale=None, block_q=256,
-                    block_k=512, interpret=False):
+                    block_k=512, interpret=False, kv_valid_len=None):
     """q,k,v: (B, H, T, D). D should be a multiple of 128 lanes ideally;
-    T must be divisible by the chosen blocks (callers pad)."""
+    T must be divisible by the chosen blocks (callers pad).
+
+    kv_valid_len: optional (B,) int — BERT-style key-padding: each example
+    attends only to K/V positions < its valid length (columns beyond are
+    masked AND their blocks skipped entirely, forward and backward)."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     Tq, Tk = q.shape[2], k.shape[2]
     bq = _largest_divisor_block(Tq, block_q)
     bk = _largest_divisor_block(Tk, block_k)
-    return _flash(q, k, v, float(scale), bool(causal), bq, bk, interpret)
+    return _flash(q, k, v, kv_valid_len, float(scale), bool(causal), bq, bk,
+                  interpret)
 
 
 def _largest_divisor_block(t, prefer):

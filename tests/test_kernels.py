@@ -15,7 +15,7 @@ def test_flash_attention_interpret_matches_reference():
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
     q, k, v = (jax.random.normal(kk, (B, H, T, D), jnp.float32) for kk in ks)
     for causal in (False, True):
-        out = _flash_fwd(q, k, v, 1.0 / D ** 0.5, causal, 128, 128, interpret=True)
+        out = _flash_fwd(q, k, v, None, 1.0 / D ** 0.5, causal, 128, 128, interpret=True)
         ref = full_attention(q, k, v, causal=causal)
         assert float(jnp.abs(out - ref).max()) < 1e-4, causal
 
@@ -141,3 +141,115 @@ def test_fused_softmax_xent_bf16_logits():
     ref = -jax.nn.log_softmax(logits.astype(jnp.float32))[
         jnp.arange(8), labels]
     assert np.abs(np.asarray(loss) - np.asarray(ref)).max() < 0.05
+
+
+def test_flash_attention_kv_valid_len():
+    """Key-padding (prefix) masking inside the flash kernels — fwd + bwd
+    match a densely masked reference, including a partially and a fully
+    valid example."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas.flash_attention import flash_attention
+
+    rng = np.random.RandomState(0)
+    B, H, T, D = 2, 2, 256, 32
+    q, k, v = (jnp.asarray(rng.randn(B, H, T, D).astype(np.float32))
+               for _ in range(3))
+    vl = jnp.asarray([100, 256], jnp.int32)
+
+    def dense(q, k, v, causal=False):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        mask = jnp.arange(T)[None, None, None, :] < vl[:, None, None, None]
+        if causal:
+            cm = jnp.arange(T)[:, None] >= jnp.arange(T)[None, :]
+            mask = mask & cm[None, None]
+        s = jnp.where(mask, s, -1e30)
+        return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+
+    for causal in (False, True):
+        out = flash_attention(q, k, v, causal=causal, interpret=True,
+                              kv_valid_len=vl)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(dense(q, k, v, causal)),
+                                   rtol=2e-4, atol=2e-5)
+
+    w = jnp.asarray(rng.randn(1, H, T, D).astype(np.float32))
+    g1 = jax.grad(lambda a, b, c: (flash_attention(
+        a, b, c, interpret=True, kv_valid_len=vl) * w).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda a, b, c: (dense(a, b, c) * w).sum(),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+    # grads of padded K/V positions must be exactly zero
+    np.testing.assert_array_equal(np.asarray(g1[1][0, :, 100:, :]), 0.0)
+    np.testing.assert_array_equal(np.asarray(g1[2][0, :, 100:, :]), 0.0)
+
+
+def test_scaled_dot_attention_prefix_mask_matches_dense():
+    """prefix_mask=True must be numerically identical to the explicit-mask
+    reference path (on CPU both take the reference; the flag changes TPU
+    routing only)."""
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.attention import scaled_dot_attention
+
+    rng = np.random.RandomState(1)
+    B, H, T, D = 2, 2, 64, 16
+    q, k, v = (jnp.asarray(rng.randn(B, H, T, D).astype(np.float32))
+               for _ in range(3))
+    vl = jnp.asarray([30, 64], jnp.int32)
+    mask = (jnp.arange(T)[None, None, None, :]
+            < vl[:, None, None, None]).astype(jnp.float32)
+    a = scaled_dot_attention(q, k, v, mask)
+    b = scaled_dot_attention(q, k, v, mask, prefix_mask=True)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_prefix_mask_to_valid_len_recovery():
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.attention import _prefix_mask_to_valid_len
+
+    vl = np.array([3, 7, 0], np.int32)
+    T = 8
+    # BERT shape (B,1,1,T) and full (B,H,Tq,Tk) prefix masks both recover
+    m1 = (np.arange(T)[None, None, None, :] < vl[:, None, None, None])
+    m4 = np.broadcast_to(m1, (3, 2, T, T))
+    for m in (m1, m4):
+        got = _prefix_mask_to_valid_len(jnp.asarray(m.astype(np.float32)))
+        np.testing.assert_array_equal(np.asarray(got), vl)
+
+
+def test_prefix_mask_routes_to_flash(monkeypatch):
+    """With the TPU gate forced open, prefix_mask=True must route through
+    the flash kernel with the recovered valid length (interpret mode stands
+    in for hardware) and match the dense reference."""
+    import jax.numpy as jnp
+    from mxnet_tpu.ops import attention as A
+    from mxnet_tpu.ops.pallas import flash_attention as FA
+
+    monkeypatch.setattr(A, "is_tpu_backend", lambda: True)
+    monkeypatch.setattr(A, "_FLASH_MIN_LEN", 0)
+    seen = {}
+    orig = FA.flash_attention
+
+    def spy(q, k, v, **kw):
+        seen["kv_valid_len"] = kw.get("kv_valid_len")
+        return orig(q, k, v, interpret=True,
+                    **{k2: v2 for k2, v2 in kw.items() if k2 != "interpret"})
+
+    monkeypatch.setattr(FA, "flash_attention", spy)
+
+    rng = np.random.RandomState(2)
+    B, H, T, D = 2, 2, 64, 16
+    q, k, v = (jnp.asarray(rng.randn(B, H, T, D).astype(np.float32))
+               for _ in range(3))
+    vl = np.array([20, 64], np.int32)
+    mask = jnp.asarray((np.arange(T)[None, None, None, :]
+                        < vl[:, None, None, None]).astype(np.float32))
+    out = A.scaled_dot_attention(q, k, v, mask, prefix_mask=True)
+    assert seen["kv_valid_len"] is not None
+    np.testing.assert_array_equal(np.asarray(seen["kv_valid_len"]), vl)
+    ref = A._reference_attention(q, k, v, mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)

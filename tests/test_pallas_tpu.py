@@ -74,6 +74,23 @@ _KERNEL_SCRIPT = textwrap.dedent("""
                                    rtol=5e-2, atol=5e-3)
     print("FLASH_OK")
 
+    # ---- flash attention with kv_valid_len (key-padding) ------------------
+    vl = jnp.asarray([300, 512], jnp.int32)
+
+    def dense_vl(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        mask = jnp.arange(T)[None, None, None, :] < vl[:, None, None, None]
+        return jnp.einsum("bhqk,bhkd->bhqd",
+                          jax.nn.softmax(jnp.where(mask, s, -1e30), -1), v)
+
+    om = flash_attention(q, k, v, interpret=False, kv_valid_len=vl)
+    np.testing.assert_allclose(np.asarray(om), np.asarray(dense_vl(q, k, v)),
+                               rtol=2e-2, atol=2e-3)
+    gm = jax.grad(floss(lambda a, b, c: flash_attention(
+        a, b, c, interpret=False, kv_valid_len=vl)), argnums=(1,))(q, k, v)
+    np.testing.assert_array_equal(np.asarray(gm[0][0, :, 300:, :]), 0.0)
+    print("FLASH_MASKED_OK")
+
     # ---- fused layernorm fwd + bwd ---------------------------------------
     from mxnet_tpu.ops.pallas.layernorm import fused_layernorm
     x = jnp.asarray(rng.normal(size=(384, 512)), jnp.float32)
@@ -128,5 +145,5 @@ def test_pallas_kernels_on_hardware():
                        timeout=1500)
     assert r.returncode == 0, "kernel run failed:\n%s\n%s" % (r.stdout[-3000:],
                                                               r.stderr[-3000:])
-    for tag in ("FLASH_OK", "LAYERNORM_OK", "XENT_OK"):
+    for tag in ("FLASH_OK", "FLASH_MASKED_OK", "LAYERNORM_OK", "XENT_OK"):
         assert tag in r.stdout, (tag, r.stdout[-2000:])

@@ -91,7 +91,8 @@ def main():
     # scale width with the mesh so heads==sp divides both d and the ulysses
     # head requirement for ANY device count (12, 6, ... included)
     heads = n
-    d = 16 * max(heads, 8)
+    d = max(128, 16 * heads)
+    d += (-d) % heads  # round up to a multiple of heads (e.g. n=6 → d=132)
     vocab, layers = 512, 2
     T = args.seq or 256 * n
     B = 2

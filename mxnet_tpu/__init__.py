@@ -38,6 +38,9 @@ from . import amp
 from . import checkpoint
 from . import parallel
 from . import module
+from . import module as mod
+from . import model
+from . import rnn
 from . import operator
 from . import sparse
 from . import quantization

@@ -1,0 +1,15 @@
+"""Single source of the contrib op-name list; mx.nd.contrib and
+mx.sym.contrib are both generated from it so their coverage cannot drift."""
+
+CONTRIB_OPS = {
+    "box_iou": "box_iou",
+    "box_nms": "box_nms",
+    "multibox_prior": "multibox_prior",
+    "MultiBoxPrior": "multibox_prior",
+    "multibox_target": "multibox_target",
+    "MultiBoxTarget": "multibox_target",
+    "multibox_detection": "multibox_detection",
+    "MultiBoxDetection": "multibox_detection",
+    "quantize": "contrib_quantize",
+    "dequantize": "contrib_dequantize",
+}

@@ -1,0 +1,50 @@
+"""``mx.model`` legacy namespace (ref: python/mxnet/model.py).
+
+MXNet 1.x users load/save checkpoints as ``prefix-symbol.json`` +
+``prefix-NNNN.params`` through mx.model; Module.save_checkpoint writes the
+same layout. FeedForward (the pre-Module API) is represented by its
+checkpoint functions — upstream deprecated it in favor of Module, which
+this framework ships fully (module.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import symbol as sym_mod
+from .ndarray import NDArray
+
+__all__ = ["save_checkpoint", "load_checkpoint", "BatchEndParam"]
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    """(ref: model.py:save_checkpoint) — symbol json + params npz."""
+    if symbol is not None:
+        with open("%s-symbol.json" % prefix, "w") as f:
+            f.write(symbol.tojson())
+    arrs = {}
+    for k, v in (arg_params or {}).items():
+        arrs["arg:%s" % k] = v.asnumpy() if isinstance(v, NDArray) else np.asarray(v)
+    for k, v in (aux_params or {}).items():
+        arrs["aux:%s" % k] = v.asnumpy() if isinstance(v, NDArray) else np.asarray(v)
+    np.savez("%s-%04d.params.npz" % (prefix, epoch), **arrs)
+
+
+def load_checkpoint(prefix, epoch):
+    """(ref: model.py:load_checkpoint) → (symbol, arg_params, aux_params)."""
+    symbol = sym_mod.load("%s-symbol.json" % prefix)
+    data = np.load("%s-%04d.params.npz" % (prefix, epoch))
+    arg_params, aux_params = {}, {}
+    for k in data.files:
+        kind, name = k.split(":", 1)
+        (arg_params if kind == "arg" else aux_params)[name] = NDArray(data[k])
+    return symbol, arg_params, aux_params
+
+
+class BatchEndParam:
+    """Callback payload (ref: model.py:BatchEndParam)."""
+
+    def __init__(self, epoch, nbatch, eval_metric, locals=None):
+        self.epoch = epoch
+        self.nbatch = nbatch
+        self.eval_metric = eval_metric
+        self.locals = locals

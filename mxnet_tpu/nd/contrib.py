@@ -1,9 +1,11 @@
-"""``mx.nd.contrib`` parity: control flow + detection ops.
+"""``mx.nd.contrib`` parity: control flow + detection + quantization ops.
 
-(ref: python/mxnet/ndarray/contrib.py, src/operator/contrib/*)
+(ref: python/mxnet/ndarray/contrib.py, src/operator/contrib/*). Op list
+shared with mx.sym.contrib via _contrib_ops.py.
 """
 from __future__ import annotations
 
+from .._contrib_ops import CONTRIB_OPS
 from ..ndarray import invoke
 from ..ops.control_flow import cond, foreach, while_loop  # noqa: F401
 
@@ -16,8 +18,5 @@ def _wrap(opname):
     return f
 
 
-box_iou = _wrap("box_iou")
-box_nms = _wrap("box_nms")
-MultiBoxPrior = multibox_prior = _wrap("multibox_prior")
-MultiBoxTarget = multibox_target = _wrap("multibox_target")
-MultiBoxDetection = multibox_detection = _wrap("multibox_detection")
+for _alias, _op in CONTRIB_OPS.items():
+    globals()[_alias] = _wrap(_op)

@@ -1,0 +1,62 @@
+"""``mx.rnn`` legacy cell namespace (ref: python/mxnet/rnn/rnn_cell.py).
+
+The 1.x pre-Gluon RNN API. Cells are the SAME implementations as
+gluon.rnn's (one lax.scan-backed codebase); this module re-exports them
+under their legacy names, plus FusedRNNCell, which upstream used to reach
+cuDNN — here fusion is simply the gluon layer whose whole recurrence
+compiles into one XLA scan, so FusedRNNCell wraps that."""
+from __future__ import annotations
+
+from .gluon import rnn as _grnn
+from .gluon.rnn.rnn_cell import (  # noqa: F401
+    BidirectionalCell, DropoutCell, GRUCell, LSTMCell, RecurrentCell,
+    ResidualCell, RNNCell, SequentialRNNCell, ZoneoutCell,
+)
+
+__all__ = ["RNNCell", "LSTMCell", "GRUCell", "SequentialRNNCell",
+           "BidirectionalCell", "DropoutCell", "ResidualCell", "ZoneoutCell",
+           "FusedRNNCell"]
+
+
+class FusedRNNCell:
+    """Legacy fused multi-layer RNN (ref: rnn_cell.py:FusedRNNCell — the
+    cuDNN path). Wraps the gluon fused layer; unroll() runs the whole
+    sequence as one compiled scan."""
+
+    def __init__(self, num_hidden, num_layers=1, mode="lstm",
+                 bidirectional=False, dropout=0.0, prefix=None):
+        cls = {"lstm": _grnn.LSTM, "gru": _grnn.GRU,
+               "rnn_tanh": _grnn.RNN, "rnn_relu": _grnn.RNN}[mode]
+        kwargs = dict(hidden_size=num_hidden, num_layers=num_layers,
+                      bidirectional=bidirectional, dropout=dropout,
+                      layout="TNC")
+        if mode.startswith("rnn_"):
+            kwargs["activation"] = mode.split("_")[1]
+        self._layer = cls(**kwargs)
+        self._mode = mode
+
+    def __getattr__(self, name):
+        return getattr(self._layer, name)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        from . import nd
+
+        x = inputs
+        if layout == "NTC":
+            x = nd.swapaxes(x, dim1=0, dim2=1)
+        T = x.shape[0]
+        if length > T:
+            raise ValueError("unroll length %d exceeds sequence length %d"
+                             % (length, T))
+        if length < T:  # legacy contract: process exactly `length` steps
+            x = nd.slice_axis(x, axis=0, begin=0, end=length)
+        self._layer.initialize()  # idempotent without force_reinit
+        if begin_state is None:
+            # always pass states so the layer returns final states (the
+            # legacy API guarantees them for truncated-BPTT carry-over)
+            begin_state = self._layer.begin_state(batch_size=x.shape[1])
+        out, states = self._layer(x, begin_state)
+        if layout == "NTC":
+            out = nd.swapaxes(out, dim1=0, dim2=1)
+        return out, states

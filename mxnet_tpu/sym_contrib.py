@@ -1,0 +1,19 @@
+"""``mx.sym.contrib`` parity: symbolic forms of the contrib ops
+(ref: python/mxnet/symbol/contrib.py). Op list shared with mx.nd.contrib
+via _contrib_ops.py."""
+from __future__ import annotations
+
+from ._contrib_ops import CONTRIB_OPS
+from .symbol import _make, cond  # noqa: F401
+
+
+def _wrap(opname):
+    def f(*args, name=None, **kwargs):
+        return _make(opname, *args, name=name, **kwargs)
+
+    f.__name__ = opname
+    return f
+
+
+for _alias, _op in CONTRIB_OPS.items():
+    globals()[_alias] = _wrap(_op)

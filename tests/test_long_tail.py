@@ -244,3 +244,73 @@ def test_npx_registry_fallback():
     np.testing.assert_allclose(float(mean.asnumpy()), 2.5, rtol=1e-6)
     with pytest.raises(AttributeError):
         mx.npx.definitely_not_an_op
+
+
+# ------------------------------------------------------- legacy namespaces
+
+def test_legacy_namespaces():
+    import tempfile, os
+    s = mx.sym.contrib.box_iou(mx.sym.var("a"), mx.sym.var("b"))
+    assert s._op == "box_iou"
+    assert mx.mod.Module is mx.module.Module
+
+    d = tempfile.mkdtemp()
+    pre = os.path.join(d, "m")
+    sym = mx.sym.FullyConnected(mx.sym.var("data"), mx.sym.var("w"),
+                                mx.sym.var("b"), num_hidden=4)
+    args = {"w": nd.array(np.ones((4, 3), np.float32)),
+            "b": nd.array(np.zeros(4, np.float32))}
+    mx.model.save_checkpoint(pre, 3, sym, args, {})
+    s2, a2, x2 = mx.model.load_checkpoint(pre, 3)
+    assert a2["w"].shape == (4, 3) and not x2
+    # loaded symbol evaluates
+    out = s2.eval(data=nd.array(np.ones((2, 3), np.float32)),
+                  w=a2["w"], b=a2["b"])[0]
+    np.testing.assert_allclose(out.asnumpy(), np.full((2, 4), 3.0), rtol=1e-6)
+
+
+def test_legacy_rnn_cells():
+    cell = mx.rnn.LSTMCell(8, input_size=4)
+    cell.initialize()
+    x = nd.array(np.random.default_rng(0).normal(size=(2, 5, 4))
+                 .astype(np.float32))
+    out, states = cell.unroll(5, x, layout="NTC")
+    assert out.shape == (2, 5, 8) and len(states) == 2
+
+    fused = mx.rnn.FusedRNNCell(8, num_layers=2, mode="lstm")
+    out2, _ = fused.unroll(5, x, layout="NTC")
+    assert out2.shape == (2, 5, 8)
+    # legacy fused == gluon layer on the same weights (same impl)
+    direct = fused._layer(nd.swapaxes(x, dim1=0, dim2=1))
+    np.testing.assert_allclose(out2.asnumpy(),
+                               np.swapaxes(direct.asnumpy(), 0, 1),
+                               rtol=1e-6)
+
+
+def test_contrib_namespaces_same_coverage():
+    from mxnet_tpu._contrib_ops import CONTRIB_OPS
+    for alias in CONTRIB_OPS:
+        assert hasattr(nd.contrib, alias), "nd.contrib missing %s" % alias
+        assert hasattr(mx.sym.contrib, alias), "sym.contrib missing %s" % alias
+    # nd.contrib carries the python control-flow helpers too
+    assert callable(nd.contrib.foreach) and callable(nd.contrib.cond)
+
+
+def test_fused_rnn_cell_truncated_bptt():
+    """Legacy contract: unroll returns real final states usable as the next
+    segment's begin_state, and honors `length`."""
+    rng = np.random.default_rng(1)
+    x = nd.array(rng.normal(size=(2, 6, 4)).astype(np.float32))
+    cell = mx.rnn.FusedRNNCell(8, mode="lstm")
+    out, states = cell.unroll(3, x, layout="NTC")  # first 3 steps only
+    assert out.shape == (2, 3, 8)
+    assert states is not None and len(states) == 2
+    out2, states2 = cell.unroll(3, nd.slice_axis(x, axis=1, begin=3, end=6),
+                                begin_state=states, layout="NTC")
+    # carrying states must differ from a cold start on the same segment
+    cold, _ = cell.unroll(3, nd.slice_axis(x, axis=1, begin=3, end=6),
+                          layout="NTC")
+    assert not np.allclose(out2.asnumpy(), cold.asnumpy())
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="exceeds"):
+        cell.unroll(9, x, layout="NTC")

@@ -7,7 +7,7 @@ __all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
            "Conv3DTranspose", "MaxPool1D", "MaxPool2D", "MaxPool3D",
            "AvgPool1D", "AvgPool2D", "AvgPool3D", "GlobalMaxPool1D",
            "GlobalMaxPool2D", "GlobalMaxPool3D", "GlobalAvgPool1D",
-           "GlobalAvgPool2D", "GlobalAvgPool3D"]
+           "GlobalAvgPool2D", "GlobalAvgPool3D", "ReflectionPad2D"]
 
 
 def _tuple(v, n):
@@ -169,3 +169,19 @@ class GlobalAvgPool3D(_Pooling):
     _pool_type = "avg"
     _ndim = 3
     _global = True
+
+
+class ReflectionPad2D(HybridBlock):
+    """Reflection padding on H/W of NCHW input
+    (ref: conv_layers.py:ReflectionPad2D). ``padding`` is an int (all four
+    spatial edges) or the upstream 8-tuple NCHW begin/end spec."""
+
+    def __init__(self, padding=0, **kwargs):
+        super().__init__(**kwargs)
+        if isinstance(padding, int):
+            padding = (0, 0, 0, 0, padding, padding, padding, padding)
+        assert len(padding) == 8, padding
+        self._pad_width = tuple(int(p) for p in padding)
+
+    def hybrid_forward(self, F, x):
+        return F.pad(x, mode="reflect", pad_width=self._pad_width)

@@ -164,3 +164,13 @@ def test_cast_bf16():
     net.cast("bfloat16")
     out = net(nd.ones((2, 4)).astype("bfloat16"))
     assert "bfloat16" in str(out.dtype)
+
+
+def test_reflection_pad2d():
+    from mxnet_tpu import gluon, nd
+
+    p = gluon.nn.ReflectionPad2D(2)
+    x = np.arange(2 * 1 * 4 * 4).reshape(2, 1, 4, 4).astype(np.float32)
+    out = p(nd.array(x)).asnumpy()
+    np.testing.assert_array_equal(
+        out, np.pad(x, ((0, 0), (0, 0), (2, 2), (2, 2)), mode="reflect"))

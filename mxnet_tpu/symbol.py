@@ -224,10 +224,19 @@ class Symbol:
                 return index[id(s)]
             # children first so inputs reference earlier node ids
             child_ids = [ser(i, nodes, index) for i in s._inputs]
+            nid_attrs = {}
+            for k, v in s._attrs.items():
+                if isinstance(v, Symbol):
+                    # subgraph attr (cond branches): serialize into the SAME
+                    # node table — branch vars are shared with the outer
+                    # graph, so the shared index keeps one copy
+                    nid_attrs[k] = {"__sym__": ser(v, nodes, index)}
+                else:
+                    nid_attrs[k] = repr(v)
             nid = len(nodes)
             index[id(s)] = nid
             nodes.append({"op": s._op or "null", "name": s.name,
-                          "attrs": {k: repr(v) for k, v in s._attrs.items()},
+                          "attrs": nid_attrs,
                           "shape": list(s._shape) if s._shape else None,
                           "inputs": child_ids})
             return nid
@@ -359,7 +368,12 @@ def loads(json_str):
     blob = json.loads(json_str)
     built = []
     for node in blob["nodes"]:
-        attrs = {k: ast.literal_eval(v) for k, v in node["attrs"].items()}
+        attrs = {}
+        for k, v in node["attrs"].items():
+            if isinstance(v, dict) and "__sym__" in v:
+                attrs[k] = built[v["__sym__"]]  # subgraph attr (cond branch)
+            else:
+                attrs[k] = ast.literal_eval(v)
         if node["op"] == "null":
             built.append(Symbol(None, name=node["name"],
                                 shape=node.get("shape")))

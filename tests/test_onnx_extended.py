@@ -191,3 +191,19 @@ def test_zeros_like_roundtrip_dtype_safe():
     got = mxonnx.import_to_gluon(mb)(nd.array(xs)).asnumpy()
     # Mul(x, 0) lowering would have produced NaN at the inf entry
     np.testing.assert_array_equal(got, xs)
+
+
+def test_cond_symbol_json_roundtrip():
+    """cond graphs serialize: branch subgraphs ride the same node table
+    (shared vars deduplicated) and loads rebuilds a working conditional."""
+    from mxnet_tpu.symbol import loads
+
+    x = S.var("x")
+    t = x * 2.0
+    c = S.cond(mx.sym.relu(mx.sym.sum(x)), t + 1.0, t - 1.0)
+    c2 = loads(c.tojson())
+    xs = np.arange(4, dtype=np.float32).reshape(2, 2)
+    for sign in (1.0, -1.0):
+        a = c.eval(x=nd.array(sign * xs))[0].asnumpy()
+        b = c2.eval(x=nd.array(sign * xs))[0].asnumpy()
+        np.testing.assert_allclose(a, b, rtol=1e-6)

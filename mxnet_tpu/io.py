@@ -6,7 +6,7 @@ import numpy as np
 from .ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
-           "ImageRecordIter", "PrefetchingIter", "ResizeIter"]
+           "ImageRecordIter", "PrefetchingIter", "ResizeIter", "LibSVMIter"]
 
 
 class DataDesc:
@@ -202,7 +202,9 @@ class ImageRecordIter(DataIter):
             img = imdecode(img_bytes)
             for aug in self._augs:
                 img = aug(img)
-            datas.append(img.asnumpy())
+            # augmenters emit HWC float32 (upstream contract); the iterator
+            # owns the HWC→CHW relayout
+            datas.append(img.asnumpy().transpose(2, 0, 1))
             lab = header.label
             labels.append(np.asarray(lab, np.float32).ravel()[0] if np.ndim(lab) else float(lab))
         self._cursor += self.batch_size
@@ -277,3 +279,61 @@ class ResizeIter(DataIter):
         except StopIteration:
             self._iter.reset()
             return self._iter.next()
+
+
+class LibSVMIter(DataIter):
+    """Sparse batches from libsvm text files (ref: src/io/iter_libsvm.cc).
+
+    Each line: ``label idx:val idx:val ...`` (0-based feature indices by
+    default, like the reference's libsvm iterator). Yields CSRNDArray data
+    batches — the TPU consumer is sparse.dot / Embedding(sparse_grad) which
+    keep the matmul dense-blocked on the MXU only over touched rows."""
+
+    def __init__(self, data_libsvm, data_shape, batch_size, label_libsvm=None,
+                 **kwargs):
+        super().__init__(batch_size)
+        self._num_features = int(data_shape[0] if np.ndim(data_shape) else data_shape)
+        self._labels = []
+        self._rows = []  # list of (indices, values)
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                self._labels.append(float(parts[0]))
+                idx, val = [], []
+                for tok in parts[1:]:
+                    i, v = tok.split(":")
+                    idx.append(int(i))
+                    val.append(float(v))
+                self._rows.append((np.asarray(idx, np.int32),
+                                   np.asarray(val, np.float32)))
+        if label_libsvm is not None:
+            self._labels = [float(l.split()[0]) for l in open(label_libsvm)
+                            if l.strip()]
+        self.reset()
+
+    def reset(self):
+        self._cursor = 0
+
+    def iter_next(self):
+        return self._cursor + self.batch_size <= len(self._rows)
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        from .sparse import CSRNDArray
+
+        rows = self._rows[self._cursor:self._cursor + self.batch_size]
+        labels = self._labels[self._cursor:self._cursor + self.batch_size]
+        self._cursor += self.batch_size
+        indptr = np.zeros(len(rows) + 1, np.int32)
+        for i, (idx, _) in enumerate(rows):
+            indptr[i + 1] = indptr[i] + len(idx)
+        indices = np.concatenate([idx for idx, _ in rows]) if rows else \
+            np.zeros(0, np.int32)
+        values = np.concatenate([v for _, v in rows]) if rows else \
+            np.zeros(0, np.float32)
+        data = CSRNDArray(values, indices, indptr,
+                          (len(rows), self._num_features))
+        return DataBatch([data], [array(np.asarray(labels, np.float32))])

@@ -260,3 +260,65 @@ def test_engine_host_tasks():
         eng.push(lambda i=i: results.append(i), mutable_vars=(v,))
     eng.wait_all()
     assert sorted(results) == list(range(10))
+
+
+def test_image_record_iter_chw(tmp_path):
+    """ImageRecordIter yields (B, C, H, W) float32 after the augmenter
+    pipeline (the augmenters emit HWC; the ITERATOR owns the relayout —
+    regression for the r2 augmenter-contract change)."""
+    from mxnet_tpu import recordio
+    from mxnet_tpu.io import ImageRecordIter
+
+    try:
+        from PIL import Image
+    except Exception:
+        pytest.skip("PIL unavailable")
+    import io as _io
+
+    path = str(tmp_path / "imgs.rec")
+    rec = recordio.MXRecordIO(path, "w")
+    rng = np.random.default_rng(0)
+    for i in range(6):
+        arr = rng.integers(0, 255, (10, 12, 3)).astype(np.uint8)
+        buf = _io.BytesIO()
+        Image.fromarray(arr).save(buf, format="PNG")
+        rec.write(recordio.pack(recordio.IRHeader(0, float(i % 3), i, 0),
+                                buf.getvalue()))
+    rec.close()
+
+    it = ImageRecordIter(path_imgrec=path, data_shape=(3, 8, 8), batch_size=2,
+                         mean_r=10.0, mean_g=10.0, mean_b=10.0)
+    batch = it.next()
+    data = batch.data[0].asnumpy()
+    label = batch.label[0].asnumpy()
+    assert data.shape == (2, 3, 8, 8), data.shape
+    assert data.dtype == np.float32
+    assert label.shape == (2,)
+    n = 1
+    while it.iter_next():
+        it.next()
+        n += 1
+    assert n == 3  # 6 images / batch 2
+
+
+def test_libsvm_iter(tmp_path):
+    from mxnet_tpu.io import LibSVMIter
+
+    p = tmp_path / "train.libsvm"
+    p.write_text("1 0:1.5 3:2.0\n"
+                 "0 1:0.5\n"
+                 "1 2:3.0 3:1.0\n"
+                 "0 0:4.0\n")
+    it = LibSVMIter(data_libsvm=str(p), data_shape=(4,), batch_size=2)
+    b1 = it.next()
+    csr = b1.data[0]
+    assert csr.stype == "csr" and csr.shape == (2, 4)
+    dense = csr.todense().asnumpy()
+    np.testing.assert_allclose(dense, [[1.5, 0, 0, 2.0], [0, 0.5, 0, 0]])
+    np.testing.assert_allclose(b1.label[0].asnumpy(), [1, 0])
+    b2 = it.next()
+    np.testing.assert_allclose(b2.data[0].todense().asnumpy(),
+                               [[0, 0, 3.0, 1.0], [4.0, 0, 0, 0]])
+    assert not it.iter_next()
+    it.reset()
+    assert it.iter_next()

@@ -6,7 +6,8 @@ import numpy as np
 from .ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
-           "ImageRecordIter", "PrefetchingIter", "ResizeIter", "LibSVMIter"]
+           "ImageRecordIter", "PrefetchingIter", "ResizeIter", "LibSVMIter",
+           "ImageDetRecordIter", "pack_det_label"]
 
 
 class DataDesc:
@@ -337,3 +338,83 @@ class LibSVMIter(DataIter):
         data = CSRNDArray(values, indices, indptr,
                           (len(rows), self._num_features))
         return DataBatch([data], [array(np.asarray(labels, np.float32))])
+
+
+class ImageDetRecordIter(DataIter):
+    """Detection record iterator (ref: src/io/iter_image_det_recordio.cc).
+
+    Records are packed with ``recordio.pack``/``pack_img`` using the upstream
+    detection label layout: a flat float array
+    ``[header_width, obj_width, <header pad...>, cls, x1, y1, x2, y2, ...]``
+    with normalized corner coords. Batches pad every image's objects to the
+    batch max (class -1 rows) — static shapes, the TPU contract — and run
+    through CreateDetAugmenter so crops/pads/flips update the boxes.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size, path_imgidx=None,
+                 shuffle=False, rand_crop=0, rand_pad=0, rand_mirror=False,
+                 mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
+                 std_b=1.0, resize=0, rng=None, **kwargs):
+        super().__init__(batch_size)
+        from .image import CreateDetAugmenter
+        from .recordio import MXRecordIO, load_offsets, unpack
+
+        self._rec = MXRecordIO(path_imgrec, "r")
+        self._offsets = load_offsets(self._rec, path_imgidx)
+        self._unpack = unpack
+        self._shuffle = shuffle
+        self._order = np.arange(len(self._offsets))
+        self._augs = CreateDetAugmenter(
+            data_shape, resize=resize, rand_crop=rand_crop, rand_pad=rand_pad,
+            rand_mirror=rand_mirror, mean=(mean_r, mean_g, mean_b),
+            std=(std_r, std_g, std_b), rng=rng)
+        self.reset()
+
+    @staticmethod
+    def _parse_label(flat):
+        flat = np.asarray(flat, np.float32).ravel()
+        hw = int(flat[0])            # header width
+        ow = int(flat[1])            # object width (>= 5)
+        body = flat[hw:]
+        n = len(body) // ow
+        return body[:n * ow].reshape(n, ow)[:, :5]
+
+    def reset(self):
+        if self._shuffle:
+            np.random.shuffle(self._order)
+        self._cursor = 0
+
+    def iter_next(self):
+        return self._cursor + self.batch_size <= len(self._offsets)
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        from .image import imdecode
+
+        datas, labels = [], []
+        for i in self._order[self._cursor:self._cursor + self.batch_size]:
+            header, img_bytes = self._unpack(self._rec.read_at(self._offsets[i]))
+            img = imdecode(img_bytes)
+            label = self._parse_label(header.label)
+            for aug in self._augs:
+                img, label = aug(img, label)
+            a = img.asnumpy() if isinstance(img, NDArray) else np.asarray(img)
+            datas.append(a.transpose(2, 0, 1))
+            labels.append(np.asarray(label, np.float32))
+        self._cursor += self.batch_size
+        max_obj = max(len(l) for l in labels)
+        out = np.full((self.batch_size, max_obj, 5), -1.0, np.float32)
+        for j, l in enumerate(labels):
+            out[j, :len(l)] = l
+        return DataBatch([array(np.stack(datas))], [array(out)])
+
+
+def pack_det_label(boxes, header_width=2):
+    """Boxes (N, 5) [cls, x1, y1, x2, y2] → flat detection label array in
+    the upstream layout (ref: tools/im2rec detection packing)."""
+    boxes = np.asarray(boxes, np.float32).reshape(-1, 5)
+    head = np.zeros(header_width, np.float32)
+    head[0] = header_width
+    head[1] = 5
+    return np.concatenate([head, boxes.ravel()])

@@ -322,3 +322,46 @@ def test_libsvm_iter(tmp_path):
     assert not it.iter_next()
     it.reset()
     assert it.iter_next()
+
+
+def test_image_det_record_iter(tmp_path):
+    """Detection records roundtrip: packed det labels come back padded to
+    the batch max with -1 rows, boxes survive the augmenter pipeline."""
+    from mxnet_tpu import recordio
+    from mxnet_tpu.io import ImageDetRecordIter, pack_det_label
+
+    try:
+        from PIL import Image
+    except Exception:
+        pytest.skip("PIL unavailable")
+    import io as _io
+
+    path = str(tmp_path / "det.rec")
+    rec = recordio.MXRecordIO(path, "w")
+    rng = np.random.default_rng(0)
+    counts = [1, 3, 2, 1]
+    for i, n in enumerate(counts):
+        arr = rng.integers(0, 255, (20, 24, 3)).astype(np.uint8)
+        buf = _io.BytesIO()
+        Image.fromarray(arr).save(buf, format="PNG")
+        base = rng.uniform(0, 0.5, (n, 2)).astype(np.float32)
+        boxes = np.concatenate([np.full((n, 1), i % 3, np.float32),
+                                base, base + 0.3], axis=1)
+        rec.write(recordio.pack(
+            recordio.IRHeader(0, pack_det_label(boxes), i, 0),
+            buf.getvalue()))
+    rec.close()
+
+    it = ImageDetRecordIter(path_imgrec=path, data_shape=(3, 16, 16),
+                            batch_size=2, rand_mirror=True,
+                            rng=np.random.RandomState(0))
+    b = it.next()
+    data = b.data[0].asnumpy()
+    lab = b.label[0].asnumpy()
+    assert data.shape == (2, 3, 16, 16)
+    assert lab.shape[0] == 2 and lab.shape[2] == 5
+    assert lab.shape[1] == 3  # batch max objects
+    # first image had 1 object: rows 1.. are -1 padding
+    assert (lab[0, 1:] == -1).all()
+    valid = lab[lab[:, :, 0] >= 0]
+    assert ((valid[:, 1:] >= -1e-6) & (valid[:, 1:] <= 1 + 1e-6)).all()

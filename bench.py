@@ -94,6 +94,62 @@ def make_batch(rng):
     return tok, tt, vl, mp, mlm_y, nsp_y
 
 
+RESNET_BATCH = 128
+RESNET_BASELINE_IMG_PER_SEC = 2900.0  # MXNet+A100 ResNet-50 (BASELINE.md)
+
+
+def build_resnet():
+    """Secondary bench (BASELINE.md config #1): ResNet-50 ImageNet training
+    throughput — `python bench.py resnet50`."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import _trace, amp
+    from mxnet_tpu.gluon.model_zoo.vision import get_resnet
+    from mxnet_tpu.parallel import tree_optimizer_step
+
+    net = get_resnet(1, 50, classes=1000)
+    net.initialize()
+    # one tiny eager forward materializes deferred param shapes
+    from mxnet_tpu import nd as _nd
+    net(_nd.array(np.zeros((1, 3, 224, 224), np.float32)))
+    amp.convert_hybrid_block(net, "bfloat16")
+    plist = list(net.collect_params().values())
+    opt = mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                           multi_precision=True)
+    init_states, apply_opt = tree_optimizer_step(opt)
+
+    def loss_fn(param_arrays, batch, key):
+        x, y = batch
+        # entry cast: bf16 activations flow the whole trunk (BatchNorm keeps
+        # x's dtype, applying its fp32 stats cast-to-input)
+        x = x.astype(jnp.bfloat16)
+        with _trace.trace_scope(key, True) as t:
+            t.param_store = {id(p): a for p, a in zip(plist, param_arrays)}
+            logits = net._call_traced(x)
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return jnp.mean(-jnp.take_along_axis(lp, y[:, None], axis=-1))
+
+    params = [p.data()._data for p in plist]
+    states = init_states(params)
+
+    @jax.jit
+    def step(params, states, t, key, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, key)
+        new_p, new_s = apply_opt(params, grads, states, jnp.float32(0.1),
+                                 jnp.float32(1e-4), t)
+        return new_p, new_s, loss
+
+    return step, params, states
+
+
+def make_resnet_batch(rng):
+    # fp32 input: amp's block-boundary cast rules put the convs in bf16
+    # against bf16-cast weights (fp32 masters live in the optimizer)
+    x = jnp.asarray(rng.normal(size=(RESNET_BATCH, 3, 224, 224)),
+                    jnp.float32)
+    y = jnp.asarray(rng.integers(0, 1000, (RESNET_BATCH,)), jnp.int32)
+    return x, y
+
+
 def main():
     # Device init over the relay either succeeds in ~seconds, raises
     # UNAVAILABLE, or — worst case — BLOCKS indefinitely (observed: >25 min
@@ -126,9 +182,20 @@ def main():
     _log("devices: %s" % (devs,))
 
     rng = np.random.default_rng(0)
-    _log("building model + train step...")
-    step, params, states = build()
-    batch = make_batch(rng)
+    mode = sys.argv[1] if len(sys.argv) > 1 else "bert"
+    _log("building model + train step (%s)..." % mode)
+    if mode == "resnet50":
+        step, params, states = build_resnet()
+        batch = make_resnet_batch(rng)
+        n_samples, metric, baseline = (
+            RESNET_BATCH, "resnet50_train_images_per_sec_per_chip",
+            RESNET_BASELINE_IMG_PER_SEC)
+    else:
+        step, params, states = build()
+        batch = make_batch(rng)
+        n_samples, metric, baseline = (
+            BATCH, "bert_base_pretrain_samples_per_sec_per_chip",
+            BASELINE_SAMPLES_PER_SEC)
     key = jax.random.PRNGKey(0)
 
     # warmup / compile. NOTE: under the axon relay block_until_ready can
@@ -150,12 +217,12 @@ def main():
     _log("timed %d iters in %.2fs (loss %.4f)" % (iters, dt, final_loss))
     assert np.isfinite(final_loss)
 
-    samples_per_sec = BATCH * iters / dt
+    samples_per_sec = n_samples * iters / dt
     print(json.dumps({
-        "metric": "bert_base_pretrain_samples_per_sec_per_chip",
+        "metric": metric,
         "value": round(samples_per_sec, 2),
         "unit": "samples/s",
-        "vs_baseline": round(samples_per_sec / BASELINE_SAMPLES_PER_SEC, 4),
+        "vs_baseline": round(samples_per_sec / baseline, 4),
     }))
 
 

@@ -158,30 +158,20 @@ class CSVIter(DataIter):
         return self._inner.next()
 
 
-class ImageRecordIter(DataIter):
-    """Image record iterator over .rec files (ref: src/io/iter_image_recordio_2.cc).
-    Decodes with PIL on a prefetch thread; augmentation per image.py."""
+class _RecordIterBase(DataIter):
+    """Shared .rec machinery: lazy byte-offset reads (multi-GB files never
+    load into host memory), shuffle order, cursor. Subclasses provide
+    ``_augment_one(img, label)`` and ``_collate_labels(list)``."""
 
-    def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
-                 shuffle=False, rand_crop=False, rand_mirror=False, mean_r=0.0,
-                 mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0,
-                 resize=0, path_imgidx=None, **kwargs):
+    def __init__(self, path_imgrec, batch_size, shuffle, path_imgidx):
         super().__init__(batch_size)
         from .recordio import MXRecordIO, load_offsets, unpack
 
-        # lazy by byte offset: multi-GB .rec files never load into host memory
         self._rec = MXRecordIO(path_imgrec, "r")
         self._offsets = load_offsets(self._rec, path_imgidx)
         self._unpack = unpack
-        self._shape = data_shape
         self._shuffle = shuffle
         self._order = np.arange(len(self._offsets))
-        from .image import CreateAugmenter
-
-        self._augs = CreateAugmenter(data_shape, resize=resize, rand_crop=rand_crop,
-                                     rand_mirror=rand_mirror,
-                                     mean=(mean_r, mean_g, mean_b),
-                                     std=(std_r, std_g, std_b))
         self.reset()
 
     def reset(self):
@@ -200,16 +190,42 @@ class ImageRecordIter(DataIter):
         datas, labels = [], []
         for i in self._order[self._cursor:self._cursor + self.batch_size]:
             header, img_bytes = self._unpack(self._rec.read_at(self._offsets[i]))
-            img = imdecode(img_bytes)
-            for aug in self._augs:
-                img = aug(img)
+            img, label = self._augment_one(imdecode(img_bytes), header.label)
+            a = img.asnumpy() if isinstance(img, NDArray) else np.asarray(img)
             # augmenters emit HWC float32 (upstream contract); the iterator
             # owns the HWC→CHW relayout
-            datas.append(img.asnumpy().transpose(2, 0, 1))
-            lab = header.label
-            labels.append(np.asarray(lab, np.float32).ravel()[0] if np.ndim(lab) else float(lab))
+            datas.append(a.transpose(2, 0, 1))
+            labels.append(label)
         self._cursor += self.batch_size
-        return DataBatch([array(np.stack(datas))], [array(np.asarray(labels))])
+        return DataBatch([array(np.stack(datas))],
+                         [array(self._collate_labels(labels))])
+
+
+class ImageRecordIter(_RecordIterBase):
+    """Image record iterator over .rec files (ref: src/io/iter_image_recordio_2.cc).
+    Decodes with PIL; augmentation per image.py."""
+
+    def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
+                 shuffle=False, rand_crop=False, rand_mirror=False, mean_r=0.0,
+                 mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0,
+                 resize=0, path_imgidx=None, **kwargs):
+        from .image import CreateAugmenter
+
+        self._augs = CreateAugmenter(data_shape, resize=resize, rand_crop=rand_crop,
+                                     rand_mirror=rand_mirror,
+                                     mean=(mean_r, mean_g, mean_b),
+                                     std=(std_r, std_g, std_b))
+        super().__init__(path_imgrec, batch_size, shuffle, path_imgidx)
+
+    def _augment_one(self, img, label):
+        for aug in self._augs:
+            img = aug(img)
+        scalar = (np.asarray(label, np.float32).ravel()[0]
+                  if np.ndim(label) else float(label))
+        return img, scalar
+
+    def _collate_labels(self, labels):
+        return np.asarray(labels, np.float32)
 
 
 class PrefetchingIter(DataIter):
@@ -340,35 +356,30 @@ class LibSVMIter(DataIter):
         return DataBatch([data], [array(np.asarray(labels, np.float32))])
 
 
-class ImageDetRecordIter(DataIter):
+class ImageDetRecordIter(_RecordIterBase):
     """Detection record iterator (ref: src/io/iter_image_det_recordio.cc).
 
     Records are packed with ``recordio.pack``/``pack_img`` using the upstream
     detection label layout: a flat float array
     ``[header_width, obj_width, <header pad...>, cls, x1, y1, x2, y2, ...]``
-    with normalized corner coords. Batches pad every image's objects to the
-    batch max (class -1 rows) — static shapes, the TPU contract — and run
-    through CreateDetAugmenter so crops/pads/flips update the boxes.
+    with normalized corner coords. Labels come back (B, K, 5) padded with
+    class -1 rows; pass ``label_pad_width`` to make K FIXED across batches
+    (the TPU contract — a varying per-batch max would recompile a jitted
+    consumer on every new object count). Default: per-batch max, min 1.
     """
 
     def __init__(self, path_imgrec, data_shape, batch_size, path_imgidx=None,
                  shuffle=False, rand_crop=0, rand_pad=0, rand_mirror=False,
                  mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
-                 std_b=1.0, resize=0, rng=None, **kwargs):
-        super().__init__(batch_size)
+                 std_b=1.0, resize=0, label_pad_width=None, rng=None, **kwargs):
         from .image import CreateDetAugmenter
-        from .recordio import MXRecordIO, load_offsets, unpack
 
-        self._rec = MXRecordIO(path_imgrec, "r")
-        self._offsets = load_offsets(self._rec, path_imgidx)
-        self._unpack = unpack
-        self._shuffle = shuffle
-        self._order = np.arange(len(self._offsets))
         self._augs = CreateDetAugmenter(
             data_shape, resize=resize, rand_crop=rand_crop, rand_pad=rand_pad,
             rand_mirror=rand_mirror, mean=(mean_r, mean_g, mean_b),
             std=(std_r, std_g, std_b), rng=rng)
-        self.reset()
+        self._label_pad_width = label_pad_width
+        super().__init__(path_imgrec, batch_size, shuffle, path_imgidx)
 
     @staticmethod
     def _parse_label(flat):
@@ -379,35 +390,22 @@ class ImageDetRecordIter(DataIter):
         n = len(body) // ow
         return body[:n * ow].reshape(n, ow)[:, :5]
 
-    def reset(self):
-        if self._shuffle:
-            np.random.shuffle(self._order)
-        self._cursor = 0
+    def _augment_one(self, img, label):
+        label = self._parse_label(label)
+        for aug in self._augs:
+            img, label = aug(img, label)
+        return img, np.asarray(label, np.float32)
 
-    def iter_next(self):
-        return self._cursor + self.batch_size <= len(self._offsets)
-
-    def next(self):
-        if not self.iter_next():
-            raise StopIteration
-        from .image import imdecode
-
-        datas, labels = [], []
-        for i in self._order[self._cursor:self._cursor + self.batch_size]:
-            header, img_bytes = self._unpack(self._rec.read_at(self._offsets[i]))
-            img = imdecode(img_bytes)
-            label = self._parse_label(header.label)
-            for aug in self._augs:
-                img, label = aug(img, label)
-            a = img.asnumpy() if isinstance(img, NDArray) else np.asarray(img)
-            datas.append(a.transpose(2, 0, 1))
-            labels.append(np.asarray(label, np.float32))
-        self._cursor += self.batch_size
-        max_obj = max(len(l) for l in labels)
-        out = np.full((self.batch_size, max_obj, 5), -1.0, np.float32)
+    def _collate_labels(self, labels):
+        width = self._label_pad_width or max(1, max(len(l) for l in labels))
+        out = np.full((len(labels), width, 5), -1.0, np.float32)
         for j, l in enumerate(labels):
+            if len(l) > width:
+                raise ValueError(
+                    "record has %d objects > label_pad_width=%d" %
+                    (len(l), width))
             out[j, :len(l)] = l
-        return DataBatch([array(np.stack(datas))], [array(out)])
+        return out
 
 
 def pack_det_label(boxes, header_width=2):

@@ -528,9 +528,10 @@ def FullyConnected(x, weight, bias=None, *, num_hidden=None, no_bias=False, flat
             "mismatch)" % (weight.shape[0], num_hidden))
     if flatten and x.ndim > 2:
         x = jnp.reshape(x, (x.shape[0], -1))
+    weight = weight.astype(x.dtype)  # compute in the input's dtype (AMP)
     y = jnp.matmul(x, weight.T)
     if bias is not None and not no_bias:
-        y = y + bias
+        y = y + bias.astype(y.dtype)  # fp32 bias must not re-widen bf16 y
     return y
 
 
@@ -557,15 +558,17 @@ def Convolution(x, weight, bias=None, *, kernel=None, stride=1, pad=0, dilate=1,
     spatial = "DHW"[-nd:] if nd <= 3 else None
     lhs = "NC" + spatial
     rhs = "OI" + spatial
+    weight = weight.astype(x.dtype)  # compute in the input's dtype (AMP)
     dn = lax.conv_dimension_numbers(x.shape, weight.shape, (lhs, rhs, lhs))
+    # NOTE: no preferred_element_type here — the TPU MXU accumulates bf16
+    # convs in fp32 natively, and jax's conv transpose rule mishandles the
+    # widened fp32 output under reverse AD (fp32 cotangent vs bf16 operand)
     y = lax.conv_general_dilated(
         x, weight, window_strides=stride, padding=[(p, p) for p in pad],
         rhs_dilation=dilate, dimension_numbers=dn, feature_group_count=num_group,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
     )
-    y = y.astype(x.dtype)
     if bias is not None and not no_bias:
-        y = y + bias.reshape((1, -1) + (1,) * nd)
+        y = y + bias.astype(x.dtype).reshape((1, -1) + (1,) * nd)
     return y
 
 
@@ -585,12 +588,12 @@ def Deconvolution(x, weight, bias=None, *, kernel=None, stride=1, pad=0, dilate=
     k = weight.shape[2:]
     padding = [(ki - 1 - p, ki - 1 - p + a) for ki, p, a in zip(k, pad, adj)]
     y = lax.conv_general_dilated(
-        x, jnp.flip(weight, axis=tuple(range(2, 2 + nd))),
+        x, jnp.flip(weight.astype(x.dtype), axis=tuple(range(2, 2 + nd))),
         window_strides=(1,) * nd, padding=padding, lhs_dilation=stride,
         dimension_numbers=dn, feature_group_count=num_group,
     )
     if bias is not None and not no_bias:
-        y = y + bias.reshape((1, -1) + (1,) * nd)
+        y = y + bias.astype(x.dtype).reshape((1, -1) + (1,) * nd)
     return y
 
 
@@ -636,17 +639,23 @@ def BatchNorm(x, gamma, beta, moving_mean, moving_var, *, eps=1e-5, momentum=0.9
     shape[axis] = x.shape[axis]
     shape = tuple(shape)
     red = tuple(i for i in range(x.ndim) if i != axis)
+    # normalize entirely in fp32 with ONE cast boundary at input and output:
+    # bf16-in → bf16-out AND bf16 cotangents. (Mixing per-factor casts made
+    # jnp.var's fp32 accumulation leak an fp32 cotangent into bf16 inputs,
+    # blowing up conv transpose rules under AMP.)
+    xf = x.astype(jnp.float32)
     if training and not use_global_stats:
-        m = jnp.mean(x, axis=red)
-        v = jnp.var(x, axis=red)
+        m = jnp.mean(xf, axis=red)
+        v = jnp.var(xf, axis=red)
         new_mean = momentum * moving_mean + (1 - momentum) * m
         new_var = momentum * moving_var + (1 - momentum) * v
     else:
-        m, v = moving_mean, moving_var
+        m, v = moving_mean.astype(jnp.float32), moving_var.astype(jnp.float32)
         new_mean, new_var = moving_mean, moving_var
-    inv = lax.rsqrt(v.astype(jnp.float32) + eps).astype(x.dtype)
-    y = (x - m.reshape(shape).astype(x.dtype)) * inv.reshape(shape) * gamma.reshape(shape).astype(x.dtype) \
-        + beta.reshape(shape).astype(x.dtype)
+    inv = lax.rsqrt(v + eps)
+    y = ((xf - m.reshape(shape)) * inv.reshape(shape)
+         * gamma.reshape(shape).astype(jnp.float32)
+         + beta.reshape(shape).astype(jnp.float32)).astype(x.dtype)
     return y, lax.stop_gradient(new_mean), lax.stop_gradient(new_var)
 
 

@@ -365,3 +365,33 @@ def test_image_det_record_iter(tmp_path):
     assert (lab[0, 1:] == -1).all()
     valid = lab[lab[:, :, 0] >= 0]
     assert ((valid[:, 1:] >= -1e-6) & (valid[:, 1:] <= 1 + 1e-6)).all()
+
+
+def test_image_det_record_iter_fixed_pad(tmp_path):
+    """label_pad_width fixes the label shape across batches (jit contract)."""
+    from mxnet_tpu import recordio
+    from mxnet_tpu.io import ImageDetRecordIter, pack_det_label
+
+    try:
+        from PIL import Image
+    except Exception:
+        pytest.skip("PIL unavailable")
+    import io as _io
+
+    path = str(tmp_path / "det2.rec")
+    rec = recordio.MXRecordIO(path, "w")
+    rng = np.random.default_rng(1)
+    for i, n in enumerate([1, 4, 2, 1]):
+        arr = rng.integers(0, 255, (16, 16, 3)).astype(np.uint8)
+        buf = _io.BytesIO()
+        Image.fromarray(arr).save(buf, format="PNG")
+        base = rng.uniform(0, 0.5, (n, 2)).astype(np.float32)
+        boxes = np.concatenate([np.zeros((n, 1), np.float32),
+                                base, base + 0.3], axis=1)
+        rec.write(recordio.pack(recordio.IRHeader(0, pack_det_label(boxes),
+                                                  i, 0), buf.getvalue()))
+    rec.close()
+    it = ImageDetRecordIter(path_imgrec=path, data_shape=(3, 8, 8),
+                            batch_size=2, label_pad_width=6)
+    shapes = {tuple(it.next().label[0].shape) for _ in range(2)}
+    assert shapes == {(2, 6, 5)}

@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Multi-process launcher (ref: tools/launch.py — upstream spawns ps-lite
+servers/workers over ssh; TPU-natively each process is a jax.distributed
+participant and XLA collectives replace the parameter server).
+
+Local mode (-n workers on this host, e.g. to exercise the DCN code path on
+CPU, or one process per TPU host when run under a cluster scheduler):
+
+    python tools/launch.py -n 4 python examples/train_bert_distributed.py
+
+Each worker gets the ps-lite env contract upstream's launcher uses
+(DMLC_PS_ROOT_URI / DMLC_PS_ROOT_PORT / DMLC_NUM_WORKER / DMLC_WORKER_ID);
+scripts join the runtime with mxnet_tpu.parallel.distributed.
+init_process_group(), which reads exactly those variables — 1.x launch
+scripts port unchanged.
+"""
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("--coordinator", default=None,
+                    help="host:port (default: 127.0.0.1:<free port>)")
+    ap.add_argument("--env", action="append", default=[],
+                    help="extra KEY=VALUE for every worker")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    if not args.command:
+        ap.error("no command given")
+
+    coord = args.coordinator or ("127.0.0.1:%d" % _free_port())
+    procs = []
+    for rank in range(args.num_workers):
+        env = dict(os.environ)
+        host, _, port = coord.rpartition(":")
+        env["DMLC_PS_ROOT_URI"] = host
+        env["DMLC_PS_ROOT_PORT"] = port
+        env["DMLC_NUM_WORKER"] = str(args.num_workers)
+        env["DMLC_WORKER_ID"] = str(rank)
+        env["DMLC_ROLE"] = "worker"
+        for kv in args.env:
+            k, _, v = kv.partition("=")
+            env[k] = v
+        procs.append(subprocess.Popen(args.command, env=env))
+
+    rc = 0
+    try:
+        for p in procs:
+            rc = p.wait() or rc
+    except KeyboardInterrupt:
+        for p in procs:
+            p.terminate()
+        rc = 130
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

@@ -167,6 +167,11 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
     (ref: python/mxnet/autograd.py:grad)."""
     from .ndarray import NDArray
 
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (higher-order grad through the imperative "
+            "tape) is not supported; compose jax.grad over a hybridized "
+            "function for higher-order derivatives")
     if isinstance(variables, NDArray):
         variables = [variables]
     saved = [(getattr(v, "_grad", None), getattr(v, "_grad_req", "write")) for v in variables]

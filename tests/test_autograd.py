@@ -95,3 +95,20 @@ def test_multi_output_op_grads():
     y.backward()
     g = x.grad.asnumpy()
     assert (g[:, :3] == 2).all() and (g[:, 3:] == 3).all()
+
+
+def test_grad_function_and_create_graph_raises():
+    import pytest as _pytest
+
+    from mxnet_tpu import autograd, nd
+
+    x = nd.array(np.array([2.0, 3.0], np.float32))
+    with autograd.record():
+        y = (x * x * x).sum()
+    g = autograd.grad(y, [x])
+    np.testing.assert_allclose(g[0].asnumpy(), 3 * np.array([4.0, 9.0]),
+                               rtol=1e-6)
+    with autograd.record():
+        y = (x * x).sum()
+    with _pytest.raises(NotImplementedError, match="higher-order"):
+        autograd.grad(y, [x], create_graph=True)

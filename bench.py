@@ -8,6 +8,7 @@ approximates with fused optimizer kernels + CachedOp; see SURVEY.md §3.4).
 
 Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
+import functools
 import json
 import os
 import sys
@@ -74,7 +75,9 @@ def build():
     params = [p.data()._data for p in plist]
     states = init_states(params)
 
-    @jax.jit
+    # donate params+opt state: step i+1 overwrites step i's buffers in place
+    # instead of allocating a second copy of every weight/moment in HBM
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, states, t, key, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch, key)
         new_p, new_s = apply_opt(params, grads, states, jnp.float32(1e-4),
@@ -131,7 +134,7 @@ def build_resnet():
     params = [p.data()._data for p in plist]
     states = init_states(params)
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, states, t, key, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch, key)
         new_p, new_s = apply_opt(params, grads, states, jnp.float32(0.1),

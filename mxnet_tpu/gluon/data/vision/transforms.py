@@ -12,6 +12,7 @@ from ....ndarray import NDArray, array
 from ...block import Block
 
 __all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "CropResize",
            "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
            "RandomBrightness", "RandomContrast", "RandomSaturation",
            "RandomHue", "RandomColorJitter", "RandomLighting", "RandomGray"]
@@ -83,6 +84,24 @@ class CenterCrop:
         x0 = max((w - tw) // 2, 0)
         y0 = max((h - th) // 2, 0)
         return array(a[y0:y0 + th, x0:x0 + tw])
+
+
+class CropResize:
+    """Crop the region (x, y, width, height) and optionally resize to ``size``
+    (ref: gluon/data/vision/transforms.py CropResize)."""
+
+    def __init__(self, x, y, width, height, size=None, interpolation=1):
+        self._box = (x, y, width, height)
+        self._size = ((size, size) if isinstance(size, int) else size) \
+            if size is not None else None
+
+    def __call__(self, img):
+        a = _np(img)
+        x0, y0, w, h = self._box
+        a = a[y0:y0 + h, x0:x0 + w]
+        if self._size is not None:
+            a = _resize(a, self._size)
+        return array(a)
 
 
 class RandomResizedCrop:

@@ -22,12 +22,16 @@ def get_model(name, **kwargs):
         "vgg19": vgg.vgg19, "vgg11_bn": vgg.vgg11_bn, "vgg13_bn": vgg.vgg13_bn,
         "vgg16_bn": vgg.vgg16_bn, "vgg19_bn": vgg.vgg19_bn,
         "alexnet": alexnet.alexnet,
-        "mobilenet1.0": mobilenet.mobilenet1_0, "mobilenet0.5": mobilenet.mobilenet0_5,
-        "mobilenet0.25": mobilenet.mobilenet0_25,
+        "mobilenet1.0": mobilenet.mobilenet1_0, "mobilenet0.75": mobilenet.mobilenet0_75,
+        "mobilenet0.5": mobilenet.mobilenet0_5, "mobilenet0.25": mobilenet.mobilenet0_25,
         "mobilenetv2_1.0": mobilenet.mobilenet_v2_1_0,
+        "mobilenetv2_0.75": mobilenet.mobilenet_v2_0_75,
+        "mobilenetv2_0.5": mobilenet.mobilenet_v2_0_5,
+        "mobilenetv2_0.25": mobilenet.mobilenet_v2_0_25,
         "squeezenet1.0": squeezenet.squeezenet1_0,
         "squeezenet1.1": squeezenet.squeezenet1_1,
-        "densenet121": densenet.densenet121, "densenet169": densenet.densenet169,
+        "densenet121": densenet.densenet121, "densenet161": densenet.densenet161,
+        "densenet169": densenet.densenet169, "densenet201": densenet.densenet201,
         "inceptionv3": inception.inception_v3,
     }
     if name.lower() not in registry:

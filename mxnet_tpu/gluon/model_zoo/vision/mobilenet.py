@@ -108,5 +108,13 @@ def mobilenet_v2_1_0(**kw):
     return MobileNetV2(1.0, **kw)
 
 
+def mobilenet_v2_0_75(**kw):
+    return MobileNetV2(0.75, **kw)
+
+
 def mobilenet_v2_0_5(**kw):
     return MobileNetV2(0.5, **kw)
+
+
+def mobilenet_v2_0_25(**kw):
+    return MobileNetV2(0.25, **kw)

@@ -81,10 +81,12 @@ def resume(profile_process="worker"):
     start()
 
 
-def _record(name, ts_us, dur_ms, cat="host"):
+def _record(name, ts_us, dur_ms=None, cat="host", ph="X", **extra):
+    rec = {"name": name, "ts_us": ts_us, "cat": cat, "ph": ph, **extra}
+    if dur_ms is not None:
+        rec["dur_ms"] = dur_ms
     with _lock:
-        _records.append({"name": name, "ts_us": ts_us, "dur_ms": dur_ms,
-                         "cat": cat})
+        _records.append(rec)
 
 
 def aggregate():
@@ -93,6 +95,8 @@ def aggregate():
     with _lock:
         recs = list(_records)
     for r in recs:
+        if r.get("ph", "X") != "X":
+            continue  # counters/markers have no duration to aggregate
         s = stats.setdefault(r["name"], {"count": 0, "total_ms": 0.0,
                                          "min_ms": float("inf"), "max_ms": 0.0})
         s["count"] += 1
@@ -128,10 +132,19 @@ def dumps(reset=False):
 def dump(finished=True, profile_process="worker"):
     """Write Chrome trace-event JSON (the format MXNet's profiler.dump
     produces; open in chrome://tracing or Perfetto)."""
+    events = []
     with _lock:
-        events = [{"name": r["name"], "cat": r.get("cat", "host"), "ph": "X",
-                   "ts": r["ts_us"], "dur": r["dur_ms"] * 1e3,
-                   "pid": os.getpid(), "tid": 0} for r in _records]
+        for r in _records:
+            ev = {"name": r["name"], "cat": r.get("cat", "host"),
+                  "ph": r.get("ph", "X"), "ts": r["ts_us"],
+                  "pid": os.getpid(), "tid": 0}
+            if ev["ph"] == "X":
+                ev["dur"] = r["dur_ms"] * 1e3
+            elif ev["ph"] == "C":
+                ev["args"] = {r["name"]: r["value"]}
+            elif ev["ph"] == "i":
+                ev["s"] = r.get("s", "g")
+            events.append(ev)
     with open(_config["filename"], "w") as f:
         json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
     return _config["filename"]
@@ -158,9 +171,33 @@ def op_scope(name):
     _record(name, (t0 - _epoch) * 1e6, (t1 - t0) * 1e3, cat="operator")
 
 
+class Domain:
+    """Named grouping for profiler objects (ref: python/mxnet/profiler.py
+    Domain). Maps to the trace-event ``cat`` field."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def new_task(self, name):
+        return Task(self, name)
+
+    def new_frame(self, name):
+        return Frame(self, name)
+
+    def new_event(self, name):
+        return Event(self, name)
+
+    def new_counter(self, name, value=None):
+        return Counter(self, name, value)
+
+    def new_marker(self, name):
+        return Marker(self, name)
+
+
 class Task:
     def __init__(self, domain=None, name="task"):
         self.name = name
+        self._cat = domain.name if isinstance(domain, Domain) else "host"
         self._t0 = None
 
     def start(self):
@@ -170,9 +207,69 @@ class Task:
         if self._t0 is not None:
             t1 = time.perf_counter()
             _record(self.name, (self._t0 - _epoch) * 1e6,
-                    (t1 - self._t0) * 1e3)
+                    (t1 - self._t0) * 1e3, cat=self._cat)
+            self._t0 = None
 
 
 Frame = Task
 Event = Task
-Counter = Task
+
+
+class Counter:
+    """Numeric counter emitted as Chrome trace 'C' events (ref: profiler.cc
+    ProfileCounter). dump() renders these as a value-over-time track."""
+
+    def __init__(self, domain=None, name="counter", value=None):
+        self.name = name
+        self._cat = domain.name if isinstance(domain, Domain) else "host"
+        self._value = 0
+        self._vlock = threading.Lock()
+        if value is not None:
+            self.set_value(value)
+
+    def set_value(self, value):
+        with self._vlock:
+            self._value = value
+        _record(self.name, (time.perf_counter() - _epoch) * 1e6,
+                cat=self._cat, ph="C", value=value)
+
+    def _add(self, delta):
+        with self._vlock:
+            self._value += delta
+            value = self._value
+        _record(self.name, (time.perf_counter() - _epoch) * 1e6,
+                cat=self._cat, ph="C", value=value)
+
+    def increment(self, delta=1):
+        self._add(delta)
+
+    def decrement(self, delta=1):
+        self._add(-delta)
+
+    def __iadd__(self, delta):
+        self.increment(delta)
+        return self
+
+    def __isub__(self, delta):
+        self.decrement(delta)
+        return self
+
+
+class Marker:
+    """Instant event (ref: profiler.cc ProfileMarker)."""
+
+    def __init__(self, domain=None, name="marker"):
+        self.name = name
+        self._cat = domain.name if isinstance(domain, Domain) else "host"
+
+    def mark(self, scope="process"):
+        _record(self.name, (time.perf_counter() - _epoch) * 1e6,
+                cat=self._cat, ph="i",
+                s={"process": "p", "thread": "t"}.get(scope, "g"))
+
+
+# MXNET_PROFILER_AUTOSTART parity: begin tracing at import when requested
+# (truthy values only — 'false'/'off'/'no' mean off, like upstream's int check).
+if os.environ.get("MXNET_PROFILER_AUTOSTART", "0").lower() in ("1", "true", "yes", "on"):
+    _config["profile_all"] = True
+    start()

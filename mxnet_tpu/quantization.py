@@ -19,7 +19,7 @@ from .ndarray import NDArray
 
 __all__ = ["quantize", "dequantize", "quantized_fully_connected",
            "quantized_conv", "QuantizedDense", "QuantizedConv2D",
-           "quantize_model"]
+           "quantize_model", "calibrate_model"]
 
 
 @register_op("contrib_quantize", nondiff=True, n_outputs=2)
@@ -41,11 +41,20 @@ def dequantize(q, scale):
     return q.astype(jnp.float32) * scale
 
 
+def _quantize_act(x, x_scale):
+    """Dynamic (x_scale=None) or static (calibrated scale) int8 activations."""
+    if x_scale is None:
+        return quantize(x)
+    qx = jnp.clip(jnp.round(x / x_scale), -127, 127).astype(jnp.int8)
+    return qx, x_scale
+
+
 @register_op("quantized_fully_connected", nondiff=True)
-def quantized_fully_connected(x, qweight, w_scale, bias=None):
-    """x fp → dynamic int8; int8×int8 matmul accumulated in int32 on the MXU.
+def quantized_fully_connected(x, qweight, w_scale, bias=None, *, x_scale=None):
+    """x fp → int8 (dynamic per-tensor, or static when a calibrated x_scale is
+    given); int8×int8 matmul accumulated in int32 on the MXU.
     qweight: (out, in) int8; w_scale: (out, 1) fp32."""
-    qx, x_scale = quantize(x)
+    qx, x_scale = _quantize_act(x, x_scale)
     acc = jax.lax.dot_general(
         qx, qweight, (((qx.ndim - 1,), (1,)), ((), ())),
         preferred_element_type=jnp.int32)
@@ -57,16 +66,17 @@ def quantized_fully_connected(x, qweight, w_scale, bias=None):
 
 @register_op("quantized_conv", nondiff=True)
 def quantized_conv(x, qweight, w_scale, bias=None, *, stride=1, pad=0, dilate=1,
-                   num_group=1):
+                   num_group=1, x_scale=None):
     """int8 convolution (ref: src/operator/quantization/quantized_conv.cc —
-    the cuDNN int8x4 path). Dynamic per-tensor int8 activations ×
-    per-output-channel int8 weights, int32 accumulation on the MXU, fp32
-    rescale. qweight: (O, I, *K) int8; w_scale: (O, 1, 1, ...) fp32."""
+    the cuDNN int8x4 path). Per-tensor int8 activations (dynamic or
+    calibrated-static) × per-output-channel int8 weights, int32 accumulation
+    on the MXU, fp32 rescale. qweight: (O, I, *K) int8; w_scale: (O, 1, 1, ...)
+    fp32."""
     from .ops.functional import _pair
 
     nd = x.ndim - 2
     stride, pad, dilate = _pair(stride, nd), _pair(pad, nd), _pair(dilate, nd)
-    qx, x_scale = quantize(x)
+    qx, x_scale = _quantize_act(x, x_scale)
     spatial = "DHW"[-nd:]
     lhs = "NC" + spatial
     dn = jax.lax.conv_dimension_numbers(x.shape, qweight.shape,
@@ -82,6 +92,93 @@ def quantized_conv(x, qweight, w_scale, bias=None, *, stride=1, pad=0, dilate=1,
     return y
 
 
+class _LayerCollector:
+    """Records input-activation statistics during calibration forwards
+    (ref: contrib/quantization.py _LayerOutputMinMaxCollector /
+    _LayerHistogramCollector)."""
+
+    def __init__(self, mode="naive", num_bins=8001):
+        import numpy as np
+
+        self.mode = mode
+        self.num_bins = num_bins
+        self.amax = 0.0
+        self.hist = None          # allocated in pass 2 (entropy mode)
+        self.phase = 1
+
+    def collect(self, x):
+        import numpy as np
+
+        if isinstance(x, NDArray):
+            a = x.asnumpy()
+        else:
+            a = np.asarray(x)
+        a = np.abs(a.astype(np.float32)).ravel()
+        if self.phase == 1:
+            self.amax = max(self.amax, float(a.max(initial=0.0)))
+        else:
+            h, _ = np.histogram(a, bins=self.num_bins, range=(0.0, self.amax))
+            self.hist = h if self.hist is None else self.hist + h
+
+    def threshold(self):
+        if self.mode == "naive" or self.hist is None:
+            return self.amax
+        return _optimal_threshold(self.hist, self.amax)
+
+
+def _smooth_distribution(d, eps=1e-4):
+    """Move eps mass onto zero entries so KL stays finite (ref:
+    contrib/quantization.py _smooth_distribution)."""
+    import numpy as np
+
+    is_zero = d == 0
+    n_zero = int(is_zero.sum())
+    n_nonzero = d.size - n_zero
+    if n_zero == 0 or n_nonzero == 0:
+        return d
+    eps1 = eps * n_zero / n_nonzero
+    # floor at eps so entries smaller than the deducted mass stay positive
+    return np.where(is_zero, eps, np.maximum(d - eps1 * (d > 0), eps))
+
+
+def _optimal_threshold(hist, amax, num_quantized_bins=255):
+    """KL-divergence-minimizing clip threshold (ref: contrib/quantization.py
+    _get_optimal_threshold, the TensorRT entropy-calibration scheme). For each
+    candidate threshold: the reference distribution p is the clipped histogram
+    with the clipped-away outlier mass folded into its edge bin; q is the
+    255-level quantization of the UNFOLDED clipped histogram — so clipping
+    cost appears as p/q divergence at the edge rather than being free."""
+    import numpy as np
+
+    num_bins = hist.size
+    if amax <= 0 or hist.sum() == 0:
+        return amax
+    best_kl, best_i = np.inf, num_bins
+    hist = hist.astype(np.float64)
+    for i in range(num_quantized_bins, num_bins + 1,
+                   max(1, (num_bins - num_quantized_bins) // 128)):
+        sliced = hist[:i]
+        if sliced.sum() == 0:
+            continue
+        p = sliced.copy()
+        p[-1] += hist[i:].sum()             # reference keeps the clipped mass
+        # quantize the clipped histogram into 255 coarse bins, spreading each
+        # coarse bin's mass uniformly over its NONZERO fine bins
+        idx = (np.arange(i) * num_quantized_bins // i).clip(
+            0, num_quantized_bins - 1)
+        q_coarse = np.bincount(idx, weights=sliced, minlength=num_quantized_bins)
+        nz = (sliced != 0).astype(np.float64)
+        nz_count = np.bincount(idx, weights=nz, minlength=num_quantized_bins)
+        q = np.where(nz > 0,
+                     q_coarse[idx] / np.maximum(nz_count[idx], 1.0), 0.0)
+        p = _smooth_distribution(p / p.sum())
+        q = _smooth_distribution(q / max(q.sum(), 1e-12))
+        kl = float(np.sum(p * np.log(p / q)))
+        if kl < best_kl:
+            best_kl, best_i = kl, i
+    return amax * best_i / num_bins
+
+
 class QuantizedDense(HybridBlock):
     """Inference-only Dense with pre-quantized int8 weights."""
 
@@ -95,12 +192,17 @@ class QuantizedDense(HybridBlock):
                       if hasattr(dense, "bias") and dense.bias is not None else None)
         self._flatten = dense._flatten
         self._act = dense.act
+        self._x_scale = None      # static activation scale after calibration
+        self._collector = None
 
     def hybrid_forward(self, F, x):
         if self._flatten:
             x = F.flatten(x)  # Dense(flatten=True) semantics, e.g. pooled NCHW
+        if self._collector is not None:
+            self._collector.collect(x)
         # raw jnp weights pass through both facades unchanged
-        y = F.quantized_fully_connected(x, self._qw, self._ws, self._bias)
+        y = F.quantized_fully_connected(x, self._qw, self._ws, self._bias,
+                                        x_scale=self._x_scale)
         if self._act is not None:
             y = self._act(y)
         return y
@@ -122,18 +224,71 @@ class QuantizedConv2D(HybridBlock):
         self._conv_kw = dict(stride=k["stride"], pad=k["pad"], dilate=k["dilate"],
                              num_group=k["num_group"])
         self._act = conv.act
+        self._x_scale = None
+        self._collector = None
 
     def hybrid_forward(self, F, x):
-        y = F.quantized_conv(x, self._qw, self._ws, self._bias, **self._conv_kw)
+        if self._collector is not None:
+            self._collector.collect(x)
+        y = F.quantized_conv(x, self._qw, self._ws, self._bias,
+                             x_scale=self._x_scale, **self._conv_kw)
         if self._act is not None:
             y = self._act(y)
         return y
 
 
-def quantize_model(block, exclude=()):
+def _quantized_layers(block, out):
+    for child in block._children.values():
+        if isinstance(child, (QuantizedDense, QuantizedConv2D)):
+            out.append(child)
+        else:
+            _quantized_layers(child, out)
+    return out
+
+
+def calibrate_model(block, calib_data, mode="naive", num_bins=8001):
+    """Freeze static activation scales from calibration batches (ref:
+    contrib/quantization.py calib_mode='naive'|'entropy').
+
+    ``calib_data``: iterable of input batches (materialized to a list so
+    entropy's second histogram pass sees the same batches); each element is
+    the net's positional input (or a tuple of them). Runs imperatively —
+    calibrate BEFORE hybridize()."""
+    if mode not in ("naive", "entropy"):
+        raise ValueError("calib mode must be 'naive' or 'entropy', got %r" % (mode,))
+    calib_data = list(calib_data)
+    if not calib_data:
+        raise ValueError("calib_data is empty — zero calibration batches "
+                         "would freeze degenerate activation scales")
+    layers = _quantized_layers(block, [])
+    if not layers:
+        return block
+    for l in layers:
+        l._collector = _LayerCollector(mode, num_bins)
+        l._x_scale = None         # dynamic during calibration forwards
+
+    def _run():
+        for batch in calib_data:
+            block(*batch) if isinstance(batch, tuple) else block(batch)
+
+    _run()                        # pass 1: amax
+    if mode == "entropy":
+        for l in layers:
+            l._collector.phase = 2
+        _run()                    # pass 2: histograms over [0, amax]
+    for l in layers:
+        t = l._collector.threshold()
+        l._x_scale = max(t, 1e-8) / 127.0
+        l._collector = None
+    return block
+
+
+def quantize_model(block, exclude=(), calib_mode="none", calib_data=None,
+                   num_bins=8001):
     """Replace Dense/Conv2D children with their int8 twins (in place),
-    skipping names matching any substring in `exclude` (ref:
-    contrib/quantization.py:quantize_model)."""
+    skipping names matching any substring in `exclude`; optionally calibrate
+    static activation ranges (ref: contrib/quantization.py:quantize_model —
+    calib_mode none/naive/entropy)."""
     from .gluon.nn.conv_layers import Conv2D
 
     for name, child in list(block._children.items()):
@@ -148,5 +303,9 @@ def quantize_model(block, exclude=()):
             if hasattr(block, name):
                 object.__setattr__(block, name, q)
         else:
-            quantize_model(child, exclude)
+            quantize_model(child, exclude, calib_mode="none")
+    if calib_mode != "none":
+        if calib_data is None:
+            raise ValueError("calib_mode=%r requires calib_data" % (calib_mode,))
+        calibrate_model(block, calib_data, mode=calib_mode, num_bins=num_bins)
     return block

@@ -270,3 +270,17 @@ def test_vision_transforms_jitter_family():
         T.ToTensor(),
     ])
     np.testing.assert_array_equal(tf2(_img(30, 40)).asnumpy(), a)
+
+
+def test_crop_resize_transform():
+    """transforms.CropResize crop-box then resize semantics (ref:
+    gluon/data/vision/transforms.py CropResize)."""
+    import numpy as np
+
+    from mxnet_tpu.gluon.data.vision import transforms
+
+    img = np.arange(20 * 30 * 3, dtype=np.uint8).reshape(20, 30, 3)
+    out = transforms.CropResize(5, 2, 10, 8)(img).asnumpy()
+    np.testing.assert_array_equal(out, img[2:10, 5:15])
+    assert transforms.CropResize(5, 2, 10, 8, size=(20, 16))(img).shape \
+        == (16, 20, 3)

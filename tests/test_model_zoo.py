@@ -115,3 +115,44 @@ def test_densenet_backward_finite():
                for p in net.collect_params().values()
                if p.grad_req != "null")
     assert np.isfinite(gsum) and gsum > 0
+
+
+def test_get_model_registry_breadth():
+    """Every upstream get_model name family resolves (width/depth variants
+    upstream's model_store lists; ref: model_zoo/vision/__init__.py)."""
+    names = ["resnet50_v2", "mobilenet0.75", "mobilenetv2_0.75",
+             "mobilenetv2_0.5", "mobilenetv2_0.25", "densenet161",
+             "densenet201", "vgg19_bn"]
+    for n in names:
+        net = get_model(n, classes=5)
+        assert net is not None
+    with pytest.raises(ValueError):
+        get_model("not_a_model")
+
+
+def test_profiler_counter_marker_domain(tmp_path, monkeypatch):
+    """Domain/Counter/Marker parity (ref: python/mxnet/profiler.py)."""
+    import json
+
+    from mxnet_tpu import profiler
+
+    monkeypatch.setitem(profiler._config, "filename", str(tmp_path / "p.json"))
+    d = profiler.Domain("dom")
+    t = d.new_task("t")
+    t.start()
+    t.stop()
+    c = d.new_counter("ctr", 10)
+    c.increment(5)
+    c.decrement(3)
+    c += 1
+    m = d.new_marker("mk")
+    m.mark("process")
+    profiler.dump()
+    ev = json.load(open(profiler._config["filename"]))["traceEvents"]
+    counts = [e for e in ev if e["ph"] == "C" and e["name"] == "ctr"]
+    assert counts and counts[-1]["args"]["ctr"] == 13
+    assert any(e["ph"] == "i" and e["name"] == "mk" for e in ev)
+    assert any(e["ph"] == "X" and e["name"] == "t" and e["cat"] == "dom"
+               for e in ev)
+    agg = profiler.aggregate()
+    assert "t" in agg and "ctr" not in agg

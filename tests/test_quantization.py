@@ -65,3 +65,46 @@ def test_quantize_zoo_model_end_to_end():
     denom = np.abs(ref).max() + 1e-6
     assert np.abs(out - ref).max() / denom < 0.15
     assert (out.argmax(-1) == ref.argmax(-1)).all()
+
+
+def test_calibrated_quantization_naive_and_entropy():
+    """calib_mode naive/entropy freeze static activation scales that match
+    fp32 closely and survive hybridize (ref: contrib/quantization.py
+    quantize_model calib_mode)."""
+    from mxnet_tpu.quantization import QuantizedDense, _quantized_layers
+
+    rng = np.random.RandomState(3)
+    batches = [nd.array(rng.randn(8, 16).astype(np.float32)) for _ in range(4)]
+    for mode in ("naive", "entropy"):
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(32, activation="relu", in_units=16),
+                gluon.nn.Dense(8, in_units=32))
+        net.initialize()
+        ref = net(batches[0]).asnumpy()
+        quantize_model(net, calib_mode=mode, calib_data=batches)
+        layers = _quantized_layers(net, [])
+        assert len(layers) == 2
+        for l in layers:
+            assert l._x_scale is not None and l._x_scale > 0
+            assert l._collector is None
+        out = net(batches[0]).asnumpy()
+        denom = np.abs(ref).max() + 1e-6
+        # entropy trades tail accuracy for in-range resolution: allow more
+        # clip error than naive's exact-max scale on this random-data net
+        tol = 0.1 if mode == "naive" else 0.25
+        assert np.abs(out - ref).max() / denom < tol, mode
+        net.hybridize()   # static scales are trace constants
+        out2 = net(batches[0]).asnumpy()
+        np.testing.assert_allclose(out2, out, rtol=1e-5, atol=1e-5)
+
+
+def test_entropy_threshold_clips_outliers():
+    """Entropy calibration should pick a threshold below a lone huge outlier
+    when the mass is concentrated near zero."""
+    from mxnet_tpu.quantization import _optimal_threshold
+
+    hist = np.zeros(8001)
+    hist[:400] = 1000.0   # bulk of the distribution in [0, 5% of range]
+    hist[8000] = 1.0      # single outlier at the max
+    t = _optimal_threshold(hist, amax=100.0)
+    assert t < 100.0

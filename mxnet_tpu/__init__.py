@@ -54,5 +54,12 @@ from . import numpy_api
 from . import numpy_api as np  # mx.np parity (ref: python/mxnet/numpy)
 from . import npx  # mx.npx parity (ref: python/mxnet/numpy_extension)
 from . import models
+from . import runtime  # feature detection (ref: python/mxnet/runtime.py)
+from . import util
+from .util import use_np, use_np_array, use_np_shape, np_array, np_shape
+from . import attribute
+from .attribute import AttrScope
+from . import name
+from . import onnx  # import/export (ref: python/mxnet/onnx)
 
 __all__ = ["nd", "gluon", "autograd", "cpu", "gpu", "tpu", "Context", "NDArray"]

@@ -1,0 +1,50 @@
+"""AttrScope: with-block attribute injection for symbols (ref:
+python/mxnet/attribute.py AttrScope, nnvm node attrs).
+
+Symbols created inside ``with AttrScope(ctx_group='dev1'):`` pick up the
+scope's attributes; scopes nest, inner values win. The symbolic layer calls
+``current().get(user_attrs)`` at node creation.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AttrScope", "current"]
+
+_local = threading.local()
+
+
+class AttrScope:
+    def __init__(self, **kwargs):
+        for v in kwargs.values():
+            if not isinstance(v, str):
+                raise ValueError("attributes must be strings")
+        self._attr = kwargs
+
+    def get(self, attr=None):
+        """Merge scope attrs with node-level ``attr`` (node wins)."""
+        if not self._attr:
+            return attr or {}
+        out = dict(self._attr)
+        if attr:
+            out.update(attr)
+        return out
+
+    def __enter__(self):
+        stack = getattr(_local, "stack", None)
+        if stack is None:
+            stack = _local.stack = [AttrScope()]
+        merged = AttrScope()
+        merged._attr = {**stack[-1]._attr, **self._attr}
+        stack.append(merged)
+        return self
+
+    def __exit__(self, *exc):
+        _local.stack.pop()
+
+
+def current():
+    stack = getattr(_local, "stack", None)
+    if not stack:
+        _local.stack = [AttrScope()]
+    return _local.stack[-1]

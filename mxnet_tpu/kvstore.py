@@ -28,6 +28,8 @@ class KVStore:
         self.type = kv_type
         self._store = {}
         self._updater = None
+        self._compression = None   # set_gradient_compression state
+        self._residual = {}        # per-key error-feedback accumulator
 
     # ------------------------------------------------------------- core API
     def init(self, key, value):
@@ -39,6 +41,8 @@ class KVStore:
         keys, values = _normalize(key, value)
         for k, v in zip(keys, values):
             agg = _aggregate(v)
+            if self._compression is not None:
+                agg = self._compress(k, agg)
             if self._updater is not None:
                 self._updater(k, agg, self._store[k])
             elif k in self._store:
@@ -68,7 +72,33 @@ class KVStore:
         self._updater = get_updater(optimizer)
 
     def set_gradient_compression(self, compression_params):
-        pass  # XLA collectives are bf16/fp32 native; compression is a no-op
+        """2-bit gradient compression with error feedback (ref:
+        src/kvstore/gradient_compression.cc, python/mxnet/kvstore.py).
+
+        Each push quantizes (gradient + residual) to {-threshold, 0,
+        +threshold}; what quantization dropped stays in the per-key residual
+        and is re-added on the next push, so small gradients accumulate until
+        they cross the threshold instead of being lost. The compressed value
+        is what crosses hosts in the dist store — the bandwidth the reference
+        saves on ps-lite wires, this saves on DCN."""
+        ctype = compression_params.get("type", "2bit")
+        if ctype != "2bit":
+            raise ValueError("unsupported gradient compression type %r "
+                             "(only '2bit')" % (ctype,))
+        self._compression = {
+            "type": ctype,
+            "threshold": float(compression_params.get("threshold", 0.5)),
+        }
+        self._residual = {}
+
+    def _compress(self, k, agg):
+        t = self._compression["threshold"]
+        acc = agg._data
+        if k in self._residual:
+            acc = acc + self._residual[k]
+        q, r = _two_bit_quantize(acc, t)
+        self._residual[k] = r
+        return NDArray(q)
 
     # ------------------------------------------------------------- topology
     @property
@@ -97,6 +127,15 @@ class KVStore:
         pass
 
 
+@jax.jit
+def _two_bit_quantize(acc, t):
+    """(residual+grad, threshold) → (ternary {-t,0,+t}, new residual)."""
+    t = jnp.asarray(t, acc.dtype)   # keep the compressed dtype = grad dtype
+    q = jnp.where(acc >= t, t, jnp.where(acc <= -t, -t,
+                                         jnp.zeros((), acc.dtype)))
+    return q, acc - q
+
+
 class DistKVStore(KVStore):
     """Multi-host synchronous store: values are psum'd across processes when
     jax.distributed is initialized (the DCN path of the ICI/DCN hierarchy)."""
@@ -105,6 +144,10 @@ class DistKVStore(KVStore):
         keys, values = _normalize(key, value)
         for k, v in zip(keys, values):
             agg = _aggregate(v)
+            if self._compression is not None:
+                # worker-side compression: the ternary value is what crosses
+                # DCN, like the reference compresses before the ps-lite send
+                agg = self._compress(k, agg)
             if jax.process_count() > 1:
                 # cross-host sum via a tiny pmapped psum over local devices
                 agg = NDArray(_allreduce_across_hosts(agg._data))

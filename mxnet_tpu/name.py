@@ -1,0 +1,51 @@
+"""NameManager / Prefix: automatic symbol naming (ref: python/mxnet/name.py).
+
+``NameManager.current.get(None, 'conv')`` yields 'conv0', 'conv1', ...;
+``with Prefix('resnet_'):`` prepends a prefix to every auto name in scope.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["NameManager", "Prefix", "current"]
+
+_local = threading.local()
+
+
+class NameManager:
+    def __init__(self):
+        self._counter = {}
+
+    def get(self, name, hint):
+        """Explicit name wins; otherwise allocate `hint%d`."""
+        if name:
+            return name
+        if hint not in self._counter:
+            self._counter[hint] = 0
+        name = "%s%d" % (hint, self._counter[hint])
+        self._counter[hint] += 1
+        return name
+
+    def __enter__(self):
+        if not hasattr(_local, "stack"):
+            _local.stack = [NameManager()]
+        _local.stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _local.stack.pop()
+
+
+class Prefix(NameManager):
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        return self._prefix + super().get(name, hint)
+
+
+def current():
+    if not hasattr(_local, "stack"):
+        _local.stack = [NameManager()]
+    return _local.stack[-1]

@@ -10,7 +10,8 @@ import sys as _sys
 
 from ..base import OP_REGISTRY as _REG
 from ..ndarray import (NDArray, array, zeros, ones, full, empty, arange,  # noqa: F401
-                       linspace, eye, concat, stack, waitall, invoke)
+                       linspace, eye, concat, stack, waitall, invoke, save,
+                       load)
 from . import random  # noqa: F401
 from . import contrib  # noqa: F401
 from ..operator import Custom  # noqa: F401  (ref: src/operator/custom/custom.cc)

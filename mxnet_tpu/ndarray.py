@@ -571,3 +571,42 @@ def waitall():
     """Block until all launched computations finish (ref:
     python/mxnet/ndarray/ndarray.py:waitall → engine WaitForAll)."""
     (jax.device_put(0.0) + 0).block_until_ready()
+
+
+def save(fname, data):
+    """Serialize NDArrays to file (ref: python/mxnet/ndarray/utils.py:save).
+
+    ``data``: a single NDArray, a list of NDArrays, or a dict str→NDArray;
+    ``load`` round-trips the container kind. Container format is npz (the
+    host-portable TPU-native choice) with a key prefix encoding list vs dict."""
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        if not all(isinstance(v, NDArray) for v in data):
+            raise ValueError("save requires NDArray elements")
+        payload = {"l:%08d" % i: np.asarray(v._data) for i, v in enumerate(data)}
+        payload["__kind__"] = np.int8(0)
+    elif isinstance(data, dict):
+        if not all(isinstance(k, str) and isinstance(v, NDArray)
+                   for k, v in data.items()):
+            raise ValueError("save requires str keys and NDArray values")
+        payload = {"d:" + k: np.asarray(v._data) for k, v in data.items()}
+        payload["__kind__"] = np.int8(1)   # container kind survives emptiness
+    else:
+        raise ValueError("data must be NDArray, list of NDArray, or "
+                         "dict of str to NDArray, got %s" % type(data))
+    with open(fname, "wb") as fh:  # keep the exact name (np.savez appends .npz)
+        np.savez(fh, **payload)
+
+
+def load(fname):
+    """Load NDArrays saved by ``save`` — returns a list or a dict matching
+    the saved container (ref: python/mxnet/ndarray/utils.py:load)."""
+    with np.load(fname) as f:
+        keys = [k for k in f.files if k != "__kind__"]
+        kind = int(f["__kind__"]) if "__kind__" in f.files else (
+            0 if keys and all(k.startswith("l:") for k in keys) else 1)
+        if kind == 0:
+            return [NDArray(jnp.asarray(f[k])) for k in sorted(keys)]
+        return {k[2:] if k.startswith("d:") else k: NDArray(jnp.asarray(f[k]))
+                for k in keys}

@@ -228,17 +228,18 @@ class Counter:
             self.set_value(value)
 
     def set_value(self, value):
+        # record under the value lock: a preempted writer must not emit a
+        # stale sample with a later timestamp (lock order _vlock→_lock only)
         with self._vlock:
             self._value = value
-        _record(self.name, (time.perf_counter() - _epoch) * 1e6,
-                cat=self._cat, ph="C", value=value)
+            _record(self.name, (time.perf_counter() - _epoch) * 1e6,
+                    cat=self._cat, ph="C", value=value)
 
     def _add(self, delta):
         with self._vlock:
             self._value += delta
-            value = self._value
-        _record(self.name, (time.perf_counter() - _epoch) * 1e6,
-                cat=self._cat, ph="C", value=value)
+            _record(self.name, (time.perf_counter() - _epoch) * 1e6,
+                    cat=self._cat, ph="C", value=self._value)
 
     def increment(self, delta=1):
         self._add(delta)

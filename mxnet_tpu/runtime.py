@@ -1,0 +1,73 @@
+"""Runtime feature detection (ref: python/mxnet/runtime.py, src/libinfo.cc).
+
+MXNet reports compile-time feature flags (CUDA, MKLDNN, OPENMP, ...) through
+``mx.runtime.Features()``. The TPU-native equivalents are runtime facts about
+the jax/XLA stack: which backend is live, whether pallas kernels apply, and
+which optional subsystems (C++ host engine, orbax checkpointing) resolved.
+"""
+from __future__ import annotations
+
+__all__ = ["Feature", "Features", "feature_list"]
+
+
+class Feature:
+    def __init__(self, name, enabled):
+        self.name = name
+        self.enabled = enabled
+
+    def __repr__(self):
+        return "✔ %s" % self.name if self.enabled else "✖ %s" % self.name
+
+
+def _detect():
+    import jax
+
+    feats = {}
+    try:
+        platform = jax.default_backend()
+    except Exception:
+        platform = "unavailable"
+    feats["TPU"] = platform == "tpu"
+    feats["CPU"] = True
+    feats["CUDA"] = False          # XLA:TPU single-backend design (SURVEY §2 #41)
+    feats["MKLDNN"] = False
+    feats["XLA"] = True
+    feats["PALLAS"] = feats["TPU"]  # flash attention / fused LN kernel dispatch
+    feats["BF16"] = True
+    feats["INT8"] = True            # quantization.py MXU int8 path
+    try:
+        from .engine import _lib  # noqa: F401
+        feats["CPP_HOST_ENGINE"] = True
+    except Exception:
+        feats["CPP_HOST_ENGINE"] = False
+    try:
+        import orbax.checkpoint  # noqa: F401
+        feats["ORBAX_CHECKPOINT"] = True
+    except Exception:
+        feats["ORBAX_CHECKPOINT"] = False
+    feats["DIST_KVSTORE"] = True
+    feats["SIGNAL_HANDLER"] = False
+    feats["PROFILER"] = True
+    return feats
+
+
+def feature_list():
+    return [Feature(k, v) for k, v in _detect().items()]
+
+
+class Features(dict):
+    """dict-like: ``Features()['TPU'].enabled`` /
+    ``Features().is_enabled('TPU')`` (ref: runtime.py:Features)."""
+
+    def __init__(self):
+        super().__init__((f.name, f) for f in feature_list())
+
+    def is_enabled(self, name):
+        name = name.upper()
+        if name not in self:
+            raise RuntimeError("unknown feature %r; known: %s"
+                               % (name, sorted(self)))
+        return self[name].enabled
+
+    def __repr__(self):
+        return "[%s]" % ", ".join(repr(f) for f in self.values())

@@ -27,8 +27,15 @@ class Symbol:
                  dtype=None, out_index=None, n_outputs=1):
         self._op = op  # registry op name, None for variables, "_group" for groups
         self._inputs = list(inputs)
-        self._attrs = dict(attrs or {})
-        self.name = name or (op if op else "var")
+        self._attrs = dict(attrs or {})   # op kwargs — splatted into the op fn
+        self._annotations = {}            # AttrScope metadata — never executed
+        if name is None:
+            # auto names flow through the ambient NameManager/Prefix scope
+            # (ref: python/mxnet/name.py; symbol.py passes name=None to it)
+            from . import name as _name_mod
+
+            name = _name_mod.current().get(None, op if op else "var")
+        self.name = name
         self._shape = tuple(shape) if shape is not None else None
         self._dtype = resolve_dtype(dtype)
         self._out_index = out_index
@@ -88,6 +95,8 @@ class Symbol:
         return Symbol("_item", [self], {"index": index}, name="%s%d" % (self.name, index))
 
     def attr(self, key):
+        if key in self._annotations:
+            return self._annotations[key]
         return self._attrs.get(key)
 
     # ------------------------------------------------------------- build ops
@@ -281,7 +290,6 @@ def _eval_symbols(outputs, feed):
     return outs
 
 
-_make_counter = {}
 
 
 def _make(op, *args, name=None, **attrs):
@@ -294,10 +302,21 @@ def _make(op, *args, name=None, **attrs):
         else:
             inputs.append(Symbol("_const", [], {"value": float(a)}, name="const"))
     if name is None:
-        cnt = _make_counter.get(op, 0)
-        _make_counter[op] = cnt + 1
-        name = "%s%d" % (op.lower(), cnt)
-    return Symbol(op, inputs, attrs, name=name)
+        # ambient NameManager/Prefix scope allocates 'op0', 'op1', ... and
+        # applies any with-block prefix (ref: python/mxnet/name.py)
+        from . import name as _name_mod
+
+        name = _name_mod.current().get(None, op.lower())
+    # AttrScope attaches only at operator-creation time — NOT in
+    # Symbol.__init__, so deserialization (load) and internal rebuilds never
+    # absorb ambient scope attributes. Scope attrs are node ANNOTATIONS
+    # (ctx_group etc.), kept apart from op kwargs which _eval splats into the
+    # registry fn (ref: python/mxnet/attribute.py)
+    from . import attribute as _attr_mod
+
+    s = Symbol(op, inputs, attrs, name=name)
+    s._annotations = _attr_mod.current().get(None)
+    return s
 
 
 # const evaluation support
@@ -345,7 +364,11 @@ def _cond_op(pred, *vals, then_sym, else_sym, arg_names):
 
 
 def var(name, shape=None, dtype=None, **kwargs):
-    return Symbol(None, name=name, shape=shape, dtype=dtype)
+    from . import attribute as _attr_mod
+
+    s = Symbol(None, name=name, shape=shape, dtype=dtype)
+    s._annotations = _attr_mod.current().get(None)
+    return s
 
 
 Variable = var

@@ -1,0 +1,113 @@
+"""Utility helpers (ref: python/mxnet/util.py).
+
+The upstream module's load-bearing pieces are the numpy-mode switches
+(``use_np`` family — MXNet 2.x's opt-in to numpy semantics) and small
+filesystem/env helpers; the mode flags delegate to npx's switch so there is
+one source of truth.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+__all__ = ["makedirs", "set_np", "reset_np", "is_np_array", "is_np_shape",
+           "use_np", "use_np_array", "use_np_shape", "np_array", "np_shape",
+           "getenv", "setenv"]
+
+
+def makedirs(d):
+    os.makedirs(os.path.expanduser(d), exist_ok=True)
+
+
+def getenv(name):
+    return os.environ.get(name)
+
+
+def setenv(name, value):
+    os.environ[name] = value
+
+
+# ------------------------------------------------------------- numpy mode
+def set_np(shape=True, array=True):
+    from . import npx
+
+    npx.set_np(shape=shape, array=array)
+
+
+def reset_np():
+    from . import npx
+
+    npx.reset_np()
+
+
+def is_np_array():
+    from . import npx
+
+    return npx.is_np_array()
+
+
+def is_np_shape():
+    # scalar/zero-size shapes are always allowed on the jax substrate; the
+    # flag tracks the array mode (upstream gates (), (0,) shapes on this)
+    return is_np_array()
+
+
+class _NpScope:
+    """Context manager + decorator flipping numpy mode inside (ref:
+    util.py np_array/np_shape)."""
+
+    def __init__(self, active=True):
+        self._active = active
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = is_np_array()
+        set_np() if self._active else reset_np()
+        return self
+
+    def __exit__(self, *exc):
+        set_np() if self._prev else reset_np()
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            with _NpScope(self._active):
+                return fn(*args, **kwargs)
+
+        return wrapped
+
+
+def np_array(active=True):
+    return _NpScope(active)
+
+
+def np_shape(active=True):
+    return _NpScope(active)
+
+
+def use_np_array(fn):
+    """Decorator: run ``fn`` in numpy-array mode (ref: util.py:use_np_array)."""
+    return _NpScope(True)(fn)
+
+
+def use_np_shape(fn):
+    return _NpScope(True)(fn)
+
+
+def use_np(fn):
+    """Decorator: numpy shape AND array semantics (ref: util.py:use_np).
+    Applies to functions; upstream also wraps classes — every plain method
+    (including __init__, where arrays are typically created) gets the scope."""
+    import inspect
+
+    if isinstance(fn, type):
+        for attr, v in list(vars(fn).items()):
+            if inspect.isfunction(v) and (not attr.startswith("__")
+                                          or attr in ("__init__", "__call__")):
+                setattr(fn, attr, _NpScope(True)(v))
+            elif isinstance(v, staticmethod):
+                setattr(fn, attr, staticmethod(_NpScope(True)(v.__func__)))
+            elif isinstance(v, classmethod):
+                setattr(fn, attr, classmethod(_NpScope(True)(v.__func__)))
+        return fn
+    return _NpScope(True)(fn)

@@ -1,0 +1,99 @@
+"""mx.name / mx.attribute / mx.runtime top-level API parity (ref:
+python/mxnet/name.py, attribute.py, runtime.py)."""
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import attribute, name, sym
+
+
+def test_name_manager_uniquifies_and_prefixes():
+    a = sym.var("x", shape=(2, 2))
+    s1 = mx.sym.relu(a)
+    s2 = mx.sym.relu(a)
+    assert s1.name != s2.name
+    with name.Prefix("net_"):
+        s3 = mx.sym.relu(a)
+    assert s3.name.startswith("net_relu")
+    with name.NameManager():   # fresh manager restarts counters in scope
+        s4 = mx.sym.relu(a)
+    assert s4.name == "relu0"
+    # explicit names always win
+    s5 = mx.sym.relu(a, name="myrelu")
+    assert s5.name == "myrelu"
+
+
+def test_attr_scope_attaches_and_nests():
+    a = sym.var("x", shape=(2, 2))
+    with attribute.AttrScope(ctx_group="dev1"):
+        s = mx.sym.relu(a)
+    assert s.attr("ctx_group") == "dev1"
+    with attribute.AttrScope(a1="x"):
+        with attribute.AttrScope(a2="y"):
+            s2 = mx.sym.relu(a)
+    assert s2.attr("a1") == "x" and s2.attr("a2") == "y"
+    # scope annotations never leak into op kwargs: the node still executes
+    with attribute.AttrScope(ctx_group="dev1"):
+        s3 = mx.sym.Activation(a, act_type="relu")
+    assert s3.attr("ctx_group") == "dev1"
+    assert s3.attr("act_type") == "relu"    # op kwargs still visible via attr
+    out = s3.eval(x=mx.nd.array([[1.0, -1.0], [2.0, -2.0]]))
+    assert out[0].shape == (2, 2)
+    with pytest.raises(ValueError):
+        attribute.AttrScope(bad=3)
+
+
+def test_attr_scope_does_not_leak_into_load(tmp_path):
+    """symbol.load inside an AttrScope must not absorb scope attributes —
+    deserialization rebuilds the graph exactly as saved."""
+    from mxnet_tpu import symbol
+
+    a = sym.var("x", shape=(2, 2))
+    s = mx.sym.relu(a)
+    p = str(tmp_path / "g.json")
+    s.save(p)
+    with attribute.AttrScope(ctx_group="dev9"):
+        loaded = symbol.load(p)
+    assert loaded.attr("ctx_group") is None
+
+
+def test_runtime_features():
+    f = mx.runtime.Features()
+    assert f.is_enabled("XLA")
+    assert not f.is_enabled("CUDA")   # single-backend design (SURVEY §2 #41)
+    assert "TPU" in f and "INT8" in f
+    assert any(x.enabled for x in mx.runtime.feature_list())
+    with pytest.raises(RuntimeError):
+        f.is_enabled("NOT_A_FEATURE")
+
+
+def test_util_np_mode_switches():
+    """mx.util numpy-mode scopes/decorators delegate to npx's switch (ref:
+    python/mxnet/util.py use_np family)."""
+    from mxnet_tpu import npx, util
+
+    npx.reset_np()
+    assert not util.is_np_array()
+    with util.np_array():
+        assert util.is_np_array()
+    assert not util.is_np_array()
+
+    @mx.use_np
+    def f():
+        return util.is_np_array()
+
+    assert f() is True
+    assert not util.is_np_array()
+
+    @mx.use_np
+    class C:
+        def m(self):
+            return util.is_np_array()
+
+    assert C().m() is True
+    assert not util.is_np_array()
+
+
+def test_nd_save_load_namespace_visible():
+    import numpy as np
+
+    assert callable(mx.nd.save) and callable(mx.nd.load)

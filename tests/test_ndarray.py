@@ -152,3 +152,35 @@ def test_where_comparison():
     np.testing.assert_allclose(m.asnumpy(), (x > y).astype(np.float32))
     w = nd.where(m, a, b)
     np.testing.assert_allclose(w.asnumpy(), np.where(x > y, x, y), rtol=1e-6)
+
+
+def test_nd_save_load_roundtrip(tmp_path):
+    """nd.save/load list- and dict-container round trips (ref:
+    python/mxnet/ndarray/utils.py save/load)."""
+    import numpy as np
+
+    from mxnet_tpu import nd
+
+    a = nd.array(np.random.randn(3, 4).astype(np.float32))
+    b = nd.array(np.arange(5, dtype=np.int32))
+    p = str(tmp_path / "arrays.params")
+
+    nd.save(p, [a, b])
+    out = nd.load(p)
+    assert isinstance(out, list) and len(out) == 2
+    np.testing.assert_array_equal(out[0].asnumpy(), a.asnumpy())
+    np.testing.assert_array_equal(out[1].asnumpy(), b.asnumpy())
+    assert out[1].dtype == np.int32
+
+    nd.save(p, {"weight": a, "bias": b})
+    out = nd.load(p)
+    assert sorted(out) == ["bias", "weight"]
+    np.testing.assert_array_equal(out["weight"].asnumpy(), a.asnumpy())
+
+    nd.save(p, a)   # single NDArray saves as a 1-list
+    out = nd.load(p)
+    assert isinstance(out, list) and len(out) == 1
+
+    import pytest
+    with pytest.raises(ValueError):
+        nd.save(p, {"k": 3})

@@ -255,3 +255,35 @@ def test_ulysses_rejects_indivisible_heads():
     q = jnp.zeros((1, 4, 64, 8), jnp.float32)  # 4 heads < sp=8
     with _pytest.raises(ValueError, match="ring_attention"):
         ulysses_attention(q, q, q, mesh)
+
+
+def test_kvstore_two_bit_gradient_compression():
+    """2-bit compression with error feedback (ref:
+    src/kvstore/gradient_compression.cc): pushes are ternarized to
+    {-t, 0, +t} and the quantization error accumulates until it crosses
+    the threshold."""
+    import numpy as np
+
+    from mxnet_tpu import kvstore, nd
+
+    kv = kvstore.create("local")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("w", nd.array(np.zeros(4, np.float32)))
+
+    # 0.7 ≥ t → +0.5 lands; residual keeps 0.2
+    kv.push("w", nd.array(np.array([0.7, -0.7, 0.2, 0.0], np.float32)))
+    out = kv.pull("w").asnumpy()
+    np.testing.assert_allclose(out, [0.5, -0.5, 0.0, 0.0], atol=1e-6)
+
+    # second push of 0.2: residual 0.2 + 0.2 = 0.4 < t → still 0...
+    kv.push("w", nd.array(np.array([0.0, 0.0, 0.2, 0.0], np.float32)))
+    np.testing.assert_allclose(kv.pull("w").asnumpy(),
+                               [0.5, -0.5, 0.0, 0.0], atol=1e-6)
+    # ...third push crosses: 0.4 + 0.2 = 0.6 ≥ t → +0.5 lands (error feedback)
+    kv.push("w", nd.array(np.array([0.0, 0.0, 0.2, 0.0], np.float32)))
+    np.testing.assert_allclose(kv.pull("w").asnumpy(),
+                               [0.5, -0.5, 0.5, 0.0], atol=1e-6)
+
+    import pytest
+    with pytest.raises(ValueError):
+        kv.set_gradient_compression({"type": "1bit"})

@@ -71,3 +71,40 @@ class LogValidationMetricsCallback:
         if param.eval_metric is not None:
             for name, value in param.eval_metric.get_name_value():
                 logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name, value)
+
+
+class ProgressBar:
+    """Text progress bar over total batches (ref: callback.py:ProgressBar)."""
+
+    def __init__(self, total, length=80):
+        self.total = total
+        self.length = length
+
+    def __call__(self, param):
+        count = param.nbatch
+        filled = int(round(self.length * count / float(self.total)))
+        pct = round(100.0 * count / float(self.total), 1)
+        bar = "=" * filled + "-" * (self.length - filled)
+        logging.info("[%s] %s%%", bar, pct)
+
+
+def log_train_metric(period, auto_reset=False):
+    """Log the evaluation metric every ``period`` batches (ref:
+    callback.py:log_train_metric)."""
+
+    def _callback(param):
+        if param.nbatch % period == 0 and param.eval_metric is not None:
+            for name, value in _metric_items(param.eval_metric):
+                logging.info("Iter[%d] Batch[%d] Train-%s=%f",
+                             param.epoch, param.nbatch, name, value)
+            if auto_reset:
+                param.eval_metric.reset()
+
+    return _callback
+
+
+def _metric_items(metric):
+    name, value = metric.get()
+    if isinstance(name, (list, tuple)):
+        return list(zip(name, value))
+    return [(name, value)]

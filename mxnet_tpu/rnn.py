@@ -15,7 +15,116 @@ from .gluon.rnn.rnn_cell import (  # noqa: F401
 
 __all__ = ["RNNCell", "LSTMCell", "GRUCell", "SequentialRNNCell",
            "BidirectionalCell", "DropoutCell", "ResidualCell", "ZoneoutCell",
-           "FusedRNNCell"]
+           "FusedRNNCell", "BucketSentenceIter"]
+
+
+class BucketSentenceIter:
+    """Bucketed iterator over variable-length token sequences (ref:
+    python/mxnet/rnn/io.py:BucketSentenceIter).
+
+    Each sentence lands in the smallest bucket that fits (padded with
+    ``invalid_label``); batches come from one bucket at a time with
+    ``bucket_key`` set so BucketingModule switches executors. Labels are the
+    inputs shifted left by one (next-token prediction), like upstream."""
+
+    def __init__(self, sentences, batch_size, buckets=None, invalid_label=-1,
+                 data_name="data", label_name="softmax_label", dtype="float32",
+                 layout="NT", shuffle=False, seed=0):
+        import numpy as np
+
+        from .io import DataDesc
+
+        if layout not in ("NT", "TN"):
+            raise ValueError("layout must be 'NT' (batch-major) or 'TN' "
+                             "(time-major), got %r" % (layout,))
+        if buckets is None:
+            lens = sorted({len(s) for s in sentences if len(s) > 1})
+            buckets = [l for l in lens
+                       if sum(len(s) <= l for s in sentences) >= batch_size]
+            buckets = buckets or [max(lens)]
+        self.buckets = sorted(buckets)
+        self.batch_size = batch_size
+        self.invalid_label = invalid_label
+        self.data_name = data_name
+        self.label_name = label_name
+        self.layout = layout
+        self._dtype = dtype
+        self._shuffle = shuffle
+        self._rng = np.random.RandomState(seed)
+
+        self.data = [[] for _ in self.buckets]
+        ndiscard = 0
+        for s in sentences:
+            i = next((j for j, b in enumerate(self.buckets) if b >= len(s)),
+                     None)
+            if i is None:
+                ndiscard += 1
+                continue
+            padded = np.full(self.buckets[i], invalid_label, np.int64)
+            padded[:len(s)] = s
+            self.data[i].append(padded)
+        self.data = [np.asarray(d).reshape(-1, b) for d, b in
+                     zip(self.data, self.buckets)]
+        if ndiscard:
+            import warnings
+
+            warnings.warn("discarded %d sentences longer than the largest "
+                          "bucket" % ndiscard)
+        self.default_bucket_key = max(self.buckets)
+        shape = self._shape(self.default_bucket_key)
+        self.provide_data = [DataDesc(data_name, shape, dtype, layout=layout)]
+        self.provide_label = [DataDesc(label_name, shape, dtype,
+                                       layout=layout)]
+        self.reset()
+
+    def _shape(self, bucket):
+        if self.layout == "TN":
+            return (bucket, self.batch_size)
+        return (self.batch_size, bucket)
+
+    def reset(self):
+        self._plan = []
+        for i, d in enumerate(self.data):
+            order = self._rng.permutation(len(d)) if self._shuffle \
+                else range(len(d))
+            order = list(order)
+            for k in range(len(d) // self.batch_size):
+                self._plan.append(
+                    (i, order[k * self.batch_size:(k + 1) * self.batch_size]))
+        if self._shuffle:
+            self._rng.shuffle(self._plan)
+        self._cursor = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+    def next(self):
+        import numpy as np
+
+        from . import nd
+        from .io import DataBatch, DataDesc
+
+        if self._cursor >= len(self._plan):
+            raise StopIteration
+        i, rows = self._plan[self._cursor]
+        self._cursor += 1
+        buf = self.data[i][rows]
+        label = np.full_like(buf, self.invalid_label)
+        label[:, :-1] = buf[:, 1:]       # next-token shift, pad tail invalid
+        if self.layout == "TN":          # time-major: (seq_len, batch)
+            buf, label = buf.T, label.T
+        shape = self._shape(self.buckets[i])
+        return DataBatch(
+            data=[nd.array(buf.astype(self._dtype))],
+            label=[nd.array(label.astype(self._dtype))],
+            bucket_key=self.buckets[i],
+            provide_data=[DataDesc(self.data_name, shape, self._dtype,
+                                   layout=self.layout)],
+            provide_label=[DataDesc(self.label_name, shape, self._dtype,
+                                    layout=self.layout)])
 
 
 class FusedRNNCell:

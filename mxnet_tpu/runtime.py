@@ -36,8 +36,8 @@ def _detect():
     feats["BF16"] = True
     feats["INT8"] = True            # quantization.py MXU int8 path
     try:
-        from .engine import _lib  # noqa: F401
-        feats["CPP_HOST_ENGINE"] = True
+        from . import engine
+        feats["CPP_HOST_ENGINE"] = engine._native() is not None
     except Exception:
         feats["CPP_HOST_ENGINE"] = False
     try:

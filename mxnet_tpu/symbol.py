@@ -95,9 +95,11 @@ class Symbol:
         return Symbol("_item", [self], {"index": index}, name="%s%d" % (self.name, index))
 
     def attr(self, key):
-        if key in self._annotations:
-            return self._annotations[key]
-        return self._attrs.get(key)
+        # op kwargs are what actually executes — they win over scope
+        # annotations on a key collision (AttrScope.get's "node wins" rule)
+        if key in self._attrs:
+            return self._attrs[key]
+        return self._annotations.get(key)
 
     # ------------------------------------------------------------- build ops
     def __add__(self, o):
@@ -244,10 +246,14 @@ class Symbol:
                     nid_attrs[k] = repr(v)
             nid = len(nodes)
             index[id(s)] = nid
-            nodes.append({"op": s._op or "null", "name": s.name,
-                          "attrs": nid_attrs,
-                          "shape": list(s._shape) if s._shape else None,
-                          "inputs": child_ids})
+            node = {"op": s._op or "null", "name": s.name,
+                    "attrs": nid_attrs,
+                    "shape": list(s._shape) if s._shape else None,
+                    "inputs": child_ids}
+            if s._annotations:
+                # AttrScope annotations persist like upstream node attrs
+                node["annotations"] = dict(s._annotations)
+            nodes.append(node)
             return nid
 
         nodes = []
@@ -398,11 +404,12 @@ def loads(json_str):
             else:
                 attrs[k] = ast.literal_eval(v)
         if node["op"] == "null":
-            built.append(Symbol(None, name=node["name"],
-                                shape=node.get("shape")))
+            s = Symbol(None, name=node["name"], shape=node.get("shape"))
         else:
             inputs = [built[i] for i in node["inputs"]]
-            built.append(Symbol(node["op"], inputs, attrs, name=node["name"]))
+            s = Symbol(node["op"], inputs, attrs, name=node["name"])
+        s._annotations = dict(node.get("annotations", {}))
+        built.append(s)
     return built[blob.get("head", len(built) - 1)]
 
 

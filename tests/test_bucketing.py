@@ -132,3 +132,57 @@ def test_bucketing_default_key_when_batch_has_none():
     out = bm.forward(b, is_train=False)
     assert out[0].shape == (8, NCLS)
     assert list(bm._buckets) == [4]
+
+
+def test_bucket_sentence_iter():
+    """BucketSentenceIter buckets, pads, shifts labels, and exposes
+    bucket_key for BucketingModule routing (ref: python/mxnet/rnn/io.py)."""
+    import numpy as np
+
+    from mxnet_tpu import rnn
+
+    sents = ([[1, 2, 3]] * 5) + ([[4, 5, 6, 7, 8]] * 7) + [[9] * 12]
+    it = rnn.BucketSentenceIter(sents, batch_size=2, buckets=[4, 8],
+                                invalid_label=0)
+    assert it.buckets == [4, 8]
+    assert it.default_bucket_key == 8
+    batches = list(it)
+    # 5 len-3 → bucket 4 (2 full batches), 7 len-5 → bucket 8 (3 batches);
+    # the len-12 sentence is discarded
+    keys = sorted(b.bucket_key for b in batches)
+    assert keys == [4, 4, 8, 8, 8]
+    for b in batches:
+        d = b.data[0].asnumpy()
+        l = b.label[0].asnumpy()
+        assert d.shape == (2, b.bucket_key)
+        # label is data shifted left by one, invalid-padded at the tail
+        np.testing.assert_array_equal(l[:, :-1], d[:, 1:])
+        assert (l[:, -1] == 0).all()
+    it.reset()
+    assert len(list(it)) == 5
+
+
+def test_bucket_sentence_iter_time_major_and_annotations_roundtrip(tmp_path):
+    """TN layout transposes batches; AttrScope annotations survive symbol
+    save/load (ref: rnn/io.py layout, nnvm SaveJSON node attrs)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import attribute, rnn, symbol, sym
+
+    it = rnn.BucketSentenceIter([[1, 2, 3]] * 4, batch_size=2, buckets=[4],
+                                invalid_label=0, layout="TN")
+    b = next(iter(it))
+    assert b.data[0].shape == (4, 2)
+    assert b.provide_data[0].layout == "TN"
+    import pytest
+    with pytest.raises(ValueError):
+        rnn.BucketSentenceIter([[1, 2]], 1, buckets=[4], layout="XY")
+
+    a = sym.var("x", shape=(2, 2))
+    with attribute.AttrScope(ctx_group="dev3"):
+        s = mx.sym.relu(a)
+    p = str(tmp_path / "g.json")
+    s.save(p)
+    loaded = symbol.load(p)
+    assert loaded.attr("ctx_group") == "dev3"   # annotations serialize

@@ -94,17 +94,10 @@ def log_train_metric(period, auto_reset=False):
 
     def _callback(param):
         if param.nbatch % period == 0 and param.eval_metric is not None:
-            for name, value in _metric_items(param.eval_metric):
+            for name, value in param.eval_metric.get_name_value():
                 logging.info("Iter[%d] Batch[%d] Train-%s=%f",
                              param.epoch, param.nbatch, name, value)
             if auto_reset:
                 param.eval_metric.reset()
 
     return _callback
-
-
-def _metric_items(metric):
-    name, value = metric.get()
-    if isinstance(name, (list, tuple)):
-        return list(zip(name, value))
-    return [(name, value)]

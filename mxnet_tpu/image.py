@@ -486,3 +486,115 @@ from .image_det import (  # noqa: F401,E402
     DetAugmenter, DetBorrowAug, DetRandomSelectAug, DetHorizontalFlipAug,
     DetRandomCropAug, DetRandomPadAug, CreateDetAugmenter,
 )
+
+
+class ImageIter:
+    """Augmenting image iterator (ref: python/mxnet/image/image.py:ImageIter).
+
+    Two sources, like upstream: ``path_imgrec`` (packed RecordIO, lazy
+    byte-offset reads) or ``path_imglist``/``imglist`` + ``path_root`` (raw
+    image files listed in a .lst: index\\tlabel...\\trelpath). Applies
+    ``aug_list`` (default: CreateAugmenter(**kwargs)) per image and yields
+    NCHW float32 DataBatches."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root="",
+                 imglist=None, shuffle=False, aug_list=None,
+                 data_name="data", label_name="softmax_label",
+                 path_imgidx=None, rng=None, **kwargs):
+        if len(data_shape) != 3 or data_shape[0] not in (1, 3):
+            raise ValueError("data_shape must be (channels, H, W)")
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self._rng = rng or np.random.RandomState(0)
+        self.auglist = (aug_list if aug_list is not None
+                        else CreateAugmenter(data_shape, rng=self._rng,
+                                             **kwargs))
+        self._shuffle = shuffle
+
+        self._rec = None
+        if path_imgrec is not None:
+            from .recordio import RecordSource
+
+            self._rec = RecordSource(path_imgrec, path_imgidx)
+            self._n = len(self._rec)
+        else:
+            entries = []
+            if path_imglist is not None:
+                with open(path_imglist) as f:
+                    for line in f:
+                        parts = line.strip().split("\t")
+                        if len(parts) < 3:
+                            continue
+                        label = np.asarray(parts[1:-1], np.float32)
+                        entries.append((label, parts[-1]))
+            elif imglist is not None:
+                for item in imglist:
+                    label = np.asarray(item[:-1], np.float32).ravel()
+                    entries.append((label, item[-1]))
+            else:
+                raise ValueError("one of path_imgrec, path_imglist, imglist "
+                                 "is required")
+            self._root = path_root
+            self._entries = entries
+            self._n = len(entries)
+
+        from .io import DataDesc
+
+        self.provide_data = [DataDesc(data_name,
+                                      (batch_size,) + self.data_shape)]
+        lshape = (batch_size,) if label_width == 1 else (batch_size,
+                                                         label_width)
+        self.provide_label = [DataDesc(label_name, lshape)]
+        self._order = np.arange(self._n)
+        self.reset()
+
+    def reset(self):
+        if self._shuffle:
+            self._rng.shuffle(self._order)
+        self._cursor = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self.next()
+
+    def _read(self, i):
+        import os
+
+        flag = 1 if self.data_shape[0] == 3 else 0   # grayscale decodes 1ch
+        if self._rec is not None:
+            header, img_bytes = self._rec.read(i)
+            img = imdecode(img_bytes, flag=flag)
+            label = np.asarray(header.label, np.float32).ravel()
+        else:
+            label, relpath = self._entries[i]
+            img = imread(os.path.join(self._root, relpath), flag=flag)
+        if label.size < self.label_width:
+            raise ValueError(
+                "record %d carries %d label value(s) but label_width=%d"
+                % (i, label.size, self.label_width))
+        return img, label
+
+    def next(self):
+        from .io import DataBatch
+        from .ndarray import NDArray, array
+
+        if self._cursor + self.batch_size > self._n:
+            raise StopIteration
+        datas, labels = [], []
+        for i in self._order[self._cursor:self._cursor + self.batch_size]:
+            img, label = self._read(i)
+            for aug in self.auglist:
+                img = aug(img)
+            a = img.asnumpy() if isinstance(img, NDArray) else np.asarray(img)
+            datas.append(a.transpose(2, 0, 1))   # iterator owns HWC→CHW
+            labels.append(label[0] if self.label_width == 1
+                          else label[:self.label_width])
+        self._cursor += self.batch_size
+        return DataBatch([array(np.stack(datas))],
+                         [array(np.asarray(labels, np.float32))],
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)

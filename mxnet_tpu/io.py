@@ -165,13 +165,11 @@ class _RecordIterBase(DataIter):
 
     def __init__(self, path_imgrec, batch_size, shuffle, path_imgidx):
         super().__init__(batch_size)
-        from .recordio import MXRecordIO, load_offsets, unpack
+        from .recordio import RecordSource
 
-        self._rec = MXRecordIO(path_imgrec, "r")
-        self._offsets = load_offsets(self._rec, path_imgidx)
-        self._unpack = unpack
+        self._src = RecordSource(path_imgrec, path_imgidx)
         self._shuffle = shuffle
-        self._order = np.arange(len(self._offsets))
+        self._order = np.arange(len(self._src))
         self.reset()
 
     def reset(self):
@@ -180,7 +178,7 @@ class _RecordIterBase(DataIter):
         self._cursor = 0
 
     def iter_next(self):
-        return self._cursor + self.batch_size <= len(self._offsets)
+        return self._cursor + self.batch_size <= len(self._src)
 
     def next(self):
         if not self.iter_next():
@@ -189,7 +187,7 @@ class _RecordIterBase(DataIter):
 
         datas, labels = [], []
         for i in self._order[self._cursor:self._cursor + self.batch_size]:
-            header, img_bytes = self._unpack(self._rec.read_at(self._offsets[i]))
+            header, img_bytes = self._src.read(i)
             img, label = self._augment_one(imdecode(img_bytes), header.label)
             a = img.asnumpy() if isinstance(img, NDArray) else np.asarray(img)
             # augmenters emit HWC float32 (upstream contract); the iterator

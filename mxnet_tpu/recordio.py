@@ -274,3 +274,19 @@ def read_all_native(uri):
     finally:
         lib.mxtpu_recordio_close(h)
     return out
+
+
+class RecordSource:
+    """Open .rec + offsets + unpack, as one indexable source: ``len(src)``
+    records, ``src.read(i)`` → (IRHeader, payload bytes). The single rec
+    plumbing shared by io._RecordIterBase and image.ImageIter."""
+
+    def __init__(self, path_imgrec, path_imgidx=None):
+        self.rec = MXRecordIO(path_imgrec, "r")
+        self.offsets = load_offsets(self.rec, path_imgidx)
+
+    def __len__(self):
+        return len(self.offsets)
+
+    def read(self, i):
+        return unpack(self.rec.read_at(self.offsets[i]))

@@ -38,7 +38,9 @@ class BucketSentenceIter:
             raise ValueError("layout must be 'NT' (batch-major) or 'TN' "
                              "(time-major), got %r" % (layout,))
         if buckets is None:
-            lens = sorted({len(s) for s in sentences if len(s) > 1})
+            lens = sorted({len(s) for s in sentences if len(s) > 0})
+            if not lens:
+                raise ValueError("no non-empty sentences to bucket")
             buckets = [l for l in lens
                        if sum(len(s) <= l for s in sentences) >= batch_size]
             buckets = buckets or [max(lens)]

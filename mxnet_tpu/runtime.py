@@ -36,8 +36,18 @@ def _detect():
     feats["BF16"] = True
     feats["INT8"] = True            # quantization.py MXU int8 path
     try:
+        import os
+
         from . import engine
-        feats["CPP_HOST_ENGINE"] = engine._native() is not None
+
+        # cheap probe: report the already-loaded lib, or an existing .so on
+        # disk — never trigger engine._native()'s lazy `make` build from a
+        # capability query
+        feats["CPP_HOST_ENGINE"] = (
+            engine._lib is not None
+            or os.path.exists(os.path.join(os.path.dirname(engine.__file__),
+                                           os.pardir, "src", "engine_cc",
+                                           "libmxtpu.so")))
     except Exception:
         feats["CPP_HOST_ENGINE"] = False
     try:

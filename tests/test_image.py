@@ -284,3 +284,71 @@ def test_crop_resize_transform():
     np.testing.assert_array_equal(out, img[2:10, 5:15])
     assert transforms.CropResize(5, 2, 10, 8, size=(20, 16))(img).shape \
         == (16, 20, 3)
+
+
+def test_image_iter_imglist_and_rec(tmp_path):
+    """ImageIter over raw files (imglist) and over RecordIO agree (ref:
+    python/mxnet/image/image.py:ImageIter)."""
+    import numpy as np
+    from PIL import Image
+
+    from mxnet_tpu import image, recordio
+
+    rng = np.random.RandomState(0)
+    paths = []
+    for i in range(4):
+        a = rng.randint(0, 255, (10, 12, 3), dtype=np.uint8)
+        p = tmp_path / ("img%d.png" % i)
+        Image.fromarray(a).save(str(p))
+        paths.append((float(i), "img%d.png" % i))
+
+    it = image.ImageIter(batch_size=2, data_shape=(3, 8, 8),
+                         imglist=[[l, p] for l, p in paths],
+                         path_root=str(tmp_path))
+    b = next(iter(it))
+    assert b.data[0].shape == (2, 3, 8, 8)
+    np.testing.assert_array_equal(b.label[0].asnumpy(), [0.0, 1.0])
+    assert len(list(it)) == 1   # one more full batch, partial tail dropped
+
+    # .lst file mode
+    lst = tmp_path / "imgs.lst"
+    with open(lst, "w") as f:
+        for i, (l, p) in enumerate(paths):
+            f.write("%d\t%.1f\t%s\n" % (i, l, p))
+    it2 = image.ImageIter(batch_size=2, data_shape=(3, 8, 8),
+                          path_imglist=str(lst), path_root=str(tmp_path))
+    b2 = next(iter(it2))
+    np.testing.assert_allclose(b2.data[0].asnumpy(), b.data[0].asnumpy())
+
+    # RecordIO mode matches (pack the same images; png keeps bytes exact)
+    rec_path = str(tmp_path / "imgs.rec")
+    rec = recordio.MXRecordIO(rec_path, "w")
+    for i, (l, p) in enumerate(paths):
+        img = np.asarray(Image.open(str(tmp_path / p)))
+        rec.write(recordio.pack_img(recordio.IRHeader(0, l, i, 0), img,
+                                    img_fmt=".png"))
+    rec.close()
+    it3 = image.ImageIter(batch_size=2, data_shape=(3, 8, 8),
+                          path_imgrec=rec_path)
+    b3 = next(iter(it3))
+    np.testing.assert_allclose(b3.data[0].asnumpy(), b.data[0].asnumpy())
+
+
+def test_image_iter_grayscale_and_label_guard(tmp_path):
+    import numpy as np
+    from PIL import Image
+
+    from mxnet_tpu import image
+
+    a = np.random.RandomState(0).randint(0, 255, (10, 12), dtype=np.uint8)
+    Image.fromarray(a).save(str(tmp_path / "g.png"))
+    it = image.ImageIter(batch_size=1, data_shape=(1, 8, 8),
+                         imglist=[[0.0, "g.png"]], path_root=str(tmp_path))
+    b = next(iter(it))
+    assert b.data[0].shape == (1, 1, 8, 8)   # decode honors channel count
+
+    import pytest
+    with pytest.raises(ValueError):
+        image.ImageIter(batch_size=1, data_shape=(3, 8, 8), label_width=3,
+                        imglist=[[0.0, "g.png"]],
+                        path_root=str(tmp_path)).next()

@@ -21,10 +21,15 @@ def set_bulk_size(size):
     return size
 
 
+def _lib_location():
+    """Where libmxtpu.so lives — the ONE place that knows the layout."""
+    d = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "src", "engine_cc"))
+    return d, os.path.join(d, "libmxtpu.so")
+
+
 def native_lib_path():
     """Path to libmxtpu.so, building it with make on first use if possible."""
-    d = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "src", "engine_cc"))
-    so = os.path.join(d, "libmxtpu.so")
+    d, so = _lib_location()
     if not os.path.exists(so) and os.path.exists(os.path.join(d, "Makefile")):
         import subprocess
 

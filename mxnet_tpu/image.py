@@ -495,7 +495,8 @@ class ImageIter:
     byte-offset reads) or ``path_imglist``/``imglist`` + ``path_root`` (raw
     image files listed in a .lst: index\\tlabel...\\trelpath). Applies
     ``aug_list`` (default: CreateAugmenter(**kwargs)) per image and yields
-    NCHW float32 DataBatches."""
+    NCHW float32 DataBatches. Satisfies the io.DataIter batch contract
+    (iter_next/getpad/getindex)."""
 
     def __init__(self, batch_size, data_shape, label_width=1,
                  path_imgrec=None, path_imglist=None, path_root="",
@@ -523,10 +524,15 @@ class ImageIter:
             entries = []
             if path_imglist is not None:
                 with open(path_imglist) as f:
-                    for line in f:
+                    for lineno, line in enumerate(f, 1):
+                        if not line.strip():
+                            continue
                         parts = line.strip().split("\t")
                         if len(parts) < 3:
-                            continue
+                            raise ValueError(
+                                "%s:%d: malformed .lst line (need "
+                                "index\\tlabel...\\tpath, tab-separated): %r"
+                                % (path_imglist, lineno, line.rstrip()))
                         label = np.asarray(parts[1:-1], np.float32)
                         entries.append((label, parts[-1]))
             elif imglist is not None:
@@ -578,11 +584,20 @@ class ImageIter:
                 % (i, label.size, self.label_width))
         return img, label
 
+    def iter_next(self):
+        return self._cursor + self.batch_size <= self._n
+
+    def getpad(self):
+        return 0   # partial tails are dropped, never padded
+
+    def getindex(self):
+        return None
+
     def next(self):
         from .io import DataBatch
         from .ndarray import NDArray, array
 
-        if self._cursor + self.batch_size > self._n:
+        if not self.iter_next():
             raise StopIteration
         datas, labels = [], []
         for i in self._order[self._cursor:self._cursor + self.batch_size]:

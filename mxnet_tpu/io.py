@@ -92,26 +92,48 @@ class NDArrayIter(DataIter):
         return [DataDesc(n, (self.batch_size,) + a.shape[1:]) for n, a in self._label]
 
     def reset(self):
+        # roll_over: rows the previous epoch could not fill a batch with are
+        # yielded FIRST this epoch, ahead of a fresh pass (ref:
+        # io.py:NDArrayIter last_batch_handle='roll_over')
+        # _consumed tracks rows actually YIELDED (iter_next pre-increments
+        # _cursor, so _cursor alone over-counts after an exhausting call and
+        # under-counts mid-epoch). Only a tail too small to fill a batch
+        # rolls over — a mid-epoch reset starts fresh instead of duplicating
+        # rows the epoch never finished.
+        leftover = None
+        consumed = getattr(self, "_consumed", 0)
+        remainder = len(getattr(self, "_order", ())) - consumed
+        if self._last == "roll_over" and 0 < remainder < self.batch_size:
+            leftover = self._order[consumed:]
+        order = np.arange(self._num)
         if self._shuffle:
-            np.random.shuffle(self._order)
+            np.random.shuffle(order)
+        self._order = (np.concatenate([leftover, order])
+                       if leftover is not None and len(leftover) else order)
         self._cursor = -self.batch_size
+        self._consumed = 0
 
     def iter_next(self):
         self._cursor += self.batch_size
-        return self._cursor < self._num
+        if self._last in ("discard", "roll_over"):
+            # only full batches; the partial tail is dropped or rolled over
+            return self._cursor + self.batch_size <= len(self._order)
+        return self._cursor < len(self._order)
 
     def _slice(self, pairs):
         out = []
+        n = len(self._order)
         for _, a in pairs:
             end = self._cursor + self.batch_size
             idx = self._order[self._cursor:end]
-            if end > self._num and self._last == "pad":
-                wrap = self._order[0:end - self._num]
+            if end > n and self._last == "pad":
+                wrap = self._order[0:end - n]
                 idx = np.concatenate([idx, wrap])
             out.append(array(np.asarray(a)[idx]))
         return out
 
     def getdata(self):
+        self._consumed = min(self._cursor + self.batch_size, len(self._order))
         return self._slice(self._data)
 
     def getlabel(self):
@@ -119,7 +141,7 @@ class NDArrayIter(DataIter):
 
     def getpad(self):
         end = self._cursor + self.batch_size
-        return max(0, end - self._num) if self._last == "pad" else 0
+        return max(0, end - len(self._order)) if self._last == "pad" else 0
 
 
 def _init_data(data, default_name):

@@ -40,14 +40,13 @@ def _detect():
 
         from . import engine
 
-        # cheap probe: report the already-loaded lib, or an existing .so on
-        # disk — never trigger engine._native()'s lazy `make` build from a
-        # capability query
-        feats["CPP_HOST_ENGINE"] = (
-            engine._lib is not None
-            or os.path.exists(os.path.join(os.path.dirname(engine.__file__),
-                                           os.pardir, "src", "engine_cc",
-                                           "libmxtpu.so")))
+        # cheap probe: report the already-loaded lib (if a load was tried,
+        # trust its outcome), else whether the .so exists on disk — never
+        # trigger engine._native()'s lazy `make` build from a capability query
+        if engine._lib_tried:
+            feats["CPP_HOST_ENGINE"] = engine._lib is not None
+        else:
+            feats["CPP_HOST_ENGINE"] = os.path.exists(engine._lib_location()[1])
     except Exception:
         feats["CPP_HOST_ENGINE"] = False
     try:

@@ -395,3 +395,28 @@ def test_image_det_record_iter_fixed_pad(tmp_path):
                             batch_size=2, label_pad_width=6)
     shapes = {tuple(it.next().label[0].shape) for _ in range(2)}
     assert shapes == {(2, 6, 5)}
+
+
+def test_ndarray_iter_last_batch_handles():
+    """pad / discard / roll_over last-batch policies (ref: io.py:NDArrayIter)."""
+    import numpy as np
+
+    from mxnet_tpu import io
+
+    data = np.arange(5, dtype=np.float32).reshape(5, 1)
+
+    it = io.NDArrayIter(data, batch_size=2, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3 and batches[-1].data is not None
+    assert [b.pad for b in batches] == [0, 0, 1]   # final batch wrapped a row
+
+    it = io.NDArrayIter(data, batch_size=2, last_batch_handle="discard")
+    assert len(list(it)) == 2   # partial tail dropped
+
+    it = io.NDArrayIter(data, batch_size=2, last_batch_handle="roll_over")
+    first = list(it)
+    assert len(first) == 2      # row 4 rolls over
+    it.reset()
+    second = list(it)
+    assert len(second) == 3     # leftover row + fresh pass of 5 = 6 rows
+    assert second[0].data[0].asnumpy()[0, 0] == 4.0   # leftover yields first

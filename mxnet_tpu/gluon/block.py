@@ -278,6 +278,35 @@ class HybridBlock(Block):
     def hybrid_forward(self, F, *args, **kwargs):
         raise NotImplementedError
 
+    def export(self, path, epoch=0, input_names=("data",), input_shapes=None):
+        """Write ``path-symbol.json`` + ``path-%04d.params`` for deployment
+        (ref: gluon/block.py:HybridBlock.export). The params file is an npz
+        keyed by parameter name — exactly what SymbolBlock.imports loads.
+
+        ``input_shapes``: optional list of shapes, one per input var, for
+        graphs whose trace needs static shape info (rnn state sizing etc.)."""
+        import numpy as np
+
+        from .. import sym as _sym
+
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        shapes = input_shapes or [None] * len(input_names)
+        ins = [_sym.var(n, shape=s) for n, s in zip(input_names, shapes)]
+        out = self(*ins)
+        if isinstance(out, (list, tuple)):
+            from ..symbol import Group
+
+            out = Group(list(out))
+        sym_file = "%s-symbol.json" % path
+        out.save(sym_file)
+        params_file = "%s-%04d.params" % (path, epoch)
+        payload = {p.name: np.asarray(p.data()._data)
+                   for p in self.collect_params().values()}
+        with open(params_file, "wb") as fh:  # exact filename, no .npz suffix
+            np.savez(fh, **payload)
+        return sym_file, params_file
+
     # ------------------------------------------------------------ traced
     def _call_traced(self, *args, **kwargs):
         tctx = _trace.current_trace()

@@ -103,7 +103,11 @@ class NDArrayIter(DataIter):
         leftover = None
         consumed = getattr(self, "_consumed", 0)
         remainder = len(getattr(self, "_order", ())) - consumed
-        if self._last == "roll_over" and 0 < remainder < self.batch_size:
+        # consumed > 0: a fresh iterator (or one that never yielded) has no
+        # "previous epoch" to roll from — without this, a dataset smaller
+        # than batch_size would duplicate its rows on construction
+        if (self._last == "roll_over" and consumed > 0
+                and 0 < remainder < self.batch_size):
             leftover = self._order[consumed:]
         order = np.arange(self._num)
         if self._shuffle:
@@ -118,6 +122,7 @@ class NDArrayIter(DataIter):
         if self._last in ("discard", "roll_over"):
             # only full batches; the partial tail is dropped or rolled over
             return self._cursor + self.batch_size <= len(self._order)
+        # 'pad' wraps the tail; 'keep' yields it short
         return self._cursor < len(self._order)
 
     def _slice(self, pairs):
@@ -170,8 +175,10 @@ class CSVIter(DataIter):
         data = data.reshape((-1,) + tuple(data_shape))
         label = (np.loadtxt(label_csv, delimiter=",", dtype=np.float32)
                  if label_csv else np.zeros(len(data), np.float32))
+        # round_batch=False yields the short final batch as-is ('keep'),
+        # matching upstream CSVIter — NOT 'discard', which drops those rows
         self._inner = NDArrayIter(data, label, batch_size,
-                                  last_batch_handle="pad" if round_batch else "discard")
+                                  last_batch_handle="pad" if round_batch else "keep")
 
     def reset(self):
         self._inner.reset()

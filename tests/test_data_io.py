@@ -420,3 +420,27 @@ def test_ndarray_iter_last_batch_handles():
     second = list(it)
     assert len(second) == 3     # leftover row + fresh pass of 5 = 6 rows
     assert second[0].data[0].asnumpy()[0, 0] == 4.0   # leftover yields first
+
+
+def test_csv_iter_keeps_short_tail_and_tiny_rollover(tmp_path):
+    """round_batch=False yields the short final batch (not dropped); a
+    roll_over iterator smaller than batch_size yields nothing rather than
+    duplicating rows."""
+    import numpy as np
+
+    from mxnet_tpu import io
+
+    csv = tmp_path / "d.csv"
+    np.savetxt(csv, np.arange(10, dtype=np.float32).reshape(5, 2),
+               delimiter=",")
+    it = io.CSVIter(str(csv), data_shape=(2,), batch_size=2,
+                    round_batch=False)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[-1].data[0].shape[0] == 1   # short tail kept
+
+    tiny = io.NDArrayIter(np.zeros((1, 2), np.float32), batch_size=2,
+                          last_batch_handle="roll_over")
+    assert list(tiny) == []
+    tiny.reset()
+    assert list(tiny) == []   # still nothing — no fabricated duplicates

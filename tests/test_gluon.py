@@ -174,3 +174,27 @@ def test_reflection_pad2d():
     out = p(nd.array(x)).asnumpy()
     np.testing.assert_array_equal(
         out, np.pad(x, ((0, 0), (0, 0), (2, 2), (2, 2)), mode="reflect"))
+
+
+def test_hybrid_block_export_imports_roundtrip(tmp_path):
+    """HybridBlock.export writes model-symbol.json + model-NNNN.params that
+    SymbolBlock.imports reconstructs exactly (ref: gluon/block.py export)."""
+    import os
+
+    import numpy as np
+
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu.gluon.block import SymbolBlock
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, activation="relu", in_units=4),
+            gluon.nn.Dense(3, in_units=8))
+    net.initialize()
+    x = nd.array(np.random.RandomState(0).randn(2, 4).astype(np.float32))
+    ref = net(x).asnumpy()
+
+    sym_f, par_f = net.export(str(tmp_path / "model"), epoch=7)
+    assert os.path.basename(sym_f) == "model-symbol.json"
+    assert os.path.basename(par_f) == "model-0007.params"
+    blk = SymbolBlock.imports(sym_f, ["data"], par_f)
+    np.testing.assert_allclose(blk(x).asnumpy(), ref, rtol=1e-5, atol=1e-6)

@@ -308,3 +308,101 @@ def IdentityAttachKLSparseReg(data, *, sparseness_target=0.1, penalty=0.001,
 
     op.defvjp(fwd, bwd)
     return op(data)
+
+
+# ------------------------------------------------- round-2 parity additions
+
+@register_op("identity")
+def identity(x):
+    """(ref: elemwise_unary_op_basic.cc _copy/identity)."""
+    return x
+
+
+@register_op("softmin")
+def softmin(x, *, axis=-1, temperature=None):
+    """softmax of the negated input (ref: softmax.cc softmin)."""
+    if temperature is not None:
+        x = x / temperature
+    return jax.nn.softmax(-x, axis=axis)
+
+
+# legacy name for split (ref: slice_channel.cc — SliceChannel predates split)
+register_op("SliceChannel")(F.split)
+
+
+@register_op("choose_element_0index")
+def choose_element_0index(lhs, rhs, *, axis=1, keepdims=False):
+    """Pick lhs[i, rhs[i]] along axis (ref: broadcast_reduce_op_index.cc —
+    the historical name of pick)."""
+    return F.pick(lhs, rhs, axis=axis, keepdims=keepdims)
+
+
+@register_op("fill_element_0index")
+def fill_element_0index(lhs, mhs, rhs):
+    """out[i, rhs[i]] = mhs[i], other entries copied from lhs
+    (ref: broadcast_reduce_op_index.cc fill_element_0index)."""
+    idx = rhs.astype(jnp.int32)[:, None]
+    vals = mhs[:, None].astype(lhs.dtype)
+    return jnp.put_along_axis(lhs, idx, vals, axis=1, inplace=False)
+
+
+@register_op("Crop")
+def Crop(*args, offset=(0, 0), h_w=(0, 0), center_crop=False, num_args=None):
+    """Legacy spatial crop of NCHW maps (ref: src/operator/crop.cc). With two
+    inputs, crops the first to the second's H×W; otherwise to ``h_w``.
+    ``center_crop`` centers the window, else ``offset`` anchors it."""
+    data = args[0]
+    H, W = data.shape[2], data.shape[3]
+    if len(args) == 2:
+        th, tw = args[1].shape[2], args[1].shape[3]
+    else:
+        th, tw = h_w
+    if th <= 0 or tw <= 0 or th > H or tw > W:
+        raise ValueError("invalid crop size (%d, %d) for input %s"
+                         % (th, tw, (H, W)))
+    if center_crop:
+        y0, x0 = (H - th) // 2, (W - tw) // 2
+    else:
+        y0, x0 = offset
+    if y0 < 0 or x0 < 0 or y0 + th > H or x0 + tw > W:
+        raise ValueError("crop window out of bounds")
+    return data[:, :, y0:y0 + th, x0:x0 + tw]
+
+
+def _im2col_patches(data, kernel, stride, dilate, pad):
+    n, c, h, w = data.shape
+    return jax.lax.conv_general_dilated_patches(
+        data, filter_shape=tuple(kernel), window_strides=tuple(stride),
+        padding=[(p, p) for p in pad], rhs_dilation=tuple(dilate),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+
+@register_op("im2col")
+def im2col(data, *, kernel, stride=(1, 1), dilate=(1, 1), pad=(0, 0)):
+    """Sliding-window patch extraction: (N, C, H, W) → (N, C·kh·kw, L)
+    (ref: src/operator/nn/im2col.h). On TPU this is one XLA patches op —
+    the conv lowering MXNet hand-writes in CUDA."""
+    kernel, stride = F._pair(kernel, 2), F._pair(stride, 2)
+    dilate, pad = F._pair(dilate, 2), F._pair(pad, 2)
+    cols = _im2col_patches(data, kernel, stride, dilate, pad)
+    n = cols.shape[0]
+    return cols.reshape(n, cols.shape[1], -1)
+
+
+@register_op("col2im")
+def col2im(data, *, output_size, kernel, stride=(1, 1), dilate=(1, 1),
+           pad=(0, 0)):
+    """Adjoint of im2col: overlap-add patches back to (N, C, H, W)
+    (ref: src/operator/nn/im2col.h col2im). Implemented as the exact VJP of
+    the im2col patches op, which IS the overlap-add scatter."""
+    kernel, stride = F._pair(kernel, 2), F._pair(stride, 2)
+    dilate, pad = F._pair(dilate, 2), F._pair(pad, 2)
+    oh, ow = output_size
+    n = data.shape[0]
+    ckk = data.shape[1]
+    c = ckk // (kernel[0] * kernel[1])
+    ref = jnp.zeros((n, c, oh, ow), data.dtype)
+    primal, vjp = jax.vjp(
+        lambda x: _im2col_patches(x, kernel, stride, dilate, pad), ref)
+    (out,) = vjp(data.reshape(primal.shape))
+    return out

@@ -120,3 +120,68 @@ def test_shuffle_permutes():
     x = nd.array(np.arange(10, dtype=np.float32))
     y = nd.shuffle(x)
     assert sorted(y.asnumpy().tolist()) == list(range(10))
+
+
+def test_round2_parity_ops():
+    """identity/softmin/SliceChannel/choose_element_0index/
+    fill_element_0index/Crop (ref: elemwise_unary_op_basic.cc, softmax.cc,
+    slice_channel.cc, broadcast_reduce_op_index.cc, crop.cc)."""
+    import numpy as np
+
+    from mxnet_tpu import nd
+
+    x = nd.array(np.random.RandomState(0).randn(2, 3).astype(np.float32))
+    np.testing.assert_array_equal(nd.identity(x).asnumpy(), x.asnumpy())
+    ref = np.exp(-x.asnumpy())
+    ref /= ref.sum(-1, keepdims=True)
+    np.testing.assert_allclose(nd.softmin(x, axis=-1).asnumpy(), ref,
+                               rtol=1e-5)
+
+    parts = nd.SliceChannel(
+        nd.array(np.arange(12, dtype=np.float32).reshape(2, 6)),
+        num_outputs=3)
+    assert len(parts) == 3 and parts[0].shape == (2, 2)
+
+    a = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    idx = nd.array(np.array([2, 0], np.float32))
+    np.testing.assert_array_equal(
+        nd.choose_element_0index(a, idx).asnumpy(), [2.0, 3.0])
+    filled = nd.fill_element_0index(
+        a, nd.array(np.array([9.0, 8.0], np.float32)), idx).asnumpy()
+    np.testing.assert_array_equal(filled, [[0, 1, 9], [8, 4, 5]])
+
+    d = nd.array(np.arange(2 * 1 * 6 * 8, dtype=np.float32).reshape(2, 1, 6, 8))
+    np.testing.assert_array_equal(
+        nd.Crop(d, h_w=(4, 4), offset=(1, 2)).asnumpy(),
+        d.asnumpy()[:, :, 1:5, 2:6])
+    like = nd.array(np.zeros((2, 1, 3, 3), np.float32))
+    np.testing.assert_array_equal(
+        nd.Crop(d, like, center_crop=True).asnumpy(),
+        d.asnumpy()[:, :, 1:4, 2:5])
+
+
+def test_im2col_col2im():
+    """im2col matches manual patch extraction; col2im is its exact adjoint
+    (<im2col(x), y> == <x, col2im(y)>) (ref: src/operator/nn/im2col.h)."""
+    import numpy as np
+
+    from mxnet_tpu import nd
+
+    x4 = nd.array(np.random.RandomState(1).randn(1, 2, 4, 4).astype(np.float32))
+    cols = nd.im2col(x4, kernel=(2, 2), stride=(1, 1)).asnumpy()
+    assert cols.shape == (1, 8, 9)
+    xa = x4.asnumpy()
+    man = np.stack([xa[0, :, i:i + 2, j:j + 2].reshape(-1)
+                    for i in range(3) for j in range(3)], -1)
+    np.testing.assert_allclose(cols[0], man, rtol=1e-5)
+
+    y = np.random.RandomState(2).randn(*cols.shape).astype(np.float32)
+    back = nd.col2im(nd.array(y), output_size=(4, 4), kernel=(2, 2)).asnumpy()
+    np.testing.assert_allclose((cols * y).sum(), (xa * back).sum(), rtol=1e-4)
+    # strided + padded case keeps the adjoint identity
+    cols2 = nd.im2col(x4, kernel=(3, 3), stride=(2, 2), pad=(1, 1)).asnumpy()
+    y2 = np.random.RandomState(3).randn(*cols2.shape).astype(np.float32)
+    back2 = nd.col2im(nd.array(y2), output_size=(4, 4), kernel=(3, 3),
+                      stride=(2, 2), pad=(1, 1)).asnumpy()
+    np.testing.assert_allclose((cols2 * y2).sum(), (xa * back2).sum(),
+                               rtol=1e-4)

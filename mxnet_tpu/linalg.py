@@ -14,13 +14,61 @@ def _w(x):
     return x._data if isinstance(x, NDArray) else jnp.asarray(x)
 
 
-def gemm2(a, b, transpose_a=False, transpose_b=False, alpha=1.0):
-    A, B = _w(a), _w(b)
+# --------------------------------------------------------- jnp-level kernels
+# ONE implementation per algorithm; the NDArray namespace below and the flat
+# registry ops (ops/legacy_ops.py linalg_*) both call these.
+
+def k_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0):
     if transpose_a:
         A = jnp.swapaxes(A, -1, -2)
     if transpose_b:
         B = jnp.swapaxes(B, -1, -2)
-    return NDArray(alpha * (A @ B))
+    return alpha * (A @ B)
+
+
+def k_potri(L):
+    """Inverse from the Cholesky FACTOR: (L Lᵀ)⁻¹ given L (the MXNet
+    linalg_potri contract — input is potrf's output, not the SPD matrix)."""
+    inv_l = jnp.linalg.inv(L)
+    return jnp.swapaxes(inv_l, -1, -2) @ inv_l
+
+
+def k_trsm(A, B, transpose=False, rightside=False, alpha=1.0, lower=True):
+    import jax.scipy.linalg as jsl
+
+    if transpose:
+        A = jnp.swapaxes(A, -1, -2)
+        lower = not lower
+    if rightside:
+        return alpha * jnp.swapaxes(
+            jsl.solve_triangular(jnp.swapaxes(A, -1, -2),
+                                 jnp.swapaxes(B, -1, -2), lower=not lower),
+            -1, -2)
+    return alpha * jsl.solve_triangular(A, B, lower=lower)
+
+
+def k_trmm(A, B, transpose=False, rightside=False, alpha=1.0):
+    if transpose:
+        A = jnp.swapaxes(A, -1, -2)
+    return alpha * ((B @ A) if rightside else (A @ B))
+
+
+def k_syrk(A, transpose=False, alpha=1.0):
+    if transpose:
+        A = jnp.swapaxes(A, -1, -2)
+    return alpha * (A @ jnp.swapaxes(A, -1, -2))
+
+
+def k_gelqf(A):
+    """LQ via QR of the transpose: A = L Q, Aᵀ = Qᵀ Lᵀ."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2))
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+# --------------------------------------------------------- NDArray namespace
+
+def gemm2(a, b, transpose_a=False, transpose_b=False, alpha=1.0):
+    return NDArray(k_gemm2(_w(a), _w(b), transpose_a, transpose_b, alpha))
 
 
 def potrf(a):
@@ -33,40 +81,19 @@ cholesky = potrf
 
 def potri(a):
     """Inverse from Cholesky factor: (L L^T)^-1 given L."""
-    L = _w(a)
-    inv_l = jnp.linalg.inv(L)
-    return NDArray(jnp.swapaxes(inv_l, -1, -2) @ inv_l)
+    return NDArray(k_potri(_w(a)))
 
 
 def trsm(a, b, transpose=False, rightside=False, alpha=1.0, lower=True):
-    import jax.scipy.linalg as jsl
-
-    A, B = _w(a), _w(b)
-    if transpose:
-        A = jnp.swapaxes(A, -1, -2)
-        lower = not lower
-    if rightside:
-        X = jnp.swapaxes(
-            jsl.solve_triangular(jnp.swapaxes(A, -1, -2),
-                                 jnp.swapaxes(B, -1, -2), lower=not lower), -1, -2)
-    else:
-        X = jsl.solve_triangular(A, B, lower=lower)
-    return NDArray(alpha * X)
+    return NDArray(k_trsm(_w(a), _w(b), transpose, rightside, alpha, lower))
 
 
 def trmm(a, b, transpose=False, rightside=False, alpha=1.0):
-    A, B = _w(a), _w(b)
-    if transpose:
-        A = jnp.swapaxes(A, -1, -2)
-    out = (B @ A) if rightside else (A @ B)
-    return NDArray(alpha * out)
+    return NDArray(k_trmm(_w(a), _w(b), transpose, rightside, alpha))
 
 
 def syrk(a, transpose=False, alpha=1.0):
-    A = _w(a)
-    if transpose:
-        A = jnp.swapaxes(A, -1, -2)
-    return NDArray(alpha * (A @ jnp.swapaxes(A, -1, -2)))
+    return NDArray(k_syrk(_w(a), transpose, alpha))
 
 
 def det(a):

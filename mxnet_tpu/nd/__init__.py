@@ -14,6 +14,7 @@ from ..ndarray import (NDArray, array, zeros, ones, full, empty, arange,  # noqa
                        load)
 from . import random  # noqa: F401
 from . import contrib  # noqa: F401
+from ..sparse import cast_storage  # noqa: F401  (ref: cast_storage.cc)
 from ..operator import Custom  # noqa: F401  (ref: src/operator/custom/custom.cc)
 
 _mod = _sys.modules[__name__]
@@ -32,6 +33,35 @@ def _make(opname):
 for _name in list(_REG):
     if not hasattr(_mod, _name):
         setattr(_mod, _name, _make(_name))
+
+
+# Optimizer update kernels: MXNet mutates the state arguments in place (they
+# are mutable inputs of the C++ op). The registry ops are pure — these
+# wrappers write the returned states back into the passed state arrays and
+# honor out= for the weight, restoring the legacy contract.
+_UPDATE_STATE_ARGS = {
+    "sgd_update": (), "signsgd_update": (),
+    "sgd_mom_update": (2,), "rmsprop_update": (2,), "signum_update": (2,),
+    "adam_update": (2, 3), "ftrl_update": (2, 3), "mp_sgd_update": (2,),
+}
+
+
+def _make_update(opname, state_pos):
+    def f(*args, out=None, **kwargs):
+        res = invoke(opname, args, kwargs)
+        outs = res if isinstance(res, tuple) else (res,)
+        for o, i in zip(outs[1:], state_pos):
+            args[i]._data = o._data
+        if out is not None:
+            out._data = outs[0]._data
+        return res
+
+    f.__name__ = opname
+    return f
+
+
+for _name, _pos in _UPDATE_STATE_ARGS.items():
+    setattr(_mod, _name, _make_update(_name, _pos))
 
 
 def __getattr__(name):  # ops registered later (e.g. pallas-backed) resolve lazily

@@ -7,4 +7,5 @@ from . import ctc  # noqa: F401
 from . import roi  # noqa: F401
 from . import spatial  # noqa: F401
 from . import extra  # noqa: F401
+from . import legacy_ops  # noqa: F401
 from .functional import *  # noqa: F401,F403

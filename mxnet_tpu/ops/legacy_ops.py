@@ -1,0 +1,346 @@
+"""Flat legacy registry names: linalg_*, random_*/sample_*, optimizer
+*_update kernels, and remaining aliases (ref: src/operator/tensor/la_op.cc,
+src/operator/random/sample_op.cc, src/operator/optimizer_op.cc).
+
+MXNet exposes every one of these as a flat nd/sym op; the structured
+namespaces (mx.linalg, nd.random, mx.optimizer) are this repo's primary
+surfaces, and these wrappers keep old call sites working. Optimizer kernels
+are PURE here (return (new_weight, *new_states)), which is what jit wants;
+the nd facade restores MXNet's in-place contract by writing the returned
+states back into the state arguments and honoring out= for the weight
+(see nd/__init__.py _UPDATE_STATE_ARGS).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import register_op, resolve_dtype
+from . import functional as F
+
+# ---------------------------------------------------------------- aliases
+register_op("stop_gradient")(F.BlockGrad)
+register_op("sum_axis")(F.sum)
+register_op("crop")(F.slice)            # historical name of slice (matrix_op.cc)
+register_op("Pad")(F.pad)
+register_op("Convolution_v1")(F.Convolution)
+register_op("Pooling_v1")(F.Pooling)
+
+
+@register_op("log_sigmoid")
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+@register_op("mish")
+def mish(x):
+    """x · tanh(softplus(x)) (ref: mxnet 2.x leakyrelu.cc mish mode)."""
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@register_op("multi_all_finite", nondiff=True)
+def multi_all_finite(*arrays, num_arrays=None, init_output=True):
+    """1.0 iff every element of every input is finite (ref:
+    contrib/all_finite.cc) — the AMP overflow check as ONE fused reduction."""
+    ok = jnp.bool_(True) if init_output else None
+    for a in arrays:
+        fin = jnp.isfinite(a).all()
+        ok = fin if ok is None else jnp.logical_and(ok, fin)
+    return ok.astype(jnp.float32).reshape(1)
+
+
+@register_op("multi_sum_sq", nondiff=True)
+def multi_sum_sq(*arrays, num_arrays=None):
+    """Per-array sum of squares, stacked (ref: contrib/multi_sum_sq.cc —
+    the LARS/clip-global-norm building block)."""
+    return jnp.stack([jnp.sum(jnp.square(a.astype(jnp.float32)))
+                      for a in arrays])
+
+
+# ---------------------------------------------------------------- linalg_*
+# Thin registry fronts over linalg.py's k_* kernels — one algorithm, two
+# surfaces. Differentiable (MXNet's la_ops have gradients; jnp provides them).
+def _reg_linalg(name, fn, n_outputs=1):
+    register_op(name, n_outputs=n_outputs)(fn)
+
+
+from .. import linalg as _la  # noqa: E402  (kernel sharing, no cycle)
+
+_reg_linalg("linalg_gemm2", lambda a, b, *, transpose_a=False,
+            transpose_b=False, alpha=1.0:
+            _la.k_gemm2(a, b, transpose_a, transpose_b, alpha))
+
+
+def _linalg_gemm(a, b, c, *, transpose_a=False, transpose_b=False, alpha=1.0,
+                 beta=1.0):
+    return _la.k_gemm2(a, b, transpose_a, transpose_b, alpha) + beta * c
+
+
+_reg_linalg("linalg_gemm", _linalg_gemm)
+_reg_linalg("linalg_potrf", lambda a: jnp.linalg.cholesky(a))
+_reg_linalg("linalg_potri", lambda a: _la.k_potri(a))
+_reg_linalg("linalg_det", lambda a: jnp.linalg.det(a))
+_reg_linalg("linalg_inverse", lambda a: jnp.linalg.inv(a))
+_reg_linalg("linalg_slogdet", lambda a: jnp.linalg.slogdet(a), n_outputs=2)
+_reg_linalg("linalg_sumlogdiag", lambda a: jnp.sum(
+    jnp.log(jnp.diagonal(a, axis1=-2, axis2=-1)), axis=-1))
+_reg_linalg("linalg_extractdiag", lambda a, *, offset=0:
+            jnp.diagonal(a, offset=offset, axis1=-2, axis2=-1))
+_reg_linalg("linalg_makediag", lambda a, *, offset=0:
+            _makediag(a, offset))
+_reg_linalg("linalg_syrk", lambda a, *, transpose=False, alpha=1.0:
+            _la.k_syrk(a, transpose, alpha))
+_reg_linalg("linalg_trmm", lambda a, b, *, transpose=False, rightside=False,
+            lower=True, alpha=1.0:
+            _la.k_trmm(jnp.tril(a) if lower else jnp.triu(a), b,
+                       transpose, rightside, alpha))
+_reg_linalg("linalg_trsm", lambda a, b, *, transpose=False, rightside=False,
+            lower=True, alpha=1.0:
+            _la.k_trsm(jnp.tril(a) if lower else jnp.triu(a), b,
+                       transpose, rightside, alpha, lower))
+_reg_linalg("linalg_gelqf", lambda a: _la.k_gelqf(a), n_outputs=2)
+
+
+def _makediag(a, offset):
+    n = a.shape[-1] + abs(offset)
+    out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+    idx = jnp.arange(a.shape[-1])
+    r = idx + max(-offset, 0)
+    c = idx + max(offset, 0)
+    return out.at[..., r, c].set(a)
+
+
+def _extracttrian(a, *, offset=0, lower=True):
+    n = a.shape[-1]
+    rows, cols = jnp.tril_indices(n, offset) if lower \
+        else jnp.triu_indices(n, offset)
+    return a[..., rows, cols]
+
+
+_reg_linalg("linalg_extracttrian", _extracttrian)
+
+
+def _maketrian(a, *, offset=0, lower=True):
+    import numpy as onp
+
+    # solve k(k+1)/2-ish inverse: find n with len == tri count at offset
+    m = a.shape[-1]
+    n = 1
+    while len(onp.tril_indices(n, offset)[0] if lower
+              else onp.triu_indices(n, offset)[0]) < m:
+        n += 1
+    rows, cols = (onp.tril_indices(n, offset) if lower
+                  else onp.triu_indices(n, offset))
+    out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+    return out.at[..., rows, cols].set(a)
+
+
+_reg_linalg("linalg_maketrian", _maketrian)
+
+
+# ------------------------------------------------------------ random_* ops
+def _rand_shape(shape):
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+def _reg_random(name, sampler):
+    @register_op(name, needs_rng=True, nondiff=True)
+    def op(*, shape=(1,), dtype="float32", ctx=None, key=None, **kw):
+        dt = resolve_dtype(dtype) or jnp.float32
+        return sampler(key, _rand_shape(shape), dt, **kw)
+
+    op.__name__ = name
+    return op
+
+
+_reg_random("random_uniform",
+            lambda key, shp, dt, low=0.0, high=1.0:
+            jax.random.uniform(key, shp, dt, low, high))
+_reg_random("random_normal",
+            lambda key, shp, dt, loc=0.0, scale=1.0:
+            jax.random.normal(key, shp, dt) * scale + loc)
+_reg_random("random_exponential",
+            lambda key, shp, dt, lam=1.0:
+            jax.random.exponential(key, shp, dt) / lam)
+_reg_random("random_gamma",
+            lambda key, shp, dt, alpha=1.0, beta=1.0:
+            jax.random.gamma(key, alpha, shp, dt) * beta)
+_reg_random("random_poisson",
+            lambda key, shp, dt, lam=1.0:
+            jax.random.poisson(key, lam, shp).astype(dt))
+_reg_random("random_negative_binomial",
+            lambda key, shp, dt, k=1, p=0.5:
+            _neg_binomial(key, shp, k, p).astype(dt))
+
+
+def _neg_binomial(key, shp, k, p):
+    # NB(k, p) = Poisson(Gamma(k, (1-p)/p)) (ref: sample_op.cc)
+    kg, kp = jax.random.split(key)
+    lam = jax.random.gamma(kg, k, shp) * ((1.0 - p) / p)
+    return jax.random.poisson(kp, lam, shp)
+
+
+@register_op("random_randint", needs_rng=True, nondiff=True)
+def random_randint(*, low, high, shape=(1,), dtype="int32", ctx=None,
+                   key=None):
+    return jax.random.randint(key, _rand_shape(shape), low, high,
+                              resolve_dtype(dtype) or jnp.int32)
+
+
+# sample_*: per-row parameter arrays → `shape` draws per row
+def _sample_expand(params, shape):
+    shp = _rand_shape(shape) if shape else ()
+    return shp, tuple(params[0].shape) + shp
+
+
+@register_op("sample_uniform", needs_rng=True, nondiff=True)
+def sample_uniform(low, high, *, shape=(), dtype="float32", key=None):
+    extra, out_shape = _sample_expand([low], shape)
+    u = jax.random.uniform(key, out_shape,
+                           resolve_dtype(dtype) or jnp.float32)
+    exp = (...,) + (None,) * len(extra)
+    return low[exp] + u * (high - low)[exp]
+
+
+@register_op("sample_normal", needs_rng=True, nondiff=True)
+def sample_normal(mu, sigma, *, shape=(), dtype="float32", key=None):
+    extra, out_shape = _sample_expand([mu], shape)
+    z = jax.random.normal(key, out_shape, resolve_dtype(dtype) or jnp.float32)
+    exp = (...,) + (None,) * len(extra)
+    return mu[exp] + z * sigma[exp]
+
+
+@register_op("sample_exponential", needs_rng=True, nondiff=True)
+def sample_exponential(lam, *, shape=(), dtype="float32", key=None):
+    extra, out_shape = _sample_expand([lam], shape)
+    e = jax.random.exponential(key, out_shape,
+                               resolve_dtype(dtype) or jnp.float32)
+    return e / lam[(...,) + (None,) * len(extra)]
+
+
+@register_op("sample_gamma", needs_rng=True, nondiff=True)
+def sample_gamma(alpha, beta, *, shape=(), dtype="float32", key=None):
+    extra, out_shape = _sample_expand([alpha], shape)
+    exp = (...,) + (None,) * len(extra)
+    g = jax.random.gamma(key, alpha[exp],
+                         out_shape, resolve_dtype(dtype) or jnp.float32)
+    return g * beta[exp]
+
+
+@register_op("sample_poisson", needs_rng=True, nondiff=True)
+def sample_poisson(lam, *, shape=(), dtype="float32", key=None):
+    extra, out_shape = _sample_expand([lam], shape)
+    p = jax.random.poisson(key, lam[(...,) + (None,) * len(extra)], out_shape)
+    return p.astype(resolve_dtype(dtype) or jnp.float32)
+
+
+@register_op("sample_multinomial", needs_rng=True, nondiff=True)
+def sample_multinomial(data, *, shape=(), get_prob=False, dtype="int32",
+                       key=None):
+    """Draw index samples from probability rows (ref: sample_op.cc
+    _sample_multinomial)."""
+    extra = _rand_shape(shape) if shape else ()
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    n = 1
+    for e in extra:
+        n *= e
+    draws = jax.random.categorical(key, logits[..., None, :], axis=-1,
+                                   shape=data.shape[:-1] + (max(n, 1),))
+    out = draws.reshape(data.shape[:-1] + extra) if extra \
+        else draws.reshape(data.shape[:-1])
+    out = out.astype(resolve_dtype(dtype) or jnp.int32)
+    if get_prob:
+        lp = jnp.take_along_axis(
+            jax.nn.log_softmax(logits, axis=-1),
+            out.reshape(data.shape[:-1] + (-1,)).astype(jnp.int32),
+            axis=-1).reshape(out.shape)
+        return out, lp
+    return out
+
+
+# ------------------------------------------------- optimizer update kernels
+def _clip(g, clip_gradient):
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g
+
+
+@register_op("sgd_update", nondiff=True)
+def sgd_update(weight, grad, *, lr, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0, lazy_update=True):
+    """(ref: optimizer_op.cc SGDUpdate) — pure: returns the new weight.
+    Through the nd facade, pass out=weight for MXNet's in-place behavior;
+    stateful kernels additionally write their new states back into the
+    passed state arrays."""
+    g = _clip(grad * rescale_grad, clip_gradient) + wd * weight
+    return weight - lr * g
+
+
+@register_op("sgd_mom_update", nondiff=True, n_outputs=2)
+def sgd_mom_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _clip(grad * rescale_grad, clip_gradient) + wd * weight
+    new_mom = momentum * mom - lr * g
+    return weight + new_mom, new_mom
+
+
+@register_op("adam_update", nondiff=True, n_outputs=3)
+def adam_update(weight, grad, mean, var, *, lr, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                lazy_update=True):
+    g = _clip(grad * rescale_grad, clip_gradient) + wd * weight
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    return weight - lr * m / (jnp.sqrt(v) + epsilon), m, v
+
+
+@register_op("rmsprop_update", nondiff=True, n_outputs=2)
+def rmsprop_update(weight, grad, n, *, lr, gamma1=0.95, epsilon=1e-8,
+                   wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _clip(grad * rescale_grad, clip_gradient) + wd * weight
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    return weight - lr * g / jnp.sqrt(new_n + epsilon), new_n
+
+
+@register_op("signsgd_update", nondiff=True)
+def signsgd_update(weight, grad, *, lr, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    g = _clip(grad * rescale_grad, clip_gradient)
+    return weight - lr * (jnp.sign(g) + wd * weight)
+
+
+@register_op("signum_update", nondiff=True, n_outputs=2)
+def signum_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    """(ref: optimizer_op.cc SignumUpdate): wd enters the momentum's
+    gradient term; wd_lh decays the weight directly."""
+    g = _clip(grad * rescale_grad, clip_gradient) + wd * weight
+    new_mom = momentum * mom - (1 - momentum) * g
+    return (1 - lr * wd_lh) * weight + lr * jnp.sign(new_mom), new_mom
+
+
+@register_op("ftrl_update", nondiff=True, n_outputs=3)
+def ftrl_update(weight, grad, z, n, *, lr, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0):
+    g = _clip(grad * rescale_grad, clip_gradient)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight
+    new_w = jnp.where(
+        jnp.abs(new_z) <= lamda1, jnp.zeros_like(weight),
+        -(new_z - jnp.sign(new_z) * lamda1)
+        / ((beta + jnp.sqrt(new_n)) / lr + wd))
+    return new_w, new_z, new_n
+
+
+@register_op("mp_sgd_update", nondiff=True, n_outputs=2)
+def mp_sgd_update(weight, grad, weight32, *, lr, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, lazy_update=True):
+    """Mixed precision: bf16/fp16 weight + fp32 master (ref:
+    optimizer_op.cc MP_SGDUpdate)."""
+    g = _clip(grad.astype(jnp.float32) * rescale_grad, clip_gradient) \
+        + wd * weight32
+    new32 = weight32 - lr * g
+    return new32.astype(weight.dtype), new32

@@ -1,0 +1,182 @@
+"""Flat legacy registry names: linalg_*, random_*/sample_*, optimizer
+*_update kernels (ref: la_op.cc, sample_op.cc, optimizer_op.cc)."""
+import numpy as np
+
+from mxnet_tpu import nd
+
+
+def _spd(n=3, seed=0):
+    a = np.random.RandomState(seed).randn(n, n).astype(np.float32)
+    return a @ a.T + n * np.eye(n, dtype=np.float32)
+
+
+def test_linalg_flat_ops():
+    spd = _spd()
+    A = nd.array(spd)
+    np.testing.assert_allclose(nd.linalg_det(A).asnumpy(), np.linalg.det(spd),
+                               rtol=1e-4)
+    np.testing.assert_allclose(nd.linalg_inverse(A).asnumpy(),
+                               np.linalg.inv(spd), rtol=1e-3, atol=1e-4)
+    L = nd.linalg_potrf(A).asnumpy()
+    np.testing.assert_allclose(L @ L.T, spd, rtol=1e-4, atol=1e-4)
+    _, ld = nd.linalg_slogdet(A)
+    np.testing.assert_allclose(ld.asnumpy(), np.linalg.slogdet(spd)[1],
+                               rtol=1e-4)
+    B = nd.array(np.random.RandomState(1).randn(3, 4).astype(np.float32))
+    np.testing.assert_allclose(nd.linalg_gemm2(A, B).asnumpy(),
+                               spd @ B.asnumpy(), rtol=1e-4)
+    l_, q_ = nd.linalg_gelqf(B)
+    np.testing.assert_allclose(l_.asnumpy() @ q_.asnumpy(), B.asnumpy(),
+                               rtol=1e-3, atol=1e-4)
+    Lnd = nd.array(np.tril(spd))
+    X = nd.linalg_trsm(Lnd, B, alpha=2.0).asnumpy()
+    np.testing.assert_allclose(np.tril(spd) @ X, 2 * B.asnumpy(),
+                               rtol=1e-3, atol=1e-3)
+    tri = nd.linalg_extracttrian(A).asnumpy()
+    np.testing.assert_allclose(
+        nd.linalg_maketrian(nd.array(tri)).asnumpy(), np.tril(spd), rtol=1e-5)
+    d = nd.linalg_extractdiag(A).asnumpy()
+    np.testing.assert_allclose(nd.linalg_makediag(nd.array(d)).asnumpy(),
+                               np.diag(np.diag(spd)), rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.linalg_sumlogdiag(A).asnumpy(),
+        np.log(np.diag(spd)).sum(), rtol=1e-4)
+
+
+def test_random_flat_ops_statistics():
+    u = nd.random_uniform(low=2.0, high=3.0, shape=(1000,)).asnumpy()
+    assert (u >= 2).all() and (u < 3).all() and abs(u.mean() - 2.5) < 0.06
+    n = nd.random_normal(loc=1.0, scale=2.0, shape=(4000,)).asnumpy()
+    assert abs(n.mean() - 1.0) < 0.15 and abs(n.std() - 2.0) < 0.15
+    ri = nd.random_randint(low=0, high=5, shape=(100,)).asnumpy()
+    assert ri.min() >= 0 and ri.max() < 5
+    p = nd.random_poisson(lam=3.0, shape=(2000,)).asnumpy()
+    assert abs(p.mean() - 3.0) < 0.3
+    nb = nd.random_negative_binomial(k=2, p=0.5, shape=(2000,)).asnumpy()
+    assert abs(nb.mean() - 2.0) < 0.45   # NB mean = k(1-p)/p
+
+
+def test_sample_ops_per_row_params():
+    mu = nd.array(np.array([0.0, 10.0], np.float32))
+    sg = nd.array(np.array([1.0, 0.1], np.float32))
+    s = nd.sample_normal(mu, sg, shape=500).asnumpy()
+    assert s.shape == (2, 500)
+    assert abs(s[0].mean()) < 0.25 and abs(s[1].mean() - 10) < 0.05
+    probs = nd.array(np.array([[0.9, 0.1], [0.05, 0.95]], np.float32))
+    m = nd.sample_multinomial(probs, shape=400).asnumpy()
+    assert m.shape == (2, 400)
+    assert m[0].mean() < 0.25 and m[1].mean() > 0.75
+    assert nd.sample_multinomial(probs).shape == (2,)
+    mi, lp = nd.sample_multinomial(probs, shape=4, get_prob=True)
+    assert mi.shape == (2, 4) and lp.shape == (2, 4)
+    assert (lp.asnumpy() <= 0).all()
+    lam = nd.array(np.array([1.0, 8.0], np.float32))
+    sp = nd.sample_poisson(lam, shape=800).asnumpy()
+    assert abs(sp[0].mean() - 1.0) < 0.3 and abs(sp[1].mean() - 8.0) < 0.6
+
+
+def test_optimizer_update_kernels():
+    w = nd.array(np.ones(3, np.float32))
+    g = nd.array(np.full(3, 0.5, np.float32))
+    np.testing.assert_allclose(nd.sgd_update(w, g, lr=0.1).asnumpy(), 0.95,
+                               rtol=1e-6)
+    nd.sgd_update(w, g, lr=0.1, out=w)   # in-place via out=
+    np.testing.assert_allclose(w.asnumpy(), 0.95, rtol=1e-6)
+
+    mom = nd.array(np.zeros(3, np.float32))
+    w2, mom2 = nd.sgd_mom_update(w, g, mom, lr=0.1, momentum=0.9)
+    np.testing.assert_allclose(mom2.asnumpy(), -0.05, rtol=1e-5)
+
+    mean = nd.array(np.zeros(3, np.float32))
+    var = nd.array(np.zeros(3, np.float32))
+    w3, m_, v_ = nd.adam_update(w, g, mean, var, lr=0.01)
+    assert np.isfinite(w3.asnumpy()).all() and (m_.asnumpy() > 0).all()
+
+    z = nd.array(np.zeros(3, np.float32))
+    n_ = nd.array(np.zeros(3, np.float32))
+    wf, z2, n2 = nd.ftrl_update(w, g, z, n_, lr=0.1, lamda1=0.01)
+    assert np.isfinite(wf.asnumpy()).all()
+
+    # clip_gradient path
+    big = nd.array(np.full(3, 100.0, np.float32))
+    wc, = (nd.sgd_update(w, big, lr=0.1, clip_gradient=1.0),)
+    np.testing.assert_allclose(wc.asnumpy(), w.asnumpy() - 0.1, rtol=1e-5)
+
+
+def test_update_kernels_mutate_states_in_place():
+    """MXNet contract: state args are mutable inputs — the nd facade writes
+    new states back so momentum accumulates at legacy call sites."""
+    w = nd.array(np.ones(3, np.float32))
+    g = nd.array(np.full(3, 0.5, np.float32))
+    mom = nd.array(np.zeros(3, np.float32))
+    nd.sgd_mom_update(w, g, mom, lr=0.1, momentum=0.9, out=w)
+    np.testing.assert_allclose(mom.asnumpy(), -0.05, rtol=1e-5)  # mutated
+    np.testing.assert_allclose(w.asnumpy(), 0.95, rtol=1e-5)
+    nd.sgd_mom_update(w, g, mom, lr=0.1, momentum=0.9, out=w)
+    # second step: momentum accumulated (0.9*-0.05 - 0.1*0.5 = -0.095)
+    np.testing.assert_allclose(mom.asnumpy(), -0.095, rtol=1e-5)
+
+    mean = nd.array(np.zeros(3, np.float32))
+    var = nd.array(np.zeros(3, np.float32))
+    nd.adam_update(w, g, mean, var, lr=0.01, out=w)
+    assert (mean.asnumpy() > 0).all() and (var.asnumpy() > 0).all()
+
+
+def test_mp_sgd_and_signum_update():
+    """mp_sgd keeps an fp32 master; signum applies wd in the momentum and
+    wd_lh on the weight (ref: optimizer_op.cc)."""
+    import jax.numpy as jnp
+
+    w16 = nd.array(np.ones(3, np.float32)).astype("bfloat16")
+    g16 = nd.array(np.full(3, 0.5, np.float32)).astype("bfloat16")
+    w32 = nd.array(np.ones(3, np.float32))
+    new16, new32 = nd.mp_sgd_update(w16, g16, w32, lr=0.1)
+    np.testing.assert_allclose(new32.asnumpy(), 0.95, rtol=1e-6)  # fp32 exact
+    assert new16.dtype == jnp.bfloat16
+
+    w = nd.array(np.ones(3, np.float32))
+    g = nd.array(np.full(3, 0.5, np.float32))
+    mom = nd.array(np.zeros(3, np.float32))
+    new_w, new_mom = nd.signum_update(w, g, mom, lr=0.1, momentum=0.9,
+                                      wd=0.2, wd_lh=0.01)
+    # mom = -(1-0.9)*(0.5 + 0.2*1) = -0.07; w = (1-0.1*0.01)*1 + 0.1*sign(-0.07)
+    np.testing.assert_allclose(new_mom.asnumpy(), -0.07, rtol=1e-5)
+    np.testing.assert_allclose(new_w.asnumpy(), 0.999 - 0.1, rtol=1e-5)
+
+
+def test_linalg_flat_ops_differentiable():
+    """linalg_* must carry gradients (the Gaussian-likelihood training
+    pattern); potri takes the Cholesky FACTOR like mx.linalg.potri."""
+    from mxnet_tpu import autograd
+
+    spd = _spd(seed=5)
+    A = nd.array(spd)
+    A.attach_grad()
+    with autograd.record():
+        L = nd.linalg_potrf(A)
+        loss = nd.linalg_sumlogdiag(L)
+    loss.backward()
+    g = A.grad.asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+    L = nd.linalg_potrf(A)
+    P = nd.linalg_potri(L).asnumpy()   # input is the FACTOR
+    np.testing.assert_allclose(P, np.linalg.inv(spd), rtol=1e-3, atol=1e-4)
+
+
+def test_amp_helpers_and_activations():
+    w = nd.array(np.ones(3, np.float32))
+    g = nd.array(np.full(3, 0.5, np.float32))
+    assert nd.multi_all_finite(w, g).asnumpy()[0] == 1.0
+    bad = nd.array(np.array([np.inf], np.float32))
+    assert nd.multi_all_finite(w, bad).asnumpy()[0] == 0.0
+    np.testing.assert_allclose(nd.multi_sum_sq(w, g).asnumpy(), [3.0, 0.75],
+                               rtol=1e-5)
+    x = nd.array(np.linspace(-3, 3, 7).astype(np.float32))
+    np.testing.assert_allclose(
+        nd.log_sigmoid(x).asnumpy(),
+        np.log(1 / (1 + np.exp(-x.asnumpy()))), rtol=1e-4, atol=1e-5)
+    sp = np.log1p(np.exp(x.asnumpy()))
+    np.testing.assert_allclose(nd.mish(x).asnumpy(),
+                               x.asnumpy() * np.tanh(sp), rtol=1e-4,
+                               atol=1e-5)

@@ -61,5 +61,6 @@ from . import attribute
 from .attribute import AttrScope
 from . import name
 from . import onnx  # import/export (ref: python/mxnet/onnx)
+from . import contrib  # mx.contrib.{ndarray,symbol,quantization,onnx,text}
 
 __all__ = ["nd", "gluon", "autograd", "cpu", "gpu", "tpu", "Context", "NDArray"]

@@ -1,0 +1,10 @@
+"""``mx.contrib`` namespace (ref: python/mxnet/contrib/__init__.py).
+
+Routes to the concrete implementations: contrib ops live in nd/sym.contrib
+(generated from _contrib_ops.py), quantization and onnx are first-class
+modules here, and text implements the vocabulary/embedding utilities."""
+from ..nd import contrib as ndarray  # noqa: F401  (mx.contrib.ndarray ops)
+from .. import sym_contrib as symbol  # noqa: F401
+from .. import quantization  # noqa: F401
+from .. import onnx  # noqa: F401
+from . import text  # noqa: F401

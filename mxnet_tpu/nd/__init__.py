@@ -53,7 +53,9 @@ def _make_update(opname, state_pos):
         for o, i in zip(outs[1:], state_pos):
             args[i]._data = o._data
         if out is not None:
+            # MXNet returns the out handle itself (return-identity contract)
             out._data = outs[0]._data
+            return out if len(outs) == 1 else (out,) + outs[1:]
         return res
 
     f.__name__ = opname
@@ -62,6 +64,17 @@ def _make_update(opname, state_pos):
 
 for _name, _pos in _UPDATE_STATE_ARGS.items():
     setattr(_mod, _name, _make_update(_name, _pos))
+
+
+def _sample_multinomial_dispatch(data, *args, get_prob=False, **kwargs):
+    # get_prob changes the op's arity — route to the matching registry entry
+    if get_prob:
+        return invoke("_sample_multinomial_prob", (data,) + args, kwargs)
+    return invoke("sample_multinomial", (data,) + args, kwargs)
+
+
+_sample_multinomial_dispatch.__name__ = "sample_multinomial"
+sample_multinomial = _sample_multinomial_dispatch
 
 
 def __getattr__(name):  # ops registered later (e.g. pallas-backed) resolve lazily

@@ -14,6 +14,7 @@ from .. import random as _rng
 from ..base import resolve_dtype
 from ..context import current_context
 from ..ndarray import NDArray
+from ..ops import rand_kernels as _rk  # ONE kernel per distribution
 
 
 def _finish(data, ctx):
@@ -23,8 +24,8 @@ def _finish(data, ctx):
 
 def uniform(low=0.0, high=1.0, shape=(1,), dtype=None, ctx=None, out=None):
     dtype = resolve_dtype(dtype) or np.float32
-    r = jax.random.uniform(_rng.next_key(), tuple(shape), dtype, low, high)
-    res = _finish(r, ctx)
+    res = _finish(_rk.k_uniform(_rng.next_key(), tuple(shape), dtype,
+                                low, high), ctx)
     if out is not None:
         out._data = res._data
         return out
@@ -33,8 +34,8 @@ def uniform(low=0.0, high=1.0, shape=(1,), dtype=None, ctx=None, out=None):
 
 def normal(loc=0.0, scale=1.0, shape=(1,), dtype=None, ctx=None, out=None):
     dtype = resolve_dtype(dtype) or np.float32
-    r = jax.random.normal(_rng.next_key(), tuple(shape), dtype) * scale + loc
-    res = _finish(r, ctx)
+    res = _finish(_rk.k_normal(_rng.next_key(), tuple(shape), dtype,
+                               loc, scale), ctx)
     if out is not None:
         out._data = res._data
         return out
@@ -46,34 +47,32 @@ def randn(*shape, loc=0.0, scale=1.0, dtype=None, ctx=None):
 
 
 def randint(low, high, shape=(1,), dtype="int32", ctx=None):
-    r = jax.random.randint(_rng.next_key(), tuple(shape), low, high,
-                           dtype=resolve_dtype(dtype))
-    return _finish(r, ctx)
+    return _finish(_rk.k_randint(_rng.next_key(), tuple(shape),
+                                 resolve_dtype(dtype), low, high), ctx)
 
 
 def exponential(scale=1.0, shape=(1,), dtype=None, ctx=None):
     dtype = resolve_dtype(dtype) or np.float32
-    r = jax.random.exponential(_rng.next_key(), tuple(shape), dtype) * scale
-    return _finish(r, ctx)
+    return _finish(_rk.k_exponential(_rng.next_key(), tuple(shape), dtype,
+                                     scale), ctx)
 
 
 def gamma(alpha=1.0, beta=1.0, shape=(1,), dtype=None, ctx=None):
     dtype = resolve_dtype(dtype) or np.float32
-    r = jax.random.gamma(_rng.next_key(), alpha, tuple(shape), dtype) * beta
-    return _finish(r, ctx)
+    return _finish(_rk.k_gamma(_rng.next_key(), tuple(shape), dtype,
+                               alpha, beta), ctx)
 
 
 def poisson(lam=1.0, shape=(1,), dtype=None, ctx=None):
-    r = jax.random.poisson(_rng.next_key(), lam, tuple(shape))
     dtype = resolve_dtype(dtype) or np.float32
-    return _finish(r.astype(dtype), ctx)
+    return _finish(_rk.k_poisson(_rng.next_key(), tuple(shape), dtype, lam),
+                   ctx)
 
 
 def negative_binomial(k=1, p=1.0, shape=(1,), dtype=None, ctx=None):
-    g = jax.random.gamma(_rng.next_key(), k, tuple(shape)) * (1 - p) / p
-    r = jax.random.poisson(_rng.next_key(), g, tuple(shape))
     dtype = resolve_dtype(dtype) or np.float32
-    return _finish(r.astype(dtype), ctx)
+    return _finish(_rk.k_negative_binomial(_rng.next_key(), tuple(shape),
+                                           dtype, k, p), ctx)
 
 
 def multinomial(data, shape=1, get_prob=False, dtype="int32"):

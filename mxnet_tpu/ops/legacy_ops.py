@@ -110,10 +110,23 @@ def _makediag(a, offset):
     return out.at[..., r, c].set(a)
 
 
+def _trian_indices(n, offset, lower):
+    """MXNet la_op contract: offset picks WHICH triangle — offset>0 the
+    super-diagonal triangle starting at that diagonal, offset<0 the
+    sub-diagonal one (``lower`` is only consulted at offset 0)."""
+    import numpy as onp
+
+    if offset > 0:
+        return onp.triu_indices(n, offset)
+    if offset < 0:
+        # triangle BELOW diagonal `offset`: rows-cols >= -offset
+        rows, cols = onp.tril_indices(n, offset)
+        return rows, cols
+    return onp.tril_indices(n, 0) if lower else onp.triu_indices(n, 0)
+
+
 def _extracttrian(a, *, offset=0, lower=True):
-    n = a.shape[-1]
-    rows, cols = jnp.tril_indices(n, offset) if lower \
-        else jnp.triu_indices(n, offset)
+    rows, cols = _trian_indices(a.shape[-1], offset, lower)
     return a[..., rows, cols]
 
 
@@ -121,16 +134,12 @@ _reg_linalg("linalg_extracttrian", _extracttrian)
 
 
 def _maketrian(a, *, offset=0, lower=True):
-    import numpy as onp
-
-    # solve k(k+1)/2-ish inverse: find n with len == tri count at offset
+    # invert the count: find n whose offset-triangle holds exactly m entries
     m = a.shape[-1]
     n = 1
-    while len(onp.tril_indices(n, offset)[0] if lower
-              else onp.triu_indices(n, offset)[0]) < m:
+    while len(_trian_indices(n, offset, lower)[0]) < m:
         n += 1
-    rows, cols = (onp.tril_indices(n, offset) if lower
-                  else onp.triu_indices(n, offset))
+    rows, cols = _trian_indices(n, offset, lower)
     out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
     return out.at[..., rows, cols].set(a)
 
@@ -155,38 +164,32 @@ def _reg_random(name, sampler):
     return op
 
 
+from . import rand_kernels as _rk  # noqa: E402  (shared with nd.random)
+
 _reg_random("random_uniform",
             lambda key, shp, dt, low=0.0, high=1.0:
-            jax.random.uniform(key, shp, dt, low, high))
+            _rk.k_uniform(key, shp, dt, low, high))
 _reg_random("random_normal",
             lambda key, shp, dt, loc=0.0, scale=1.0:
-            jax.random.normal(key, shp, dt) * scale + loc)
+            _rk.k_normal(key, shp, dt, loc, scale))
 _reg_random("random_exponential",
             lambda key, shp, dt, lam=1.0:
-            jax.random.exponential(key, shp, dt) / lam)
+            _rk.k_exponential(key, shp, dt, 1.0 / lam))
 _reg_random("random_gamma",
             lambda key, shp, dt, alpha=1.0, beta=1.0:
-            jax.random.gamma(key, alpha, shp, dt) * beta)
+            _rk.k_gamma(key, shp, dt, alpha, beta))
 _reg_random("random_poisson",
-            lambda key, shp, dt, lam=1.0:
-            jax.random.poisson(key, lam, shp).astype(dt))
+            lambda key, shp, dt, lam=1.0: _rk.k_poisson(key, shp, dt, lam))
 _reg_random("random_negative_binomial",
             lambda key, shp, dt, k=1, p=0.5:
-            _neg_binomial(key, shp, k, p).astype(dt))
-
-
-def _neg_binomial(key, shp, k, p):
-    # NB(k, p) = Poisson(Gamma(k, (1-p)/p)) (ref: sample_op.cc)
-    kg, kp = jax.random.split(key)
-    lam = jax.random.gamma(kg, k, shp) * ((1.0 - p) / p)
-    return jax.random.poisson(kp, lam, shp)
+            _rk.k_negative_binomial(key, shp, dt, k, p))
 
 
 @register_op("random_randint", needs_rng=True, nondiff=True)
 def random_randint(*, low, high, shape=(1,), dtype="int32", ctx=None,
                    key=None):
-    return jax.random.randint(key, _rand_shape(shape), low, high,
-                              resolve_dtype(dtype) or jnp.int32)
+    return _rk.k_randint(key, _rand_shape(shape),
+                         resolve_dtype(dtype) or jnp.int32, low, high)
 
 
 # sample_*: per-row parameter arrays → `shape` draws per row
@@ -236,11 +239,7 @@ def sample_poisson(lam, *, shape=(), dtype="float32", key=None):
     return p.astype(resolve_dtype(dtype) or jnp.float32)
 
 
-@register_op("sample_multinomial", needs_rng=True, nondiff=True)
-def sample_multinomial(data, *, shape=(), get_prob=False, dtype="int32",
-                       key=None):
-    """Draw index samples from probability rows (ref: sample_op.cc
-    _sample_multinomial)."""
+def _multinomial_draw(data, shape, dtype, key):
     extra = _rand_shape(shape) if shape else ()
     logits = jnp.log(jnp.maximum(data, 1e-30))
     n = 1
@@ -250,14 +249,33 @@ def sample_multinomial(data, *, shape=(), get_prob=False, dtype="int32",
                                    shape=data.shape[:-1] + (max(n, 1),))
     out = draws.reshape(data.shape[:-1] + extra) if extra \
         else draws.reshape(data.shape[:-1])
-    out = out.astype(resolve_dtype(dtype) or jnp.int32)
+    return out.astype(resolve_dtype(dtype) or jnp.int32), logits
+
+
+@register_op("sample_multinomial", needs_rng=True, nondiff=True)
+def sample_multinomial(data, *, shape=(), get_prob=False, dtype="int32",
+                       key=None):
+    """Draw index samples from probability rows (ref: sample_op.cc
+    _sample_multinomial). get_prob=True has its own 2-output registry entry
+    (static arity keeps the symbol facade's tuple mirroring honest); the nd
+    facade dispatches between the two."""
     if get_prob:
-        lp = jnp.take_along_axis(
-            jax.nn.log_softmax(logits, axis=-1),
-            out.reshape(data.shape[:-1] + (-1,)).astype(jnp.int32),
-            axis=-1).reshape(out.shape)
-        return out, lp
+        raise ValueError("get_prob=True resolves to the 2-output op "
+                         "'_sample_multinomial_prob' (the nd facade does "
+                         "this automatically)")
+    out, _ = _multinomial_draw(data, shape, dtype, key)
     return out
+
+
+@register_op("_sample_multinomial_prob", needs_rng=True, nondiff=True,
+             n_outputs=2)
+def _sample_multinomial_prob(data, *, shape=(), dtype="int32", key=None):
+    out, logits = _multinomial_draw(data, shape, dtype, key)
+    lp = jnp.take_along_axis(
+        jax.nn.log_softmax(logits, axis=-1),
+        out.reshape(data.shape[:-1] + (-1,)).astype(jnp.int32),
+        axis=-1).reshape(out.shape)
+    return out, lp
 
 
 # ------------------------------------------------- optimizer update kernels

@@ -1,0 +1,46 @@
+"""ONE sampling kernel per distribution (key, shape, dtype, params) → array.
+
+Shared by the stateful nd.random namespace (which feeds keys from the global
+threefry chain) and the flat random_*/sample_* registry ops in legacy_ops.py
+(which get keys injected by the op facade) — so the two surfaces cannot
+drift (ref: src/operator/random/sample_op.cc, one kernel per distribution).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["k_uniform", "k_normal", "k_exponential", "k_gamma", "k_poisson",
+           "k_negative_binomial", "k_randint"]
+
+
+def k_uniform(key, shape, dtype, low=0.0, high=1.0):
+    return jax.random.uniform(key, shape, dtype, low, high)
+
+
+def k_normal(key, shape, dtype, loc=0.0, scale=1.0):
+    return jax.random.normal(key, shape, dtype) * scale + loc
+
+
+def k_exponential(key, shape, dtype, scale=1.0):
+    """Mean = scale (the lam parameterization is scale = 1/lam)."""
+    return jax.random.exponential(key, shape, dtype) * scale
+
+
+def k_gamma(key, shape, dtype, alpha=1.0, beta=1.0):
+    return jax.random.gamma(key, alpha, shape, dtype) * beta
+
+
+def k_poisson(key, shape, dtype, lam=1.0):
+    return jax.random.poisson(key, lam, shape).astype(dtype)
+
+
+def k_negative_binomial(key, shape, dtype, k=1, p=0.5):
+    """NB(k, p) = Poisson(Gamma(k, (1-p)/p)) (ref: sample_op.cc)."""
+    kg, kp = jax.random.split(key)
+    lam = jax.random.gamma(kg, k, shape) * ((1.0 - p) / p)
+    return jax.random.poisson(kp, lam, shape).astype(dtype)
+
+
+def k_randint(key, shape, dtype, low, high):
+    return jax.random.randint(key, shape, low, high, dtype)

@@ -180,3 +180,33 @@ def test_amp_helpers_and_activations():
     np.testing.assert_allclose(nd.mish(x).asnumpy(),
                                x.asnumpy() * np.tanh(sp), rtol=1e-4,
                                atol=1e-5)
+
+
+def test_trian_offset_semantics_and_multinomial_arity():
+    """offset picks the starting diagonal's triangle (ref: la_op.cc doc
+    example); sample_multinomial's get_prob path uses a static 2-output op."""
+    a = nd.array(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    np.testing.assert_array_equal(
+        nd.linalg_extracttrian(a, offset=1).asnumpy(), [2.0])
+    np.testing.assert_array_equal(
+        nd.linalg_extracttrian(a, offset=-1).asnumpy(), [3.0])
+    back = nd.linalg_maketrian(nd.array(np.array([7.0], np.float32)),
+                               offset=1).asnumpy()
+    np.testing.assert_array_equal(back, [[0, 7], [0, 0]])
+
+    import pytest
+
+    from mxnet_tpu.ops.legacy_ops import sample_multinomial as raw_op
+    with pytest.raises(ValueError):
+        raw_op(np.ones((2, 2), np.float32) / 2, get_prob=True, key=None)
+
+
+def test_update_out_return_identity():
+    """nd.sgd_update(..., out=w) returns w itself (MXNet contract)."""
+    w = nd.array(np.ones(3, np.float32))
+    g = nd.array(np.full(3, 0.5, np.float32))
+    y = nd.sgd_update(w, g, lr=0.1, out=w)
+    assert y is w
+    mom = nd.array(np.zeros(3, np.float32))
+    res = nd.sgd_mom_update(w, g, mom, lr=0.1, momentum=0.9, out=w)
+    assert res[0] is w

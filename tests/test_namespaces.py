@@ -97,3 +97,39 @@ def test_nd_save_load_namespace_visible():
     import numpy as np
 
     assert callable(mx.nd.save) and callable(mx.nd.load)
+
+
+def test_contrib_text_vocabulary_and_embedding(tmp_path):
+    """mx.contrib.text Vocabulary/CustomEmbedding (ref:
+    python/mxnet/contrib/text/{vocab,embedding}.py)."""
+    import numpy as np
+
+    from mxnet_tpu.contrib import text
+
+    c = text.count_tokens_from_str("the cat sat on the mat\nthe dog")
+    assert c["the"] == 3
+    v = text.Vocabulary(c, min_freq=1, reserved_tokens=["<pad>"])
+    assert v.to_indices("the") > 1 and v.to_indices("unicorn") == 0
+    assert v.to_tokens(0) == "<unk>" and v.idx_to_token[1] == "<pad>"
+    assert len(v) == 2 + len(c)
+    v2 = text.Vocabulary(c, most_freq_count=2)
+    assert len(v2) == 3   # unk + top-2
+    with pytest.raises(ValueError):
+        text.Vocabulary(c, reserved_tokens=["<unk>"])
+
+    p = tmp_path / "emb.txt"
+    p.write_text("the 1 0 0\ncat 0 1 0\nmat 0 0 1\n")
+    emb = text.CustomEmbedding(str(p), vocabulary=v)
+    assert emb.idx_to_vec.shape == (len(v), 3)
+    np.testing.assert_array_equal(emb.idx_to_vec[v.to_indices("cat")],
+                                  [0, 1, 0])
+    np.testing.assert_array_equal(emb.get_vecs_by_tokens("unicorn"),
+                                  [0, 0, 0])   # single token → 1-D
+    assert emb.get_vecs_by_tokens(["the", "cat"]).shape == (2, 3)
+    # reserved tokens in the counter must not consume most_freq_count slots
+    import collections
+    c2 = collections.Counter({"<pad>": 10, "a": 5, "b": 3})
+    v3 = text.Vocabulary(c2, most_freq_count=2, reserved_tokens=["<pad>"])
+    assert "a" in v3.token_to_idx and "b" in v3.token_to_idx
+    assert mx.contrib.quantization is not None
+    assert hasattr(mx.contrib.ndarray, "box_nms")

@@ -28,6 +28,53 @@ class Dataset:
     def take(self, count):
         return SimpleDataset([self[i] for i in range(min(count, len(self)))])
 
+    def shard(self, num_shards, index):
+        """Every ``num_shards``-th sample starting at ``index`` — the
+        per-worker slice for distributed loading (ref: dataset.py:shard;
+        trailing shards may be one element shorter, like upstream)."""
+        if not 0 <= index < num_shards:
+            raise ValueError("shard index %d out of range [0, %d)"
+                             % (index, num_shards))
+        return _ShardedDataset(self, num_shards, index)
+
+    def sample(self, sampler):
+        """Dataset reordered/subsetted by a Sampler's indices
+        (ref: dataset.py:sample)."""
+        return _SampledDataset(self, list(sampler))
+
+
+class _ShardedDataset(Dataset):
+    def __init__(self, data, num_shards, index):
+        self._data = data
+        self._num = num_shards
+        self._index = index
+
+    def __len__(self):
+        n = len(self._data)
+        return (n - self._index + self._num - 1) // self._num
+
+    def __getitem__(self, idx):
+        n = len(self)
+        if idx < 0:
+            idx += n
+        if not 0 <= idx < n:
+            # without this, a negative idx would silently read ANOTHER
+            # shard's element, breaking the exact-partition guarantee
+            raise IndexError("shard index %d out of range [0, %d)" % (idx, n))
+        return self._data[self._index + idx * self._num]
+
+
+class _SampledDataset(Dataset):
+    def __init__(self, data, indices):
+        self._data = data
+        self._indices = indices
+
+    def __len__(self):
+        return len(self._indices)
+
+    def __getitem__(self, idx):
+        return self._data[self._indices[idx]]
+
 
 class _TransformFirstClosure:
     def __init__(self, fn):

@@ -76,21 +76,18 @@ def negative_binomial(k=1, p=1.0, shape=(1,), dtype=None, ctx=None):
 
 
 def multinomial(data, shape=1, get_prob=False, dtype="int32"):
-    logits = jnp.log(jnp.maximum(data._data, 1e-30))
-    n = shape if isinstance(shape, int) else int(np.prod(shape))
-    ks = jax.random.split(_rng.next_key(), n)
-    if logits.ndim == 1:
-        samp = jnp.stack([jax.random.categorical(k, logits) for k in ks])
-        samp = samp if n > 1 else samp[0]
-    else:
-        samp = jnp.stack([jax.random.categorical(k, logits, axis=-1) for k in ks], axis=-1)
-        samp = samp if n > 1 else samp[..., 0]
-    out = NDArray(samp.astype(resolve_dtype(dtype)))
+    """Shares the registry's _multinomial_draw kernel (one categorical
+    implementation; ref: sample_op.cc). shape=1 squeezes, like upstream."""
+    from ..ops.legacy_ops import _multinomial_draw, _sample_multinomial_prob
+
+    squeeze = isinstance(shape, int) and shape == 1
+    kshape = () if squeeze else shape
     if get_prob:
-        lp = jax.nn.log_softmax(logits, axis=-1)
-        probs = jnp.take_along_axis(lp, jnp.atleast_2d(samp.astype(jnp.int32)), axis=-1)
-        return out, NDArray(probs)
-    return out
+        out, lp = _sample_multinomial_prob(data._data, shape=kshape,
+                                           dtype=dtype, key=_rng.next_key())
+        return NDArray(out), NDArray(lp)
+    out, _ = _multinomial_draw(data._data, kshape, dtype, _rng.next_key())
+    return NDArray(out)
 
 
 def shuffle(data):

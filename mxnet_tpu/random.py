@@ -19,13 +19,17 @@ _state = threading.local()
 
 def _ensure():
     if not hasattr(_state, "key"):
-        _state.key = jax.random.PRNGKey(0)
+        # concrete even when first touched inside a jit trace (a staged key
+        # stored in module state would leak a tracer out of the trace)
+        with jax.ensure_compile_time_eval():
+            _state.key = jax.random.PRNGKey(0)
         _state.counter = 0
 
 
 def seed(seed_state, ctx=None):
     """mx.random.seed parity; ctx accepted for API compat (single key domain)."""
-    _state.key = jax.random.PRNGKey(int(seed_state))
+    with jax.ensure_compile_time_eval():
+        _state.key = jax.random.PRNGKey(int(seed_state))
     _state.counter = 0
 
 

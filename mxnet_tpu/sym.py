@@ -33,6 +33,13 @@ for _name in list(_REG):
         setattr(_mod, _name, _builder(_name))
 
 
+def sample_multinomial(data, *args, get_prob=False, name=None, **kwargs):
+    """get_prob changes arity — route to the matching static-arity registry
+    entry (mirrors the nd facade's dispatch)."""
+    op = "_sample_multinomial_prob" if get_prob else "sample_multinomial"
+    return _builder(op)(data, *args, name=name, **kwargs)
+
+
 # creation ops: not registry entries (nd implements them directly), so the
 # symbol forms are explicit builders over the _filled op
 def zeros(shape, dtype="float32", ctx=None, name=None, **kwargs):

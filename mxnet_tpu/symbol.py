@@ -282,7 +282,17 @@ def _eval(sym, env, cache):
         val = parent[sym._attrs["index"]]
     else:
         ins = [_eval(i, env, cache) for i in sym._inputs]
-        val = OP_REGISTRY[sym._op].fn(*ins, **sym._attrs)
+        opdef = OP_REGISTRY[sym._op]
+        attrs = sym._attrs
+        if opdef.needs_rng and "key" not in attrs:
+            # sampling ops in a symbol graph draw from the global chain at
+            # trace time: each (re)trace gets a fresh key constant; a cached
+            # executor replays the same stream until rebound (the compiled-
+            # program analogue of MXNet's per-build random resource seed)
+            from . import random as _rng
+
+            attrs = {**attrs, "key": _rng.next_key()}
+        val = opdef.fn(*ins, **attrs)
     cache[id(sym)] = val
     return val
 

@@ -444,3 +444,21 @@ def test_csv_iter_keeps_short_tail_and_tiny_rollover(tmp_path):
     assert list(tiny) == []
     tiny.reset()
     assert list(tiny) == []   # still nothing — no fabricated duplicates
+
+
+def test_dataset_shard_and_sample():
+    """Dataset.shard partitions without overlap; Dataset.sample reorders by
+    a Sampler (ref: gluon/data/dataset.py shard/sample)."""
+    import numpy as np
+    import pytest
+
+    ds = gluon.data.ArrayDataset(np.arange(10).astype(np.float32))
+    shards = [ds.shard(3, i) for i in range(3)]
+    assert [len(s) for s in shards] == [4, 3, 3]
+    seen = sorted(float(s[i]) for s in shards for i in range(len(s)))
+    assert seen == list(range(10))   # exact partition
+    with pytest.raises(ValueError):
+        ds.shard(3, 3)
+
+    sub = ds.sample(gluon.data.SequentialSampler(4))
+    assert len(sub) == 4 and float(sub[3]) == 3.0

@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Environment diagnosis (ref: incubator-mxnet tools/diagnose.py).
+
+Prints platform, Python, key package versions, mxnet_tpu feature flags, and
+device visibility — the report users attach to bug reports.
+
+Run: python tools/diagnose.py [--no-device]  (device probe can hang when the
+TPU relay is down; --no-device skips it)
+"""
+import argparse
+import os
+import platform
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--no-device", action="store_true",
+                    help="skip the jax device probe (it can block when the "
+                         "accelerator relay is unreachable)")
+    args = ap.parse_args()
+
+    print("----------Platform Info----------")
+    print("Platform     :", platform.platform())
+    print("system       :", platform.system())
+    print("node         :", platform.node())
+    print("release      :", platform.release())
+    print("version      :", platform.version())
+
+    print("----------Python Info----------")
+    print("Version      :", platform.python_version())
+    print("Compiler     :", platform.python_compiler())
+    print("Build        :", platform.python_build())
+
+    print("----------Environment----------")
+    for k in sorted(os.environ):
+        if any(s in k for s in ("MXNET", "JAX", "XLA", "TPU", "OMP")):
+            print("%s=\"%s\"" % (k, os.environ[k]))
+
+    print("----------Package Info----------")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    for name in ("jax", "jaxlib", "numpy", "flax", "optax", "orbax.checkpoint"):
+        try:
+            mod = __import__(name)
+            print("%-16s: %s" % (name, getattr(mod, "__version__", "?")))
+        except Exception as e:
+            print("%-16s: unavailable (%s)" % (name, e))
+    import mxnet_tpu
+    print("%-16s: %s" % ("mxnet_tpu", mxnet_tpu.__version__))
+
+    if not args.no_device:
+        # Features() also probes the backend (jax.default_backend inside
+        # runtime._detect) — it must sit behind the same flag
+        print("----------Feature Info----------")
+        print(mxnet_tpu.runtime.Features())
+        print("----------Device Info----------")
+        import jax
+        try:
+            print("backend      :", jax.default_backend())
+            print("devices      :", jax.devices())
+        except Exception as e:
+            print("device probe failed:", e)
+
+
+if __name__ == "__main__":
+    main()

@@ -11,3 +11,6 @@ from . import utils
 from . import data
 from . import model_zoo
 from . import contrib
+
+# 2.x location: metrics live under gluon.metric as well (ref: python/mxnet/gluon/metric.py)
+from .. import metric  # noqa: F401,E402

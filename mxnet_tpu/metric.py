@@ -1,7 +1,7 @@
 """Evaluation metrics (ref: python/mxnet/metric.py)."""
 from __future__ import annotations
 
-import numpy as np
+import numpy
 
 from .ndarray import NDArray
 
@@ -28,7 +28,7 @@ def create(metric, **kwargs):
 
 
 def _np(x):
-    return x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
+    return x.asnumpy() if isinstance(x, NDArray) else numpy.asarray(x)
 
 
 class EvalMetric:
@@ -64,12 +64,12 @@ class Accuracy(EvalMetric):
         self.axis = axis
 
     def update(self, labels, preds):
-        if isinstance(labels, (NDArray, np.ndarray)):
+        if isinstance(labels, (NDArray, numpy.ndarray)):
             labels, preds = [labels], [preds]
         for label, pred in zip(labels, preds):
             label, pred = _np(label), _np(pred)
             if pred.ndim > label.ndim:
-                pred = np.argmax(pred, axis=self.axis)
+                pred = numpy.argmax(pred, axis=self.axis)
             self.sum_metric += float((pred.astype("int64").flat == label.astype("int64").flat).sum())
             self.num_inst += label.size
 
@@ -81,11 +81,11 @@ class TopKAccuracy(EvalMetric):
         self.top_k = top_k
 
     def update(self, labels, preds):
-        if isinstance(labels, (NDArray, np.ndarray)):
+        if isinstance(labels, (NDArray, numpy.ndarray)):
             labels, preds = [labels], [preds]
         for label, pred in zip(labels, preds):
             label, pred = _np(label).astype("int64"), _np(pred)
-            topk = np.argsort(-pred, axis=-1)[:, :self.top_k]
+            topk = numpy.argsort(-pred, axis=-1)[:, :self.top_k]
             self.sum_metric += float((topk == label[:, None]).any(axis=1).sum())
             self.num_inst += label.shape[0]
 
@@ -101,22 +101,22 @@ class _ConfusionMetric(EvalMetric):
         self.fn = {}
 
     def update(self, labels, preds):
-        if isinstance(labels, (NDArray, np.ndarray)):
+        if isinstance(labels, (NDArray, numpy.ndarray)):
             labels, preds = [labels], [preds]
         for label, pred in zip(labels, preds):
             label, pred = _np(label).astype("int64").ravel(), _np(pred)
             if pred.ndim > 1:
-                pred = np.argmax(pred, axis=-1)
+                pred = numpy.argmax(pred, axis=-1)
             pred = pred.astype("int64").ravel()
             # one-pass confusion matrix; per-class loops would cost O(C)
             # full-array scans per batch
             c = int(max(label.max(initial=0), pred.max(initial=0))) + 1
-            cm = np.bincount(label * c + pred,
-                             minlength=c * c).reshape(c, c).astype(np.float64)
+            cm = numpy.bincount(label * c + pred,
+                             minlength=c * c).reshape(c, c).astype(numpy.float64)
             row = cm.sum(axis=1)  # true class counts
             col = cm.sum(axis=0)  # predicted class counts
-            diag = np.diag(cm)
-            for k in np.nonzero(row + col)[0]:
+            diag = numpy.diag(cm)
+            for k in numpy.nonzero(row + col)[0]:
                 k = int(k)
                 self.tp[k] = self.tp.get(k, 0.0) + diag[k]
                 self.fp[k] = self.fp.get(k, 0.0) + (col[k] - diag[k])
@@ -156,7 +156,7 @@ class F1(_ConfusionMetric):
                                        self.fn.get(1, 0.0))
         scores = [self._f1(self.tp[c], self.fp[c], self.fn[c])
                   for c in classes]
-        return self.name, float(np.mean(scores))
+        return self.name, float(numpy.mean(scores))
 
 
 @register
@@ -172,7 +172,7 @@ class MCC(_ConfusionMetric):
         fp = self.fp.get(1, 0.0)
         fn = self.fn.get(1, 0.0)
         tn = self.tp.get(0, 0.0)
-        denom = np.sqrt((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+        denom = numpy.sqrt((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
         return self.name, float((tp * tn - fp * fn) / max(denom, 1e-12))
 
 
@@ -182,11 +182,11 @@ class MAE(EvalMetric):
         super().__init__(name, **kwargs)
 
     def update(self, labels, preds):
-        if isinstance(labels, (NDArray, np.ndarray)):
+        if isinstance(labels, (NDArray, numpy.ndarray)):
             labels, preds = [labels], [preds]
         for label, pred in zip(labels, preds):
             label, pred = _np(label), _np(pred)
-            self.sum_metric += float(np.abs(label - pred.reshape(label.shape)).mean())
+            self.sum_metric += float(numpy.abs(label - pred.reshape(label.shape)).mean())
             self.num_inst += 1
 
 
@@ -196,7 +196,7 @@ class MSE(EvalMetric):
         super().__init__(name, **kwargs)
 
     def update(self, labels, preds):
-        if isinstance(labels, (NDArray, np.ndarray)):
+        if isinstance(labels, (NDArray, numpy.ndarray)):
             labels, preds = [labels], [preds]
         for label, pred in zip(labels, preds):
             label, pred = _np(label), _np(pred)
@@ -211,7 +211,7 @@ class RMSE(MSE):
 
     def get(self):
         name, value = super().get()
-        return name, float(np.sqrt(value))
+        return name, float(numpy.sqrt(value))
 
 
 @register
@@ -221,12 +221,12 @@ class CrossEntropy(EvalMetric):
         self.eps = eps
 
     def update(self, labels, preds):
-        if isinstance(labels, (NDArray, np.ndarray)):
+        if isinstance(labels, (NDArray, numpy.ndarray)):
             labels, preds = [labels], [preds]
         for label, pred in zip(labels, preds):
             label, pred = _np(label).astype("int64").ravel(), _np(pred)
-            prob = pred.reshape(-1, pred.shape[-1])[np.arange(label.size), label]
-            self.sum_metric += float(-np.log(prob + self.eps).sum())
+            prob = pred.reshape(-1, pred.shape[-1])[numpy.arange(label.size), label]
+            self.sum_metric += float(-numpy.log(prob + self.eps).sum())
             self.num_inst += label.size
 
 
@@ -237,21 +237,21 @@ class Perplexity(CrossEntropy):
         self.ignore_label = ignore_label
 
     def update(self, labels, preds):
-        if isinstance(labels, (NDArray, np.ndarray)):
+        if isinstance(labels, (NDArray, numpy.ndarray)):
             labels, preds = [labels], [preds]
         for label, pred in zip(labels, preds):
             label, pred = _np(label).astype("int64").ravel(), _np(pred)
-            prob = pred.reshape(-1, pred.shape[-1])[np.arange(label.size), label]
+            prob = pred.reshape(-1, pred.shape[-1])[numpy.arange(label.size), label]
             if self.ignore_label is not None:
                 mask = label != self.ignore_label
                 prob = prob[mask]
-            self.sum_metric += float(-np.log(prob + self.eps).sum())
+            self.sum_metric += float(-numpy.log(prob + self.eps).sum())
             self.num_inst += prob.size
 
     def get(self):
         if self.num_inst == 0:
             return self.name, float("nan")
-        return self.name, float(np.exp(self.sum_metric / self.num_inst))
+        return self.name, float(numpy.exp(self.sum_metric / self.num_inst))
 
 
 @register
@@ -260,11 +260,11 @@ class PearsonCorrelation(EvalMetric):
         super().__init__(name, **kwargs)
 
     def update(self, labels, preds):
-        if isinstance(labels, (NDArray, np.ndarray)):
+        if isinstance(labels, (NDArray, numpy.ndarray)):
             labels, preds = [labels], [preds]
         for label, pred in zip(labels, preds):
             label, pred = _np(label).ravel(), _np(pred).ravel()
-            self.sum_metric += float(np.corrcoef(label, pred)[0, 1])
+            self.sum_metric += float(numpy.corrcoef(label, pred)[0, 1])
             self.num_inst += 1
 
 
@@ -274,7 +274,7 @@ class Loss(EvalMetric):
         super().__init__(name, **kwargs)
 
     def update(self, _, preds):
-        if isinstance(preds, (NDArray, np.ndarray)):
+        if isinstance(preds, (NDArray, numpy.ndarray)):
             preds = [preds]
         for pred in preds:
             pred = _np(pred)
@@ -286,10 +286,16 @@ class CustomMetric(EvalMetric):
     def __init__(self, feval, name="custom", allow_extra_outputs=False, **kwargs):
         super().__init__(name, **kwargs)
         self.feval = feval
+        self._allow_extra_outputs = allow_extra_outputs
 
     def update(self, labels, preds):
-        if isinstance(labels, (NDArray, np.ndarray)):
+        if isinstance(labels, (NDArray, numpy.ndarray)):
             labels, preds = [labels], [preds]
+        if not self._allow_extra_outputs and len(labels) != len(preds):
+            raise ValueError(
+                "%d labels vs %d predictions — pass allow_extra_outputs=True "
+                "to ignore extra outputs (ref: metric.py:CustomMetric)"
+                % (len(labels), len(preds)))
         for label, pred in zip(labels, preds):
             v = self.feval(_np(label), _np(pred))
             if isinstance(v, tuple):
@@ -327,3 +333,14 @@ class CompositeEvalMetric(EvalMetric):
             names.append(n)
             values.append(v)
         return names, values
+
+
+def np(numpy_feval, name=None, allow_extra_outputs=False):
+    """Wrap a numpy feval(label, pred) into a CustomMetric (ref:
+    python/mxnet/metric.py:np)."""
+
+    def feval(label, pred):
+        return numpy_feval(label, pred)
+
+    feval.__name__ = name or getattr(numpy_feval, "__name__", "custom")
+    return CustomMetric(feval, feval.__name__, allow_extra_outputs)

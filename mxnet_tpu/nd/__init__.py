@@ -14,6 +14,7 @@ from ..ndarray import (NDArray, array, zeros, ones, full, empty, arange,  # noqa
                        load)
 from . import random  # noqa: F401
 from . import contrib  # noqa: F401
+from .. import sparse  # noqa: F401  (mx.nd.sparse namespace)
 from ..sparse import cast_storage  # noqa: F401  (ref: cast_storage.cc)
 from ..operator import Custom  # noqa: F401  (ref: src/operator/custom/custom.cc)
 

@@ -268,6 +268,19 @@ class Symbol:
         return "<Symbol %s>" % self.name
 
 
+def _graph_has_rng(sym, seen=None):
+    """True when any node is a needs_rng op without an explicit key attr."""
+    seen = seen if seen is not None else set()
+    if id(sym) in seen:
+        return False
+    seen.add(id(sym))
+    if sym._op not in (None, "_group", "_item", "_const"):
+        opdef = OP_REGISTRY.get(sym._op)
+        if opdef is not None and opdef.needs_rng and "key" not in sym._attrs:
+            return True
+    return any(_graph_has_rng(i, seen) for i in sym._inputs)
+
+
 def _eval(sym, env, cache):
     if id(sym) in cache:
         return cache[id(sym)]
@@ -435,7 +448,15 @@ class Executor:
         self._grad_req = grad_req
         fn, names = sym._build_fn()
         self._names = names
-        self._fn = jax.jit(fn)
+        # A graph with sampling nodes must NOT be baked into one cached XLA
+        # program: _eval draws the node keys from the global chain at trace
+        # time, so a cached jit would replay identical noise every forward.
+        # Stochastic graphs run the builder eagerly — fresh keys per call,
+        # matching MXNet's per-forward random resource draws; deterministic
+        # graphs keep the single cached program.
+        self._stochastic = _graph_has_rng(sym)
+        self._raw_fn = fn
+        self._fn = fn if self._stochastic else jax.jit(fn)
         self._vjp = None
         self.outputs = []
 

@@ -210,3 +210,24 @@ def test_update_out_return_identity():
     mom = nd.array(np.zeros(3, np.float32))
     res = nd.sgd_mom_update(w, g, mom, lr=0.1, momentum=0.9, out=w)
     assert res[0] is w
+
+
+def test_executor_stochastic_graph_fresh_draws():
+    """A bound executor over a sampling graph must produce fresh noise per
+    forward (MXNet's random resource advances per call), while deterministic
+    graphs stay one cached XLA program."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym
+
+    x = sym.var("x", shape=(2, 3))
+    probs = nd.array(np.array([[0.5, 0.3, 0.2], [0.2, 0.3, 0.5]], np.float32))
+    ex = mx.sym.sample_multinomial(x, shape=64).bind(args={"x": probs})
+    assert ex._stochastic
+    a1 = ex.forward()[0].asnumpy()
+    a2 = ex.forward()[0].asnumpy()
+    assert not (a1 == a2).all()
+
+    exd = mx.sym.relu(x).bind(args={"x": probs})
+    assert not exd._stochastic
+    np.testing.assert_array_equal(exd.forward()[0].asnumpy(),
+                                  exd.forward()[0].asnumpy())

@@ -133,3 +133,18 @@ def test_contrib_text_vocabulary_and_embedding(tmp_path):
     assert "a" in v3.token_to_idx and "b" in v3.token_to_idx
     assert mx.contrib.quantization is not None
     assert hasattr(mx.contrib.ndarray, "box_nms")
+
+
+def test_metric_np_and_gluon_metric():
+    """mx.metric.np wraps a numpy feval; gluon.metric aliases the module
+    (ref: python/mxnet/metric.py:np, python/mxnet/gluon/metric.py)."""
+    import numpy as np
+
+    from mxnet_tpu import gluon, metric, nd
+
+    m = metric.np(lambda label, pred:
+                  float((label == pred.argmax(-1)).mean()), name="acc2")
+    m.update(nd.array(np.array([0, 1], np.float32)),
+             nd.array(np.array([[0.9, 0.1], [0.2, 0.8]], np.float32)))
+    assert m.get() == ("acc2", 1.0)
+    assert gluon.metric.Accuracy is metric.Accuracy

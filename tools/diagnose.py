@@ -40,9 +40,11 @@ def main():
     print("----------Package Info----------")
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
+    import importlib
+
     for name in ("jax", "jaxlib", "numpy", "flax", "optax", "orbax.checkpoint"):
         try:
-            mod = __import__(name)
+            mod = importlib.import_module(name)  # resolves dotted submodules
             print("%-16s: %s" % (name, getattr(mod, "__version__", "?")))
         except Exception as e:
             print("%-16s: unavailable (%s)" % (name, e))

@@ -47,13 +47,15 @@ MASKED = 20
 VOCAB = 30522
 
 
-def build():
+def build(seq=SEQ):
+    # batch/mask sizes come from make_batch via the jit trace; only the
+    # max sequence length specializes the model itself
     import mxnet_tpu as mx
     from mxnet_tpu import _trace, amp
     from mxnet_tpu.models.bert import bert_base
     from mxnet_tpu.parallel import tree_optimizer_step
 
-    bert = bert_base(dropout=0.1, max_length=SEQ)
+    bert = bert_base(dropout=0.1, max_length=seq)
     bert.initialize()
     amp.convert_hybrid_block(bert, "bfloat16")
 
@@ -87,18 +89,28 @@ def build():
     return step, params, states
 
 
-def make_batch(rng):
-    tok = jnp.asarray(rng.integers(0, VOCAB, (BATCH, SEQ)), jnp.int32)
-    tt = jnp.zeros((BATCH, SEQ), jnp.int32)
-    vl = jnp.full((BATCH,), SEQ, jnp.float32)
-    mp = jnp.asarray(rng.integers(0, SEQ, (BATCH, MASKED)), jnp.int32)
-    mlm_y = jnp.asarray(rng.integers(0, VOCAB, (BATCH, MASKED)), jnp.int32)
-    nsp_y = jnp.asarray(rng.integers(0, 2, (BATCH,)), jnp.int32)
+def make_batch(rng, batch=BATCH, seq=SEQ, masked=MASKED):
+    tok = jnp.asarray(rng.integers(0, VOCAB, (batch, seq)), jnp.int32)
+    tt = jnp.zeros((batch, seq), jnp.int32)
+    vl = jnp.full((batch,), seq, jnp.float32)
+    mp = jnp.asarray(rng.integers(0, seq, (batch, masked)), jnp.int32)
+    mlm_y = jnp.asarray(rng.integers(0, VOCAB, (batch, masked)), jnp.int32)
+    nsp_y = jnp.asarray(rng.integers(0, 2, (batch,)), jnp.int32)
     return tok, tt, vl, mp, mlm_y, nsp_y
 
 
 RESNET_BATCH = 128
 RESNET_BASELINE_IMG_PER_SEC = 2900.0  # MXNet+A100 ResNet-50 (BASELINE.md)
+
+# BERT phase-2 config (seq 512): exercises the pallas flash-attention path
+# (seq 128 dispatches to dense XLA attention below _FLASH_MIN_LEN). Baseline
+# derived from BASELINE.md's phase-1 250 samples/s/chip by FLOP ratio:
+# per-sample FLOPs scale ~5.1x from seq 128→512 (linear in tokens plus the
+# quadratic attention term), so 250 / 5.1 ≈ 49 samples/s/chip.
+BERT512_BATCH = 16
+BERT512_SEQ = 512
+BERT512_MASKED = 80
+BERT512_BASELINE = 49.0
 
 
 def build_resnet():
@@ -193,6 +205,13 @@ def main():
         n_samples, metric, baseline = (
             RESNET_BATCH, "resnet50_train_images_per_sec_per_chip",
             RESNET_BASELINE_IMG_PER_SEC)
+    elif mode == "bert512":
+        # phase-2 long-seq config: the pallas flash-attention training path
+        step, params, states = build(seq=BERT512_SEQ)
+        batch = make_batch(rng, BERT512_BATCH, BERT512_SEQ, BERT512_MASKED)
+        n_samples, metric, baseline = (
+            BERT512_BATCH, "bert_base_seq512_train_samples_per_sec_per_chip",
+            BERT512_BASELINE)
     else:
         step, params, states = build()
         batch = make_batch(rng)

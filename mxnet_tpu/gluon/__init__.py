@@ -14,3 +14,5 @@ from . import contrib
 
 # 2.x location: metrics live under gluon.metric as well (ref: python/mxnet/gluon/metric.py)
 from .. import metric  # noqa: F401,E402
+import sys as _sys  # noqa: E402
+_sys.modules[__name__ + ".metric"] = metric  # dotted imports resolve

@@ -84,3 +84,5 @@ def __getattr__(name):  # ops registered later (e.g. pallas-backed) resolve lazi
         setattr(_mod, name, f)
         return f
     raise AttributeError(name)
+
+_sys.modules[__name__ + ".sparse"] = sparse  # `import mxnet_tpu.nd.sparse`

@@ -6,7 +6,7 @@ import sys as _sys
 
 from .base import OP_REGISTRY as _REG
 from . import sym_contrib as contrib  # noqa: F401
-from .symbol import Symbol, var, Variable, Group, _make  # noqa: F401
+from .symbol import Symbol, var, Variable, Group, cond, _make  # noqa: F401
 
 _mod = _sys.modules[__name__]
 

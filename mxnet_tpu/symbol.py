@@ -159,14 +159,21 @@ class Symbol:
         self._shape = tuple(out.shape)
         return self._shape
 
-    def _build_fn(self):
-        """Return (fn(feed_dict values in arg order) -> outputs, arg names)."""
+    def _build_fn(self, thread_key=False):
+        """Return (fn, arg names). With ``thread_key``, fn takes a leading
+        PRNG key argument from which every stochastic node derives its
+        subkey — the caller jits ONCE and passes a fresh key per call."""
         args = self._arg_symbols()
         names = [a.name for a in args]
 
-        def fn(*values):
-            env = dict(zip(names, values))
-            return _eval(self, env, {})
+        if thread_key:
+            def fn(key, *values):
+                env = dict(zip(names, values))
+                return _eval(self, env, {}, _KeyCtx(key))
+        else:
+            def fn(*values):
+                env = dict(zip(names, values))
+                return _eval(self, env, {})
 
         return fn, names
 
@@ -268,20 +275,56 @@ class Symbol:
         return "<Symbol %s>" % self.name
 
 
-def _graph_has_rng(sym, seen=None):
-    """True when any node is a needs_rng op without an explicit key attr."""
+def _node_is_stochastic(sym):
+    """Will this node actually DRAW at run time? needs_rng without an
+    explicit key, and — for training-gated ops like Dropout — only when the
+    node's training attr enables it (inference dropout is the identity, so
+    marking it stochastic would needlessly forfeit jit)."""
+    if sym._op in (None, "_group", "_item", "_const"):
+        return False
+    opdef = OP_REGISTRY.get(sym._op)
+    if opdef is None or not opdef.needs_rng or "key" in sym._attrs:
+        return False
+    if opdef.needs_training and not sym._attrs.get("training", False):
+        return False
+    return True
+
+
+def _graph_has_rng(sym, seen=None, in_attrs=False):
+    """Walk _inputs AND Symbol-valued attrs (cond subgraphs live there).
+    Returns (in_main_graph, in_subgraph_attrs)."""
     seen = seen if seen is not None else set()
     if id(sym) in seen:
-        return False
+        return False, False
     seen.add(id(sym))
-    if sym._op not in (None, "_group", "_item", "_const"):
-        opdef = OP_REGISTRY.get(sym._op)
-        if opdef is not None and opdef.needs_rng and "key" not in sym._attrs:
-            return True
-    return any(_graph_has_rng(i, seen) for i in sym._inputs)
+    main = sub = False
+    if _node_is_stochastic(sym):
+        main, sub = (not in_attrs), in_attrs
+    for i in sym._inputs:
+        m, s = _graph_has_rng(i, seen, in_attrs)
+        main, sub = main or m, sub or s
+    for v in sym._attrs.values():
+        if isinstance(v, Symbol):
+            m, s = _graph_has_rng(v, seen, True)
+            main, sub = main or m, sub or s
+    return main, sub
 
 
-def _eval(sym, env, cache):
+class _KeyCtx:
+    """Derives one subkey per stochastic node from a traced base key — the
+    base key is a jit ARGUMENT, so one cached program yields fresh noise
+    every call (the bench.py step(…, key, …) pattern)."""
+
+    def __init__(self, key):
+        self._key = key
+        self._n = 0
+
+    def next(self):
+        self._n += 1
+        return jax.random.fold_in(self._key, self._n)
+
+
+def _eval(sym, env, cache, keyctx=None):
     if id(sym) in cache:
         return cache[id(sym)]
     if sym.is_var():
@@ -289,22 +332,25 @@ def _eval(sym, env, cache):
             raise KeyError("unbound variable %s" % sym.name)
         val = env[sym.name]
     elif sym._op == "_group":
-        val = [_eval(i, env, cache) for i in sym._inputs]
+        val = [_eval(i, env, cache, keyctx) for i in sym._inputs]
     elif sym._op == "_item":
-        parent = _eval(sym._inputs[0], env, cache)
+        parent = _eval(sym._inputs[0], env, cache, keyctx)
         val = parent[sym._attrs["index"]]
     else:
-        ins = [_eval(i, env, cache) for i in sym._inputs]
+        ins = [_eval(i, env, cache, keyctx) for i in sym._inputs]
         opdef = OP_REGISTRY[sym._op]
         attrs = sym._attrs
         if opdef.needs_rng and "key" not in attrs:
-            # sampling ops in a symbol graph draw from the global chain at
-            # trace time: each (re)trace gets a fresh key constant; a cached
-            # executor replays the same stream until rebound (the compiled-
-            # program analogue of MXNet's per-build random resource seed)
-            from . import random as _rng
+            if keyctx is not None:
+                # key threaded as a jit argument → cached program, fresh
+                # noise per call
+                attrs = {**attrs, "key": keyctx.next()}
+            else:
+                # no threaded key (Symbol.eval retraces per call; shape
+                # inference discards values): draw a trace-time constant
+                from . import random as _rng
 
-            attrs = {**attrs, "key": _rng.next_key()}
+                attrs = {**attrs, "key": _rng.next_key()}
         val = opdef.fn(*ins, **attrs)
     cache[id(sym)] = val
     return val
@@ -446,17 +492,19 @@ class Executor:
         self.arg_dict = args
         self.grad_dict = args_grad or {}
         self._grad_req = grad_req
-        fn, names = sym._build_fn()
+        # Sampling nodes must not bake trace-time keys into one cached
+        # program (that replays identical noise every forward). Main-graph
+        # sampling threads the key as a jit ARGUMENT — one cached program,
+        # fresh noise per call. Sampling hidden inside subgraph attrs (cond
+        # branches evaluate inside their op fn, out of the key thread's
+        # reach) falls back to eager evaluation; deterministic graphs keep
+        # the plain cached program.
+        rng_main, rng_sub = _graph_has_rng(sym)
+        self._stochastic = rng_main or rng_sub
+        self._keyed = rng_main and not rng_sub
+        fn, names = sym._build_fn(thread_key=self._keyed)
         self._names = names
-        # A graph with sampling nodes must NOT be baked into one cached XLA
-        # program: _eval draws the node keys from the global chain at trace
-        # time, so a cached jit would replay identical noise every forward.
-        # Stochastic graphs run the builder eagerly — fresh keys per call,
-        # matching MXNet's per-forward random resource draws; deterministic
-        # graphs keep the single cached program.
-        self._stochastic = _graph_has_rng(sym)
-        self._raw_fn = fn
-        self._fn = fn if self._stochastic else jax.jit(fn)
+        self._fn = fn if rng_sub else jax.jit(fn)
         self._vjp = None
         self.outputs = []
 
@@ -464,6 +512,11 @@ class Executor:
         for k, v in kwargs.items():
             self.arg_dict[k] = v if isinstance(v, NDArray) else NDArray(jnp.asarray(v))
         vals = [self.arg_dict[n]._data for n in self._names]
+        if self._keyed:
+            from . import random as _rng
+
+            key = _rng.next_key()
+            vals = [key] + vals
         if is_train:
             out, self._vjp = jax.vjp(lambda *v: self._fn(*v), *vals)
         else:
@@ -482,6 +535,8 @@ class Executor:
             cots = [g._data for g in out_grads]
         # cotangent must match the primal output structure (list for groups)
         grads = self._vjp(list(cots) if self._sym._op == "_group" else cots[0])
+        if self._keyed:
+            grads = grads[1:]   # leading entry is the PRNG key's float0
         for n, g in zip(self._names, grads):
             if n in self.grad_dict and self.grad_dict[n] is not None:
                 if self._grad_req == "add":

@@ -222,7 +222,8 @@ def test_executor_stochastic_graph_fresh_draws():
     x = sym.var("x", shape=(2, 3))
     probs = nd.array(np.array([[0.5, 0.3, 0.2], [0.2, 0.3, 0.5]], np.float32))
     ex = mx.sym.sample_multinomial(x, shape=64).bind(args={"x": probs})
-    assert ex._stochastic
+    # main-graph sampling threads the key through ONE cached jitted program
+    assert ex._stochastic and ex._keyed
     a1 = ex.forward()[0].asnumpy()
     a2 = ex.forward()[0].asnumpy()
     assert not (a1 == a2).all()
@@ -231,3 +232,30 @@ def test_executor_stochastic_graph_fresh_draws():
     assert not exd._stochastic
     np.testing.assert_array_equal(exd.forward()[0].asnumpy(),
                                   exd.forward()[0].asnumpy())
+
+    # sampling inside a cond branch (subgraph attr) → eager fallback,
+    # still fresh noise
+    p = sym.var("p", shape=(1,))
+    c = sym.cond(p, mx.sym.random_uniform(shape=(2, 3)), x)
+    exc = c.bind(args={"p": nd.array(np.array([1.0], np.float32)),
+                       "x": probs})
+    assert exc._stochastic and not exc._keyed
+    assert not (exc.forward()[0].asnumpy()
+                == exc.forward()[0].asnumpy()).all()
+
+    # inference dropout is the identity → graph stays jit-compiled
+    exdp = mx.sym.Dropout(x, p=0.5).bind(args={"x": probs})
+    assert not exdp._stochastic
+    np.testing.assert_array_equal(exdp.forward()[0].asnumpy(),
+                                  probs.asnumpy())
+
+    # keyed training graph: backward drops the key grad, weights align
+    w = sym.var("w", shape=(3, 3))
+    y = mx.sym.dot(x + mx.sym.random_normal(shape=(2, 3), scale=0.01), w)
+    exg = y.bind(args={"x": probs,
+                       "w": nd.array(np.eye(3, dtype=np.float32))},
+                 args_grad={"w": nd.zeros((3, 3))})
+    exg.forward(is_train=True)
+    exg.backward(nd.array(np.ones((2, 3), np.float32)))
+    g = exg.grad_dict["w"].asnumpy()
+    assert np.isfinite(g).all() and abs(g.sum()) > 0

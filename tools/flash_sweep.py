@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Flash-attention block-size sweep on the real chip.
+
+Times forward and forward+backward for a grid of (block_q, block_k) at the
+given sequence lengths, against the dense XLA reference. Output guides the
+default block sizes in ops/pallas/flash_attention.py (r3 perf item).
+
+Run: python tools/flash_sweep.py [--seq 512 2048] [--iters 20]
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def time_fn(fn, *args, iters=20):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq", type=int, nargs="+", default=[512, 2048])
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--heads", type=int, default=12)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--batch", type=int, default=4)
+    args = ap.parse_args()
+
+    from mxnet_tpu.ops.attention import _reference_attention
+    from mxnet_tpu.ops.pallas.flash_attention import flash_attention
+
+    for T in args.seq:
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+        shape = (args.batch, args.heads, T, args.dim)
+        q = jax.random.normal(k1, shape, jnp.bfloat16)
+        k = jax.random.normal(k2, shape, jnp.bfloat16)
+        v = jax.random.normal(k3, shape, jnp.bfloat16)
+
+        def dense_fwd(q, k, v):
+            return _reference_attention(q, k, v, causal=True)
+
+        def dense_grad(q, k, v):
+            return jax.grad(lambda *a: dense_fwd(*a).astype(
+                jnp.float32).sum())(q, k, v)
+
+        print("== seq %d (B%d H%d D%d bf16) ==" %
+              (T, args.batch, args.heads, args.dim))
+        try:
+            ms_f = time_fn(jax.jit(dense_fwd), q, k, v, iters=args.iters)
+            ms_b = time_fn(jax.jit(dense_grad), q, k, v, iters=args.iters)
+            print("dense xla          fwd %7.3f ms   fwd+bwd %7.3f ms"
+                  % (ms_f, ms_b))
+        except Exception as e:
+            print("dense xla failed:", e)
+
+        for bq in (128, 256, 512):
+            for bk in (128, 256, 512):
+                if bq > T or bk > T:
+                    continue
+
+                def flash_fwd(q, k, v, bq=bq, bk=bk):
+                    return flash_attention(q, k, v, causal=True,
+                                           block_q=bq, block_k=bk)
+
+                def flash_grad(q, k, v, bq=bq, bk=bk):
+                    return jax.grad(lambda *a: flash_fwd(*a).astype(
+                        jnp.float32).sum())(q, k, v)
+
+                try:
+                    ms_f = time_fn(jax.jit(flash_fwd), q, k, v,
+                                   iters=args.iters)
+                    ms_b = time_fn(jax.jit(flash_grad), q, k, v,
+                                   iters=args.iters)
+                    print("flash bq=%3d bk=%3d fwd %7.3f ms   fwd+bwd %7.3f ms"
+                          % (bq, bk, ms_f, ms_b))
+                except Exception as e:
+                    print("flash bq=%3d bk=%3d FAILED: %s" % (bq, bk, e))
+
+
+if __name__ == "__main__":
+    main()

@@ -290,23 +290,40 @@ def _node_is_stochastic(sym):
     return True
 
 
-def _graph_has_rng(sym, seen=None, in_attrs=False):
-    """Walk _inputs AND Symbol-valued attrs (cond subgraphs live there).
-    Returns (in_main_graph, in_subgraph_attrs)."""
-    seen = seen if seen is not None else set()
-    if id(sym) in seen:
-        return False, False
-    seen.add(id(sym))
+def _graph_has_rng(sym):
+    """Returns (in_main_graph, in_subgraph_attrs). Two INDEPENDENT walks —
+    a node reachable both from the main graph and from a cond-branch attr
+    must register in both (one shared visited-set would classify it by
+    whichever path got there first and could wrongly keep the keyed-jit
+    path for a graph whose branch replays baked noise)."""
     main = sub = False
-    if _node_is_stochastic(sym):
-        main, sub = (not in_attrs), in_attrs
-    for i in sym._inputs:
-        m, s = _graph_has_rng(i, seen, in_attrs)
-        main, sub = main or m, sub or s
-    for v in sym._attrs.values():
-        if isinstance(v, Symbol):
-            m, s = _graph_has_rng(v, seen, True)
-            main, sub = main or m, sub or s
+    attr_roots = []
+    seen = set()
+    stack = [sym]
+    while stack:
+        s = stack.pop()
+        if id(s) in seen:
+            continue
+        seen.add(id(s))
+        if _node_is_stochastic(s):
+            main = True
+        stack.extend(s._inputs)
+        for v in s._attrs.values():
+            if isinstance(v, Symbol):
+                attr_roots.append(v)
+    seen2 = set()
+    stack = attr_roots
+    while stack:
+        s = stack.pop()
+        if id(s) in seen2:
+            continue
+        seen2.add(id(s))
+        if _node_is_stochastic(s):
+            sub = True
+        stack.extend(s._inputs)
+        for v in s._attrs.values():
+            if isinstance(v, Symbol):
+                stack.append(v)
     return main, sub
 
 
@@ -336,6 +353,21 @@ def _eval(sym, env, cache, keyctx=None):
     elif sym._op == "_item":
         parent = _eval(sym._inputs[0], env, cache, keyctx)
         val = parent[sym._attrs["index"]]
+    elif sym._op == "_cond":
+        # evaluated HERE (not via the registry fn) so branches share the
+        # outer cache: a node used both outside and inside a branch
+        # evaluates once — one noise draw per node per forward — and
+        # branch-internal rng nodes reach the threaded keyctx
+        pred = _eval(sym._inputs[0], env, cache, keyctx)
+        vals = [_eval(i, env, cache, keyctx) for i in sym._inputs[1:]]
+        benv = dict(zip(sym._attrs["arg_names"], vals))
+        p = jnp.asarray(pred).reshape(()).astype(bool)
+        then_sym, else_sym = sym._attrs["then_sym"], sym._attrs["else_sym"]
+        val = lax.cond(
+            p,
+            lambda e: _eval(then_sym, e, dict(cache), keyctx),
+            lambda e: _eval(else_sym, e, dict(cache), keyctx),
+            benv)
     else:
         ins = [_eval(i, env, cache, keyctx) for i in sym._inputs]
         opdef = OP_REGISTRY[sym._op]
@@ -493,18 +525,16 @@ class Executor:
         self.grad_dict = args_grad or {}
         self._grad_req = grad_req
         # Sampling nodes must not bake trace-time keys into one cached
-        # program (that replays identical noise every forward). Main-graph
-        # sampling threads the key as a jit ARGUMENT — one cached program,
-        # fresh noise per call. Sampling hidden inside subgraph attrs (cond
-        # branches evaluate inside their op fn, out of the key thread's
-        # reach) falls back to eager evaluation; deterministic graphs keep
-        # the plain cached program.
+        # program (that would replay identical noise every forward). Any
+        # stochastic graph — including sampling inside cond branches, which
+        # _eval evaluates with the shared cache and keyctx — threads the key
+        # as a jit ARGUMENT: one cached program, fresh noise per call.
         rng_main, rng_sub = _graph_has_rng(sym)
         self._stochastic = rng_main or rng_sub
-        self._keyed = rng_main and not rng_sub
+        self._keyed = self._stochastic
         fn, names = sym._build_fn(thread_key=self._keyed)
         self._names = names
-        self._fn = fn if rng_sub else jax.jit(fn)
+        self._fn = jax.jit(fn)
         self._vjp = None
         self.outputs = []
 

@@ -233,13 +233,13 @@ def test_executor_stochastic_graph_fresh_draws():
     np.testing.assert_array_equal(exd.forward()[0].asnumpy(),
                                   exd.forward()[0].asnumpy())
 
-    # sampling inside a cond branch (subgraph attr) → eager fallback,
-    # still fresh noise
+    # sampling inside a cond branch: still keyed-jit (branches share the
+    # threaded keyctx), fresh noise per call
     p = sym.var("p", shape=(1,))
     c = sym.cond(p, mx.sym.random_uniform(shape=(2, 3)), x)
     exc = c.bind(args={"p": nd.array(np.array([1.0], np.float32)),
                        "x": probs})
-    assert exc._stochastic and not exc._keyed
+    assert exc._stochastic and exc._keyed
     assert not (exc.forward()[0].asnumpy()
                 == exc.forward()[0].asnumpy()).all()
 
@@ -259,3 +259,26 @@ def test_executor_stochastic_graph_fresh_draws():
     exg.backward(nd.array(np.ones((2, 3), np.float32)))
     g = exg.grad_dict["w"].asnumpy()
     assert np.isfinite(g).all() and abs(g.sum()) > 0
+
+
+def test_rng_node_shared_between_main_and_branch():
+    """A sampling node used both outside and inside a cond branch draws
+    ONCE per forward (branch evaluation shares the outer cache), while
+    successive forwards still get fresh noise."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym
+    from mxnet_tpu.symbol import Group
+
+    p = sym.var("p", shape=(1,))
+    x = sym.var("x", shape=(2, 3))
+    r = mx.sym.random_uniform(shape=(2, 3))
+    y = r + sym.cond(p, r * 2, x)
+    g = Group([r, y])
+    ex = g.bind(args={"p": nd.array(np.array([1.0], np.float32)),
+                      "x": nd.array(np.zeros((2, 3), np.float32))})
+    assert ex._stochastic and ex._keyed
+    r1, y1 = (o.asnumpy() for o in ex.forward())
+    # intra-call consistency: the branch saw the SAME draw → y = 3r exactly
+    np.testing.assert_allclose(y1, 3 * r1, rtol=1e-6)
+    r2, y2 = (o.asnumpy() for o in ex.forward())
+    assert not (r1 == r2).all()   # cross-call freshness

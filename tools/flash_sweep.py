@@ -35,6 +35,12 @@ def main():
     ap.add_argument("--heads", type=int, default=12)
     ap.add_argument("--dim", type=int, default=64)
     ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--causal", action="store_true",
+                    help="causal masking (default off — the BERT bench path "
+                         "is bidirectional)")
+    ap.add_argument("--valid-len", type=int, default=0,
+                    help="exercise the kv_valid_len key-padding path with "
+                         "this per-example length (0 = no padding mask)")
     args = ap.parse_args()
 
     from mxnet_tpu.ops.attention import _reference_attention
@@ -46,16 +52,25 @@ def main():
         q = jax.random.normal(k1, shape, jnp.bfloat16)
         k = jax.random.normal(k2, shape, jnp.bfloat16)
         v = jax.random.normal(k3, shape, jnp.bfloat16)
+        causal = args.causal
+        vl = (jnp.full((args.batch,), args.valid_len, jnp.float32)
+              if args.valid_len else None)
+        mask = (None if vl is None else
+                (jnp.arange(T)[None, None, None, :] < vl[:, None, None, None]))
 
         def dense_fwd(q, k, v):
-            return _reference_attention(q, k, v, causal=True)
+            return _reference_attention(q, k, v, mask, causal=causal)
 
         def dense_grad(q, k, v):
-            return jax.grad(lambda *a: dense_fwd(*a).astype(
-                jnp.float32).sum())(q, k, v)
+            # differentiate w.r.t. ALL of q/k/v: default argnums=0 would let
+            # XLA dead-code-eliminate the dk/dv two-thirds of the backward
+            gs = jax.grad(lambda *a: dense_fwd(*a).astype(jnp.float32).sum(),
+                          argnums=(0, 1, 2))(q, k, v)
+            return sum(g.astype(jnp.float32).sum() for g in gs)
 
-        print("== seq %d (B%d H%d D%d bf16) ==" %
-              (T, args.batch, args.heads, args.dim))
+        print("== seq %d (B%d H%d D%d bf16, causal=%s, vl=%s) ==" %
+              (T, args.batch, args.heads, args.dim, causal,
+               args.valid_len or "-"))
         try:
             ms_f = time_fn(jax.jit(dense_fwd), q, k, v, iters=args.iters)
             ms_b = time_fn(jax.jit(dense_grad), q, k, v, iters=args.iters)
@@ -64,18 +79,28 @@ def main():
         except Exception as e:
             print("dense xla failed:", e)
 
+        from mxnet_tpu.ops.pallas.flash_attention import \
+            _largest_divisor_block
+
         for bq in (128, 256, 512):
             for bk in (128, 256, 512):
                 if bq > T or bk > T:
                     continue
+                # flash_attention shrinks non-divisor blocks; skip labels
+                # that would silently re-measure another row's config
+                if (_largest_divisor_block(T, bq) != bq
+                        or _largest_divisor_block(T, bk) != bk):
+                    continue
 
                 def flash_fwd(q, k, v, bq=bq, bk=bk):
-                    return flash_attention(q, k, v, causal=True,
-                                           block_q=bq, block_k=bk)
+                    return flash_attention(q, k, v, causal=causal,
+                                           block_q=bq, block_k=bk,
+                                           kv_valid_len=vl)
 
                 def flash_grad(q, k, v, bq=bq, bk=bk):
-                    return jax.grad(lambda *a: flash_fwd(*a).astype(
-                        jnp.float32).sum())(q, k, v)
+                    gs = jax.grad(lambda *a: flash_fwd(*a).astype(
+                        jnp.float32).sum(), argnums=(0, 1, 2))(q, k, v)
+                    return sum(g.astype(jnp.float32).sum() for g in gs)
 
                 try:
                     ms_f = time_fn(jax.jit(flash_fwd), q, k, v,

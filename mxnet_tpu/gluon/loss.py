@@ -216,3 +216,58 @@ class CosineEmbeddingLoss(Loss):
         loss = F.where(label == 1.0, 1.0 - cos, F.relu(cos - self._margin))
         loss = _apply_weighting(F, loss, self._weight, sample_weight)
         return loss
+
+
+class PoissonNLLLoss(Loss):
+    """Poisson negative log likelihood (ref: gluon/loss.py:PoissonNLLLoss).
+
+    from_logits=True: ``pred`` is log-rate, loss = exp(pred) − target·pred;
+    from_logits=False: ``pred`` is the rate, loss = pred − target·log(pred+ε).
+    ``compute_full`` adds the Stirling approximation of log(target!)."""
+
+    def __init__(self, weight=None, from_logits=True, batch_axis=0,
+                 compute_full=False, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._compute_full = compute_full
+
+    def hybrid_forward(self, F, pred, target, sample_weight=None, epsilon=1e-08):
+        if self._from_logits:
+            loss = F.exp(pred) - target * pred
+        else:
+            loss = pred - target * F.log(pred + epsilon)
+        if self._compute_full:
+            # Stirling: t·log(t) − t + 0.5·log(2πt), for target > 1
+            stirling = (target * F.log(target + epsilon) - target
+                        + 0.5 * F.log(2.0 * 3.141592653589793 * (target + epsilon)))
+            loss = loss + F.where(target > 1.0, stirling,
+                                  F.zeros_like(target))
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss)
+
+
+class SDMLLoss(Loss):
+    """Smoothed deep metric learning loss (ref: gluon/loss.py:SDMLLoss).
+
+    Treats matching rows of two batches as positives and every other row as
+    an in-batch negative: KL between a smoothed identity distribution and the
+    softmax over negative pairwise L2 distances."""
+
+    def __init__(self, smoothing_parameter=0.3, weight=1.0, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._smoothing = smoothing_parameter
+
+    def hybrid_forward(self, F, x1, x2):
+        n = x1.shape[0]
+        # pairwise squared L2 distances (B, B)
+        d = (F.sum(F.square(x1), axis=1, keepdims=True)
+             + F.reshape(F.sum(F.square(x2), axis=1), shape=(1, -1))
+             - 2.0 * F.dot(x1, F.transpose(x2)))
+        # smoothed one-hot targets over each row
+        eye = F.one_hot(F.arange(0, n), depth=n)
+        smoothed = (eye * (1.0 - self._smoothing)
+                    + (1.0 - eye) * self._smoothing / max(n - 1, 1))
+        logp = F.log_softmax(-d, axis=-1)
+        kl = F.sum(smoothed * (F.log(smoothed + 1e-12) - logp), axis=1)
+        return _apply_weighting(F, kl, self._weight, None)

@@ -41,6 +41,21 @@ class Trainer:
             self._kvstore = kv_create(kvstore)
         elif not isinstance(kvstore, str) and kvstore is not None:
             self._kvstore = kvstore
+        if compression_params:
+            if self._kvstore is None:
+                import warnings
+
+                # the in-mesh 'device'/'local' path reduces with a compiled
+                # psum — there is no wire stage to compress, so the request
+                # cannot be honored; say so instead of silently ignoring it
+                warnings.warn("compression_params ignored: kvstore=%r "
+                              "reduces in-mesh (compiled psum); gradient "
+                              "compression applies to dist kvstores"
+                              % (kvstore,))
+            else:
+                # 2-bit error-feedback compression on the kvstore reduction
+                # path (ref: gluon/trainer.py → set_gradient_compression)
+                self._kvstore.set_gradient_compression(compression_params)
 
     @property
     def learning_rate(self):

@@ -291,13 +291,8 @@ def _node_is_stochastic(sym):
 
 
 def _graph_has_rng(sym):
-    """Returns (in_main_graph, in_subgraph_attrs). Two INDEPENDENT walks —
-    a node reachable both from the main graph and from a cond-branch attr
-    must register in both (one shared visited-set would classify it by
-    whichever path got there first and could wrongly keep the keyed-jit
-    path for a graph whose branch replays baked noise)."""
-    main = sub = False
-    attr_roots = []
+    """True when any node — in the main graph or inside a Symbol-valued
+    attr (cond branch subgraphs) — will draw randomness at run time."""
     seen = set()
     stack = [sym]
     while stack:
@@ -306,25 +301,24 @@ def _graph_has_rng(sym):
             continue
         seen.add(id(s))
         if _node_is_stochastic(s):
-            main = True
+            return True
         stack.extend(s._inputs)
-        for v in s._attrs.values():
-            if isinstance(v, Symbol):
-                attr_roots.append(v)
-    seen2 = set()
-    stack = attr_roots
-    while stack:
-        s = stack.pop()
-        if id(s) in seen2:
-            continue
-        seen2.add(id(s))
-        if _node_is_stochastic(s):
-            sub = True
-        stack.extend(s._inputs)
-        for v in s._attrs.values():
-            if isinstance(v, Symbol):
-                stack.append(v)
-    return main, sub
+        stack.extend(v for v in s._attrs.values() if isinstance(v, Symbol))
+    return False
+
+
+def _stochastic_nodes(sym, seen, out):
+    """Collect stochastic nodes of a subgraph (attr subgraphs included)."""
+    if id(sym) in seen:
+        return
+    seen.add(id(sym))
+    if _node_is_stochastic(sym):
+        out.append(sym)
+    for i in sym._inputs:
+        _stochastic_nodes(i, seen, out)
+    for v in sym._attrs.values():
+        if isinstance(v, Symbol):
+            _stochastic_nodes(v, seen, out)
 
 
 class _KeyCtx:
@@ -363,6 +357,18 @@ def _eval(sym, env, cache, keyctx=None):
         benv = dict(zip(sym._attrs["arg_names"], vals))
         p = jnp.asarray(pred).reshape(()).astype(bool)
         then_sym, else_sym = sym._attrs["then_sym"], sym._attrs["else_sym"]
+        # HOIST stochastic branch nodes into the outer scope first: their
+        # draws land in the SHARED cache regardless of whether the rest of
+        # the graph evaluates them before or after this cond (a draw inside
+        # the branch lambda would live in a throwaway cache copy, so a
+        # later outer use would re-draw — order-dependent inconsistency)
+        hoist, hseen = [], set()
+        _stochastic_nodes(then_sym, hseen, hoist)
+        _stochastic_nodes(else_sym, hseen, hoist)
+        if hoist:
+            menv = {**env, **benv}
+            for node in hoist:
+                _eval(node, menv, cache, keyctx)
         val = lax.cond(
             p,
             lambda e: _eval(then_sym, e, dict(cache), keyctx),
@@ -463,6 +469,10 @@ def cond(pred, then_sym, else_sym, name=None):
 
 @register_op("_cond")
 def _cond_op(pred, *vals, then_sym, else_sym, arg_names):
+    """SHAPE-INFERENCE ONLY (shape_inference.py eval_shapes through the
+    registry). Value evaluation goes through _eval's dedicated _cond branch,
+    which shares the outer cache and keyctx — this fallback has neither, so
+    its noise semantics are wrong for values. Do not route execution here."""
     env = dict(zip(arg_names, vals))
     p = jnp.asarray(pred).reshape(()).astype(bool)
     return lax.cond(p,
@@ -529,8 +539,7 @@ class Executor:
         # stochastic graph — including sampling inside cond branches, which
         # _eval evaluates with the shared cache and keyctx — threads the key
         # as a jit ARGUMENT: one cached program, fresh noise per call.
-        rng_main, rng_sub = _graph_has_rng(sym)
-        self._stochastic = rng_main or rng_sub
+        self._stochastic = _graph_has_rng(sym)
         self._keyed = self._stochastic
         fn, names = sym._build_fn(thread_key=self._keyed)
         self._names = names

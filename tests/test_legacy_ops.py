@@ -272,13 +272,15 @@ def test_rng_node_shared_between_main_and_branch():
     p = sym.var("p", shape=(1,))
     x = sym.var("x", shape=(2, 3))
     r = mx.sym.random_uniform(shape=(2, 3))
-    y = r + sym.cond(p, r * 2, x)
-    g = Group([r, y])
-    ex = g.bind(args={"p": nd.array(np.array([1.0], np.float32)),
-                      "x": nd.array(np.zeros((2, 3), np.float32))})
-    assert ex._stochastic and ex._keyed
-    r1, y1 = (o.asnumpy() for o in ex.forward())
-    # intra-call consistency: the branch saw the SAME draw → y = 3r exactly
-    np.testing.assert_allclose(y1, 3 * r1, rtol=1e-6)
-    r2, y2 = (o.asnumpy() for o in ex.forward())
-    assert not (r1 == r2).all()   # cross-call freshness
+    args = {"p": nd.array(np.array([1.0], np.float32)),
+            "x": nd.array(np.zeros((2, 3), np.float32))}
+    # consistency must hold for BOTH evaluation orders: the branch's
+    # stochastic nodes are hoisted into the shared cache before the cond,
+    # so whether the outer use evaluates before or after doesn't matter
+    for y in (r + sym.cond(p, r * 2, x), sym.cond(p, r * 2, x) + r):
+        ex = Group([r, y]).bind(args=dict(args))
+        assert ex._stochastic and ex._keyed
+        r1, y1 = (o.asnumpy() for o in ex.forward())
+        np.testing.assert_allclose(y1, 3 * r1, rtol=1e-6)
+        r2, _ = (o.asnumpy() for o in ex.forward())
+        assert not (r1 == r2).all()   # cross-call freshness

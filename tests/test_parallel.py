@@ -287,3 +287,18 @@ def test_kvstore_two_bit_gradient_compression():
     import pytest
     with pytest.raises(ValueError):
         kv.set_gradient_compression({"type": "1bit"})
+
+
+def test_trainer_wires_gradient_compression():
+    """Trainer(compression_params=...) configures the kvstore's 2-bit
+    compressor (ref: gluon/trainer.py)."""
+    from mxnet_tpu import gluon, kvstore
+
+    kv = kvstore.create("dist_sync")
+    net = gluon.nn.Dense(4, in_units=3)
+    net.initialize()
+    gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                  kvstore=kv,
+                  compression_params={"type": "2bit", "threshold": 0.5})
+    assert kv._compression is not None
+    assert kv._compression["threshold"] == 0.5

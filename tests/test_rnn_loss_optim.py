@@ -200,3 +200,32 @@ def test_initializers():
     init.Orthogonal()(init.InitDesc("w"), o)
     q = o.asnumpy()
     np.testing.assert_allclose(q @ q.T, np.eye(6) * (q @ q.T)[0, 0], atol=1e-4)
+
+
+def test_poisson_nll_and_sdml_losses():
+    """PoissonNLLLoss (logits/rate/Stirling modes) and SDMLLoss in-batch
+    metric learning (ref: gluon/loss.py late-1.x additions)."""
+    import numpy as np
+
+    from mxnet_tpu import autograd, gluon, nd
+
+    rng = np.random.RandomState(0)
+    pl = gluon.loss.PoissonNLLLoss()
+    pred = nd.array(np.log(np.array([[2.0, 5.0]], np.float32)))
+    tgt = nd.array(np.array([[2.0, 5.0]], np.float32))
+    assert float(pl(pred, tgt).asnumpy()) < float(pl(pred + 1.0, tgt).asnumpy())
+    full = gluon.loss.PoissonNLLLoss(compute_full=True)
+    assert np.isfinite(float(full(pred, tgt).asnumpy()))
+    rate = gluon.loss.PoissonNLLLoss(from_logits=False)
+    assert np.isfinite(float(rate(tgt, tgt).asnumpy()))
+
+    sd = gluon.loss.SDMLLoss()
+    x1 = nd.array(rng.randn(4, 8).astype(np.float32))
+    x2c = nd.array(x1.asnumpy() + 0.01 * rng.randn(4, 8).astype(np.float32))
+    x2f = nd.array(rng.randn(4, 8).astype(np.float32))
+    assert float(sd(x1, x2c).asnumpy().mean()) < float(sd(x1, x2f).asnumpy().mean())
+    x1.attach_grad()
+    with autograd.record():
+        l = sd(x1, x2f)
+    l.backward()
+    assert np.isfinite(x1.grad.asnumpy()).all()

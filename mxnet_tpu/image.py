@@ -613,3 +613,14 @@ class ImageIter:
                          [array(np.asarray(labels, np.float32))],
                          provide_data=self.provide_data,
                          provide_label=self.provide_label)
+
+
+def __getattr__(name):
+    if name == "ImageDetIter":
+        # upstream name for the detection iterator (ref: python/mxnet/image/
+        # detection.py:ImageDetIter); the record-backed implementation lives
+        # in io (lazy to avoid a module cycle)
+        from .io import ImageDetRecordIter
+
+        return ImageDetRecordIter
+    raise AttributeError(name)

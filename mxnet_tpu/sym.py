@@ -60,3 +60,55 @@ def __getattr__(name):
         setattr(_mod, name, f)
         return f
     raise AttributeError(name)
+
+
+class _SymRandom:
+    """``mx.sym.random`` namespace: symbol builders over the flat random_*
+    registry ops (ref: python/mxnet/symbol/random.py)."""
+
+    @staticmethod
+    def uniform(low=0.0, high=1.0, shape=(1,), dtype="float32", name=None):
+        return _builder("random_uniform")(low=low, high=high, shape=tuple(shape),
+                                          dtype=dtype, name=name)
+
+    @staticmethod
+    def normal(loc=0.0, scale=1.0, shape=(1,), dtype="float32", name=None):
+        return _builder("random_normal")(loc=loc, scale=scale, shape=tuple(shape),
+                                         dtype=dtype, name=name)
+
+    @staticmethod
+    def randint(low, high, shape=(1,), dtype="int32", name=None):
+        return _builder("random_randint")(low=low, high=high, shape=tuple(shape),
+                                          dtype=dtype, name=name)
+
+    @staticmethod
+    def exponential(lam=1.0, shape=(1,), dtype="float32", name=None):
+        return _builder("random_exponential")(lam=lam, shape=tuple(shape),
+                                              dtype=dtype, name=name)
+
+    @staticmethod
+    def gamma(alpha=1.0, beta=1.0, shape=(1,), dtype="float32", name=None):
+        return _builder("random_gamma")(alpha=alpha, beta=beta,
+                                        shape=tuple(shape), dtype=dtype,
+                                        name=name)
+
+    @staticmethod
+    def poisson(lam=1.0, shape=(1,), dtype="float32", name=None):
+        return _builder("random_poisson")(lam=lam, shape=tuple(shape),
+                                          dtype=dtype, name=name)
+
+    @staticmethod
+    def negative_binomial(k=1, p=0.5, shape=(1,), dtype="float32", name=None):
+        return _builder("random_negative_binomial")(k=k, p=p,
+                                                    shape=tuple(shape),
+                                                    dtype=dtype, name=name)
+
+    @staticmethod
+    def multinomial(data, shape=(), get_prob=False, dtype="int32", name=None):
+        return sample_multinomial(data, shape=tuple(shape) if not
+                                  isinstance(shape, int) else shape,
+                                  get_prob=get_prob, dtype=dtype, name=name)
+
+
+random = _SymRandom()
+_sys.modules[__name__ + ".random"] = random  # `import mxnet_tpu.sym.random`

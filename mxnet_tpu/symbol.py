@@ -165,15 +165,18 @@ class Symbol:
         subkey — the caller jits ONCE and passes a fresh key per call."""
         args = self._arg_symbols()
         names = [a.name for a in args]
+        # nodes reachable from multiple regions (main graph / cond branches):
+        # only these hoist out of lax.cond for order-independent single draws
+        shared = _shared_stochastic_ids(self)
 
         if thread_key:
             def fn(key, *values):
                 env = dict(zip(names, values))
-                return _eval(self, env, {}, _KeyCtx(key))
+                return _eval(self, env, {}, _KeyCtx(key), shared)
         else:
             def fn(*values):
                 env = dict(zip(names, values))
-                return _eval(self, env, {})
+                return _eval(self, env, {}, None, shared)
 
         return fn, names
 
@@ -321,6 +324,46 @@ def _stochastic_nodes(sym, seen, out):
             _stochastic_nodes(v, seen, out)
 
 
+def _shared_stochastic_ids(root):
+    """Ids of stochastic nodes reachable from MORE THAN ONE region — the
+    main graph (inputs-only walk) or any individual cond branch. Only these
+    need hoisting out of lax.cond for order-independent single draws;
+    branch-PRIVATE draws stay inside the untaken-branch-skipping cond."""
+    conds = []
+
+    def walk(s, acc, seen, descend_attrs):
+        if id(s) in seen:
+            return
+        seen.add(id(s))
+        acc.add(id(s))
+        if s._op == "_cond":
+            conds.append(s)
+        for i in s._inputs:
+            walk(i, acc, seen, descend_attrs)
+        if descend_attrs:
+            for v in s._attrs.values():
+                if isinstance(v, Symbol):
+                    walk(v, acc, seen, True)
+
+    regions = []
+    main = set()
+    walk(root, main, set(), False)
+    regions.append(main)
+    i = 0
+    while i < len(conds):   # walk_full discovers nested conds as it goes
+        c = conds[i]
+        i += 1
+        for b in (c._attrs["then_sym"], c._attrs["else_sym"]):
+            acc = set()
+            walk(b, acc, set(), True)
+            regions.append(acc)
+    counts = {}
+    for r in regions:
+        for nid in r:
+            counts[nid] = counts.get(nid, 0) + 1
+    return frozenset(nid for nid, n in counts.items() if n > 1)
+
+
 class _KeyCtx:
     """Derives one subkey per stochastic node from a traced base key — the
     base key is a jit ARGUMENT, so one cached program yields fresh noise
@@ -335,7 +378,7 @@ class _KeyCtx:
         return jax.random.fold_in(self._key, self._n)
 
 
-def _eval(sym, env, cache, keyctx=None):
+def _eval(sym, env, cache, keyctx=None, shared=frozenset()):
     if id(sym) in cache:
         return cache[id(sym)]
     if sym.is_var():
@@ -343,17 +386,17 @@ def _eval(sym, env, cache, keyctx=None):
             raise KeyError("unbound variable %s" % sym.name)
         val = env[sym.name]
     elif sym._op == "_group":
-        val = [_eval(i, env, cache, keyctx) for i in sym._inputs]
+        val = [_eval(i, env, cache, keyctx, shared) for i in sym._inputs]
     elif sym._op == "_item":
-        parent = _eval(sym._inputs[0], env, cache, keyctx)
+        parent = _eval(sym._inputs[0], env, cache, keyctx, shared)
         val = parent[sym._attrs["index"]]
     elif sym._op == "_cond":
         # evaluated HERE (not via the registry fn) so branches share the
         # outer cache: a node used both outside and inside a branch
         # evaluates once — one noise draw per node per forward — and
         # branch-internal rng nodes reach the threaded keyctx
-        pred = _eval(sym._inputs[0], env, cache, keyctx)
-        vals = [_eval(i, env, cache, keyctx) for i in sym._inputs[1:]]
+        pred = _eval(sym._inputs[0], env, cache, keyctx, shared)
+        vals = [_eval(i, env, cache, keyctx, shared) for i in sym._inputs[1:]]
         benv = dict(zip(sym._attrs["arg_names"], vals))
         p = jnp.asarray(pred).reshape(()).astype(bool)
         then_sym, else_sym = sym._attrs["then_sym"], sym._attrs["else_sym"]
@@ -365,17 +408,18 @@ def _eval(sym, env, cache, keyctx=None):
         hoist, hseen = [], set()
         _stochastic_nodes(then_sym, hseen, hoist)
         _stochastic_nodes(else_sym, hseen, hoist)
+        hoist = [n for n in hoist if id(n) in shared]
         if hoist:
             menv = {**env, **benv}
             for node in hoist:
-                _eval(node, menv, cache, keyctx)
+                _eval(node, menv, cache, keyctx, shared)
         val = lax.cond(
             p,
-            lambda e: _eval(then_sym, e, dict(cache), keyctx),
-            lambda e: _eval(else_sym, e, dict(cache), keyctx),
+            lambda e: _eval(then_sym, e, dict(cache), keyctx, shared),
+            lambda e: _eval(else_sym, e, dict(cache), keyctx, shared),
             benv)
     else:
-        ins = [_eval(i, env, cache, keyctx) for i in sym._inputs]
+        ins = [_eval(i, env, cache, keyctx, shared) for i in sym._inputs]
         opdef = OP_REGISTRY[sym._op]
         attrs = sym._attrs
         if opdef.needs_rng and "key" not in attrs:

@@ -148,3 +148,20 @@ def test_metric_np_and_gluon_metric():
              nd.array(np.array([[0.9, 0.1], [0.2, 0.8]], np.float32)))
     assert m.get() == ("acc2", 1.0)
     assert gluon.metric.Accuracy is metric.Accuracy
+
+
+def test_sym_random_namespace():
+    """mx.sym.random builders (ref: python/mxnet/symbol/random.py)."""
+    import numpy as np
+
+    u = mx.sym.random.uniform(low=1.0, high=2.0, shape=(3, 3))
+    out = u.eval()[0].asnumpy()
+    assert out.shape == (3, 3) and (out >= 1).all() and (out < 2).all()
+    m = mx.sym.random.multinomial(
+        sym.var("x", shape=(2, 2)), shape=5)
+    res = m.eval(x=mx.nd.array(np.array([[0.9, 0.1], [0.1, 0.9]],
+                                        np.float32)))[0]
+    assert res.shape == (2, 5)
+
+    import mxnet_tpu.sym.random as symrand
+    assert symrand is mx.sym.random

@@ -11,6 +11,13 @@ def get_model(name, **kwargs):
     """(ref: model_zoo/vision/__init__.py:get_model)"""
     from . import resnet, vgg, alexnet, mobilenet, squeezenet, densenet, inception
 
+    if kwargs.pop("pretrained", False):
+        # no model store is reachable (zero-egress TPU pods); silently
+        # returning random weights would be far worse than failing
+        raise ValueError(
+            "pretrained weights are not bundled; construct the model and "
+            "load a checkpoint explicitly with net.load_parameters(path)")
+
     registry = {
         "resnet18_v1": resnet.resnet18_v1, "resnet34_v1": resnet.resnet34_v1,
         "resnet50_v1": resnet.resnet50_v1, "resnet101_v1": resnet.resnet101_v1,

@@ -218,6 +218,12 @@ resnet_block_versions = [
 
 
 def get_resnet(version, num_layers, pretrained=False, ctx=None, **kwargs):
+    if pretrained:
+        # no model store is reachable (zero-egress); silently returning
+        # random weights would masquerade as ImageNet initialization
+        raise ValueError(
+            "pretrained weights are not bundled; construct the model and "
+            "load a checkpoint explicitly with net.load_parameters(path)")
     block_type, layers, channels = resnet_spec[num_layers]
     net = resnet_net_versions[version - 1](
         resnet_block_versions[version - 1][block_type], layers, channels, **kwargs)

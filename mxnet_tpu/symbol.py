@@ -324,38 +324,44 @@ def _stochastic_nodes(sym, seen, out):
             _stochastic_nodes(v, seen, out)
 
 
-def _shared_stochastic_ids(root):
-    """Ids of stochastic nodes reachable from MORE THAN ONE region — the
-    main graph (inputs-only walk) or any individual cond branch. Only these
-    need hoisting out of lax.cond for order-independent single draws;
+def _shared_stochastic_ids(roots):
+    """Ids of stochastic nodes reachable from MORE THAN ONE region. Regions
+    are: the main graph (all roots, one inputs-only walk stopping at cond
+    attrs) and each cond BRANCH (also stopping at nested cond attrs, whose
+    branches form their own regions; conds deduped by id so a cond used
+    twice doesn't double-count its branches). Only shared nodes need
+    hoisting out of lax.cond for order-independent single draws —
     branch-PRIVATE draws stay inside the untaken-branch-skipping cond."""
+    if isinstance(roots, Symbol):
+        roots = [roots]
     conds = []
+    cond_ids = set()
 
-    def walk(s, acc, seen, descend_attrs):
+    def walk(s, acc, seen):
+        # region walk: stop at cond branch attrs (they are separate regions)
         if id(s) in seen:
             return
         seen.add(id(s))
         acc.add(id(s))
-        if s._op == "_cond":
+        if s._op == "_cond" and id(s) not in cond_ids:
+            cond_ids.add(id(s))
             conds.append(s)
         for i in s._inputs:
-            walk(i, acc, seen, descend_attrs)
-        if descend_attrs:
-            for v in s._attrs.values():
-                if isinstance(v, Symbol):
-                    walk(v, acc, seen, True)
+            walk(i, acc, seen)
 
     regions = []
     main = set()
-    walk(root, main, set(), False)
+    seen_main = set()
+    for r in roots:
+        walk(r, main, seen_main)
     regions.append(main)
     i = 0
-    while i < len(conds):   # walk_full discovers nested conds as it goes
+    while i < len(conds):   # branch walks discover nested conds as they go
         c = conds[i]
         i += 1
         for b in (c._attrs["then_sym"], c._attrs["else_sym"]):
             acc = set()
-            walk(b, acc, set(), True)
+            walk(b, acc, set())
             regions.append(acc)
     counts = {}
     for r in regions:
@@ -441,8 +447,11 @@ def _eval(sym, env, cache, keyctx=None, shared=frozenset()):
 def _eval_symbols(outputs, feed):
     cache = {}
     outs = []
+    # shared-draw classification must cover ALL outputs' graphs at once —
+    # the SymbolBlock path needs the same cond-hoist guarantee as Executor
+    shared = _shared_stochastic_ids(outputs)
     for s in outputs:
-        o = _eval(s, feed, cache)
+        o = _eval(s, feed, cache, None, shared)
         outs.extend(o if isinstance(o, list) else [o])
     return outs
 

@@ -284,3 +284,29 @@ def test_rng_node_shared_between_main_and_branch():
         np.testing.assert_allclose(y1, 3 * r1, rtol=1e-6)
         r2, _ = (o.asnumpy() for o in ex.forward())
         assert not (r1 == r2).all()   # cross-call freshness
+
+
+def test_nested_cond_private_draws_and_symbolblock_consistency():
+    """Nested-cond branch-private draws stay inside lax.cond (not hoisted);
+    the SymbolBlock evaluation path gets the same order-independent
+    single-draw guarantee as Executor."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym
+    from mxnet_tpu.gluon.block import SymbolBlock
+    from mxnet_tpu.symbol import Group, _shared_stochastic_ids
+
+    p = sym.var("p", shape=(1,))
+    x = sym.var("x", shape=(2, 3))
+    r = mx.sym.random_uniform(shape=(2, 3))
+    priv = mx.sym.random_normal(shape=(2, 3))
+    inner = sym.cond(p, priv * 1, x)
+    outer = sym.cond(p, inner + r, x) + r
+    shared = _shared_stochastic_ids(outer)
+    assert id(r) in shared and id(priv) not in shared
+
+    y = sym.cond(p, r * 2, x) + r   # cond evaluates first
+    blk = SymbolBlock(Group([r, y]), [p, x])
+    pv = nd.array(np.array([1.0], np.float32))
+    xv = nd.array(np.zeros((2, 3), np.float32))
+    r1, y1 = (o.asnumpy() for o in blk(pv, xv))
+    np.testing.assert_allclose(y1, 3 * r1, rtol=1e-6)

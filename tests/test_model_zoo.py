@@ -156,3 +156,12 @@ def test_profiler_counter_marker_domain(tmp_path, monkeypatch):
                for e in ev)
     agg = profiler.aggregate()
     assert "t" in agg and "ctr" not in agg
+
+
+def test_pretrained_raises_clearly():
+    """pretrained=True must fail loudly — silently returning random weights
+    would masquerade as ImageNet initialization."""
+    with pytest.raises(ValueError):
+        get_model("resnet18_v1", pretrained=True)
+    net = get_model("resnet18_v1", pretrained=False, classes=4)
+    assert net is not None

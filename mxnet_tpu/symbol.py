@@ -448,8 +448,18 @@ def _eval_symbols(outputs, feed):
     cache = {}
     outs = []
     # shared-draw classification must cover ALL outputs' graphs at once —
-    # the SymbolBlock path needs the same cond-hoist guarantee as Executor
-    shared = _shared_stochastic_ids(outputs)
+    # the SymbolBlock path needs the same cond-hoist guarantee as Executor.
+    # Memoized ON the first output symbol (its lifetime bounds the memo, so
+    # id() reuse after GC can never serve a stale set), keyed by the full
+    # output id tuple in case the same head appears in different groupings.
+    ck = tuple(id(s) for s in outputs)
+    memo = outputs[0].__dict__.get("_shared_memo") if outputs else None
+    if memo is not None and memo[0] == ck:
+        shared = memo[1]
+    else:
+        shared = _shared_stochastic_ids(outputs)
+        if outputs:
+            outputs[0]._shared_memo = (ck, shared)
     for s in outputs:
         o = _eval(s, feed, cache, None, shared)
         outs.extend(o if isinstance(o, list) else [o])

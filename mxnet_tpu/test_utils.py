@@ -69,3 +69,55 @@ def check_numeric_gradient(fn, inputs, eps=1e-3, rtol=1e-2, atol=1e-3,
                     "gradient mismatch at input %d elem %d: autograd %g vs fd %g"
                     % (k, i, g[i], fd))
     return True
+
+
+def assert_exception(f, exception_type, *args, **kwargs):
+    """Assert f(*args, **kwargs) raises exception_type (ref:
+    test_utils.py:assert_exception)."""
+    try:
+        f(*args, **kwargs)
+    except exception_type:
+        return
+    raise AssertionError("%r did not raise %s" % (f, exception_type.__name__))
+
+
+def check_symbolic_forward(sym, inputs, expected, rtol=1e-5, atol=1e-8):
+    """Bind ``sym`` with positional input arrays (matched to
+    list_arguments order) and compare outputs against ``expected``
+    (ref: test_utils.py:check_symbolic_forward)."""
+    names = sym.list_arguments()
+    args = {n: array(_np(v)) for n, v in zip(names, inputs)}
+    ex = sym.bind(args=args)
+    outs = ex.forward()
+    if not isinstance(expected, (list, tuple)):
+        expected = [expected]
+    assert len(outs) == len(expected), (
+        "%d outputs vs %d expected values" % (len(outs), len(expected)))
+    for o, e in zip(outs, expected):
+        np.testing.assert_allclose(_np(o), _np(e), rtol=rtol, atol=atol)
+    return outs
+
+
+def check_symbolic_backward(sym, inputs, out_grads, expected_grads,
+                            rtol=1e-5, atol=1e-8, grad_req="write"):
+    """Forward+backward ``sym`` and compare input gradients (ref:
+    test_utils.py:check_symbolic_backward)."""
+    names = sym.list_arguments()
+    args = {n: array(_np(v)) for n, v in zip(names, inputs)}
+    grads = {n: array(np.zeros_like(_np(v))) for n, v in zip(names, inputs)}
+    ex = sym.bind(args=args, args_grad=grads, grad_req=grad_req)
+    ex.forward(is_train=True)
+    ex.backward([array(_np(g)) for g in out_grads]
+                if isinstance(out_grads, (list, tuple))
+                else array(_np(out_grads)))
+    if isinstance(expected_grads, dict):
+        items = expected_grads.items()
+    else:
+        assert len(names) == len(expected_grads), (
+            "%d arguments vs %d expected gradients"
+            % (len(names), len(expected_grads)))
+        items = zip(names, expected_grads)
+    for n, e in items:
+        np.testing.assert_allclose(_np(ex.grad_dict[n]), _np(e),
+                                   rtol=rtol, atol=atol)
+    return ex.grad_dict

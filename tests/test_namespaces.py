@@ -165,3 +165,24 @@ def test_sym_random_namespace():
 
     import mxnet_tpu.sym.random as symrand
     assert symrand is mx.sym.random
+
+
+def test_test_utils_symbolic_checks():
+    """check_symbolic_forward/backward + assert_exception (ref:
+    python/mxnet/test_utils.py)."""
+    import numpy as np
+
+    from mxnet_tpu import test_utils
+
+    a = sym.var("a", shape=(2, 2))
+    b = sym.var("b", shape=(2, 2))
+    y = a * b + a
+    av = np.random.RandomState(0).randn(2, 2).astype(np.float32)
+    bv = np.random.RandomState(1).randn(2, 2).astype(np.float32)
+    test_utils.check_symbolic_forward(y, [av, bv], [av * bv + av])
+    og = np.ones((2, 2), np.float32)
+    test_utils.check_symbolic_backward(y, [av, bv], [og],
+                                       {"a": bv + 1, "b": av})
+    test_utils.assert_exception(lambda: 1 / 0, ZeroDivisionError)
+    with pytest.raises(AssertionError):
+        test_utils.assert_exception(lambda: None, ValueError)

@@ -19,7 +19,8 @@ from .base import OP_REGISTRY, resolve_dtype
 from .context import current_context
 from .ndarray import NDArray
 
-__all__ = ["Symbol", "var", "Variable", "Group", "load", "Executor", "cond"]
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "Executor", "cond",
+           "foreach"]
 
 
 class Symbol:
@@ -252,6 +253,10 @@ class Symbol:
                     # node table — branch vars are shared with the outer
                     # graph, so the shared index keeps one copy
                     nid_attrs[k] = {"__sym__": ser(v, nodes, index)}
+                elif isinstance(v, list) and any(isinstance(e, Symbol)
+                                                 for e in v):
+                    nid_attrs[k] = {"__symlist__": [ser(e, nodes, index)
+                                                    for e in v]}
                 else:
                     nid_attrs[k] = repr(v)
             nid = len(nodes)
@@ -276,6 +281,18 @@ class Symbol:
 
     def __repr__(self):
         return "<Symbol %s>" % self.name
+
+
+def _attr_symbols(attrs):
+    """Symbol-valued attr entries, including lists of Symbols (foreach's
+    state_syms)."""
+    for v in attrs.values():
+        if isinstance(v, Symbol):
+            yield v
+        elif isinstance(v, list):
+            for e in v:
+                if isinstance(e, Symbol):
+                    yield e
 
 
 def _node_is_stochastic(sym):
@@ -306,7 +323,7 @@ def _graph_has_rng(sym):
         if _node_is_stochastic(s):
             return True
         stack.extend(s._inputs)
-        stack.extend(v for v in s._attrs.values() if isinstance(v, Symbol))
+        stack.extend(_attr_symbols(s._attrs))
     return False
 
 
@@ -319,9 +336,8 @@ def _stochastic_nodes(sym, seen, out):
         out.append(sym)
     for i in sym._inputs:
         _stochastic_nodes(i, seen, out)
-    for v in sym._attrs.values():
-        if isinstance(v, Symbol):
-            _stochastic_nodes(v, seen, out)
+    for v in _attr_symbols(sym._attrs):
+        _stochastic_nodes(v, seen, out)
 
 
 def _shared_stochastic_ids(roots):
@@ -334,18 +350,24 @@ def _shared_stochastic_ids(roots):
     branch-PRIVATE draws stay inside the untaken-branch-skipping cond."""
     if isinstance(roots, Symbol):
         roots = [roots]
-    conds = []
-    cond_ids = set()
+    subgraph_nodes = []   # (list of region-root Symbols) per cond/foreach
+    seen_owners = set()
 
     def walk(s, acc, seen):
-        # region walk: stop at cond branch attrs (they are separate regions)
+        # region walk: stop at subgraph attrs (they are separate regions)
         if id(s) in seen:
             return
         seen.add(id(s))
         acc.add(id(s))
-        if s._op == "_cond" and id(s) not in cond_ids:
-            cond_ids.add(id(s))
-            conds.append(s)
+        if id(s) not in seen_owners:
+            if s._op == "_cond":
+                seen_owners.add(id(s))
+                subgraph_nodes.append([s._attrs["then_sym"]])
+                subgraph_nodes.append([s._attrs["else_sym"]])
+            elif s._op == "_foreach":
+                seen_owners.add(id(s))
+                subgraph_nodes.append([s._attrs["out_sym"]]
+                                      + list(s._attrs["state_syms"]))
         for i in s._inputs:
             walk(i, acc, seen)
 
@@ -356,13 +378,14 @@ def _shared_stochastic_ids(roots):
         walk(r, main, seen_main)
     regions.append(main)
     i = 0
-    while i < len(conds):   # branch walks discover nested conds as they go
-        c = conds[i]
+    while i < len(subgraph_nodes):   # walks discover nested subgraphs
+        region_roots = subgraph_nodes[i]
         i += 1
-        for b in (c._attrs["then_sym"], c._attrs["else_sym"]):
-            acc = set()
-            walk(b, acc, set())
-            regions.append(acc)
+        acc = set()
+        seen = set()
+        for b in region_roots:
+            walk(b, acc, seen)
+        regions.append(acc)
     counts = {}
     for r in regions:
         for nid in r:
@@ -396,6 +419,63 @@ def _eval(sym, env, cache, keyctx=None, shared=frozenset()):
     elif sym._op == "_item":
         parent = _eval(sym._inputs[0], env, cache, keyctx, shared)
         val = parent[sym._attrs["index"]]
+    elif sym._op == "_foreach":
+        n_states = sym._attrs["n_states"]
+        data_v = _eval(sym._inputs[0], env, cache, keyctx, shared)
+        state_vs = [_eval(i, env, cache, keyctx, shared)
+                    for i in sym._inputs[1:1 + n_states]]
+        free_vs = [_eval(i, env, cache, keyctx, shared)
+                   for i in sym._inputs[1 + n_states:]]
+        free_env = dict(zip(sym._attrs["free_names"], free_vs))
+        out_sym = sym._attrs["out_sym"]
+        state_syms = sym._attrs["state_syms"]
+        slice_name = sym._attrs["slice_name"]
+        state_names = sym._attrs["state_names"]
+
+        # nodes shared with the outer graph hoist BEFORE the scan (same
+        # single-draw guarantee as cond); the body sees them via the cache
+        body_stoch, hseen = [], set()
+        _stochastic_nodes(out_sym, hseen, body_stoch)
+        for s in state_syms:
+            _stochastic_nodes(s, hseen, body_stoch)
+        for node in body_stoch:
+            if id(node) in shared:
+                _eval(node, env, cache, keyctx, shared)
+        body_private = [n for n in body_stoch if id(n) not in shared]
+
+        if body_private:
+            # per-iteration noise: thread a key through the scan CARRY and
+            # split each step — a trace-constant key would repeat the same
+            # draw (e.g. one dropout mask) every timestep
+            from . import random as _rng
+
+            k0 = keyctx.next() if keyctx is not None else _rng.next_key()
+
+            def step(carry, x):
+                key, st = carry
+                key, sub = jax.random.split(key)
+                sctx = _KeyCtx(sub)
+                senv = {slice_name: x, **dict(zip(state_names, st)),
+                        **free_env}
+                sc = dict(cache)
+                o = _eval(out_sym, senv, sc, sctx, shared)
+                new = tuple(_eval(s, senv, sc, sctx, shared)
+                            for s in state_syms)
+                return (key, new), o
+
+            (_, final), outs = lax.scan(step, (k0, tuple(state_vs)), data_v)
+        else:
+            def step(carry, x):
+                senv = {slice_name: x, **dict(zip(state_names, carry)),
+                        **free_env}
+                sc = dict(cache)
+                o = _eval(out_sym, senv, sc, keyctx, shared)
+                new = tuple(_eval(s, senv, sc, keyctx, shared)
+                            for s in state_syms)
+                return new, o
+
+            final, outs = lax.scan(step, tuple(state_vs), data_v)
+        val = [outs] + list(final)
     elif sym._op == "_cond":
         # evaluated HERE (not via the registry fn) so branches share the
         # outer cache: a node used both outside and inside a branch
@@ -530,6 +610,77 @@ def cond(pred, then_sym, else_sym, name=None):
                    "arg_names": arg_names}, name=name or "cond")
 
 
+_foreach_uid = 0
+
+
+def foreach(body, data, init_states, name=None):
+    """Symbolic scan (ref: python/mxnet/symbol/contrib.py:foreach,
+    src/operator/control_flow.cc). ``body(slice_sym, states) ->
+    (out_sym, new_states)`` is traced ONCE over fresh loop variables; the
+    node lowers to lax.scan at evaluation, so the whole loop is one compiled
+    XLA while-op. Returns (outputs, states) like upstream."""
+    single_state = not isinstance(init_states, (list, tuple))
+    states = [init_states] if single_state else list(init_states)
+
+    # loop vars get reserved '_fe*' names no user var can plausibly carry
+    global _foreach_uid
+    _foreach_uid += 1
+    slice_v = Symbol(None, name="_fe%d_x" % _foreach_uid,
+                     shape=(data._shape[1:] if data._shape else None))
+    state_vs = [Symbol(None, name="_fe%d_s%d" % (_foreach_uid, j),
+                       shape=(s._shape if isinstance(s, Symbol) else None))
+                for j, s in enumerate(states)]
+    out_sym, new_states = body(slice_v,
+                               state_vs[0] if single_state else state_vs)
+    new_states = [new_states] if not isinstance(new_states, (list, tuple)) \
+        else list(new_states)
+    if len(new_states) != len(states):
+        raise ValueError("body returned %d states, expected %d"
+                         % (len(new_states), len(states)))
+
+    # free variables of the body = everything its subgraphs reference that
+    # is not a loop variable; their values come from the outer graph
+    loop_names = {slice_v.name} | {v.name for v in state_vs}
+    free = []
+    seen_names = set()
+    for s in [out_sym] + new_states:
+        for a in s._arg_symbols():
+            if a.name not in loop_names and a.name not in seen_names:
+                seen_names.add(a.name)
+                free.append(a)
+
+    node = Symbol("_foreach", [data] + list(states) + free,
+                  {"out_sym": out_sym, "state_syms": new_states,
+                   "slice_name": slice_v.name,
+                   "state_names": [v.name for v in state_vs],
+                   "free_names": [a.name for a in free],
+                   "n_states": len(states)},
+                  name=name)
+    outputs = node[0]
+    out_states = [node[i + 1] for i in range(len(states))]
+    return outputs, (out_states[0] if single_state else out_states)
+
+
+@register_op("_foreach")
+def _foreach_op(data, *rest, out_sym, state_syms, slice_name, state_names,
+                free_names, n_states):
+    """SHAPE-INFERENCE ONLY (shape_inference.py eval_shapes through the
+    registry) — like _cond_op below, value evaluation goes through _eval's
+    dedicated _foreach branch (cache sharing + per-iteration keys)."""
+    states = rest[:n_states]
+    free_env = dict(zip(free_names, rest[n_states:]))
+
+    def step(carry, x):
+        senv = {slice_name: x, **dict(zip(state_names, carry)), **free_env}
+        sc = {}
+        o = _eval(out_sym, senv, sc)
+        new = tuple(_eval(s, senv, sc) for s in state_syms)
+        return new, o
+
+    final, outs = lax.scan(step, tuple(states), data)
+    return [outs] + list(final)
+
+
 @register_op("_cond")
 def _cond_op(pred, *vals, then_sym, else_sym, arg_names):
     """SHAPE-INFERENCE ONLY (shape_inference.py eval_shapes through the
@@ -575,6 +726,8 @@ def loads(json_str):
         for k, v in node["attrs"].items():
             if isinstance(v, dict) and "__sym__" in v:
                 attrs[k] = built[v["__sym__"]]  # subgraph attr (cond branch)
+            elif isinstance(v, dict) and "__symlist__" in v:
+                attrs[k] = [built[i] for i in v["__symlist__"]]
             else:
                 attrs[k] = ast.literal_eval(v)
         if node["op"] == "null":

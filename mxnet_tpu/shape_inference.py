@@ -188,6 +188,19 @@ def infer_shapes_partial(sym, known, int_vars=()):
                             shapes[id(child)] = ins[idx]
                             var_shapes[child.name] = ins[idx]
                             progress[0] = True
+            if node._op in ("_foreach", "_while") and any(
+                    s is None for s in ins):
+                # loop bodies carry their own param-rule deductions: infer
+                # through the SUBGRAPH with the loop-var shapes bound, then
+                # lift what it learns about free vars (e.g. an RNN weight
+                # used only inside the loop) back to the outer graph
+                for idx, s in _loop_free_var_shapes(node, ins).items():
+                    child = node._inputs[idx]
+                    if ins[idx] is None and s is not None and child.is_var():
+                        ins[idx] = tuple(s)
+                        shapes[id(child)] = ins[idx]
+                        var_shapes[child.name] = ins[idx]
+                        progress[0] = True
             if any(s is None for s in ins):
                 return None
             entry = OP_REGISTRY.get(node._op)
@@ -230,3 +243,44 @@ def format_infer_errors(errors):
         return ""
     return "; node failures: " + "; ".join(
         "%s -> %s" % (k, v) for k, v in list(errors.items())[:5])
+
+
+def _loop_free_var_shapes(node, ins):
+    """Deduce free-variable shapes of a _foreach/_while body by running
+    shape inference INSIDE the subgraph with loop-var shapes bound.
+    Returns {outer input index: shape}."""
+    from .symbol import Group
+
+    a = node._attrs
+    body_known = {}
+    if node._op == "_foreach":
+        n_states = a["n_states"]
+        if ins[0] is not None and len(ins[0]) >= 1:
+            body_known[a["slice_name"]] = tuple(ins[0][1:])
+        for nm, s in zip(a["state_names"], ins[1:1 + n_states]):
+            if s is not None:
+                body_known[nm] = tuple(s)
+        free_names = a["free_names"]
+        free_base = 1 + n_states
+        roots = [a["out_sym"]] + list(a["state_syms"])
+    else:
+        n_vars = a["n_vars"]
+        for nm, s in zip(a["var_names"], ins[:n_vars]):
+            if s is not None:
+                body_known[nm] = tuple(s)
+        free_names = a["free_names"]
+        free_base = n_vars
+        roots = [a["pred_sym"], a["out_sym"]] + list(a["var_syms"])
+    for nm, s in zip(free_names, ins[free_base:]):
+        if s is not None:
+            body_known[nm] = tuple(s)
+    try:
+        var_shapes, _, _ = infer_shapes_partial(Group(roots), body_known)
+    except Exception:
+        return {}
+    out = {}
+    for j, nm in enumerate(free_names):
+        s = var_shapes.get(nm)
+        if s is not None:
+            out[free_base + j] = s
+    return out

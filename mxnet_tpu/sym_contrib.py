@@ -4,7 +4,7 @@ via _contrib_ops.py."""
 from __future__ import annotations
 
 from ._contrib_ops import CONTRIB_OPS
-from .symbol import _make, cond, foreach  # noqa: F401
+from .symbol import _make, cond, foreach, while_loop  # noqa: F401
 
 
 def _wrap(opname):

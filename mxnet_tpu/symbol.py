@@ -20,7 +20,7 @@ from .context import current_context
 from .ndarray import NDArray
 
 __all__ = ["Symbol", "var", "Variable", "Group", "load", "Executor", "cond",
-           "foreach"]
+           "foreach", "while_loop"]
 
 
 class Symbol:
@@ -130,6 +130,18 @@ class Symbol:
 
     def __neg__(self):
         return _make("negative", self)
+
+    def __lt__(self, o):
+        return _make("lesser", self, o)
+
+    def __le__(self, o):
+        return _make("lesser_equal", self, o)
+
+    def __gt__(self, o):
+        return _make("greater", self, o)
+
+    def __ge__(self, o):
+        return _make("greater_equal", self, o)
 
     # ------------------------------------------------------------- evaluate
     @property
@@ -368,6 +380,11 @@ def _shared_stochastic_ids(roots):
                 seen_owners.add(id(s))
                 subgraph_nodes.append([s._attrs["out_sym"]]
                                       + list(s._attrs["state_syms"]))
+            elif s._op == "_while":
+                seen_owners.add(id(s))
+                subgraph_nodes.append([s._attrs["pred_sym"],
+                                       s._attrs["out_sym"]]
+                                      + list(s._attrs["var_syms"]))
         for i in s._inputs:
             walk(i, acc, seen)
 
@@ -419,6 +436,20 @@ def _eval(sym, env, cache, keyctx=None, shared=frozenset()):
     elif sym._op == "_item":
         parent = _eval(sym._inputs[0], env, cache, keyctx, shared)
         val = parent[sym._attrs["index"]]
+    elif sym._op == "_while":
+        n_vars = sym._attrs["n_vars"]
+        var_vs = [_eval(i, env, cache, keyctx, shared)
+                  for i in sym._inputs[:n_vars]]
+        free_vs = [_eval(i, env, cache, keyctx, shared)
+                   for i in sym._inputs[n_vars:]]
+        free_env = dict(zip(sym._attrs["free_names"], free_vs))
+        stochastic = _hoist_shared_draws(
+            [sym._attrs["pred_sym"], sym._attrs["out_sym"]]
+            + list(sym._attrs["var_syms"]), env, cache, keyctx, shared)
+        val = _while_scan(sym._attrs["pred_sym"], sym._attrs["out_sym"],
+                          sym._attrs["var_syms"], sym._attrs["var_names"],
+                          free_env, var_vs, sym._attrs["max_iterations"],
+                          cache, keyctx, shared, stochastic)
     elif sym._op == "_foreach":
         n_states = sym._attrs["n_states"]
         data_v = _eval(sym._inputs[0], env, cache, keyctx, shared)
@@ -434,48 +465,11 @@ def _eval(sym, env, cache, keyctx=None, shared=frozenset()):
 
         # nodes shared with the outer graph hoist BEFORE the scan (same
         # single-draw guarantee as cond); the body sees them via the cache
-        body_stoch, hseen = [], set()
-        _stochastic_nodes(out_sym, hseen, body_stoch)
-        for s in state_syms:
-            _stochastic_nodes(s, hseen, body_stoch)
-        for node in body_stoch:
-            if id(node) in shared:
-                _eval(node, env, cache, keyctx, shared)
-        body_private = [n for n in body_stoch if id(n) not in shared]
-
-        if body_private:
-            # per-iteration noise: thread a key through the scan CARRY and
-            # split each step — a trace-constant key would repeat the same
-            # draw (e.g. one dropout mask) every timestep
-            from . import random as _rng
-
-            k0 = keyctx.next() if keyctx is not None else _rng.next_key()
-
-            def step(carry, x):
-                key, st = carry
-                key, sub = jax.random.split(key)
-                sctx = _KeyCtx(sub)
-                senv = {slice_name: x, **dict(zip(state_names, st)),
-                        **free_env}
-                sc = dict(cache)
-                o = _eval(out_sym, senv, sc, sctx, shared)
-                new = tuple(_eval(s, senv, sc, sctx, shared)
-                            for s in state_syms)
-                return (key, new), o
-
-            (_, final), outs = lax.scan(step, (k0, tuple(state_vs)), data_v)
-        else:
-            def step(carry, x):
-                senv = {slice_name: x, **dict(zip(state_names, carry)),
-                        **free_env}
-                sc = dict(cache)
-                o = _eval(out_sym, senv, sc, keyctx, shared)
-                new = tuple(_eval(s, senv, sc, keyctx, shared)
-                            for s in state_syms)
-                return new, o
-
-            final, outs = lax.scan(step, tuple(state_vs), data_v)
-        val = [outs] + list(final)
+        stochastic = _hoist_shared_draws(
+            [out_sym] + list(state_syms), env, cache, keyctx, shared)
+        val = _foreach_scan(out_sym, state_syms, slice_name, state_names,
+                            free_env, state_vs, data_v, cache, keyctx,
+                            shared, stochastic)
     elif sym._op == "_cond":
         # evaluated HERE (not via the registry fn) so branches share the
         # outer cache: a node used both outside and inside a branch
@@ -621,6 +615,11 @@ def foreach(body, data, init_states, name=None):
     XLA while-op. Returns (outputs, states) like upstream."""
     single_state = not isinstance(init_states, (list, tuple))
     states = [init_states] if single_state else list(init_states)
+    for s in [data] + states:
+        if not isinstance(s, Symbol):
+            raise TypeError("foreach data/init_states must be Symbols, got "
+                            "%s — nd.contrib.foreach is the eager form"
+                            % type(s).__name__)
 
     # loop vars get reserved '_fe*' names no user var can plausibly carry
     global _foreach_uid
@@ -632,6 +631,11 @@ def foreach(body, data, init_states, name=None):
                 for j, s in enumerate(states)]
     out_sym, new_states = body(slice_v,
                                state_vs[0] if single_state else state_vs)
+    if isinstance(out_sym, (list, tuple)):
+        raise NotImplementedError(
+            "foreach bodies with multiple per-step outputs are not "
+            "supported yet — return one Symbol (stack/concat inside the "
+            "body, or run several foreach loops)")
     new_states = [new_states] if not isinstance(new_states, (list, tuple)) \
         else list(new_states)
     if len(new_states) != len(states):
@@ -641,13 +645,7 @@ def foreach(body, data, init_states, name=None):
     # free variables of the body = everything its subgraphs reference that
     # is not a loop variable; their values come from the outer graph
     loop_names = {slice_v.name} | {v.name for v in state_vs}
-    free = []
-    seen_names = set()
-    for s in [out_sym] + new_states:
-        for a in s._arg_symbols():
-            if a.name not in loop_names and a.name not in seen_names:
-                seen_names.add(a.name)
-                free.append(a)
+    free = _free_args([out_sym] + new_states, loop_names)
 
     node = Symbol("_foreach", [data] + list(states) + free,
                   {"out_sym": out_sym, "state_syms": new_states,
@@ -661,24 +659,184 @@ def foreach(body, data, init_states, name=None):
     return outputs, (out_states[0] if single_state else out_states)
 
 
+def while_loop(cond_fn, func, loop_vars, max_iterations, name=None):
+    """Symbolic bounded while loop (ref: python/mxnet/symbol/contrib.py:
+    while_loop). ``cond_fn(vars) -> pred_sym``; ``func(vars) ->
+    (out_sym, new_vars)``. Lowers to a masked lax.scan of length
+    ``max_iterations`` (the TPU-static form — XLA needs a bound to stack
+    per-step outputs); steps after the predicate turns false leave the vars
+    unchanged and emit zero rows. Returns (outputs, final_vars)."""
+    if max_iterations is None:
+        raise ValueError("symbolic while_loop needs max_iterations (static "
+                         "output stacking; the nd.contrib form allows None)")
+    single = not isinstance(loop_vars, (list, tuple))
+    vars_in = [loop_vars] if single else list(loop_vars)
+    for v in vars_in:
+        if not isinstance(v, Symbol):
+            raise TypeError("while_loop loop_vars must be Symbols, got %s — "
+                            "nd.contrib.while_loop is the eager form"
+                            % type(v).__name__)
+
+    global _foreach_uid
+    _foreach_uid += 1
+    var_vs = [Symbol(None, name="_wl%d_v%d" % (_foreach_uid, j),
+                     shape=(v._shape if isinstance(v, Symbol) else None))
+              for j, v in enumerate(vars_in)]
+    packed = var_vs[0] if single else var_vs
+    pred_sym = cond_fn(packed)
+    out_sym, new_vars = func(packed)
+    if isinstance(out_sym, (list, tuple)):
+        raise NotImplementedError(
+            "while_loop bodies with multiple per-step outputs are not "
+            "supported yet — return one Symbol")
+    new_vars = [new_vars] if not isinstance(new_vars, (list, tuple)) \
+        else list(new_vars)
+    if len(new_vars) != len(vars_in):
+        raise ValueError("func returned %d loop vars, expected %d"
+                         % (len(new_vars), len(vars_in)))
+
+    loop_names = {v.name for v in var_vs}
+    free = _free_args([pred_sym, out_sym] + new_vars, loop_names)
+
+    node = Symbol("_while", list(vars_in) + free,
+                  {"pred_sym": pred_sym, "out_sym": out_sym,
+                   "var_syms": new_vars,
+                   "var_names": [v.name for v in var_vs],
+                   "free_names": [a.name for a in free],
+                   "n_vars": len(vars_in),
+                   "max_iterations": int(max_iterations)},
+                  name=name)
+    outputs = node[0]
+    out_vars = [node[i + 1] for i in range(len(vars_in))]
+    return outputs, (out_vars[0] if single else out_vars)
+
+
+def _hoist_shared_draws(roots, env, cache, keyctx, shared):
+    """Evaluate subgraph stochastic nodes that are SHARED with the outer
+    graph into the outer cache (one draw per forward); returns whether any
+    body-PRIVATE stochastic nodes remain (those need per-iteration keys)."""
+    body_stoch, seen = [], set()
+    for s in roots:
+        _stochastic_nodes(s, seen, body_stoch)
+    for node in body_stoch:
+        if id(node) in shared:
+            _eval(node, env, cache, keyctx, shared)
+    return any(id(n) not in shared for n in body_stoch)
+
+
+def _free_args(roots, loop_names):
+    """Free variables of the body subgraphs, outer-graph order, deduped —
+    everything the body references that is not a loop variable."""
+    free, seen = [], set()
+    for s in roots:
+        if not isinstance(s, Symbol):
+            raise TypeError(
+                "loop body must return Symbols, got %s — nd.contrib offers "
+                "the eager NDArray form" % type(s).__name__)
+        for a in s._arg_symbols():
+            if a.name not in loop_names and a.name not in seen:
+                seen.add(a.name)
+                free.append(a)
+    return free
+
+
+def _foreach_scan(out_sym, state_syms, slice_name, state_names, free_env,
+                  state_vs, data_v, cache, keyctx, shared, stochastic):
+    """The ONE scan-step implementation for foreach (value evaluation and
+    shape inference both route here)."""
+    from . import random as _rng
+
+    def body(st, x, sctx):
+        senv = {slice_name: x, **dict(zip(state_names, st)), **free_env}
+        sc = dict(cache)
+        o = _eval(out_sym, senv, sc, sctx, shared)
+        new = tuple(_eval(s, senv, sc, sctx, shared) for s in state_syms)
+        return new, o
+
+    if stochastic:
+        # per-iteration noise: thread a key through the scan CARRY and split
+        # each step — a trace-constant key would repeat the same draw (e.g.
+        # one dropout mask) every timestep
+        k0 = keyctx.next() if keyctx is not None else _rng.next_key()
+
+        def step(carry, x):
+            key, st = carry
+            key, sub = jax.random.split(key)
+            new, o = body(st, x, _KeyCtx(sub))
+            return (key, new), o
+
+        (_, final), outs = lax.scan(step, (k0, tuple(state_vs)), data_v)
+    else:
+        def step(st, x):
+            new, o = body(st, x, keyctx)
+            return new, o
+
+        final, outs = lax.scan(step, tuple(state_vs), data_v)
+    return [outs] + list(final)
+
+
+def _while_scan(pred_sym, out_sym, var_syms, var_names, free_env, var_vs,
+                max_iterations, cache, keyctx, shared, stochastic):
+    """Masked bounded scan shared by _eval's _while branch and the
+    shape-inference registry fn."""
+    from . import random as _rng
+
+    def body(st, key):
+        senv = {**dict(zip(var_names, st)), **free_env}
+        sc = dict(cache)
+        sctx = _KeyCtx(key) if key is not None else keyctx
+        pred = jnp.asarray(
+            _eval(pred_sym, senv, sc, sctx, shared)).reshape(()).astype(bool)
+        o = _eval(out_sym, senv, sc, sctx, shared)
+        new = tuple(_eval(s, senv, sc, sctx, shared) for s in var_syms)
+        return pred, o, new
+
+    if stochastic:
+        k0 = keyctx.next() if keyctx is not None else _rng.next_key()
+
+        def step(carry, _):
+            key, st = carry
+            key, sub = jax.random.split(key)
+            pred, o, new = body(st, sub)
+            st2 = tuple(jnp.where(pred, n, s) for n, s in zip(new, st))
+            o = jnp.where(pred, o, jnp.zeros_like(o))
+            return (key, st2), o
+
+        (_, final), outs = lax.scan(step, (k0, tuple(var_vs)), None,
+                                    length=max_iterations)
+    else:
+        def step(st, _):
+            pred, o, new = body(st, None)
+            st2 = tuple(jnp.where(pred, n, s) for n, s in zip(new, st))
+            o = jnp.where(pred, o, jnp.zeros_like(o))
+            return st2, o
+
+        final, outs = lax.scan(step, tuple(var_vs), None,
+                               length=max_iterations)
+    return [outs] + list(final)
+
+
+@register_op("_while")
+def _while_op(*rest, pred_sym, out_sym, var_syms, var_names, free_names,
+              n_vars, max_iterations):
+    """SHAPE-INFERENCE ONLY — value evaluation goes through _eval's _while
+    branch (cache sharing + per-iteration keys)."""
+    var_vs = rest[:n_vars]
+    free_env = dict(zip(free_names, rest[n_vars:]))
+    return _while_scan(pred_sym, out_sym, var_syms, var_names, free_env,
+                       var_vs, max_iterations, {}, None, frozenset(), False)
+
+
 @register_op("_foreach")
 def _foreach_op(data, *rest, out_sym, state_syms, slice_name, state_names,
                 free_names, n_states):
     """SHAPE-INFERENCE ONLY (shape_inference.py eval_shapes through the
     registry) — like _cond_op below, value evaluation goes through _eval's
     dedicated _foreach branch (cache sharing + per-iteration keys)."""
-    states = rest[:n_states]
     free_env = dict(zip(free_names, rest[n_states:]))
-
-    def step(carry, x):
-        senv = {slice_name: x, **dict(zip(state_names, carry)), **free_env}
-        sc = {}
-        o = _eval(out_sym, senv, sc)
-        new = tuple(_eval(s, senv, sc) for s in state_syms)
-        return new, o
-
-    final, outs = lax.scan(step, tuple(states), data)
-    return [outs] + list(final)
+    return _foreach_scan(out_sym, state_syms, slice_name, state_names,
+                         free_env, rest[:n_states], data, {}, None,
+                         frozenset(), False)
 
 
 @register_op("_cond")

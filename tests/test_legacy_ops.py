@@ -385,3 +385,39 @@ def test_foreach_shape_inference_noise_and_sharing():
     rv, ov = (o.asnumpy() for o in g.bind(args=dict(feed)).forward())
     for t in range(5):
         np.testing.assert_allclose(ov[t], rv, rtol=1e-6)
+
+
+def test_sym_contrib_while_loop():
+    """Symbolic bounded while loop (ref: symbol/contrib.py:while_loop):
+    masked lax.scan to max_iterations, shape inference, Symbol comparison
+    operators in the predicate, per-iteration noise."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import sym
+
+    i0 = sym.var("i0", shape=(1,))
+    a0 = sym.var("a0", shape=(1,))
+    outs, (fi, fa) = sym.contrib.while_loop(
+        lambda vs: vs[0] < 5.0,
+        lambda vs: (vs[0] * 10.0, [vs[0] + 1.0, vs[1] + vs[0]]),
+        [i0, a0], max_iterations=8)
+    feed = {"i0": nd.array(np.array([0.0], np.float32)),
+            "a0": nd.array(np.array([0.0], np.float32))}
+    o = outs.eval(**feed)[0].asnumpy()
+    np.testing.assert_allclose(o[:5, 0], [0, 10, 20, 30, 40])
+    np.testing.assert_allclose(o[5:, 0], 0)      # masked after termination
+    np.testing.assert_allclose(fa.eval(**feed)[0].asnumpy(), [10.0])
+    _, os_, _ = outs.infer_shape(i0=(1,), a0=(1,))
+    assert os_[0] == (8, 1)
+
+    on, _ = sym.contrib.while_loop(
+        lambda vs: vs[0] < 3.0,
+        lambda vs: (mx.sym.random_uniform(shape=(1,)), [vs[0] + 1.0, vs[1]]),
+        [i0, a0], max_iterations=4)
+    v = on.bind(args=dict(feed)).forward()[0].asnumpy()
+    assert not np.allclose(v[0], v[1])
+
+    import pytest
+    with pytest.raises(ValueError):
+        sym.contrib.while_loop(lambda vs: vs[0] < 1.0,
+                               lambda vs: (vs[0], [vs[0]]),
+                               [i0], max_iterations=None)

@@ -593,7 +593,20 @@ def cond(pred, then_sym, else_sym, name=None):
     traced into ONE compiled program and selected at run time (TPU-native
     replacement for MXNet's contrib cond subgraph op,
     src/operator/control_flow.cc). Branch symbols may reference any graph
-    variables; the ONNX exporter maps this to an If node."""
+    variables; the ONNX exporter maps this to an If node.
+
+    Branches may also be zero-arg callables returning Symbols (upstream
+    sym.contrib.cond's then_func/else_func form)."""
+    if callable(then_sym) and not isinstance(then_sym, Symbol):
+        then_sym = then_sym()
+    if callable(else_sym) and not isinstance(else_sym, Symbol):
+        else_sym = else_sym()
+    for b in (then_sym, else_sym):
+        if not isinstance(b, Symbol):
+            raise NotImplementedError(
+                "cond branches must be (or return) a single Symbol, got %s "
+                "— multi-output branches are not supported yet (Group them "
+                "or use several conds)" % type(b).__name__)
     seen = {}
     for branch in (then_sym, else_sym):
         for a in branch._arg_symbols():

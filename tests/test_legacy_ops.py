@@ -421,3 +421,16 @@ def test_sym_contrib_while_loop():
         sym.contrib.while_loop(lambda vs: vs[0] < 1.0,
                                lambda vs: (vs[0], [vs[0]]),
                                [i0], max_iterations=None)
+
+
+def test_sym_cond_thunk_form():
+    """Upstream sym.contrib.cond takes zero-arg branch functions; both the
+    symbol and thunk forms work."""
+    from mxnet_tpu import sym
+
+    p = sym.var("p", shape=(1,))
+    x = sym.var("x", shape=(2,))
+    c = sym.contrib.cond(p, lambda: x * 2, lambda: x * 3)
+    feed = {"p": nd.array(np.array([0.0], np.float32)),
+            "x": nd.array(np.array([1.0, 2.0], np.float32))}
+    np.testing.assert_allclose(c.eval(**feed)[0].asnumpy(), [3.0, 6.0])

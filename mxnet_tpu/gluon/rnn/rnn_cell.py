@@ -261,3 +261,9 @@ class ZoneoutCell(RecurrentCell):
         if self._zo > 0:
             out = F.Dropout(out, p=self._zo) if self._prev_output is None else out
         return out, new_states
+
+
+# hybridizable variant: same cell-stacking semantics — every cell here is
+# already pure-functional/traceable, so the hybrid class IS the sequential
+# one (ref: gluon/rnn/rnn_cell.py:HybridSequentialRNNCell)
+HybridSequentialRNNCell = SequentialRNNCell

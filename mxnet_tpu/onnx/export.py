@@ -671,6 +671,76 @@ def _cond_conv(ctx, s, ins, out):
              attrs={"then_branch": then_attr, "else_branch": else_attr})
 
 
+@register_converter("_foreach")
+def _foreach_conv(ctx, s, ins, out):
+    """symbol.foreach → ONNX Scan (the exact semantic match: per-step state
+    threading + stacked scan outputs). Body formal inputs are [states...,
+    scan slice]; free variables resolve through ONNX outer-scope naming."""
+    a = s._attrs
+    n_states = a["n_states"]
+    roots = list(a["state_syms"]) + [a["out_sym"]]  # Scan output order
+
+    # the loop-var Symbols ARE the body's formal inputs — find them by name
+    loop_names = [a["slice_name"]] + list(a["state_names"])
+    var_syms = {}
+    for root in roots:
+        for arg in root._arg_symbols():
+            if arg.name in loop_names:
+                var_syms[arg.name] = arg
+
+    outer_names = dict(ctx.names)
+    outer_multi = dict(ctx.multi)
+    saved_nodes = ctx.nodes
+    ctx.nodes = []
+    ctx.names = dict(outer_names)
+    ctx.multi = dict(outer_multi)
+    try:
+        input_vis = []
+        for nm in list(a["state_names"]) + [a["slice_name"]]:
+            if nm in var_syms:
+                ctx.names[id(var_syms[nm])] = nm
+            input_vis.append(P.value_info(nm, np.float32, ()))
+        for node_ in _toposort(roots):
+            if node_.is_var():
+                if id(node_) not in ctx.names:
+                    raise ValueError("Scan export: body var %r not in outer "
+                                     "scope" % node_.name)
+                continue
+            if id(node_) in ctx.names:
+                continue  # outer-scope value, visible by ONNX scoping
+            _convert_node(ctx, node_)
+        # graph output names must be UNIQUE: the idiomatic `return h, h`
+        # body reuses one Symbol for output and state — alias repeats
+        # through Identity nodes
+        out_names, used = [], set()
+        for r in roots:
+            nm = ctx.names[id(r)]
+            if nm in used:
+                alias = ctx.fresh("%s_alias" % nm)
+                ctx.emit("Identity", [nm], [alias])
+                nm = alias
+            used.add(nm)
+            out_names.append(nm)
+        out_vis = [P.value_info(nm, np.float32, ()) for nm in out_names]
+        body = P.GraphAttr(P.graph_proto("%s_body" % s.name, ctx.nodes,
+                                         input_vis, out_vis, []))
+    finally:
+        ctx.nodes = saved_nodes
+        ctx.names = outer_names
+        ctx.multi = outer_multi
+
+    # Scan node: inputs [initial_states..., scan_input]; outputs
+    # [final_states..., stacked_scan_output]
+    node_inputs = [ins[1 + i] for i in range(n_states)] + [ins[0]]
+    final_states = [ctx.fresh("scan_state%d" % i) for i in range(n_states)]
+    ctx.emit("Scan", node_inputs, final_states + [out],
+             attrs={"body": body, "num_scan_inputs": 1})
+    # our _item order is [stacked_outputs, states...]
+    ctx.multi[id(s)] = [out] + final_states
+    ctx.names[id(s)] = out
+    return out
+
+
 # ------------------------------------------------------------- graph walker
 
 def _convert_node(ctx, s):

@@ -392,43 +392,75 @@ def _cast(g, node):
     return _make("cast", g.inp(node["inputs"][0]), dtype=dtype)
 
 
+def _import_subgraph(g, graphd, tag, bound_inputs=()):
+    """Run a subgraph's nodes through the importers in a scoped symbol table
+    (ONNX scoping: inner names may shadow outer; restored afterwards).
+    ``bound_inputs``: {formal input name: Symbol} for loop vars. Returns the
+    list of subgraph output Symbols. Shared by If/Scan (and future Loop)."""
+    saved_syms = dict(g.syms)
+    for k, v in graphd.get("initializers", {}).items():
+        if k in g.initializers and not np.array_equal(g.initializers[k], v):
+            raise ValueError(
+                "%s import: subgraph initializer %r shadows an outer "
+                "initializer with different data" % (tag, k))
+        g.initializers[k] = v
+    try:
+        for nm, sy in dict(bound_inputs).items():
+            g.syms[nm] = sy
+        for sub in graphd["nodes"]:
+            imp = _IMPORTERS.get(sub["op"])
+            if imp is None:
+                raise ValueError("no importer for ONNX op %r (%s subgraph)"
+                                 % (sub["op"], tag))
+            out = imp(g, sub)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            for nm, sy in zip(sub["outputs"], outs):
+                g.syms[nm] = sy
+        return [g.syms[vi["name"]] for vi in graphd["outputs"]]
+    finally:
+        g.syms = saved_syms
+
+
 @register_importer("If")
 def _if(g, node):
     """ONNX If → symbol.cond (lax.cond). Subgraph nodes may reference
     outer-scope values by name (ONNX scoping) — they resolve through the
     shared _Graph symbol table."""
     a = node["attrs"]
-
-    def build(graphd):
-        # branch scope: names defined inside the subgraph may legally shadow
-        # outer names — restore the outer symbol table afterwards so later
-        # outer nodes don't read branch-internal values
-        saved_syms = dict(g.syms)
-        for k, v in graphd.get("initializers", {}).items():
-            if k in g.initializers and not np.array_equal(
-                    g.initializers[k], v):
-                raise ValueError(
-                    "If import: branch initializer %r shadows an outer "
-                    "initializer with different data" % k)
-            g.initializers[k] = v
-        try:
-            for sub in graphd["nodes"]:
-                imp = _IMPORTERS.get(sub["op"])
-                if imp is None:
-                    raise ValueError("no importer for ONNX op %r (If branch)"
-                                     % sub["op"])
-                out = imp(g, sub)
-                outs = out if isinstance(out, (list, tuple)) else [out]
-                for nm, sy in zip(sub["outputs"], outs):
-                    g.syms[nm] = sy
-            return g.syms[graphd["outputs"][0]["name"]]
-        finally:
-            g.syms = saved_syms
-
-    then_s = build(a["then_branch"])
-    else_s = build(a["else_branch"])
+    then_s = _import_subgraph(g, a["then_branch"], "If")[0]
+    else_s = _import_subgraph(g, a["else_branch"], "If")[0]
     from ..symbol import cond
     return cond(g.inp(node["inputs"][0]), then_s, else_s)
+
+
+@register_importer("Scan")
+def _scan_imp(g, node):
+    """ONNX Scan → symbol foreach node (lax.scan). Body formal inputs are
+    [states..., scan slice]; outer-scope references resolve through the
+    shared symbol table like If branches."""
+    from ..symbol import Symbol, _foreach_node
+
+    a = node["attrs"]
+    body = a["body"]
+    num_scan = int(a.get("num_scan_inputs", 1))
+    if num_scan != 1:
+        raise ValueError("Scan import: only one scan input supported")
+    n_states = len(node["inputs"]) - num_scan
+    binput_names = [vi["name"] for vi in body["inputs"]]
+    state_names, slice_name = binput_names[:n_states], binput_names[-1]
+
+    body_outs = _import_subgraph(
+        g, body, "Scan",
+        bound_inputs={nm: Symbol(None, name=nm) for nm in binput_names})
+    state_syms, out_sym = body_outs[:n_states], body_outs[-1]
+
+    data = g.inp(node["inputs"][-1])
+    inits = [g.inp(n) for n in node["inputs"][:n_states]]
+    fnode = _foreach_node(data, inits, out_sym, state_syms, slice_name,
+                          state_names)
+    # ONNX output order: final_states..., stacked scan output; ours is
+    # [stacked, states...]
+    return [fnode[i + 1] for i in range(n_states)] + [fnode[0]]
 
 
 @register_importer("NonMaxSuppression")

@@ -655,21 +655,28 @@ def foreach(body, data, init_states, name=None):
         raise ValueError("body returned %d states, expected %d"
                          % (len(new_states), len(states)))
 
-    # free variables of the body = everything its subgraphs reference that
-    # is not a loop variable; their values come from the outer graph
-    loop_names = {slice_v.name} | {v.name for v in state_vs}
-    free = _free_args([out_sym] + new_states, loop_names)
-
-    node = Symbol("_foreach", [data] + list(states) + free,
-                  {"out_sym": out_sym, "state_syms": new_states,
-                   "slice_name": slice_v.name,
-                   "state_names": [v.name for v in state_vs],
-                   "free_names": [a.name for a in free],
-                   "n_states": len(states)},
-                  name=name)
+    node = _foreach_node(data, states, out_sym, new_states, slice_v.name,
+                         [v.name for v in state_vs], name)
     outputs = node[0]
     out_states = [node[i + 1] for i in range(len(states))]
     return outputs, (out_states[0] if single_state else out_states)
+
+
+def _foreach_node(data, states, out_sym, state_syms, slice_name, state_names,
+                  name=None):
+    """Build the _foreach Symbol from already-traced body subgraphs — shared
+    by foreach() and the ONNX Scan importer."""
+    # free variables of the body = everything its subgraphs reference that
+    # is not a loop variable; their values come from the outer graph
+    loop_names = {slice_name} | set(state_names)
+    free = _free_args([out_sym] + list(state_syms), loop_names)
+    return Symbol("_foreach", [data] + list(states) + free,
+                  {"out_sym": out_sym, "state_syms": list(state_syms),
+                   "slice_name": slice_name,
+                   "state_names": list(state_names),
+                   "free_names": [a.name for a in free],
+                   "n_states": len(states)},
+                  name=name)
 
 
 def while_loop(cond_fn, func, loop_vars, max_iterations, name=None):

@@ -207,3 +207,64 @@ def test_cond_symbol_json_roundtrip():
         a = c.eval(x=nd.array(sign * xs))[0].asnumpy()
         b = c2.eval(x=nd.array(sign * xs))[0].asnumpy()
         np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_onnx_scan_roundtrip_foreach(tmp_path):
+    """foreach ↔ ONNX Scan: exported body graph re-imports and matches
+    numerically, including a free weight threading through outer scope
+    (ref: onnx Scan spec; mx2onnx has no loop export — this is new ground)."""
+    import numpy as np
+
+    from mxnet_tpu import nd, sym
+    from mxnet_tpu.onnx.export import symbol_to_onnx
+    from mxnet_tpu.onnx.import_model import import_model
+
+    data = sym.var("data", shape=(5, 3))
+    init = sym.var("init", shape=(3,))
+    w = sym.var("w", shape=(3,))
+    outs, _ = sym.contrib.foreach(lambda x, s: (x * w + s, x * w + s),
+                                  data, init)
+
+    dv = np.arange(15, dtype=np.float32).reshape(5, 3)
+    feed = {"data": nd.array(dv), "init": nd.array(np.zeros(3, np.float32))}
+    wv = np.full(3, 2.0, np.float32)
+    ref = outs.eval(w=nd.array(wv), **feed)[0].asnumpy()
+
+    blob = symbol_to_onnx(outs, params={"w": wv},
+                          input_shapes={"data": (5, 3), "init": (3,)})
+    path = str(tmp_path / "scan.onnx")
+    open(path, "wb").write(blob)
+    s2, args, _ = import_model(path)
+    f2 = {k: feed[k] for k in s2.list_arguments() if k in feed}
+    f2.update(args)
+    np.testing.assert_allclose(s2.eval(**f2)[0].asnumpy(), ref, rtol=1e-5)
+
+
+def test_onnx_scan_shared_output_state_body(tmp_path):
+    """The idiomatic `return h, h` body (one Symbol as both output and
+    state) must export with unique graph output names (Identity alias)."""
+    import numpy as np
+
+    from mxnet_tpu import nd, sym
+    from mxnet_tpu.onnx.export import symbol_to_onnx
+    from mxnet_tpu.onnx.import_model import import_model
+
+    data = sym.var("data", shape=(5, 3))
+    init = sym.var("init", shape=(3,))
+
+    def body(x, s):
+        h = x + s
+        return h, h
+
+    outs, _ = sym.contrib.foreach(body, data, init)
+    dv = np.arange(15, dtype=np.float32).reshape(5, 3)
+    feed = {"data": nd.array(dv), "init": nd.array(np.zeros(3, np.float32))}
+    blob = symbol_to_onnx(outs, params={},
+                          input_shapes={"data": (5, 3), "init": (3,)})
+    path = str(tmp_path / "s.onnx")
+    open(path, "wb").write(blob)
+    s2, args, _ = import_model(path)
+    f2 = {k: feed[k] for k in s2.list_arguments() if k in feed}
+    f2.update(args)
+    np.testing.assert_allclose(s2.eval(**f2)[0].asnumpy(),
+                               np.cumsum(dv, 0), rtol=1e-5)

@@ -689,3 +689,177 @@ def import_to_gluon(model_file, ctx=None):
         p.set_data(jnp.asarray(arr))
         blk._params._params[name] = p
     return blk
+
+
+# --------------------------- breadth batch: official-producer common ops
+
+def _reg_elemwise_imp(onnx_name, op):
+    @register_importer(onnx_name)
+    def f(g, node, _op=op):
+        ins = [g.inp(n) for n in node["inputs"]]
+        return _make(_op, *ins)
+
+
+# NOTE: Max/Min already have variadic importers above (pairwise fold) —
+# do not re-register them with binary ops
+_reg_elemwise_imp("Where", "where")
+_reg_elemwise_imp("Equal", "broadcast_equal")
+_reg_elemwise_imp("Greater", "broadcast_greater")
+_reg_elemwise_imp("Less", "broadcast_lesser")
+_reg_elemwise_imp("Not", "logical_not")
+_reg_elemwise_imp("And", "broadcast_logical_and")
+_reg_elemwise_imp("Or", "broadcast_logical_or")
+_reg_elemwise_imp("Sum", "add_n")
+
+
+@register_importer("Mean")
+def _mean_imp(g, node):
+    ins = [g.inp(n) for n in node["inputs"]]
+    s = _make("add_n", *ins)
+    return s / float(len(ins))
+
+
+@register_importer("HardSigmoid")
+def _hard_sigmoid_imp(g, node):
+    a = node["attrs"]
+    return _make("hard_sigmoid", g.inp(node["inputs"][0]),
+                 alpha=float(a.get("alpha", 0.2)),
+                 beta=float(a.get("beta", 0.5)))
+
+
+@register_importer("Expand")
+def _expand_imp(g, node):
+    shape = tuple(int(v) for v in g.const_value(node["inputs"][1]))
+    return _make("broadcast_to", g.inp(node["inputs"][0]), shape=shape)
+
+
+@register_importer("Tile")
+def _tile_imp(g, node):
+    reps = tuple(int(v) for v in g.const_value(node["inputs"][1]))
+    return _make("tile", g.inp(node["inputs"][0]), reps=reps)
+
+
+@register_importer("Range")
+def _range_imp(g, node):
+    start, limit, delta = (float(g.const_value(n)) for n in node["inputs"])
+    vals = np.arange(start, limit, delta)
+    s = var(node["outputs"][0])
+    g.initializers[node["outputs"][0]] = vals
+    g.used_params.add(node["outputs"][0])
+    return s
+
+
+@register_importer("ArgMax")
+def _argmax_imp(g, node):
+    a = node["attrs"]
+    out = _make("argmax", g.inp(node["inputs"][0]),
+                axis=int(a.get("axis", 0)))
+    if int(a.get("keepdims", 1)):
+        out = _make("expand_dims", out, axis=int(a.get("axis", 0)))
+    return out
+
+
+@register_importer("ArgMin")
+def _argmin_imp(g, node):
+    a = node["attrs"]
+    out = _make("argmin", g.inp(node["inputs"][0]),
+                axis=int(a.get("axis", 0)))
+    if int(a.get("keepdims", 1)):
+        out = _make("expand_dims", out, axis=int(a.get("axis", 0)))
+    return out
+
+
+@register_importer("TopK")
+def _topk_imp(g, node):
+    k = int(g.const_value(node["inputs"][1]))
+    a = node["attrs"]
+    out = _make("topk", g.inp(node["inputs"][0]), k=k,
+                axis=int(a.get("axis", -1)), ret_typ="both",
+                is_ascend=not int(a.get("largest", 1)))
+    return [out[0], out[1]]
+
+
+@register_importer("Split")
+def _split_imp(g, node):
+    a = node["attrs"]
+    axis = int(a.get("axis", 0))
+    n_out = len(node["outputs"])
+    if len(node["inputs"]) > 1 or "split" in a:
+        sizes = (tuple(int(v) for v in g.const_value(node["inputs"][1]))
+                 if len(node["inputs"]) > 1
+                 else tuple(int(v) for v in a["split"]))
+        if len(set(sizes)) != 1:
+            raise ValueError("Split import: unequal split sizes %r not "
+                             "supported" % (sizes,))
+    out = _make("split", g.inp(node["inputs"][0]), num_outputs=n_out,
+                axis=axis)
+    return [out[i] for i in range(n_out)]
+
+
+@register_importer("Pad")
+def _pad_imp(g, node):
+    a = node["attrs"]
+    mode = a.get("mode", b"constant")
+    mode = mode.decode() if isinstance(mode, bytes) else mode
+    if mode not in ("constant", "edge", "reflect"):
+        # the registry pad op would silently fall through to reflect
+        raise ValueError("Pad import: mode %r not supported" % (mode,))
+    pads = (tuple(int(v) for v in g.const_value(node["inputs"][1]))
+            if len(node["inputs"]) > 1
+            else tuple(int(v) for v in a.get("pads", ())))
+    n = len(pads) // 2
+    # ONNX: [x1_begin.. xn_begin, x1_end.. xn_end] → MXNet flat interleave
+    # (b0, e0, b1, e1, ...) — the registry pad op's layout
+    pad_width = tuple(v for i in range(n) for v in (pads[i], pads[n + i]))
+    cval = (float(g.const_value(node["inputs"][2]))
+            if len(node["inputs"]) > 2 else 0.0)
+    return _make("pad", g.inp(node["inputs"][0]), mode=mode,
+                 pad_width=pad_width, constant_value=cval)
+
+
+@register_importer("InstanceNormalization")
+def _instancenorm_imp(g, node):
+    eps = float(node["attrs"].get("epsilon", 1e-5))
+    return _make("InstanceNorm", g.inp(node["inputs"][0]),
+                 g.inp(node["inputs"][1]), g.inp(node["inputs"][2]),
+                 eps=eps)
+
+
+@register_importer("SpaceToDepth")
+def _space_to_depth_imp(g, node):
+    bs = int(node["attrs"]["blocksize"])
+    return _make("space_to_depth", g.inp(node["inputs"][0]), block_size=bs)
+
+
+@register_importer("DepthToSpace")
+def _depth_to_space_imp(g, node):
+    bs = int(node["attrs"]["blocksize"])
+    mode = node["attrs"].get("mode", b"DCR")
+    mode = mode.decode() if isinstance(mode, bytes) else mode
+    if mode != "DCR":
+        raise ValueError("DepthToSpace import: only DCR mode supported")
+    return _make("depth_to_space", g.inp(node["inputs"][0]), block_size=bs)
+
+
+@register_importer("OneHot")
+def _one_hot_imp(g, node):
+    axis = int(node["attrs"].get("axis", -1))
+    if axis != -1:
+        # registry one_hot always places the hot dim LAST; silently wrong
+        # shapes are worse than failing
+        raise ValueError("OneHot import: axis=%d not supported (only -1)"
+                         % axis)
+    depth = int(g.const_value(node["inputs"][1]))
+    vals = g.const_value(node["inputs"][2])
+    off, on = float(vals[0]), float(vals[1])
+    return _make("one_hot", g.inp(node["inputs"][0]), depth=depth,
+                 on_value=on, off_value=off)
+
+
+@register_importer("CumSum")
+def _cumsum_imp(g, node):
+    axis = int(g.const_value(node["inputs"][1]))
+    a = node["attrs"]
+    if int(a.get("exclusive", 0)) or int(a.get("reverse", 0)):
+        raise ValueError("CumSum import: exclusive/reverse not supported")
+    return _make("cumsum", g.inp(node["inputs"][0]), axis=axis)

@@ -268,3 +268,62 @@ def test_onnx_scan_shared_output_state_body(tmp_path):
     f2.update(args)
     np.testing.assert_allclose(s2.eval(**f2)[0].asnumpy(),
                                np.cumsum(dv, 0), rtol=1e-5)
+
+
+def test_importer_breadth_official_producer_ops():
+    """Importers for common official-producer ONNX ops map onto registry ops
+    with correct numerics (ref: onnx2mx/_op_translations breadth)."""
+    import numpy as np
+
+    from mxnet_tpu import nd
+    from mxnet_tpu.onnx.import_model import _Graph, _IMPORTERS
+
+    def run(op, inputs, attrs=None, inits=None, n_out=1):
+        inits = dict(inits or {})
+        g = _Graph({"initializers": inits})
+        node = {"op": op, "inputs": list(inputs),
+                "outputs": ["o%d" % i for i in range(n_out)],
+                "attrs": attrs or {}}
+        out = _IMPORTERS[op](g, node)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        res = []
+        for o in outs:
+            feed = {n: nd.array(np.asarray(inits[n], np.float32))
+                    for n in o.list_arguments() if n in inits}
+            res.append(o.eval(**feed)[0].asnumpy())
+        return res
+
+    x = np.random.RandomState(0).randn(2, 3).astype(np.float32)
+    y = np.random.RandomState(1).randn(2, 3).astype(np.float32)
+    assert run("Equal", ["a", "b"], inits={"a": x, "b": x})[0].all()
+    np.testing.assert_allclose(
+        run("Mean", ["a", "b"], inits={"a": x, "b": y})[0], (x + y) / 2,
+        rtol=1e-5)
+    np.testing.assert_allclose(
+        run("HardSigmoid", ["a"], {"alpha": 0.25, "beta": 0.4},
+            inits={"a": x})[0], np.clip(0.25 * x + 0.4, 0, 1), rtol=1e-5)
+    np.testing.assert_allclose(
+        run("Range", ["s", "l", "d"],
+            inits={"s": np.float32(0), "l": np.float32(5),
+                   "d": np.float32(1)})[0], np.arange(0, 5, 1))
+    np.testing.assert_allclose(
+        run("TopK", ["a", "k"], {"axis": -1, "largest": 1},
+            inits={"a": x, "k": np.int64(2)}, n_out=2)[0],
+        np.sort(x, -1)[:, ::-1][:, :2], rtol=1e-5)
+    p = run("Pad", ["a", "p"], {"mode": b"constant"},
+            inits={"a": x, "p": np.array([0, 1, 0, 1])})[0]
+    np.testing.assert_allclose(p[:, 1:4], x, rtol=1e-6)
+    assert run("SpaceToDepth", ["a"], {"blocksize": 2},
+               inits={"a": np.arange(16, dtype=np.float32)
+                      .reshape(1, 1, 4, 4)})[0].shape == (1, 4, 2, 2)
+    np.testing.assert_allclose(
+        run("OneHot", ["i", "d", "v"],
+            inits={"i": np.array([0, 2], np.float32), "d": np.int64(3),
+                   "v": np.array([0.0, 1.0], np.float32)})[0],
+        np.eye(3, dtype=np.float32)[[0, 2]])
+    np.testing.assert_allclose(
+        run("CumSum", ["a", "ax"], inits={"a": x, "ax": np.int64(1)})[0],
+        np.cumsum(x, 1), rtol=1e-5)
+    assert len(run("Split", ["a"], {"axis": 1},
+                   inits={"a": np.arange(12, dtype=np.float32)
+                          .reshape(2, 6)}, n_out=3)) == 3

@@ -875,3 +875,161 @@ def export_model(model, params=None, input_shapes=None, input_types=None,
     with open(onnx_file, "wb") as f:
         f.write(buf)
     return onnx_file
+
+
+# --------------------------- breadth batch: converters for common ops
+
+def _reg_simple_conv(op, onnx_op):
+    @register_converter(op)
+    def conv(ctx, s, ins, out, _onnx=onnx_op):
+        ctx.emit(_onnx, ins, [out])
+
+
+_reg_simple_conv("add_n", "Sum")
+
+
+def _cast(ctx, name, to):
+    c = ctx.fresh("cast")
+    ctx.emit("Cast", [name], [c], attrs={"to": int(to)})
+    return c
+
+
+@register_converter("where")
+def _where_conv(ctx, s, ins, out):
+    # mxnet_tpu conditions are float; ONNX Where requires bool
+    ctx.emit("Where", [_cast(ctx, ins[0], P.BOOL), ins[1], ins[2]], [out])
+
+
+def _reg_compare_conv(op, onnx_op):
+    @register_converter(op)
+    def conv(ctx, s, ins, out, _onnx=onnx_op):
+        # comparisons produce bool in ONNX but float in mxnet_tpu — cast
+        # inputs for Not, and cast every result back to float
+        if _onnx == "Not":
+            ins = [_cast(ctx, ins[0], P.BOOL)]
+        b = ctx.fresh("cmp")
+        ctx.emit(_onnx, ins, [b])
+        ctx.emit("Cast", [b], [out], attrs={"to": int(P.FLOAT)})
+
+
+_reg_compare_conv("broadcast_equal", "Equal")
+_reg_compare_conv("broadcast_greater", "Greater")
+_reg_compare_conv("broadcast_lesser", "Less")
+_reg_compare_conv("logical_not", "Not")
+
+
+def _flat_input(ctx, s, ins):
+    """axis=None reduces over the FLATTENED array — reshape first so the
+    exported graph matches registry semantics."""
+    shp = ctx.const("flat", np.asarray([-1], np.int64))
+    r = ctx.fresh("flatten1d")
+    ctx.emit("Reshape", [ins[0], shp], [r])
+    return r
+
+
+def _reg_arg_conv(op, onnx_op):
+    @register_converter(op)
+    def conv(ctx, s, ins, out, _onnx=onnx_op):
+        a = s._attrs
+        axis = a.get("axis")
+        keepdims = int(bool(a.get("keepdims", False)))
+        if axis is None:
+            src = _flat_input(ctx, s, ins)
+            ctx.emit(_onnx, [src], [out], attrs={"axis": 0, "keepdims": 0})
+        else:
+            ctx.emit(_onnx, ins, [out],
+                     attrs={"axis": int(axis), "keepdims": keepdims})
+
+
+_reg_arg_conv("argmax", "ArgMax")
+_reg_arg_conv("argmin", "ArgMin")
+
+
+@register_converter("topk")
+def _topk_conv(ctx, s, ins, out):
+    a = s._attrs
+    if a.get("ret_typ", "indices") not in ("both", "value", "indices"):
+        raise ValueError("topk export: ret_typ %r unsupported" % a["ret_typ"])
+    k = ctx.const("k", np.asarray([int(a.get("k", 1))], np.int64))
+    vals = ctx.fresh("topk_val")
+    idx = ctx.fresh("topk_idx")
+    ctx.emit("TopK", [ins[0], k], [vals, idx],
+             attrs={"axis": int(a.get("axis", -1)),
+                    "largest": 0 if a.get("is_ascend", False) else 1})
+    ctx.multi[id(s)] = [vals, idx]
+    # single-output forms project the right tensor
+    primary = vals if a.get("ret_typ", "indices") != "indices" else idx
+    ctx.emit("Identity", [primary], [out])
+
+
+@register_converter("one_hot")
+def _one_hot_conv(ctx, s, ins, out):
+    a = s._attrs
+    depth = ctx.const("depth", np.asarray(int(a["depth"]), np.int64))
+    vals = ctx.const("values", np.asarray(
+        [float(a.get("off_value", 0.0)), float(a.get("on_value", 1.0))],
+        np.float32))
+    ctx.emit("OneHot", [ins[0], depth, vals], [out], attrs={"axis": -1})
+
+
+@register_converter("cumsum")
+def _cumsum_conv(ctx, s, ins, out):
+    axis = s._attrs.get("axis")
+    if axis is None:
+        # registry default: cumsum over the FLATTENED array
+        src_name = _flat_input(ctx, s, ins)
+        axis_c = ctx.const("axis", np.asarray(0, np.int64))
+        ctx.emit("CumSum", [src_name, axis_c], [out])
+        return
+    axis_c = ctx.const("axis", np.asarray(int(axis), np.int64))
+    ctx.emit("CumSum", [ins[0], axis_c], [out])
+
+
+@register_converter("tile")
+def _tile_conv(ctx, s, ins, out):
+    reps = ctx.const("repeats", np.asarray(s._attrs["reps"], np.int64))
+    ctx.emit("Tile", [ins[0], reps], [out])
+
+
+@register_converter("broadcast_to")
+def _broadcast_to_conv(ctx, s, ins, out):
+    shape = list(s._attrs["shape"])
+    if any(v == 0 for v in shape):
+        # MXNet's 0 sentinel (copy this input dim) has no ONNX equivalent —
+        # resolve through the input's static shape, or fail loudly
+        in_shape = s._inputs[0].shape
+        shape = [in_shape[i] if v == 0 else v for i, v in enumerate(shape)]
+    shape_c = ctx.const("shape", np.asarray(shape, np.int64))
+    ctx.emit("Expand", [ins[0], shape_c], [out])
+
+
+@register_converter("pad")
+def _pad_conv(ctx, s, ins, out):
+    a = s._attrs
+    mode = a.get("mode", "constant")
+    if mode not in ("constant", "edge", "reflect"):
+        raise ValueError("pad export: mode %r unsupported" % (mode,))
+    pw = a["pad_width"]
+    n = len(pw) // 2
+    # MXNet interleave (b0, e0, ...) → ONNX [begins..., ends...]
+    onnx_pads = [pw[2 * i] for i in range(n)] + \
+                [pw[2 * i + 1] for i in range(n)]
+    pads = ctx.const("pads", np.asarray(onnx_pads, np.int64))
+    cval = ctx.const("cval", np.float32(a.get("constant_value", 0.0)))
+    ctx.emit("Pad", [ins[0], pads, cval], [out],
+             attrs={"mode": mode})
+
+
+def _split_conv_impl(ctx, s, ins, out):
+    a = s._attrs
+    n_out = int(a["num_outputs"])
+    if a.get("squeeze_axis"):
+        raise ValueError("split export: squeeze_axis unsupported")
+    names = [ctx.fresh("split%d" % i) for i in range(n_out)]
+    ctx.emit("Split", ins, names, attrs={"axis": int(a.get("axis", 1))})
+    ctx.multi[id(s)] = names
+    ctx.emit("Identity", [names[0]], [out])
+
+
+register_converter("split")(_split_conv_impl)
+register_converter("SliceChannel")(_split_conv_impl)

@@ -729,8 +729,14 @@ def _hard_sigmoid_imp(g, node):
 
 @register_importer("Expand")
 def _expand_imp(g, node):
+    # ONNX Expand broadcasts BIDIRECTIONALLY (out = broadcast(x, shape),
+    # where x dims may exceed a 1 in shape) — multiply by ones(shape), which
+    # has exactly those semantics; broadcast_to would reject such shapes
     shape = tuple(int(v) for v in g.const_value(node["inputs"][1]))
-    return _make("broadcast_to", g.inp(node["inputs"][0]), shape=shape)
+    ones = var(node["outputs"][0] + "_expand_ones")
+    g.initializers[ones.name] = np.ones(shape, np.float32)
+    g.used_params.add(ones.name)
+    return _make("broadcast_mul", g.inp(node["inputs"][0]), ones)
 
 
 @register_importer("Tile")
@@ -749,24 +755,18 @@ def _range_imp(g, node):
     return s
 
 
-@register_importer("ArgMax")
-def _argmax_imp(g, node):
-    a = node["attrs"]
-    out = _make("argmax", g.inp(node["inputs"][0]),
-                axis=int(a.get("axis", 0)))
-    if int(a.get("keepdims", 1)):
-        out = _make("expand_dims", out, axis=int(a.get("axis", 0)))
-    return out
+def _reg_arg_imp(onnx_name, op):
+    @register_importer(onnx_name)
+    def imp(g, node, _op=op):
+        a = node["attrs"]
+        # registry argmax/argmin honor keepdims directly
+        return _make(_op, g.inp(node["inputs"][0]),
+                     axis=int(a.get("axis", 0)),
+                     keepdims=bool(int(a.get("keepdims", 1))))
 
 
-@register_importer("ArgMin")
-def _argmin_imp(g, node):
-    a = node["attrs"]
-    out = _make("argmin", g.inp(node["inputs"][0]),
-                axis=int(a.get("axis", 0)))
-    if int(a.get("keepdims", 1)):
-        out = _make("expand_dims", out, axis=int(a.get("axis", 0)))
-    return out
+_reg_arg_imp("ArgMax", "argmax")
+_reg_arg_imp("ArgMin", "argmin")
 
 
 @register_importer("TopK")

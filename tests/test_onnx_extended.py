@@ -327,3 +327,54 @@ def test_importer_breadth_official_producer_ops():
     assert len(run("Split", ["a"], {"axis": 1},
                    inits={"a": np.arange(12, dtype=np.float32)
                           .reshape(2, 6)}, n_out=3)) == 3
+
+
+def test_converter_breadth_roundtrips(tmp_path):
+    """Export→import roundtrips for the breadth converters: where/topk/
+    split/pad/one_hot/cumsum/tile/broadcast_to/argmax."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, sym
+    from mxnet_tpu.onnx.export import symbol_to_onnx
+    from mxnet_tpu.onnx.import_model import import_model
+
+    def roundtrip(out_sym, feed):
+        blob = symbol_to_onnx(out_sym, params={},
+                              input_shapes={k: v.shape
+                                            for k, v in feed.items()})
+        p = str(tmp_path / ("m%d.onnx" % abs(hash(out_sym.name)) ))
+        open(p, "wb").write(blob)
+        s2, args, _ = import_model(p)
+        f2 = {k: nd.array(feed[k]) for k in s2.list_arguments() if k in feed}
+        f2.update(args)
+        return s2.eval(**f2)[0].asnumpy()
+
+    x = np.random.RandomState(0).randn(2, 6).astype(np.float32)
+    xs = sym.var("x", shape=(2, 6))
+    c = sym.var("c", shape=(2, 6))
+    cond = (x > 0).astype(np.float32)
+    np.testing.assert_allclose(
+        roundtrip(mx.sym.where(c, xs, xs * 2), {"x": x, "c": cond}),
+        np.where(cond.astype(bool), x, x * 2), rtol=1e-5)
+    np.testing.assert_allclose(
+        roundtrip(mx.sym.topk(xs, k=3, axis=-1, ret_typ="value"), {"x": x}),
+        np.sort(x, -1)[:, ::-1][:, :3], rtol=1e-5)
+    sp = mx.sym.split(xs, num_outputs=3, axis=1)
+    np.testing.assert_allclose(roundtrip(sp[1], {"x": x}), x[:, 2:4],
+                               rtol=1e-6)
+    pd = mx.sym.pad(xs, mode="constant", pad_width=(0, 0, 1, 2),
+                    constant_value=7.0)
+    out = roundtrip(pd, {"x": x})
+    assert out.shape == (2, 9) and (out[:, 0] == 7).all()
+    ih = sym.var("i", shape=(4,))
+    np.testing.assert_allclose(
+        roundtrip(mx.sym.one_hot(ih, depth=4),
+                  {"i": np.array([0, 2, 1, 3], np.float32)}),
+        np.eye(4, dtype=np.float32)[[0, 2, 1, 3]])
+    np.testing.assert_allclose(roundtrip(mx.sym.cumsum(xs, axis=1),
+                                         {"x": x}), np.cumsum(x, 1),
+                               rtol=1e-5)
+    assert roundtrip(mx.sym.tile(xs, reps=(2, 1)), {"x": x}).shape == (4, 6)
+    np.testing.assert_allclose(roundtrip(mx.sym.argmax(xs, axis=1),
+                                         {"x": x}), x.argmax(1))

@@ -691,6 +691,12 @@ def import_to_gluon(model_file, ctx=None):
     return blk
 
 
+def _scalar(v):
+    """Python scalar from a 0-d or 1-element initializer (NumPy >=1.25
+    errors on int(array) with ndim > 0)."""
+    return np.asarray(v).reshape(-1)[0]
+
+
 # --------------------------- breadth batch: official-producer common ops
 
 def _reg_elemwise_imp(onnx_name, op):
@@ -771,7 +777,7 @@ _reg_arg_imp("ArgMin", "argmin")
 
 @register_importer("TopK")
 def _topk_imp(g, node):
-    k = int(g.const_value(node["inputs"][1]))
+    k = int(_scalar(g.const_value(node["inputs"][1])))
     a = node["attrs"]
     out = _make("topk", g.inp(node["inputs"][0]), k=k,
                 axis=int(a.get("axis", -1)), ret_typ="both",
@@ -811,7 +817,7 @@ def _pad_imp(g, node):
     # ONNX: [x1_begin.. xn_begin, x1_end.. xn_end] → MXNet flat interleave
     # (b0, e0, b1, e1, ...) — the registry pad op's layout
     pad_width = tuple(v for i in range(n) for v in (pads[i], pads[n + i]))
-    cval = (float(g.const_value(node["inputs"][2]))
+    cval = (float(_scalar(g.const_value(node["inputs"][2])))
             if len(node["inputs"]) > 2 else 0.0)
     return _make("pad", g.inp(node["inputs"][0]), mode=mode,
                  pad_width=pad_width, constant_value=cval)
@@ -849,7 +855,7 @@ def _one_hot_imp(g, node):
         # shapes are worse than failing
         raise ValueError("OneHot import: axis=%d not supported (only -1)"
                          % axis)
-    depth = int(g.const_value(node["inputs"][1]))
+    depth = int(_scalar(g.const_value(node["inputs"][1])))
     vals = g.const_value(node["inputs"][2])
     off, on = float(vals[0]), float(vals[1])
     return _make("one_hot", g.inp(node["inputs"][0]), depth=depth,
@@ -858,7 +864,7 @@ def _one_hot_imp(g, node):
 
 @register_importer("CumSum")
 def _cumsum_imp(g, node):
-    axis = int(g.const_value(node["inputs"][1]))
+    axis = int(_scalar(g.const_value(node["inputs"][1])))
     a = node["attrs"]
     if int(a.get("exclusive", 0)) or int(a.get("reverse", 0)):
         raise ValueError("CumSum import: exclusive/reverse not supported")

@@ -30,12 +30,16 @@ def _st():
 
 
 class TapeNode:
-    __slots__ = ("inputs", "outputs", "vjp_fn", "out_treedef")
+    __slots__ = ("inputs", "outputs", "vjp_fn", "out_treedef", "primal_fn")
 
-    def __init__(self, inputs, outputs, vjp_fn):
+    def __init__(self, inputs, outputs, vjp_fn, primal_fn=None):
         self.inputs = inputs    # list[NDArray] (diff args, in vjp order)
         self.outputs = outputs  # list[NDArray]
         self.vjp_fn = vjp_fn
+        # pure function mapping input VALUES -> output tree (same flat order
+        # as `outputs`); enables tape replay for create_graph=True. None for
+        # nodes that cannot be re-traced (imperative CustomOp.backward).
+        self.primal_fn = primal_fn
 
 
 def _tape() -> List[TapeNode]:
@@ -164,16 +168,21 @@ def _accum(cot, keep, arr, g):
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
          train_mode=True):
     """Compute grads of heads w.r.t. variables without touching .grad
-    (ref: python/mxnet/autograd.py:grad)."""
+    (ref: python/mxnet/autograd.py:grad).
+
+    With ``create_graph=True`` the gradient computation itself is recorded on
+    the tape (MXNet builds a second nnvm backward graph; here the recorded
+    tape segment is replayed as ONE pure jax function and differentiated with
+    ``jax.grad``, and that whole grad program becomes a new differentiable
+    tape node) — so grad-of-grad losses (WGAN-GP gradient penalties etc.)
+    backward() correctly into parameters.
+    """
     from .ndarray import NDArray
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (higher-order grad through the imperative "
-            "tape) is not supported; compose jax.grad over a hybridized "
-            "function for higher-order derivatives")
     if isinstance(variables, NDArray):
         variables = [variables]
+    if create_graph:
+        return _grad_create_graph(heads, variables, head_grads)
     saved = [(getattr(v, "_grad", None), getattr(v, "_grad_req", "write")) for v in variables]
     for v in variables:
         v.attach_grad()
@@ -182,6 +191,107 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
     for v, (g, req) in zip(variables, saved):
         v._grad, v._grad_req = g, req
     return outs
+
+
+def _grad_create_graph(heads, variables, head_grads):
+    """Differentiable (higher-order) gradients via tape replay.
+
+    The recorded tape is a DAG of pure primal closures. Replaying it from its
+    leaf inputs gives a pure function leaf-values -> head-values; gradients of
+    ``sum(head · head_grad)`` w.r.t. ``variables`` are then an ordinary
+    ``jax.grad``. Gradients w.r.t. an INTERMEDIATE array are handled by
+    value-injection: the variable's passed-in value replaces the recomputed
+    one at its production site, making it a perturbation point (the same cut
+    MXNet's backward graph makes at the variable node).
+    """
+    from .ndarray import NDArray
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        hg = [jnp.ones(h.shape, h.dtype) for h in heads]
+    elif isinstance(head_grads, NDArray):
+        hg = [head_grads._data]
+    else:
+        hg = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
+              for g in head_grads]
+
+    # prune the tape to the subgraph the heads actually depend on — an
+    # unrelated subgraph recorded in the same scope (e.g. the generator
+    # forward in a GAN step) is neither replayed nor required to be replayable
+    needed = {id(h) for h in heads}
+    tape = []
+    for node in reversed(_tape()):
+        if any(id(o) in needed for o in node.outputs):
+            tape.append(node)
+            needed.update(id(i) for i in node.inputs)
+    tape.reverse()
+    for node in tape:
+        if node.primal_fn is None:
+            raise NotImplementedError(
+                "create_graph=True across an imperative CustomOp tape node "
+                "is not supported (its backward is not jax-traceable)")
+
+    var_ids = [id(v) for v in variables]
+    var_set = set(var_ids)
+    # leaf inputs: tape inputs not produced by an earlier tape node
+    produced, leaves, seen = set(), [], set()
+    for node in tape:
+        for inp in node.inputs:
+            if id(inp) not in produced and id(inp) not in seen:
+                seen.add(id(inp))
+                if id(inp) not in var_set:
+                    leaves.append(inp)
+        for o in node.outputs:
+            produced.add(id(o))
+    nv = len(variables)
+    leaf_var_ids = {vid for vid in var_ids if vid not in produced}
+
+    def scalar_replay(vk, k, var_vals, leaf_vals):
+        # The cut for variable k only: its passed value replaces the
+        # recomputed one at its production site, making it the perturbation
+        # point. OTHER variables' sites recompute naturally, so grads w.r.t.
+        # an ancestor of an intermediate variable keep the full chain rule
+        # (torch semantics: each requested grad sees all paths).
+        vid = var_ids[k]
+        env = {id(l): v for l, v in zip(leaves, leaf_vals)}
+        for i, v in zip(var_ids, var_vals):
+            if i in leaf_var_ids:
+                env[i] = v
+        if vid in leaf_var_ids:
+            env[vid] = vk
+        for node in tape:
+            in_vals = [env.get(id(i), i._data) for i in node.inputs]
+            flat = jax.tree_util.tree_leaves(node.primal_fn(*in_vals))
+            for o, val in zip(node.outputs, flat):
+                env[id(o)] = vk if id(o) == vid else val
+        total = jnp.float32(0.0)
+        for h, g in zip(heads, hg):
+            hv = env.get(id(h), h._data)
+            total = total + jnp.sum(hv.astype(jnp.float32)
+                                    * g.astype(jnp.float32))
+        return total
+
+    def gfun(*all_vals):
+        var_vals = list(all_vals[:nv])
+        leaf_vals = list(all_vals[nv:])
+        return tuple(
+            jax.grad(scalar_replay, argnums=0)(var_vals[k], k, var_vals,
+                                               leaf_vals)
+            for k in range(nv))
+
+    ext_inputs = list(variables) + leaves
+    out_grads, vjp_fn = jax.vjp(gfun, *[a._data for a in ext_inputs])
+    wrapped = [NDArray(g) for g in out_grads]
+
+    if is_recording():
+        def node_vjp(cots):
+            if not isinstance(cots, tuple):
+                cots = (cots,)
+            return vjp_fn(tuple(cots))
+
+        append_node(TapeNode(ext_inputs, wrapped, node_vjp, primal_fn=gfun))
+    return wrapped
 
 
 def get_symbol(x):  # MXNet API parity; no nnvm graph here

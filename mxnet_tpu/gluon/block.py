@@ -363,7 +363,15 @@ class HybridBlock(Block):
                 sel = [x_cots[i] for i in nd_arg_pos]
                 return tuple(pa_cots) + tuple(sel)
 
-            autograd.append_node(autograd.TapeNode(node_inputs, wrapped, flat_vjp))
+            def primal(*vals, _np=len(plist)):
+                xs_ = list(xs)
+                for j, i in enumerate(nd_arg_pos):
+                    xs_[i] = vals[_np + j]
+                out_, _upd = fn(list(vals[:_np]), key, *xs_)
+                return out_
+
+            autograd.append_node(autograd.TapeNode(node_inputs, wrapped,
+                                                   flat_vjp, primal_fn=primal))
             result = jax.tree_util.tree_unflatten(treedef, wrapped)
         else:
             out, upd = fn(pa, key, *xs)

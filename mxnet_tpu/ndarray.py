@@ -428,7 +428,8 @@ def _invoke_impl(opname, args, kwargs):
         outs_flat, treedef = jax.tree_util.tree_flatten(out)
         wrapped = [NDArray(o) for o in outs_flat]
         inputs = [args[i] for i in diff_pos] + [traced_kw[k] for k in diff_kw]
-        autograd.append_node(autograd.TapeNode(inputs, wrapped, vjp_fn))
+        autograd.append_node(autograd.TapeNode(inputs, wrapped, vjp_fn,
+                                               primal_fn=g))
         result = jax.tree_util.tree_unflatten(treedef, wrapped)
     else:
         f = jitted(fn, static)

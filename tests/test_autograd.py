@@ -97,18 +97,172 @@ def test_multi_output_op_grads():
     assert (g[:, :3] == 2).all() and (g[:, 3:] == 3).all()
 
 
-def test_grad_function_and_create_graph_raises():
-    import pytest as _pytest
-
-    from mxnet_tpu import autograd, nd
-
+def test_grad_function():
     x = nd.array(np.array([2.0, 3.0], np.float32))
     with autograd.record():
         y = (x * x * x).sum()
     g = autograd.grad(y, [x])
     np.testing.assert_allclose(g[0].asnumpy(), 3 * np.array([4.0, 9.0]),
                                rtol=1e-6)
+
+
+def test_create_graph_second_order():
+    # d/dx of (d/dx x^3) = 6x, through backward() on the first-order grads
+    x = nd.array(np.array([2.0, -1.5, 3.0], np.float32))
+    x.attach_grad()
     with autograd.record():
+        y = (x * x * x).sum()
+        (g,) = autograd.grad(y, [x], create_graph=True)
+        np.testing.assert_allclose(g.asnumpy(), 3 * x.asnumpy() ** 2,
+                                   rtol=1e-5)
+        z = (g * g).sum()  # sum(9 x^4) -> dz/dx = 36 x^3
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 36 * x.asnumpy() ** 3,
+                               rtol=1e-4)
+
+
+def test_create_graph_third_order():
+    x = nd.array(np.array([1.5], np.float32))
+    with autograd.record():
+        y = (x * x * x * x).sum()          # x^4
+        (g1,) = autograd.grad(y, [x], create_graph=True)   # 4x^3
+        (g2,) = autograd.grad(g1, [x], create_graph=True)  # 12x^2
+        (g3,) = autograd.grad(g2, [x])                     # 24x
+    np.testing.assert_allclose(g1.asnumpy(), [4 * 1.5 ** 3], rtol=1e-5)
+    np.testing.assert_allclose(g2.asnumpy(), [12 * 1.5 ** 2], rtol=1e-5)
+    np.testing.assert_allclose(g3.asnumpy(), [24 * 1.5], rtol=1e-5)
+
+
+def test_create_graph_gradient_penalty_vs_jax():
+    """WGAN-GP style: loss includes || dD/dx || — its grads w.r.t. the D
+    params must match a pure jax.grad-of-grad oracle."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    w1v = rng.normal(size=(4, 8)).astype(np.float32)
+    b1v = rng.normal(size=(8,)).astype(np.float32)
+    w2v = rng.normal(size=(8, 1)).astype(np.float32)
+    xv = rng.normal(size=(5, 4)).astype(np.float32)
+
+    w1, b1, w2, x = (nd.array(a) for a in (w1v, b1v, w2v, xv))
+    for p in (w1, b1, w2):
+        p.attach_grad()
+    with autograd.record():
+        out = nd.dot(nd.tanh(nd.dot(x, w1) + b1), w2)
+        (gp,) = autograd.grad(out.sum(), [x], create_graph=True)
+        norm = nd.sqrt((gp * gp).sum(axis=1))
+        loss = ((norm - 1.0) * (norm - 1.0)).mean()
+    loss.backward()
+
+    def gp_loss(params, xx):
+        ww1, bb1, ww2 = params
+
+        def d_sum(xi):
+            return (jnp.tanh(xi @ ww1 + bb1) @ ww2).sum()
+
+        g = jax.grad(d_sum)(xx)
+        n = jnp.sqrt((g * g).sum(axis=1))
+        return ((n - 1.0) ** 2).mean()
+
+    want = jax.grad(gp_loss)((jnp.asarray(w1v), jnp.asarray(b1v),
+                              jnp.asarray(w2v)), jnp.asarray(xv))
+    np.testing.assert_allclose(w1.grad.asnumpy(), np.asarray(want[0]),
+                               rtol=2e-4, atol=1e-5)
+    np.testing.assert_allclose(b1.grad.asnumpy(), np.asarray(want[1]),
+                               rtol=2e-4, atol=1e-5)
+    np.testing.assert_allclose(w2.grad.asnumpy(), np.asarray(want[2]),
+                               rtol=2e-4, atol=1e-5)
+
+
+def test_create_graph_through_hybridized_block():
+    """The compiled HybridBlock tape node replays through its jitted primal."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import gluon
+
+    net = gluon.nn.Dense(1, in_units=3, use_bias=False)
+    net.initialize()
+    net.hybridize()
+    xv = np.array([[1.0, -2.0, 0.5], [0.3, 0.7, -1.1]], np.float32)
+    x = nd.array(xv)
+    w = net.weight
+    w.data()  # materialize
+    wv = w.data().asnumpy()
+    with autograd.record():
+        out = net(x)                                   # (2, 1) = x @ w.T
+        (gx,) = autograd.grad(out.sum(), [x], create_graph=True)
+        loss = (gx * gx).sum()                         # = 2 * ||w||^2
+    loss.backward()
+    np.testing.assert_allclose(gx.asnumpy(),
+                               np.broadcast_to(wv, (2, 3)), rtol=1e-5)
+    # d loss / d w = 4 w (two batch rows each contribute 2w)
+    want = jax.grad(lambda ww: (jnp.broadcast_to(ww, (2, 3)) ** 2).sum())(
+        jnp.asarray(wv))
+    np.testing.assert_allclose(w.grad().asnumpy(), np.asarray(want),
+                               rtol=1e-5)
+
+
+def test_create_graph_intermediate_and_ancestor():
+    # requesting grads w.r.t. BOTH an intermediate and its ancestor: the
+    # ancestor's grad keeps the full chain rule (torch semantics), the
+    # intermediate's grad is the cotangent at its site
+    x = nd.array(np.array([1.0, 2.0], np.float32))
+    with autograd.record():
+        v = x * 2.0
+        y = (v * v).sum()
+        gx, gv = autograd.grad(y, [x, v], create_graph=True)
+    np.testing.assert_allclose(gx.asnumpy(), 8 * x.asnumpy(), rtol=1e-6)
+    np.testing.assert_allclose(gv.asnumpy(), 4 * x.asnumpy(), rtol=1e-6)
+
+
+def test_create_graph_prunes_unrelated_tape():
+    # an unrelated recorded subgraph (here: one that create_graph could not
+    # replay anyway, via a CustomOp) must not affect grad() of heads that
+    # do not depend on it — MXNet builds the backward graph from the heads
+    import mxnet_tpu as mx
+    from mxnet_tpu import operator
+
+    class _Sq(operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.assign(out_data[0], req[0], in_data[0] * in_data[0])
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            self.assign(in_grad[0], req[0], 2 * in_data[0] * out_grad[0])
+
+    @operator.register("sq_prune_test")
+    class _SqProp(operator.CustomOpProp):
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return _Sq()
+
+    x = nd.array(np.array([3.0], np.float32))
+    x.attach_grad()
+    other = nd.array(np.array([4.0], np.float32))
+    with autograd.record():
+        _ = mx.nd.Custom(other, op_type="sq_prune_test")  # unrelated
         y = (x * x).sum()
-    with _pytest.raises(NotImplementedError, match="higher-order"):
-        autograd.grad(y, [x], create_graph=True)
+        (g,) = autograd.grad(y, [x], create_graph=True)  # g = 2x
+        z = (g * g).sum()                                # 4x^2 -> dz/dx = 8x
+    z.backward()
+    np.testing.assert_allclose(g.asnumpy(), [6.0], rtol=1e-6)
+    np.testing.assert_allclose(x.grad.asnumpy(), [24.0], rtol=1e-6)
+
+
+def test_create_graph_intermediate_variable():
+    # grad w.r.t. an intermediate: v = 2x, y = sum(v^2) -> dy/dv = 2v = 4x;
+    # s = sum(dy/dv) = sum(2v) = 4·sum(x) -> ds/dx_i = 4 (torch semantics:
+    # the returned grad stays a function of v, which stays a function of x)
+    x = nd.array(np.array([1.0, 2.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        v = x * 2.0
+        y = (v * v).sum()
+        (gv,) = autograd.grad(y, [v], create_graph=True)
+        s = gv.sum()
+    s.backward()
+    np.testing.assert_allclose(gv.asnumpy(), 4 * x.asnumpy(), rtol=1e-6)
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0, 4.0], rtol=1e-6)

@@ -8,6 +8,7 @@ default block sizes in ops/pallas/flash_attention.py (r3 perf item).
 Run: python tools/flash_sweep.py [--seq 512 2048] [--iters 20]
 """
 import argparse
+import json
 import os
 import sys
 import time
@@ -41,7 +42,12 @@ def main():
     ap.add_argument("--valid-len", type=int, default=0,
                     help="exercise the kv_valid_len key-padding path with "
                          "this per-example length (0 = no padding mask)")
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="also write structured sweep results to PATH "
+                         "(committed as the evidence artifact for the "
+                         "default block-size choice)")
     args = ap.parse_args()
+    rows = []
 
     from mxnet_tpu.ops.attention import _reference_attention
     from mxnet_tpu.ops.pallas.flash_attention import flash_attention
@@ -76,6 +82,8 @@ def main():
             ms_b = time_fn(jax.jit(dense_grad), q, k, v, iters=args.iters)
             print("dense xla          fwd %7.3f ms   fwd+bwd %7.3f ms"
                   % (ms_f, ms_b))
+            rows.append({"seq": T, "kernel": "dense", "fwd_ms": round(ms_f, 3),
+                         "fwd_bwd_ms": round(ms_b, 3)})
         except Exception as e:
             print("dense xla failed:", e)
 
@@ -109,8 +117,23 @@ def main():
                                    iters=args.iters)
                     print("flash bq=%3d bk=%3d fwd %7.3f ms   fwd+bwd %7.3f ms"
                           % (bq, bk, ms_f, ms_b))
+                    rows.append({"seq": T, "kernel": "flash", "block_q": bq,
+                                 "block_k": bk, "fwd_ms": round(ms_f, 3),
+                                 "fwd_bwd_ms": round(ms_b, 3)})
                 except Exception as e:
                     print("flash bq=%3d bk=%3d FAILED: %s" % (bq, bk, e))
+
+    if args.json:
+        meta = {"batch": args.batch, "heads": args.heads, "dim": args.dim,
+                "causal": args.causal, "valid_len": args.valid_len,
+                "iters": args.iters,
+                "platform": jax.devices()[0].platform,
+                "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                             time.gmtime())}
+        with open(args.json, "w") as f:
+            json.dump({"config": meta, "rows": rows}, f, indent=1)
+            f.write("\n")
+        print("wrote %d rows to %s" % (len(rows), args.json))
 
 
 if __name__ == "__main__":

@@ -392,12 +392,18 @@ def _load_results():
 
 
 def _save_result(mode, rec):
-    results = _load_results()
-    results[mode] = rec
-    with open(RESULTS_PATH + ".tmp", "w") as f:
-        json.dump(results, f, indent=1, sort_keys=True)
-        f.write("\n")
-    os.replace(RESULTS_PATH + ".tmp", RESULTS_PATH)
+    # flock around load-modify-replace: a concurrent bench process (the
+    # background loop + a manual run) must not lose the other's just-saved
+    # mode — these records are the replay-on-wedge fallback
+    import fcntl
+    with open(RESULTS_PATH + ".lock", "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        results = _load_results()
+        results[mode] = rec
+        with open(RESULTS_PATH + ".tmp", "w") as f:
+            json.dump(results, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(RESULTS_PATH + ".tmp", RESULTS_PATH)
 
 
 def _extras(results, skip_mode):

@@ -12,4 +12,9 @@ CONTRIB_OPS = {
     "MultiBoxDetection": "multibox_detection",
     "quantize": "contrib_quantize",
     "dequantize": "contrib_dequantize",
+    "DeformableConvolution": "DeformableConvolution",
+    "ModulatedDeformableConvolution": "ModulatedDeformableConvolution",
+    "PSROIPooling": "PSROIPooling",
+    "Proposal": "Proposal",
+    "MultiProposal": "MultiProposal",
 }

@@ -247,24 +247,23 @@ def _grad_create_graph(heads, variables, head_grads):
     nv = len(variables)
     leaf_var_ids = {vid for vid in var_ids if vid not in produced}
 
-    def scalar_replay(vk, k, var_vals, leaf_vals):
-        # The cut for variable k only: its passed value replaces the
-        # recomputed one at its production site, making it the perturbation
-        # point. OTHER variables' sites recompute naturally, so grads w.r.t.
-        # an ancestor of an intermediate variable keep the full chain rule
-        # (torch semantics: each requested grad sees all paths).
-        vid = var_ids[k]
+    def scalar_replay(inject, var_vals, leaf_vals):
+        # `inject`: None (no cut — leaf variables perturb naturally at their
+        # env slot) or (vid, value) cutting ONE intermediate variable: its
+        # passed value replaces the recomputed one at its production site,
+        # making it the perturbation point. Other variables' sites recompute
+        # naturally, so grads w.r.t. an ancestor of an intermediate keep the
+        # full chain rule (torch semantics: each grad sees all paths).
+        cut_id, cut_val = inject if inject is not None else (None, None)
         env = {id(l): v for l, v in zip(leaves, leaf_vals)}
         for i, v in zip(var_ids, var_vals):
             if i in leaf_var_ids:
                 env[i] = v
-        if vid in leaf_var_ids:
-            env[vid] = vk
         for node in tape:
             in_vals = [env.get(id(i), i._data) for i in node.inputs]
             flat = jax.tree_util.tree_leaves(node.primal_fn(*in_vals))
             for o, val in zip(node.outputs, flat):
-                env[id(o)] = vk if id(o) == vid else val
+                env[id(o)] = cut_val if id(o) == cut_id else val
         total = jnp.float32(0.0)
         for h, g in zip(heads, hg):
             hv = env.get(id(h), h._data)
@@ -272,13 +271,26 @@ def _grad_create_graph(heads, variables, head_grads):
                                     * g.astype(jnp.float32))
         return total
 
+    leaf_ks = [k for k in range(nv) if var_ids[k] in leaf_var_ids]
+    inter_ks = [k for k in range(nv) if var_ids[k] not in leaf_var_ids]
+
     def gfun(*all_vals):
+        # one shared replay covers ALL leaf variables (the common
+        # all-params case — O(tape), not O(nv·tape)); intermediates each
+        # need their own cut replay
         var_vals = list(all_vals[:nv])
         leaf_vals = list(all_vals[nv:])
-        return tuple(
-            jax.grad(scalar_replay, argnums=0)(var_vals[k], k, var_vals,
-                                               leaf_vals)
-            for k in range(nv))
+        grads = [None] * nv
+        if leaf_ks:
+            shared = jax.grad(lambda vv: scalar_replay(None, vv, leaf_vals))(
+                var_vals)
+            for k in leaf_ks:
+                grads[k] = shared[k]
+        for k in inter_ks:
+            grads[k] = jax.grad(
+                lambda vk: scalar_replay((var_ids[k], vk), var_vals,
+                                         leaf_vals))(var_vals[k])
+        return tuple(grads)
 
     ext_inputs = list(variables) + leaves
     out_grads, vjp_fn = jax.vjp(gfun, *[a._data for a in ext_inputs])

@@ -5,6 +5,7 @@ from . import control_flow  # noqa: F401
 from . import attention  # noqa: F401
 from . import ctc  # noqa: F401
 from . import roi  # noqa: F401
+from . import rcnn  # noqa: F401
 from . import spatial  # noqa: F401
 from . import extra  # noqa: F401
 from . import legacy_ops  # noqa: F401

@@ -1,0 +1,281 @@
+"""Two-stage / deformable detector contrib ops (ref:
+src/operator/contrib/deformable_convolution.cc, proposal.cc,
+psroi_pooling.cc, modulated_deformable_convolution.cc).
+
+TPU-native formulation: everything is static-shape and vmapped so one XLA
+program covers the batch. Deformable sampling is a bilinear gather with
+zero outside-image contribution (the CUDA kernels' im2col_bilinear); the
+gather's transpose (scatter-add) gives the backward via autodiff instead of
+the reference's hand-written atomicAdd kernels. Proposal generation keeps
+fixed-size candidate sets (top-k + score masking) rather than dynamic
+filtering, so it jits and shards.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import register_op
+from .detection import _nms_single
+
+
+def _bilinear_zero(img, y, x):
+    """img (C, H, W); y, x arbitrary sample grids (...,) -> (C, ...).
+    Samples outside [0, H-1]x[0, W-1] contribute zero (the deformable-conv
+    boundary convention), unlike roi._bilinear which clamps."""
+    H, W = img.shape[1], img.shape[2]
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    out = 0.0
+    for yi, wy in ((y0, 1.0 - (y - y0)), (y0 + 1.0, y - y0)):
+        for xi, wx in ((x0, 1.0 - (x - x0)), (x0 + 1.0, x - x0)):
+            valid = (yi >= 0) & (yi <= H - 1) & (xi >= 0) & (xi <= W - 1)
+            yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+            xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+            w = (wy * wx * valid).astype(img.dtype)
+            out = out + img[:, yc, xc] * w
+    return out
+
+
+def _pair(v):
+    if isinstance(v, (tuple, list)):
+        return tuple(int(x) for x in v[:2])
+    return (int(v), int(v))
+
+
+def _deform_col(data_n, offset_n, mask_n, kernel, stride, pad, dilate,
+                num_deformable_group, h_out, w_out):
+    """One sample's deformable im2col: data (C, H, W), offset
+    (2·dg·KH·KW, Ho, Wo), mask (dg·KH·KW, Ho, Wo) or None ->
+    col (C, KH·KW, Ho, Wo)."""
+    C = data_n.shape[0]
+    KH, KW = kernel
+    K2 = KH * KW
+    dg = num_deformable_group
+    # base sampling grid per tap
+    hy = jnp.arange(h_out) * stride[0] - pad[0]
+    wx = jnp.arange(w_out) * stride[1] - pad[1]
+    ky = jnp.arange(KH) * dilate[0]
+    kx = jnp.arange(KW) * dilate[1]
+    base_y = hy[None, :, None] + ky.repeat(KW)[:, None, None]  # (K2, Ho, 1)
+    base_x = wx[None, None, :] + jnp.tile(kx, KH)[:, None, None]  # (K2,1,Wo)
+    base_y = jnp.broadcast_to(base_y, (K2, h_out, w_out))
+    base_x = jnp.broadcast_to(base_x, (K2, h_out, w_out))
+    off = offset_n.reshape(dg, K2, 2, h_out, w_out)
+    data_g = data_n.reshape(dg, C // dg, *data_n.shape[1:])
+
+    def one_group(dat, og, mg):
+        ys = base_y + og[:, 0]
+        xs = base_x + og[:, 1]
+        col = _bilinear_zero(dat, ys, xs)  # (C/dg, K2, Ho, Wo)
+        if mg is not None:
+            col = col * mg[None]
+        return col
+
+    if mask_n is None:
+        cols = jax.vmap(lambda d, o: one_group(d, o, None))(data_g, off)
+    else:
+        mask_g = mask_n.reshape(dg, K2, h_out, w_out)
+        cols = jax.vmap(one_group)(data_g, off, mask_g)
+    return cols.reshape(C, K2, h_out, w_out)
+
+
+def _deformable_conv_impl(data, offset, weight, bias, mask, kernel, stride,
+                          pad, dilate, num_filter, num_group,
+                          num_deformable_group):
+    kernel, stride, pad, dilate = map(_pair, (kernel, stride, pad, dilate))
+    N, C, H, W = data.shape
+    KH, KW = kernel
+    h_out = (H + 2 * pad[0] - dilate[0] * (KH - 1) - 1) // stride[0] + 1
+    w_out = (W + 2 * pad[1] - dilate[1] * (KW - 1) - 1) // stride[1] + 1
+
+    if mask is None:
+        col = jax.vmap(lambda d, o: _deform_col(
+            d, o, None, kernel, stride, pad, dilate, num_deformable_group,
+            h_out, w_out))(data, offset)  # (N, C, K2, Ho, Wo)
+    else:
+        col = jax.vmap(lambda d, o, m: _deform_col(
+            d, o, m, kernel, stride, pad, dilate, num_deformable_group,
+            h_out, w_out))(data, offset, mask)
+
+    G = num_group
+    colg = col.reshape(N, G, C // G, KH * KW, h_out, w_out)
+    wg = weight.reshape(G, num_filter // G, C // G, KH * KW)
+    out = jnp.einsum("ngckhw,gfck->ngfhw", colg, wg,
+                     preferred_element_type=jnp.float32)
+    out = out.reshape(N, num_filter, h_out, w_out).astype(data.dtype)
+    if bias is not None:
+        out = out + bias[None, :, None, None]
+    return out
+
+
+@register_op("DeformableConvolution")
+def deformable_convolution(data, offset, weight, bias=None, *, kernel,
+                           num_filter, stride=(1, 1), pad=(0, 0),
+                           dilate=(1, 1), num_group=1,
+                           num_deformable_group=1, no_bias=False,
+                           workspace=None, layout=None):
+    """Deformable conv v1 (ref: src/operator/contrib/
+    deformable_convolution.cc). offset (N, 2·dg·KH·KW, Ho, Wo) in
+    (y, x) tap order; sampling outside the image contributes zero."""
+    return _deformable_conv_impl(data, offset, weight,
+                                 None if no_bias else bias, None, kernel,
+                                 stride, pad, dilate, num_filter, num_group,
+                                 num_deformable_group)
+
+
+@register_op("ModulatedDeformableConvolution")
+def modulated_deformable_convolution(data, offset, mask, weight, bias=None, *,
+                                     kernel, num_filter, stride=(1, 1),
+                                     pad=(0, 0), dilate=(1, 1), num_group=1,
+                                     num_deformable_group=1, no_bias=False,
+                                     im2col_step=None, workspace=None,
+                                     layout=None):
+    """Deformable conv v2 (ref: src/operator/contrib/
+    modulated_deformable_convolution.cc): adds a learned [0,1] modulation
+    scalar per sampling tap (mask (N, dg·KH·KW, Ho, Wo))."""
+    return _deformable_conv_impl(data, offset, weight,
+                                 None if no_bias else bias, mask, kernel,
+                                 stride, pad, dilate, num_filter, num_group,
+                                 num_deformable_group)
+
+
+@register_op("PSROIPooling")
+def psroi_pooling(data, rois, *, spatial_scale, output_dim, pooled_size,
+                  group_size=0):
+    """Position-sensitive ROI pooling (R-FCN; ref:
+    src/operator/contrib/psroi_pooling.cc). data (N, od·P·P, H, W),
+    rois (R, 5) [batch_idx, x1, y1, x2, y2] -> (R, od, P, P): bin (i, j)
+    average-pools its OWN channel slice od·(i·P + j).
+
+    The CUDA kernel averages the integer grid cells inside each quantized
+    bin; here each bin averages a fixed 2x2 bilinear sample grid (the
+    static-shape formulation, exact in the dense-grid limit — same
+    approximation ROIPooling documents)."""
+    P = int(pooled_size)
+    gs = int(group_size) or P
+    if gs != P:
+        raise ValueError("group_size must equal pooled_size (got %d vs %d)"
+                         % (gs, P))
+    od = int(output_dim)
+    from .roi import _roi_grid
+
+    def one(roi):
+        img = data[roi[0].astype(jnp.int32)]  # (od*P*P, H, W)
+        ys, xs = _roi_grid(roi[1:], (P, P), 2, spatial_scale)  # (P,P,2,2)
+        d = img.reshape(od, P, P, *img.shape[1:])
+        d = jnp.moveaxis(d, (1, 2), (0, 1))  # (P, P, od, H, W)
+        vals = jax.vmap(jax.vmap(_bilinear_zero))(d, ys, xs)
+        # (P, P, od, 2, 2) -> average samples, put od first
+        return jnp.moveaxis(vals.mean(axis=(-1, -2)), 2, 0)
+
+    return jax.vmap(one)(rois)
+
+
+def _gen_anchors(base_size, scales, ratios):
+    """(A, 4) corner anchors centered on a base_size cell at the origin
+    (ref: src/operator/contrib/proposal.cc GenerateAnchors)."""
+    import numpy as np
+    base = np.array([0, 0, base_size - 1, base_size - 1], np.float32)
+    w = base[2] - base[0] + 1
+    h = base[3] - base[1] + 1
+    cx = base[0] + 0.5 * (w - 1)
+    cy = base[1] + 0.5 * (h - 1)
+    anchors = []
+    for r in ratios:
+        size = w * h
+        ws = np.round(np.sqrt(size / r))
+        hs = np.round(ws * r)
+        for s in scales:
+            wss, hss = ws * s, hs * s
+            anchors.append([cx - 0.5 * (wss - 1), cy - 0.5 * (hss - 1),
+                            cx + 0.5 * (wss - 1), cy + 0.5 * (hss - 1)])
+    return jnp.asarray(np.array(anchors, np.float32))
+
+
+def _decode_boxes(anchors, deltas):
+    """bbox regression transform (ref: proposal.cc BBoxTransformInv)."""
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + 0.5 * (aw - 1.0)
+    acy = anchors[:, 1] + 0.5 * (ah - 1.0)
+    dx, dy, dw, dh = deltas[:, 0], deltas[:, 1], deltas[:, 2], deltas[:, 3]
+    cx = dx * aw + acx
+    cy = dy * ah + acy
+    w = jnp.exp(dw) * aw
+    h = jnp.exp(dh) * ah
+    return jnp.stack([cx - 0.5 * (w - 1.0), cy - 0.5 * (h - 1.0),
+                      cx + 0.5 * (w - 1.0), cy + 0.5 * (h - 1.0)], axis=1)
+
+
+@register_op("Proposal", nondiff=True, n_outputs=2)
+def proposal(cls_prob, bbox_pred, im_info, *, feature_stride=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+             rpn_pre_nms_top_n=6000, rpn_post_nms_top_n=300, threshold=0.7,
+             rpn_min_size=16, iou_loss=False, output_score=False):
+    """RPN proposal generation (ref: src/operator/contrib/proposal.cc).
+    cls_prob (N, 2A, H, W), bbox_pred (N, 4A, H, W), im_info (N, 3)
+    [height, width, scale] -> rois (N·post, 5), plus scores (N·post, 1)
+    when ``output_score=True`` (MXNet default is rois only).
+
+    Static-shape design: clip/min-size/NMS suppress by score-masking and the
+    output is always exactly rpn_post_nms_top_n rows per image (suppressed
+    rows have score -1 and box 0), so the op jits once regardless of content.
+    """
+    if iou_loss:
+        raise NotImplementedError(
+            "Proposal(iou_loss=True) IoU-mode box decoding is not "
+            "implemented — deltas would be mis-decoded by the standard "
+            "center transform")
+    N, A2, H, W = cls_prob.shape
+    A = A2 // 2
+    anchors = _gen_anchors(feature_stride, scales, ratios)  # (A, 4)
+    sy = jnp.arange(H, dtype=jnp.float32) * feature_stride
+    sx = jnp.arange(W, dtype=jnp.float32) * feature_stride
+    shifts = jnp.stack([
+        jnp.broadcast_to(sx[None, :], (H, W)),
+        jnp.broadcast_to(sy[:, None], (H, W)),
+        jnp.broadcast_to(sx[None, :], (H, W)),
+        jnp.broadcast_to(sy[:, None], (H, W))], axis=-1)  # (H, W, 4)
+    all_anchors = (anchors[None, None] + shifts[:, :, None]).reshape(-1, 4)
+    K = all_anchors.shape[0]  # H*W*A
+    pre_n = min(rpn_pre_nms_top_n, K)
+    post_n = min(rpn_post_nms_top_n, pre_n)
+
+    def one(cp, bp, info):
+        scores = cp[A:].transpose(1, 2, 0).reshape(-1)         # (K,) fg
+        deltas = bp.reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        boxes = _decode_boxes(all_anchors, deltas)
+        im_h, im_w, im_scale = info[0], info[1], info[2]
+        boxes = jnp.stack([jnp.clip(boxes[:, 0], 0.0, im_w - 1.0),
+                           jnp.clip(boxes[:, 1], 0.0, im_h - 1.0),
+                           jnp.clip(boxes[:, 2], 0.0, im_w - 1.0),
+                           jnp.clip(boxes[:, 3], 0.0, im_h - 1.0)], axis=1)
+        min_sz = rpn_min_size * im_scale
+        ws = boxes[:, 2] - boxes[:, 0] + 1.0
+        hs = boxes[:, 3] - boxes[:, 1] + 1.0
+        scores = jnp.where((ws >= min_sz) & (hs >= min_sz), scores, -1.0)
+        top_s, top_i = lax.top_k(scores, pre_n)
+        top_b = boxes[top_i]
+        b, s, _ = _nms_single(top_b, top_s, jnp.zeros_like(top_s),
+                              threshold, -1.0, True)
+        keep_s, keep_i = lax.top_k(s, post_n)
+        keep_b = b[keep_i]
+        keep_b = jnp.where(keep_s[:, None] > -1.0, keep_b, 0.0)
+        return keep_b, keep_s
+
+    rois_b, scores_b = jax.vmap(one)(cls_prob, bbox_pred, im_info)
+    batch_idx = jnp.repeat(jnp.arange(N, dtype=cls_prob.dtype), post_n)
+    rois = jnp.concatenate([batch_idx[:, None],
+                            rois_b.reshape(N * post_n, 4)], axis=1)
+    if not output_score:
+        return rois
+    return rois, scores_b.reshape(N * post_n, 1)
+
+
+@register_op("MultiProposal", nondiff=True, n_outputs=2)
+def multi_proposal(cls_prob, bbox_pred, im_info, **kwargs):
+    """Batched alias (ref: src/operator/contrib/multi_proposal.cc) — the
+    vmapped Proposal already handles the batch dimension."""
+    return proposal(cls_prob, bbox_pred, im_info, **kwargs)

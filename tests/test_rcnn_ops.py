@@ -1,0 +1,200 @@
+"""Contrib detection ops: DeformableConvolution / PSROIPooling / Proposal
+(mirrors reference tests/python/unittest/test_contrib_operator.py +
+test_operator.py:test_deformable_convolution)."""
+import numpy as np
+import pytest
+
+from mxnet_tpu import autograd, nd
+
+
+def _np_conv2d(x, w, b=None, stride=1, pad=1):
+    """Plain numpy conv oracle (cross-correlation, NCHW)."""
+    N, C, H, W = x.shape
+    F, _, KH, KW = w.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    Ho = (H + 2 * pad - KH) // stride + 1
+    Wo = (W + 2 * pad - KW) // stride + 1
+    out = np.zeros((N, F, Ho, Wo), np.float32)
+    for i in range(Ho):
+        for j in range(Wo):
+            patch = xp[:, :, i * stride:i * stride + KH,
+                       j * stride:j * stride + KW]
+            out[:, :, i, j] = np.einsum("nchw,fchw->nf", patch, w)
+    if b is not None:
+        out += b[None, :, None, None]
+    return out
+
+
+def test_deformable_conv_zero_offset_equals_conv():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+    w = rng.normal(size=(4, 3, 3, 3)).astype(np.float32)
+    b = rng.normal(size=(4,)).astype(np.float32)
+    off = np.zeros((2, 2 * 9, 8, 8), np.float32)
+    out = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(w), nd.array(b),
+        kernel=(3, 3), num_filter=4, pad=(1, 1))
+    np.testing.assert_allclose(out.asnumpy(), _np_conv2d(x, w, b, pad=1),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_deformable_conv_integer_offset_is_shift():
+    # constant integer offset (dy=1, dx=0) samples one row down: interior
+    # outputs equal a conv over the down-shifted image
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(1, 2, 10, 10)).astype(np.float32)
+    w = rng.normal(size=(2, 2, 3, 3)).astype(np.float32)
+    off = np.zeros((1, 2 * 9, 10, 10), np.float32)
+    off[:, 0::2] = 1.0  # all y-offsets +1
+    out = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(w),
+        kernel=(3, 3), num_filter=2, pad=(1, 1), no_bias=True)
+    x_shift = np.roll(x, -1, axis=2)  # sampling y+1 == input shifted up
+    want = _np_conv2d(x_shift, w, pad=1)
+    np.testing.assert_allclose(out.asnumpy()[:, :, 1:-2, 1:-1],
+                               want[:, :, 1:-2, 1:-1], rtol=2e-4, atol=2e-4)
+
+
+def test_deformable_conv_gradients_finite_difference():
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(1, 1, 5, 5)).astype(np.float32)
+    w = rng.normal(size=(1, 1, 3, 3)).astype(np.float32)
+    off = (0.3 * rng.normal(size=(1, 18, 5, 5))).astype(np.float32)
+    xa, oa, wa = nd.array(x), nd.array(off), nd.array(w)
+    for a in (xa, oa, wa):
+        a.attach_grad()
+    with autograd.record():
+        y = nd.contrib.DeformableConvolution(
+            xa, oa, wa, kernel=(3, 3), num_filter=1, pad=(1, 1),
+            no_bias=True).sum()
+    y.backward()
+
+    def f(xv, ov, wv):
+        return float(nd.contrib.DeformableConvolution(
+            nd.array(xv), nd.array(ov), nd.array(wv), kernel=(3, 3),
+            num_filter=1, pad=(1, 1), no_bias=True).sum().asscalar())
+
+    eps = 1e-2
+    for arr, grad, idx in ((x, xa.grad, (0, 0, 2, 2)),
+                           (off, oa.grad, (0, 4, 2, 2)),
+                           (w, wa.grad, (0, 0, 1, 1))):
+        ap = arr.copy()
+        ap[idx] += eps
+        am = arr.copy()
+        am[idx] -= eps
+        args_p = [ap if arr is a else a for a in (x, off, w)]
+        args_m = [am if arr is a else a for a in (x, off, w)]
+        fd = (f(*args_p) - f(*args_m)) / (2 * eps)
+        np.testing.assert_allclose(float(grad.asnumpy()[idx]), fd,
+                                   rtol=2e-2, atol=2e-2)
+
+
+def test_modulated_deformable_conv():
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(1, 2, 6, 6)).astype(np.float32)
+    w = rng.normal(size=(2, 2, 3, 3)).astype(np.float32)
+    off = np.zeros((1, 18, 6, 6), np.float32)
+    ones = np.ones((1, 9, 6, 6), np.float32)
+    v2 = nd.contrib.ModulatedDeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(ones), nd.array(w),
+        kernel=(3, 3), num_filter=2, pad=(1, 1), no_bias=True)
+    v1 = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(w), kernel=(3, 3),
+        num_filter=2, pad=(1, 1), no_bias=True)
+    np.testing.assert_allclose(v2.asnumpy(), v1.asnumpy(), rtol=1e-5)
+    half = nd.contrib.ModulatedDeformableConvolution(
+        nd.array(x), nd.array(off), nd.array(0.5 * ones), nd.array(w),
+        kernel=(3, 3), num_filter=2, pad=(1, 1), no_bias=True)
+    np.testing.assert_allclose(half.asnumpy(), 0.5 * v1.asnumpy(), rtol=1e-5)
+
+
+def test_psroi_pooling_position_sensitive():
+    # channel c holds the constant value c -> bin (i,j) of output map o must
+    # read exactly channel o*P*P + i*P + j
+    P, od = 2, 3
+    C = od * P * P
+    data = np.broadcast_to(
+        np.arange(C, dtype=np.float32)[None, :, None, None],
+        (1, C, 12, 12)).copy()
+    rois = np.array([[0, 1, 1, 9, 9]], np.float32)
+    out = nd.contrib.PSROIPooling(nd.array(data), nd.array(rois),
+                                  spatial_scale=1.0, output_dim=od,
+                                  pooled_size=P)
+    assert out.shape == (1, od, P, P)
+    want = np.arange(C, dtype=np.float32).reshape(od, P, P)
+    np.testing.assert_allclose(out.asnumpy()[0], want, rtol=1e-5)
+
+
+def test_psroi_pooling_grad_flows():
+    P, od = 2, 2
+    data = nd.array(np.random.default_rng(4).normal(
+        size=(1, od * P * P, 8, 8)).astype(np.float32))
+    rois = nd.array(np.array([[0, 0, 0, 7, 7]], np.float32))
+    data.attach_grad()
+    with autograd.record():
+        s = nd.contrib.PSROIPooling(data, rois, spatial_scale=1.0,
+                                    output_dim=od, pooled_size=P).sum()
+    s.backward()
+    g = data.grad.asnumpy()
+    assert np.abs(g).sum() > 0
+    # unit cotangent per bin distributes weight 1 over its samples
+    np.testing.assert_allclose(g.sum(), od * P * P, rtol=1e-4)
+
+
+def test_proposal_shapes_and_ordering():
+    rng = np.random.default_rng(5)
+    N, A, H, W = 2, 3, 4, 4
+    cls_prob = rng.uniform(0, 1, (N, 2 * A, H, W)).astype(np.float32)
+    bbox_pred = (0.1 * rng.normal(size=(N, 4 * A, H, W))).astype(np.float32)
+    im_info = np.array([[64, 64, 1.0], [64, 64, 1.0]], np.float32)
+    rois, scores = nd.contrib.Proposal(
+        nd.array(cls_prob), nd.array(bbox_pred), nd.array(im_info),
+        feature_stride=16, scales=(8,), ratios=(0.5, 1, 2),
+        rpn_pre_nms_top_n=32, rpn_post_nms_top_n=8, threshold=0.7,
+        rpn_min_size=4, output_score=True)
+    r = rois.asnumpy()
+    s = scores.asnumpy()
+    assert r.shape == (N * 8, 5) and s.shape == (N * 8, 1)
+    # batch indices are 0 for the first 8 rows, 1 for the next 8
+    np.testing.assert_array_equal(r[:8, 0], 0)
+    np.testing.assert_array_equal(r[8:, 0], 1)
+    # per-image scores are sorted descending
+    for b in range(N):
+        sb = s[b * 8:(b + 1) * 8, 0]
+        assert (np.diff(sb) <= 1e-6).all()
+    # surviving boxes are inside the image
+    live = s[:, 0] > -1
+    assert live.any()
+    assert (r[live, 1:] >= 0).all() and (r[live, 1:] <= 63).all()
+
+
+def test_proposal_nms_suppresses_duplicates():
+    # two identical high-score anchors at the same location: NMS must keep one
+    N, A, H, W = 1, 2, 2, 2
+    cls_prob = np.zeros((N, 2 * A, H, W), np.float32)
+    cls_prob[0, A:, 0, 0] = 0.9  # both anchors at (0,0) are foreground
+    bbox_pred = np.zeros((N, 4 * A, H, W), np.float32)
+    im_info = np.array([[32, 32, 1.0]], np.float32)
+    rois, scores = nd.contrib.Proposal(
+        nd.array(cls_prob), nd.array(bbox_pred), nd.array(im_info),
+        feature_stride=16, scales=(2,), ratios=(1.0, 1.0),  # identical ratios
+        rpn_pre_nms_top_n=8, rpn_post_nms_top_n=4, threshold=0.5,
+        rpn_min_size=1, output_score=True)
+    s = scores.asnumpy()[:, 0]
+    assert (s > 0.5).sum() == 1  # the duplicate was suppressed
+
+
+def test_multi_proposal_alias():
+    N, A, H, W = 1, 1, 2, 2
+    cls_prob = np.random.default_rng(6).uniform(
+        0, 1, (N, 2 * A, H, W)).astype(np.float32)
+    bbox_pred = np.zeros((N, 4 * A, H, W), np.float32)
+    im_info = np.array([[32, 32, 1.0]], np.float32)
+    kw = dict(scales=(8,), ratios=(1.0,), rpn_pre_nms_top_n=4,
+              rpn_post_nms_top_n=2, rpn_min_size=1, output_score=True)
+    r1, s1 = nd.contrib.Proposal(nd.array(cls_prob), nd.array(bbox_pred),
+                                 nd.array(im_info), **kw)
+    r2, s2 = nd.contrib.MultiProposal(nd.array(cls_prob), nd.array(bbox_pred),
+                                      nd.array(im_info), **kw)
+    np.testing.assert_allclose(r1.asnumpy(), r2.asnumpy())
+    np.testing.assert_allclose(s1.asnumpy(), s2.asnumpy())

@@ -12,7 +12,8 @@ cd "$(dirname "$0")/.."
 LOG=${TPU_LOOP_LOG:-/tmp/tpu_measurements_r3.log}
 exec >>"$LOG" 2>&1
 
-echo "[loop] started $(date -u +%FT%TZ) pid $$"
+LOOP_START=$(date -u +%FT%TZ)
+echo "[loop] started $LOOP_START pid $$"
 while true; do
   echo "[loop] $(date -u +%T) probing relay..."
   if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
@@ -20,10 +21,15 @@ while true; do
     # the loop just proved the relay is up, so the inner probe can be short
     BENCH_PROBE_BUDGET_S=600 timeout 7200 python bench.py all
     rc=$?
-    # bench.py persists each successful mode; proceed once the headline
-    # (bert) number landed even if a secondary mode failed — a persistently
-    # failing mode must not starve the sweep forever
-    if python -c "import json,sys; sys.exit(0 if 'bert' in json.load(open('BENCH_RESULTS.json')) else 1)" 2>/dev/null; then
+    # bench.py persists each successful mode; proceed once a FRESH headline
+    # (bert) number landed — measured after this loop started, so a stale
+    # record or a replay can't consume the one-shot sequence — even if a
+    # secondary mode failed (a persistently failing mode must not starve
+    # the sweep forever)
+    if python -c "
+import json, sys
+r = json.load(open('BENCH_RESULTS.json')).get('bert', {})
+sys.exit(0 if r.get('measured_at', '') >= '$LOOP_START' else 1)" 2>/dev/null; then
       echo "[loop] $(date -u +%T) bench all rc=$rc with headline saved; running flash sweep"
       timeout 3600 python tools/flash_sweep.py --seq 512 1024 2048 \
         --json tools/flash_sweep_r3.json \

@@ -220,6 +220,19 @@ def create(name="local"):
     """(ref: python/mxnet/kvstore.py:create)"""
     if name in ("local", "local_allreduce_cpu", "local_allreduce_device", "device", "nccl"):
         return KVStore(name)
+    if "async" in name:
+        # Deliberately unsupported, not silently aliased: upstream dist_async
+        # (src/kvstore/kvstore_dist.h) applies server-side updates with no
+        # worker barrier — stale-gradient semantics that fight the SPMD
+        # execution model XLA compiles to on TPU pods (every collective is a
+        # program-ordered barrier by construction). The TPU-native equivalent
+        # of "hide communication latency" is overlapped synchronous
+        # collectives (see parallel/), not asynchrony. SURVEY.md row 23
+        # records this as a justified N/A.
+        raise ValueError(
+            "kvstore %r: asynchronous push semantics are not supported on "
+            "the TPU backend; use 'dist_sync' / 'dist_device_sync' "
+            "(synchronous allreduce over ICI/DCN)" % name)
     if name.startswith("dist"):
         return DistKVStore(name)
     raise ValueError("unknown kvstore type %r" % name)

@@ -5,3 +5,4 @@ from . import bert  # noqa: F401
 from . import lstm_lm  # noqa: F401
 from . import transformer  # noqa: F401
 from . import ssd  # noqa: F401
+from . import faster_rcnn  # noqa: F401

@@ -1,0 +1,216 @@
+"""Faster R-CNN two-stage detector (ref: incubator-mxnet example/rcnn +
+gluoncv model_zoo/faster_rcnn/faster_rcnn.py), built on the contrib kernel
+set: Proposal (RPN decode + NMS), ROIAlign, and optionally
+DeformableConvolution in the head (Deformable R-CNN, ref:
+example/deformable-convnets).
+
+TPU-native shape discipline: every stage is static — the RPN emits exactly
+``rpn_post_nms_top_n`` proposals per image (suppressed rows score -1), the
+head classifies all of them, and ``detect()`` score-masks instead of
+filtering, so the whole forward (backbone → RPN → ROIAlign → head) is ONE
+jittable program. The CUDA original interleaves dynamic-size host steps.
+"""
+from __future__ import annotations
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+
+__all__ = ["FasterRCNN", "faster_rcnn_small", "RCNNTargetLoss"]
+
+
+class _RPNHead(HybridBlock):
+    def __init__(self, channels, num_anchors, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.conv = nn.Conv2D(channels, 3, padding=1, activation="relu")
+            self.cls = nn.Conv2D(2 * num_anchors, 1)
+            self.box = nn.Conv2D(4 * num_anchors, 1)
+
+    def hybrid_forward(self, F, x):
+        h = self.conv(x)
+        return self.cls(h), self.box(h)
+
+
+class _DeformBlock(HybridBlock):
+    """3x3 deformable conv with its own offset predictor (DCN head style)."""
+
+    def __init__(self, channels, in_channels, **kwargs):
+        super().__init__(**kwargs)
+        self._channels = channels
+        with self.name_scope():
+            self.offset = nn.Conv2D(18, 3, padding=1, in_channels=in_channels,
+                                    weight_initializer="zeros",
+                                    bias_initializer="zeros")
+            self.weight = self.params.get(
+                "weight", shape=(channels, in_channels, 3, 3),
+                init="xavier")
+            self.bias = self.params.get("bias", shape=(channels,),
+                                        init="zeros")
+
+    def hybrid_forward(self, F, x, weight=None, bias=None):
+        off = self.offset(x)
+        out = F.DeformableConvolution(x, off, weight, bias, kernel=(3, 3),
+                                      num_filter=self._channels, pad=(1, 1))
+        return F.relu(out)
+
+
+class FasterRCNN(HybridBlock):
+    """Backbone → RPN → Proposal → ROIAlign → 2-FC head → (cls, box).
+
+    forward(x, im_info) returns (cls_prob (B·R, C+1), box_deltas (B·R, 4·(C+1)),
+    rois (B·R, 5), rpn_cls (B, 2A, H, W), rpn_box (B, 4A, H, W), anchors-free).
+    """
+
+    def __init__(self, num_classes=20, backbone_channels=(32, 64),
+                 feature_stride=16, scales=(8, 16), ratios=(0.5, 1, 2),
+                 rpn_channels=64, roi_size=7, head_units=256,
+                 rpn_pre_nms=256, rpn_post_nms=32, rpn_nms_thresh=0.7,
+                 rpn_min_size=4, deformable_head=False, **kwargs):
+        super().__init__(**kwargs)
+        self._nc = num_classes
+        self._stride = feature_stride
+        self._scales = tuple(scales)
+        self._ratios = tuple(ratios)
+        self._pre = rpn_pre_nms
+        self._post = rpn_post_nms
+        self._nms = rpn_nms_thresh
+        self._min = rpn_min_size
+        self._roi = roi_size
+        na = len(scales) * len(ratios)
+        with self.name_scope():
+            feat = nn.HybridSequential(prefix="backbone_")
+            with feat.name_scope():
+                c_in = 3
+                for i, c in enumerate(backbone_channels):
+                    feat.add(nn.Conv2D(c, 3, padding=1, activation="relu"))
+                    feat.add(nn.Conv2D(c, 3, padding=1, activation="relu"))
+                    feat.add(nn.MaxPool2D(2, 2))
+                    c_in = c
+                # two extra stride-2 stages land on feature_stride 16
+                feat.add(nn.Conv2D(rpn_channels, 3, strides=2, padding=1,
+                                   activation="relu"))
+                feat.add(nn.Conv2D(rpn_channels, 3, strides=2, padding=1,
+                                   activation="relu"))
+            self.features = feat
+            if deformable_head:
+                self.neck = _DeformBlock(rpn_channels, rpn_channels,
+                                         prefix="deform_")
+            else:
+                self.neck = None
+            self.rpn = _RPNHead(rpn_channels, na, prefix="rpn_")
+            self.fc1 = nn.Dense(head_units, activation="relu",
+                                in_units=rpn_channels * roi_size * roi_size,
+                                prefix="head_fc1_")
+            self.fc2 = nn.Dense(head_units, activation="relu",
+                                in_units=head_units, prefix="head_fc2_")
+            self.cls_out = nn.Dense(num_classes + 1, in_units=head_units,
+                                    prefix="head_cls_")
+            self.box_out = nn.Dense(4 * (num_classes + 1), in_units=head_units,
+                                    prefix="head_box_")
+
+    def hybrid_forward(self, F, x, im_info):
+        feat = self.features(x)
+        if self.neck is not None:
+            feat = self.neck(feat)
+        rpn_cls, rpn_box = self.rpn(feat)
+        A2 = rpn_cls.shape[1]
+        B, _, H, W = rpn_cls.shape
+        # objectness softmax over the 2-way (bg, fg) split, spec layout
+        cls_resh = F.reshape(rpn_cls, shape=(B, 2, A2 // 2, H, W))
+        cls_prob = F.softmax(cls_resh, axis=1)
+        cls_prob = F.reshape(cls_prob, shape=(B, A2, H, W))
+        rois, scores = F.Proposal(
+            cls_prob, rpn_box, im_info, feature_stride=self._stride,
+            scales=self._scales, ratios=self._ratios,
+            rpn_pre_nms_top_n=self._pre, rpn_post_nms_top_n=self._post,
+            threshold=self._nms, rpn_min_size=self._min, output_score=True)
+        pooled = F.ROIAlign(feat, rois, pooled_size=(self._roi, self._roi),
+                            spatial_scale=1.0 / self._stride)
+        h = self.fc2(self.fc1(F.reshape(
+            pooled, shape=(pooled.shape[0], -1))))
+        cls = F.softmax(self.cls_out(h), axis=-1)
+        deltas = self.box_out(h)
+        return cls, deltas, rois, scores, rpn_cls, rpn_box
+
+    def detect(self, x, im_info, score_thresh=0.05, nms_thresh=0.3):
+        """Score-masked per-class detection over the fixed proposal set:
+        (B·R, 6) rows [cls_id, score, x1, y1, x2, y2]; suppressed rows get
+        score -1 (the static-shape convention of ops/detection.py)."""
+        from .. import nd
+
+        cls, deltas, rois, *_ = self(x, im_info)
+        R = rois.shape[0]
+        best = nd.argmax(cls, axis=1)                       # (R,)
+        best_score = nd.max(cls, axis=1)
+        # decode the best class's deltas against the roi box
+        d = nd.reshape(deltas, shape=(R, self._nc + 1, 4))
+        idx = nd.repeat(nd.reshape(best, shape=(R, 1)), repeats=4, axis=1)
+        sel = nd.pick(nd.transpose(d, axes=(0, 2, 1)), idx, axis=2)  # (R,4)
+        boxes = _decode_rcnn_boxes(rois, sel)
+        keep_fg = (best > 0) * (best_score > score_thresh)
+        data = nd.concat(
+            nd.reshape(best.astype("float32") - 1.0, shape=(R, 1)),
+            nd.reshape(nd.where(keep_fg, best_score,
+                                nd.zeros_like(best_score) - 1.0),
+                       shape=(R, 1)),
+            boxes, dim=1)
+        return nd.box_nms(data, overlap_thresh=nms_thresh,
+                          valid_thresh=score_thresh, coord_start=2,
+                          score_index=1, id_index=0)
+
+
+def _decode_rcnn_boxes(rois, deltas):
+    from .. import nd
+
+    x1, y1 = rois[:, 1], rois[:, 2]
+    x2, y2 = rois[:, 3], rois[:, 4]
+    w = x2 - x1 + 1.0
+    h = y2 - y1 + 1.0
+    cx = x1 + 0.5 * w
+    cy = y1 + 0.5 * h
+    ncx = deltas[:, 0] * w + cx
+    ncy = deltas[:, 1] * h + cy
+    nw = nd.exp(deltas[:, 2]) * w
+    nh = nd.exp(deltas[:, 3]) * h
+    out = nd.stack(ncx - 0.5 * nw, ncy - 0.5 * nh,
+                   ncx + 0.5 * nw, ncy + 0.5 * nh, axis=1)
+    return out
+
+
+class RCNNTargetLoss(HybridBlock):
+    """Training loss over the static proposal set: proposals are matched to
+    GT with the same on-device assignment the SSD path uses
+    (ops/detection.py multibox_target over corner boxes normalized by the
+    image size), giving cls CE + smooth-L1 on positives
+    (ref: example/rcnn rcnn/core loss wiring)."""
+
+    def __init__(self, num_classes, image_size, **kwargs):
+        super().__init__(**kwargs)
+        self._nc = num_classes
+        self._sz = float(image_size)
+
+    def hybrid_forward(self, F, cls, deltas, rois, labels):
+        R = rois.shape[0]
+        boxes = rois[:, 1:] / self._sz                 # (R, 4) in [0, 1]
+        cls_t_in = F.transpose(cls, axes=(1, 0))       # (C+1, R)
+        bt, bm, ct = F.multibox_target(
+            F.reshape(boxes, shape=(1, R, 4)), labels,
+            F.reshape(cls_t_in, shape=(1, self._nc + 1, R)))
+        logp = F.log(F.maximum(cls, 1e-12))
+        picked = F.pick(logp, F.maximum(ct[0], 0.0), axis=1)
+        valid = F.cast(F.greater_equal(ct[0], 0.0), dtype="float32")
+        cls_loss = -F.sum(picked * valid) / F.maximum(F.sum(valid), 1.0)
+        d = F.reshape(deltas, shape=(R, self._nc + 1, 4))
+        idx = F.repeat(F.reshape(F.maximum(ct[0], 0.0), shape=(R, 1)),
+                       repeats=4, axis=1)
+        fg = F.pick(F.transpose(d, axes=(0, 2, 1)), idx, axis=2)  # (R, 4)
+        box_l = F.smooth_l1(F.reshape(fg, shape=(1, R * 4))
+                            - bt, scalar=1.0) * bm
+        box_loss = F.sum(box_l) / F.maximum(F.sum(bm), 1.0)
+        return cls_loss + box_loss
+
+
+def faster_rcnn_small(num_classes=20, deformable=False, **kwargs):
+    """Small test/train-scale config (stride 16, 6 anchors)."""
+    return FasterRCNN(num_classes=num_classes, deformable_head=deformable,
+                      **kwargs)

@@ -272,10 +272,34 @@ linalg = _DelegatedModule(jnp.linalg, "mxnet_tpu.np.linalg")
 fft = _DelegatedModule(jnp.fft, "mxnet_tpu.np.fft")
 
 
+# Names whose semantics are purely host-side (business-day calendars,
+# structured/record arrays, file-backed memmaps, build introspection, legacy
+# matrix/poly classes, utility submodules). jnp deliberately omits them; the
+# TPU-native stance is that they never touch the device, so the classic numpy
+# implementations ARE the correct ones (ref: python/mxnet/numpy/__init__.py
+# re-exports the same names from its bundled numpy).
+_ONP_HOST_NAMES = frozenset((
+    "ScalarType", "asmatrix", "bmat", "broadcast", "busday_count",
+    "busday_offset", "busdaycalendar", "char", "clongdouble", "complex256",
+    "core", "ctypeslib", "datetime_as_string", "datetime_data", "dtypes",
+    "emath", "exceptions", "f2py", "flatiter", "float128", "fromregex",
+    "get_include", "getbufsize", "geterrcall", "info", "is_busday",
+    "isfortran", "isnat", "lib", "ma", "matrix", "may_share_memory",
+    "memmap", "nditer", "nested_iters", "poly1d", "polynomial", "putmask",
+    "rec", "recarray", "record", "require", "sctypeDict", "setbufsize",
+    "seterrcall", "shares_memory", "show_config", "show_runtime", "strings",
+    "testing", "typecodes", "typing",
+))
+
+
 def __getattr__(name):
     import sys
     fn = getattr(jnp, name, None)
     if fn is None:
+        if name in _ONP_HOST_NAMES and hasattr(_onp, name):
+            v = getattr(_onp, name)
+            setattr(sys.modules[__name__], name, v)
+            return v
         raise AttributeError("mx.np has no attribute %r" % name)
     if not callable(fn) or isinstance(fn, type):
         return fn  # dtypes, constants

@@ -309,6 +309,257 @@ for _mx, _onnx in [("relu", "Relu"), ("sigmoid", "Sigmoid"), ("tanh", "Tanh"),
     register_converter(_mx)(_unop(_onnx))
 
 
+for _mx, _onnx in [("arcsin", "Asin"), ("arccos", "Acos"),
+                   ("arctan", "Atan"), ("sinh", "Sinh"), ("cosh", "Cosh"),
+                   ("arcsinh", "Asinh"), ("arccosh", "Acosh"),
+                   ("arctanh", "Atanh")]:
+    register_converter(_mx)(_unop(_onnx))
+
+
+_F32 = 1  # onnx TensorProto.FLOAT
+
+
+def _cmpop(onnx_op, negate=False):
+    """MXNet comparisons return float 0/1; ONNX returns bool → Cast back
+    (f32, the framework's default compute dtype)."""
+    def conv(ctx, s, ins, out):
+        b = ctx.fresh("cmp")
+        ctx.emit(onnx_op, ins[:2], [b])
+        if negate:
+            nb = ctx.fresh("not")
+            ctx.emit("Not", [b], [nb])
+            b = nb
+        ctx.emit("Cast", [b], [out], attrs={"to": _F32})
+    return conv
+
+
+for _mx, _onnx, _neg in [
+        ("broadcast_equal", "Equal", False),
+        ("broadcast_not_equal", "Equal", True),
+        ("broadcast_greater", "Greater", False),
+        ("broadcast_greater_equal", "GreaterOrEqual", False),
+        ("broadcast_lesser", "Less", False),
+        ("broadcast_lesser_equal", "LessOrEqual", False),
+        ("equal", "Equal", False), ("not_equal", "Equal", True),
+        ("greater", "Greater", False),
+        ("greater_equal", "GreaterOrEqual", False),
+        ("lesser", "Less", False), ("lesser_equal", "LessOrEqual", False)]:
+    register_converter(_mx)(_cmpop(_onnx, _neg))
+
+
+_BOOL = 9  # onnx TensorProto.BOOL
+
+
+def _logicop(onnx_op):
+    def conv(ctx, s, ins, out):
+        bs = []
+        for i in ins[:2]:
+            b = ctx.fresh("b")
+            ctx.emit("Cast", [i], [b], attrs={"to": _BOOL})
+            bs.append(b)
+        r = ctx.fresh("logic")
+        ctx.emit(onnx_op, bs, [r])
+        ctx.emit("Cast", [r], [out], attrs={"to": _F32})
+    return conv
+
+
+for _mx, _onnx in [("logical_and", "And"), ("logical_or", "Or"),
+                   ("logical_xor", "Xor"),
+                   ("broadcast_logical_and", "And"),
+                   ("broadcast_logical_or", "Or"),
+                   ("broadcast_logical_xor", "Xor")]:
+    register_converter(_mx)(_logicop(_onnx))
+
+
+@register_converter("logical_not")
+def _logical_not(ctx, s, ins, out):
+    b = ctx.fresh("b")
+    ctx.emit("Cast", ins[:1], [b], attrs={"to": _BOOL})
+    r = ctx.fresh("not")
+    ctx.emit("Not", [b], [r])
+    ctx.emit("Cast", [r], [out], attrs={"to": _F32})
+
+
+@register_converter("mod")
+def _mod_conv(ctx, s, ins, out):
+    # framework mod is floor modulo (jnp.mod, sign of divisor); ONNX Mod on
+    # floats requires fmod=1 (truncation, sign of dividend) — decompose
+    # instead: x - floor(x/y)*y, exact for both signs
+    q = ctx.fresh("div")
+    ctx.emit("Div", ins[:2], [q])
+    fq = ctx.fresh("floor")
+    ctx.emit("Floor", [q], [fq])
+    prod = ctx.fresh("mul")
+    ctx.emit("Mul", [fq, ins[1]], [prod])
+    ctx.emit("Sub", [ins[0], prod], [out])
+
+
+def _argop(onnx_op):
+    def conv(ctx, s, ins, out):
+        a = s._attrs
+        ax = a.get("axis")
+        attrs = {"axis": int(ax) if ax is not None else 0,
+                 "keepdims": int(bool(a.get("keepdims", False)))}
+        if ax is None:
+            flat = ctx.fresh("flat")
+            shp = ctx.const("shape", np.asarray([-1], np.int64))
+            ctx.emit("Reshape", [ins[0], shp], [flat])
+            r = ctx.fresh("arg")
+            ctx.emit(onnx_op, [flat], [r], attrs=attrs)
+            ctx.emit("Cast", [r], [out], attrs={"to": _F32})
+            return
+        r = ctx.fresh("arg")
+        ctx.emit(onnx_op, ins[:1], [r], attrs=attrs)
+        # MXNet argmax/argmin return float; ONNX returns int64
+        ctx.emit("Cast", [r], [out], attrs={"to": _F32})
+    return conv
+
+
+register_converter("argmax")(_argop("ArgMax"))
+register_converter("argmin")(_argop("ArgMin"))
+
+
+@register_converter("norm")
+def _norm_conv(ctx, s, ins, out):
+    a = s._attrs
+    ordv = int(a.get("ord", 2))
+    op = {1: "ReduceL1", 2: "ReduceL2"}.get(ordv)
+    if op is None:
+        raise ValueError("norm export: only ord 1/2 map to ONNX ReduceL1/L2")
+    attrs = {"keepdims": int(bool(a.get("keepdims", False)))}
+    ax = a.get("axis")
+    if ax is not None:
+        attrs["axes"] = [ax] if isinstance(ax, int) else list(ax)
+    ctx.emit(op, ins[:1], [out], attrs=attrs)
+
+
+@register_converter("stack")
+def _stack_conv(ctx, s, ins, out):
+    axis = int(s._attrs.get("axis", 0))
+    ax_in = ctx.const("axes", np.asarray([axis], np.int64))
+    unsq = []
+    for i in ins:
+        u = ctx.fresh("unsq")
+        ctx.emit("Unsqueeze", [i, ax_in], [u])
+        unsq.append(u)
+    ctx.emit("Concat", unsq, [out], attrs={"axis": axis})
+
+
+@register_converter("take")
+def _take_conv(ctx, s, ins, out):
+    a = s._attrs
+    mode = a.get("mode", "clip")
+    if mode not in ("clip", "raise"):
+        raise ValueError("take export: mode=%r unsupported" % mode)
+    axis = int(a.get("axis", 0))
+    idx = ctx.fresh("idx")
+    ctx.emit("Cast", [ins[1]], [idx], attrs={"to": 7})  # int64 indices
+    if mode == "clip":
+        # ONNX Gather is out-of-bounds-undefined; reproduce MXNet's clamp
+        # with Clip(idx, 0, dim-1). Static dim when the traced shape is
+        # known (the usual export path), Shape-at-runtime otherwise.
+        data_shape = getattr(s._inputs[0], "_shape", None)
+        zero = ctx.const("zero", np.asarray(0, np.int64))
+        if data_shape is not None:
+            hi = ctx.const("hi", np.asarray(data_shape[axis] - 1, np.int64))
+        else:
+            shp = ctx.fresh("shape")
+            ctx.emit("Shape", [ins[0]], [shp])
+            ax_c = ctx.const("axidx", np.asarray(axis, np.int64))
+            dim = ctx.fresh("dim")
+            ctx.emit("Gather", [shp, ax_c], [dim], attrs={"axis": 0})
+            one = ctx.const("one", np.asarray(1, np.int64))
+            hi = ctx.fresh("hi")
+            ctx.emit("Sub", [dim, one], [hi])
+        clipped = ctx.fresh("clipped")
+        ctx.emit("Clip", [idx, zero, hi], [clipped])
+        idx = clipped
+    ctx.emit("Gather", [ins[0], idx], [out], attrs={"axis": axis})
+
+
+@register_converter("InstanceNorm")
+def _instance_norm_conv(ctx, s, ins, out):
+    ctx.emit("InstanceNormalization", ins[:3], [out],
+             attrs={"epsilon": float(s._attrs.get("eps", 1e-5))})
+
+
+@register_converter("LRN")
+def _lrn_conv(ctx, s, ins, out):
+    a = s._attrs
+    ctx.emit("LRN", ins[:1], [out], attrs={
+        "size": int(a.get("nsize", 5)), "alpha": float(a.get("alpha", 1e-4)),
+        "beta": float(a.get("beta", 0.75)), "bias": float(a.get("knorm", 2.0))})
+
+
+@register_converter("L2Normalization")
+def _l2norm_conv(ctx, s, ins, out):
+    mode = s._attrs.get("mode", "instance")
+    if mode != "channel":
+        raise ValueError("L2Normalization export: only mode='channel' maps "
+                         "to ONNX LpNormalization (axis semantics)")
+    ctx.emit("LpNormalization", ins[:1], [out], attrs={"axis": 1, "p": 2})
+
+
+@register_converter("log1p")
+def _log1p_conv(ctx, s, ins, out):
+    one = ctx.const("one", np.float32(1.0))
+    ap = ctx.fresh("add")
+    ctx.emit("Add", [ins[0], one], [ap])
+    ctx.emit("Log", [ap], [out])
+
+
+@register_converter("expm1")
+def _expm1_conv(ctx, s, ins, out):
+    one = ctx.const("one", np.float32(1.0))
+    e = ctx.fresh("exp")
+    ctx.emit("Exp", ins[:1], [e])
+    ctx.emit("Sub", [e, one], [out])
+
+
+@register_converter("rsqrt")
+def _rsqrt_conv(ctx, s, ins, out):
+    r = ctx.fresh("sqrt")
+    ctx.emit("Sqrt", ins[:1], [r])
+    ctx.emit("Reciprocal", [r], [out])
+
+
+@register_converter("hard_sigmoid")
+def _hard_sigmoid_conv(ctx, s, ins, out):
+    a = s._attrs
+    ctx.emit("HardSigmoid", ins[:1], [out],
+             attrs={"alpha": float(a.get("alpha", 0.2)),
+                    "beta": float(a.get("beta", 0.5))})
+
+
+@register_converter("depth_to_space")
+def _d2s_conv(ctx, s, ins, out):
+    ctx.emit("DepthToSpace", ins[:1], [out],
+             attrs={"blocksize": int(s._attrs["block_size"]), "mode": "DCR"})
+
+
+@register_converter("space_to_depth")
+def _s2d_conv(ctx, s, ins, out):
+    ctx.emit("SpaceToDepth", ins[:1], [out],
+             attrs={"blocksize": int(s._attrs["block_size"])})
+
+
+@register_converter("gather_nd")
+def _gather_nd_export(ctx, s, ins, out):
+    # MXNet gather_nd leads with the index-tuple axis; ONNX GatherND wants
+    # indices (..., index_depth) — move the leading axis to the back
+    idx_sym = s._inputs[1]
+    rank = len(idx_sym._shape) if getattr(idx_sym, "_shape", None) else None
+    if rank is None:
+        raise ValueError("gather_nd export needs a known indices rank for "
+                         "the layout transpose")
+    idx = ctx.fresh("idx")
+    ctx.emit("Cast", [ins[1]], [idx], attrs={"to": 7})
+    tr = ctx.fresh("tr")
+    ctx.emit("Transpose", [idx], [tr],
+             attrs={"perm": list(range(1, rank)) + [0]})
+    ctx.emit("GatherND", [ins[0], tr], [out])
+
+
 @register_converter("square")
 def _square(ctx, s, ins, out):
     two = ctx.const("two", np.float32(2.0))

@@ -359,7 +359,11 @@ for _onnx, _mx in [("Exp", "exp"), ("Log", "log"), ("Sqrt", "sqrt"),
                    ("Neg", "negative"), ("Abs", "abs"), ("Floor", "floor"),
                    ("Ceil", "ceil"), ("Round", "round"), ("Erf", "erf"),
                    ("Sin", "sin"), ("Cos", "cos"), ("Tan", "tan"),
-                   ("Reciprocal", "reciprocal"), ("Sign", "sign")]:
+                   ("Reciprocal", "reciprocal"), ("Sign", "sign"),
+                   ("Asin", "arcsin"), ("Acos", "arccos"),
+                   ("Atan", "arctan"), ("Sinh", "sinh"), ("Cosh", "cosh"),
+                   ("Asinh", "arcsinh"), ("Acosh", "arccosh"),
+                   ("Atanh", "arctanh")]:
     register_importer(_onnx)(_unop(_mx))
 
 
@@ -461,6 +465,79 @@ def _scan_imp(g, node):
     # ONNX output order: final_states..., stacked scan output; ours is
     # [stacked, states...]
     return [fnode[i + 1] for i in range(n_states)] + [fnode[0]]
+
+
+@register_importer("Loop")
+def _loop_imp(g, node):
+    """ONNX Loop → symbol while_loop (masked lax.scan, the TPU-static form).
+
+    Body formals are [iteration_num, cond_in, carried...]; body outputs are
+    [cond_out, carried..., scan_outputs...]. The trip count M must be a
+    constant (XLA needs a static bound to stack per-step outputs). Static-
+    shape deviation from the spec: scan outputs always have M rows — rows
+    after the condition turns false are zero (the while_loop masking),
+    where ONNX would return only the executed prefix.
+    """
+    from ..symbol import Symbol, while_loop
+
+    a = node["attrs"]
+    body = a["body"]
+    ins = node["inputs"]
+    m_name, cond_name = ins[0], ins[1]
+    carried_names = list(ins[2:])
+    n_carried = len(carried_names)
+    if not m_name:
+        raise ValueError(
+            "Loop import: trip count M is required and must be constant "
+            "(a static bound is what lets XLA compile the loop)")
+    M = int(np.asarray(g.const_value(m_name)).reshape(()))
+
+    binputs = [vi["name"] for vi in body["inputs"]]
+    if len(binputs) != 2 + n_carried:
+        raise ValueError("Loop body has %d inputs, expected %d"
+                         % (len(binputs), 2 + n_carried))
+    iter_name, cond_in_name = binputs[0], binputs[1]
+    bstate_names = binputs[2:]
+    n_scan = len(body["outputs"]) - 1 - n_carried
+    if n_scan < 0:
+        raise ValueError("Loop body must output [cond, carried..., scans...]")
+    if n_scan > 1:
+        raise ValueError("Loop import: at most one scan output supported "
+                         "(while_loop stacks a single per-step Symbol)")
+
+    def _bool_const(v):
+        f = _make("_filled", shape=(), value=1.0 if v else 0.0)
+        return _make("cast", f, dtype="bool")
+
+    iter0 = _make("cast", _make("_filled", shape=(), value=0.0),
+                  dtype="int64")
+    init_cond = g.inp(cond_name) if cond_name else _bool_const(True)
+    init_states = [g.inp(n) for n in carried_names]
+
+    def cond_fn(vs):
+        return vs[1]
+
+    def func(vs):
+        i, c = vs[0], vs[1]
+        bound = {iter_name: i, cond_in_name: c}
+        bound.update(dict(zip(bstate_names, vs[2:])))
+        outs = _import_subgraph(g, body, "Loop", bound_inputs=bound)
+        cond_out = outs[0]
+        states_out = list(outs[1:1 + n_carried])
+        scan_outs = list(outs[1 + n_carried:])
+        out_sym = scan_outs[0] if scan_outs else cond_out  # dummy when K=0
+        i_next = _make("cast", _make("add", _make("cast", i,
+                                                  dtype="float32"), 1.0),
+                       dtype="int64")
+        return out_sym, [i_next, cond_out] + states_out
+
+    outputs, final_vars = while_loop(cond_fn, func,
+                                     [iter0, init_cond] + init_states,
+                                     max_iterations=M)
+    result = list(final_vars[2:])
+    if n_scan:
+        result.append(outputs)
+    return result
 
 
 @register_importer("NonMaxSuppression")
@@ -715,7 +792,64 @@ _reg_elemwise_imp("Less", "broadcast_lesser")
 _reg_elemwise_imp("Not", "logical_not")
 _reg_elemwise_imp("And", "broadcast_logical_and")
 _reg_elemwise_imp("Or", "broadcast_logical_or")
+_reg_elemwise_imp("Xor", "broadcast_logical_xor")
+_reg_elemwise_imp("GreaterOrEqual", "broadcast_greater_equal")
+_reg_elemwise_imp("LessOrEqual", "broadcast_lesser_equal")
 _reg_elemwise_imp("Sum", "add_n")
+
+
+@register_importer("Mod")
+def _mod_imp(g, node):
+    x, y = g.inp(node["inputs"][0]), g.inp(node["inputs"][1])
+    if int(node["attrs"].get("fmod", 0)):
+        # fmod semantics: x - trunc(x/y)*y (sign of dividend)
+        return _make("subtract", x,
+                     _make("multiply", _make("trunc", _make("divide", x, y)),
+                           y))
+    return _make("mod", x, y)
+
+
+def _reduce_lp(ordv):
+    def imp(g, node):
+        a = node["attrs"]
+        axes = a.get("axes")
+        if axes is None and len(node["inputs"]) > 1:
+            # opset>=18 moved ReduceL1/L2 axes to a second input, like
+            # ReduceSum at 13 — resolve through the same initializer path
+            ax_init = g.initializers.get(node["inputs"][1])
+            if ax_init is None:
+                raise ValueError("%s: dynamic axes input unsupported"
+                                 % node["op"])
+            axes = [int(x) for x in np.asarray(ax_init).reshape(-1)]
+        kw = {"ord": ordv, "keepdims": bool(a.get("keepdims", 1))}
+        if axes is not None:
+            kw["axis"] = (tuple(int(x) for x in axes) if len(axes) > 1
+                          else int(axes[0]))
+        return _make("norm", g.inp(node["inputs"][0]), **kw)
+    return imp
+
+
+register_importer("ReduceL1")(_reduce_lp(1))
+register_importer("ReduceL2")(_reduce_lp(2))
+
+
+@register_importer("LpNormalization")
+def _lp_norm_imp(g, node):
+    a = node["attrs"]
+    if int(a.get("p", 2)) != 2 or int(a.get("axis", -1)) != 1:
+        raise ValueError("LpNormalization import: only p=2, axis=1 "
+                         "(channel mode) supported")
+    return _make("L2Normalization", g.inp(node["inputs"][0]), mode="channel")
+
+
+@register_importer("LRN")
+def _lrn_imp(g, node):
+    a = node["attrs"]
+    return _make("LRN", g.inp(node["inputs"][0]),
+                 nsize=int(a.get("size", 5)),
+                 alpha=float(a.get("alpha", 1e-4)),
+                 beta=float(a.get("beta", 0.75)),
+                 knorm=float(a.get("bias", 1.0)))
 
 
 @register_importer("Mean")

@@ -416,3 +416,47 @@ def parse_model(buf):
         "opset": opset,
         "graph": parse_graph(f[7][0]),
     }
+
+
+def check_model(buf):
+    """Structural validation of an encoded ModelProto (the spec rules the
+    official onnx.checker enforces that don't need the full type system —
+    that package is unavailable here): every node input is a graph input,
+    an initializer, or an earlier node's output (SSA + topological order);
+    node ops are named; output names exist; subgraphs check recursively
+    against the outer scope (ONNX scoping). Raises ValueError.
+    """
+    model = parse_model(buf)
+    if not model["ir_version"]:
+        raise ValueError("checker: missing ir_version")
+    _check_graph(model["graph"], set(), "main")
+    return model
+
+
+def _check_graph(g, outer_names, tag):
+    known = set(outer_names)
+    known.update(vi["name"] for vi in g["inputs"])
+    known.update(g["initializers"])
+    known.add("")  # optional (empty) inputs are legal
+    for i, node in enumerate(g["nodes"]):
+        if not node["op"]:
+            raise ValueError("checker: %s node %d has no op_type" % (tag, i))
+        for inp in node["inputs"]:
+            if inp not in known:
+                raise ValueError(
+                    "checker: %s node %d (%s) input %r is not a graph "
+                    "input, initializer, or earlier output (SSA order)"
+                    % (tag, i, node["op"], inp))
+        for attr, v in node["attrs"].items():
+            if isinstance(v, dict) and "nodes" in v:  # subgraph
+                _check_graph(v, known, "%s/%s.%s" % (tag, node["op"], attr))
+        for out in node["outputs"]:
+            if out in known and out:
+                raise ValueError("checker: %s node %d (%s) output %r "
+                                 "redefines an existing name (SSA)"
+                                 % (tag, i, node["op"], out))
+            known.add(out)
+    for vo in g["outputs"]:
+        if vo["name"] not in known:
+            raise ValueError("checker: %s graph output %r is never produced"
+                             % (tag, vo["name"]))

@@ -169,6 +169,10 @@ class Symbol:
         out = jax.eval_shape(fn, *specs)
         if isinstance(out, (list, tuple)):
             out = out[self._out_index or 0]
+        elif self._out_index:
+            raise ValueError(
+                "symbol output %d requested but %r produced a single output "
+                "with these attributes" % (self._out_index, self._op))
         self._shape = tuple(out.shape)
         return self._shape
 
@@ -435,7 +439,15 @@ def _eval(sym, env, cache, keyctx=None, shared=frozenset()):
         val = [_eval(i, env, cache, keyctx, shared) for i in sym._inputs]
     elif sym._op == "_item":
         parent = _eval(sym._inputs[0], env, cache, keyctx, shared)
-        val = parent[sym._attrs["index"]]
+        idx = sym._attrs["index"]
+        if not isinstance(parent, (list, tuple)) and idx != 0:
+            # an op whose output arity depends on attrs (e.g. Proposal with
+            # output_score=False) returned a single array — indexing past it
+            # must fail loudly, not silently alias output 0
+            raise ValueError(
+                "symbol output %d requested but %r produced a single "
+                "output with these attributes" % (idx, sym._inputs[0]._op))
+        val = parent[idx] if isinstance(parent, (list, tuple)) else parent
     elif sym._op == "_while":
         n_vars = sym._attrs["n_vars"]
         var_vs = [_eval(i, env, cache, keyctx, shared)

@@ -314,3 +314,36 @@ def test_fused_rnn_cell_truncated_bptt():
     import pytest as _pytest
     with _pytest.raises(ValueError, match="exceeds"):
         cell.unroll(9, x, layout="NTC")
+
+
+def test_np_host_side_delegation():
+    """Host-semantics numpy names (busday calendars, record arrays, legacy
+    matrix/poly classes, utility submodules) resolve through mx.np."""
+    import numpy as onp
+
+    from mxnet_tpu import np as mnp
+
+    assert mnp.is_busday("2026-07-30") == onp.is_busday("2026-07-30")
+    assert mnp.busday_count("2026-07-01", "2026-07-30") == \
+        onp.busday_count("2026-07-01", "2026-07-30")
+    p = mnp.poly1d([1.0, -3.0, 2.0])
+    assert p(2.0) == 0.0
+    r = mnp.rec.fromarrays([onp.arange(3), onp.ones(3)], names="a,b")
+    assert r.a[2] == 2
+    m = mnp.asmatrix(onp.eye(2))
+    assert isinstance(m, mnp.matrix)
+    assert mnp.ma.masked_array(onp.arange(3), mask=[0, 1, 0]).sum() == 2
+    assert callable(mnp.testing.assert_allclose)
+    assert mnp.typecodes["AllInteger"]
+
+
+def test_dist_async_is_loud_na():
+    """dist_async must not silently alias to sync semantics (VERDICT r2)."""
+    import pytest as _pytest
+
+    import mxnet_tpu as mx
+
+    with _pytest.raises(ValueError, match="async"):
+        mx.kvstore.create("dist_async")
+    with _pytest.raises(ValueError, match="async"):
+        mx.kvstore.create("dist_sync_async")

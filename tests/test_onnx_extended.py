@@ -378,3 +378,153 @@ def test_converter_breadth_roundtrips(tmp_path):
     assert roundtrip(mx.sym.tile(xs, reps=(2, 1)), {"x": x}).shape == (4, 6)
     np.testing.assert_allclose(roundtrip(mx.sym.argmax(xs, axis=1),
                                          {"x": x}), x.argmax(1))
+
+
+def _roundtrip_eval(build, feeds, rtol=1e-5, atol=1e-6):
+    """Export a symbol graph, re-import, evaluate both, compare."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, sym
+    from mxnet_tpu import onnx as mxonnx
+
+    vars_ = {k: sym.Variable(k) for k in feeds}
+    out = build(vars_)
+    shapes = {k: v.shape for k, v in feeds.items()}
+    buf = mxonnx.symbol_to_onnx(out, {}, input_shapes=shapes)
+    from mxnet_tpu.onnx import proto as P
+    P.check_model(buf)
+    nd_feeds = {k: nd.array(v) for k, v in feeds.items()}
+    ex = out.bind(mx.cpu(), dict(nd_feeds))
+    want = ex.forward()
+    want = want if isinstance(want, (list, tuple)) else [want]
+    blk = mxonnx.import_to_gluon(buf)
+    got = blk(*[nd_feeds[k] for k in sorted(feeds)])
+    got = got if isinstance(got, (list, tuple)) else [got]
+    for w, g in zip(want, got):
+        np.testing.assert_allclose(g.asnumpy(), w.asnumpy(),
+                                   rtol=rtol, atol=atol)
+
+
+def test_onnx_breadth_trig_family_roundtrip():
+    from mxnet_tpu import sym
+    x = np.random.RandomState(0).uniform(0.2, 0.8, (2, 5)).astype(np.float32)
+
+    def build(v):
+        s = v["a"]
+        return sym.arctanh(sym.arcsin(s) * 0.5) + sym.sinh(s) + \
+            sym.cosh(s) + sym.arctan(s) + sym.arccos(s) + sym.arcsinh(s)
+
+    _roundtrip_eval(build, {"a": x}, rtol=1e-4)
+
+
+def test_onnx_breadth_comparisons_and_logic_roundtrip():
+    from mxnet_tpu import sym
+    rs = np.random.RandomState(1)
+    a = rs.randn(3, 4).astype(np.float32)
+    b = rs.randn(3, 4).astype(np.float32)
+
+    def build(v):
+        x, y = v["a"], v["b"]
+        eq = sym.broadcast_equal(x, y)
+        gt = sym.broadcast_greater(x, y)
+        ge = sym.broadcast_greater_equal(x, y)
+        le = sym.broadcast_lesser_equal(x, y)
+        land = sym.logical_and(gt, ge)
+        lnot = sym.logical_not(eq)
+        return gt + ge + le + land + lnot
+
+    _roundtrip_eval(build, {"a": a, "b": b})
+
+
+def test_onnx_breadth_arg_and_norm_roundtrip():
+    from mxnet_tpu import sym
+    a = np.random.RandomState(2).randn(3, 6).astype(np.float32)
+
+    def build(v):
+        x = v["a"]
+        am = sym.argmax(x, axis=1)
+        an = sym.argmin(x, axis=0)
+        n2 = sym.norm(x, ord=2, axis=1)
+        n1 = sym.norm(x, ord=1, axis=0, keepdims=True)
+        return sym.sum(am) + sym.sum(an) + sym.sum(n2) + sym.sum(n1)
+
+    _roundtrip_eval(build, {"a": a}, rtol=1e-4)
+
+
+def test_onnx_breadth_stack_take_mod_roundtrip():
+    from mxnet_tpu import sym
+    rs = np.random.RandomState(3)
+    a = rs.randn(4, 3).astype(np.float32)
+    b = rs.uniform(1.0, 2.0, (4, 3)).astype(np.float32)
+
+    def build(v):
+        x, y = v["a"], v["b"]
+        st = sym.stack(x, y, axis=1)            # (4, 2, 3)
+        md = sym.mod(x, y)
+        lg = sym.log1p(sym.abs(x)) + sym.expm1(sym.clip(x, a_min=-1.0, a_max=1.0))
+        rs_ = sym.rsqrt(y)
+        return sym.sum(st) + sym.sum(md) + sym.sum(lg) + sym.sum(rs_)
+
+    _roundtrip_eval(build, {"a": a, "b": b}, rtol=1e-4)
+
+
+def test_onnx_breadth_lrn_instancenorm_l2norm_roundtrip():
+    from mxnet_tpu import sym
+    x = np.random.RandomState(4).randn(2, 6, 5, 5).astype(np.float32)
+
+    def build(v):
+        d = v["a"]
+        ln = sym.LRN(d, nsize=3, alpha=1e-4, beta=0.75, knorm=2.0)
+        l2 = sym.L2Normalization(d, mode="channel")
+        return sym.sum(ln) + sym.sum(l2)
+
+    _roundtrip_eval(build, {"a": x}, rtol=1e-4)
+
+
+def test_onnx_mod_floor_semantics_negative_dividend():
+    """Framework mod is floor modulo; the export decomposition and fmod-aware
+    importer must preserve it for negative dividends."""
+    from mxnet_tpu import sym
+    a = np.array([[-3.0, 3.0, -7.5]], np.float32)
+    b = np.array([[2.0, -2.0, 2.0]], np.float32)
+
+    def build(v):
+        return sym.mod(v["a"], v["b"])
+
+    _roundtrip_eval(build, {"a": a, "b": b})
+    # oracle check: jnp.mod(-3, 2) == 1 (sign of divisor)
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    got = nd.mod(nd.array(a), nd.array(b)).asnumpy()
+    np.testing.assert_allclose(got, np.mod(a, b), rtol=1e-6)
+
+
+def test_onnx_take_clip_mode_roundtrip():
+    """take(mode='clip') export must clamp out-of-range indices like MXNet."""
+    from mxnet_tpu import sym
+    a = np.arange(12, dtype=np.float32).reshape(4, 3)
+    idx = np.array([0.0, 3.0, 9.0], np.float32)  # 9 is out of range -> clamp
+
+    def build(v):
+        return sym.take(v["a"], v["b"], axis=0, mode="clip")
+
+    _roundtrip_eval(build, {"a": a, "b": idx})
+
+
+def test_symbol_single_output_overindex_is_loud():
+    import pytest as _pytest
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, sym
+
+    p = sym.contrib.Proposal(sym.Variable("cp"), sym.Variable("bp"),
+                             sym.Variable("ii"), scales=(8,), ratios=(1.0,),
+                             rpn_pre_nms_top_n=4, rpn_post_nms_top_n=2,
+                             rpn_min_size=1)  # output_score=False -> 1 output
+    feeds = {"cp": nd.array(np.random.rand(1, 2, 2, 2).astype(np.float32)),
+             "bp": nd.zeros((1, 4, 2, 2)),
+             "ii": nd.array([[32, 32, 1.0]])}
+    rois = p.bind(mx.cpu(), dict(feeds)).forward()
+    first = rois[0] if isinstance(rois, (list, tuple)) else rois
+    assert first.shape == (2, 5)
+    with _pytest.raises(ValueError, match="single output"):
+        p[1].bind(mx.cpu(), dict(feeds)).forward()

@@ -217,6 +217,59 @@ def test_block_loss_fn_compiled_dp():
     assert losses[-1] < losses[0]
 
 
+def test_sync_batchnorm_global_stats_under_dp():
+    """SyncBatchNorm's claim (contrib/nn.py): under a dp-sharded jit the SPMD
+    partitioner computes batch statistics over the FULL global batch. Give
+    each of the 8 shards a different distribution and check the normalized
+    output matches the global-batch oracle, NOT per-shard normalization."""
+    from jax.sharding import NamedSharding
+    from mxnet_tpu import _trace
+    from mxnet_tpu.gluon.contrib.nn import SyncBatchNorm
+
+    bn = SyncBatchNorm(in_channels=4)
+    bn.initialize()
+    plist = list(bn.collect_params().values())
+
+    # shard i drawn around mean 2*i: per-shard mean differs wildly from global
+    rng = np.random.default_rng(0)
+    x = np.concatenate([
+        rng.normal(loc=2.0 * i, scale=0.5, size=(2, 4)).astype(np.float32)
+        for i in range(8)], axis=0)  # (16, 4)
+
+    def fwd(param_arrays, xb):
+        with _trace.trace_scope(jax.random.PRNGKey(0), True) as t:
+            t.param_store = {id(p): a for p, a in zip(plist, param_arrays)}
+            out = bn._call_traced(xb)
+            upd = {i: t.state_updates.get(id(p)) for i, p in enumerate(plist)}
+        return out, upd
+
+    mesh = parallel.make_mesh({"dp": 8})
+    xs = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P("dp")))
+    params = [p.data()._data for p in plist]
+    out, upd = jax.jit(fwd, in_shardings=(None, NamedSharding(mesh, P("dp"))),
+                       )(params, xs)
+    out = np.asarray(out)
+
+    gm = x.mean(axis=0)
+    gv = x.var(axis=0)
+    want_global = (x - gm) / np.sqrt(gv + 1e-5)
+    np.testing.assert_allclose(out, want_global, rtol=2e-3, atol=2e-3)
+
+    # per-shard normalization would differ enormously (shard means span 0..14)
+    shard0 = x[:2]
+    per_shard = (shard0 - shard0.mean(0)) / np.sqrt(shard0.var(0) + 1e-5)
+    assert np.abs(out[:2] - per_shard).max() > 1.0
+
+    # running-mean update reflects the GLOBAL batch mean
+    momentum = 0.9
+    names = [p.name for p in plist]
+    mean_upd = [np.asarray(v) for i, v in sorted(upd.items())
+                if v is not None and "running_mean" in names[i]]
+    assert mean_upd, "BatchNorm recorded no running_mean update"
+    np.testing.assert_allclose(mean_upd[0], (1 - momentum) * gm, rtol=2e-3,
+                               atol=2e-3)
+
+
 def test_ulysses_attention_matches_full():
     """All-to-all (Ulysses) sequence parallelism: forward + grads exactly
     match dense attention under a position-sensitive loss (a permutation of

@@ -675,11 +675,15 @@ def LayerNorm(x, gamma, beta, *, axis=-1, eps=1e-5):
             return y.reshape(lead + (x.shape[-1],))
         except Exception:
             pass
+    # fp32 stats with ONE cast boundary back to x.dtype (same recipe as
+    # BatchNorm above): `y.astype * gamma` would re-promote bf16 activations
+    # to f32 through the affine and poison every downstream matmul
     xf = x.astype(jnp.float32)
     m = jnp.mean(xf, axis=axis, keepdims=True)
     v = jnp.var(xf, axis=axis, keepdims=True)
-    y = (xf - m) * lax.rsqrt(v + eps)
-    return (y.astype(x.dtype)) * gamma + beta
+    y = ((xf - m) * lax.rsqrt(v + eps) * gamma.astype(jnp.float32)
+         + beta.astype(jnp.float32))
+    return y.astype(x.dtype)
 
 
 @register_op("InstanceNorm")

@@ -31,10 +31,12 @@ def _scores(q_ref, k_ref, q_idx, kv_idx, *, scale, causal, bq, bk, vl=None):
     kernels, so their numerics can never desynchronize. ``vl`` is a traced
     per-example valid K length: columns >= vl are masked (BERT-style prefix
     padding)."""
-    q = q_ref[0].astype(jnp.float32) * scale  # (bq, d)
-    k = k_ref[0].astype(jnp.float32)          # (bk, d)
+    # native-dtype (bf16) MXU operands with fp32 accumulation; scale applied
+    # to the fp32 scores so no extra bf16 rounding hits the matmul inputs
+    q = q_ref[0]                              # (bq, d)
+    k = k_ref[0]                              # (bk, d)
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)  # (bq, bk)
+                            preferred_element_type=jnp.float32) * scale
     if causal:
         rows = q_idx * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         cols = kv_idx * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -77,18 +79,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, bq, bk,
 
     @pl.when(run)
     def _compute():
-        v = v_ref[0].astype(jnp.float32)          # (bk, d)
+        v = v_ref[0]                            # (bk, d) native dtype
         s = _scores(q_ref, k_ref, q_idx, kv_idx, scale=scale, causal=causal,
                     bq=bq, bk=bk, vl=vl)
         m_prev = m_ref[:]                       # (bq, 128) broadcast lanes
         m_cur = jnp.max(s, axis=1, keepdims=True)  # (bq, 1)
         m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
         corr = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new[:, :1])           # (bq, bk)
+        p = jnp.exp(s - m_new[:, :1])           # (bq, bk) fp32
         l_ref[:] = l_ref[:] * corr + jnp.broadcast_to(
             jnp.sum(p, axis=1, keepdims=True), m_prev.shape)
+        # p downcast to the operand dtype for the MXU; accumulator stays fp32
         acc_ref[:] = acc_ref[:] * corr[:, :1] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         m_ref[:] = m_new
 
     @pl.when(kv_idx == pl.num_programs(2) - 1)
@@ -180,17 +184,18 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
     @pl.when(run)
     def _compute():
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         s = _scores(q_ref, k_ref, q_idx, kv_idx, scale=scale, causal=causal,
                     bq=bq, bk=bk, vl=vl)
         p = jnp.exp(s - lse_ref[0][:, :1])                       # (bq, bk)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0][:, :1])
+        # one fp32→native downcast of ds before the MXU matmul (FA2 recipe)
         dq_acc[:] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
 
     @pl.when(kv_idx == pl.num_programs(2) - 1)
@@ -223,20 +228,22 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         s = _scores(q_ref, k_ref, q_idx, kv_idx, scale=scale, causal=causal,
                     bq=bq, bk=bk, vl=vl)
         p = jnp.exp(s - lse_ref[0][:, :1])                       # (bq, bk)
-        # dv += p^T @ do
-        dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+        # dv += p^T @ do — p downcast to the operand dtype for the MXU
+        dv_acc[:] += jax.lax.dot_general(p.astype(do.dtype), do,
+                                         (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta_ref[0][:, :1])                      # (bq, bk)
         # dk += ds^T @ q * scale
-        dk_acc[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+        dk_acc[:] += jax.lax.dot_general(ds.astype(q.dtype), q,
+                                         (((0,), (0,)), ((), ())),
                                          preferred_element_type=jnp.float32) * scale
 
     @pl.when(q_idx == pl.num_programs(2) - 1)

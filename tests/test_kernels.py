@@ -253,3 +253,59 @@ def test_prefix_mask_routes_to_flash(monkeypatch):
     ref = A._reference_attention(q, k, v, mask)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attention_bf16_fwd_and_grads_match_oracle():
+    """The bf16 MXU path (native-dtype operands, p/ds downcasts — the AMP
+    train-step path): fwd + all three grads vs the f32 dense oracle, with
+    bf16-appropriate tolerances. f32-input tests cannot see this path
+    because its casts are no-ops there."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.pallas.flash_attention import flash_attention
+
+    rng = np.random.default_rng(7)
+    B, H, T, D = 2, 2, 256, 64
+    q = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.bfloat16)
+    k = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.bfloat16)
+    v = jnp.asarray(rng.normal(size=(B, H, T, D)), jnp.bfloat16)
+    vl = jnp.asarray([192, 256], jnp.float32)
+
+    def oracle(q, k, v, causal, vl_):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32) / np.sqrt(D),
+                       k.astype(jnp.float32))
+        if causal:
+            cm = jnp.arange(T)[:, None] >= jnp.arange(T)[None, :]
+            s = jnp.where(cm[None, None], s, -1e30)
+        if vl_ is not None:
+            km = jnp.arange(T)[None, None, None, :] < vl_[:, None, None, None]
+            s = jnp.where(km, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+
+    for causal, kv in ((False, None), (True, None), (False, vl), (True, vl)):
+        got = flash_attention(q, k, v, causal=causal, block_q=128,
+                              block_k=128, interpret=True, kv_valid_len=kv)
+        assert got.dtype == jnp.bfloat16
+        want = oracle(q, k, v, causal, kv)
+        err = float(jnp.max(jnp.abs(got.astype(jnp.float32) - want)))
+        assert err < 0.05, (causal, kv is not None, err)
+
+        def f(args, causal=causal, kv=kv):
+            return (flash_attention(*args, causal=causal, block_q=128,
+                                    block_k=128, interpret=True,
+                                    kv_valid_len=kv)
+                    .astype(jnp.float32) ** 2).sum()
+
+        def g(args, causal=causal, kv=kv):
+            return (oracle(*args, causal, kv) ** 2).sum()
+
+        gn = jax.grad(f)((q, k, v))
+        go = jax.grad(g)((q, k, v))
+        for a, b, nm in zip(gn, go, "qkv"):
+            assert a.dtype == jnp.bfloat16, nm
+            rel = float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                        - b.astype(jnp.float32)))
+                        / (float(jnp.max(jnp.abs(b))) + 1e-9))
+            assert rel < 0.08, (nm, causal, kv is not None, rel)

@@ -34,6 +34,19 @@ sys.exit(0 if r.get('measured_at', '') >= '$LOOP_START' else 1)" 2>/dev/null; th
       timeout 3600 python tools/flash_sweep.py --seq 512 1024 2048 \
         --json tools/flash_sweep_r3.json \
         || echo "[loop] sweep failed (rerun manually)"
+      echo "[loop] $(date -u +%T) sweep done; hardware pallas tests"
+      timeout 1800 python -m pytest \
+        tests/test_pallas_tpu.py -q -p no:cacheprovider \
+        > /tmp/pallas_hw_tests.log 2>&1
+      rc=$?
+      # the tests self-skip when their 90s TPU probe fails — an all-skipped
+      # run exits 0 but proves nothing; require actual 'passed' in the log
+      if [ $rc -eq 0 ] && grep -q " passed" /tmp/pallas_hw_tests.log \
+         && ! grep -q "no tests ran" /tmp/pallas_hw_tests.log; then
+        echo "[loop] pallas hw tests PASSED: $(tail -1 /tmp/pallas_hw_tests.log)"
+      else
+        echo "[loop] pallas hw tests NOT green (rc=$rc): $(tail -1 /tmp/pallas_hw_tests.log)"
+      fi
       echo "[loop] $(date -u +%T) sequence complete"
       exit 0
     fi

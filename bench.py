@@ -331,9 +331,12 @@ def make_nmt_batch(rng, batch=NMT_BATCH, src_len=NMT_SRC_LEN,
 
 # mode -> (build_fn(smoke) -> (step, params, states, batch, units_per_step,
 #          metric, unit, baseline, mfu_fn or None))
-def _mode_spec(mode, rng, smoke=False):
+def _mode_spec(mode, rng, smoke=False, batch_override=None):
+    def _b(default):
+        return batch_override or (default)
+
     if mode == "bert":
-        b = 4 if smoke else BATCH
+        b = _b(4 if smoke else BATCH)
         step, params, states = build()
         return (step, params, states, make_batch(rng, b), b,
                 "bert_base_pretrain_samples_per_sec_per_chip", "samples/s",
@@ -341,7 +344,7 @@ def _mode_spec(mode, rng, smoke=False):
                 lambda v: v * _bert_train_flops_per_sample(SEQ, MASKED)
                 / V5E_PEAK_BF16_FLOPS)
     if mode == "bert512":
-        b = 2 if smoke else BERT512_BATCH
+        b = _b(2 if smoke else BERT512_BATCH)
         step, params, states = build(seq=BERT512_SEQ)
         return (step, params, states,
                 make_batch(rng, b, BERT512_SEQ, BERT512_MASKED), b,
@@ -351,25 +354,25 @@ def _mode_spec(mode, rng, smoke=False):
                                                            BERT512_MASKED)
                 / V5E_PEAK_BF16_FLOPS)
     if mode == "resnet50":
-        b = 2 if smoke else RESNET_BATCH
+        b = _b(2 if smoke else RESNET_BATCH)
         step, params, states = build_resnet()
         return (step, params, states, make_resnet_batch(rng, b), b,
                 "resnet50_train_images_per_sec_per_chip", "images/s",
                 RESNET_BASELINE_IMG_PER_SEC, None)
     if mode == "lstm":
-        b = 4 if smoke else LSTM_BATCH
+        b = _b(4 if smoke else LSTM_BATCH)
         step, params, states = build_lstm()
         return (step, params, states, make_lstm_batch(rng, b), b * LSTM_BPTT,
                 "lstm_ptb_train_tokens_per_sec_per_chip", "tokens/s",
                 LSTM_BASELINE_TOK_PER_SEC, None)
     if mode == "ssd512":
-        b = 1 if smoke else SSD_BATCH
+        b = _b(1 if smoke else SSD_BATCH)
         step, params, states = build_ssd()
         return (step, params, states, make_ssd_batch(rng, b), b,
                 "ssd512_vgg16_train_images_per_sec_per_chip", "images/s",
                 SSD_BASELINE_IMG_PER_SEC, None)
     if mode == "nmt":
-        b = 2 if smoke else NMT_BATCH
+        b = _b(2 if smoke else NMT_BATCH)
         src_len = 16 if smoke else NMT_SRC_LEN
         tgt_len = 16 if smoke else NMT_TGT_LEN
         step, params, states = build_nmt()
@@ -440,11 +443,12 @@ def probe_backend(budget_s, probe_timeout=120):
         sleep_s = min(int(sleep_s * 1.5), 300)
 
 
-def run_mode(mode, results, smoke=False, iters=None, headline=False):
+def run_mode(mode, results, smoke=False, iters=None, headline=False,
+             batch_override=None):
     rng = np.random.default_rng(0)
     _log("building model + train step (%s)..." % mode)
     (step, params, states, batch, units, metric, unit, baseline,
-     mfu_fn) = _mode_spec(mode, rng, smoke)
+     mfu_fn) = _mode_spec(mode, rng, smoke, batch_override)
     key = jax.random.PRNGKey(0)
 
     # warmup / compile. NOTE: under the axon relay block_until_ready can
@@ -474,11 +478,12 @@ def run_mode(mode, results, smoke=False, iters=None, headline=False):
         "vs_baseline": round(per_sec / baseline, 4),
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "iters": iters,
+        "batch": (batch_override or "default"),
         "platform": jax.devices()[0].platform,
     }
     if mfu_fn is not None:
         rec["mfu"] = round(mfu_fn(per_sec), 4)
-    if not smoke and rec["platform"] not in ("cpu",):
+    if not smoke and batch_override is None and rec["platform"] not in ("cpu",):
         _save_result(mode, rec)
         results[mode] = rec
     out = dict(rec)
@@ -495,9 +500,16 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     mode = args[0] if args else "bert"
     iters = None
+    batch_override = None
     for f in flags:
         if f.startswith("--iters="):
             iters = int(f.split("=", 1)[1])
+        if f.startswith("--batch="):
+            # exploratory batch sweeps; results are NOT persisted (replay
+            # must reflect the BASELINE.md configs)
+            batch_override = int(f.split("=", 1)[1])
+            if batch_override < 1:
+                raise SystemExit("--batch must be >= 1")
 
     results = _load_results()
 
@@ -542,7 +554,8 @@ def main():
         for m in [m for m in MODES if m != "bert"] + ["bert"]:
             try:
                 run_mode(m, results, smoke=smoke, iters=iters,
-                         headline=(m == "bert"))
+                         headline=(m == "bert"),
+                         batch_override=batch_override)
             except Exception as e:
                 _log("mode %s FAILED: %r — continuing with remaining modes"
                      % (m, e))
@@ -550,7 +563,8 @@ def main():
         if failed:
             raise SystemExit("modes failed: %s" % ",".join(failed))
     else:
-        run_mode(mode, results, smoke=smoke, iters=iters, headline=(mode == "bert"))
+        run_mode(mode, results, smoke=smoke, iters=iters,
+                 headline=(mode == "bert"), batch_override=batch_override)
 
 
 if __name__ == "__main__":

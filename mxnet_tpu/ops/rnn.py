@@ -26,9 +26,9 @@ def _lstm_step(h, c, xw, whh, bhh):
     f = jax.nn.sigmoid(f)
     gg = jnp.tanh(gg)
     o = jax.nn.sigmoid(o)
-    c = f * c + i * gg
-    h = o * jnp.tanh(c)
-    return h, c
+    c = f * c + i * gg          # cell state keeps ITS OWN dtype (callers may
+    h = o * jnp.tanh(c)         # deliberately carry c in f32 under AMP)
+    return h.astype(xw.dtype), c
 
 
 def _gru_step(h, xw, whh, bhh):
@@ -90,6 +90,13 @@ def RNN(x, state_h, state_c, *weights, mode="lstm", num_layers=1,
     weights: per (layer, direction): i2h_w, h2h_w, i2h_b, h2h_b.
     Returns (out (T, N, H*D), new_h, new_c)."""
     D = 2 if bidirectional else 1
+    # h (the matmul operand) follows the input dtype: f32 default initial
+    # states would otherwise promote every recurrent h@Whh matmul (and with
+    # it the whole scan body) to f32 under AMP — measured as 12 of the
+    # LSTM-PTB step's 15 dots before this cast. c is NOT cast: the cell
+    # state only flows through elementwise VPU math, so a caller-provided
+    # f32 c keeps full-precision accumulation across the sequence.
+    state_h = state_h.astype(x.dtype)
     out = x
     hs, cs = [], []
     wi = 0

@@ -24,15 +24,19 @@ class SyncBatchNorm(BatchNorm):
 
 
 class SparseEmbedding(HybridBlock):
-    """row_sparse-gradient embedding; dense on TPU (see mxnet_tpu/sparse.py
-    design note), API parity only."""
+    """row_sparse-gradient embedding (ref: contrib/nn:SparseEmbedding):
+    the weight's gradient is carried as (indices, values) rows and applied
+    through the optimizer's lazy row-sparse update — only touched rows are
+    read/written (mxnet_tpu/sparse.py; Trainer routes grad_stype
+    'row_sparse' at trainer.py:101)."""
 
     def __init__(self, input_dim, output_dim, dtype="float32", **kwargs):
         super().__init__(**kwargs)
         from ..nn import Embedding
 
         with self.name_scope():
-            self.embed = Embedding(input_dim, output_dim, dtype=dtype)
+            self.embed = Embedding(input_dim, output_dim, dtype=dtype,
+                                   sparse_grad=True)
 
     def hybrid_forward(self, F, x):
         return self.embed(x)

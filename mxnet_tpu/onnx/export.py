@@ -992,6 +992,108 @@ def _foreach_conv(ctx, s, ins, out):
     return out
 
 
+@register_converter("_while")
+def _while_conv(ctx, s, ins, out):
+    """symbol while_loop → ONNX Loop. Body formals are [iter, cond_in,
+    vars...]; the body emits [cond_out, new_vars..., per-step output], with
+    cond_out re-evaluating the predicate on the NEW vars (ONNX's cond is
+    produced by the body for the next iteration, where our masked scan
+    evaluates it before each step — same executed-iteration set). The
+    initial cond is the predicate over the initial values, emitted in the
+    outer graph. Static-shape deviation: our executor always stacks
+    max_iterations rows (masked steps emit zeros); a spec runtime stacks
+    only executed rows.
+    """
+    a = s._attrs
+    n_vars = a["n_vars"]
+    var_names = list(a["var_names"])
+    pred_sym = a["pred_sym"]
+    roots = [pred_sym, a["out_sym"]] + list(a["var_syms"])
+
+    var_syms = {}
+    for root in roots:
+        for arg in root._arg_symbols():
+            if arg.name in var_names:
+                var_syms[arg.name] = arg
+
+    def _convert_scoped(root_list, bindings):
+        """Convert symbols with loop-var ids bound to given value names;
+        conversion cache starts from the OUTER names only, so the same
+        subgraph can be re-emitted against different bindings."""
+        ctx.names = dict(outer_names)
+        ctx.multi = dict(outer_multi)
+        for nm, val in bindings.items():
+            if nm in var_syms:
+                ctx.names[id(var_syms[nm])] = val
+        for node_ in _toposort(root_list):
+            if node_.is_var():
+                if id(node_) not in ctx.names:
+                    raise ValueError("Loop export: body var %r not in outer "
+                                     "scope" % node_.name)
+                continue
+            if id(node_) in ctx.names:
+                continue
+            _convert_node(ctx, node_)
+        return [ctx.names[id(r)] for r in root_list]
+
+    outer_names = dict(ctx.names)
+    outer_multi = dict(ctx.multi)
+
+    # initial condition: predicate over the Loop node's initial values,
+    # evaluated in the OUTER graph
+    (cond0,) = _convert_scoped([pred_sym],
+                               dict(zip(var_names, ins[:n_vars])))
+
+    iter_nm = ctx.fresh("wl_iter")
+    cond_in = ctx.fresh("wl_cond_in")
+    saved_nodes = ctx.nodes
+    ctx.nodes = []
+    try:
+        # pass 1: body exprs (out + new vars) on the formal var inputs
+        body_roots = [a["out_sym"]] + list(a["var_syms"])
+        body_outs = _convert_scoped(body_roots,
+                                    dict(zip(var_names, var_names)))
+        step_out, new_var_names = body_outs[0], body_outs[1:]
+        # pass 2: predicate on the NEW var values (fresh scope: shared
+        # subexpressions re-emit rather than alias stale bindings)
+        (cond_out,) = _convert_scoped([pred_sym],
+                                      dict(zip(var_names, new_var_names)))
+        cond_out_b = ctx.fresh("wl_cond_out")
+        ctx.emit("Cast", [cond_out], [cond_out_b],
+                 attrs={"to": int(P.BOOL)})
+        cond_out = cond_out_b
+
+        input_vis = ([P.value_info(iter_nm, np.int64, ()),
+                      P.value_info(cond_in, np.bool_, ())]
+                     + [P.value_info(nm, np.float32, ()) for nm in var_names])
+        out_names, used = [], set()
+        for nm in [cond_out] + new_var_names + [step_out]:
+            if nm in used:
+                alias = ctx.fresh("%s_alias" % nm)
+                ctx.emit("Identity", [nm], [alias])
+                nm = alias
+            used.add(nm)
+            out_names.append(nm)
+        out_vis = [P.value_info(nm, np.float32, ()) for nm in out_names]
+        body = P.GraphAttr(P.graph_proto("%s_body" % s.name, ctx.nodes,
+                                         input_vis, out_vis, []))
+    finally:
+        ctx.nodes = saved_nodes
+        ctx.names = dict(outer_names)
+        ctx.multi = dict(outer_multi)
+
+    m_name = ctx.const("wl_m", np.asarray(a["max_iterations"], np.int64))
+    cond0b = ctx.fresh("wl_cond0")
+    ctx.emit("Cast", [cond0], [cond0b], attrs={"to": int(P.BOOL)})
+    final_vars = [ctx.fresh("wl_final%d" % i) for i in range(n_vars)]
+    ctx.emit("Loop", [m_name, cond0b] + list(ins[:n_vars]),
+             final_vars + [out], attrs={"body": body})
+    # our _item order is [stacked_outputs, final_vars...]
+    ctx.multi[id(s)] = [out] + final_vars
+    ctx.names[id(s)] = out
+    return out
+
+
 # ------------------------------------------------------------- graph walker
 
 def _convert_node(ctx, s):

@@ -528,3 +528,47 @@ def test_symbol_single_output_overindex_is_loud():
     assert first.shape == (2, 5)
     with _pytest.raises(ValueError, match="single output"):
         p[1].bind(mx.cpu(), dict(feeds)).forward()
+
+
+def test_onnx_loop_roundtrip_while_loop(tmp_path):
+    """while_loop ↔ ONNX Loop: the exported Loop (body re-evaluates the
+    predicate on the NEW vars; initial cond emitted in the outer graph)
+    re-imports through the Loop importer and matches the original masked-
+    scan execution exactly, including a free outer weight."""
+    import numpy as np
+
+    from mxnet_tpu import nd, sym
+    from mxnet_tpu.onnx import proto as P
+    from mxnet_tpu.onnx.export import symbol_to_onnx
+    from mxnet_tpu.onnx.import_model import import_model
+
+    x0 = sym.var("x0", shape=(3,))
+    w = sym.var("w", shape=(3,))
+
+    def cond(v):
+        return sym.broadcast_lesser(sym.sum(v), sym.full(shape=(), val=40.0))
+
+    def body(v):
+        nv = v * 2.0 + w
+        return nv, nv
+
+    outs, fin = sym.contrib.while_loop(cond, body, x0, max_iterations=6)
+    g = sym.Group([outs, fin])
+
+    xv = np.array([1.0, 2.0, 3.0], np.float32)
+    wv = np.full(3, 0.5, np.float32)
+    ref = g.eval(x0=nd.array(xv), w=nd.array(wv))
+    ref_outs, ref_fin = ref[0].asnumpy(), ref[1].asnumpy()
+
+    blob = symbol_to_onnx(g, params={"w": wv}, input_shapes={"x0": (3,)})
+    P.check_model(blob)
+    path = str(tmp_path / "loop_rt.onnx")
+    open(path, "wb").write(blob)
+    s2, args, _ = import_model(path)
+    feeds = {"x0": nd.array(xv)}
+    feeds.update(args)
+    got = [o.asnumpy() for o in s2.eval(**feeds)]
+    # graph outputs follow the exported Group order [stacked, final_var];
+    # assert positionally so an importer output permutation cannot pass
+    np.testing.assert_allclose(got[0], ref_outs, rtol=1e-5)
+    np.testing.assert_allclose(got[1], ref_fin, rtol=1e-5)

@@ -162,3 +162,26 @@ def test_embedding_sparse_grad_end_to_end():
     assert moved == [1, 3, 7]
     untouched = [r for r in range(20) if r not in (1, 3, 7)]
     np.testing.assert_array_equal(w1[untouched], w0[untouched])
+
+
+def test_contrib_sparse_embedding_is_actually_sparse():
+    """gluon.contrib.nn.SparseEmbedding must carry row_sparse gradients and
+    take the lazy-update path, not silently alias a dense Embedding
+    (VERDICT r2 weak #6)."""
+    from mxnet_tpu.gluon.contrib.nn import SparseEmbedding
+
+    se = SparseEmbedding(16, 4)
+    se.initialize()
+    (p,) = se.collect_params().values()
+    assert p._grad_stype == "row_sparse"
+    trainer = gluon.Trainer(se.collect_params(), "sgd",
+                            {"learning_rate": 1.0, "momentum": 0.0})
+    w0 = p.data().asnumpy().copy()
+    x = nd.array(np.array([[2, 5]], dtype=np.int64))
+    with autograd.record():
+        loss = (se(x) ** 2).sum()
+    loss.backward()
+    trainer.step(1)
+    w1 = p.data().asnumpy()
+    moved = sorted(set(np.nonzero(np.abs(w1 - w0).sum(axis=1) > 1e-9)[0].tolist()))
+    assert moved == [2, 5]

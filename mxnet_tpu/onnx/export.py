@@ -458,7 +458,10 @@ def _take_conv(ctx, s, ins, out):
         # ONNX Gather is out-of-bounds-undefined; reproduce MXNet's clamp
         # with Clip(idx, 0, dim-1). Static dim when the traced shape is
         # known (the usual export path), Shape-at-runtime otherwise.
-        data_shape = getattr(s._inputs[0], "_shape", None)
+        try:
+            data_shape = s._inputs[0].shape
+        except ValueError:
+            data_shape = None
         zero = ctx.const("zero", np.asarray(0, np.int64))
         if data_shape is not None:
             hi = ctx.const("hi", np.asarray(data_shape[axis] - 1, np.int64))
@@ -547,9 +550,9 @@ def _s2d_conv(ctx, s, ins, out):
 def _gather_nd_export(ctx, s, ins, out):
     # MXNet gather_nd leads with the index-tuple axis; ONNX GatherND wants
     # indices (..., index_depth) — move the leading axis to the back
-    idx_sym = s._inputs[1]
-    rank = len(idx_sym._shape) if getattr(idx_sym, "_shape", None) else None
-    if rank is None:
+    try:
+        rank = len(s._inputs[1].shape)
+    except ValueError:
         raise ValueError("gather_nd export needs a known indices rank for "
                          "the layout transpose")
     idx = ctx.fresh("idx")
@@ -626,6 +629,222 @@ def _cast_conv(ctx, s, ins, out):
     from ..base import resolve_dtype
     code = P.np_to_onnx_dtype(np.dtype(resolve_dtype(s._attrs["dtype"])))
     ctx.emit("Cast", ins[:1], [out], attrs={"to": int(code)})
+
+
+# ---- flat legacy aliases: same semantics as an already-registered converter
+def _alias_conv(target):
+    def conv(ctx, s, ins, out):
+        return _CONVERTERS[target](ctx, s, ins, out)
+    return conv
+
+
+for _legacy, _target in [("Cast", "cast"), ("Concat", "concat"),
+                         ("Flatten", "flatten"), ("Reshape", "reshape"),
+                         ("elemwise_add", "add"), ("elemwise_sub", "subtract"),
+                         ("elemwise_mul", "multiply"),
+                         ("elemwise_div", "divide"),
+                         ("broadcast_mod", "mod")]:
+    register_converter(_legacy)(_alias_conv(_target))
+
+
+def _identity_conv(ctx, s, ins, out):
+    ctx.emit("Identity", ins[:1], [out])
+
+
+# BlockGrad/stop_gradient is Identity at inference (ONNX has no grad graph)
+for _nm in ("identity", "BlockGrad", "stop_gradient"):
+    register_converter(_nm)(_identity_conv)
+
+
+@register_converter("ElementWiseSum")
+def _ews_conv(ctx, s, ins, out):
+    ctx.emit("Sum", ins, [out])
+
+
+register_converter("add_n")(_ews_conv)
+
+
+@register_converter("SwapAxis")
+def _swapaxis_conv(ctx, s, ins, out):
+    try:
+        shape = s._inputs[0].shape  # lazy jax.eval_shape through the graph
+    except ValueError:
+        raise ValueError("SwapAxis export needs a known input rank")
+    perm = list(range(len(shape)))
+    d1 = int(s._attrs.get("dim1", 0))
+    d2 = int(s._attrs.get("dim2", 0))
+    perm[d1], perm[d2] = perm[d2], perm[d1]
+    ctx.emit("Transpose", ins[:1], [out], attrs={"perm": perm})
+
+
+@register_converter("SoftmaxActivation")
+def _softmax_act_conv(ctx, s, ins, out):
+    if s._attrs.get("mode", "instance") != "instance":
+        raise ValueError("SoftmaxActivation export: channel mode unsupported")
+    # instance mode normalizes over ALL trailing dims per sample
+    # (ops/extra.py:SoftmaxActivation flattens) — rank > 2 needs the
+    # flatten/softmax/restore decomposition
+    shape = s._inputs[0].shape
+    if len(shape) <= 2:
+        ctx.emit("Softmax", ins[:1], [out], attrs={"axis": -1})
+        return
+    flat = ctx.fresh("sa_flat")
+    ctx.emit("Reshape", [ins[0], ctx.const(
+        "fshape", np.asarray([shape[0], -1], np.int64))], [flat])
+    sm = ctx.fresh("sa_softmax")
+    ctx.emit("Softmax", [flat], [sm], attrs={"axis": -1})
+    ctx.emit("Reshape", [sm, ctx.const(
+        "rshape", np.asarray(shape, np.int64))], [out])
+
+
+@register_converter("hypot")
+def _hypot_conv(ctx, s, ins, out):
+    sq = []
+    for i in ins[:2]:
+        m = ctx.fresh("sq")
+        ctx.emit("Mul", [i, i], [m])
+        sq.append(m)
+    ssum = ctx.fresh("ssum")
+    ctx.emit("Add", sq, [ssum])
+    ctx.emit("Sqrt", [ssum], [out])
+
+
+register_converter("broadcast_hypot")(_CONVERTERS["hypot"])
+
+
+@register_converter("mish")
+def _mish_conv(ctx, s, ins, out):
+    sp = ctx.fresh("softplus")
+    ctx.emit("Softplus", ins[:1], [sp])
+    th = ctx.fresh("tanh")
+    ctx.emit("Tanh", [sp], [th])
+    ctx.emit("Mul", [ins[0], th], [out])
+
+
+@register_converter("log_sigmoid")
+def _log_sigmoid_conv(ctx, s, ins, out):
+    # log(sigmoid(x)) = -softplus(-x)
+    ng = ctx.fresh("neg")
+    ctx.emit("Neg", ins[:1], [ng])
+    sp = ctx.fresh("softplus")
+    ctx.emit("Softplus", [ng], [sp])
+    ctx.emit("Neg", [sp], [out])
+
+
+def _float_unop_via(onnx_pred):
+    """IsNaN/IsInf return bool; MXNet isnan/isinf return float 0/1."""
+    def conv(ctx, s, ins, out):
+        b = ctx.fresh("pred")
+        ctx.emit(onnx_pred, ins[:1], [b])
+        ctx.emit("Cast", [b], [out], attrs={"to": int(P.FLOAT)})
+    return conv
+
+
+register_converter("isnan")(_float_unop_via("IsNaN"))
+register_converter("isinf")(_float_unop_via("IsInf"))
+
+
+@register_converter("isfinite")
+def _isfinite_conv(ctx, s, ins, out):
+    nn = ctx.fresh("isnan")
+    ctx.emit("IsNaN", ins[:1], [nn])
+    ii = ctx.fresh("isinf")
+    ctx.emit("IsInf", ins[:1], [ii])
+    either = ctx.fresh("or")
+    ctx.emit("Or", [nn, ii], [either])
+    nb = ctx.fresh("not")
+    ctx.emit("Not", [either], [nb])
+    ctx.emit("Cast", [nb], [out], attrs={"to": int(P.FLOAT)})
+
+
+def _scale_by(factor, hint):
+    def conv(ctx, s, ins, out):
+        f = ctx.const(hint, np.float32(factor))
+        ctx.emit("Mul", [ins[0], f], [out])
+    return conv
+
+
+def _scaled_log(base, hint):
+    def conv(ctx, s, ins, out):
+        ln = ctx.fresh("ln")
+        ctx.emit("Log", ins[:1], [ln])
+        _scale_by(1.0 / np.log(base), hint)(ctx, s, [ln], out)
+    return conv
+
+
+register_converter("log2")(_scaled_log(2.0, "invln2"))
+register_converter("log10")(_scaled_log(10.0, "invln10"))
+register_converter("degrees")(_scale_by(180.0 / np.pi, "r2d"))
+register_converter("radians")(_scale_by(np.pi / 180.0, "d2r"))
+
+
+@register_converter("cbrt")
+def _cbrt_conv(ctx, s, ins, out):
+    # sign(x)·|x|^(1/3): plain Pow would NaN on negative inputs
+    sg = ctx.fresh("sign")
+    ctx.emit("Sign", ins[:1], [sg])
+    ab = ctx.fresh("abs")
+    ctx.emit("Abs", ins[:1], [ab])
+    third = ctx.const("third", np.float32(1.0 / 3.0))
+    pw = ctx.fresh("pow")
+    ctx.emit("Pow", [ab, third], [pw])
+    ctx.emit("Mul", [sg, pw], [out])
+
+
+@register_converter("trunc")
+def _trunc_conv(ctx, s, ins, out):
+    # trunc = sign(x)·floor(|x|)  (ONNX has no Trunc node)
+    sg = ctx.fresh("sign")
+    ctx.emit("Sign", ins[:1], [sg])
+    ab = ctx.fresh("abs")
+    ctx.emit("Abs", ins[:1], [ab])
+    fl = ctx.fresh("floor")
+    ctx.emit("Floor", [ab], [fl])
+    ctx.emit("Mul", [sg, fl], [out])
+
+
+register_converter("fix")(_CONVERTERS["trunc"])
+
+
+@register_converter("GroupNorm")
+def _group_norm_conv(ctx, s, ins, out):
+    """Exact decomposition over standard nodes (opset 13 has no
+    GroupNormalization): reshape to (N, G, rest), normalize over rest with
+    the op's own eps, reshape back, per-channel affine."""
+    a = s._attrs
+    G = int(a.get("num_groups", 1))
+    eps = float(a.get("eps", 1e-5))
+    shape = s._inputs[0].shape  # lazy jax.eval_shape through the graph
+    C = shape[1]
+    xg = ctx.fresh("gn_grouped")
+    ctx.emit("Reshape", [ins[0], ctx.const(
+        "gshape", np.asarray([shape[0], G, -1], np.int64))], [xg])
+    m = ctx.fresh("gn_mean")
+    ctx.emit("ReduceMean", [xg], [m], attrs={"axes": [2], "keepdims": 1})
+    d = ctx.fresh("gn_dev")
+    ctx.emit("Sub", [xg, m], [d])
+    d2 = ctx.fresh("gn_dev2")
+    ctx.emit("Mul", [d, d], [d2])
+    v = ctx.fresh("gn_var")
+    ctx.emit("ReduceMean", [d2], [v], attrs={"axes": [2], "keepdims": 1})
+    ve = ctx.fresh("gn_vareps")
+    ctx.emit("Add", [v, ctx.const("eps", np.float32(eps))], [ve])
+    sd = ctx.fresh("gn_std")
+    ctx.emit("Sqrt", [ve], [sd])
+    yn = ctx.fresh("gn_norm")
+    ctx.emit("Div", [d, sd], [yn])
+    yr = ctx.fresh("gn_back")
+    ctx.emit("Reshape", [yn, ctx.const(
+        "xshape", np.asarray(shape, np.int64))], [yr])
+    cshape = ctx.const("cshape",
+                       np.asarray([1, C] + [1] * (len(shape) - 2), np.int64))
+    gr = ctx.fresh("gn_gamma")
+    ctx.emit("Reshape", [ins[1], cshape], [gr])
+    br = ctx.fresh("gn_beta")
+    ctx.emit("Reshape", [ins[2], cshape], [br])
+    sc = ctx.fresh("gn_scaled")
+    ctx.emit("Mul", [yr, gr], [sc])
+    ctx.emit("Add", [sc, br], [out])
 
 
 @register_converter("UpSampling")

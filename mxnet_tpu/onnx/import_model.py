@@ -363,8 +363,18 @@ for _onnx, _mx in [("Exp", "exp"), ("Log", "log"), ("Sqrt", "sqrt"),
                    ("Asin", "arcsin"), ("Acos", "arccos"),
                    ("Atan", "arctan"), ("Sinh", "sinh"), ("Cosh", "cosh"),
                    ("Asinh", "arcsinh"), ("Acosh", "arccosh"),
-                   ("Atanh", "arctanh")]:
+                   ("Atanh", "arctanh"), ("IsNaN", "isnan")]:
     register_importer(_onnx)(_unop(_mx))
+
+
+@register_importer("IsInf")
+def _isinf_imp(g, node):
+    a = node["attrs"]
+    if not int(a.get("detect_negative", 1)) or \
+            not int(a.get("detect_positive", 1)):
+        raise ValueError("IsInf import: one-sided detect_negative/"
+                         "detect_positive not supported")
+    return _make("isinf", g.inp(node["inputs"][0]))
 
 
 @register_importer("Shape")

@@ -572,3 +572,51 @@ def test_onnx_loop_roundtrip_while_loop(tmp_path):
     # assert positionally so an importer output permutation cannot pass
     np.testing.assert_allclose(got[0], ref_outs, rtol=1e-5)
     np.testing.assert_allclose(got[1], ref_fin, rtol=1e-5)
+
+
+def test_onnx_breadth_legacy_and_decomposition_roundtrip():
+    """Legacy aliases (SwapAxis/ElementWiseSum/elemwise_*) and decomposition
+    exports (hypot/mish/log_sigmoid/isnan/log2/degrees/cbrt/trunc)."""
+    from mxnet_tpu import sym
+    rs = np.random.RandomState(9)
+    a = rs.randn(3, 4).astype(np.float32)
+    b = (rs.randn(3, 4) * 2).astype(np.float32)
+
+    def build(v):
+        x, y = v["a"], v["b"]
+        sw = sym.SwapAxis(x, dim1=0, dim2=1)              # (4, 3)
+        parts = [
+            sym.sum(sw),
+            sym.sum(sym.ElementWiseSum(x, y, x)),
+            sym.sum(sym.elemwise_add(x, y) - sym.elemwise_mul(x, y)),
+            sym.sum(sym.hypot(x, y)),
+            sym.sum(sym.mish(x)),
+            sym.sum(sym.log_sigmoid(x)),
+            sym.sum(sym.cast(sym.isnan(x), dtype="float32")),
+            sym.sum(sym.cast(sym.isfinite(x), dtype="float32")),
+            sym.sum(sym.log2(sym.abs(y) + 1.0)),
+            sym.sum(sym.log10(sym.abs(y) + 1.0)),
+            sym.sum(sym.degrees(x)),
+            sym.sum(sym.cbrt(x)),
+            sym.sum(sym.trunc(y)),
+            sym.sum(sym.identity(x) + sym.BlockGrad(y)),
+        ]
+        total = parts[0]
+        for p in parts[1:]:
+            total = total + p
+        return total
+
+    _roundtrip_eval(build, {"a": a, "b": b}, rtol=1e-4)
+
+
+def test_onnx_groupnorm_roundtrip():
+    from mxnet_tpu import sym
+    rs = np.random.RandomState(11)
+    x = rs.randn(2, 6, 4, 4).astype(np.float32)
+    gm = rs.rand(6).astype(np.float32) + 0.5
+    bt = rs.randn(6).astype(np.float32)
+
+    def build(v):
+        return sym.GroupNorm(v["a"], v["b"], v["c"], num_groups=3, eps=1e-5)
+
+    _roundtrip_eval(build, {"a": x, "b": gm, "c": bt}, rtol=1e-4, atol=1e-5)

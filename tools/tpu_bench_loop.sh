@@ -16,7 +16,9 @@ LOOP_START=$(date -u +%FT%TZ)
 echo "[loop] started $LOOP_START pid $$"
 while true; do
   echo "[loop] $(date -u +%T) probing relay..."
-  if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+  # -k: a wedged jax ignores SIGTERM — follow up with SIGKILL or the loop
+  # hangs forever on one probe (observed 2026-07-30 19:47Z)
+  if timeout -k 10 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "[loop] $(date -u +%T) relay up; running bench all"
     # the loop just proved the relay is up, so the inner probe can be short
     BENCH_PROBE_BUDGET_S=600 timeout 7200 python bench.py all

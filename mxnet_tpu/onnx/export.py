@@ -699,14 +699,28 @@ def _softmax_act_conv(ctx, s, ins, out):
 
 @register_converter("hypot")
 def _hypot_conv(ctx, s, ins, out):
-    sq = []
+    # overflow-safe (jnp.hypot semantics): m·sqrt(1 + (n/m)²) with
+    # m = max(|x|,|y|) — naive sqrt(x²+y²) infs above ~1.8e19 in f32
+    ab = []
     for i in ins[:2]:
-        m = ctx.fresh("sq")
-        ctx.emit("Mul", [i, i], [m])
-        sq.append(m)
-    ssum = ctx.fresh("ssum")
-    ctx.emit("Add", sq, [ssum])
-    ctx.emit("Sqrt", [ssum], [out])
+        a = ctx.fresh("abs")
+        ctx.emit("Abs", [i], [a])
+        ab.append(a)
+    mx = ctx.fresh("hmax")
+    ctx.emit("Max", ab, [mx])
+    mn = ctx.fresh("hmin")
+    ctx.emit("Min", ab, [mn])
+    denom = ctx.fresh("hden")
+    ctx.emit("Max", [mx, ctx.const("tiny", np.float32(1e-38))], [denom])
+    t = ctx.fresh("hratio")
+    ctx.emit("Div", [mn, denom], [t])
+    t2 = ctx.fresh("ht2")
+    ctx.emit("Mul", [t, t], [t2])
+    onep = ctx.fresh("h1p")
+    ctx.emit("Add", [t2, ctx.const("one", np.float32(1.0))], [onep])
+    rt = ctx.fresh("hsqrt")
+    ctx.emit("Sqrt", [onep], [rt])
+    ctx.emit("Mul", [mx, rt], [out])
 
 
 register_converter("broadcast_hypot")(_CONVERTERS["hypot"])
@@ -804,6 +818,167 @@ def _trunc_conv(ctx, s, ins, out):
 
 
 register_converter("fix")(_CONVERTERS["trunc"])
+
+
+def _seq_len_mask(ctx, s, ins, T, trailing_rank):
+    """(T, N) bool mask: position t is valid iff t < sequence_length[n],
+    unsqueezed over `trailing_rank` extra dims."""
+    rng = ctx.fresh("seq_range")
+    ctx.emit("Range", [ctx.const("r0", np.asarray(0, np.float32)),
+                       ctx.const("rT", np.asarray(T, np.float32)),
+                       ctx.const("r1", np.asarray(1, np.float32))], [rng])
+    rcol = ctx.fresh("seq_rcol")
+    ctx.emit("Unsqueeze", [rng, ctx.const("ax1", np.asarray([1], np.int64))],
+             [rcol])                                   # (T, 1)
+    cmp = ctx.fresh("seq_valid")
+    ctx.emit("Less", [rcol, ins[1]], [cmp])            # (T, N) via broadcast
+    for _ in range(trailing_rank):
+        nxt = ctx.fresh("seq_valid_u")
+        ctx.emit("Unsqueeze", [cmp, ctx.const(
+            "axm1", np.asarray([-1], np.int64))], [nxt])
+        cmp = nxt
+    return cmp
+
+
+@register_converter("SequenceMask")
+def _sequence_mask_conv(ctx, s, ins, out):
+    a = s._attrs
+    if not a.get("use_sequence_length", False):
+        ctx.emit("Identity", ins[:1], [out])
+        return
+    if int(a.get("axis", 0)) != 0:
+        raise ValueError("SequenceMask export: only axis=0 (time-major)")
+    shape = s._inputs[0].shape
+    valid = _seq_len_mask(ctx, s, ins, shape[0], len(shape) - 2)
+    val = ctx.const("maskval", np.float32(a.get("value", 0.0)))
+    ctx.emit("Where", [valid, ins[0], val], [out])
+
+
+@register_converter("SequenceLast")
+def _sequence_last_conv(ctx, s, ins, out):
+    a = s._attrs
+    if int(a.get("axis", 0)) != 0:
+        raise ValueError("SequenceLast export: only axis=0 (time-major)")
+    shape = s._inputs[0].shape
+    if not a.get("use_sequence_length", False):
+        ctx.emit("Gather", [ins[0], ctx.const(
+            "lastidx", np.asarray(shape[0] - 1, np.int64))], [out],
+            attrs={"axis": 0})
+        return
+    # per-example last valid step: GatherND with indices [(len[n]-1, n)]
+    li = ctx.fresh("sl_lastpos")
+    ctx.emit("Sub", [ins[1], ctx.const("one", np.float32(1.0))], [li])
+    lii = ctx.fresh("sl_lastpos_i")
+    ctx.emit("Cast", [li], [lii], attrs={"to": 7})
+    lcol = ctx.fresh("sl_lcol")
+    ctx.emit("Unsqueeze", [lii, ctx.const("ax1b",
+                                          np.asarray([1], np.int64))], [lcol])
+    nrng = ctx.fresh("sl_nrange")
+    ctx.emit("Range", [ctx.const("n0", np.asarray(0, np.int64)),
+                       ctx.const("nN", np.asarray(shape[1], np.int64)),
+                       ctx.const("n1", np.asarray(1, np.int64))], [nrng])
+    ncol = ctx.fresh("sl_ncol")
+    ctx.emit("Unsqueeze", [nrng, ctx.const("ax1c",
+                                           np.asarray([1], np.int64))], [ncol])
+    idx = ctx.fresh("sl_idx")
+    ctx.emit("Concat", [lcol, ncol], [idx], attrs={"axis": 1})   # (N, 2)
+    ctx.emit("GatherND", [ins[0], idx], [out])
+
+
+@register_converter("SequenceReverse")
+def _sequence_reverse_conv(ctx, s, ins, out):
+    a = s._attrs
+    if a.get("use_sequence_length", False):
+        raise ValueError("SequenceReverse export: per-example lengths do "
+                         "not map to a fixed ONNX node set")
+    if int(a.get("axis", 0)) != 0:
+        raise ValueError("SequenceReverse export: only axis=0")
+    imax = np.iinfo(np.int64).max
+    ctx.emit("Slice", [ins[0],
+                       ctx.const("starts", np.asarray([-1], np.int64)),
+                       ctx.const("ends", np.asarray([-imax], np.int64)),
+                       ctx.const("axes", np.asarray([0], np.int64)),
+                       ctx.const("steps", np.asarray([-1], np.int64))], [out])
+
+
+@register_converter("masked_softmax")
+def _masked_softmax_conv(ctx, s, ins, out):
+    axis = int(s._attrs.get("axis", -1))
+    if len(ins) < 2:
+        ctx.emit("Softmax", ins[:1], [out], attrs={"axis": axis})
+        return
+    # matches the registry op exactly: softmax(where(mask, x, -1e30)) with
+    # NO re-zeroing (a fully-masked row yields uniform 1/n, not zeros)
+    mb = ctx.fresh("msm_bool")
+    ctx.emit("Cast", [ins[1]], [mb], attrs={"to": int(P.BOOL)})
+    neg = ctx.const("msm_neg", np.float32(-1e30))
+    masked = ctx.fresh("msm_masked")
+    ctx.emit("Where", [mb, ins[0], neg], [masked])
+    ctx.emit("Softmax", [masked], [out], attrs={"axis": axis})
+
+
+@register_converter("broadcast_like")
+def _broadcast_like_conv(ctx, s, ins, out):
+    shp = ctx.fresh("bl_shape")
+    ctx.emit("Shape", [ins[1]], [shp])
+    ctx.emit("Expand", [ins[0], shp], [out])
+
+
+@register_converter("broadcast_axis")
+def _broadcast_axis_conv(ctx, s, ins, out):
+    a = s._attrs
+    shape = list(s._inputs[0].shape)
+    axes = a["axis"] if isinstance(a["axis"], (tuple, list)) else [a["axis"]]
+    sizes = a["size"] if isinstance(a["size"], (tuple, list)) else [a["size"]]
+    for ax, sz in zip(axes, sizes):
+        shape[ax] = int(sz)
+    ctx.emit("Expand", [ins[0], ctx.const(
+        "target", np.asarray(shape, np.int64))], [out])
+
+
+register_converter("broadcast_axes")(_CONVERTERS["broadcast_axis"])
+
+
+@register_converter("Pad")
+def _pad_legacy_conv(ctx, s, ins, out):
+    a = s._attrs
+    pw = a.get("pad_width")
+    if pw is None:
+        raise ValueError("Pad export needs pad_width")
+    nd = len(pw) // 2
+    begins = [int(pw[2 * i]) for i in range(nd)]
+    ends = [int(pw[2 * i + 1]) for i in range(nd)]
+    mode = {"constant": "constant", "edge": "edge",
+            "reflect": "reflect"}[a.get("mode", "constant")]
+    node_in = [ins[0], ctx.const("pads", np.asarray(begins + ends, np.int64))]
+    if mode == "constant":
+        node_in.append(ctx.const("padval",
+                                 np.float32(a.get("constant_value", 0.0))))
+    ctx.emit("Pad", node_in, [out], attrs={"mode": mode})
+
+
+@register_converter("argsort")
+def _argsort_conv(ctx, s, ins, out):
+    a = s._attrs
+    axis = int(a.get("axis", -1))
+    shape = s._inputs[0].shape
+    k = ctx.const("k", np.asarray([shape[axis]], np.int64))
+    vals = ctx.fresh("argsort_vals")
+    idx = ctx.fresh("argsort_idx")
+    ctx.emit("TopK", [ins[0], k], [vals, idx],
+             attrs={"axis": axis, "largest": 0 if a.get("is_ascend", True)
+                    else 1, "sorted": 1})
+    from ..base import resolve_dtype
+    code = P.np_to_onnx_dtype(np.dtype(resolve_dtype(
+        a.get("dtype", "float32"))))
+    ctx.emit("Cast", [idx], [out], attrs={"to": int(code)})
+
+
+@register_converter("argmax_channel")
+def _argmax_channel_conv(ctx, s, ins, out):
+    r = ctx.fresh("amc")
+    ctx.emit("ArgMax", ins[:1], [r], attrs={"axis": 1, "keepdims": 0})
+    ctx.emit("Cast", [r], [out], attrs={"to": int(P.FLOAT)})
 
 
 @register_converter("GroupNorm")

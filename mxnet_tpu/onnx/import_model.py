@@ -292,12 +292,25 @@ def _slice(g, node):
     starts = [int(v) for v in g.const_value(node["inputs"][1])]
     ends = [int(v) for v in g.const_value(node["inputs"][2])]
     axes = ([int(v) for v in g.const_value(node["inputs"][3])]
-            if len(node["inputs"]) > 3 else list(range(len(starts))))
+            if len(node["inputs"]) > 3 and node["inputs"][3]
+            else list(range(len(starts))))
+    steps = ([int(v) for v in g.const_value(node["inputs"][4])]
+             if len(node["inputs"]) > 4 and node["inputs"][4]
+             else [1] * len(starts))
     out = g.inp(node["inputs"][0])
     imax = np.iinfo(np.int64).max
-    for st, en, ax in zip(starts, ends, axes):
-        out = _make("slice_axis", out, axis=ax, begin=st,
-                    end=None if en >= imax else en)
+    for st, en, ax, sp in zip(starts, ends, axes, steps):
+        if sp == 1:
+            out = _make("slice_axis", out, axis=ax, begin=st,
+                        end=None if en >= imax else en)
+        elif sp == -1 and st == -1 and en <= -imax + 1:
+            # full reversal along ax (the SequenceReverse lowering)
+            out = _make("reverse", out, axis=ax)
+        else:
+            raise ValueError(
+                "Slice import: step %d (start %d, end %d) unsupported — "
+                "only unit steps and full reversals map to registry ops"
+                % (sp, st, en))
     return out
 
 
@@ -882,6 +895,13 @@ def _expand_imp(g, node):
     # ONNX Expand broadcasts BIDIRECTIONALLY (out = broadcast(x, shape),
     # where x dims may exceed a 1 in shape) — multiply by ones(shape), which
     # has exactly those semantics; broadcast_to would reject such shapes
+    if node["inputs"][1] not in g.initializers:
+        src = g.inp(node["inputs"][1])
+        if src._op == "_onnx_shape":
+            # Expand(x, Shape(y)): mul by ones_like(y) keeps ONNX Expand's
+            # BIDIRECTIONAL broadcast (x dims may exceed a 1 in the target)
+            return _make("broadcast_mul", g.inp(node["inputs"][0]),
+                         _make("ones_like", src._inputs[0]))
     shape = tuple(int(v) for v in g.const_value(node["inputs"][1]))
     ones = var(node["outputs"][0] + "_expand_ones")
     g.initializers[ones.name] = np.ones(shape, np.float32)

@@ -397,7 +397,12 @@ def _roundtrip_eval(build, feeds, rtol=1e-5, atol=1e-6):
     want = ex.forward()
     want = want if isinstance(want, (list, tuple)) else [want]
     blk = mxonnx.import_to_gluon(buf)
-    got = blk(*[nd_feeds[k] for k in sorted(feeds)])
+    # SymbolBlock binds positionally in list_arguments order — feed that
+    # order, not sorted names
+    s2, arg_params, aux_params = mxonnx.import_model(buf)
+    pnames = set(arg_params) | set(aux_params)
+    order = [n for n in s2.list_arguments() if n not in pnames]
+    got = blk(*[nd_feeds[k] for k in order])
     got = got if isinstance(got, (list, tuple)) else [got]
     for w, g in zip(want, got):
         np.testing.assert_allclose(g.asnumpy(), w.asnumpy(),
@@ -620,3 +625,39 @@ def test_onnx_groupnorm_roundtrip():
         return sym.GroupNorm(v["a"], v["b"], v["c"], num_groups=3, eps=1e-5)
 
     _roundtrip_eval(build, {"a": x, "b": gm, "c": bt}, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_sequence_family_roundtrip():
+    """SequenceMask/Last/Reverse, masked_softmax, broadcast_like/axis, Pad,
+    argsort, argmax_channel."""
+    from mxnet_tpu import sym
+    rs = np.random.RandomState(12)
+    x = rs.randn(5, 3, 2).astype(np.float32)   # (T, N, C) time-major
+    sl = np.array([3.0, 5.0, 1.0], np.float32)
+    m = (rs.rand(4, 6) > 0.4).astype(np.float32)
+    y = rs.randn(4, 6).astype(np.float32)
+
+    def build(v):
+        xx, ll, yy, mm = v["a"], v["b"], v["c"], v["d"]
+        parts = [
+            sym.sum(sym.SequenceMask(xx, ll, use_sequence_length=True,
+                                     value=-2.0)),
+            sym.sum(sym.SequenceLast(xx, ll, use_sequence_length=True)),
+            sym.sum(sym.SequenceLast(xx)),
+            sym.sum(sym.SequenceReverse(xx) * 3.0),
+            sym.sum(sym.masked_softmax(yy, mm)),
+            sym.sum(sym.broadcast_like(sym.reshape(ll, shape=(3, 1)),
+                               sym.slice_axis(yy, axis=0, begin=0, end=3))),
+            sym.sum(sym.broadcast_axis(sym.reshape(ll, shape=(1, 3)),
+                                       axis=0, size=4)),
+            sym.sum(sym.Pad(yy, mode="constant", constant_value=1.5,
+                            pad_width=(1, 1, 2, 0))),
+            sym.sum(sym.argsort(yy, axis=1, is_ascend=False)),
+            sym.sum(sym.argmax_channel(yy)),
+        ]
+        t = parts[0]
+        for p in parts[1:]:
+            t = t + p
+        return t
+
+    _roundtrip_eval(build, {"a": x, "b": sl, "c": y, "d": m}, rtol=1e-4)

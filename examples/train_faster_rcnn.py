@@ -1,0 +1,83 @@
+"""Train a small Faster R-CNN on synthetic boxes (two-stage detection).
+
+The example/rcnn workflow (ref: incubator-mxnet example/rcnn/train_end2end.py)
+rebuilt TPU-native on the contrib kernel set: backbone → RPN →
+``contrib.Proposal`` (static top-k + on-device NMS) → ``ROIAlign`` → head,
+with the proposal-target assignment running ON DEVICE inside the same
+program (ops/detection.py multibox_target). ``--deformable`` swaps a
+DeformableConvolution block into the neck (Deformable R-CNN).
+
+Runs out of the box:
+    python examples/train_faster_rcnn.py [--steps 20] [--deformable]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.models.faster_rcnn import RCNNTargetLoss, faster_rcnn_small
+
+IMG = 64
+CLASSES = 3
+
+
+def synth_sample(rng):
+    """One image with 1-2 colored rectangles; labels [cls, x1, y1, x2, y2]
+    normalized to [0, 1] (pad rows cls=-1)."""
+    img = rng.normal(scale=0.05, size=(3, IMG, IMG)).astype(np.float32)
+    labels = np.full((2, 5), -1.0, np.float32)
+    for i in range(rng.integers(1, 3)):
+        cls = int(rng.integers(0, CLASSES))
+        w, h = rng.integers(16, 32, 2)
+        x1 = int(rng.integers(0, IMG - w))
+        y1 = int(rng.integers(0, IMG - h))
+        img[cls, y1:y1 + h, x1:x1 + w] += 1.0
+        labels[i] = [cls, x1 / IMG, y1 / IMG, (x1 + w) / IMG, (y1 + h) / IMG]
+    return img, labels
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--deformable", action="store_true")
+    args = ap.parse_args()
+
+    rng = np.random.default_rng(0)
+    mx.random.seed(0)
+    net = faster_rcnn_small(num_classes=CLASSES, deformable=args.deformable,
+                            rpn_pre_nms=64, rpn_post_nms=8)
+    net.initialize()
+    lossfn = RCNNTargetLoss(CLASSES, IMG)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+    im_info = nd.array(np.array([[IMG, IMG, 1.0]], np.float32))
+
+    losses = []
+    for step in range(args.steps):
+        img, labels = synth_sample(rng)
+        x = nd.array(img[None])
+        lab = nd.array(labels[None])
+        with autograd.record():
+            cls, deltas, rois, *_ = net(x, im_info)
+            loss = lossfn(cls, deltas, rois, lab)
+        loss.backward()
+        trainer.step(1)
+        losses.append(float(loss.asscalar()))
+        if step % 5 == 0 or step == args.steps - 1:
+            print("step %3d  loss %.4f" % (step, losses[-1]))
+
+    det = net.detect(x, im_info)
+    live = det.asnumpy()[det.asnumpy()[:, 1] > 0]
+    print("detections above threshold: %d rows" % len(live))
+    assert all(np.isfinite(losses))
+    print("done — two-stage detector trained %.3f -> %.3f"
+          % (losses[0], min(losses)))
+
+
+if __name__ == "__main__":
+    main()

@@ -820,6 +820,54 @@ def _trunc_conv(ctx, s, ins, out):
 register_converter("fix")(_CONVERTERS["trunc"])
 
 
+# ---- Module-era output heads: inference semantics (the label input and
+# grad_scale only shape the backward, which ONNX doesn't carry)
+@register_converter("SoftmaxOutput")
+def _softmax_output_conv(ctx, s, ins, out):
+    # matches the registry kernel exactly (ops/functional.py SoftmaxOutput:
+    # softmax over the LAST axis regardless of multi_output)
+    ctx.emit("Softmax", ins[:1], [out], attrs={"axis": -1})
+
+
+@register_converter("LogisticRegressionOutput")
+def _logistic_output_conv(ctx, s, ins, out):
+    ctx.emit("Sigmoid", ins[:1], [out])
+
+
+def _fwd_identity_conv(ctx, s, ins, out):
+    ctx.emit("Identity", ins[:1], [out])
+
+
+for _nm in ("LinearRegressionOutput", "MAERegressionOutput", "MakeLoss",
+            "SVMOutput", "IdentityAttachKLSparseReg"):
+    register_converter(_nm)(_fwd_identity_conv)
+
+
+@register_converter("ROIAlign")
+def _roi_align_conv(ctx, s, ins, out):
+    """rois are (R, 5) [batch_idx, x1, y1, x2, y2] — split into ONNX
+    RoiAlign's (rois (R,4), batch_indices (R,)) pair."""
+    a = s._attrs
+    ph, pw = a["pooled_size"]
+    bcol = _slice_emit(ctx, ins[1], 0, 1, 1, "ra_bidx")
+    bi = ctx.fresh("ra_bi")
+    ctx.emit("Cast", [bcol], [bi], attrs={"to": 7})
+    bsq = ctx.fresh("ra_bsq")
+    ctx.emit("Squeeze", [bi, ctx.const("ax1", np.asarray([1], np.int64))],
+             [bsq])
+    boxes = _slice_emit(ctx, ins[1], 1, 5, 1, "ra_boxes")
+    attrs = {"output_height": int(ph), "output_width": int(pw),
+             "spatial_scale": float(a.get("spatial_scale", 1.0)),
+             "sampling_ratio": int(a.get("sample_ratio", 2)),
+             "mode": "avg"}
+    if ctx.opset >= 16:
+        # our _roi_grid samples WITHOUT the -0.5 pixel-center offset — that
+        # is opset-16's 'output_half_pixel' (the legacy behavior); the
+        # opset-16 default is 'half_pixel', so it must be spelled out
+        attrs["coordinate_transformation_mode"] = "output_half_pixel"
+    ctx.emit("RoiAlign", [ins[0], boxes, bsq], [out], attrs=attrs)
+
+
 def _seq_len_mask(ctx, s, ins, T, trailing_rank):
     """(T, N) bool mask: position t is valid iff t < sequence_length[n],
     unsqueezed over `trailing_rank` extra dims."""

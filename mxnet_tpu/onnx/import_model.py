@@ -915,6 +915,33 @@ def _tile_imp(g, node):
     return _make("tile", g.inp(node["inputs"][0]), reps=reps)
 
 
+@register_importer("RoiAlign")
+def _roi_align_imp(g, node):
+    """sampling_ratio=0 (the spec's adaptive mode) is approximated with a
+    fixed 2x2 sample grid per bin — the common producer setting; exact for
+    ROIs up to 2x the pooled size."""
+    a = node["attrs"]
+    if a.get("mode", "avg") != "avg":
+        raise ValueError("RoiAlign import: only mode='avg'")
+    ctm = a.get("coordinate_transformation_mode", "output_half_pixel")
+    if ctm != "output_half_pixel":
+        # the kernel's grid has no -0.5 pixel-center offset; importing a
+        # 'half_pixel' model would shift every ROI feature by half a pixel
+        raise ValueError("RoiAlign import: coordinate_transformation_mode="
+                         "%r unsupported (only 'output_half_pixel')" % ctm)
+    data = g.inp(node["inputs"][0])
+    boxes = g.inp(node["inputs"][1])
+    bidx = g.inp(node["inputs"][2])
+    bcol = _make("reshape", _make("cast", bidx, dtype="float32"),
+                 shape=(-1, 1))
+    rois5 = _make("concat", bcol, boxes, dim=1)
+    return _make("ROIAlign", data, rois5,
+                 pooled_size=(int(a["output_height"]),
+                              int(a["output_width"])),
+                 spatial_scale=float(a.get("spatial_scale", 1.0)),
+                 sample_ratio=int(a.get("sampling_ratio", 2)) or 2)
+
+
 @register_importer("Range")
 def _range_imp(g, node):
     start, limit, delta = (float(g.const_value(n)) for n in node["inputs"])

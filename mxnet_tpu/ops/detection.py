@@ -133,12 +133,17 @@ def multibox_target(anchors, labels, cls_preds, *, overlap_threshold=0.5,
         iou = jnp.where(gt_valid[None, :], iou, -1.0)
         best_gt = jnp.argmax(iou, axis=1)
         best_iou = jnp.max(iou, axis=1)
-        # force-match: each gt's best anchor is positive
+        # force-match: each VALID gt's best anchor is positive. Invalid
+        # (padding) rows all argmax to index 0 (their iou column is -1
+        # everywhere) — scattering them directly would collide with and
+        # overwrite a valid gt's force-match, so they're routed to a
+        # dropped extra row instead.
+        N = anc.shape[0]
         best_anchor_per_gt = jnp.argmax(iou, axis=0)  # (M,)
-        forced = jnp.zeros(anc.shape[0], bool)
-        forced = forced.at[best_anchor_per_gt].set(gt_valid)
-        gt_for_forced = jnp.zeros(anc.shape[0], jnp.int32).at[best_anchor_per_gt].set(
-            jnp.arange(lab.shape[0], dtype=jnp.int32))
+        idx = jnp.where(gt_valid, best_anchor_per_gt, N)
+        forced = jnp.zeros(N + 1, bool).at[idx].set(True)[:N]
+        gt_for_forced = jnp.zeros(N + 1, jnp.int32).at[idx].set(
+            jnp.arange(lab.shape[0], dtype=jnp.int32))[:N]
         pos = (best_iou >= overlap_threshold) | forced
         matched_gt = jnp.where(forced, gt_for_forced, best_gt.astype(jnp.int32))
         mb = gt_boxes[matched_gt]  # (N, 4)

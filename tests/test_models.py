@@ -205,3 +205,20 @@ def test_deformable_faster_rcnn_head():
     cls, deltas, rois, *_ = net(x, ii)
     assert cls.shape == (4, 4)
     assert np.isfinite(cls.asnumpy()).all()
+
+
+def test_multibox_target_force_match_with_padding_rows():
+    """A padding gt row (cls=-1) must not overwrite a valid gt's force-match
+    (their argmax indices collide at 0 when the padding row's iou column is
+    -1 everywhere) — regression for the scatter-collision bug."""
+    anchors = nd.array(np.array([[[0.0, 0.0, 0.9, 0.9],
+                                  [0.5, 0.5, 0.6, 0.6]]], np.float32))
+    # low-IoU gt (below 0.5 threshold) + one padding row AFTER it
+    labels = nd.array(np.array([[[2, 0.05, 0.45, 0.5, 0.75],
+                                 [-1, 0, 0, 0, 0]]], np.float32))
+    cls_preds = nd.array(np.full((1, 4, 2), 0.25, np.float32))
+    bt, bm, ct = nd.multibox_target(anchors, labels, cls_preds)
+    c = ct.asnumpy()[0]
+    # anchor 0 is the gt's best anchor -> force-matched positive class 3
+    assert c[0] == 3.0, c
+    assert bm.asnumpy().sum() > 0

@@ -661,3 +661,29 @@ def test_onnx_sequence_family_roundtrip():
         return t
 
     _roundtrip_eval(build, {"a": x, "b": sl, "c": y, "d": m}, rtol=1e-4)
+
+
+def test_onnx_output_heads_and_roialign_roundtrip():
+    from mxnet_tpu import sym
+    rs = np.random.RandomState(13)
+    y = rs.randn(3, 5).astype(np.float32)
+    img = rs.randn(1, 2, 8, 8).astype(np.float32)
+    rois = np.array([[0, 0, 0, 6, 6], [0, 2, 2, 7, 7]], np.float32)
+
+    def build(v):
+        d, im, rr = v["a"], v["b"], v["c"]
+        lbl = sym.zeros_like(d)
+        parts = [
+            sym.sum(sym.SoftmaxOutput(d, lbl)),
+            sym.sum(sym.LogisticRegressionOutput(d, lbl)),
+            sym.sum(sym.LinearRegressionOutput(d, lbl)),
+            sym.sum(sym.MakeLoss(sym.square(d))),
+            sym.sum(sym.ROIAlign(im, rr, pooled_size=(3, 3),
+                                 spatial_scale=0.5)),
+        ]
+        t = parts[0]
+        for p in parts[1:]:
+            t = t + p
+        return t
+
+    _roundtrip_eval(build, {"a": y, "b": img, "c": rois}, rtol=1e-4)

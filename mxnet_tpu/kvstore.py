@@ -67,6 +67,39 @@ class KVStore:
         self.push(key, value, priority)
         return self.pull(key, out or value, priority)
 
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the requested rows of a row_sparse value (ref:
+        python/mxnet/kvstore/kvstore.py:row_sparse_pull). On TPU the store
+        value stays dense: the id'd rows are gathered ON DEVICE into a
+        dense ``out`` with the untouched rows zeroed (the row_sparse
+        representation's dense view). The API exists for call-pattern
+        parity — the device gather is cheap, but ``out`` is full-shape, so
+        a host read of it still transfers the whole table."""
+        if out is None or row_ids is None:
+            raise ValueError("row_sparse_pull requires out= and row_ids=")
+        from .ndarray import NDArray
+        import jax.numpy as jnp
+
+        keys, outs = _normalize(key, out)
+        rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
+        if len(rids) == 1 and len(outs) > 1:
+            rids = rids * len(outs)
+        if len(rids) != len(outs):
+            raise ValueError("row_sparse_pull: %d row_ids for %d keys"
+                             % (len(rids), len(outs)))
+        results = []
+        for k, o, r in zip(keys, outs, rids):
+            v = self._store[k]
+            idx = r._data.astype(jnp.int32) if isinstance(r, NDArray) \
+                else jnp.asarray(r, jnp.int32)
+            rows = v._data[idx]
+            # out keeps full shape (dense backing); untouched rows zeroed,
+            # matching the reference's row_sparse representation semantics
+            dense = jnp.zeros_like(v._data).at[idx].set(rows)
+            o._data = dense
+            results.append(o)
+        return results if len(results) > 1 else results[0]
+
     def set_optimizer(self, optimizer):
         assert isinstance(optimizer, Optimizer)
         self._updater = get_updater(optimizer)

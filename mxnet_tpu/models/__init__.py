@@ -6,3 +6,4 @@ from . import lstm_lm  # noqa: F401
 from . import transformer  # noqa: F401
 from . import ssd  # noqa: F401
 from . import faster_rcnn  # noqa: F401
+from . import gpt  # noqa: F401

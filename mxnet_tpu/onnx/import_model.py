@@ -23,10 +23,11 @@ def register_importer(onnx_op):
 
 
 class _Graph:
-    def __init__(self, parsed):
+    def __init__(self, parsed, opset=13):
         self.initializers = parsed["initializers"]  # name -> np array
         self.syms = {}                              # value name -> Symbol
         self.used_params = set()
+        self.opset = opset
 
     def inp(self, name):
         """Symbol for a node input; initializer-backed names become vars."""
@@ -737,7 +738,7 @@ def import_model(model_file):
             buf = f.read()
     parsed = P.parse_model(buf)
     graph = parsed["graph"]
-    g = _Graph(graph)
+    g = _Graph(graph, opset=parsed.get("opset", 13))
 
     for vi in graph["inputs"]:
         if vi["name"] not in g.initializers:
@@ -923,7 +924,10 @@ def _roi_align_imp(g, node):
     a = node["attrs"]
     if a.get("mode", "avg") != "avg":
         raise ValueError("RoiAlign import: only mode='avg'")
-    ctm = a.get("coordinate_transformation_mode", "output_half_pixel")
+    # the ABSENT-attr default flipped at opset 16: 'output_half_pixel'
+    # before, 'half_pixel' (pixel-center offset) from 16 on
+    default_ctm = "half_pixel" if g.opset >= 16 else "output_half_pixel"
+    ctm = a.get("coordinate_transformation_mode", default_ctm)
     if ctm != "output_half_pixel":
         # the kernel's grid has no -0.5 pixel-center offset; importing a
         # 'half_pixel' model would shift every ROI feature by half a pixel

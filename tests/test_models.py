@@ -222,3 +222,51 @@ def test_multibox_target_force_match_with_padding_rows():
     # anchor 0 is the gt's best anchor -> force-matched positive class 3
     assert c[0] == 3.0, c
     assert bm.asnumpy().sum() > 0
+
+
+def test_gpt_forward_causality_and_cached_generation():
+    """Decoder-only LM: logits shape, strict causality (future tokens cannot
+    influence earlier positions), and KV-cached greedy decode == full
+    re-forward decode."""
+    from mxnet_tpu.models.gpt import gpt_nano
+
+    m = gpt_nano()
+    m.initialize()
+    toks = nd.array(np.random.RandomState(0).randint(0, 256, (2, 8)),
+                    dtype="int32")
+    logits = m(toks)
+    assert logits.shape == (2, 8, 256)
+    t2 = toks.asnumpy().copy()
+    t2[:, 5] = (t2[:, 5] + 1) % 256
+    l2 = m(nd.array(t2, dtype="int32"))
+    leak = np.abs(logits.asnumpy()[:, :5] - l2.asnumpy()[:, :5]).max()
+    assert leak < 1e-5, leak
+    out_c = m.generate(toks, max_new_tokens=4, use_cache=True)
+    out_f = m.generate(toks, max_new_tokens=4, use_cache=False)
+    np.testing.assert_array_equal(out_c.asnumpy(), out_f.asnumpy())
+
+
+def test_gpt_training_descends():
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.models.gpt import gpt_nano
+
+    m = gpt_nano()
+    m.initialize()
+    trainer = gluon.Trainer(m.collect_params(), "adam",
+                            {"learning_rate": 3e-3})
+    rs = np.random.RandomState(1)
+    toks = nd.array(rs.randint(0, 256, (4, 12)), dtype="int32")
+    inp = nd.slice_axis(toks, axis=1, begin=0, end=11)
+    tgt = nd.slice_axis(toks, axis=1, begin=1, end=12)
+    ls = []
+    for _ in range(6):
+        with autograd.record():
+            logits = m(inp).astype("float32")
+            lp = nd.log_softmax(logits, axis=-1)
+            L = -nd.pick(lp, tgt.astype("float32"), axis=2).mean()
+        L.backward()
+        trainer.step(4)
+        ls.append(float(L.asscalar()))
+    assert all(np.isfinite(ls))
+    assert ls[-1] < ls[0], ls

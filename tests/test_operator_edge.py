@@ -1,0 +1,181 @@
+"""Operator edge cases (mirrors reference tests/python/unittest/
+test_operator.py's adversarial corners: degenerate shapes, negative axes,
+keepdims combos, out-of-range indices, empty reductions)."""
+import numpy as np
+import pytest
+
+from mxnet_tpu import nd
+
+
+def _a(x):
+    return nd.array(np.asarray(x, np.float32))
+
+
+def test_broadcast_binary_degenerate_shapes():
+    # (1,) vs (3, 1, 2); (3, 1) vs (1, 4); scalar vs array
+    a = _a(np.random.RandomState(0).randn(3, 1, 2))
+    b = _a([2.0])
+    np.testing.assert_allclose((a * b).asnumpy(), a.asnumpy() * 2.0, rtol=1e-6)
+    c = _a(np.random.RandomState(1).randn(3, 1))
+    d = _a(np.random.RandomState(2).randn(1, 4))
+    np.testing.assert_allclose(nd.broadcast_add(c, d).asnumpy(),
+                               c.asnumpy() + d.asnumpy(), rtol=1e-6)
+    np.testing.assert_allclose((c + 5).asnumpy(), c.asnumpy() + 5, rtol=1e-6)
+
+
+def test_reduce_axis_combinations():
+    x = np.random.RandomState(3).randn(2, 3, 4).astype(np.float32)
+    a = _a(x)
+    for axis in (0, 1, 2, -1, (0, 2), None):
+        for keep in (False, True):
+            got = nd.sum(a, axis=axis, keepdims=keep).asnumpy()
+            want = x.sum(axis=axis, keepdims=keep)
+            np.testing.assert_allclose(got, np.asarray(want), rtol=1e-5)
+    # min/max/prod on negative axis with keepdims
+    np.testing.assert_allclose(nd.max(a, axis=-2, keepdims=True).asnumpy(),
+                               x.max(axis=-2, keepdims=True), rtol=1e-6)
+    np.testing.assert_allclose(nd.prod(a, axis=0).asnumpy(),
+                               x.prod(axis=0), rtol=1e-5)
+
+
+def test_mean_of_single_element_axis():
+    x = np.random.RandomState(4).randn(5, 1).astype(np.float32)
+    np.testing.assert_allclose(nd.mean(_a(x), axis=1).asnumpy(),
+                               x.mean(axis=1), rtol=1e-6)
+
+
+def test_slice_axis_negative_and_open_end():
+    x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    a = _a(x)
+    np.testing.assert_allclose(
+        nd.slice_axis(a, axis=-1, begin=1, end=3).asnumpy(), x[..., 1:3])
+    np.testing.assert_allclose(
+        nd.slice_axis(a, axis=1, begin=1, end=None).asnumpy(), x[:, 1:])
+
+
+def test_take_clip_and_wrap_modes():
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+    idx = _a([0.0, 5.0, -1.0])
+    got = nd.take(_a(x), idx, axis=0, mode="clip").asnumpy()
+    np.testing.assert_allclose(got[0], x[0])
+    np.testing.assert_allclose(got[1], x[3])   # 5 clamps to 3
+    got_w = nd.take(_a(x), idx, axis=0, mode="wrap").asnumpy()
+    np.testing.assert_allclose(got_w[1], x[1])  # 5 wraps to 1
+    np.testing.assert_allclose(got_w[2], x[3])  # -1 wraps to 3
+
+
+def test_pick_negative_axis_and_modes():
+    x = np.random.RandomState(5).randn(3, 4).astype(np.float32)
+    idx = _a([0.0, 3.0, 2.0])
+    got = nd.pick(_a(x), idx, axis=-1).asnumpy()
+    np.testing.assert_allclose(got, x[np.arange(3), [0, 3, 2]], rtol=1e-6)
+
+
+def test_one_hot_shape_and_values():
+    got = nd.one_hot(_a([1.0, 0.0, 3.0]), depth=4).asnumpy()
+    want = np.eye(4, dtype=np.float32)[[1, 0, 3]]
+    np.testing.assert_allclose(got, want)
+    got2 = nd.one_hot(_a([0.0]), depth=2, on_value=5.0,
+                      off_value=-1.0).asnumpy()
+    np.testing.assert_allclose(got2, [[5.0, -1.0]])
+
+
+def test_topk_variants():
+    x = np.array([[3.0, 1.0, 4.0, 1.5]], np.float32)
+    idx = nd.topk(_a(x), k=2, axis=1).asnumpy()
+    np.testing.assert_array_equal(idx[0], [2, 0])
+    both = nd.topk(_a(x), k=2, axis=1, ret_typ="both")
+    np.testing.assert_allclose(both[0].asnumpy()[0], [4.0, 3.0])
+    np.testing.assert_array_equal(both[1].asnumpy()[0], [2, 0])
+    smallest = nd.topk(_a(x), k=1, axis=1, is_ascend=True).asnumpy()
+    np.testing.assert_array_equal(smallest[0], [1])
+
+
+def test_clip_degenerate_range():
+    x = _a([-5.0, 0.0, 5.0])
+    np.testing.assert_allclose(
+        nd.clip(x, a_min=2.0, a_max=2.0).asnumpy(), [2.0, 2.0, 2.0])
+
+
+def test_concat_single_input_and_many():
+    x = _a(np.ones((2, 2)))
+    np.testing.assert_allclose(nd.concat(x, dim=0).asnumpy(), np.ones((2, 2)))
+    got = nd.concat(x, x, x, dim=1).asnumpy()
+    assert got.shape == (2, 6)
+
+
+def test_reshape_special_tokens():
+    x = _a(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    # 0 = copy input dim, -1 = infer
+    assert nd.reshape(x, shape=(0, -1)).shape == (2, 12)
+    assert nd.reshape(x, shape=(-1, 4)).shape == (6, 4)
+    assert nd.reshape(x, shape=(0, 0, 2, 2)).shape == (2, 3, 2, 2)
+
+
+def test_expand_dims_squeeze_roundtrip():
+    x = _a(np.ones((2, 3)))
+    e = nd.expand_dims(x, axis=-1)
+    assert e.shape == (2, 3, 1)
+    s = nd.squeeze(e, axis=-1)
+    assert s.shape == (2, 3)
+
+
+def test_where_broadcast_condition():
+    cond = _a([[1.0], [0.0]])
+    a = _a(np.ones((2, 3)))
+    b = _a(np.zeros((2, 3)))
+    got = nd.where(cond, a, b).asnumpy()
+    np.testing.assert_allclose(got, [[1, 1, 1], [0, 0, 0]])
+
+
+def test_sequence_ops_eager():
+    x = np.arange(2 * 3 * 2, dtype=np.float32).reshape(2, 3, 2)  # (T, N, C)
+    sl = _a([1.0, 2.0, 1.0])
+    m = nd.SequenceMask(_a(x), sl, use_sequence_length=True,
+                        value=-9.0).asnumpy()
+    np.testing.assert_allclose(m[0], x[0])           # t=0 valid everywhere
+    np.testing.assert_allclose(m[1, 0], -9.0)        # len 1 -> t=1 masked
+    np.testing.assert_allclose(m[1, 1], x[1, 1])     # len 2 -> t=1 valid
+    last = nd.SequenceLast(_a(x), sl, use_sequence_length=True).asnumpy()
+    np.testing.assert_allclose(last[0], x[0, 0])
+    np.testing.assert_allclose(last[1], x[1, 1])
+    rev = nd.SequenceReverse(_a(x)).asnumpy()
+    np.testing.assert_allclose(rev, x[::-1])
+
+
+def test_norm_ord_and_axis():
+    x = np.random.RandomState(6).randn(3, 4).astype(np.float32)
+    np.testing.assert_allclose(nd.norm(_a(x), ord=2, axis=1).asnumpy(),
+                               np.linalg.norm(x, axis=1), rtol=1e-5)
+    np.testing.assert_allclose(nd.norm(_a(x), ord=1, axis=0).asnumpy(),
+                               np.abs(x).sum(axis=0), rtol=1e-5)
+
+
+def test_argsort_and_argmax_ties():
+    x = np.array([[1.0, 1.0, 0.0]], np.float32)
+    # ties: first occurrence wins (numpy convention)
+    assert nd.argmax(_a(x), axis=1).asnumpy()[0] == 0
+    order = nd.argsort(_a(x), axis=1).asnumpy()[0]
+    assert order[0] == 2  # smallest first
+
+
+def test_mod_sign_conventions():
+    a = _a([-3.0, 3.0, -7.5])
+    b = _a([2.0, -2.0, 2.0])
+    np.testing.assert_allclose(nd.mod(a, b).asnumpy(),
+                               np.mod(a.asnumpy(), b.asnumpy()), rtol=1e-6)
+
+
+def test_flip_reverse_multiaxis():
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    np.testing.assert_allclose(nd.flip(_a(x), axis=0).asnumpy(), x[::-1])
+    np.testing.assert_allclose(nd.reverse(_a(x), axis=1).asnumpy(),
+                               x[:, ::-1])
+
+
+def test_cast_integer_float_roundtrip():
+    x = _a([1.7, -2.3])
+    i = nd.cast(x, dtype="int32")
+    np.testing.assert_array_equal(i.asnumpy(), [1, -2])  # trunc toward zero
+    f = nd.cast(i, dtype="float32")
+    assert f.asnumpy().dtype == np.float32

@@ -185,3 +185,17 @@ def test_contrib_sparse_embedding_is_actually_sparse():
     w1 = p.data().asnumpy()
     moved = sorted(set(np.nonzero(np.abs(w1 - w0).sum(axis=1) > 1e-9)[0].tolist()))
     assert moved == [2, 5]
+
+
+def test_kvstore_row_sparse_pull():
+    import mxnet_tpu as mx
+
+    kv = mx.kvstore.create("local")
+    w = nd.array(np.arange(20, dtype=np.float32).reshape(5, 4))
+    kv.init("emb", w)
+    out = nd.zeros((5, 4))
+    kv.row_sparse_pull("emb", out=out, row_ids=nd.array([1.0, 3.0]))
+    got = out.asnumpy()
+    np.testing.assert_allclose(got[1], w.asnumpy()[1])
+    np.testing.assert_allclose(got[3], w.asnumpy()[3])
+    np.testing.assert_allclose(got[[0, 2, 4]], 0.0)

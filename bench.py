@@ -59,7 +59,7 @@ MASKED = 20
 VOCAB = 30522
 
 
-def build(seq=SEQ):
+def build(seq=SEQ, remat=False):
     # batch/mask sizes come from make_batch via the jit trace; only the
     # max sequence length specializes the model itself
     import mxnet_tpu as mx
@@ -88,6 +88,11 @@ def build(seq=SEQ):
 
     params = [p.data()._data for p in plist]
     states = init_states(params)
+    if remat:
+        # rematerialize the forward during backward: activation HBM drops
+        # from O(layers) to O(1) per microbatch, buying larger batches
+        # (the --batch sweep) at ~1.3x FLOPs
+        loss_fn = jax.checkpoint(loss_fn)
 
     # donate params+opt state: step i+1 overwrites step i's buffers in place
     # instead of allocating a second copy of every weight/moment in HBM
@@ -331,13 +336,13 @@ def make_nmt_batch(rng, batch=NMT_BATCH, src_len=NMT_SRC_LEN,
 
 # mode -> (build_fn(smoke) -> (step, params, states, batch, units_per_step,
 #          metric, unit, baseline, mfu_fn or None))
-def _mode_spec(mode, rng, smoke=False, batch_override=None):
+def _mode_spec(mode, rng, smoke=False, batch_override=None, remat=False):
     def _b(default):
         return batch_override or (default)
 
     if mode == "bert":
         b = _b(4 if smoke else BATCH)
-        step, params, states = build()
+        step, params, states = build(remat=remat)
         return (step, params, states, make_batch(rng, b), b,
                 "bert_base_pretrain_samples_per_sec_per_chip", "samples/s",
                 BASELINE_SAMPLES_PER_SEC,
@@ -345,7 +350,7 @@ def _mode_spec(mode, rng, smoke=False, batch_override=None):
                 / V5E_PEAK_BF16_FLOPS)
     if mode == "bert512":
         b = _b(2 if smoke else BERT512_BATCH)
-        step, params, states = build(seq=BERT512_SEQ)
+        step, params, states = build(seq=BERT512_SEQ, remat=remat)
         return (step, params, states,
                 make_batch(rng, b, BERT512_SEQ, BERT512_MASKED), b,
                 "bert_base_seq512_train_samples_per_sec_per_chip", "samples/s",
@@ -444,11 +449,11 @@ def probe_backend(budget_s, probe_timeout=120):
 
 
 def run_mode(mode, results, smoke=False, iters=None, headline=False,
-             batch_override=None):
+             batch_override=None, remat=False):
     rng = np.random.default_rng(0)
     _log("building model + train step (%s)..." % mode)
     (step, params, states, batch, units, metric, unit, baseline,
-     mfu_fn) = _mode_spec(mode, rng, smoke, batch_override)
+     mfu_fn) = _mode_spec(mode, rng, smoke, batch_override, remat)
     key = jax.random.PRNGKey(0)
 
     # warmup / compile. NOTE: under the axon relay block_until_ready can
@@ -461,6 +466,8 @@ def run_mode(mode, results, smoke=False, iters=None, headline=False,
     float(loss)
     _log("compile + first step done; timing...")
 
+    remat = remat and mode in ("bert", "bert512")  # only the bert builds
+    # thread jax.checkpoint; other modes must not claim remat in the record
     iters = iters or (3 if smoke else 50)
     t0 = time.perf_counter()
     for i in range(iters):
@@ -479,11 +486,13 @@ def run_mode(mode, results, smoke=False, iters=None, headline=False,
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "iters": iters,
         "batch": (batch_override or "default"),
+        "remat": remat,
         "platform": jax.devices()[0].platform,
     }
     if mfu_fn is not None:
         rec["mfu"] = round(mfu_fn(per_sec), 4)
-    if not smoke and batch_override is None and rec["platform"] not in ("cpu",):
+    if not smoke and batch_override is None and not remat \
+            and rec["platform"] not in ("cpu",):
         _save_result(mode, rec)
         results[mode] = rec
     out = dict(rec)
@@ -496,6 +505,7 @@ def main():
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
     flags = {a for a in sys.argv[1:] if a.startswith("--")}
     smoke = "--smoke" in flags
+    remat = "--remat" in flags
     if "--cpu" in flags:
         jax.config.update("jax_platforms", "cpu")
     mode = args[0] if args else "bert"
@@ -555,7 +565,7 @@ def main():
             try:
                 run_mode(m, results, smoke=smoke, iters=iters,
                          headline=(m == "bert"),
-                         batch_override=batch_override)
+                         batch_override=batch_override, remat=remat)
             except Exception as e:
                 _log("mode %s FAILED: %r — continuing with remaining modes"
                      % (m, e))
@@ -564,7 +574,8 @@ def main():
             raise SystemExit("modes failed: %s" % ",".join(failed))
     else:
         run_mode(mode, results, smoke=smoke, iters=iters,
-                 headline=(mode == "bert"), batch_override=batch_override)
+                 headline=(mode == "bert"), batch_override=batch_override,
+                 remat=remat)
 
 
 if __name__ == "__main__":

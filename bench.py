@@ -527,7 +527,12 @@ def main():
         # Device init over the relay either succeeds in ~seconds, raises
         # UNAVAILABLE, or — worst case — BLOCKS indefinitely (observed:
         # multi-hour wedges where jax.devices() never returns).
-        have_fallback = (bool(results) if mode == "all" else mode in results)
+        # sweep configs (--batch/--remat) can never match a persisted
+        # baseline record — replay would silently report the default config
+        # under the sweep's banner, so they abort loudly instead
+        sweep = batch_override is not None or remat
+        have_fallback = not sweep and (bool(results) if mode == "all"
+                                       else mode in results)
         budget = int(os.environ.get(
             "BENCH_PROBE_BUDGET_S", 900 if have_fallback else 10800))
         _log("probing backend (%s), budget %ds, fallback=%s..."

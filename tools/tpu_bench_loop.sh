@@ -36,7 +36,21 @@ sys.exit(0 if r.get('measured_at', '') >= '$LOOP_START' else 1)" 2>/dev/null; th
       timeout 3600 python tools/flash_sweep.py --seq 512 1024 2048 \
         --json tools/flash_sweep_r3.json \
         || echo "[loop] sweep failed (rerun manually)"
-      echo "[loop] $(date -u +%T) sweep done; hardware pallas tests"
+      echo "[loop] $(date -u +%T) sweep done; batch/remat sweep (MFU hunt)"
+      SWEEP_OUT=tools/batch_sweep_r3.jsonl
+      : > "$SWEEP_OUT"
+      for args in "bert --batch=64" "bert --batch=128" "bert --batch=256" \
+                  "bert512 --batch=32" "bert512 --batch=32 --remat" \
+                  "bert512 --batch=64 --remat"; do
+        echo "[loop] bench $args"
+        # durable copy in-repo (the /tmp loop log is not) — one JSON line per
+        # config, tagged with its args
+        printf '{"args": "%s"}\n' "$args" >> "$SWEEP_OUT"
+        BENCH_PROBE_BUDGET_S=300 timeout 2400 python bench.py $args \
+          >> "$SWEEP_OUT" \
+          || echo "[loop] bench $args failed (rc=$?)"
+      done
+      echo "[loop] $(date -u +%T) hardware pallas tests"
       timeout 1800 python -m pytest \
         tests/test_pallas_tpu.py -q -p no:cacheprovider \
         > /tmp/pallas_hw_tests.log 2>&1

@@ -820,6 +820,84 @@ def _trunc_conv(ctx, s, ins, out):
 register_converter("fix")(_CONVERTERS["trunc"])
 
 
+def _emit_grid_sample(ctx, data, grid_nchw, out):
+    """grid (N, 2, H, W) [-1,1] (x,y) → ONNX GridSample's (N, H, W, 2);
+    MXNet's corner mapping x_src=(x+1)(W-1)/2 IS align_corners=1, and its
+    zero out-of-bounds taps are padding_mode='zeros'."""
+    if ctx.opset < 16:
+        raise ValueError("BilinearSampler/SpatialTransformer export needs "
+                         "opset>=16 (GridSample); pass opset=16 to "
+                         "export_model")
+    gt = ctx.fresh("gs_grid")
+    ctx.emit("Transpose", [grid_nchw], [gt], attrs={"perm": [0, 2, 3, 1]})
+    ctx.emit("GridSample", [data, gt], [out],
+             attrs={"mode": "bilinear", "padding_mode": "zeros",
+                    "align_corners": 1})
+
+
+@register_converter("BilinearSampler")
+def _bilinear_sampler_conv(ctx, s, ins, out):
+    _emit_grid_sample(ctx, ins[0], ins[1], out)
+
+
+def _emit_affine_grid(ctx, theta_name, H, W, hint, out=None):
+    """theta (N, 6) → grid (N, 2, H, W): one MatMul against the constant
+    homogeneous base grid (the whole GridGenerator as MXU work). Writes to
+    ``out`` when given, else a fresh name (returned either way)."""
+    ys = np.linspace(-1.0, 1.0, H)
+    xs = np.linspace(-1.0, 1.0, W)
+    gy, gx = np.meshgrid(ys, xs, indexing="ij")
+    base = np.stack([gx.ravel(), gy.ravel(),
+                     np.ones_like(gx).ravel()]).astype(np.float32)
+    th = ctx.fresh("%s_theta" % hint)
+    ctx.emit("Reshape", [theta_name, ctx.const(
+        "tshape", np.asarray([0, 2, 3], np.int64))], [th])
+    mm = ctx.fresh("%s_mm" % hint)
+    ctx.emit("MatMul", [th, ctx.const("base", base)], [mm])
+    grid = out or ctx.fresh("%s_grid" % hint)
+    ctx.emit("Reshape", [mm, ctx.const(
+        "gshape", np.asarray([0, 2, H, W], np.int64))], [grid])
+    return grid
+
+
+@register_converter("GridGenerator")
+def _grid_generator_conv(ctx, s, ins, out):
+    a = s._attrs
+    tt = a.get("transform_type", "affine")
+    if tt == "affine":
+        H, W = a["target_shape"]
+        _emit_affine_grid(ctx, ins[0], int(H), int(W), "gg", out=out)
+        return
+    if tt != "warp":
+        raise ValueError("GridGenerator export: transform_type %r" % tt)
+    # warp: ((flow + identity_pixel_grid) * 2/(dim-1)) - 1
+    shape = s._inputs[0].shape      # (N, 2, H, W)
+    H, W = int(shape[2]), int(shape[3])
+    gy, gx = np.meshgrid(np.arange(H, dtype=np.float32),
+                         np.arange(W, dtype=np.float32), indexing="ij")
+    ident = ctx.const("ident", np.stack([gx, gy])[None])       # (1,2,H,W)
+    scale = ctx.const("scale", np.asarray(
+        [2.0 / max(W - 1, 1), 2.0 / max(H - 1, 1)],
+        np.float32).reshape(1, 2, 1, 1))
+    sm = ctx.fresh("gg_sum")
+    ctx.emit("Add", [ins[0], ident], [sm])
+    sc = ctx.fresh("gg_scaled")
+    ctx.emit("Mul", [sm, scale], [sc])
+    one = ctx.const("one", np.float32(1.0))
+    ctx.emit("Sub", [sc, one], [out])
+
+
+@register_converter("SpatialTransformer")
+def _spatial_transformer_conv(ctx, s, ins, out):
+    a = s._attrs
+    if a.get("transform_type", "affine") != "affine":
+        raise ValueError("SpatialTransformer export: affine only")
+    tshape = a.get("target_shape") or s._inputs[0].shape[2:]
+    H, W = int(tshape[0]), int(tshape[1])
+    grid = _emit_affine_grid(ctx, ins[1], H, W, "st")
+    _emit_grid_sample(ctx, ins[0], grid, out)
+
+
 # ---- Module-era output heads: inference semantics (the label input and
 # grad_scale only shape the backward, which ONNX doesn't carry)
 @register_converter("SoftmaxOutput")

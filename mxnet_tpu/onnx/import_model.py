@@ -916,6 +916,23 @@ def _tile_imp(g, node):
     return _make("tile", g.inp(node["inputs"][0]), reps=reps)
 
 
+@register_importer("GridSample")
+def _grid_sample_imp(g, node):
+    a = node["attrs"]
+    mode = a.get("mode", "bilinear")
+    if mode not in ("bilinear", "linear"):
+        raise ValueError("GridSample import: mode %r unsupported" % mode)
+    if a.get("padding_mode", "zeros") != "zeros":
+        raise ValueError("GridSample import: padding_mode %r unsupported"
+                         % a.get("padding_mode"))
+    if not int(a.get("align_corners", 0)):
+        # BilinearSampler's corner mapping IS align_corners=1; the default
+        # (half-pixel) mapping would shift every sample
+        raise ValueError("GridSample import: align_corners=0 unsupported")
+    grid = _make("transpose", g.inp(node["inputs"][1]), axes=(0, 3, 1, 2))
+    return _make("BilinearSampler", g.inp(node["inputs"][0]), grid)
+
+
 @register_importer("RoiAlign")
 def _roi_align_imp(g, node):
     """sampling_ratio=0 (the spec's adaptive mode) is approximated with a

@@ -687,3 +687,48 @@ def test_onnx_output_heads_and_roialign_roundtrip():
         return t
 
     _roundtrip_eval(build, {"a": y, "b": img, "c": rois}, rtol=1e-4)
+
+
+def test_onnx_spatial_transformer_family_roundtrip_opset16():
+    """BilinearSampler/GridGenerator/SpatialTransformer via opset-16
+    GridSample (grid layout transpose, align_corners=1, zero padding)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, sym
+    from mxnet_tpu import onnx as mxonnx
+    from mxnet_tpu.onnx import proto as P
+
+    rs = np.random.RandomState(15)
+    img = rs.randn(2, 3, 8, 8).astype(np.float32)
+    # slightly-off-identity affine + a warp flow field
+    theta = np.tile(np.array([[1.0, 0.1, 0.0, -0.1, 0.9, 0.05]], np.float32),
+                    (2, 1))
+    flow = (0.5 * rs.randn(2, 2, 8, 8)).astype(np.float32)
+
+    d = sym.var("d", shape=img.shape)
+    t = sym.var("t", shape=theta.shape)
+    f = sym.var("f", shape=flow.shape)
+    g = sym.Group([
+        sym.SpatialTransformer(d, t, target_shape=(8, 8)),
+        sym.BilinearSampler(d, sym.GridGenerator(f, transform_type="warp")),
+        sym.BilinearSampler(d, sym.GridGenerator(t, transform_type="affine",
+                                                 target_shape=(6, 6))),
+    ])
+    feeds = dict(d=nd.array(img), t=nd.array(theta), f=nd.array(flow))
+    want = [o.asnumpy() for o in g.eval(**feeds)]
+
+    buf = mxonnx.symbol_to_onnx(g, {}, input_shapes={
+        "d": img.shape, "t": theta.shape, "f": flow.shape}, opset=16)
+    P.check_model(buf)
+    s2, args, _ = mxonnx.import_model(buf)
+    got = [o.asnumpy() for o in s2.eval(
+        **feeds, **{k: nd.array(v) for k, v in args.items()})]
+    for w, gt_ in zip(want, got):
+        np.testing.assert_allclose(gt_, w, rtol=1e-4, atol=1e-5)
+
+    # opset-13 export of GridSample consumers must refuse loudly
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="opset"):
+        mxonnx.symbol_to_onnx(
+            sym.BilinearSampler(d, sym.GridGenerator(
+                t, transform_type="affine", target_shape=(4, 4))),
+            {}, input_shapes={"d": img.shape, "t": theta.shape}, opset=13)

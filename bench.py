@@ -491,6 +491,13 @@ def run_mode(mode, results, smoke=False, iters=None, headline=False,
     }
     if mfu_fn is not None:
         rec["mfu"] = round(mfu_fn(per_sec), 4)
+    try:
+        from mxnet_tpu.profiler import device_memory_summary
+        mem = device_memory_summary()
+        if mem.get("peak_bytes_in_use"):
+            rec["hbm_peak_gb"] = round(mem["peak_bytes_in_use"] / 2**30, 3)
+    except Exception:
+        pass
     if not smoke and batch_override is None and not remat \
             and rec["platform"] not in ("cpu",):
         _save_result(mode, rec)

@@ -274,3 +274,32 @@ class Marker:
 if os.environ.get("MXNET_PROFILER_AUTOSTART", "0").lower() in ("1", "true", "yes", "on"):
     _config["profile_all"] = True
     start()
+
+
+def device_memory_summary(device=None):
+    """Live per-device memory stats (ref: MXNET_PROFILER memory counters /
+    src/profiler/storage_profiler.h — there a storage-allocator hook; here
+    the XLA client's own accounting, which is authoritative on TPU since
+    jax owns the HBM pool).
+
+    Returns {"bytes_in_use", "peak_bytes_in_use", "bytes_limit", ...} —
+    whatever the backend reports (CPU backends may return {}).
+    """
+    import jax
+
+    dev = device or jax.devices()[0]
+    stats = getattr(dev, "memory_stats", lambda: None)()
+    return dict(stats) if stats else {}
+
+
+def dump_memory(path=None, device=None):
+    """Write (or return) the device memory summary as JSON — the quick
+    'how much HBM is this model using' answer during bench/batch sweeps."""
+    import json as _json
+
+    stats = device_memory_summary(device)
+    text = _json.dumps(stats, indent=1, sort_keys=True, default=int)
+    if path:
+        with open(path, "w") as f:
+            f.write(text + "\n")
+    return stats

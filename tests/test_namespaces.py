@@ -186,3 +186,12 @@ def test_test_utils_symbolic_checks():
     test_utils.assert_exception(lambda: 1 / 0, ZeroDivisionError)
     with pytest.raises(AssertionError):
         test_utils.assert_exception(lambda: None, ValueError)
+
+
+def test_profiler_memory_summary():
+    from mxnet_tpu import profiler
+
+    s = profiler.device_memory_summary()
+    assert isinstance(s, dict)  # CPU backends may report nothing
+    out = profiler.dump_memory()
+    assert isinstance(out, dict)

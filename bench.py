@@ -494,8 +494,14 @@ def run_mode(mode, results, smoke=False, iters=None, headline=False,
     try:
         from mxnet_tpu.profiler import device_memory_summary
         mem = device_memory_summary()
+        # in_use is per-mode accurate (this mode's buffers are live here);
+        # the peak is PROCESS-lifetime — in `all` mode it covers every mode
+        # run so far, hence the explicit name
+        if mem.get("bytes_in_use"):
+            rec["hbm_gb_in_use"] = round(mem["bytes_in_use"] / 2**30, 3)
         if mem.get("peak_bytes_in_use"):
-            rec["hbm_peak_gb"] = round(mem["peak_bytes_in_use"] / 2**30, 3)
+            rec["hbm_process_peak_gb"] = round(
+                mem["peak_bytes_in_use"] / 2**30, 3)
     except Exception:
         pass
     if not smoke and batch_override is None and not remat \

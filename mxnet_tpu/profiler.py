@@ -293,13 +293,14 @@ def device_memory_summary(device=None):
 
 
 def dump_memory(path=None, device=None):
-    """Write (or return) the device memory summary as JSON — the quick
-    'how much HBM is this model using' answer during bench/batch sweeps."""
-    import json as _json
-
+    """Return the device memory summary dict; with ``path``, also write it
+    as JSON — the quick 'how much HBM is this model using' answer during
+    bench/batch sweeps."""
     stats = device_memory_summary(device)
-    text = _json.dumps(stats, indent=1, sort_keys=True, default=int)
     if path:
+        import json as _json
+
         with open(path, "w") as f:
-            f.write(text + "\n")
+            f.write(_json.dumps(stats, indent=1, sort_keys=True,
+                                default=int) + "\n")
     return stats

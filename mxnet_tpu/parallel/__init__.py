@@ -7,8 +7,9 @@ from . import tensor_parallel  # noqa: F401
 from .tensor_parallel import shard_params, param_specs, constrain  # noqa: F401
 from .ring_attention import ring_attention, full_attention  # noqa: F401
 from .ulysses import ulysses_attention  # noqa: F401
-from .pipeline import (pipeline_apply, pipeline_train_step_1f1b,  # noqa: F401
-                       stack_stage_params)
+from .pipeline import (pipeline_apply, pipeline_apply_interleaved,  # noqa: F401
+                       pipeline_train_step_1f1b, stack_stage_params,
+                       interleave_stage_params)
 from .expert_parallel import moe_ffn  # noqa: F401
 from .resilience import Heartbeat, ResumableLoop  # noqa: F401
 from . import distributed  # noqa: F401

@@ -77,6 +77,99 @@ def stack_stage_params(per_stage_params):
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *per_stage_params)
 
 
+def interleave_stage_params(per_stage_params, n_devices):
+    """Megatron virtual-chunk assignment: global stage g lives on device
+    g % n_devices as its local chunk g // n_devices. Reorders the stage list
+    so sharding the stacked leading dim over 'pp' gives each device ITS
+    chunks contiguously: row (d*v + j) = global stage (j*n_devices + d)."""
+    G = len(per_stage_params)
+    if G % n_devices:
+        raise ValueError("n_stages %d not divisible by n_devices %d"
+                         % (G, n_devices))
+    v = G // n_devices
+    order = [j * n_devices + d for d in range(n_devices) for j in range(v)]
+    return stack_stage_params([per_stage_params[g] for g in order])
+
+
+def pipeline_apply_interleaved(stage_fn, stage_params, microbatches, mesh,
+                               n_virtual, axis_name="pp"):
+    """Interleaved-schedule pipeline forward: each device holds ``n_virtual``
+    chunks (global stage g on device g % S — ``interleave_stage_params``
+    layout), so every microbatch rides the +1 ``ppermute`` ring v times.
+    Returning wavefronts take priority over fresh injection at device 0
+    (injection fills the bubbles) — the scan-friendly form of Megatron's
+    interleaved 1F1B forward order. Same per-device work as a depth-S*v
+    pipeline; the interleave cuts pipeline-fill latency by ~v.
+
+    stage_fn(params, x) -> y, uniform activation shape; stage_params leaves
+    (S*v, ...) in interleaved row order, sharded over `axis_name`.
+    microbatches (n_micro, mb, ...) replicated; returns (n_micro, ...) after
+    ALL S*v stages.
+    """
+    sm = get_shard_map()
+    v = int(n_virtual)
+    S = int(mesh.shape[axis_name])
+    G = S * v
+    n_micro = microbatches.shape[0]
+    # device 0 is busy every tick while injections remain (each microbatch
+    # costs exactly v device-0 slots), so the last output lands at tick
+    # n_micro*v + G - 2 — no slack needed
+    ticks = n_micro * v + G - 1
+
+    def local(params, xs):
+        # params leaves arrive as this device's (v, ...) chunk block
+        stage = lax.axis_index(axis_name)
+        perm = [(j, (j + 1) % S) for j in range(S)]
+
+        zero_x = jnp.zeros_like(xs[0])
+        outputs = jnp.zeros((n_micro,) + xs.shape[1:], xs.dtype)
+
+        def tick(carry, _):
+            rx, rg, rmb, n_inj, outputs = carry
+            # device 0: returning wavefront (rg >= 0) beats fresh injection
+            ring_valid = rg >= 0
+            can_inject = (stage == 0) & (~ring_valid) & (n_inj < n_micro)
+            g = jnp.where(ring_valid, jnp.maximum(rg, 0),
+                          jnp.where(can_inject, 0, -1))
+            mb = jnp.where(ring_valid, rmb,
+                           jnp.where(can_inject, n_inj, -1))
+            x_in = jnp.where(ring_valid, rx,
+                             xs[jnp.clip(mb, 0, n_micro - 1)])
+            n_inj = n_inj + can_inject
+
+            chunk = jnp.clip(g // S, 0, v - 1)
+            p = jax.tree_util.tree_map(
+                lambda a: lax.dynamic_index_in_dim(a, chunk, 0,
+                                                   keepdims=False), params)
+            y = stage_fn(p, x_in)
+            valid = g >= 0
+            g_next = jnp.where(valid, g + 1, -1)
+            done = valid & (g_next == G)
+            outputs = lax.cond(
+                done,
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, y.astype(o.dtype), jnp.clip(mb, 0, n_micro - 1), 0),
+                lambda o: o, outputs)
+            send_g = jnp.where(valid & ~done, g_next, -1)
+            send_mb = jnp.where(valid & ~done, mb, -1)
+            send_x = jnp.where(valid & ~done, y, zero_x)
+            rx2, rg2, rmb2 = lax.ppermute((send_x, send_g, send_mb),
+                                          axis_name, perm)
+            return (rx2, rg2, rmb2, n_inj, outputs), None
+
+        init = (zero_x, jnp.int32(-1), jnp.int32(-1), jnp.int32(0), outputs)
+        carry, _ = lax.scan(tick, init, None, length=ticks)
+        outputs = carry[-1]
+        # results were written on device (G-1) % S == S-1; broadcast
+        mask = (stage == S - 1).astype(outputs.dtype)
+        return lax.psum(outputs * mask, axis_name)
+
+    pspec = jax.tree_util.tree_map(lambda _: P(axis_name), stage_params,
+                                   is_leaf=lambda a: hasattr(a, "shape"))
+    f = sm(local, mesh, in_specs=(pspec, P()), out_specs=P())
+    return f(stage_params, microbatches)
+
+
 def pipeline_train_step_1f1b(stage_fn, loss_fn, stage_params, microbatches,
                              targets, mesh, axis_name="pp"):
     """One-forward-one-backward (PipeDream-flush) pipelined training step.

@@ -18,6 +18,46 @@ from ..base import is_tpu_backend, register_op
 
 _FLASH_MIN_LEN = 256  # below this, XLA's fused unblocked attention wins
 
+import threading
+
+_SP_SCOPE = threading.local()
+
+
+class sequence_parallel_scope:
+    """Route every ``F.scaled_dot_attention`` inside the scope through
+    sequence-parallel attention over ``mesh``'s ``axis_name`` axis —
+    ``impl='ring'`` (ppermute ring, any head count) or ``'ulysses'``
+    (all_to_all head scatter, needs H % axis == 0). Models need no edits;
+    this is how a single-chip model becomes a long-context sp model.
+    Exposed as ``mxnet_tpu.parallel.sequence_parallel_scope``.
+
+    The scope is consulted AT TRACE TIME: a ``jax.jit``/``hybridize`` cache
+    entry keeps whichever dispatch was active when it was first traced
+    (same contract as ``autograd.train_mode`` and the keyed-jit stochastic
+    executors) — enter the scope before the first call, and don't reuse a
+    function jitted outside it."""
+
+    def __init__(self, mesh, axis_name="sp", impl="ring"):
+        if impl not in ("ring", "ulysses"):
+            raise ValueError("impl must be 'ring' or 'ulysses', got %r"
+                             % (impl,))
+        self._cfg = (mesh, axis_name, impl)
+
+    def __enter__(self):
+        stack = getattr(_SP_SCOPE, "stack", None)
+        if stack is None:
+            stack = _SP_SCOPE.stack = []
+        stack.append(self._cfg)
+        return self
+
+    def __exit__(self, *a):
+        _SP_SCOPE.stack.pop()
+
+
+def _current_sp_scope():
+    stack = getattr(_SP_SCOPE, "stack", None)
+    return stack[-1] if stack else None
+
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
 def _dense_attention_core(q, k, v, bias, scale):
@@ -115,7 +155,44 @@ def scaled_dot_attention(q, k, v, mask=None, *, causal=False, scale=None,
     key-padding prefix (mask[b, ..., t] = t < valid_len[b], BERT-style) —
     then the O(T)-memory flash path applies with a per-example valid length
     recovered as the mask's row sum, instead of falling back to the dense
-    T×T reference the way arbitrary masks must."""
+    T×T reference the way arbitrary masks must.
+
+    Inside ``parallel.sequence_parallel_scope(mesh, ...)`` this seam
+    dispatches to ring/ulysses attention over the scope's mesh axis — the
+    model code doesn't change, the sequence dimension just shards."""
+    sp = _current_sp_scope()
+    if sp is not None:
+        mesh, axis_name, impl = sp
+        if mask is not None:
+            raise ValueError(
+                "sequence_parallel_scope: ring/ulysses attention supports "
+                "causal or unmasked only — key-padding masks would need "
+                "per-shard valid lengths (pad to full length instead)")
+        n_sp = int(mesh.shape[axis_name])
+        if q.shape[2] % n_sp or k.shape[2] % n_sp:
+            raise ValueError(
+                "sequence_parallel_scope: sequence length %d/%d must divide "
+                "the %r axis (%d) — incremental decode (T=1) and ragged "
+                "lengths cannot shard; run generation outside the scope"
+                % (q.shape[2], k.shape[2], axis_name, n_sp))
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as _P
+
+        from ..parallel import ring_attention, ulysses_attention
+
+        fn = ring_attention if impl == "ring" else ulysses_attention
+        # eager NDArray data is committed to one device; the shard_map needs
+        # the whole mesh, so reshard in and gather back out to the caller's
+        # original placement. Inside a single-device jit both puts are
+        # no-ops; users doing whole-program mesh sharding should call
+        # parallel.ring_attention directly.
+        orig = getattr(q, "sharding", None)  # None for tracers
+        s_in = NamedSharding(mesh, _P(None, None, axis_name, None))
+        q, k, v = (jax.device_put(a, s_in) for a in (q, k, v))
+        out = fn(q, k, v, mesh, axis_name=axis_name, causal=causal,
+                 scale=scale)
+        return jax.device_put(out, orig if orig is not None
+                              else mesh.devices.flat[0])
     if (is_tpu_backend() and q.shape[2] >= _FLASH_MIN_LEN
             and (mask is None or prefix_mask)):
         try:

@@ -11,6 +11,7 @@ from .pipeline import (pipeline_apply, pipeline_apply_interleaved,  # noqa: F401
                        pipeline_train_step_1f1b, stack_stage_params,
                        interleave_stage_params)
 from .expert_parallel import moe_ffn  # noqa: F401
+from ..ops.attention import sequence_parallel_scope  # noqa: F401
 from .resilience import Heartbeat, ResumableLoop  # noqa: F401
 from . import distributed  # noqa: F401
 from .distributed import init_process_group, global_mesh  # noqa: F401

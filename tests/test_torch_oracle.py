@@ -1,0 +1,182 @@
+"""Cross-framework numerics: core layers vs torch (CPU) with matched
+weights — an INDEPENDENT oracle, unlike the numpy refs we wrote ourselves
+(mirrors how reference tests validate against external implementations).
+torch is inference-only here; no torch autograd is used."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from mxnet_tpu import nd
+
+
+def _t(x):
+    return torch.from_numpy(np.asarray(x))
+
+
+def test_conv2d_vs_torch():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2, 3, 11, 13)).astype(np.float32)
+    w = rng.normal(size=(5, 3, 3, 3)).astype(np.float32)
+    b = rng.normal(size=(5,)).astype(np.float32)
+    for stride, pad, dil in ((1, 1, 1), (2, 0, 1), (2, 2, 2)):
+        got = nd.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                             kernel=(3, 3), num_filter=5,
+                             stride=(stride, stride), pad=(pad, pad),
+                             dilate=(dil, dil)).asnumpy()
+        want = torch.nn.functional.conv2d(
+            _t(x), _t(w), _t(b), stride=stride, padding=pad,
+            dilation=dil).numpy()
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_grouped_and_depthwise_conv_vs_torch():
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(1, 8, 9, 9)).astype(np.float32)
+    w = rng.normal(size=(8, 1, 3, 3)).astype(np.float32)  # depthwise
+    got = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                         num_filter=8, num_group=8, pad=(1, 1),
+                         no_bias=True).asnumpy()
+    want = torch.nn.functional.conv2d(_t(x), _t(w), padding=1,
+                                      groups=8).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_deconv_vs_torch():
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(2, 4, 6, 6)).astype(np.float32)
+    w = rng.normal(size=(4, 3, 3, 3)).astype(np.float32)
+    got = nd.Deconvolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                           num_filter=3, stride=(2, 2), pad=(1, 1),
+                           adj=(1, 1), no_bias=True).asnumpy()
+    want = torch.nn.functional.conv_transpose2d(
+        _t(x), _t(w), stride=2, padding=1, output_padding=1).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_batchnorm_layernorm_groupnorm_vs_torch():
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(4, 6, 5, 5)).astype(np.float32)
+    g = rng.normal(size=(6,)).astype(np.float32)
+    b = rng.normal(size=(6,)).astype(np.float32)
+    rm = rng.normal(size=(6,)).astype(np.float32)
+    rv = rng.uniform(0.5, 2.0, (6,)).astype(np.float32)
+
+    got = nd.BatchNorm(nd.array(x), nd.array(g), nd.array(b), nd.array(rm),
+                       nd.array(rv), use_global_stats=True, eps=1e-5)
+    got = (got[0] if isinstance(got, (list, tuple)) else got).asnumpy()
+    want = torch.nn.functional.batch_norm(
+        _t(x), _t(rm), _t(rv), _t(g), _t(b), training=False,
+        eps=1e-5).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    xl = rng.normal(size=(3, 7, 10)).astype(np.float32)
+    gl = rng.normal(size=(10,)).astype(np.float32)
+    bl = rng.normal(size=(10,)).astype(np.float32)
+    got = nd.LayerNorm(nd.array(xl), nd.array(gl), nd.array(bl),
+                       axis=-1, eps=1e-5).asnumpy()
+    want = torch.nn.functional.layer_norm(_t(xl), (10,), _t(gl), _t(bl),
+                                          eps=1e-5).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    got = nd.GroupNorm(nd.array(x), nd.array(g), nd.array(b), num_groups=3,
+                       eps=1e-5).asnumpy()
+    want = torch.nn.functional.group_norm(_t(x), 3, _t(g), _t(b),
+                                          eps=1e-5).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_pooling_vs_torch():
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(2, 3, 9, 9)).astype(np.float32)
+    got = nd.Pooling(nd.array(x), kernel=(3, 3), stride=(2, 2), pad=(1, 1),
+                     pool_type="max").asnumpy()
+    want = torch.nn.functional.max_pool2d(_t(x), 3, stride=2,
+                                          padding=1).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    got = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                     pool_type="avg", count_include_pad=True).asnumpy()
+    want = torch.nn.functional.avg_pool2d(_t(x), 2, stride=2).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_activations_vs_torch():
+    x = np.linspace(-4, 4, 41, dtype=np.float32)
+    pairs = [
+        (nd.Activation(nd.array(x), act_type="gelu"),
+         torch.nn.functional.gelu(_t(x), approximate="none")),
+        (nd.LeakyReLU(nd.array(x), act_type="elu", slope=1.0),
+         torch.nn.functional.elu(_t(x))),
+        (nd.LeakyReLU(nd.array(x), act_type="selu"),
+         torch.nn.functional.selu(_t(x))),
+        (nd.mish(nd.array(x)), torch.nn.functional.mish(_t(x))),
+        (nd.log_sigmoid(nd.array(x)),
+         torch.nn.functional.logsigmoid(_t(x))),
+        (nd.softmax(nd.array(x[None]), axis=-1),
+         torch.nn.functional.softmax(_t(x[None]), dim=-1)),
+    ]
+    for got, want in pairs:
+        np.testing.assert_allclose(got.asnumpy(), want.numpy(),
+                                   rtol=2e-4, atol=1e-5)
+
+
+def test_embedding_and_dense_vs_torch():
+    rng = np.random.default_rng(5)
+    table = rng.normal(size=(20, 8)).astype(np.float32)
+    idx = rng.integers(0, 20, (3, 4))
+    got = nd.Embedding(nd.array(idx.astype(np.float32)), nd.array(table),
+                       input_dim=20, output_dim=8).asnumpy()
+    want = torch.nn.functional.embedding(_t(idx), _t(table)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    x = rng.normal(size=(4, 8)).astype(np.float32)
+    w = rng.normal(size=(5, 8)).astype(np.float32)
+    b = rng.normal(size=(5,)).astype(np.float32)
+    got = nd.FullyConnected(nd.array(x), nd.array(w), nd.array(b),
+                            num_hidden=5).asnumpy()
+    want = torch.nn.functional.linear(_t(x), _t(w), _t(b)).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_attention_vs_torch_sdpa():
+    rng = np.random.default_rng(6)
+    q = rng.normal(size=(2, 2, 16, 8)).astype(np.float32)
+    k = rng.normal(size=(2, 2, 16, 8)).astype(np.float32)
+    v = rng.normal(size=(2, 2, 16, 8)).astype(np.float32)
+    for causal in (False, True):
+        got = nd.scaled_dot_attention(nd.array(q), nd.array(k), nd.array(v),
+                                      causal=causal).asnumpy()
+        want = torch.nn.functional.scaled_dot_product_attention(
+            _t(q), _t(k), _t(v), is_causal=causal).numpy()
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_lstm_vs_torch():
+    rng = np.random.default_rng(7)
+    T, N, C, H = 5, 3, 4, 6
+    x = rng.normal(size=(T, N, C)).astype(np.float32)
+    wih = rng.normal(size=(4 * H, C)).astype(np.float32) * 0.3
+    whh = rng.normal(size=(4 * H, H)).astype(np.float32) * 0.3
+    bih = rng.normal(size=(4 * H,)).astype(np.float32) * 0.1
+    bhh = rng.normal(size=(4 * H,)).astype(np.float32) * 0.1
+    h0 = np.zeros((1, N, H), np.float32)
+    c0 = np.zeros((1, N, H), np.float32)
+
+    out, hT, cT = nd.RNN(nd.array(x), nd.array(h0), nd.array(c0),
+                         nd.array(wih), nd.array(whh), nd.array(bih),
+                         nd.array(bhh), mode="lstm", num_layers=1)
+
+    lstm = torch.nn.LSTM(C, H, 1)
+    with torch.no_grad():
+        # torch gate order [i, f, g, o] matches MXNet's
+        lstm.weight_ih_l0.copy_(_t(wih))
+        lstm.weight_hh_l0.copy_(_t(whh))
+        lstm.bias_ih_l0.copy_(_t(bih))
+        lstm.bias_hh_l0.copy_(_t(bhh))
+        want, (whT, wcT) = lstm(_t(x))
+    np.testing.assert_allclose(out.asnumpy(), want.numpy(), rtol=2e-4,
+                               atol=2e-4)
+    np.testing.assert_allclose(hT.asnumpy(), whT.numpy(), rtol=2e-4,
+                               atol=2e-4)
+    np.testing.assert_allclose(cT.asnumpy(), wcT.numpy(), rtol=2e-4,
+                               atol=2e-4)

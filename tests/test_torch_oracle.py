@@ -180,3 +180,89 @@ def test_lstm_vs_torch():
                                atol=2e-4)
     np.testing.assert_allclose(cT.asnumpy(), wcT.numpy(), rtol=2e-4,
                                atol=2e-4)
+
+
+def test_gru_and_tanh_rnn_vs_torch():
+    rng = np.random.default_rng(8)
+    T, N, C, H = 4, 2, 3, 5
+    x = rng.normal(size=(T, N, C)).astype(np.float32)
+    h0 = np.zeros((1, N, H), np.float32)
+    c0 = np.zeros((1, N, H), np.float32)
+    for mode, tmod, gates in (("gru", torch.nn.GRU, 3),
+                              ("rnn_tanh", torch.nn.RNN, 1)):
+        wih = (rng.normal(size=(gates * H, C)) * 0.3).astype(np.float32)
+        whh = (rng.normal(size=(gates * H, H)) * 0.3).astype(np.float32)
+        bih = (rng.normal(size=(gates * H,)) * 0.1).astype(np.float32)
+        bhh = (rng.normal(size=(gates * H,)) * 0.1).astype(np.float32)
+        out, hT, _ = nd.RNN(nd.array(x), nd.array(h0), nd.array(c0),
+                            nd.array(wih), nd.array(whh), nd.array(bih),
+                            nd.array(bhh), mode=mode, num_layers=1)
+        tr = tmod(C, H, 1)
+        with torch.no_grad():
+            tr.weight_ih_l0.copy_(_t(wih))
+            tr.weight_hh_l0.copy_(_t(whh))
+            tr.bias_ih_l0.copy_(_t(bih))
+            tr.bias_hh_l0.copy_(_t(bhh))
+            want, _ = tr(_t(x))
+        np.testing.assert_allclose(out.asnumpy(), want.numpy(), rtol=2e-4,
+                                   atol=2e-4, err_msg=mode)
+
+
+def test_conv1d_conv3d_vs_torch():
+    rng = np.random.default_rng(9)
+    x1 = rng.normal(size=(2, 3, 15)).astype(np.float32)
+    w1 = rng.normal(size=(4, 3, 5)).astype(np.float32)
+    got = nd.Convolution(nd.array(x1), nd.array(w1), kernel=(5,),
+                         num_filter=4, stride=(2,), pad=(2,),
+                         no_bias=True).asnumpy()
+    want = torch.nn.functional.conv1d(_t(x1), _t(w1), stride=2,
+                                      padding=2).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    x3 = rng.normal(size=(1, 2, 5, 6, 7)).astype(np.float32)
+    w3 = rng.normal(size=(3, 2, 3, 3, 3)).astype(np.float32)
+    got = nd.Convolution(nd.array(x3), nd.array(w3), kernel=(3, 3, 3),
+                         num_filter=3, pad=(1, 1, 1), no_bias=True).asnumpy()
+    want = torch.nn.functional.conv3d(_t(x3), _t(w3), padding=1).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_adam_and_sgd_momentum_step_vs_torch():
+    """One optimizer step on identical params/grads — MXNet's Adam and
+    momentum-SGD formulas against torch.optim's."""
+    import mxnet_tpu as mx
+
+    rng = np.random.default_rng(10)
+    w0 = rng.normal(size=(7,)).astype(np.float32)
+    g = rng.normal(size=(7,)).astype(np.float32)
+
+    # Adam (bias-corrected, eps outside sqrt in both)
+    opt = mx.optimizer.Adam(learning_rate=0.1, beta1=0.9, beta2=0.999,
+                            epsilon=1e-8, wd=0.0)
+    w = nd.array(w0.copy())
+    state = opt.create_state(0, w)
+    state = opt.update(0, w, nd.array(g), state)
+
+    tw = torch.nn.Parameter(_t(w0.copy()))
+    topt = torch.optim.Adam([tw], lr=0.1, betas=(0.9, 0.999), eps=1e-8)
+    tw.grad = _t(g)
+    topt.step()
+    np.testing.assert_allclose(w.asnumpy(), tw.detach().numpy(), rtol=1e-5,
+                               atol=1e-6)
+
+    # SGD + momentum: MXNet uses v = m*v + (g + wd*w); w -= lr*v — torch's
+    # formulation matches with dampening=0
+    opt = mx.optimizer.SGD(learning_rate=0.05, momentum=0.9, wd=0.0)
+    w = nd.array(w0.copy())
+    state = opt.create_state(0, w)
+    for _ in range(3):
+        state = opt.update(0, w, nd.array(g), state)
+
+    tw = torch.nn.Parameter(_t(w0.copy()))
+    topt = torch.optim.SGD([tw], lr=0.05, momentum=0.9)
+    for _ in range(3):
+        topt.zero_grad()
+        tw.grad = _t(g)
+        topt.step()
+    np.testing.assert_allclose(w.asnumpy(), tw.detach().numpy(), rtol=1e-5,
+                               atol=1e-6)

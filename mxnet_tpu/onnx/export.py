@@ -1165,11 +1165,9 @@ def _upsampling_conv(ctx, s, ins, out):
     ctx.emit("Resize", [ins[0], "", scales], [out], attrs=attrs)
 
 
-@register_converter("BilinearResize2D")
-def _bilinear_resize_conv(ctx, s, ins, out):
+def _emit_linear_resize(ctx, s, ins, out, ctm):
     a = s._attrs
-    attrs = {"mode": "linear",
-             "coordinate_transformation_mode": "half_pixel"}
+    attrs = {"mode": "linear", "coordinate_transformation_mode": ctm}
     if a.get("height") is not None:
         n, c = s._inputs[0].shape[:2]
         sizes = ctx.const("sizes", np.asarray(
@@ -1180,6 +1178,16 @@ def _bilinear_resize_conv(ctx, s, ins, out):
             [1.0, 1.0, float(a["scale_height"]), float(a["scale_width"])],
             np.float32))
         ctx.emit("Resize", [ins[0], "", scales], [out], attrs=attrs)
+
+
+@register_converter("BilinearResize2D")
+def _bilinear_resize_conv(ctx, s, ins, out):
+    _emit_linear_resize(ctx, s, ins, out, "align_corners")
+
+
+@register_converter("_resize_linear_half_pixel")
+def _resize_half_pixel_conv(ctx, s, ins, out):
+    _emit_linear_resize(ctx, s, ins, out, "half_pixel")
 
 
 def _slice_emit(ctx, src, start, end, axis, hint):

@@ -606,11 +606,23 @@ def _import_resize(g, node, scales, sizes):
         return _make("UpSampling", x, scale=int(s[2]), sample_type="nearest")
     if mode != "linear":
         raise ValueError("Resize mode %r unsupported" % mode)
+    ctm = a.get("coordinate_transformation_mode", "half_pixel")
+    if ctm == "align_corners":
+        if sizes is not None:
+            return _make("BilinearResize2D", x, height=int(sizes[2]),
+                         width=int(sizes[3]))
+        return _make("BilinearResize2D", x, scale_height=float(scales[2]),
+                     scale_width=float(scales[3]))
+    if ctm not in ("half_pixel", "pytorch_half_pixel"):
+        raise ValueError("linear Resize import: coordinate_transformation_"
+                         "mode %r unsupported" % ctm)
+    pt = ctm == "pytorch_half_pixel"
     if sizes is not None:
-        return _make("BilinearResize2D", x, height=int(sizes[2]),
-                     width=int(sizes[3]))
-    return _make("BilinearResize2D", x, scale_height=float(scales[2]),
-                 scale_width=float(scales[3]))
+        return _make("_resize_linear_half_pixel", x, height=int(sizes[2]),
+                     width=int(sizes[3]), pytorch_mode=pt)
+    return _make("_resize_linear_half_pixel", x,
+                 scale_height=float(scales[2]),
+                 scale_width=float(scales[3]), pytorch_mode=pt)
 
 
 @register_importer("Resize")

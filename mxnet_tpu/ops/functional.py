@@ -883,7 +883,54 @@ def UpSampling(x, *, scale=2, sample_type="nearest"):
 @register_op("BilinearResize2D")
 def BilinearResize2D(x, *, height=None, width=None, scale_height=None,
                      scale_width=None):
+    """ALIGN-CORNERS bilinear (src maps out pixel i to i·(H-1)/(h-1)) — the
+    reference's convention (src/operator/contrib/bilinear_resize-inl.h);
+    jax.image.resize's half-pixel centers would shift every sample (caught
+    by the torch-oracle test)."""
+    h = int(height) if height is not None else int(x.shape[2] * scale_height)
+    w = int(width) if width is not None else int(x.shape[3] * scale_width)
+    return _resize_bilinear_align_corners(x, h, w)
+
+
+def _resize_bilinear_align_corners(x, h, w):
+    H, W = x.shape[2], x.shape[3]
+    ys = (jnp.linspace(0.0, H - 1.0, h) if h > 1
+          else jnp.zeros((1,), jnp.float32))
+    xs = (jnp.linspace(0.0, W - 1.0, w) if w > 1
+          else jnp.zeros((1,), jnp.float32))
+    y0 = jnp.floor(ys).astype(jnp.int32)
+    x0 = jnp.floor(xs).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, H - 1)
+    x1 = jnp.minimum(x0 + 1, W - 1)
+    wy = (ys - y0).astype(x.dtype)[:, None]
+    wx = (xs - x0).astype(x.dtype)[None, :]
+    v00 = x[:, :, y0[:, None], x0[None, :]]
+    v01 = x[:, :, y0[:, None], x1[None, :]]
+    v10 = x[:, :, y1[:, None], x0[None, :]]
+    v11 = x[:, :, y1[:, None], x1[None, :]]
+    top = v00 * (1 - wx) + v01 * wx
+    bot = v10 * (1 - wx) + v11 * wx
+    return top * (1 - wy) + bot * wy
+
+
+@register_op("_resize_linear_half_pixel")
+def _resize_linear_half_pixel(x, *, height=None, width=None,
+                              scale_height=None, scale_width=None,
+                              pytorch_mode=False):
+    """Half-pixel-centers bilinear (the ONNX Resize default) — kept as its
+    own op so importing external half_pixel models stays exact while
+    BilinearResize2D keeps MXNet's align-corners parity. Scales resolve
+    against x's (static-under-trace) shape. antialias=False: ONNX Resize
+    has no antialiasing before opset 18, and jax's default triangle filter
+    on downscale would silently diverge from the producer's runtime."""
     n, c = x.shape[:2]
     h = int(height) if height is not None else int(x.shape[2] * scale_height)
     w = int(width) if width is not None else int(x.shape[3] * scale_width)
-    return jax.image.resize(x, (n, c, h, w), method="bilinear")
+    if pytorch_mode and (h == 1 or w == 1):
+        # pytorch_half_pixel maps a length-1 output dim to source 0 where
+        # half_pixel maps it mid-image — refuse rather than sample wrong
+        raise NotImplementedError(
+            "pytorch_half_pixel Resize with an output dim of 1 differs "
+            "from half_pixel and is not implemented")
+    return jax.image.resize(x, (n, c, h, w), method="bilinear",
+                            antialias=False)

@@ -124,3 +124,34 @@ def test_checker_passes_own_exports_and_torch_file(tmp_path):
 
 def test_checker_passes_loop_model():
     P.check_model(_loop_model(3))
+
+
+def test_torch_half_pixel_resize_import(tmp_path, monkeypatch):
+    """A genuine torch-exported half-pixel Resize (F.interpolate) must
+    import with exact numerics — while BilinearResize2D itself keeps
+    MXNet's align-corners convention (two distinct resize ops)."""
+    torch = pytest.importorskip("torch")
+    try:
+        from torch.onnx._internal.torchscript_exporter import \
+            onnx_proto_utils
+    except ImportError:
+        pytest.skip("torch exporter internals moved")
+    monkeypatch.setattr(onnx_proto_utils, "_add_onnxscript_fn",
+                        lambda b, c: b)
+
+    class Net(torch.nn.Module):
+        def forward(self, t):
+            return torch.nn.functional.interpolate(
+                t, scale_factor=2.0, mode="bilinear", align_corners=False,
+                recompute_scale_factor=False)
+
+    net = Net().eval()
+    tx = torch.randn(1, 2, 3, 4)
+    with torch.no_grad():
+        want = net(tx).numpy()
+    path = str(tmp_path / "resize_hp.onnx")
+    torch.onnx.export(net, (tx,), path, dynamo=False, opset_version=13,
+                      do_constant_folding=True)
+    blk = mxonnx.import_to_gluon(path)
+    got = blk(nd.array(tx.numpy())).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)

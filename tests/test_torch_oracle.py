@@ -266,3 +266,54 @@ def test_adam_and_sgd_momentum_step_vs_torch():
         topt.step()
     np.testing.assert_allclose(w.asnumpy(), tw.detach().numpy(), rtol=1e-5,
                                atol=1e-6)
+
+
+def test_ctc_loss_vs_torch():
+    """CTC forward algorithm (ragged labels, blank='first') vs
+    torch.nn.functional.ctc_loss — the trickiest dynamic-programming op."""
+    rng = np.random.default_rng(11)
+    N, T, V, L = 3, 12, 6, 4
+    pred = rng.normal(size=(N, T, V)).astype(np.float32)
+    labels = rng.integers(1, V, (N, L)).astype(np.float32)  # blank=0 excluded
+    lab_lens = np.array([4, 2, 3], np.float32)
+    pred_lens = np.array([12, 9, 10], np.float32)
+
+    got = nd.CTCLoss(nd.array(pred), nd.array(labels),
+                     nd.array(pred_lens), nd.array(lab_lens)).asnumpy()
+
+    logp = torch.log_softmax(_t(pred), dim=-1).transpose(0, 1)  # (T, N, V)
+    want = torch.nn.functional.ctc_loss(
+        logp, _t(labels.astype(np.int64)),
+        _t(pred_lens.astype(np.int64)), _t(lab_lens.astype(np.int64)),
+        blank=0, reduction="none").numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_bilinear_sampler_vs_torch_grid_sample():
+    rng = np.random.default_rng(12)
+    x = rng.normal(size=(2, 3, 7, 9)).astype(np.float32)
+    grid = rng.uniform(-1.2, 1.2, (2, 2, 5, 6)).astype(np.float32)  # (N,2,H,W)
+    got = nd.BilinearSampler(nd.array(x), nd.array(grid)).asnumpy()
+    tg = _t(np.moveaxis(grid, 1, -1))  # (N, H, W, 2) xy
+    want = torch.nn.functional.grid_sample(
+        _t(x), tg, mode="bilinear", padding_mode="zeros",
+        align_corners=True).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_bilinear_resize_vs_torch_interpolate():
+    rng = np.random.default_rng(13)
+    x = rng.normal(size=(2, 3, 6, 8)).astype(np.float32)
+    got = nd.BilinearResize2D(nd.array(x), height=11, width=5).asnumpy()
+    want = torch.nn.functional.interpolate(
+        _t(x), size=(11, 5), mode="bilinear", align_corners=True).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_nearest_upsampling_vs_torch():
+    rng = np.random.default_rng(14)
+    x = rng.normal(size=(1, 2, 4, 5)).astype(np.float32)
+    got = nd.UpSampling(nd.array(x), scale=3, sample_type="nearest").asnumpy()
+    want = torch.nn.functional.interpolate(_t(x), scale_factor=3,
+                                           mode="nearest").numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6)

@@ -37,6 +37,15 @@ import jax
 jax.config.update("jax_compilation_cache_dir",
                   os.environ["JAX_COMPILATION_CACHE_DIR"])
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+# Host-side model builds (_build_on_host) need the cpu backend ALONGSIDE the
+# relay: the sitecustomize-latched JAX_PLATFORMS=axon registers only axon, so
+# jax.local_devices(backend="cpu") would raise "Unknown backend cpu". Append
+# cpu — the first entry stays the default backend, so device placement of the
+# timed step is unchanged.
+_PLATS = os.environ.get("JAX_PLATFORMS", "")
+if _PLATS and "cpu" not in _PLATS.split(","):
+    jax.config.update("jax_platforms", _PLATS + ",cpu")
 import jax.numpy as jnp
 import numpy as np
 
@@ -334,6 +343,37 @@ def make_nmt_batch(rng, batch=NMT_BATCH, src_len=NMT_SRC_LEN,
     return src, tgt, labels
 
 
+def _build_on_host(thunk):
+    """Run model construction on the host CPU backend, then ship state to the
+    accelerator in ONE device_put.
+
+    Param init and the eager shape-materialization warmup (resnet/ssd) are
+    hundreds of tiny one-off ops; dispatching each through the axon relay was
+    observed to cost 20+ minutes PER MODEL before the first timed step. None
+    of that work needs the TPU — the jitted train step is the only hot path —
+    so it runs pinned to the host CPU backend and the finished params/opt-state
+    cross to the device once (keeping step-1 buffer donation valid).
+
+    BOTH scopes are required: the mxnet_tpu Context scope places `nd.array`
+    factory outputs, but parameter/optimizer init is raw jnp/jax.random
+    compute that only honors jax's own default-device setting — without
+    jax.default_device it would still dispatch through the relay."""
+    from mxnet_tpu import context as _ctx
+    try:
+        cpu_dev = jax.local_devices(backend="cpu")[0]
+    except RuntimeError:  # no cpu backend registered: build on the default
+        _log("cpu backend unavailable; building on the default device")
+        return thunk()
+    with _ctx.cpu(), jax.default_device(cpu_dev):
+        step, params, states = thunk()
+    # context-layer resolution, not jax.devices()[0]: under multi-controller
+    # jax that global list leads with host 0's device (context.py:50)
+    dev = _ctx.current_context().jax_device()
+    if dev.platform != "cpu":
+        params, states = jax.device_put((params, states), dev)
+    return step, params, states
+
+
 # mode -> (build_fn(smoke) -> (step, params, states, batch, units_per_step,
 #          metric, unit, baseline, mfu_fn or None))
 def _mode_spec(mode, rng, smoke=False, batch_override=None, remat=False):
@@ -342,7 +382,7 @@ def _mode_spec(mode, rng, smoke=False, batch_override=None, remat=False):
 
     if mode == "bert":
         b = _b(4 if smoke else BATCH)
-        step, params, states = build(remat=remat)
+        step, params, states = _build_on_host(lambda: build(remat=remat))
         return (step, params, states, make_batch(rng, b), b,
                 "bert_base_pretrain_samples_per_sec_per_chip", "samples/s",
                 BASELINE_SAMPLES_PER_SEC,
@@ -350,7 +390,8 @@ def _mode_spec(mode, rng, smoke=False, batch_override=None, remat=False):
                 / V5E_PEAK_BF16_FLOPS)
     if mode == "bert512":
         b = _b(2 if smoke else BERT512_BATCH)
-        step, params, states = build(seq=BERT512_SEQ, remat=remat)
+        step, params, states = _build_on_host(
+            lambda: build(seq=BERT512_SEQ, remat=remat))
         return (step, params, states,
                 make_batch(rng, b, BERT512_SEQ, BERT512_MASKED), b,
                 "bert_base_seq512_train_samples_per_sec_per_chip", "samples/s",
@@ -360,19 +401,19 @@ def _mode_spec(mode, rng, smoke=False, batch_override=None, remat=False):
                 / V5E_PEAK_BF16_FLOPS)
     if mode == "resnet50":
         b = _b(2 if smoke else RESNET_BATCH)
-        step, params, states = build_resnet()
+        step, params, states = _build_on_host(build_resnet)
         return (step, params, states, make_resnet_batch(rng, b), b,
                 "resnet50_train_images_per_sec_per_chip", "images/s",
                 RESNET_BASELINE_IMG_PER_SEC, None)
     if mode == "lstm":
         b = _b(4 if smoke else LSTM_BATCH)
-        step, params, states = build_lstm()
+        step, params, states = _build_on_host(build_lstm)
         return (step, params, states, make_lstm_batch(rng, b), b * LSTM_BPTT,
                 "lstm_ptb_train_tokens_per_sec_per_chip", "tokens/s",
                 LSTM_BASELINE_TOK_PER_SEC, None)
     if mode == "ssd512":
         b = _b(1 if smoke else SSD_BATCH)
-        step, params, states = build_ssd()
+        step, params, states = _build_on_host(build_ssd)
         return (step, params, states, make_ssd_batch(rng, b), b,
                 "ssd512_vgg16_train_images_per_sec_per_chip", "images/s",
                 SSD_BASELINE_IMG_PER_SEC, None)
@@ -380,7 +421,7 @@ def _mode_spec(mode, rng, smoke=False, batch_override=None, remat=False):
         b = _b(2 if smoke else NMT_BATCH)
         src_len = 16 if smoke else NMT_SRC_LEN
         tgt_len = 16 if smoke else NMT_TGT_LEN
-        step, params, states = build_nmt()
+        step, params, states = _build_on_host(build_nmt)
         return (step, params, states, make_nmt_batch(rng, b, src_len, tgt_len),
                 b * (src_len + tgt_len),
                 "transformer_nmt_train_tokens_per_sec_per_chip", "tokens/s",

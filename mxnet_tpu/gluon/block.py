@@ -436,7 +436,20 @@ class SymbolBlock(HybridBlock):
         self._inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
 
     def forward(self, *args):
-        from ..symbol import _eval_symbols
+        from ..symbol import Symbol as _Sym, _eval_symbols, _substitute
+
+        if any(isinstance(a, _Sym) for a in args):
+            # symbolic composition (export / enclosing trace): splice the
+            # caller's symbols in for the stored input vars — evaluating the
+            # graph would shove Symbols into op kernels
+            if not all(isinstance(a, _Sym) for a in args):
+                raise TypeError(
+                    "SymbolBlock symbolic call requires ALL inputs to be "
+                    "Symbols; mixing in arrays would splice raw data into "
+                    "the graph (wrap constants in sym.var + bind instead)")
+            mapping = {s.name: a for s, a in zip(self._inputs, args)}
+            outs = _substitute(self._outputs, mapping)
+            return outs[0] if len(outs) == 1 else outs
 
         feed = {s.name: (a._data if isinstance(a, NDArray) else a)
                 for s, a in zip(self._inputs, args)}

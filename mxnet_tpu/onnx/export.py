@@ -1185,9 +1185,20 @@ def _bilinear_resize_conv(ctx, s, ins, out):
     _emit_linear_resize(ctx, s, ins, out, "align_corners")
 
 
+@register_converter("_resize_linear_asymmetric")
+def _resize_asymmetric_conv(ctx, s, ins, out):
+    _emit_linear_resize(ctx, s, ins, out, "asymmetric")
+
+
 @register_converter("_resize_linear_half_pixel")
 def _resize_half_pixel_conv(ctx, s, ins, out):
-    _emit_linear_resize(ctx, s, ins, out, "half_pixel")
+    # preserve the ctm the op was imported with: half_pixel and
+    # pytorch_half_pixel diverge when an output spatial dim is 1
+    # (ops/functional.py:929), so rewriting one as the other on re-export
+    # would change what onnxruntime computes
+    ctm = ("pytorch_half_pixel" if s._attrs.get("pytorch_mode")
+           else "half_pixel")
+    _emit_linear_resize(ctx, s, ins, out, ctm)
 
 
 def _slice_emit(ctx, src, start, end, axis, hint):

@@ -607,22 +607,20 @@ def _import_resize(g, node, scales, sizes):
     if mode != "linear":
         raise ValueError("Resize mode %r unsupported" % mode)
     ctm = a.get("coordinate_transformation_mode", "half_pixel")
-    if ctm == "align_corners":
-        if sizes is not None:
-            return _make("BilinearResize2D", x, height=int(sizes[2]),
-                         width=int(sizes[3]))
-        return _make("BilinearResize2D", x, scale_height=float(scales[2]),
-                     scale_width=float(scales[3]))
-    if ctm not in ("half_pixel", "pytorch_half_pixel"):
+    ops = {"align_corners": "BilinearResize2D",
+           "asymmetric": "_resize_linear_asymmetric",
+           "half_pixel": "_resize_linear_half_pixel",
+           "pytorch_half_pixel": "_resize_linear_half_pixel"}
+    if ctm not in ops:
         raise ValueError("linear Resize import: coordinate_transformation_"
                          "mode %r unsupported" % ctm)
-    pt = ctm == "pytorch_half_pixel"
+    kw = ({"pytorch_mode": ctm == "pytorch_half_pixel"}
+          if ops[ctm] == "_resize_linear_half_pixel" else {})
     if sizes is not None:
-        return _make("_resize_linear_half_pixel", x, height=int(sizes[2]),
-                     width=int(sizes[3]), pytorch_mode=pt)
-    return _make("_resize_linear_half_pixel", x,
-                 scale_height=float(scales[2]),
-                 scale_width=float(scales[3]), pytorch_mode=pt)
+        return _make(ops[ctm], x, height=int(sizes[2]), width=int(sizes[3]),
+                     **kw)
+    return _make(ops[ctm], x, scale_height=float(scales[2]),
+                 scale_width=float(scales[3]), **kw)
 
 
 @register_importer("Resize")

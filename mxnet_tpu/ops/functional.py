@@ -898,19 +898,53 @@ def _resize_bilinear_align_corners(x, h, w):
           else jnp.zeros((1,), jnp.float32))
     xs = (jnp.linspace(0.0, W - 1.0, w) if w > 1
           else jnp.zeros((1,), jnp.float32))
+    return _bilinear_gather(x, ys, xs)
+
+
+def _bilinear_gather(x, ys, xs):
+    """Sample NCHW ``x`` at float source rows ``ys`` × cols ``xs`` with
+    bilinear weights (coords pre-clamped to [0, dim-1]). Integer inputs
+    (uint8 image subgraphs) interpolate in float32 and round back —
+    weights cast to an int dtype would truncate to 0 and silently degrade
+    to floor-nearest sampling."""
+    H, W = x.shape[2], x.shape[3]
+    in_dtype = x.dtype
+    integral = jnp.issubdtype(in_dtype, jnp.integer)
+    compute = jnp.float32 if integral else in_dtype
     y0 = jnp.floor(ys).astype(jnp.int32)
     x0 = jnp.floor(xs).astype(jnp.int32)
     y1 = jnp.minimum(y0 + 1, H - 1)
     x1 = jnp.minimum(x0 + 1, W - 1)
-    wy = (ys - y0).astype(x.dtype)[:, None]
-    wx = (xs - x0).astype(x.dtype)[None, :]
+    wy = (ys - y0).astype(compute)[:, None]
+    wx = (xs - x0).astype(compute)[None, :]
+    x = x.astype(compute)
     v00 = x[:, :, y0[:, None], x0[None, :]]
     v01 = x[:, :, y0[:, None], x1[None, :]]
     v10 = x[:, :, y1[:, None], x0[None, :]]
     v11 = x[:, :, y1[:, None], x1[None, :]]
     top = v00 * (1 - wx) + v01 * wx
     bot = v10 * (1 - wx) + v11 * wx
-    return top * (1 - wy) + bot * wy
+    out = top * (1 - wy) + bot * wy
+    if integral:
+        out = jnp.rint(out).astype(in_dtype)
+    return out
+
+
+@register_op("_resize_linear_asymmetric")
+def _resize_linear_asymmetric(x, *, height=None, width=None,
+                              scale_height=None, scale_width=None):
+    """ONNX ctm=asymmetric linear Resize: x_original = x_resized / scale,
+    no half-pixel shift (onnx.ai Resize spec; common in TF exports and
+    opset-10 Upsample upgrades). Kept exact via the shared bilinear gather
+    rather than approximated as half_pixel."""
+    H, W = x.shape[2], x.shape[3]
+    h = int(height) if height is not None else int(H * scale_height)
+    w = int(width) if width is not None else int(W * scale_width)
+    sh = float(scale_height) if scale_height is not None else h / H
+    sw = float(scale_width) if scale_width is not None else w / W
+    ys = jnp.minimum(jnp.arange(h, dtype=jnp.float32) / sh, H - 1.0)
+    xs = jnp.minimum(jnp.arange(w, dtype=jnp.float32) / sw, W - 1.0)
+    return _bilinear_gather(x, ys, xs)
 
 
 @register_op("_resize_linear_half_pixel")

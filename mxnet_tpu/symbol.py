@@ -554,6 +554,41 @@ def _eval_symbols(outputs, feed):
 
 
 
+def _substitute(outputs, mapping):
+    """Graph splicing: rebuild ``outputs`` with free variables whose names
+    appear in ``mapping`` replaced by the mapped symbols.
+
+    This is how a SymbolBlock composes into an enclosing symbolic trace
+    (net(sym.var('data')) on an imported model, e.g. ONNX re-export): the
+    stored graph's input vars are spliced out for the caller's symbols while
+    parameter vars (absent from the mapping) stay free. Control-flow bodies
+    (cond/foreach subgraphs held in attrs) reference outer values by NAME
+    through their free_names/arg_names env, so substituting the input spine
+    is sufficient — body-internal vars are scoped and never collide with
+    data input names."""
+    memo = {}
+
+    def sub(s):
+        got = memo.get(id(s))
+        if got is not None:
+            return got
+        if s.is_var():
+            out = mapping.get(s.name, s)
+        else:
+            new_ins = [sub(i) for i in s._inputs]
+            if all(n is o for n, o in zip(new_ins, s._inputs)):
+                out = s  # untouched subtree: reuse (keeps memoized walks)
+            else:
+                out = Symbol(s._op, new_ins, s._attrs, name=s.name,
+                             shape=s._shape, dtype=s._dtype,
+                             out_index=s._out_index, n_outputs=s._n_outputs)
+                out._annotations = dict(s._annotations)
+        memo[id(s)] = out
+        return out
+
+    return [sub(s) for s in outputs]
+
+
 def _make(op, *args, name=None, **attrs):
     inputs = []
     for a in args:

@@ -89,6 +89,77 @@ def test_bilinear_resize_roundtrip():
     np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
 
 
+def test_half_pixel_resize_ctm_preserved_on_reexport():
+    """half_pixel vs pytorch_half_pixel diverge when an output spatial dim
+    is 1 — re-export must emit the ctm the op was imported with, not rewrite
+    one as the other (ops/functional.py:929)."""
+    from mxnet_tpu.symbol import _make
+    for pt, want in ((True, "pytorch_half_pixel"), (False, "half_pixel")):
+        out = _make("_resize_linear_half_pixel", S.var("data"),
+                    height=6, width=8, pytorch_mode=pt)
+        mb = mxonnx.export_model(out, params={},
+                                 input_shapes={"data": (1, 2, 3, 4)})
+        nodes = P.parse_model(mb)["graph"]["nodes"]
+        (resize,) = [n for n in nodes if n["op"] == "Resize"]
+        assert resize["attrs"]["coordinate_transformation_mode"] == want
+        # and the round trip still computes (non-degenerate dims)
+        blk = mxonnx.import_to_gluon(mb)
+        x = np.random.default_rng(7).normal(size=(1, 2, 3, 4)) \
+            .astype(np.float32)
+        got = blk(nd.array(x)).asnumpy()
+        assert got.shape == (1, 2, 6, 8)
+
+        # RE-export of the imported block (SymbolBlock symbolic splice):
+        # ctm survives a second generation and numerics are unchanged
+        mb2 = mxonnx.export_model(blk, input_shapes={"data": (1, 2, 3, 4)})
+        nodes2 = P.parse_model(mb2)["graph"]["nodes"]
+        (resize2,) = [n for n in nodes2 if n["op"] == "Resize"]
+        assert resize2["attrs"]["coordinate_transformation_mode"] == want
+        got2 = mxonnx.import_to_gluon(mb2)(nd.array(x)).asnumpy()
+        np.testing.assert_allclose(got2, got, rtol=1e-5, atol=1e-6)
+
+
+def test_asymmetric_resize_import_oracle():
+    """ctm=asymmetric linear Resize (TF exports, opset-10 Upsample upgrades)
+    imports exactly: src = dst/scale with NO half-pixel shift, vs a direct
+    numpy oracle; and re-exports with its ctm preserved."""
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(1, 2, 3, 4)).astype(np.float32)
+    H, W, sh, sw = 3, 4, 2.0, 2.0
+    h, w = int(H * sh), int(W * sw)
+
+    scales = P.tensor_proto("scales", np.asarray([1, 1, sh, sw], np.float32))
+    node = P.node_proto("Resize", ["x", "", "scales"], ["y"],
+                        attrs={"mode": "linear",
+                               "coordinate_transformation_mode": "asymmetric"})
+    g = P.graph_proto("m", nodes=[node],
+                      inputs=[P.value_info("x", np.float32, x.shape)],
+                      outputs=[P.value_info("y", np.float32, (1, 2, h, w))],
+                      initializers=[scales])
+    mb = P.model_proto(g).tobytes()
+    blk = mxonnx.import_to_gluon(mb)
+    got = blk(nd.array(x)).asnumpy()
+
+    ys = np.minimum(np.arange(h) / sh, H - 1.0)
+    xs = np.minimum(np.arange(w) / sw, W - 1.0)
+    y0 = np.floor(ys).astype(int); y1 = np.minimum(y0 + 1, H - 1)
+    x0 = np.floor(xs).astype(int); x1 = np.minimum(x0 + 1, W - 1)
+    wy = (ys - y0)[:, None]; wx = (xs - x0)[None, :]
+    top = x[:, :, y0[:, None], x0[None, :]] * (1 - wx) \
+        + x[:, :, y0[:, None], x1[None, :]] * wx
+    bot = x[:, :, y1[:, None], x0[None, :]] * (1 - wx) \
+        + x[:, :, y1[:, None], x1[None, :]] * wx
+    want = top * (1 - wy) + bot * wy
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    mb2 = mxonnx.export_model(blk, input_shapes={"data": x.shape})
+    (resize,) = [n for n in P.parse_model(mb2)["graph"]["nodes"]
+                 if n["op"] == "Resize"]
+    assert resize["attrs"]["coordinate_transformation_mode"] == "asymmetric"
+    got2 = mxonnx.import_to_gluon(mb2)(nd.array(x)).asnumpy()
+    np.testing.assert_allclose(got2, got, rtol=1e-5, atol=1e-6)
+
+
 def test_box_nms_roundtrip():
     rng = np.random.default_rng(5)
     # [id, score, x1, y1, x2, y2], overlapping clusters

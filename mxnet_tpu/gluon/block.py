@@ -447,6 +447,12 @@ class SymbolBlock(HybridBlock):
                     "SymbolBlock symbolic call requires ALL inputs to be "
                     "Symbols; mixing in arrays would splice raw data into "
                     "the graph (wrap constants in sym.var + bind instead)")
+            if len(args) != len(self._inputs):
+                raise TypeError(
+                    "SymbolBlock symbolic call got %d inputs, graph has %d "
+                    "(%s) — an unbound input var would only fail much later"
+                    % (len(args), len(self._inputs),
+                       ", ".join(s.name for s in self._inputs)))
             mapping = {s.name: a for s, a in zip(self._inputs, args)}
             outs = _substitute(self._outputs, mapping)
             return outs[0] if len(outs) == 1 else outs

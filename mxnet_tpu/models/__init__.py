@@ -7,3 +7,4 @@ from . import transformer  # noqa: F401
 from . import ssd  # noqa: F401
 from . import faster_rcnn  # noqa: F401
 from . import gpt  # noqa: F401
+from . import yolo  # noqa: F401

@@ -1,0 +1,228 @@
+"""YOLOv3 object detection (GluonCV parity — ref: gluon-cv
+gluoncv/model_zoo/yolo/yolo3.py, darknet.py, yolo_target.py).
+
+Darknet-53 backbone, top-down feature fusion, three detection scales.
+TPU-native differences from the reference: target assignment and box decode
+are single jittable static-shape ops (``F.yolo3_target`` / ``F.yolo3_decode``
+in ops/detection.py) instead of the reference's CPU prefetch target generator
+and per-head decode layers, so the whole train step — assignment included —
+compiles into one XLA program; inference NMS is the on-device ``box_nms``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+
+__all__ = ["YOLOv3", "YOLOv3Loss", "yolo3_darknet53", "yolo3_tiny_test",
+           "COCO_ANCHORS"]
+
+# (w, h) pixel priors at size 416, in SLOT order: the model emits the
+# stride-32 scale first, so its (large) anchors lead (ref: gluoncv yolo3.py
+# `anchors` arg reversed per scale depth)
+COCO_ANCHORS = ((116, 90), (156, 198), (373, 326),
+                (30, 61), (62, 45), (59, 119),
+                (10, 13), (16, 30), (33, 23))
+
+
+def _conv(channels, kernel, strides=1):
+    # auto prefix (NOT ""): every conv tower needs its own name scope or the
+    # towers' children collide on auto names and collect_params dedupes them
+    out = nn.HybridSequential()
+    with out.name_scope():
+        out.add(nn.Conv2D(channels, kernel, strides=strides,
+                          padding=kernel // 2, use_bias=False))
+        out.add(nn.BatchNorm())
+        out.add(nn.LeakyReLU(0.1))
+    return out
+
+
+class _DarkResidual(HybridBlock):
+    """1x1 squeeze + 3x3 expand with identity shortcut
+    (ref: gluoncv darknet.py:DarknetBasicBlockV3)."""
+
+    def __init__(self, channels, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.body = nn.HybridSequential(prefix="")
+            self.body.add(_conv(channels // 2, 1))
+            self.body.add(_conv(channels, 3))
+
+    def hybrid_forward(self, F, x):
+        return x + self.body(x)
+
+
+class _Darknet(HybridBlock):
+    """Darknet-53-style backbone returning the three detection feature maps
+    (strides 8/16/32 relative to the input)."""
+
+    def __init__(self, layers=(1, 2, 8, 8, 4), channels=(64, 128, 256, 512,
+                                                         1024), **kwargs):
+        super().__init__(**kwargs)
+        assert len(layers) == len(channels) == 5
+        with self.name_scope():
+            self.stem = _conv(channels[0] // 2, 3)
+            self.stages = nn.HybridSequential(prefix="stage_")
+            for n, ch in zip(layers, channels):
+                stage = nn.HybridSequential(prefix="")
+                stage.add(_conv(ch, 3, strides=2))  # downsample
+                for _ in range(n):
+                    stage.add(_DarkResidual(ch))
+                self.stages.add(stage)
+
+    def hybrid_forward(self, F, x):
+        x = self.stem(x)
+        feats = []
+        for stage in self.stages:
+            x = stage(x)
+            feats.append(x)
+        return feats[2], feats[3], feats[4]  # strides 8, 16, 32
+
+
+class _DetBlock(HybridBlock):
+    """Alternating 1x1/3x3 tower; emits the lateral route (1x1, ch) and the
+    head tip (3x3, 2*ch) (ref: gluoncv yolo3.py:YOLODetectionBlockV3)."""
+
+    def __init__(self, channels, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.body = nn.HybridSequential(prefix="")
+            for _ in range(2):
+                self.body.add(_conv(channels, 1))
+                self.body.add(_conv(channels * 2, 3))
+            self.route = _conv(channels, 1)
+            self.tip = _conv(channels * 2, 3)
+
+    def hybrid_forward(self, F, x):
+        r = self.route(self.body(x))
+        return r, self.tip(r)
+
+
+class YOLOv3(HybridBlock):
+    """Forward returns the concatenated raw head output (B, N, 5+C), slot
+    order = stride-32 scale, then 16, then 8, row-major cells × 3 anchors —
+    the order ``yolo3_target``/``yolo3_decode`` assume."""
+
+    def __init__(self, num_classes=20, size=416, anchors=COCO_ANCHORS,
+                 strides=(32, 16, 8), channels=(128, 256, 512),
+                 backbone_layers=(1, 2, 8, 8, 4),
+                 backbone_channels=(64, 128, 256, 512, 1024), **kwargs):
+        super().__init__(**kwargs)
+        self._num_classes = num_classes
+        self._size = size
+        self._anchors = tuple(float(v) for wh in anchors for v in wh)
+        self._strides = tuple(strides)
+        with self.name_scope():
+            self.backbone = _Darknet(backbone_layers, backbone_channels)
+            # deepest scale first; lateral 1x1 + upsample feeds the next
+            self.det3 = _DetBlock(channels[2])   # stride 32
+            self.det2 = _DetBlock(channels[1])   # stride 16
+            self.det1 = _DetBlock(channels[0])   # stride 8
+            self.lat3 = _conv(channels[1], 1)
+            self.lat2 = _conv(channels[0], 1)
+            per = 3 * (5 + num_classes)
+            self.head3 = nn.Conv2D(per, 1)
+            self.head2 = nn.Conv2D(per, 1)
+            self.head1 = nn.Conv2D(per, 1)
+
+    def _flatten(self, F, y):
+        b = y.shape[0]
+        y = F.transpose(y, axes=(0, 2, 3, 1))  # (B, H, W, 3*(5+C))
+        return F.reshape(y, shape=(b, -1, 5 + self._num_classes))
+
+    def hybrid_forward(self, F, x):
+        c8, c16, c32 = self.backbone(x)
+        r3, t3 = self.det3(c32)
+        out3 = self._flatten(F, self.head3(t3))
+        up3 = F.UpSampling(self.lat3(r3), scale=2, sample_type="nearest")
+        r2, t2 = self.det2(F.concat(up3, c16, dim=1))
+        out2 = self._flatten(F, self.head2(t2))
+        up2 = F.UpSampling(self.lat2(r2), scale=2, sample_type="nearest")
+        _, t1 = self.det1(F.concat(up2, c8, dim=1))
+        out1 = self._flatten(F, self.head1(t1))
+        return F.concat(out3, out2, out1, dim=1)  # (B, N, 5+C)
+
+    @property
+    def meta(self):
+        return dict(size=self._size, strides=self._strides,
+                    anchors=self._anchors)
+
+    def detect(self, x, nms_thresh=0.45, score_thresh=0.01):
+        """(B, 3, size, size) → (B, N, 6) rows [id, score, x1, y1, x2, y2],
+        suppressed/low-score rows get score -1 (box_nms convention)."""
+        from .. import nd
+
+        raw = self(x)
+        boxes, obj, cls = nd.yolo3_decode(raw, **self.meta)
+        score = obj * nd.max(cls, axis=-1, keepdims=True)
+        ids = nd.cast(nd.argmax(cls, axis=-1), dtype="float32")
+        det = nd.concat(nd.expand_dims(ids, axis=-1), score, boxes, dim=-1)
+        return nd.box_nms(det, overlap_thresh=nms_thresh,
+                          valid_thresh=score_thresh, force_suppress=False)
+
+
+class YOLOv3Loss(HybridBlock):
+    """Per-image YOLOv3 loss: sigmoid-BCE for objectness (with the
+    best-IoU>thresh ignore band), center offsets and classes; L1 for the
+    log-scale wh (ref: gluoncv model_zoo/yolo/yolo3.py:YOLOV3Loss)."""
+
+    def __init__(self, num_classes, size, strides, anchors,
+                 ignore_iou_thresh=0.7, **kwargs):
+        super().__init__(**kwargs)
+        self._nc = num_classes
+        self._meta = dict(size=size, strides=tuple(strides),
+                          anchors=tuple(anchors))
+        self._ignore = ignore_iou_thresh
+
+    @staticmethod
+    def _bce(F, logits, targets):
+        # stable sigmoid cross-entropy: max(x,0) - x*z + log1p(exp(-|x|))
+        return (F.relu(logits) - logits * targets
+                + F.log1p(F.exp(-F.abs(logits))))
+
+    def hybrid_forward(self, F, raw, labels):
+        nc = self._nc
+        obj_t, ctr_t, wh_t, wt, cls_t = F.yolo3_target(
+            labels, **self._meta)
+        boxes, _, _ = F.yolo3_decode(F.stop_gradient(raw), **self._meta)
+        # ignore band: predictions overlapping ANY gt above thresh are not
+        # penalized as background (they're probably just unassigned dupes)
+        gt_valid = F.cast(F.greater_equal(
+            F.slice_axis(labels, axis=-1, begin=0, end=1), 0.0),
+            dtype="float32")
+        iou = F.box_iou(boxes, F.slice_axis(labels, axis=-1, begin=1, end=5))
+        iou = iou * F.transpose(gt_valid, axes=(0, 2, 1))  # (B, N, M)
+        best_iou = F.max(iou, axis=-1, keepdims=True)
+        ignore = F.cast(F.greater(best_iou, self._ignore), dtype="float32")
+        obj_w = obj_t + (1.0 - obj_t) * (1.0 - ignore)
+
+        obj_loss = self._bce(F, F.slice_axis(raw, axis=-1, begin=4, end=5),
+                             obj_t) * obj_w
+        ctr_loss = self._bce(F, F.slice_axis(raw, axis=-1, begin=0, end=2),
+                             ctr_t) * wt * obj_t
+        wh_loss = F.abs(F.slice_axis(raw, axis=-1, begin=2, end=4)
+                        - wh_t) * wt * obj_t
+        cls_oh = F.one_hot(F.cast(F.maximum(cls_t, 0.0), dtype="int32"),
+                           depth=nc)
+        cls_loss = self._bce(F, F.slice_axis(raw, axis=-1, begin=5, end=5 + nc),
+                             cls_oh) * obj_t
+        npos = F.maximum(F.sum(obj_t, axis=(1, 2)), 1.0)
+        total = (F.sum(obj_loss, axis=(1, 2)) + F.sum(ctr_loss, axis=(1, 2))
+                 + F.sum(wh_loss, axis=(1, 2)) + F.sum(cls_loss, axis=(1, 2)))
+        return total / npos
+
+
+def yolo3_darknet53(num_classes=20, size=416, **kwargs):
+    """Full-size YOLOv3-darknet53 (ref: gluoncv yolo3_darknet53_voc/coco)."""
+    return YOLOv3(num_classes=num_classes, size=size, **kwargs)
+
+
+def yolo3_tiny_test(num_classes=3, size=64):
+    """Tiny variant for tests: same topology, 8x smaller widths/depths, and
+    anchors scaled from the 416-pixel priors to ``size``."""
+    scale = size / 416.0
+    anchors = tuple((w * scale, h * scale) for w, h in COCO_ANCHORS)
+    return YOLOv3(num_classes=num_classes, size=size, anchors=anchors,
+                  channels=(16, 32, 64), backbone_layers=(1, 1, 1, 1, 1),
+                  backbone_channels=(8, 16, 32, 64, 128))

@@ -210,6 +210,107 @@ def multibox_detection(cls_prob, loc_pred, anchors, *, clip=True, threshold=0.01
     return jax.vmap(one)(cls_prob, loc_pred)
 
 
+# ------------------------------------------------------------------- YOLOv3
+
+def _yolo_grid(size, strides, anchors):
+    """Static per-slot metadata for YOLOv3's concatenated prediction list.
+
+    Slot order matches the model's head concat: scales in ``strides`` order,
+    each scale row-major over its grid with 3 anchors per cell. Returns
+    numpy (N,2) cell xy, (N,2) anchor wh (pixels), (N,) stride."""
+    import numpy as np
+    xs, whs, sts = [], [], []
+    a = np.asarray(anchors, np.float32).reshape(len(strides), 3, 2)
+    for si, s in enumerate(strides):
+        g = size // s
+        jj, ii = np.meshgrid(np.arange(g), np.arange(g), indexing="ij")
+        cell = np.stack([ii, jj], -1).reshape(-1, 1, 2)  # (G*G, 1, 2) [x, y]
+        cell = np.broadcast_to(cell, (g * g, 3, 2)).reshape(-1, 2)
+        xs.append(cell.astype(np.float32))
+        whs.append(np.broadcast_to(a[si][None], (g * g, 3, 2)).reshape(-1, 2))
+        sts.append(np.full((g * g * 3,), s, np.float32))
+    return (np.concatenate(xs), np.concatenate(whs).astype(np.float32),
+            np.concatenate(sts))
+
+
+@register_op("yolo3_target", n_outputs=5, nondiff=True)
+def yolo3_target(labels, *, size, strides, anchors):
+    """YOLOv3 training-target assignment, fully on device (ref: gluon-cv
+    gluoncv/model_zoo/yolo/yolo_target.py:YOLOV3PrefetchTargetGenerator —
+    there a CPU prefetch pass, here a jittable static-shape op).
+
+    Each valid gt is assigned to the anchor (of 9) with best wh-IoU, at the
+    grid cell containing its center on that anchor's scale. Collisions keep
+    the LAST gt (upstream's overwrite semantics) via an argmax-priority
+    one-hot scatter — no dynamic indexing.
+
+    labels (B, M, 5) rows [cls, x1, y1, x2, y2] normalized, cls<0 = pad.
+    Returns obj_t (B,N,1), center_t (B,N,2) in-cell offsets, scale_t (B,N,2)
+    log(gt/anchor), weight (B,N,1) = 2 - area, cls_t (B,N) (-1 = no gt)."""
+    cell, awh, stride = (jnp.asarray(v) for v in
+                         _yolo_grid(size, strides, anchors))
+    N = cell.shape[0]
+    all_a = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)  # (9, 2) slotted
+    n_per = 3
+    # flat slot offset of each scale block
+    import numpy as np
+    offs = np.cumsum([0] + [(size // s) ** 2 * 3 for s in strides])[:-1]
+    offs = jnp.asarray(offs, jnp.int32)
+    g_per = jnp.asarray([size // s for s in strides], jnp.int32)
+    st_per = jnp.asarray(strides, jnp.float32)
+
+    def one(lab):
+        valid = lab[:, 0] >= 0
+        wh = (lab[:, 3:5] - lab[:, 1:3]) * size          # (M, 2) pixels
+        ctr = (lab[:, 1:3] + lab[:, 3:5]) / 2 * size     # (M, 2) pixels
+        inter = (jnp.minimum(wh[:, None, 0], all_a[None, :, 0])
+                 * jnp.minimum(wh[:, None, 1], all_a[None, :, 1]))
+        union = (wh[:, 0:1] * wh[:, 1:2] + all_a[None, :, 0] * all_a[None, :, 1]
+                 - inter)
+        iou = inter / jnp.maximum(union, 1e-12)          # (M, 9)
+        best = jnp.argmax(iou, axis=1).astype(jnp.int32)  # (M,)
+        sidx = best // n_per
+        st = st_per[sidx]
+        g = g_per[sidx]
+        gij = jnp.floor(ctr / st[:, None]).astype(jnp.int32)
+        gij = jnp.clip(gij, 0, (g - 1)[:, None])
+        flat = offs[sidx] + (gij[:, 1] * g + gij[:, 0]) * n_per + best % n_per
+        flat = jnp.where(valid, flat, N)                 # pads → dropped row
+        # per-gt targets
+        t_ctr = ctr / st[:, None] - gij                  # in-cell offset
+        t_wh = jnp.log(jnp.maximum(wh, 1e-8) / all_a[best])
+        t_wt = 2.0 - (wh[:, 0] * wh[:, 1]) / (size * size)
+        # LAST-gt-wins scatter: one-hot weighted by gt index, argmax per slot
+        M = lab.shape[0]
+        E = (flat[:, None] == jnp.arange(N)[None, :])    # (M, N)
+        winner = jnp.argmax(E * (jnp.arange(M)[:, None] + 1), axis=0)
+        has = jnp.any(E, axis=0)
+        obj = has.astype(jnp.float32)[:, None]
+        ctr_t = jnp.where(has[:, None], t_ctr[winner], 0.0)
+        wh_t = jnp.where(has[:, None], t_wh[winner], 0.0)
+        wt = jnp.where(has[:, None], t_wt[winner, None], 0.0)
+        cls_t = jnp.where(has, lab[winner, 0], -1.0)
+        return obj, ctr_t, wh_t, wt, cls_t
+
+    return tuple(jax.vmap(one)(labels))
+
+
+@register_op("yolo3_decode", n_outputs=3)
+def yolo3_decode(raw, *, size, strides, anchors):
+    """Decode raw YOLOv3 head output (B, N, 5+C) → corner boxes (B, N, 4)
+    normalized to [0,1], objectness (B, N, 1), class probs (B, N, C)
+    (ref: gluoncv yolo3 YOLOOutputV3 — grid offsets + anchor exp there are
+    baked into the head; here one decode op shared by loss and detect)."""
+    cell, awh, stride = (jnp.asarray(v) for v in
+                         _yolo_grid(size, strides, anchors))
+    ctr = (jax.nn.sigmoid(raw[..., 0:2]) + cell) * stride[:, None] / size
+    wh = jnp.exp(jnp.clip(raw[..., 2:4], -10.0, 10.0)) * awh / size
+    boxes = jnp.concatenate([ctr - wh / 2, ctr + wh / 2], axis=-1)
+    obj = jax.nn.sigmoid(raw[..., 4:5])
+    cls = jax.nn.sigmoid(raw[..., 5:])
+    return jnp.clip(boxes, 0.0, 1.0), obj, cls
+
+
 # --------------------------------------------------------------- ONNX interop
 
 @register_op("_onnx_nms", nondiff=True)

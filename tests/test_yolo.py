@@ -1,0 +1,109 @@
+"""YOLOv3 model family: shapes, target-assignment oracle, training smoke,
+hybridize parity (ref: gluon-cv tests/unittests/test_model_zoo.py yolo cases
++ yolo_target semantics from gluoncv/model_zoo/yolo/yolo_target.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.models.yolo import YOLOv3Loss, yolo3_tiny_test
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    net = yolo3_tiny_test(num_classes=3, size=64)
+    net.initialize()
+    return net
+
+
+def _labels(rng, b=2, m=4, nc=3):
+    cls = rng.integers(0, nc, (b, m, 1)).astype(np.float32)
+    lo = rng.uniform(0, 0.6, (b, m, 2)).astype(np.float32)
+    wh = rng.uniform(0.1, 0.3, (b, m, 2)).astype(np.float32)
+    return np.concatenate([cls, lo, np.minimum(lo + wh, 1.0)], axis=-1)
+
+
+def test_forward_and_detect_shapes(tiny):
+    x = nd.array(np.random.default_rng(0).normal(
+        size=(2, 3, 64, 64)).astype(np.float32))
+    raw = tiny(x)
+    n = (2 * 2 + 4 * 4 + 8 * 8) * 3
+    assert raw.shape == (2, n, 5 + 3)
+    det = tiny.detect(x)
+    assert det.shape == (2, n, 6)
+    d = det.asnumpy()
+    # suppressed rows carry score -1; surviving scores are valid probs
+    alive = d[..., 1] > 0
+    assert alive.any()
+    assert (d[..., 1][alive] <= 1.0).all()
+
+
+def test_target_assignment_oracle(tiny):
+    """One gt: the slot at its best wh-IoU anchor + center cell gets obj=1
+    and targets that decode back to the gt box exactly."""
+    meta = tiny.meta
+    size, strides = meta["size"], meta["strides"]
+    anchors = np.asarray(meta["anchors"], np.float32).reshape(9, 2)
+    gt = np.array([[[1.0, 0.25, 0.30, 0.55, 0.80]]], np.float32)  # (1,1,5)
+
+    obj, ctr, wh, wt, cls = (o.asnumpy() for o in nd.yolo3_target(
+        nd.array(gt), **meta))
+
+    gw, gh = (0.55 - 0.25) * size, (0.80 - 0.30) * size
+    inter = np.minimum(gw, anchors[:, 0]) * np.minimum(gh, anchors[:, 1])
+    iou = inter / (gw * gh + anchors.prod(1) - inter)
+    best = int(iou.argmax())
+    s = strides[best // 3]
+    g = size // s
+    cx, cy = (0.25 + 0.55) / 2 * size, (0.30 + 0.80) / 2 * size
+    gi, gj = int(cx // s), int(cy // s)
+    offs = np.cumsum([0] + [(size // st) ** 2 * 3 for st in strides])[:-1]
+    slot = int(offs[best // 3] + (gj * g + gi) * 3 + best % 3)
+
+    assert obj[0, slot, 0] == 1.0
+    assert obj.sum() == 1.0  # only that slot
+    assert cls[0, slot] == 1.0
+    assert (cls[0, :slot] == -1).all() and (cls[0, slot + 1:] == -1).all()
+    # targets decode back to the gt geometry
+    np.testing.assert_allclose((ctr[0, slot] + [gi, gj]) * s,
+                               [cx, cy], rtol=1e-5)
+    np.testing.assert_allclose(np.exp(wh[0, slot]) * anchors[best],
+                               [gw, gh], rtol=1e-5)
+    np.testing.assert_allclose(wt[0, slot, 0],
+                               2 - gw * gh / size ** 2, rtol=1e-5)
+
+
+def test_target_padding_rows_ignored(tiny):
+    pad = -np.ones((2, 5, 5), np.float32)
+    obj, ctr, wh, wt, cls = (o.asnumpy() for o in nd.yolo3_target(
+        nd.array(pad), **tiny.meta))
+    assert obj.sum() == 0 and (cls == -1).all() and wt.sum() == 0
+
+
+def test_train_loss_decreases(tiny):
+    rng = np.random.default_rng(1)
+    loss_blk = YOLOv3Loss(3, **tiny.meta)
+    x = nd.array(rng.normal(size=(2, 3, 64, 64)).astype(np.float32))
+    labels = nd.array(_labels(rng))
+    trainer = gluon.Trainer(tiny.collect_params(),
+                            mx.optimizer.Adam(learning_rate=1e-3))
+    losses = []
+    for _ in range(5):
+        with autograd.record():
+            total = nd.mean(loss_blk(tiny(x), labels))
+        total.backward()
+        trainer.step(1)
+        losses.append(float(total.asnumpy()))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_hybridize_parity():
+    rng = np.random.default_rng(2)
+    x = nd.array(rng.normal(size=(1, 3, 64, 64)).astype(np.float32))
+    net = yolo3_tiny_test()
+    net.initialize()
+    want = net(x).asnumpy()
+    net.hybridize()
+    got = net(x).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)

@@ -27,10 +27,22 @@ def _lib_location():
     return d, os.path.join(d, "libmxtpu.so")
 
 
+_make_attempted = False
+
+
 def native_lib_path():
-    """Path to libmxtpu.so, building it with make on first use if possible."""
+    """Path to libmxtpu.so, building it with make on first use if possible.
+    The same make also produces libmxtpu_im.so (image pipeline), so rebuild
+    when either is missing — but attempt the build at most ONCE per process:
+    on hosts where a target can never build (no libjpeg), re-forking the
+    compiler for every ImageRecordIter would add seconds of latency each."""
+    global _make_attempted
     d, so = _lib_location()
-    if not os.path.exists(so) and os.path.exists(os.path.join(d, "Makefile")):
+    missing = (not os.path.exists(so)
+               or not os.path.exists(os.path.join(d, "libmxtpu_im.so")))
+    if missing and not _make_attempted and os.path.exists(
+            os.path.join(d, "Makefile")):
+        _make_attempted = True
         import subprocess
 
         try:

@@ -228,25 +228,138 @@ class _RecordIterBase(DataIter):
                          [array(self._collate_labels(labels))])
 
 
+class _NativeImagePipe:
+    """ctypes handle to the C++ decode pipeline (src/engine_cc/
+    image_pipeline.cc): N threads pread→libjpeg→resize/crop→CHW uint8 into
+    ordered batches — the reference's iter_image_recordio_2.cc hot path."""
+
+    def __init__(self, lib, handle, batch, shape, label_width):
+        self._lib, self._h = lib, handle
+        self._batch, self._shape, self._lw = batch, shape, label_width
+
+    @staticmethod
+    def try_create(path, threads, batch, data_shape, label_width, shuffle,
+                   mirror, resize, seed=0, depth=4):
+        import ctypes
+        import os
+
+        from .engine import _lib_location, native_lib_path
+
+        native_lib_path()  # builds all engine_cc targets on first use
+        so = os.path.join(_lib_location()[0], "libmxtpu_im.so")
+        if not os.path.exists(so):
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            return None
+        lib.mxtpu_impipe_create.restype = ctypes.c_void_p
+        lib.mxtpu_impipe_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_uint64, ctypes.c_int]
+        lib.mxtpu_impipe_next.restype = ctypes.c_int
+        lib.mxtpu_impipe_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                          ctypes.c_void_p]
+        lib.mxtpu_impipe_reset.argtypes = [ctypes.c_void_p]
+        lib.mxtpu_impipe_destroy.argtypes = [ctypes.c_void_p]
+        c, h, w = data_shape
+        if c != 3:
+            return None  # pipeline decodes to RGB only
+        handle = lib.mxtpu_impipe_create(
+            str(path).encode(), int(threads), int(batch), int(h), int(w),
+            int(label_width), int(bool(shuffle)), int(bool(mirror)),
+            int(resize), int(seed), int(depth))
+        if not handle:
+            return None
+        return _NativeImagePipe(lib, handle, batch, (c, h, w), label_width)
+
+    def next(self):
+        import ctypes
+
+        c, h, w = self._shape
+        data = np.empty((self._batch, c, h, w), np.uint8)
+        labels = np.empty((self._batch, self._lw), np.float32)
+        n = self._lib.mxtpu_impipe_next(
+            self._h, data.ctypes.data_as(ctypes.c_void_p),
+            labels.ctypes.data_as(ctypes.c_void_p))
+        if n <= 0:
+            return None
+        return data, labels
+
+    def reset(self):
+        self._lib.mxtpu_impipe_reset(self._h)
+
+    def __del__(self):
+        try:
+            self._lib.mxtpu_impipe_destroy(self._h)
+        except Exception:
+            pass
+
+
 class ImageRecordIter(_RecordIterBase):
     """Image record iterator over .rec files (ref: src/io/iter_image_recordio_2.cc).
-    Decodes with PIL; augmentation per image.py."""
+
+    Hot path: the C++ pipeline (``preprocess_threads`` workers, libjpeg
+    decode, resize/center-crop/mirror, ordered batch ring) when the requested
+    augmentation is the standard resize+crop+mirror+normalize set; falls back
+    to the per-image PIL/augmenter path (image.py) for anything richer
+    (rand_crop, color jitter via ImageIter) or when the .so isn't built."""
 
     def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
                  shuffle=False, rand_crop=False, rand_mirror=False, mean_r=0.0,
                  mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0,
-                 resize=0, path_imgidx=None, **kwargs):
+                 resize=0, path_imgidx=None, preprocess_threads=4, **kwargs):
         from .image import CreateAugmenter
 
         self._augs = CreateAugmenter(data_shape, resize=resize, rand_crop=rand_crop,
                                      rand_mirror=rand_mirror,
                                      mean=(mean_r, mean_g, mean_b),
                                      std=(std_r, std_g, std_b))
+        self._label_width = label_width
+        self._mean = np.asarray([mean_r, mean_g, mean_b],
+                                np.float32).reshape(1, 3, 1, 1)
+        self._std = np.asarray([std_r, std_g, std_b],
+                               np.float32).reshape(1, 3, 1, 1)
+        self._pipe = None
+        if not rand_crop and not kwargs.get("force_python", False):
+            self._pipe = _NativeImagePipe.try_create(
+                path_imgrec, preprocess_threads, batch_size, data_shape,
+                label_width, shuffle, rand_mirror, resize,
+                seed=int(np.random.randint(1, 2 ** 31)) if shuffle else 1)
         super().__init__(path_imgrec, batch_size, shuffle, path_imgidx)
+
+    def next(self):
+        if self._pipe is None:
+            return super().next()
+        if not self.iter_next():  # keep the DataIter protocol's cursor
+            raise StopIteration   # semantics identical to the Python path
+        got = self._pipe.next()
+        if got is None:
+            raise StopIteration
+        self._cursor += self.batch_size
+        data, labels = got
+        x = (data.astype(np.float32) - self._mean) / self._std
+        if self._label_width == 1:
+            labels = labels.ravel()
+        return DataBatch([array(x)], [array(labels)])
+
+    def reset(self):
+        super().reset()
+        if getattr(self, "_pipe", None) is not None:
+            self._pipe.reset()
 
     def _augment_one(self, img, label):
         for aug in self._augs:
             img = aug(img)
+        if self._label_width > 1:
+            # multi-float labels keep their width, padded/truncated to
+            # label_width — same shape contract as the native path
+            vec = np.zeros((self._label_width,), np.float32)
+            flat = np.asarray(label, np.float32).ravel()
+            vec[:min(len(flat), self._label_width)] = \
+                flat[:self._label_width]
+            return img, vec
         scalar = (np.asarray(label, np.float32).ravel()[0]
                   if np.ndim(label) else float(label))
         return img, scalar

@@ -301,6 +301,77 @@ def test_image_record_iter_chw(tmp_path):
     assert n == 3  # 6 images / batch 2
 
 
+def _write_color_rec(path, colors, fmt="JPEG", hw=(16, 20)):
+    import io as _io
+
+    from PIL import Image
+
+    from mxnet_tpu import recordio
+
+    rec = recordio.MXRecordIO(str(path), "w")
+    for i, c in enumerate(colors):
+        arr = np.tile(np.array(c, np.uint8), (hw[0], hw[1], 1))
+        buf = _io.BytesIO()
+        Image.fromarray(arr).save(buf, format=fmt, quality=95)
+        rec.write(recordio.pack(recordio.IRHeader(0, float(i), i, 0),
+                                buf.getvalue()))
+    rec.close()
+
+
+def test_native_image_pipeline(tmp_path):
+    """The C++ decode pipeline (engine_cc/image_pipeline.cc) engages for
+    JPEG .rec files and matches the Python path's contract: CHW float32,
+    normalized, full-batch epochs, reset, shuffle coverage. Constant-color
+    JPEGs make pixel values interpolation-independent, so parity is exact
+    up to JPEG quantization (±6/255)."""
+    pytest.importorskip("PIL")
+    from mxnet_tpu.io import ImageRecordIter
+
+    colors = [(250, 10, 10), (10, 250, 10), (10, 10, 250), (200, 200, 0),
+              (0, 200, 200), (120, 60, 180)]
+    path = tmp_path / "imgs.rec"
+    _write_color_rec(path, colors)
+
+    it = ImageRecordIter(path_imgrec=str(path), data_shape=(3, 8, 8),
+                         batch_size=2, preprocess_threads=3,
+                         mean_r=5.0, mean_g=5.0, mean_b=5.0, std_r=2.0,
+                         std_g=2.0, std_b=2.0)
+    if it._pipe is None:
+        pytest.skip("native image pipeline not built (libjpeg missing)")
+    n, seen = 0, []
+    while it.iter_next():
+        b = it.next()
+        x = b.data[0].asnumpy()
+        lab = b.label[0].asnumpy()
+        assert x.shape == (2, 3, 8, 8) and x.dtype == np.float32
+        for k in range(2):
+            want = (np.array(colors[int(lab[k])], np.float32) - 5.0) / 2.0
+            got = x[k].mean(axis=(1, 2))
+            assert np.abs(got - want).max() < 3.0, (got, want)
+        seen += list(lab)
+        n += 1
+    assert n == 3 and sorted(seen) == [0, 1, 2, 3, 4, 5]
+    it.reset()  # second epoch replays
+    assert it.next().data[0].shape == (2, 3, 8, 8)
+
+    # shuffled epochs still cover every sample exactly once
+    its = ImageRecordIter(path_imgrec=str(path), data_shape=(3, 8, 8),
+                          batch_size=2, shuffle=True, preprocess_threads=2)
+    if its._pipe is not None:
+        seen = []
+        while its.iter_next():
+            seen += list(its.next().label[0].asnumpy())
+        assert sorted(seen) == [0, 1, 2, 3, 4, 5]
+
+    # non-JPEG payloads fall back to the Python decode path transparently
+    png = tmp_path / "imgs_png.rec"
+    _write_color_rec(png, colors[:4], fmt="PNG", hw=(10, 10))
+    it2 = ImageRecordIter(path_imgrec=str(png), data_shape=(3, 8, 8),
+                          batch_size=2)
+    assert it2._pipe is None
+    assert it2.next().data[0].shape == (2, 3, 8, 8)
+
+
 def test_libsvm_iter(tmp_path):
     from mxnet_tpu.io import LibSVMIter
 

@@ -263,6 +263,8 @@ class _NativeImagePipe:
                                           ctypes.c_void_p]
         lib.mxtpu_impipe_reset.argtypes = [ctypes.c_void_p]
         lib.mxtpu_impipe_destroy.argtypes = [ctypes.c_void_p]
+        lib.mxtpu_impipe_errors.restype = ctypes.c_long
+        lib.mxtpu_impipe_errors.argtypes = [ctypes.c_void_p]
         c, h, w = data_shape
         if c != 3:
             return None  # pipeline decodes to RGB only
@@ -283,6 +285,14 @@ class _NativeImagePipe:
         n = self._lib.mxtpu_impipe_next(
             self._h, data.ctypes.data_as(ctypes.c_void_p),
             labels.ctypes.data_as(ctypes.c_void_p))
+        errs = self._lib.mxtpu_impipe_errors(self._h)
+        if errs:
+            # the Python decode path raises on a corrupt record — the native
+            # path must not silently train on zeroed images instead
+            raise RuntimeError(
+                "native image pipeline: %d record(s) failed to read/decode "
+                "(corrupt or non-JPEG payloads); use force_python=True to "
+                "locate them via the PIL path's exception" % errs)
         if n <= 0:
             return None
         return data, labels
@@ -322,12 +332,15 @@ class ImageRecordIter(_RecordIterBase):
         self._std = np.asarray([std_r, std_g, std_b],
                                np.float32).reshape(1, 3, 1, 1)
         self._pipe = None
+        # pipe is created AFTER super().__init__: the base reset() would
+        # otherwise immediately respawn the just-started worker pool and
+        # discard its first decoded batches
+        super().__init__(path_imgrec, batch_size, shuffle, path_imgidx)
         if not rand_crop and not kwargs.get("force_python", False):
             self._pipe = _NativeImagePipe.try_create(
                 path_imgrec, preprocess_threads, batch_size, data_shape,
                 label_width, shuffle, rand_mirror, resize,
                 seed=int(np.random.randint(1, 2 ** 31)) if shuffle else 1)
-        super().__init__(path_imgrec, batch_size, shuffle, path_imgidx)
 
     def next(self):
         if self._pipe is None:

@@ -1925,3 +1925,85 @@ def _split_conv_impl(ctx, s, ins, out):
 
 register_converter("split")(_split_conv_impl)
 register_converter("SliceChannel")(_split_conv_impl)
+
+
+# ------------------------------------------------- ONNX-parity op converters
+# (ops/extra.py "ONNX-parity ops" section: importer counterparts live in
+# import_model.py; these close the round trip)
+
+@register_converter("einsum")
+def _einsum_conv(ctx, s, ins, out):
+    ctx.emit("Einsum", list(ins), [out],
+             attrs={"equation": s._attrs["equation"]})
+
+
+@register_converter("take_along_axis")
+def _take_along_axis_conv(ctx, s, ins, out):
+    idx = ctx.fresh("idx64")
+    ctx.emit("Cast", [ins[1]], [idx], attrs={"to": 7})  # GatherElements: int64
+    ctx.emit("GatherElements", [ins[0], idx], [out],
+             attrs={"axis": int(s._attrs.get("axis", 0))})
+
+
+@register_converter("scatter_elements")
+def _scatter_elements_conv(ctx, s, ins, out):
+    idx = ctx.fresh("idx64")
+    ctx.emit("Cast", [ins[1]], [idx], attrs={"to": 7})
+    attrs = {"axis": int(s._attrs.get("axis", 0))}
+    red = s._attrs.get("reduction", "none")
+    if red != "none":
+        if ctx.opset < 16:
+            raise ValueError("scatter_elements with reduction=%r needs "
+                             "opset>=16; pass opset=16 to export_model"
+                             % red)
+        attrs["reduction"] = red
+    ctx.emit("ScatterElements", [ins[0], idx, ins[2]], [out], attrs=attrs)
+
+
+@register_converter("trilu")
+def _trilu_conv(ctx, s, ins, out):
+    if ctx.opset < 14:
+        raise ValueError("trilu export needs opset>=14 (Trilu); pass "
+                         "opset=14 to export_model")
+    k = ctx.const("k", np.asarray(int(s._attrs.get("k", 0)), np.int64))
+    ctx.emit("Trilu", [ins[0], k], [out],
+             attrs={"upper": int(bool(s._attrs.get("upper", True)))})
+
+
+@register_converter("celu")
+def _celu_conv(ctx, s, ins, out):
+    ctx.emit("Celu", ins[:1], [out],
+             attrs={"alpha": float(s._attrs.get("alpha", 1.0))})
+
+
+@register_converter("hardswish")
+def _hardswish_conv(ctx, s, ins, out):
+    if ctx.opset >= 14:
+        ctx.emit("HardSwish", ins[:1], [out])
+        return
+    # opset 13 decomposition: x * HardSigmoid(x, alpha=1/6, beta=0.5)
+    hs = ctx.fresh("hsig")
+    ctx.emit("HardSigmoid", ins[:1], [hs],
+             attrs={"alpha": 1.0 / 6.0, "beta": 0.5})
+    ctx.emit("Mul", [ins[0], hs], [out])
+
+
+@register_converter("thresholded_relu")
+def _thresholded_relu_conv(ctx, s, ins, out):
+    ctx.emit("ThresholdedRelu", ins[:1], [out],
+             attrs={"alpha": float(s._attrs.get("alpha", 1.0))})
+
+
+@register_converter("logsumexp")
+def _logsumexp_conv(ctx, s, ins, out):
+    a = s._attrs
+    attrs = {"keepdims": int(bool(a.get("keepdims", False)))}
+    ax = a.get("axis")
+    if ax is not None:
+        attrs["axes"] = [ax] if isinstance(ax, int) else list(ax)
+    ctx.emit("ReduceLogSumExp", ins[:1], [out], attrs=attrs)
+
+
+@register_converter("size_array")
+def _size_array_conv(ctx, s, ins, out):
+    ctx.emit("Size", ins[:1], [out])

@@ -867,6 +867,135 @@ register_importer("ReduceL1")(_reduce_lp(1))
 register_importer("ReduceL2")(_reduce_lp(2))
 
 
+def _axes_attr_or_input(g, node, input_idx=1):
+    """axes from the attr (opset<13/18) or a constant second input (newer
+    opsets moved reduce axes to an initializer input)."""
+    axes = node["attrs"].get("axes")
+    if axes is None and len(node["inputs"]) > input_idx \
+            and node["inputs"][input_idx]:
+        ax_init = g.initializers.get(node["inputs"][input_idx])
+        if ax_init is None:
+            raise ValueError("%s: dynamic axes input unsupported"
+                             % node["op"])
+        axes = [int(x) for x in np.asarray(ax_init).reshape(-1)]
+    return axes
+
+
+def _axes_kw(axes, keepdims):
+    # empty axes list (opset>=18 empty initializer) means reduce-all, same
+    # as an absent attr — callers handle noop_with_empty_axes separately
+    kw = {"keepdims": bool(keepdims)}
+    if axes:
+        kw["axis"] = (tuple(int(x) for x in axes) if len(axes) > 1
+                      else int(axes[0]))
+    return kw
+
+
+def _reduce_is_noop(node, axes):
+    # opset>=18: an EMPTY axes input + noop_with_empty_axes=1 means identity
+    return (axes is not None and len(axes) == 0
+            and bool(node["attrs"].get("noop_with_empty_axes", 0)))
+
+
+@register_importer("ReduceLogSumExp")
+def _reduce_lse_imp(g, node):
+    axes = _axes_attr_or_input(g, node)
+    if _reduce_is_noop(node, axes):
+        return _make("identity", g.inp(node["inputs"][0]))
+    kw = _axes_kw(axes, node["attrs"].get("keepdims", 1))
+    return _make("logsumexp", g.inp(node["inputs"][0]), **kw)
+
+
+@register_importer("ReduceLogSum")
+def _reduce_logsum_imp(g, node):
+    axes = _axes_attr_or_input(g, node)
+    if _reduce_is_noop(node, axes):
+        return _make("identity", g.inp(node["inputs"][0]))
+    kw = _axes_kw(axes, node["attrs"].get("keepdims", 1))
+    return _make("log", _make("sum", g.inp(node["inputs"][0]), **kw))
+
+
+@register_importer("ReduceSumSquare")
+def _reduce_sumsq_imp(g, node):
+    axes = _axes_attr_or_input(g, node)
+    if _reduce_is_noop(node, axes):
+        return _make("identity", g.inp(node["inputs"][0]))
+    kw = _axes_kw(axes, node["attrs"].get("keepdims", 1))
+    return _make("sum", _make("square", g.inp(node["inputs"][0])), **kw)
+
+
+@register_importer("GatherElements")
+def _gather_elements_imp(g, node):
+    return _make("take_along_axis", g.inp(node["inputs"][0]),
+                 g.inp(node["inputs"][1]),
+                 axis=int(node["attrs"].get("axis", 0)))
+
+
+def _scatter_elements_imp(g, node):
+    red = node["attrs"].get("reduction", "none")
+    if red not in ("none", "add", "mul"):
+        raise ValueError("ScatterElements reduction %r unsupported" % red)
+    return _make("scatter_elements", g.inp(node["inputs"][0]),
+                 g.inp(node["inputs"][1]), g.inp(node["inputs"][2]),
+                 axis=int(node["attrs"].get("axis", 0)), reduction=red)
+
+
+register_importer("ScatterElements")(_scatter_elements_imp)
+register_importer("Scatter")(_scatter_elements_imp)  # deprecated alias
+
+
+@register_importer("Einsum")
+def _einsum_imp(g, node):
+    return _make("einsum", *[g.inp(i) for i in node["inputs"]],
+                 equation=node["attrs"]["equation"])
+
+
+@register_importer("Trilu")
+def _trilu_imp(g, node):
+    k = 0
+    if len(node["inputs"]) > 1 and node["inputs"][1]:
+        k_init = g.initializers.get(node["inputs"][1])
+        if k_init is None:
+            raise ValueError("Trilu: dynamic k input unsupported")
+        k = int(np.asarray(k_init).reshape(()))
+    return _make("trilu", g.inp(node["inputs"][0]), k=k,
+                 upper=bool(node["attrs"].get("upper", 1)))
+
+
+@register_importer("Celu")
+def _celu_imp(g, node):
+    return _make("celu", g.inp(node["inputs"][0]),
+                 alpha=float(node["attrs"].get("alpha", 1.0)))
+
+
+@register_importer("HardSwish")
+def _hardswish_imp(g, node):
+    return _make("hardswish", g.inp(node["inputs"][0]))
+
+
+@register_importer("ThresholdedRelu")
+def _thresholded_relu_imp(g, node):
+    return _make("thresholded_relu", g.inp(node["inputs"][0]),
+                 alpha=float(node["attrs"].get("alpha", 1.0)))
+
+
+@register_importer("Size")
+def _size_imp(g, node):
+    return _make("size_array", g.inp(node["inputs"][0]))
+
+
+@register_importer("Multinomial")
+def _multinomial_imp(g, node):
+    """ONNX Multinomial input is unnormalized LOG-probabilities (the TF
+    lineage); sample_multinomial wants a probability simplex — softmax
+    bridges exactly."""
+    a = node["attrs"]
+    dtype = {6: "int32", 7: "int64"}.get(int(a.get("dtype", 6)), "int32")
+    return _make("sample_multinomial",
+                 _make("softmax", g.inp(node["inputs"][0]), axis=-1),
+                 shape=(int(a.get("sample_size", 1)),), dtype=dtype)
+
+
 @register_importer("LpNormalization")
 def _lp_norm_imp(g, node):
     a = node["attrs"]

@@ -406,3 +406,72 @@ def col2im(data, *, output_size, kernel, stride=(1, 1), dilate=(1, 1),
         lambda x: _im2col_patches(x, kernel, stride, dilate, pad), ref)
     (out,) = vjp(data.reshape(primal.shape))
     return out
+
+
+# ---------------------------------------------------------- ONNX-parity ops
+# Registry ops backing the ONNX importer's opset breadth (each with an
+# exporter in onnx/export.py so they round-trip). jnp-native, fully static.
+
+@register_op("einsum")
+def einsum(*args, equation):
+    """ONNX Einsum / np.einsum (ref: onnx.ai Einsum; upstream
+    mxnet.np.einsum). Variadic inputs; the subscripts string is static."""
+    return jnp.einsum(equation, *args)
+
+
+@register_op("take_along_axis")
+def take_along_axis(a, indices, *, axis):
+    """ONNX GatherElements semantics: pick one element per output position
+    along ``axis`` (np.take_along_axis)."""
+    return jnp.take_along_axis(a, indices.astype(jnp.int32), axis=int(axis))
+
+
+@register_op("scatter_elements")
+def scatter_elements(data, indices, updates, *, axis=0, reduction="none"):
+    """ONNX ScatterElements (and the deprecated Scatter): write ``updates``
+    at per-element positions along ``axis``. reduction none/add/mul map to
+    .at[].set/add/multiply — XLA scatter either way."""
+    idx = indices.astype(jnp.int32)
+    axis = int(axis)
+    # build full coordinate grids: every dim is its own index except `axis`
+    coords = list(jnp.meshgrid(*[jnp.arange(s) for s in idx.shape],
+                               indexing="ij"))
+    coords[axis] = idx
+    at = data.at[tuple(coords)]
+    if reduction == "add":
+        return at.add(updates)
+    if reduction == "mul":
+        return at.multiply(updates)
+    return at.set(updates)
+
+
+@register_op("trilu")
+def trilu(x, *, k=0, upper=True):
+    """ONNX Trilu: upper/lower triangle of the last two dims."""
+    return jnp.triu(x, k=int(k)) if upper else jnp.tril(x, k=int(k))
+
+
+@register_op("celu")
+def celu(x, *, alpha=1.0):
+    """ONNX Celu: max(0, x) + min(0, alpha*(exp(x/alpha) - 1))."""
+    return jax.nn.celu(x, alpha=float(alpha))
+
+
+@register_op("hardswish")
+def hardswish(x):
+    """ONNX HardSwish (opset 14): x * clip(x/6 + 0.5, 0, 1)."""
+    return x * jnp.clip(x / 6.0 + 0.5, 0.0, 1.0)
+
+
+@register_op("thresholded_relu")
+def thresholded_relu(x, *, alpha=1.0):
+    """ONNX ThresholdedRelu: x if x > alpha else 0."""
+    return jnp.where(x > alpha, x, jnp.zeros_like(x))
+
+
+@register_op("logsumexp")
+def logsumexp(data, *, axis=None, keepdims=False):
+    """ONNX ReduceLogSumExp, numerically stable (max-shifted) — a naive
+    log(sum(exp)) decomposition overflows in fp16/bf16."""
+    ax = axis if axis is None or isinstance(axis, tuple) else (int(axis),)
+    return jax.nn.logsumexp(data, axis=ax, keepdims=bool(keepdims))

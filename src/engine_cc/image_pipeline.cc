@@ -244,6 +244,18 @@ class Pipe {
     }
   }
 
+  // A record that can't be read or decoded zeroes its slot AND counts as an
+  // error — the consumer raises instead of silently training on black
+  // images (the Python decode path raises on the same file).
+  void Bad(unsigned char* out) {
+    std::memset(out, 0, size_t(3) * h_ * w_);
+    ++decode_errors_;
+  }
+
+ public:
+  long DecodeErrors() const { return decode_errors_.load(); }
+
+ private:
   void Produce(long s, std::vector<unsigned char>* rec,
                std::vector<unsigned char>* rgb) {
     long b = s / batch_, slot = s % batch_;
@@ -255,7 +267,7 @@ class Pipe {
     auto [off, len] = recs_[order_[s]];
     rec->resize(len);
     if (pread(fd_, rec->data(), len, off) != (ssize_t)len || len < kHeaderBytes) {
-      std::memset(out, 0, size_t(3) * h_ * w_);
+      Bad(out);
       return;
     }
     uint32_t flag;
@@ -263,6 +275,10 @@ class Pipe {
     std::memcpy(&flag, rec->data(), 4);
     std::memcpy(&label0, rec->data() + 4, 4);
     size_t img_off = kHeaderBytes + size_t(flag) * 4;
+    if (img_off >= (size_t)len) {  // label floats past the record end
+      Bad(out);
+      return;
+    }
     if (flag == 0) {
       lab[0] = label0;
     } else {
@@ -270,9 +286,8 @@ class Pipe {
         std::memcpy(&lab[i], rec->data() + kHeaderBytes + i * 4, 4);
     }
     int sw = 0, sh = 0;
-    if (img_off >= (size_t)len ||
-        !DecodeJpeg(rec->data() + img_off, len - img_off, rgb, &sw, &sh)) {
-      std::memset(out, 0, size_t(3) * h_ * w_);
+    if (!DecodeJpeg(rec->data() + img_off, len - img_off, rgb, &sw, &sh)) {
+      Bad(out);
       return;
     }
     // shorter-edge resize to `resize_`, then center crop h_ x w_ — upstream
@@ -325,6 +340,7 @@ class Pipe {
   std::vector<long> order_;
   std::vector<Batch> ring_;
   std::atomic<long> next_sample_{0};
+  std::atomic<long> decode_errors_{0};
   long n_batches_ = 0, consumer_ = 0;
   std::mutex mu_;
   std::condition_variable cv_ready_, cv_space_;
@@ -382,6 +398,10 @@ int mxtpu_impipe_next(void* h, unsigned char* data, float* labels) {
 }
 
 void mxtpu_impipe_reset(void* h) { static_cast<Pipe*>(h)->Reset(); }
+
+long mxtpu_impipe_errors(void* h) {
+  return static_cast<Pipe*>(h)->DecodeErrors();
+}
 
 void mxtpu_impipe_destroy(void* h) { delete static_cast<Pipe*>(h); }
 

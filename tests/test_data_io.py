@@ -371,6 +371,30 @@ def test_native_image_pipeline(tmp_path):
     assert it2._pipe is None
     assert it2.next().data[0].shape == (2, 3, 8, 8)
 
+    # a corrupt record AFTER index 0 (which the create-time JPEG probe can't
+    # see) raises loudly instead of silently training on a zeroed image
+    from mxnet_tpu import recordio as _rio
+    bad = tmp_path / "bad.rec"
+    rec = _rio.MXRecordIO(str(bad), "w")
+    import io as _io
+
+    from PIL import Image
+    for i in range(4):
+        arr = np.tile(np.array(colors[i], np.uint8), (10, 10, 1))
+        buf = _io.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG")
+        payload = buf.getvalue()
+        if i == 2:  # truncate one JPEG body
+            payload = payload[: len(payload) // 2]
+        rec.write(_rio.pack(_rio.IRHeader(0, float(i), i, 0), payload))
+    rec.close()
+    it3 = ImageRecordIter(path_imgrec=str(bad), data_shape=(3, 8, 8),
+                          batch_size=2)
+    if it3._pipe is not None:
+        with pytest.raises(RuntimeError, match="failed to read/decode"):
+            for _ in range(2):
+                it3.next()
+
 
 def test_libsvm_iter(tmp_path):
     from mxnet_tpu.io import LibSVMIter

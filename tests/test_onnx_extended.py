@@ -160,6 +160,117 @@ def test_asymmetric_resize_import_oracle():
     np.testing.assert_allclose(got2, got, rtol=1e-5, atol=1e-6)
 
 
+def test_onnx_parity_ops_roundtrip():
+    """New opset-breadth ops (ops/extra.py ONNX-parity section): symbol →
+    export → import matches direct nd evaluation."""
+    from mxnet_tpu.symbol import _make
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(3, 4)).astype(np.float32)
+    y = rng.normal(size=(4, 5)).astype(np.float32)
+    idx = rng.integers(0, 3, (3, 4)).astype(np.int32)
+    upd = rng.normal(size=(3, 4)).astype(np.float32)
+
+    cases = [
+        (_make("einsum", S.var("a"), S.var("b"), equation="ij,jk->ik"),
+         {"a": x, "b": y}, 13),
+        (_make("take_along_axis", S.var("a"), S.var("i"), axis=0),
+         {"a": x, "i": idx}, 13),
+        # reduction attr is opset>=16; Trilu is opset>=14 (export refuses
+        # to emit them into an opset-13 model — tested below)
+        (_make("scatter_elements", S.var("a"), S.var("i"), S.var("u"),
+               axis=0, reduction="add"), {"a": x, "i": idx, "u": upd}, 16),
+        (_make("scatter_elements", S.var("a"), S.var("i"), S.var("u"),
+               axis=0), {"a": x, "i": idx, "u": upd}, 13),
+        (_make("trilu", S.var("a"), k=1, upper=False), {"a": x}, 14),
+        (_make("celu", S.var("a"), alpha=0.5), {"a": x}, 13),
+        (_make("hardswish", S.var("a")), {"a": x}, 14),
+        (_make("hardswish", S.var("a")), {"a": x}, 13),  # decomposed form
+        (_make("thresholded_relu", S.var("a"), alpha=0.3), {"a": x}, 13),
+        (_make("logsumexp", S.var("a"), axis=1, keepdims=True), {"a": x}, 13),
+    ]
+    for sym, feed, opset in cases:
+        want = sym.eval(**{k: nd.array(v) for k, v in feed.items()})
+        want = (want[0] if isinstance(want, (list, tuple)) else want).asnumpy()
+        mb = mxonnx.export_model(
+            sym, params={}, input_shapes={k: v.shape for k, v in feed.items()},
+            input_types={k: v.dtype for k, v in feed.items()},
+            input_names=tuple(feed), opset=opset)
+        blk = mxonnx.import_to_gluon(mb)
+        got = blk(*[nd.array(feed[k]) for k in feed]).asnumpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6,
+                                   err_msg=str(sym))
+
+    # opset-13 export of opset-14/16-only forms refuses loudly instead of
+    # emitting spec-invalid models
+    for sym, feed in [
+            (_make("trilu", S.var("a"), k=0, upper=True), {"a": x}),
+            (_make("scatter_elements", S.var("a"), S.var("i"), S.var("u"),
+                   axis=0, reduction="add"), {"a": x, "i": idx, "u": upd})]:
+        with pytest.raises(ValueError, match="opset"):
+            mxonnx.export_model(
+                sym, params={},
+                input_shapes={k: v.shape for k, v in feed.items()},
+                input_types={k: v.dtype for k, v in feed.items()},
+                input_names=tuple(feed), opset=13)
+
+
+def test_onnx_parity_ops_import_only():
+    """Importer-only breadth vs numpy oracles: reduce composites, Size,
+    deprecated Scatter, Multinomial sampling."""
+    rng = np.random.default_rng(4)
+    x = np.abs(rng.normal(size=(2, 3, 4))).astype(np.float32) + 0.1
+
+    def run(op, attrs, want, inputs=None, extra_inits=(), out_shape=None):
+        names = list(inputs or {"x": x})
+        feeds = inputs or {"x": x}
+        node = P.node_proto(op, names + [n for n, _ in extra_inits], ["y"],
+                            attrs=attrs)
+        inits = [P.tensor_proto(n, v) for n, v in extra_inits]
+        g = P.graph_proto(
+            "m", nodes=[node],
+            inputs=[P.value_info(n, v.dtype, v.shape)
+                    for n, v in feeds.items()],
+            outputs=[P.value_info("y", np.float32,
+                                  out_shape or want.shape)],
+            initializers=inits)
+        blk = mxonnx.import_to_gluon(P.model_proto(g).tobytes())
+        return blk(*[nd.array(v) for v in feeds.values()]).asnumpy()
+
+    got = run("ReduceLogSum", {"keepdims": 0, "axes": [2]},
+              np.log(x.sum(2)))
+    np.testing.assert_allclose(got, np.log(x.sum(2)), rtol=1e-5)
+
+    got = run("ReduceSumSquare", {"keepdims": 1, "axes": [0]},
+              (x ** 2).sum(0, keepdims=True))
+    np.testing.assert_allclose(got, (x ** 2).sum(0, keepdims=True),
+                               rtol=1e-5)
+
+    got = run("ReduceLogSumExp", {"keepdims": 0, "axes": [1]},
+              np.log(np.exp(x).sum(1)))
+    np.testing.assert_allclose(got, np.log(np.exp(x).sum(1)), rtol=1e-5)
+
+    got = run("Size", {}, np.asarray(x.size))
+    assert int(got) == x.size
+
+    # deprecated Scatter aliases ScatterElements
+    data = np.zeros((3, 3), np.float32)
+    indices = np.array([[0, 1, 2]], np.int64)
+    updates = np.array([[9.0, 8.0, 7.0]], np.float32)
+    want = data.copy()
+    want[0, 0], want[1, 1], want[2, 2] = 9, 8, 7
+    got = run("Scatter", {"axis": 0},
+              want, inputs={"d": data, "i": indices, "u": updates})
+    np.testing.assert_allclose(got, want)
+
+    logits = np.log(np.array([[0.999, 1e-3, 1e-3],
+                              [1e-3, 1e-3, 0.999]], np.float32))
+    got = run("Multinomial", {"sample_size": 8}, None,
+              inputs={"l": logits}, out_shape=(2, 8))
+    assert got.shape == (2, 8)
+    # overwhelming-probability classes dominate the draws
+    assert (got[0] == 0).mean() > 0.9 and (got[1] == 2).mean() > 0.9
+
+
 def test_box_nms_roundtrip():
     rng = np.random.default_rng(5)
     # [id, score, x1, y1, x2, y2], overlapping clusters

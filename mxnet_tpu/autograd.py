@@ -306,5 +306,69 @@ def _grad_create_graph(heads, variables, head_grads):
     return wrapped
 
 
+class Function:
+    """User-defined differentiable function (ref: python/mxnet/autograd.py:
+    Function). Subclass with ``forward``/``backward``; calling the instance
+    runs ``forward`` un-recorded and, when recording, installs a tape node
+    whose vjp invokes ``backward`` with the output cotangents.
+
+    Matches upstream semantics: ``forward`` sees plain values (autograd is
+    paused inside it), ``save_for_backward`` stashes tensors on the instance,
+    and ``backward`` must return one gradient per ``forward`` input, in order.
+    For a jit-fusable custom op use ``operator.register_jax_op`` instead —
+    this tier is eager host dispatch, like upstream's Function (which also
+    never enters the CachedOp fast path).
+    """
+
+    def __init__(self):
+        self.saved_tensors = ()
+
+    def save_for_backward(self, *args):
+        self.saved_tensors = args
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray import NDArray
+
+        if not all(isinstance(a, NDArray) for a in inputs):
+            raise TypeError("autograd.Function inputs must be NDArrays")
+        rec = is_recording()
+        with pause():
+            raw = self.forward(*inputs)
+        single = not isinstance(raw, (list, tuple))
+        outs = [raw] if single else list(raw)
+        if not all(isinstance(o, NDArray) for o in outs):
+            raise TypeError("autograd.Function.forward must return NDArrays")
+        if rec:
+            ins = list(inputs)
+
+            def vjp_fn(cots):
+                if not isinstance(cots, tuple):
+                    cots = (cots,)
+                with pause():
+                    ig = self.backward(*[NDArray(c) for c in cots])
+                if isinstance(ig, NDArray):
+                    ig = [ig]
+                ig = list(ig)
+                if len(ig) != len(ins):
+                    raise ValueError(
+                        "backward returned %d grads for %d inputs"
+                        % (len(ig), len(ins)))
+                return tuple(None if g is None else
+                             (g._data if isinstance(g, NDArray)
+                              else jnp.asarray(g)) for g in ig)
+
+            # primal_fn=None: backward is arbitrary host Python, so this node
+            # is not replayable under grad(create_graph=True) — same limit as
+            # the imperative CustomOp tier
+            append_node(TapeNode(ins, outs, vjp_fn, primal_fn=None))
+        return raw
+
+
 def get_symbol(x):  # MXNet API parity; no nnvm graph here
     raise NotImplementedError("use mxnet_tpu.symbol for graph capture")

@@ -251,20 +251,22 @@ class _NativeImagePipe:
             return None
         try:
             lib = ctypes.CDLL(so)
-        except OSError:
+            lib.mxtpu_impipe_create.restype = ctypes.c_void_p
+            lib.mxtpu_impipe_create.argtypes = [
+                ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, ctypes.c_uint64, ctypes.c_int]
+            lib.mxtpu_impipe_next.restype = ctypes.c_int
+            lib.mxtpu_impipe_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                              ctypes.c_void_p]
+            lib.mxtpu_impipe_reset.argtypes = [ctypes.c_void_p]
+            lib.mxtpu_impipe_destroy.argtypes = [ctypes.c_void_p]
+            lib.mxtpu_impipe_errors.restype = ctypes.c_long
+            lib.mxtpu_impipe_errors.argtypes = [ctypes.c_void_p]
+        except (OSError, AttributeError):
+            # missing/stale .so (e.g. built before a symbol was added):
+            # fall back to the Python decode path rather than crashing
             return None
-        lib.mxtpu_impipe_create.restype = ctypes.c_void_p
-        lib.mxtpu_impipe_create.argtypes = [
-            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-            ctypes.c_int, ctypes.c_uint64, ctypes.c_int]
-        lib.mxtpu_impipe_next.restype = ctypes.c_int
-        lib.mxtpu_impipe_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
-                                          ctypes.c_void_p]
-        lib.mxtpu_impipe_reset.argtypes = [ctypes.c_void_p]
-        lib.mxtpu_impipe_destroy.argtypes = [ctypes.c_void_p]
-        lib.mxtpu_impipe_errors.restype = ctypes.c_long
-        lib.mxtpu_impipe_errors.argtypes = [ctypes.c_void_p]
         c, h, w = data_shape
         if c != 3:
             return None  # pipeline decodes to RGB only

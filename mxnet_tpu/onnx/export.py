@@ -248,8 +248,14 @@ def _squeeze(ctx, s, ins, out):
 
 @register_converter("clip")
 def _clip(ctx, s, ins, out):
-    lo = ctx.const("min", np.float32(s._attrs["a_min"]))
-    hi = ctx.const("max", np.float32(s._attrs["a_max"]))
+    if "a_min" in s._attrs:
+        lo_v, hi_v = s._attrs["a_min"], s._attrs["a_max"]
+    else:
+        # positional `F.clip(x, lo, hi)`: bounds arrive as _const inputs
+        lo_v = s._inputs[1]._attrs["value"]
+        hi_v = s._inputs[2]._attrs["value"]
+    lo = ctx.const("min", np.float32(lo_v))
+    hi = ctx.const("max", np.float32(hi_v))
     ctx.emit("Clip", [ins[0], lo, hi], [out])
 
 

@@ -326,8 +326,10 @@ def _reduce(mx_op):
                 raise ValueError("%s: dynamic axes input unsupported"
                                  % node["op"])
             axes = [int(x) for x in np.asarray(ax_init).reshape(-1)]
+        if _reduce_is_noop(node, axes):
+            return _make("identity", g.inp(node["inputs"][0]))
         kw = {"keepdims": bool(a.get("keepdims", 1))}
-        if axes is not None:
+        if axes:
             kw["axis"] = tuple(axes) if len(axes) > 1 else int(axes[0])
         return _make(mx_op, g.inp(node["inputs"][0]), **kw)
     return imp
@@ -892,8 +894,10 @@ def _axes_kw(axes, keepdims):
 
 
 def _reduce_is_noop(node, axes):
-    # opset>=18: an EMPTY axes input + noop_with_empty_axes=1 means identity
-    return (axes is not None and len(axes) == 0
+    # opset>=18: noop_with_empty_axes=1 with axes EMPTY **or absent
+    # altogether** means identity (the spec's "empty" covers both); only
+    # with the flag unset does missing axes mean reduce-all
+    return ((axes is None or len(axes) == 0)
             and bool(node["attrs"].get("noop_with_empty_axes", 0)))
 
 
@@ -981,7 +985,9 @@ def _thresholded_relu_imp(g, node):
 
 @register_importer("Size")
 def _size_imp(g, node):
-    return _make("size_array", g.inp(node["inputs"][0]))
+    # ONNX Size is a RANK-0 scalar; size_array returns shape (1,)
+    return _make("reshape", _make("size_array", g.inp(node["inputs"][0])),
+                 shape=())
 
 
 @register_importer("Multinomial")

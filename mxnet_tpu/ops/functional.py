@@ -81,7 +81,9 @@ def softrelu(x):
 
 
 @register_op("clip")
-def clip(x, *, a_min, a_max):
+def clip(x, a_min, a_max):
+    # positional a_min/a_max: upstream's `mx.nd.clip(data, -1, 1)` form
+    # (ref: src/operator/tensor/matrix_op.cc clip)
     return jnp.clip(x, a_min, a_max)
 
 

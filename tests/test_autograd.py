@@ -266,3 +266,54 @@ def test_create_graph_intermediate_variable():
     s.backward()
     np.testing.assert_allclose(gv.asnumpy(), 4 * x.asnumpy(), rtol=1e-6)
     np.testing.assert_allclose(x.grad.asnumpy(), [4.0, 4.0], rtol=1e-6)
+
+
+def test_function_custom_sigmoid():
+    """autograd.Function parity (ref: python/mxnet/autograd.py:Function
+    docstring example): user forward/backward, grads flow through the tape."""
+
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = 1.0 / (1.0 + nd.exp(-x))
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1.0 - y)
+
+    f = Sigmoid()
+    x = nd.array(np.random.uniform(-2, 2, size=(10,)).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        # composition: tape ops on both sides of the Function node
+        y = f(x * 2.0)
+        z = nd.sum(y * y)
+    z.backward()
+    xs = x.asnumpy()
+    s = 1.0 / (1.0 + np.exp(-2.0 * xs))
+    expect = 2.0 * s * (s * (1.0 - s)) * 2.0  # dz/dy=2y, dy/du=s(1-s), du/dx=2
+    np.testing.assert_allclose(x.grad.asnumpy(), expect, rtol=1e-5, atol=1e-6)
+
+
+def test_function_multi_input_output():
+    class SplitScale(autograd.Function):
+        def forward(self, a, b):
+            return a + b, a * b
+
+        def backward(self, dsum, dprod):
+            a, b = self._ab
+            return dsum + dprod * b, dsum + dprod * a
+
+    f = SplitScale()
+    a = nd.array(np.array([1.0, 2.0], np.float32))
+    b = nd.array(np.array([3.0, 4.0], np.float32))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        f._ab = (a, b)
+        s, p = f(a, b)
+        out = nd.sum(s) + nd.sum(p)
+    out.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), 1.0 + b.asnumpy(), rtol=1e-6)
+    np.testing.assert_allclose(b.grad.asnumpy(), 1.0 + a.asnumpy(), rtol=1e-6)

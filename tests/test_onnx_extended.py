@@ -251,6 +251,11 @@ def test_onnx_parity_ops_import_only():
 
     got = run("Size", {}, np.asarray(x.size))
     assert int(got) == x.size
+    assert np.asarray(got).shape == ()  # spec: rank-0 scalar, not (1,)
+
+    # opset-18 noop_with_empty_axes=1 with axes entirely absent: identity
+    got = run("ReduceSum", {"keepdims": 1, "noop_with_empty_axes": 1}, x)
+    np.testing.assert_allclose(got, x, rtol=1e-6)
 
     # deprecated Scatter aliases ScatterElements
     data = np.zeros((3, 3), np.float32)
@@ -914,3 +919,15 @@ def test_onnx_spatial_transformer_family_roundtrip_opset16():
             sym.BilinearSampler(d, sym.GridGenerator(
                 t, transform_type="affine", target_shape=(4, 4))),
             {}, input_shapes={"d": img.shape, "t": theta.shape}, opset=13)
+
+
+def test_clip_positional_export():
+    """Positional F.clip(x, lo, hi) (upstream's documented form) exports:
+    the bounds arrive as _const input symbols, not attrs."""
+    data = S.var("data")
+    out = mx.sym.clip(data, -0.5, 0.5)
+    x = np.random.default_rng(7).normal(size=(3, 4)).astype(np.float32)
+    mb = mxonnx.export_model(out, params={}, input_shapes={"data": x.shape})
+    blk = mxonnx.import_to_gluon(mb)
+    got = blk(nd.array(x)).asnumpy()
+    np.testing.assert_allclose(got, np.clip(x, -0.5, 0.5), rtol=1e-6)

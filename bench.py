@@ -50,9 +50,42 @@ import jax.numpy as jnp
 import numpy as np
 
 
+_LAST_BEAT = None  # monotonic time of the last progress line (watchdog feed)
+
+
 def _log(msg):
+    global _LAST_BEAT
+    _LAST_BEAT = time.monotonic()
     print("[bench] %.1fs %s" % (time.perf_counter() - _T0, msg),
           file=sys.stderr, flush=True)
+
+
+def _start_watchdog():
+    """Abort if no progress line lands for BENCH_WATCHDOG_S (default 20 min).
+
+    The axon relay can die MID-RUN (observed 2026-07-31 03:35Z: relay process
+    gone, the PJRT client's reconnect loop then blocks device_put/compile
+    forever with no exception). Every long phase is bracketed by _log calls,
+    so a stale heartbeat means a wedge, not slow work; exiting lets the outer
+    retry loop (tools/tpu_bench_loop.sh) reclaim the window instead of
+    burning its whole per-attempt timeout."""
+    import threading
+    limit = int(os.environ.get("BENCH_WATCHDOG_S", 1200))
+    if limit <= 0:
+        return
+    global _LAST_BEAT
+    _LAST_BEAT = time.monotonic()
+
+    def watch():
+        while True:
+            time.sleep(30)
+            if time.monotonic() - _LAST_BEAT > limit:
+                print("[bench] WATCHDOG: no progress for %ds — relay wedged "
+                      "mid-run; aborting (persisted modes are kept)" % limit,
+                      file=sys.stderr, flush=True)
+                os._exit(3)
+
+    threading.Thread(target=watch, daemon=True).start()
 
 
 _T0 = time.perf_counter()
@@ -612,6 +645,7 @@ def main():
                 print(json.dumps(out), flush=True)
             return
         _log("backend up (%s); initializing in-process..." % probe)
+    _start_watchdog()
     devs = jax.devices()
     _log("devices: %s" % (devs,))
 

@@ -1,10 +1,84 @@
 """contrib layers (ref: python/mxnet/gluon/contrib/nn/basic_layers.py)."""
 from __future__ import annotations
 
+import numpy as np
+
 from ..block import HybridBlock
 from ..nn import BatchNorm
 
-__all__ = ["Identity", "SparseEmbedding", "SyncBatchNorm", "HybridConcurrent", "Concurrent"]
+__all__ = ["Identity", "SparseEmbedding", "SyncBatchNorm", "HybridConcurrent",
+           "Concurrent", "PixelShuffle1D", "PixelShuffle2D", "PixelShuffle3D"]
+
+
+def _factors(factor, n):
+    f = tuple(factor) if isinstance(factor, (tuple, list)) else (factor,) * n
+    if len(f) != n or not all(isinstance(x, (int, np.integer)) and x > 0
+                              for x in f):
+        raise ValueError("factor must be a positive int or a tuple of %d "
+                         "positive ints, got %r" % (n, factor))
+    return tuple(int(x) for x in f)
+
+
+class PixelShuffle1D(HybridBlock):
+    """(N, C·f, W) → (N, C, W·f) sub-pixel upsample (ref:
+    python/mxnet/gluon/contrib/nn/basic_layers.py:PixelShuffle1D). Pure
+    reshape/transpose — XLA lowers it to a layout change fused into the
+    producing conv, so it is the TPU-preferred upsampling for super-resolution
+    heads (vs. Deconvolution's overlapping scatter)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        (self._f,) = _factors(factor, 1)
+
+    def hybrid_forward(self, F, x):
+        f = self._f
+        n, c, w = x.shape
+        y = F.reshape(x, shape=(n, c // f, f, w))
+        y = F.transpose(y, axes=(0, 1, 3, 2))        # (N, C, W, f)
+        return F.reshape(y, shape=(n, c // f, w * f))
+
+    def __repr__(self):
+        return "%s(factor=%d)" % (type(self).__name__, self._f)
+
+
+class PixelShuffle2D(HybridBlock):
+    """(N, C·f1·f2, H, W) → (N, C, H·f1, W·f2) (ref: contrib/nn
+    basic_layers.py:PixelShuffle2D; factor may be int or (f1, f2))."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        self._fs = _factors(factor, 2)
+
+    def hybrid_forward(self, F, x):
+        f1, f2 = self._fs
+        n, c, h, w = x.shape
+        cc = c // (f1 * f2)
+        y = F.reshape(x, shape=(n, cc, f1, f2, h, w))
+        y = F.transpose(y, axes=(0, 1, 4, 2, 5, 3))  # (N, C, H, f1, W, f2)
+        return F.reshape(y, shape=(n, cc, h * f1, w * f2))
+
+    def __repr__(self):
+        return "%s(factor=%s)" % (type(self).__name__, self._fs)
+
+
+class PixelShuffle3D(HybridBlock):
+    """(N, C·f1·f2·f3, D, H, W) → (N, C, D·f1, H·f2, W·f3) (ref: contrib/nn
+    basic_layers.py:PixelShuffle3D)."""
+
+    def __init__(self, factor, **kwargs):
+        super().__init__(**kwargs)
+        self._fs = _factors(factor, 3)
+
+    def hybrid_forward(self, F, x):
+        f1, f2, f3 = self._fs
+        n, c, d, h, w = x.shape
+        cc = c // (f1 * f2 * f3)
+        y = F.reshape(x, shape=(n, cc, f1, f2, f3, d, h, w))
+        y = F.transpose(y, axes=(0, 1, 5, 2, 6, 3, 7, 4))
+        return F.reshape(y, shape=(n, cc, d * f1, h * f2, w * f3))
+
+    def __repr__(self):
+        return "%s(factor=%s)" % (type(self).__name__, self._fs)
 
 
 class Identity(HybridBlock):

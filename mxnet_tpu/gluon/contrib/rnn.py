@@ -12,6 +12,7 @@ import numpy as np
 from ..rnn.rnn_cell import RecurrentCell
 
 __all__ = ["Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
+           "VariationalDropoutCell", "LSTMPCell",
            "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
            "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell"]
 
@@ -156,3 +157,114 @@ Conv3DLSTMCell = _cell("Conv3DLSTMCell", _ConvLSTMMixin, 3)
 Conv1DGRUCell = _cell("Conv1DGRUCell", _ConvGRUMixin, 1)
 Conv2DGRUCell = _cell("Conv2DGRUCell", _ConvGRUMixin, 2)
 Conv3DGRUCell = _cell("Conv3DGRUCell", _ConvGRUMixin, 3)
+
+
+class VariationalDropoutCell(RecurrentCell):
+    """Variational (per-sequence) dropout wrapper (ref: python/mxnet/gluon/
+    contrib/rnn/rnn_cell.py:VariationalDropoutCell, Gal & Ghahramani 2016).
+
+    One Bernoulli mask per sequence for each of inputs / recurrent state /
+    outputs, sampled on the first step after ``reset()`` and reused every
+    step — unlike ``DropoutCell`` which resamples per step. Masks are
+    inverted-dropout scaled (``F.Dropout`` of ones). Call ``reset()``
+    between sequences (upstream contract) so fresh masks are drawn."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+        self._di, self._ds, self._do = drop_inputs, drop_states, drop_outputs
+        self._mask_i = self._mask_s = self._mask_o = None
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def reset(self):
+        self.base_cell.reset()
+        self._mask_i = self._mask_s = self._mask_o = None
+
+    def _mask(self, F, cached, ref, rate):
+        if cached is None:
+            cached = F.Dropout(F.ones_like(ref), p=rate)
+        return cached
+
+    def hybrid_forward(self, F, inputs, states):
+        from ... import autograd
+
+        # inference is a pure pass-through even if a training-phase mask is
+        # still cached (upstream relies on reset() alone; gating on the mode
+        # removes the stale-mask foot-gun)
+        if not autograd.is_training():
+            return self.base_cell(inputs, states)
+        if self._di > 0:
+            self._mask_i = self._mask(F, self._mask_i, inputs, self._di)
+            inputs = inputs * self._mask_i
+        if self._ds > 0:
+            self._mask_s = self._mask(F, self._mask_s, states[0], self._ds)
+            states = [states[0] * self._mask_s] + list(states[1:])
+        out, nstates = self.base_cell(inputs, states)
+        if self._do > 0:
+            self._mask_o = self._mask(F, self._mask_o, out, self._do)
+            out = out * self._mask_o
+        return out, nstates
+
+    def __repr__(self):
+        return ("VariationalDropoutCell(p_in=%g, p_state=%g, p_out=%g, %r)"
+                % (self._di, self._ds, self._do, self.base_cell))
+
+
+class LSTMPCell(RecurrentCell):
+    """LSTM with a recurrent projection layer (ref: python/mxnet/gluon/
+    contrib/rnn/rnn_cell.py:LSTMPCell; Sak et al. 2014). The recurrent
+    state is ``r = h @ h2r`` of size ``projection_size`` — h2h and the
+    output operate on the projected state, cutting recurrent matmul cost
+    from O(h²) to O(h·p). Gate order [i, f, g, o] as LSTMCell."""
+
+    def __init__(self, hidden_size, projection_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 h2r_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._projection_size = projection_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(4 * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(4 * hidden_size, projection_size),
+                init=h2h_weight_initializer)
+            self.h2r_weight = self.params.get(
+                "h2r_weight", shape=(projection_size, hidden_size),
+                init=h2r_weight_initializer)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(4 * hidden_size,),
+                init=i2h_bias_initializer)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(4 * hidden_size,),
+                init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size)},
+                {"shape": (batch_size, self._hidden_size)}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       h2r_weight, i2h_bias, h2h_bias):
+        nh = self._hidden_size
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias, num_hidden=4 * nh)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=4 * nh)
+        gates = i2h + h2h
+        i = F.sigmoid(F.slice_axis(gates, axis=-1, begin=0, end=nh))
+        f = F.sigmoid(F.slice_axis(gates, axis=-1, begin=nh, end=2 * nh))
+        g = F.tanh(F.slice_axis(gates, axis=-1, begin=2 * nh, end=3 * nh))
+        o = F.sigmoid(F.slice_axis(gates, axis=-1, begin=3 * nh, end=4 * nh))
+        c = f * states[1] + i * g
+        hidden = o * F.tanh(c)
+        r = F.FullyConnected(hidden, h2r_weight, None, no_bias=True,
+                             num_hidden=self._projection_size)
+        return r, [r, c]

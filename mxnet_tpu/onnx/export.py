@@ -248,14 +248,23 @@ def _squeeze(ctx, s, ins, out):
 
 @register_converter("clip")
 def _clip(ctx, s, ins, out):
-    if "a_min" in s._attrs:
-        lo_v, hi_v = s._attrs["a_min"], s._attrs["a_max"]
-    else:
-        # positional `F.clip(x, lo, hi)`: bounds arrive as _const inputs
-        lo_v = s._inputs[1]._attrs["value"]
-        hi_v = s._inputs[2]._attrs["value"]
-    lo = ctx.const("min", np.float32(lo_v))
-    hi = ctx.const("max", np.float32(hi_v))
+    # each bound independently from attrs (keyword form) or the next _const
+    # input (positional form) — mixed calls like clip(x, -1, a_max=1) are
+    # legal Python and record one of each
+    nxt = [1]
+
+    def bound(name):
+        if name in s._attrs:
+            return s._attrs[name]
+        inp = s._inputs[nxt[0]]
+        nxt[0] += 1
+        if inp._op != "_const":
+            raise ValueError(
+                "clip: %s must be a scalar constant for ONNX export" % name)
+        return inp._attrs["value"]
+
+    lo = ctx.const("min", np.float32(bound("a_min")))
+    hi = ctx.const("max", np.float32(bound("a_max")))
     ctx.emit("Clip", [ins[0], lo, hi], [out])
 
 

@@ -347,3 +347,85 @@ def test_dist_async_is_loud_na():
         mx.kvstore.create("dist_async")
     with _pytest.raises(ValueError, match="async"):
         mx.kvstore.create("dist_sync_async")
+
+
+def test_pixelshuffle_layers():
+    """PixelShuffle{1,2,3}D vs numpy block-rearrange oracle (ref:
+    contrib/nn/basic_layers.py:PixelShuffle2D)."""
+    rng = np.random.default_rng(5)
+    # 1D: (N, C*f, W) -> (N, C, W*f)
+    x = rng.normal(size=(2, 6, 4)).astype(np.float32)
+    got = gluon.contrib.nn.PixelShuffle1D(3)(nd.array(x)).asnumpy()
+    want = x.reshape(2, 2, 3, 4).transpose(0, 1, 3, 2).reshape(2, 2, 12)
+    np.testing.assert_allclose(got, want)
+    # 2D, asymmetric factors
+    x = rng.normal(size=(2, 2 * 2 * 3, 4, 5)).astype(np.float32)
+    got = gluon.contrib.nn.PixelShuffle2D((2, 3))(nd.array(x)).asnumpy()
+    want = (x.reshape(2, 2, 2, 3, 4, 5).transpose(0, 1, 4, 2, 5, 3)
+            .reshape(2, 2, 8, 15))
+    np.testing.assert_allclose(got, want)
+    # 3D
+    x = rng.normal(size=(1, 8, 2, 3, 2)).astype(np.float32)
+    got = gluon.contrib.nn.PixelShuffle3D(2)(nd.array(x)).asnumpy()
+    want = (x.reshape(1, 1, 2, 2, 2, 2, 3, 2)
+            .transpose(0, 1, 5, 2, 6, 3, 7, 4).reshape(1, 1, 4, 6, 4))
+    np.testing.assert_allclose(got, want)
+    # hybridized path agrees with the numpy oracle
+    xh = rng.normal(size=(2, 12, 4, 5)).astype(np.float32)
+    blk = gluon.contrib.nn.PixelShuffle2D((2, 3))
+    blk.hybridize()
+    got_h = blk(nd.array(xh)).asnumpy()
+    want_h = (xh.reshape(2, 2, 2, 3, 4, 5).transpose(0, 1, 4, 2, 5, 3)
+              .reshape(2, 2, 8, 15))
+    np.testing.assert_allclose(got_h, want_h)
+
+
+def test_lstmp_cell():
+    """LSTMPCell: projected recurrent state sizes + grads flow (ref:
+    contrib/rnn/rnn_cell.py:LSTMPCell)."""
+    from mxnet_tpu import autograd
+    cell = gluon.contrib.rnn.LSTMPCell(hidden_size=8, projection_size=3,
+                                       input_size=5)
+    cell.initialize()
+    x = nd.array(np.random.default_rng(0).normal(size=(4, 5))
+                 .astype(np.float32))
+    states = cell.begin_state(4)
+    assert states[0].shape == (4, 3) and states[1].shape == (4, 8)
+    with autograd.record():
+        out, (r, c) = cell(x, states)
+        loss = (out * out).sum()
+    loss.backward()
+    assert out.shape == (4, 3) and r.shape == (4, 3) and c.shape == (4, 8)
+    g = cell.h2r_weight.grad()
+    assert np.isfinite(g.asnumpy()).all() and np.abs(g.asnumpy()).sum() > 0
+    # unroll keeps the projected state as the carried recurrent input
+    seq = nd.array(np.random.default_rng(1).normal(size=(4, 6, 5))
+                   .astype(np.float32))
+    outs, last = cell.unroll(6, seq, layout="NTC")
+    assert outs.shape == (4, 6, 3) and last[0].shape == (4, 3)
+
+
+def test_variational_dropout_cell_mask_reuse():
+    """One mask per sequence: the same elements are dropped at every step
+    (vs DropoutCell's per-step resample); reset() draws a fresh mask."""
+    from mxnet_tpu import autograd
+    base = gluon.rnn.LSTMCell(6, input_size=6)
+    cell = gluon.contrib.rnn.VariationalDropoutCell(base, drop_inputs=0.5)
+    cell.initialize()
+    x = nd.array(np.ones((2, 5, 6), np.float32))
+    with autograd.record():  # train mode: dropout active
+        cell.reset()
+        _ = cell.unroll(5, x, layout="NTC")
+        m1 = cell._mask_i.asnumpy()
+        cell.reset()
+        _ = cell.unroll(5, x, layout="NTC")
+        m2 = cell._mask_i.asnumpy()
+    assert set(np.unique(m1)) <= {0.0, 2.0}  # inverted dropout scaling
+    assert m1.shape == (2, 6)
+    assert not np.array_equal(m1, m2)  # fresh draw after reset
+    # eval mode: identity
+    out, _ = cell(nd.array(np.ones((2, 6), np.float32)),
+                  cell.begin_state(2))
+    base_out, _ = base(nd.array(np.ones((2, 6), np.float32)),
+                       base.begin_state(2))
+    np.testing.assert_allclose(out.asnumpy(), base_out.asnumpy(), rtol=1e-6)

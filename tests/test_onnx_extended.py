@@ -931,3 +931,12 @@ def test_clip_positional_export():
     blk = mxonnx.import_to_gluon(mb)
     got = blk(nd.array(x)).asnumpy()
     np.testing.assert_allclose(got, np.clip(x, -0.5, 0.5), rtol=1e-6)
+
+
+def test_clip_mixed_positional_keyword_export():
+    data = S.var("data")
+    out = mx.sym.clip(data, -0.25, a_max=0.75)
+    x = np.random.default_rng(9).normal(size=(2, 3)).astype(np.float32)
+    mb = mxonnx.export_model(out, params={}, input_shapes={"data": x.shape})
+    got = mxonnx.import_to_gluon(mb)(nd.array(x)).asnumpy()
+    np.testing.assert_allclose(got, np.clip(x, -0.25, 0.75), rtol=1e-6)

@@ -15,6 +15,8 @@ CONTRIB_OPS = {
     "DeformableConvolution": "DeformableConvolution",
     "ModulatedDeformableConvolution": "ModulatedDeformableConvolution",
     "PSROIPooling": "PSROIPooling",
+    "AdaptiveAvgPooling2D": "AdaptiveAvgPooling2D",
+    "BilinearResize2D": "BilinearResize2D",
     "Proposal": "Proposal",
     "MultiProposal": "MultiProposal",
 }

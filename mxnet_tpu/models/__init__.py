@@ -8,3 +8,4 @@ from . import ssd  # noqa: F401
 from . import faster_rcnn  # noqa: F401
 from . import gpt  # noqa: F401
 from . import yolo  # noqa: F401
+from . import fcn  # noqa: F401

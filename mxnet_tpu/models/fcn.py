@@ -1,0 +1,260 @@
+"""FCN semantic segmentation (GluonCV parity — ref: gluon-cv
+gluoncv/model_zoo/fcn.py, segbase.py, resnetv1b.py).
+
+Dilated-ResNet backbone (output stride 8: stages 3/4 trade stride for
+dilation 2/4) + the FCN head (3x3 conv bottleneck → 1x1 classifier) with a
+bilinear upsample back to input resolution, plus the stage-3 auxiliary head.
+
+TPU-native notes: the whole network is static-shape at a fixed crop size, so
+train step (including the per-pixel loss with ignore-label masking) compiles
+to ONE XLA program; the upsample is the align-corners BilinearResize2D
+(ops/functional.py) which XLA lowers to two MXU-free gather/matmul passes —
+no transposed-conv scatter like the original FCN's deconv layers.
+"""
+from __future__ import annotations
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from ..gluon.loss import Loss, _apply_weighting
+
+__all__ = ["FCN", "FCNHead", "PSPNet", "PSPHead",
+           "MixSoftmaxCrossEntropyLoss", "fcn_resnet50", "psp_resnet50",
+           "fcn_tiny_test", "psp_tiny_test"]
+
+
+class _BottleneckV1b(HybridBlock):
+    """ResNetV1b bottleneck with dilation (ref: gluoncv resnetv1b.py:
+    BottleneckV1b): 1x1 reduce → 3x3 (stride/dilation) → 1x1 expand."""
+
+    def __init__(self, channels, stride=1, dilation=1, downsample=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        mid = channels // 4
+        with self.name_scope():
+            self.body = nn.HybridSequential(prefix="")
+            self.body.add(nn.Conv2D(mid, 1, use_bias=False))
+            self.body.add(nn.BatchNorm())
+            self.body.add(nn.Activation("relu"))
+            self.body.add(nn.Conv2D(mid, 3, strides=stride, padding=dilation,
+                                    dilation=dilation, use_bias=False))
+            self.body.add(nn.BatchNorm())
+            self.body.add(nn.Activation("relu"))
+            self.body.add(nn.Conv2D(channels, 1, use_bias=False))
+            self.body.add(nn.BatchNorm())
+            if downsample:
+                self.downsample = nn.HybridSequential(prefix="down_")
+                with self.downsample.name_scope():
+                    self.downsample.add(nn.Conv2D(channels, 1, strides=stride,
+                                                  use_bias=False))
+                    self.downsample.add(nn.BatchNorm())
+            else:
+                self.downsample = None
+
+    def hybrid_forward(self, F, x):
+        residual = x if self.downsample is None else self.downsample(x)
+        return F.Activation(self.body(x) + residual, act_type="relu")
+
+
+class DilatedResNet(HybridBlock):
+    """Stride-8 dilated backbone (ref: gluoncv resnetv1b.py with
+    dilated=True): stages 1-2 stride {1,2}; stages 3-4 keep stride 1 and
+    dilate 2/4 so the stage-4 map stays at 1/8 input resolution."""
+
+    def __init__(self, layers=(3, 4, 6, 3), channels=(256, 512, 1024, 2048),
+                 stem_channels=64, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.stem = nn.HybridSequential(prefix="stem_")
+            with self.stem.name_scope():
+                self.stem.add(nn.Conv2D(stem_channels, 7, strides=2,
+                                        padding=3, use_bias=False))
+                self.stem.add(nn.BatchNorm())
+                self.stem.add(nn.Activation("relu"))
+                self.stem.add(nn.MaxPool2D(3, 2, 1))
+            specs = [  # (stride, dilation) per stage
+                (1, 1), (2, 1), (1, 2), (1, 4)]
+            self.stages = nn.HybridSequential(prefix="")
+            for i, (n, ch) in enumerate(zip(layers, channels)):
+                stride, dil = specs[i]
+                stage = nn.HybridSequential(prefix="stage%d_" % (i + 1))
+                with stage.name_scope():
+                    stage.add(_BottleneckV1b(ch, stride=stride, dilation=dil,
+                                             downsample=True, prefix=""))
+                    for _ in range(n - 1):
+                        stage.add(_BottleneckV1b(ch, dilation=dil, prefix=""))
+                self.stages.add(stage)
+
+    def hybrid_forward(self, F, x):
+        x = self.stem(x)
+        feats = []
+        for stage in self.stages:
+            x = stage(x)
+            feats.append(x)
+        return feats[-2], feats[-1]  # (c3 for the aux head, c4)
+
+
+class FCNHead(HybridBlock):
+    """3x3 bottleneck conv + dropout + 1x1 classifier (ref: gluoncv
+    fcn.py:_FCNHead)."""
+
+    def __init__(self, nclass, in_channels, **kwargs):
+        super().__init__(**kwargs)
+        mid = in_channels // 4
+        with self.name_scope():
+            self.block = nn.HybridSequential(prefix="")
+            self.block.add(nn.Conv2D(mid, 3, padding=1, use_bias=False))
+            self.block.add(nn.BatchNorm())
+            self.block.add(nn.Activation("relu"))
+            self.block.add(nn.Dropout(0.1))
+            self.block.add(nn.Conv2D(nclass, 1))
+
+    def hybrid_forward(self, F, x):
+        return self.block(x)
+
+
+class _SegBase(HybridBlock):
+    """Shared segmentation contract (ref: gluoncv segbase.py:SegBaseModel):
+    dilated backbone → head on c4 (+ aux FCNHead on c3), both upsampled to
+    input resolution (align-corners bilinear). Returns ``(out, auxout)``
+    when ``aux`` else ``(out,)``. Subclasses pick the head class."""
+
+    _head_cls = None  # set by subclass
+
+    def __init__(self, nclass, layers=(3, 4, 6, 3),
+                 channels=(256, 512, 1024, 2048), stem_channels=64,
+                 aux=True, **kwargs):
+        super().__init__(**kwargs)
+        self.nclass = nclass
+        self._aux = aux
+        with self.name_scope():
+            self.backbone = DilatedResNet(layers, channels, stem_channels)
+            self.head = self._head_cls(nclass, channels[-1])
+            if aux:
+                self.auxhead = FCNHead(nclass, channels[-2])
+
+    def hybrid_forward(self, F, x):
+        h, w = x.shape[2], x.shape[3]
+        c3, c4 = self.backbone(x)
+        out = F.BilinearResize2D(self.head(c4), height=h, width=w)
+        if not self._aux:
+            return (out,)
+        auxout = F.BilinearResize2D(self.auxhead(c3), height=h, width=w)
+        return out, auxout
+
+
+class FCN(_SegBase):
+    """FCN over a dilated backbone (ref: gluoncv fcn.py:FCN)."""
+
+    _head_cls = FCNHead
+
+
+class MixSoftmaxCrossEntropyLoss(Loss):
+    """Per-pixel CE over (B, nclass, H, W) logits with ignore-label masking
+    and an aux-head term (ref: gluoncv loss.py:MixSoftmaxCrossEntropyLoss).
+    The mask-and-mean stays on device — labels never round-trip to host."""
+
+    def __init__(self, aux=True, aux_weight=0.2, ignore_label=-1,
+                 weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._aux = aux
+        self._aux_weight = aux_weight
+        self._ignore = ignore_label
+
+    def _masked_ce(self, F, pred, label, sample_weight):
+        valid = label != self._ignore
+        safe = F.where(valid, label,
+                       F.zeros_like(label))  # in-range index for pick
+        lp = F.log_softmax(pred, axis=1)
+        nll = -F.pick(lp, safe, axis=1, keepdims=False)
+        nll = F.where(valid, nll, F.zeros_like(nll))
+        # global weight + optional per-pixel sample_weight, like every other
+        # gluon Loss (ref: gluon/loss.py:_apply_weighting), BEFORE the
+        # valid-pixel mean so weighting can't resurrect ignored pixels
+        nll = _apply_weighting(F, nll, self._weight, sample_weight)
+        denom = F.maximum(valid.astype(nll.dtype).sum(), 1.0)
+        return nll.sum() / denom
+
+    def hybrid_forward(self, F, preds, label, sample_weight=None):
+        if not isinstance(preds, (list, tuple)):
+            preds = (preds,)
+        loss = self._masked_ce(F, preds[0], label, sample_weight)
+        if self._aux and len(preds) > 1:
+            loss = loss + self._aux_weight * self._masked_ce(
+                F, preds[1], label, sample_weight)
+        return loss
+
+
+class _PSPConv(HybridBlock):
+    def __init__(self, channels, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.block = nn.HybridSequential(prefix="")
+            self.block.add(nn.Conv2D(channels, 1, use_bias=False))
+            self.block.add(nn.BatchNorm())
+            self.block.add(nn.Activation("relu"))
+
+    def hybrid_forward(self, F, x):
+        return self.block(x)
+
+
+class PSPHead(HybridBlock):
+    """Pyramid Scene Parsing head (ref: gluoncv pspnet.py:_PyramidPooling +
+    _PSPHead): pool the stage-4 map to 1/2/3/6 grids
+    (``F.AdaptiveAvgPooling2D`` — two-matmul form, ops/functional.py), 1x1
+    bottleneck each, upsample back and concat, then a 3x3 fuse conv and the
+    classifier."""
+
+    def __init__(self, nclass, in_channels, **kwargs):
+        super().__init__(**kwargs)
+        mid = max(in_channels // 4, 4)
+        with self.name_scope():
+            self.p1 = _PSPConv(mid)
+            self.p2 = _PSPConv(mid)
+            self.p3 = _PSPConv(mid)
+            self.p6 = _PSPConv(mid)
+            self.fuse = nn.HybridSequential(prefix="fuse_")
+            with self.fuse.name_scope():
+                self.fuse.add(nn.Conv2D(mid, 3, padding=1, use_bias=False))
+                self.fuse.add(nn.BatchNorm())
+                self.fuse.add(nn.Activation("relu"))
+                self.fuse.add(nn.Dropout(0.1))
+                self.fuse.add(nn.Conv2D(nclass, 1))
+
+    def hybrid_forward(self, F, x):
+        h, w = x.shape[2], x.shape[3]
+
+        def level(blk, size):
+            y = blk(F.AdaptiveAvgPooling2D(x, output_size=size))
+            return F.BilinearResize2D(y, height=h, width=w)
+
+        cat = F.concat(x, level(self.p1, 1), level(self.p2, 2),
+                       level(self.p3, 3), level(self.p6, 6), dim=1)
+        return self.fuse(cat)
+
+
+class PSPNet(_SegBase):
+    """PSPNet over the dilated backbone (ref: gluoncv pspnet.py:PSPNet).
+    Same output contract as FCN: (out, auxout) at input resolution."""
+
+    _head_cls = PSPHead
+
+
+def fcn_resnet50(nclass=21, aux=True, **kwargs):
+    """FCN-ResNet50 (ref: gluoncv fcn.py:get_fcn_resnet50_voc; 21 = VOC)."""
+    return FCN(nclass, layers=(3, 4, 6, 3), aux=aux, **kwargs)
+
+
+def psp_resnet50(nclass=21, aux=True, **kwargs):
+    """PSPNet-ResNet50 (ref: gluoncv pspnet.py:get_psp_resnet50_voc)."""
+    return PSPNet(nclass, layers=(3, 4, 6, 3), aux=aux, **kwargs)
+
+
+def fcn_tiny_test(nclass=5, aux=True):
+    """Small config for tests: two blocks/stage, narrow channels."""
+    return FCN(nclass, layers=(1, 1, 1, 1), channels=(16, 32, 48, 64),
+               stem_channels=8, aux=aux)
+
+
+def psp_tiny_test(nclass=5, aux=True):
+    return PSPNet(nclass, layers=(1, 1, 1, 1), channels=(16, 32, 48, 64),
+                  stem_channels=8, aux=aux)

@@ -14,6 +14,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.scipy import special as jsp
 
@@ -880,6 +881,32 @@ def UpSampling(x, *, scale=2, sample_type="nearest"):
     if sample_type == "nearest":
         return jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
     return jax.image.resize(x, (n, c, h * scale, w * scale), method="bilinear")
+
+
+@register_op("AdaptiveAvgPooling2D")
+def AdaptiveAvgPooling2D(x, *, output_size=1):
+    """Adaptive average pool of (B, C, H, W) to (B, C, oh, ow) (ref:
+    src/operator/contrib/adaptive_avg_pooling.cc, torch-style windows
+    [floor(i·H/oh), ceil((i+1)·H/oh))). Output sizes are static, so the pool
+    is two small matmuls (row/col averaging matrices built at trace time) —
+    MXU-tiled by XLA instead of a gather loop."""
+    if isinstance(output_size, (tuple, list)):
+        oh, ow = (int(output_size[0]),
+                  int(output_size[1 if len(output_size) > 1 else 0]))
+    else:
+        oh = ow = int(output_size)
+    h, w = x.shape[2], x.shape[3]
+
+    def avg_mat(n_in, n_out):
+        m = np.zeros((n_out, n_in), np.float32)
+        for i in range(n_out):
+            s, e = (i * n_in) // n_out, -((-(i + 1) * n_in) // n_out)
+            m[i, s:e] = 1.0 / (e - s)
+        return m
+
+    left = jnp.asarray(avg_mat(h, oh), x.dtype)
+    right = jnp.asarray(avg_mat(w, ow), x.dtype).T
+    return jnp.einsum("oh,bchw,wp->bcop", left, x, right)
 
 
 @register_op("BilinearResize2D")

@@ -1,0 +1,119 @@
+"""FCN segmentation family (ref: gluon-cv tests/unittests/test_model_zoo.py
+segmentation entries)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.models.fcn import (FCN, MixSoftmaxCrossEntropyLoss,
+                                  fcn_tiny_test)
+
+
+def _rand_batch(rng, b=2, size=32, nclass=5):
+    x = nd.array(rng.normal(size=(b, 3, size, size)).astype(np.float32))
+    y = rng.integers(0, nclass, (b, size, size)).astype(np.float32)
+    y[:, :2, :] = -1  # ignore strip
+    return x, nd.array(y)
+
+
+def test_fcn_forward_shapes():
+    net = fcn_tiny_test(nclass=5)
+    net.initialize()
+    x = nd.array(np.random.default_rng(0).normal(size=(2, 3, 32, 32))
+                 .astype(np.float32))
+    out, auxout = net(x)
+    assert out.shape == (2, 5, 32, 32)
+    assert auxout.shape == (2, 5, 32, 32)
+    # no-aux variant returns a 1-tuple
+    net2 = fcn_tiny_test(nclass=3, aux=False)
+    net2.initialize()
+    (o,) = net2(x)
+    assert o.shape == (2, 3, 32, 32)
+
+
+def test_fcn_output_stride_8():
+    """Dilated stages keep the stage-4 map at 1/8 input resolution."""
+    from mxnet_tpu.models.fcn import DilatedResNet
+    bb = DilatedResNet(layers=(1, 1, 1, 1), channels=(8, 16, 24, 32),
+                       stem_channels=8)
+    bb.initialize()
+    x = nd.array(np.zeros((1, 3, 64, 64), np.float32))
+    c3, c4 = bb(x)
+    assert c3.shape[2:] == (8, 8) and c4.shape[2:] == (8, 8)
+
+
+def test_fcn_ignore_label_loss():
+    rng = np.random.default_rng(1)
+    net = fcn_tiny_test(nclass=5)
+    net.initialize()
+    x, y = _rand_batch(rng)
+    crit = MixSoftmaxCrossEntropyLoss(aux=True, ignore_label=-1)
+    loss = crit(net(x), y)
+    assert np.isfinite(float(loss.asnumpy()))
+    # all-ignored labels give exactly zero loss (masked mean, no NaN)
+    y_all = nd.array(np.full((2, 32, 32), -1, np.float32))
+    l0 = crit(net(x), y_all)
+    assert float(l0.asnumpy()) == 0.0
+
+
+def test_fcn_trains_and_hybridizes():
+    rng = np.random.default_rng(2)
+    net = fcn_tiny_test(nclass=5)
+    net.initialize()
+    x, y = _rand_batch(rng)
+    crit = MixSoftmaxCrossEntropyLoss(aux=True, ignore_label=-1)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 5e-3})
+    losses = []
+    for _ in range(8):
+        with autograd.record():
+            loss = crit(net(x), y)
+        loss.backward()
+        trainer.step(1)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0]
+    # hybridized forward == imperative (eval mode: dropout off)
+    ref = net(x)[0].asnumpy()
+    net.hybridize()
+    got = net(x)[0].asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_adaptive_avg_pooling_vs_torch():
+    """contrib.AdaptiveAvgPooling2D matches torch's window convention
+    (ref: src/operator/contrib/adaptive_avg_pooling.cc)."""
+    import pytest
+    torch = pytest.importorskip("torch")
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(2, 4, 7, 11)).astype(np.float32)
+    for size in (1, 3, (2, 5), (7, 11)):
+        got = nd.contrib.AdaptiveAvgPooling2D(nd.array(x),
+                                              output_size=size).asnumpy()
+        tsize = size if isinstance(size, tuple) else (size, size)
+        want = torch.nn.functional.adaptive_avg_pool2d(
+            torch.tensor(x), tsize).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_pspnet_trains_and_hybridizes():
+    from mxnet_tpu.models.fcn import psp_tiny_test
+    rng = np.random.default_rng(4)
+    net = psp_tiny_test(nclass=4)
+    net.initialize()
+    x, y = _rand_batch(rng, b=2, size=32, nclass=4)
+    out, auxout = net(x)
+    assert out.shape == (2, 4, 32, 32) and auxout.shape == (2, 4, 32, 32)
+    crit = MixSoftmaxCrossEntropyLoss(aux=True, ignore_label=-1)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 5e-3})
+    losses = []
+    for _ in range(6):
+        with autograd.record():
+            loss = crit(net(x), y)
+        loss.backward()
+        trainer.step(1)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < losses[0]
+    ref = net(x)[0].asnumpy()
+    net.hybridize()
+    got = net(x)[0].asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)

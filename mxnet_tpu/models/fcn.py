@@ -18,8 +18,9 @@ from ..gluon.block import HybridBlock
 from ..gluon.loss import Loss, _apply_weighting
 
 __all__ = ["FCN", "FCNHead", "PSPNet", "PSPHead",
-           "MixSoftmaxCrossEntropyLoss", "fcn_resnet50", "psp_resnet50",
-           "fcn_tiny_test", "psp_tiny_test"]
+           "MixSoftmaxCrossEntropyLoss", "DeepLabV3", "ASPPHead",
+           "fcn_resnet50", "psp_resnet50", "deeplabv3_resnet50",
+           "fcn_tiny_test", "psp_tiny_test", "deeplab_tiny_test"]
 
 
 class _BottleneckV1b(HybridBlock):
@@ -171,8 +172,11 @@ class MixSoftmaxCrossEntropyLoss(Loss):
         # gluon Loss (ref: gluon/loss.py:_apply_weighting), BEFORE the
         # valid-pixel mean so weighting can't resurrect ignored pixels
         nll = _apply_weighting(F, nll, self._weight, sample_weight)
-        denom = F.maximum(valid.astype(nll.dtype).sum(), 1.0)
-        return nll.sum() / denom
+        # per-SAMPLE masked mean, shape (B,) — the gluon Loss contract
+        # (every loss returns batch-axis vectors for downstream weighting)
+        spatial = tuple(range(1, len(nll.shape)))
+        denom = F.maximum(valid.astype(nll.dtype).sum(axis=spatial), 1.0)
+        return nll.sum(axis=spatial) / denom
 
     def hybrid_forward(self, F, preds, label, sample_weight=None):
         if not isinstance(preds, (list, tuple)):
@@ -184,12 +188,14 @@ class MixSoftmaxCrossEntropyLoss(Loss):
         return loss
 
 
-class _PSPConv(HybridBlock):
-    def __init__(self, channels, **kwargs):
+class _ASPPConv(HybridBlock):
+    def __init__(self, channels, kernel, dilation=1, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
             self.block = nn.HybridSequential(prefix="")
-            self.block.add(nn.Conv2D(channels, 1, use_bias=False))
+            self.block.add(nn.Conv2D(channels, kernel,
+                                     padding=(kernel // 2) * dilation,
+                                     dilation=dilation, use_bias=False))
             self.block.add(nn.BatchNorm())
             self.block.add(nn.Activation("relu"))
 
@@ -208,10 +214,10 @@ class PSPHead(HybridBlock):
         super().__init__(**kwargs)
         mid = max(in_channels // 4, 4)
         with self.name_scope():
-            self.p1 = _PSPConv(mid)
-            self.p2 = _PSPConv(mid)
-            self.p3 = _PSPConv(mid)
-            self.p6 = _PSPConv(mid)
+            self.p1 = _ASPPConv(mid, 1)
+            self.p2 = _ASPPConv(mid, 1)
+            self.p3 = _ASPPConv(mid, 1)
+            self.p6 = _ASPPConv(mid, 1)
             self.fuse = nn.HybridSequential(prefix="fuse_")
             with self.fuse.name_scope():
                 self.fuse.add(nn.Conv2D(mid, 3, padding=1, use_bias=False))
@@ -239,6 +245,46 @@ class PSPNet(_SegBase):
     _head_cls = PSPHead
 
 
+class ASPPHead(HybridBlock):
+    """Atrous Spatial Pyramid Pooling head (ref: gluoncv deeplab.py:
+    _DeepLabHead/_ASPP): parallel 1x1 + three dilated 3x3 branches
+    (rates 12/24/36 at output stride 8) + a global-pool image branch,
+    concatenated and projected, then the classifier."""
+
+    def __init__(self, nclass, in_channels, rates=(12, 24, 36), **kwargs):
+        super().__init__(**kwargs)
+        mid = max(in_channels // 8, 4)
+        with self.name_scope():
+            self.b0 = _ASPPConv(mid, 1)
+            self.b1 = _ASPPConv(mid, 3, rates[0])
+            self.b2 = _ASPPConv(mid, 3, rates[1])
+            self.b3 = _ASPPConv(mid, 3, rates[2])
+            self.image_pool = _ASPPConv(mid, 1)
+            self.project = nn.HybridSequential(prefix="proj_")
+            with self.project.name_scope():
+                self.project.add(nn.Conv2D(mid, 1, use_bias=False))
+                self.project.add(nn.BatchNorm())
+                self.project.add(nn.Activation("relu"))
+                self.project.add(nn.Dropout(0.1))
+                self.project.add(nn.Conv2D(nclass, 1))
+
+    def hybrid_forward(self, F, x):
+        h, w = x.shape[2], x.shape[3]
+        img = F.BilinearResize2D(
+            self.image_pool(F.AdaptiveAvgPooling2D(x, output_size=1)),
+            height=h, width=w)
+        cat = F.concat(self.b0(x), self.b1(x), self.b2(x), self.b3(x), img,
+                       dim=1)
+        return self.project(cat)
+
+
+class DeepLabV3(_SegBase):
+    """DeepLabV3 (ref: gluoncv deeplab.py:DeepLabV3): ASPP over the
+    stride-8 dilated backbone, same (out, auxout) contract."""
+
+    _head_cls = ASPPHead
+
+
 def fcn_resnet50(nclass=21, aux=True, **kwargs):
     """FCN-ResNet50 (ref: gluoncv fcn.py:get_fcn_resnet50_voc; 21 = VOC)."""
     return FCN(nclass, layers=(3, 4, 6, 3), aux=aux, **kwargs)
@@ -258,3 +304,13 @@ def fcn_tiny_test(nclass=5, aux=True):
 def psp_tiny_test(nclass=5, aux=True):
     return PSPNet(nclass, layers=(1, 1, 1, 1), channels=(16, 32, 48, 64),
                   stem_channels=8, aux=aux)
+
+
+def deeplabv3_resnet50(nclass=21, aux=True, **kwargs):
+    """DeepLabV3-ResNet50 (ref: gluoncv deeplab.py:get_deeplab_resnet50_voc)."""
+    return DeepLabV3(nclass, layers=(3, 4, 6, 3), aux=aux, **kwargs)
+
+
+def deeplab_tiny_test(nclass=5, aux=True):
+    return DeepLabV3(nclass, layers=(1, 1, 1, 1), channels=(16, 32, 48, 64),
+                     stem_channels=8, aux=aux)

@@ -884,12 +884,15 @@ def UpSampling(x, *, scale=2, sample_type="nearest"):
 
 
 @register_op("AdaptiveAvgPooling2D")
-def AdaptiveAvgPooling2D(x, *, output_size=1):
+def AdaptiveAvgPooling2D(x, *, output_size=None):
     """Adaptive average pool of (B, C, H, W) to (B, C, oh, ow) (ref:
     src/operator/contrib/adaptive_avg_pooling.cc, torch-style windows
     [floor(i·H/oh), ceil((i+1)·H/oh))). Output sizes are static, so the pool
     is two small matmuls (row/col averaging matrices built at trace time) —
-    MXU-tiled by XLA instead of a gather loop."""
+    MXU-tiled by XLA instead of a gather loop. An omitted/empty output_size
+    keeps the input size (upstream's empty-param branch)."""
+    if output_size is None or output_size == ():
+        return x
     if isinstance(output_size, (tuple, list)):
         oh, ow = (int(output_size[0]),
                   int(output_size[1 if len(output_size) > 1 else 0]))

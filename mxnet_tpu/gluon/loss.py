@@ -55,6 +55,13 @@ class L1Loss(Loss):
         return _batch_mean(F, loss, self._batch_axis)
 
 
+def sigmoid_bce_with_logits(F, logits, targets):
+    """Numerically-stable sigmoid cross-entropy from logits:
+    max(x,0) - x·z + log1p(exp(-|x|)). Shared by SigmoidBCELoss, the YOLOv3
+    objectness/class terms, and the Mask R-CNN mask loss."""
+    return F.relu(logits) - logits * targets + F.log1p(F.exp(-F.abs(logits)))
+
+
 class SigmoidBinaryCrossEntropyLoss(Loss):
     """(ref: loss.py:SigmoidBinaryCrossEntropyLoss)"""
 
@@ -65,8 +72,7 @@ class SigmoidBinaryCrossEntropyLoss(Loss):
     def hybrid_forward(self, F, pred, label, sample_weight=None, pos_weight=None):
         label = F.reshape(label, shape=pred.shape)
         if not self._from_sigmoid:
-            # log-sum-exp stable form
-            loss = F.relu(pred) - pred * label + F.log(1.0 + F.exp(-F.abs(pred)))
+            loss = sigmoid_bce_with_logits(F, pred, label)
         else:
             eps = 1e-12
             loss = -(F.log(pred + eps) * label + F.log(1.0 - pred + eps) * (1.0 - label))

@@ -15,7 +15,8 @@ from __future__ import annotations
 from ..gluon import nn
 from ..gluon.block import HybridBlock
 
-__all__ = ["FasterRCNN", "faster_rcnn_small", "RCNNTargetLoss"]
+__all__ = ["FasterRCNN", "MaskRCNN", "faster_rcnn_small",
+           "mask_rcnn_small", "RCNNTargetLoss", "MaskTargetLoss"]
 
 
 class _RPNHead(HybridBlock):
@@ -108,7 +109,9 @@ class FasterRCNN(HybridBlock):
             self.box_out = nn.Dense(4 * (num_classes + 1), in_units=head_units,
                                     prefix="head_box_")
 
-    def hybrid_forward(self, F, x, im_info):
+    def _core(self, F, x, im_info):
+        """Backbone → RPN → Proposal → ROIAlign → head; also returns the
+        backbone feature map for subclasses (MaskRCNN's mask branch)."""
         feat = self.features(x)
         if self.neck is not None:
             feat = self.neck(feat)
@@ -130,7 +133,10 @@ class FasterRCNN(HybridBlock):
             pooled, shape=(pooled.shape[0], -1))))
         cls = F.softmax(self.cls_out(h), axis=-1)
         deltas = self.box_out(h)
-        return cls, deltas, rois, scores, rpn_cls, rpn_box
+        return cls, deltas, rois, scores, rpn_cls, rpn_box, feat
+
+    def hybrid_forward(self, F, x, im_info):
+        return self._core(F, x, im_info)[:6]
 
     def detect(self, x, im_info, score_thresh=0.05, nms_thresh=0.3):
         """Score-masked per-class detection over the fixed proposal set:
@@ -138,7 +144,9 @@ class FasterRCNN(HybridBlock):
         score -1 (the static-shape convention of ops/detection.py)."""
         from .. import nd
 
-        cls, deltas, rois, *_ = self(x, im_info)
+        # _core (not self(...)): a MaskRCNN must not pay for the mask branch
+        # it would immediately discard here
+        cls, deltas, rois, *_ = self._core(nd, x, im_info)
         R = rois.shape[0]
         best = nd.argmax(cls, axis=1)                       # (R,)
         best_score = nd.max(cls, axis=1)
@@ -210,7 +218,89 @@ class RCNNTargetLoss(HybridBlock):
         return cls_loss + box_loss
 
 
+class MaskRCNN(FasterRCNN):
+    """Mask R-CNN (ref: gluoncv model_zoo/mask_rcnn/mask_rcnn.py): Faster
+    R-CNN + an FCN mask branch — ROIAlign at ``mask_roi`` on the shared
+    feature map over the SAME static proposal set, four 3x3 convs, a 2x
+    transposed-conv upsample, and a per-class 1x1 mask logit layer. Output
+    masks are (R, num_classes, 2·mask_roi, 2·mask_roi) logits; everything
+    stays one jittable program (the CUDA original re-pools on host-selected
+    detections)."""
+
+    def __init__(self, num_classes=20, mask_roi=14, mask_channels=64,
+                 **kwargs):
+        super().__init__(num_classes=num_classes, **kwargs)
+        self._mask_roi = mask_roi
+        with self.name_scope():
+            self.mask_convs = nn.HybridSequential(prefix="mask_")
+            with self.mask_convs.name_scope():
+                for _ in range(4):
+                    self.mask_convs.add(nn.Conv2D(mask_channels, 3, padding=1,
+                                                  activation="relu"))
+                self.mask_convs.add(nn.Conv2DTranspose(mask_channels, 2,
+                                                       strides=2,
+                                                       activation="relu"))
+                self.mask_convs.add(nn.Conv2D(num_classes, 1))
+
+    def hybrid_forward(self, F, x, im_info):
+        cls, deltas, rois, scores, rpn_cls, rpn_box, feat = \
+            self._core(F, x, im_info)
+        m = F.ROIAlign(feat, rois,
+                       pooled_size=(self._mask_roi, self._mask_roi),
+                       spatial_scale=1.0 / self._stride)
+        masks = self.mask_convs(m)  # (R, C, 2·roi, 2·roi) logits
+        return cls, deltas, rois, scores, rpn_cls, rpn_box, masks
+
+
+class MaskTargetLoss(HybridBlock):
+    """On-device mask targets + BCE (ref: gluoncv mask_rcnn target
+    generator, rcnn/mask_target.py). Instead of the reference's host-side
+    crop-and-resize per sampled roi, the gt instance masks (N, H, W) are
+    treated as an N-channel image and ROIAlign'd over ALL R static
+    proposals at the mask resolution in one shot; each roi then picks its
+    argmax-IoU instance's channel. Foreground = IoU > fg_thresh; the BCE is
+    computed on the matched gt class's logit channel only (Mask R-CNN's
+    per-class decoupling)."""
+
+    def __init__(self, fg_thresh=0.5, **kwargs):
+        super().__init__(**kwargs)
+        self._fg = fg_thresh
+
+    def hybrid_forward(self, F, mask_logits, rois, gt_boxes, gt_classes,
+                       gt_masks):
+        """mask_logits (R, C, m, m); rois (R, 5); gt_boxes (N, 4) corner
+        pixels (padded rows: all -1); gt_classes (N,) in [0, C) or -1 pad;
+        gt_masks (N, H, W) binary."""
+        R = rois.shape[0]
+        m = mask_logits.shape[2]
+        iou = F.box_iou(rois[:, 1:], gt_boxes)             # (R, N)
+        pad = F.reshape(gt_classes < 0.0, shape=(1, -1))
+        iou = F.where(F.broadcast_like(pad, iou), F.zeros_like(iou), iou)
+        match = F.argmax(iou, axis=1)                      # (R,)
+        fg = F.max(iou, axis=1) > self._fg
+        # crop-resize every instance mask to every roi in one ROIAlign
+        crops = F.ROIAlign(F.expand_dims(gt_masks, axis=0), rois,
+                           pooled_size=(m, m), spatial_scale=1.0)  # (R,N,m,m)
+        tgt = F.pick(F.transpose(crops, axes=(0, 2, 3, 1)),
+                     F.reshape(match, shape=(R, 1, 1)), axis=3)    # (R,m,m)
+        cls_of = F.maximum(F.take(gt_classes, match), 0.0)         # (R,)
+        logit = F.pick(F.transpose(mask_logits, axes=(0, 2, 3, 1)),
+                       F.reshape(cls_of, shape=(R, 1, 1)), axis=3)  # (R,m,m)
+        from ..gluon.loss import sigmoid_bce_with_logits
+
+        bce = sigmoid_bce_with_logits(F, logit, tgt)
+        w = F.reshape(fg.astype("float32"), shape=(R, 1, 1))
+        return F.sum(bce * w) / F.maximum(F.sum(w) * m * m, 1.0)
+
+
 def faster_rcnn_small(num_classes=20, deformable=False, **kwargs):
     """Small test/train-scale config (stride 16, 6 anchors)."""
     return FasterRCNN(num_classes=num_classes, deformable_head=deformable,
                       **kwargs)
+
+
+def mask_rcnn_small(num_classes=20, **kwargs):
+    """Small Mask R-CNN config (ref: gluoncv mask_rcnn_resnet50 family)."""
+    kwargs.setdefault("mask_roi", 7)
+    kwargs.setdefault("mask_channels", 32)
+    return MaskRCNN(num_classes=num_classes, **kwargs)

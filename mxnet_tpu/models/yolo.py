@@ -177,9 +177,9 @@ class YOLOv3Loss(HybridBlock):
 
     @staticmethod
     def _bce(F, logits, targets):
-        # stable sigmoid cross-entropy: max(x,0) - x*z + log1p(exp(-|x|))
-        return (F.relu(logits) - logits * targets
-                + F.log1p(F.exp(-F.abs(logits))))
+        from ..gluon.loss import sigmoid_bce_with_logits
+
+        return sigmoid_bce_with_logits(F, logits, targets)
 
     def hybrid_forward(self, F, raw, labels):
         nc = self._nc

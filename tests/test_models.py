@@ -270,3 +270,76 @@ def test_gpt_training_descends():
         ls.append(float(L.asscalar()))
     assert all(np.isfinite(ls))
     assert ls[-1] < ls[0], ls
+
+
+def test_mask_rcnn_forward_and_mask_loss():
+    """Mask R-CNN branch (ref: gluoncv model_zoo/mask_rcnn): mask logits
+    shape, on-device mask-target crop oracle, and the mask BCE descending."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    from mxnet_tpu.models.faster_rcnn import (MaskTargetLoss, RCNNTargetLoss,
+                                              mask_rcnn_small)
+
+    net = mask_rcnn_small(num_classes=3, rpn_pre_nms=64, rpn_post_nms=8)
+    net.initialize()
+    x = _rand(1, 3, 64, 64)
+    ii = nd.array(np.array([[64, 64, 1.0]], np.float32))
+    cls, deltas, rois, scores, rpn_cls, rpn_box, masks = net(x, ii)
+    R = rois.shape[0]
+    assert masks.shape == (R, 3, 14, 14)  # 2 x mask_roi(7)
+
+    # two gt instances: boxes in pixels, binary masks
+    gt_boxes = nd.array(np.array([[8, 8, 30, 30], [34, 34, 60, 60]],
+                                 np.float32))
+    gt_cls = nd.array(np.array([0.0, 2.0], np.float32))
+    gm = np.zeros((2, 64, 64), np.float32)
+    gm[0, 8:31, 8:31] = 1.0
+    gm[1, 34:61, 34:61] = 1.0
+    gt_masks = nd.array(gm)
+
+    lossfn = MaskTargetLoss()
+    head_loss = RCNNTargetLoss(3, 64)
+    lab = nd.array(np.array([[[0, 8 / 64, 8 / 64, 30 / 64, 30 / 64],
+                              [2, 34 / 64, 34 / 64, 60 / 64, 60 / 64]]],
+                            np.float32))
+    trainer = mx.gluon.Trainer(net.collect_params(), "adam",
+                               {"learning_rate": 3e-3})
+    ls = []
+    for _ in range(5):
+        with autograd.record():
+            cls, deltas, rois, scores, rpn_cls, rpn_box, masks = net(x, ii)
+            L = head_loss(cls, deltas, rois, lab) \
+                + lossfn(masks, rois, gt_boxes, gt_cls, gt_masks)
+        L.backward()
+        trainer.step(1)
+        ls.append(float(L.asscalar()))
+    assert all(np.isfinite(ls))
+    assert min(ls[1:]) < ls[0]
+
+
+def test_mask_target_crop_oracle():
+    """A roi exactly covering a gt box crops that instance's mask: interior
+    of a solid mask -> target 1 everywhere inside."""
+    from mxnet_tpu.models.faster_rcnn import MaskTargetLoss
+    m = 8
+    R = 2
+    rois = nd.array(np.array([[0, 8, 8, 31, 31], [0, 34, 34, 61, 61]],
+                             np.float32))
+    gt_boxes = nd.array(np.array([[8, 8, 31, 31], [34, 34, 61, 61]],
+                                 np.float32))
+    gt_cls = nd.array(np.array([1.0, 0.0], np.float32))
+    gm = np.zeros((2, 64, 64), np.float32)
+    gm[0, 8:32, 8:32] = 1.0
+    gm[1, 34:62, 34:62] = 1.0
+    # logits hugely positive on the right class channel -> BCE ~ 0
+    logits = np.full((R, 2, m, m), -20.0, np.float32)
+    logits[0, 1] = 20.0
+    logits[1, 0] = 20.0
+    lossfn = MaskTargetLoss()
+    L = float(lossfn(nd.array(logits), rois, gt_boxes, gt_cls,
+                     nd.array(gm)).asscalar())
+    assert L < 1e-3
+    # flipped logits -> large loss
+    Lbad = float(lossfn(nd.array(-logits), rois, gt_boxes, gt_cls,
+                        nd.array(gm)).asscalar())
+    assert Lbad > 5.0

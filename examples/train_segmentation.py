@@ -10,7 +10,6 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import numpy as np
 
-import mxnet_tpu as mx
 from mxnet_tpu import autograd, gluon, nd
 from mxnet_tpu.models.fcn import (MixSoftmaxCrossEntropyLoss,
                                   deeplab_tiny_test, fcn_tiny_test,
@@ -22,6 +21,8 @@ FACTORIES = {"fcn": fcn_tiny_test, "psp": psp_tiny_test,
 
 def synthetic_batch(rng, batch=4, size=64, nclass=3):
     """Images with bright axis-aligned squares; mask = square's class."""
+    if size <= 24:
+        raise ValueError("size must be > 24 to place the squares")
     x = rng.standard_normal((batch, 3, size, size)).astype(np.float32) * 0.2
     y = np.zeros((batch, size, size), np.float32)
     for b in range(batch):

@@ -1195,6 +1195,36 @@ def _emit_linear_resize(ctx, s, ins, out, ctm):
         ctx.emit("Resize", [ins[0], "", scales], [out], attrs=attrs)
 
 
+@register_converter("AdaptiveAvgPooling2D")
+def _adaptive_avg_pool_conv(ctx, s, ins, out):
+    """output_size (1,1) → GlobalAveragePool; anything else exports the op's
+    exact two-matmul form (ops/functional.py:AdaptiveAvgPooling2D): L·x·R
+    with the static averaging matrices as initializers — bit-identical to
+    the registry op, expressible in plain ONNX (no AdaptiveAvgPool exists
+    in the spec)."""
+    size = s._attrs.get("output_size")
+    h, w = s._inputs[0].shape[2], s._inputs[0].shape[3]
+    if size is None or size == ():
+        oh, ow = h, w
+    elif isinstance(size, (tuple, list)):
+        oh, ow = int(size[0]), int(size[1 if len(size) > 1 else 0])
+    else:
+        oh = ow = int(size)
+    if (oh, ow) == (1, 1):
+        ctx.emit("GlobalAveragePool", [ins[0]], [out])
+        return
+    if (oh, ow) == (h, w):
+        ctx.emit("Identity", [ins[0]], [out])
+        return
+    from ..ops.functional import adaptive_avg_matrix
+
+    left = ctx.const("adaptL", adaptive_avg_matrix(h, oh))    # (oh, h)
+    right = ctx.const("adaptR", adaptive_avg_matrix(w, ow).T)  # (w, ow)
+    rows = ctx.fresh("rows")
+    ctx.emit("MatMul", [left, ins[0]], [rows])          # (B, C, oh, w)
+    ctx.emit("MatMul", [rows, right], [out])            # (B, C, oh, ow)
+
+
 @register_converter("BilinearResize2D")
 def _bilinear_resize_conv(ctx, s, ins, out):
     _emit_linear_resize(ctx, s, ins, out, "align_corners")

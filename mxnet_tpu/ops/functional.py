@@ -883,6 +883,17 @@ def UpSampling(x, *, scale=2, sample_type="nearest"):
     return jax.image.resize(x, (n, c, h * scale, w * scale), method="bilinear")
 
 
+def adaptive_avg_matrix(n_in, n_out):
+    """Row-averaging matrix for adaptive pooling, window
+    [floor(i·n/o), ceil((i+1)·n/o)) — single source for the on-device op
+    AND its ONNX two-matmul export (onnx/export.py)."""
+    m = np.zeros((n_out, n_in), np.float32)
+    for i in range(n_out):
+        s, e = (i * n_in) // n_out, -((-(i + 1) * n_in) // n_out)
+        m[i, s:e] = 1.0 / (e - s)
+    return m
+
+
 @register_op("AdaptiveAvgPooling2D")
 def AdaptiveAvgPooling2D(x, *, output_size=None):
     """Adaptive average pool of (B, C, H, W) to (B, C, oh, ow) (ref:
@@ -899,16 +910,8 @@ def AdaptiveAvgPooling2D(x, *, output_size=None):
     else:
         oh = ow = int(output_size)
     h, w = x.shape[2], x.shape[3]
-
-    def avg_mat(n_in, n_out):
-        m = np.zeros((n_out, n_in), np.float32)
-        for i in range(n_out):
-            s, e = (i * n_in) // n_out, -((-(i + 1) * n_in) // n_out)
-            m[i, s:e] = 1.0 / (e - s)
-        return m
-
-    left = jnp.asarray(avg_mat(h, oh), x.dtype)
-    right = jnp.asarray(avg_mat(w, ow), x.dtype).T
+    left = jnp.asarray(adaptive_avg_matrix(h, oh), x.dtype)
+    right = jnp.asarray(adaptive_avg_matrix(w, ow), x.dtype).T
     return jnp.einsum("oh,bchw,wp->bcop", left, x, right)
 
 

@@ -105,3 +105,20 @@ def test_adaptive_avg_pooling_vs_torch():
     # omitted output_size keeps the input size (upstream empty-param branch)
     same = nd.contrib.AdaptiveAvgPooling2D(nd.array(x)).asnumpy()
     np.testing.assert_allclose(same, x)
+
+
+def test_segmentation_onnx_roundtrip():
+    """FCN and PSPNet export→import numerics (exercises BilinearResize2D and
+    the AdaptiveAvgPooling2D two-matmul ONNX form on real models)."""
+    from mxnet_tpu import onnx as mxonnx
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=(1, 3, 32, 32)).astype(np.float32)
+    for factory in (fcn_tiny_test, psp_tiny_test, deeplab_tiny_test):
+        net = factory(nclass=3, aux=False)
+        net.initialize()
+        ref = net(nd.array(x))[0].asnumpy()
+        mb = mxonnx.export_model(net, input_shapes={"data": x.shape})
+        blk = mxonnx.import_to_gluon(mb)
+        got = blk(nd.array(x))
+        got = (got[0] if isinstance(got, (list, tuple)) else got).asnumpy()
+        np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-4)

@@ -2,4 +2,4 @@ from ...ops import rnn as _fused  # noqa: F401  (registers the fused RNN op)
 from .rnn_layer import RNN, LSTM, GRU  # noqa: F401
 from .rnn_cell import (RecurrentCell, RNNCell, LSTMCell, GRUCell,  # noqa: F401
                        SequentialRNNCell, HybridSequentialRNNCell, BidirectionalCell, DropoutCell,
-                       ResidualCell, ZoneoutCell)
+                       ResidualCell, ZoneoutCell, ModifierCell)

@@ -229,7 +229,10 @@ class DropoutCell(RecurrentCell):
         return inputs, states
 
 
-class ResidualCell(RecurrentCell):
+class ModifierCell(RecurrentCell):
+    """Base for cells that wrap another cell (ref: rnn_cell.py:ModifierCell):
+    state shape, begin_state and reset delegate to the wrapped cell."""
+
     def __init__(self, base_cell, **kwargs):
         super().__init__(**kwargs)
         self.base_cell = base_cell
@@ -237,29 +240,49 @@ class ResidualCell(RecurrentCell):
     def state_info(self, batch_size=0):
         return self.base_cell.state_info(batch_size)
 
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        return self.base_cell.begin_state(batch_size, func=func, **kwargs)
+
+    def reset(self):
+        self.base_cell.reset()
+
+
+class ResidualCell(ModifierCell):
     def hybrid_forward(self, F, inputs, states):
         out, states = self.base_cell(inputs, states)
         return out + inputs, states
 
 
-class ZoneoutCell(RecurrentCell):
+class ZoneoutCell(ModifierCell):
+    """Zoneout (ref: rnn_cell.py:ZoneoutCell, Krueger et al. 2016): each
+    unit keeps its PREVIOUS value with probability p (a where-mask between
+    new and old), for states and/or outputs."""
+
     def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0, **kwargs):
-        super().__init__(**kwargs)
-        self.base_cell = base_cell
+        super().__init__(base_cell, **kwargs)
         self._zo = zoneout_outputs
         self._zs = zoneout_states
         self._prev_output = None
 
-    def state_info(self, batch_size=0):
-        return self.base_cell.state_info(batch_size)
+    def reset(self):
+        super().reset()
+        self._prev_output = None
 
     def hybrid_forward(self, F, inputs, states):
         out, new_states = self.base_cell(inputs, states)
+
+        def mask(p, like):
+            # Dropout(ones): 0 with prob p, else nonzero — a keep-new mask
+            return F.Dropout(F.ones_like(like), p=p)
+
         if self._zs > 0:
-            new_states = [s_old + F.Dropout(s_new - s_old, p=self._zs)
+            new_states = [F.where(mask(self._zs, s_new), s_new, s_old)
                           for s_old, s_new in zip(states, new_states)]
         if self._zo > 0:
-            out = F.Dropout(out, p=self._zo) if self._prev_output is None else out
+            prev = (self._prev_output if self._prev_output is not None
+                    else F.zeros_like(out))
+            out = F.where(mask(self._zo, out), out, prev)
+        self._prev_output = out
         return out, new_states
 
 

@@ -17,7 +17,7 @@ from .context import current_context
 from .ndarray import NDArray
 from .symbol import Symbol
 
-__all__ = ["Module", "BucketingModule"]
+__all__ = ["Module", "BucketingModule", "SequentialModule"]
 
 
 class Module:
@@ -239,3 +239,107 @@ class BucketingModule(Module):
     @_exec.setter
     def _exec(self, v):
         self.__dict__["_exec_base"] = v
+
+
+class SequentialModule:
+    """Chain of Modules where module i's outputs feed module i+1's data
+    (ref: python/mxnet/module/sequential_module.py). Intermediate modules
+    bind with ``inputs_need_grad=True`` so the backward pass hands each
+    stage's input grads to the stage before it as ``out_grads``."""
+
+    def __init__(self, logger=None):
+        self._modules = []
+        self._take_labels = []
+        self.binded = False
+        self.params_initialized = False
+
+    def add(self, module, take_labels=False):
+        if self.binded:
+            raise RuntimeError("add() after bind()")
+        self._modules.append(module)
+        self._take_labels.append(bool(take_labels))
+        return self
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, **kwargs):
+        if self.binded and not force_rebind:
+            return
+        assert self._modules, "add() at least one module before bind()"
+        cur = [(n, tuple(s)) for n, s in data_shapes]
+        for i, m in enumerate(self._modules):
+            lab = label_shapes if self._take_labels[i] else None
+            need = inputs_need_grad if i == 0 else for_training
+            m.bind(cur, lab, for_training=for_training,
+                   inputs_need_grad=need, force_rebind=force_rebind)
+            # next stage's data shapes = this stage's inferred output shapes
+            feed = dict(cur)
+            if lab:
+                feed.update({n: tuple(s) for n, s in lab})
+            _, out_shapes, _ = m._symbol.infer_shape(**feed)
+            if i + 1 < len(self._modules):
+                nxt = self._modules[i + 1]
+                if len(nxt._data_names) > len(out_shapes):
+                    raise ValueError(
+                        "module %d expects %d inputs but module %d emits %d "
+                        "outputs" % (i + 1, len(nxt._data_names), i,
+                                     len(out_shapes)))
+                cur = list(zip(nxt._data_names, out_shapes))
+        self._for_training = for_training
+        self._inputs_need_grad = inputs_need_grad
+        self.binded = True
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    **kwargs):
+        assert self.binded
+        for m in self._modules:
+            m.init_params(initializer=initializer, arg_params=arg_params,
+                          aux_params=aux_params, allow_missing=True,
+                          allow_extra=True, **{k: v for k, v in kwargs.items()
+                                               if k not in ("allow_missing",
+                                                            "allow_extra")})
+        self.params_initialized = True
+
+    def init_optimizer(self, **kwargs):
+        for m in self._modules:
+            m.init_optimizer(**kwargs)
+
+    def forward(self, data_batch, is_train=None):
+        from .io import DataBatch
+
+        batch = data_batch
+        for i, m in enumerate(self._modules):
+            label = (data_batch.label
+                     if self._take_labels[i] else [])
+            batch = DataBatch(data=list(batch.data if i == 0
+                                        else self._modules[i - 1]
+                                        .get_outputs()),
+                              label=label)
+            m.forward(batch, is_train=is_train)
+        return self._modules[-1].get_outputs()
+
+    def backward(self, out_grads=None):
+        grads = out_grads
+        for i in reversed(range(len(self._modules))):
+            m = self._modules[i]
+            m.backward(grads)
+            if i > 0:
+                grads = m.get_input_grads()
+
+    def update(self):
+        for m in self._modules:
+            m.update()
+
+    def get_outputs(self):
+        return self._modules[-1].get_outputs()
+
+    def get_input_grads(self):
+        assert self._inputs_need_grad
+        return self._modules[0].get_input_grads()
+
+    def get_params(self):
+        arg, aux = {}, {}
+        for m in self._modules:
+            a, x = m.get_params()
+            arg.update(a)
+            aux.update(x)
+        return arg, aux

@@ -11,11 +11,66 @@ from .symbol import Symbol, var, Variable, Group, cond, _make  # noqa: F401
 _mod = _sys.modules[__name__]
 
 
+_TENSOR_SLOTS = {}  # opname -> (names of positional tensor params, required count)
+_NEVER_AUTO = {"key", "training", "out"}  # injected/internal, never a param var
+
+
+def _tensor_slots(opname):
+    """Positional tensor-parameter names of the registry fn, in order, plus
+    how many are required — drives upstream-style auto-variable creation
+    (ref: python/mxnet/symbol/register.py: unfilled tensor inputs become
+    ``{name}_{param}`` variables, e.g. fc1_weight/fc1_bias)."""
+    cached = _TENSOR_SLOTS.get(opname)
+    if cached is not None:
+        return cached
+    import inspect
+
+    try:
+        sig = inspect.signature(_REG[opname].fn)
+        pos = [p for p in sig.parameters.values()
+               if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+               and p.name not in _NEVER_AUTO]
+        names = [p.name for p in pos]
+        n_req = len([p for p in pos
+                     if p.default is inspect.Parameter.empty])
+    except (TypeError, ValueError):
+        names, n_req = [], 0
+    _TENSOR_SLOTS[opname] = (names, n_req)
+    return names, n_req
+
+
 def _builder(opname):
     def f(*args, name=None, **kwargs):
         sym_kwargs = {k: v for k, v in kwargs.items() if isinstance(v, Symbol)}
         attrs = {k: v for k, v in kwargs.items() if not isinstance(v, Symbol)}
-        inputs = list(args) + list(sym_kwargs.values())
+        slots, n_req = _tensor_slots(opname)
+        if slots and not sym_kwargs.keys() - set(slots) \
+                and len(args) <= len(slots):
+            # slot-mapped form: tensor args land in their signature slots.
+            # Wanted slots = required ∪ explicitly filled ∪ bias (unless
+            # no_bias — upstream creates the bias var even when weight= is
+            # passed explicitly); any wanted-but-unfilled slot becomes an
+            # auto-named variable (fc1_weight, conv0_bias, bn_gamma, ...)
+            # exactly like upstream's register.py.
+            filled = dict(zip(slots, args))
+            filled.update(sym_kwargs)
+            wanted = set(slots[:n_req]) | set(filled)
+            if "bias" in slots[n_req:] and not attrs.get("no_bias", False) \
+                    and filled:
+                wanted.add("bias")
+            order = [s for s in slots if s in wanted]
+            # fn is called positionally: fill any hole before the last
+            # wanted slot too (upstream: every unfilled input is a var)
+            if order:
+                order = slots[:slots.index(order[-1]) + 1]
+            if any(s not in filled for s in order):
+                from . import name as _name_mod
+
+                name = _name_mod.current().get(name, opname.lower())
+            inputs = [filled[s] if s in filled
+                      else var("%s_%s" % (name, s)) for s in order]
+        else:
+            inputs = list(args) + list(sym_kwargs.values())
         out = _make(opname, *inputs, name=name, **attrs)
         # tuple-returning ops (OpDef.n_outputs > 1) are mirrored with _item
         # projections so hybrid_forward unpacking works under symbol tracing

@@ -156,3 +156,50 @@ def test_for_training_flag_default():
     batch = DataBatch([nd.array(np.ones((2, 3)))], None)
     m.forward(batch)  # is_train defaults to for_training=False
     assert m._exec._vjp is None
+
+
+def test_sequential_module_chains_and_trains():
+    """SequentialModule (ref: python/mxnet/module/sequential_module.py):
+    outputs feed the next stage, backward hands input grads upstream as
+    out_grads, update touches every stage's params."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.io import DataBatch
+    from mxnet_tpu.module import Module, SequentialModule
+
+    d = mx.sym.var("data")
+    s1 = mx.sym.FullyConnected(d, num_hidden=8, name="fc1")
+    s1 = mx.sym.Activation(s1, act_type="relu")
+    d2 = mx.sym.var("data")
+    s2 = mx.sym.FullyConnected(d2, num_hidden=3, name="fc2")
+    s2 = mx.sym.SoftmaxOutput(s2, name="softmax")
+
+    seq = SequentialModule()
+    seq.add(Module(s1, label_names=[]))
+    seq.add(Module(s2), take_labels=True)
+    seq.bind(data_shapes=[("data", (4, 6))],
+             label_shapes=[("softmax_label", (4,))])
+    seq.init_params()
+    seq.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 1.0})
+
+    rng = np.random.default_rng(0)
+    x = nd.array(rng.normal(size=(4, 6)).astype(np.float32))
+    y = nd.array(np.array([0, 1, 2, 0], np.float32))
+    batch = DataBatch(data=[x], label=[y])
+
+    def nll():
+        out = seq.forward(batch, is_train=True)[0].asnumpy()
+        return -np.log(out[np.arange(4), y.asnumpy().astype(int)] + 1e-9).mean()
+
+    first = nll()
+    for _ in range(60):
+        seq.forward(batch, is_train=True)
+        seq.backward()
+        seq.update()
+    last = nll()
+    assert last < first * 0.5, (first, last)
+    arg, _ = seq.get_params()
+    assert any(k.startswith("fc1") for k in arg)
+    assert any(k.startswith("fc2") for k in arg)

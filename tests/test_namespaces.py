@@ -195,3 +195,51 @@ def test_profiler_memory_summary():
     assert isinstance(s, dict)  # CPU backends may report nothing
     out = profiler.dump_memory()
     assert isinstance(out, dict)
+
+
+def test_sym_auto_param_variables():
+    """Unfilled required tensor inputs become auto-named variables (ref:
+    python/mxnet/symbol/register.py): fc1_weight/fc1_bias appear in
+    list_arguments and infer_shape sizes them."""
+    import mxnet_tpu as mx
+    d = mx.sym.var("data")
+    s = mx.sym.FullyConnected(d, num_hidden=8, name="fc1")
+    names = [getattr(a, "name", a) for a in s.list_arguments()]
+    assert names == ["data", "fc1_weight", "fc1_bias"]
+    args, outs, _ = s.infer_shape(data=(4, 6))
+    assert args == [(4, 6), (8, 6), (8,)] and outs == [(4, 8)]
+    # no_bias drops the bias var (upstream behavior)
+    s2 = mx.sym.FullyConnected(d, num_hidden=8, no_bias=True, name="fcn")
+    assert [getattr(a, "name", a) for a in s2.list_arguments()] \
+        == ["data", "fcn_weight"]
+    # Convolution too
+    s3 = mx.sym.Convolution(d, kernel=(3, 3), num_filter=4, name="conv0")
+    assert [getattr(a, "name", a) for a in s3.list_arguments()] \
+        == ["data", "conv0_weight", "conv0_bias"]
+    # explicit weight symbol wins; bias is STILL auto-created (upstream)
+    w = mx.sym.var("myw")
+    s4 = mx.sym.FullyConnected(d, weight=w, num_hidden=8, name="fcw")
+    assert [getattr(a, "name", a) for a in s4.list_arguments()] \
+        == ["data", "myw", "fcw_bias"]
+    # explicit bias fills ITS slot; weight is auto-created, not displaced
+    b = mx.sym.var("myb")
+    s5 = mx.sym.FullyConnected(d, bias=b, num_hidden=8, name="fcb")
+    argss, _, _ = s5.infer_shape(data=(4, 6))
+    names5 = [getattr(a, "name", a) for a in s5.list_arguments()]
+    assert names5 == ["data", "fcb_weight", "myb"]
+    assert argss[names5.index("myb")] == (8,)  # bias-shaped, not weight
+    # keyword-only data also triggers auto-creation
+    s6 = mx.sym.FullyConnected(x=d, num_hidden=8, name="fck")
+    assert [getattr(a, "name", a) for a in s6.list_arguments()] \
+        == ["data", "fck_weight", "fck_bias"]
+
+
+def test_modifier_cell_base():
+    from mxnet_tpu import gluon
+    assert issubclass(gluon.rnn.ResidualCell, gluon.rnn.ModifierCell)
+    assert issubclass(gluon.rnn.ZoneoutCell, gluon.rnn.ModifierCell)
+    base = gluon.rnn.LSTMCell(4, input_size=4)
+    wrapped = gluon.rnn.ResidualCell(base)
+    assert wrapped.state_info(2) == base.state_info(2)
+    assert [s.shape for s in wrapped.begin_state(2)] \
+        == [s.shape for s in base.begin_state(2)]

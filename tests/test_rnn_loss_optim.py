@@ -229,3 +229,33 @@ def test_poisson_nll_and_sdml_losses():
         l = sd(x1, x2f)
     l.backward()
     assert np.isfinite(x1.grad.asnumpy()).all()
+
+
+def test_zoneout_cell_keeps_previous_values():
+    """Zoneout semantics (ref: rnn_cell.py:ZoneoutCell): each zoned-out unit
+    keeps the OLD value exactly (where-mask), not a scaled blend; eval mode
+    is a pass-through."""
+    import numpy as np
+    from mxnet_tpu import autograd, gluon, nd
+
+    base = gluon.rnn.RNNCell(16, input_size=16)
+    base.initialize()
+    cell = gluon.rnn.ZoneoutCell(base, zoneout_states=0.5)
+    x = nd.array(np.random.default_rng(0).normal(size=(4, 16))
+                 .astype(np.float32))
+    s0 = [nd.array(np.random.default_rng(1).normal(size=(4, 16))
+                   .astype(np.float32))]
+    ref_out, ref_states = base(x, s0)
+    with autograd.record():  # train mode: zoneout active
+        cell.reset()
+        out, states = cell(x, s0)
+    new, old = states[0].asnumpy(), s0[0].asnumpy()
+    full = ref_states[0].asnumpy()
+    kept_old = np.isclose(new, old, atol=1e-6)
+    kept_new = np.isclose(new, full, atol=1e-6)
+    assert (kept_old | kept_new).all()      # every unit is one or the other
+    assert kept_old.any() and kept_new.any()  # and both actually occur
+    # eval: identical to the base cell
+    cell.reset()
+    out_e, states_e = cell(x, s0)
+    np.testing.assert_allclose(states_e[0].asnumpy(), full, rtol=1e-6)

@@ -205,8 +205,13 @@ class BatchNorm(_NormBase):
             p.shape = (c,)
 
     def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
-        out, m, v = F.BatchNorm(x, gamma, beta, running_mean, running_var, **self._kwargs)
-        self._store_stats(self.running_mean, self.running_var, m, v)
+        out = F.BatchNorm(x, gamma, beta, running_mean, running_var,
+                          **self._kwargs)
+        if isinstance(out, tuple):
+            out, m, v = out
+            self._store_stats(self.running_mean, self.running_var, m, v)
+        # else: F=sym exposes only the visible output (upstream
+        # NumVisibleOutputs=1); symbolic capture never updates stats anyway
         return out
 
 

@@ -11,7 +11,7 @@ from ..block import HybridBlock
 
 __all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
            "SequentialRNNCell", "BidirectionalCell", "DropoutCell",
-           "ResidualCell", "ZoneoutCell"]
+           "ResidualCell", "ZoneoutCell", "ModifierCell"]
 
 
 class RecurrentCell(HybridBlock):
@@ -282,7 +282,8 @@ class ZoneoutCell(ModifierCell):
             prev = (self._prev_output if self._prev_output is not None
                     else F.zeros_like(out))
             out = F.where(mask(self._zo, out), out, prev)
-        self._prev_output = out
+            self._prev_output = out  # only read on the _zo path; storing
+            # unconditionally would pin a dead array/tracer per step
         return out, new_states
 
 

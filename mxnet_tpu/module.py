@@ -275,8 +275,8 @@ class SequentialModule:
             feed = dict(cur)
             if lab:
                 feed.update({n: tuple(s) for n, s in lab})
-            _, out_shapes, _ = m._symbol.infer_shape(**feed)
             if i + 1 < len(self._modules):
+                _, out_shapes, _ = m._symbol.infer_shape(**feed)
                 nxt = self._modules[i + 1]
                 if len(nxt._data_names) > len(out_shapes):
                     raise ValueError(

@@ -10,11 +10,12 @@ from __future__ import annotations
 from .gluon import rnn as _grnn
 from .gluon.rnn.rnn_cell import (  # noqa: F401
     BidirectionalCell, DropoutCell, GRUCell, LSTMCell, RecurrentCell,
-    ResidualCell, RNNCell, SequentialRNNCell, ZoneoutCell,
+    ModifierCell, ResidualCell, RNNCell, SequentialRNNCell, ZoneoutCell,
 )
 
 __all__ = ["RNNCell", "LSTMCell", "GRUCell", "SequentialRNNCell",
            "BidirectionalCell", "DropoutCell", "ResidualCell", "ZoneoutCell",
+           "ModifierCell",
            "FusedRNNCell", "BucketSentenceIter"]
 
 

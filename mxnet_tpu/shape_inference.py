@@ -176,7 +176,10 @@ def infer_shapes_partial(sym, known, int_vars=()):
                 p = get(node._inputs[0])
                 if isinstance(p, list):
                     return p[node._attrs["index"]]
-                return None
+                # single-output parent: index 0 aliases it (same rule as
+                # symbol eval's _item; arises from e.g. BatchNorm(...)[0]
+                # where the facade already projected the visible output)
+                return p if node._attrs["index"] == 0 else None
             ins = [get(i) for i in node._inputs]
             if any(s is None for s in ins):
                 rule = PARAM_SHAPE_RULES.get(node._op)

@@ -11,6 +11,8 @@ from .symbol import Symbol, var, Variable, Group, cond, _make  # noqa: F401
 _mod = _sys.modules[__name__]
 
 
+_VISIBLE_SINGLE = {"BatchNorm"}  # multi-output ops upstream exposes as one
+
 _TENSOR_SLOTS = {}  # opname -> (names of positional tensor params, required count)
 _NEVER_AUTO = {"key", "training", "out"}  # injected/internal, never a param var
 
@@ -44,6 +46,11 @@ def _builder(opname):
         sym_kwargs = {k: v for k, v in kwargs.items() if isinstance(v, Symbol)}
         attrs = {k: v for k, v in kwargs.items() if not isinstance(v, Symbol)}
         slots, n_req = _tensor_slots(opname)
+        if slots and "data" in sym_kwargs and "data" not in slots \
+                and not args and slots[0] not in sym_kwargs:
+            # upstream's generated API calls the first input `data=`; the
+            # registry fns mostly name it `x` — alias it to slot 0
+            sym_kwargs[slots[0]] = sym_kwargs.pop("data")
         if slots and not sym_kwargs.keys() - set(slots) \
                 and len(args) <= len(slots):
             # slot-mapped form: tensor args land in their signature slots.
@@ -54,9 +61,11 @@ def _builder(opname):
             # exactly like upstream's register.py.
             filled = dict(zip(slots, args))
             filled.update(sym_kwargs)
-            wanted = set(slots[:n_req]) | set(filled)
+            # a slot provided as a scalar keyword rides in attrs (splatted
+            # into the fn as a keyword) — it is provided, not missing
+            wanted = (set(slots[:n_req]) - set(attrs)) | set(filled)
             if "bias" in slots[n_req:] and not attrs.get("no_bias", False) \
-                    and filled:
+                    and filled and "bias" not in attrs:
                 wanted.add("bias")
             order = [s for s in slots if s in wanted]
             # fn is called positionally: fill any hole before the last
@@ -67,14 +76,28 @@ def _builder(opname):
                 from . import name as _name_mod
 
                 name = _name_mod.current().get(name, opname.lower())
-            inputs = [filled[s] if s in filled
-                      else var("%s_%s" % (name, s)) for s in order]
+            inputs = []
+            for s in order:
+                if s in filled:
+                    inputs.append(filled[s])
+                elif s in attrs:
+                    raise ValueError(
+                        "%s: %r is given as a keyword scalar but a later "
+                        "input is positional/Symbol — pass %r positionally "
+                        "or as a Symbol" % (opname, s, s))
+                else:
+                    inputs.append(var("%s_%s" % (name, s)))
         else:
             inputs = list(args) + list(sym_kwargs.values())
         out = _make(opname, *inputs, name=name, **attrs)
         # tuple-returning ops (OpDef.n_outputs > 1) are mirrored with _item
         # projections so hybrid_forward unpacking works under symbol tracing
         arity = _REG[opname].n_outputs if opname in _REG else 1
+        if opname in _VISIBLE_SINGLE:
+            # upstream hides auxiliary outputs (BatchNorm's batch mean/var
+            # are NumVisibleOutputs=1 in src/operator/nn/batch_norm.cc):
+            # composing `sym.BatchNorm(x)` into the next op must work
+            return out[0] if arity > 1 else out
         if arity > 1:
             return tuple(out[i] for i in range(arity))
         return out

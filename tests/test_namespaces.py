@@ -243,3 +243,27 @@ def test_modifier_cell_base():
     assert wrapped.state_info(2) == base.state_info(2)
     assert [s.shape for s in wrapped.begin_state(2)] \
         == [s.shape for s in base.begin_state(2)]
+
+
+def test_sym_batchnorm_composes_single_output():
+    """Upstream BatchNorm is NumVisibleOutputs=1: sym.BatchNorm(x) must feed
+    the next op directly (ref: src/operator/nn/batch_norm.cc); the batch
+    mean/var outputs stay hidden. Auto-created gamma/beta/moving vars."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    x = mx.sym.var("data")
+    net = mx.sym.Activation(mx.sym.BatchNorm(x, name="bn0"),
+                            act_type="relu")
+    names = [getattr(a, "name", a) for a in net.list_arguments()]
+    assert names[0] == "data" and any("bn0" in n for n in names[1:])
+    args, outs, _ = net.infer_shape(data=(2, 3, 4, 4))
+    assert outs == [(2, 3, 4, 4)]
+    # eval end-to-end through an executor
+    ex = net.simple_bind(grad_req="null", data=(2, 3, 4, 4))
+    out = ex.forward(is_train=False,
+                     data=nd.array(np.random.default_rng(0)
+                                   .normal(size=(2, 3, 4, 4))
+                                   .astype(np.float32)))
+    assert out[0].shape == (2, 3, 4, 4)

@@ -31,6 +31,8 @@ class Module:
         self._arg_params = {}
         self._optimizer = None
         self._opt_states = {}
+        self._n_main_outputs = 1
+        self._aux_update_names = []
         self.binded = False
         self.params_initialized = False
 
@@ -106,9 +108,49 @@ class Module:
                     a = feed[n]
                     d = a._data if isinstance(a, NDArray) else jnp.asarray(a)
                     grads[n] = NDArray(jnp.zeros_like(d))
-            self._exec = self._symbol.bind(self._ctx, args, grads)
+            self._exec = self._bn_aux_symbol().bind(self._ctx, args, grads)
         self._exec.forward(is_train=bool(is_train), **feed)
-        return self._exec.outputs
+        outs = self._exec.outputs
+        n_main = self._n_main_outputs
+        if is_train and len(outs) > n_main:
+            # BatchNorm aux write-back (upstream: executor aux_states are
+            # copied back after each training forward): the hidden
+            # new-moving-mean/var outputs land in the bound moving vars
+            # IN PLACE, so the next forward (and eval mode) sees them
+            for name, new in zip(self._aux_update_names, outs[n_main:]):
+                self._arg_params[name]._data = new._data
+        return outs[:n_main]
+
+    def _bn_aux_symbol(self):
+        """Wrap the bound symbol so each BatchNorm's hidden updated-stat
+        outputs are fetched alongside the main outputs (ref:
+        src/executor/graph_executor.cc aux-state write-back)."""
+        from .symbol import Group, Symbol, _attr_symbols
+
+        self._aux_update_names = []
+        self._n_main_outputs = self._symbol._n_outputs \
+            if self._symbol._op == "_group" else 1
+        items, seen, stack = [], set(), [self._symbol]
+        while stack:
+            s = stack.pop()
+            if id(s) in seen or not isinstance(s, Symbol):
+                continue
+            seen.add(id(s))
+            if (s._op == "BatchNorm" and len(s._inputs) >= 5
+                    and s._inputs[3].is_var() and s._inputs[4].is_var()):
+                items.append(Symbol("_item", [s], {"index": 1},
+                                    name=s.name + "_mm_upd"))
+                items.append(Symbol("_item", [s], {"index": 2},
+                                    name=s.name + "_mv_upd"))
+                self._aux_update_names += [s._inputs[3].name,
+                                           s._inputs[4].name]
+            stack.extend(s._inputs)
+            stack.extend(_attr_symbols(s._attrs))
+        if not items:
+            return self._symbol
+        mains = ([self._symbol[i] for i in range(self._n_main_outputs)]
+                 if self._symbol._op == "_group" else [self._symbol])
+        return Group(mains + items)
 
     def backward(self, out_grads=None):
         if out_grads is None and self._symbol._op == "SoftmaxOutput":
@@ -119,12 +161,24 @@ class Module:
             onehot = jnp.zeros_like(prob).at[
                 jnp.arange(prob.shape[0]), label.astype(jnp.int32)].set(1.0)
             grad = (prob - onehot) / prob.shape[0]
-            self._exec.backward([NDArray(grad)])
-        else:
-            self._exec.backward(out_grads)
+            out_grads = [NDArray(grad)]
+        elif out_grads is None:
+            out_grads = [NDArray(jnp.ones(o.shape, o.dtype))
+                         for o in self._exec.outputs[:self._n_main_outputs]]
+        elif isinstance(out_grads, NDArray):
+            out_grads = [out_grads]
+        out_grads = list(out_grads)
+        if len(out_grads) < self._n_main_outputs:
+            raise ValueError("backward needs %d output gradients, got %d"
+                             % (self._n_main_outputs, len(out_grads)))
+        # aux stat fetches are NOT differentiated through (upstream treats
+        # aux states as non-gradient): zero cotangents for the tail ONLY
+        out_grads += [NDArray(jnp.zeros(o.shape, o.dtype))
+                      for o in self._exec.outputs[len(out_grads):]]
+        self._exec.backward(out_grads)
 
     def get_outputs(self):
-        return self._exec.outputs
+        return self._exec.outputs[:self._n_main_outputs]
 
     def get_input_grads(self):
         """(ref: module/base_module.py:get_input_grads) — requires

@@ -965,9 +965,53 @@ def loads(json_str):
     return built[blob.get("head", len(built) - 1)]
 
 
+def _with_training(sym, training):
+    """Clone the DAG with ``training=training`` on every training-gated op
+    that does not pin the attr explicitly (explicit pins win, like
+    upstream's mode='always' dropout). This is how ``forward(is_train=...)``
+    actually governs Dropout/BatchNorm behavior — the reference threads
+    is_train through its executors at run time (src/executor), while here
+    each mode is its own jitted program (XLA needs the flag static)."""
+    import copy
+
+    memo = {}
+
+    def clone(s):
+        if not isinstance(s, Symbol):
+            return s
+        if s._op in (None, "_const"):
+            return s  # variables/consts: identity matters for arg mapping
+        c = memo.get(id(s))
+        if c is not None:
+            return c
+        c = copy.copy(s)
+        memo[id(s)] = c
+        c._inputs = [clone(i) for i in s._inputs]
+        attrs = {}
+        for k, v in s._attrs.items():
+            if isinstance(v, Symbol):
+                attrs[k] = clone(v)
+            elif isinstance(v, (list, tuple)) and any(
+                    isinstance(x, Symbol) for x in v):
+                attrs[k] = type(v)(clone(x) for x in v)
+            else:
+                attrs[k] = v
+        opdef = OP_REGISTRY.get(s._op)
+        if (opdef is not None and opdef.needs_training
+                and "training" not in s._attrs):
+            attrs["training"] = bool(training)
+        c._attrs = attrs
+        return c
+
+    return clone(sym)
+
+
 class Executor:
     """(ref: src/executor/graph_executor.cc → one jitted XLA callable +
-    its jitted VJP)."""
+    its jitted VJP). ``forward(is_train=...)`` selects between two jitted
+    programs: the train variant runs Dropout/BatchNorm in training mode
+    (fresh PRNG key threaded per call when that makes the graph
+    stochastic), the eval variant is the deterministic inference program."""
 
     def __init__(self, sym, ctx, args, args_grad, grad_req):
         self._sym = sym
@@ -975,32 +1019,49 @@ class Executor:
         self.arg_dict = args
         self.grad_dict = args_grad or {}
         self._grad_req = grad_req
-        # Sampling nodes must not bake trace-time keys into one cached
-        # program (that would replay identical noise every forward). Any
-        # stochastic graph — including sampling inside cond branches, which
-        # _eval evaluates with the shared cache and keyctx — threads the key
-        # as a jit ARGUMENT: one cached program, fresh noise per call.
-        self._stochastic = _graph_has_rng(sym)
-        self._keyed = self._stochastic
-        fn, names = sym._build_fn(thread_key=self._keyed)
-        self._names = names
-        self._fn = jax.jit(fn)
+        self._modes = {}  # is_train -> (jitted fn, keyed)
+        # arg order is mode-independent (same variables); build it once
+        self._names = [a.name for a in sym._arg_symbols()]
         self._vjp = None
+        self._vjp_keyed = False
         self.outputs = []
+        # eval variant built at bind (upstream binds eagerly); its
+        # stochasticity is the bind-time contract tests/users observe
+        _, keyed = self._get_fn(False)
+        self._stochastic = self._keyed = keyed
+
+    def _get_fn(self, is_train):
+        ent = self._modes.get(bool(is_train))
+        if ent is None:
+            s = _with_training(self._sym, is_train)
+            # Sampling nodes must not bake trace-time keys into one cached
+            # program (that would replay identical noise every forward):
+            # stochastic graphs thread the key as a jit ARGUMENT.
+            keyed = _graph_has_rng(s)
+            fn, names = s._build_fn(thread_key=keyed)
+            assert names == self._names
+            ent = (jax.jit(fn), keyed)
+            self._modes[bool(is_train)] = ent
+        return ent
 
     def forward(self, is_train=False, **kwargs):
         for k, v in kwargs.items():
             self.arg_dict[k] = v if isinstance(v, NDArray) else NDArray(jnp.asarray(v))
+        fn, keyed = self._get_fn(is_train)
+        self._keyed = keyed
         vals = [self.arg_dict[n]._data for n in self._names]
-        if self._keyed:
+        if keyed:
             from . import random as _rng
 
             key = _rng.next_key()
             vals = [key] + vals
         if is_train:
-            out, self._vjp = jax.vjp(lambda *v: self._fn(*v), *vals)
+            out, self._vjp = jax.vjp(lambda *v: fn(*v), *vals)
+            # backward must strip the key cotangent iff THIS vjp's program
+            # was keyed — a later eval forward must not flip that decision
+            self._vjp_keyed = keyed
         else:
-            out = self._fn(*vals)
+            out = fn(*vals)
         outs = out if isinstance(out, (list, tuple)) else [out]
         self.outputs = [NDArray(o) for o in outs]
         return self.outputs
@@ -1015,7 +1076,7 @@ class Executor:
             cots = [g._data for g in out_grads]
         # cotangent must match the primal output structure (list for groups)
         grads = self._vjp(list(cots) if self._sym._op == "_group" else cots[0])
-        if self._keyed:
+        if self._vjp_keyed:
             grads = grads[1:]   # leading entry is the PRNG key's float0
         for n, g in zip(self._names, grads):
             if n in self.grad_dict and self.grad_dict[n] is not None:

@@ -203,3 +203,73 @@ def test_sequential_module_chains_and_trains():
     arg, _ = seq.get_params()
     assert any(k.startswith("fc1") for k in arg)
     assert any(k.startswith("fc2") for k in arg)
+
+
+def test_executor_is_train_governs_dropout_and_bn():
+    """forward(is_train) selects op behavior at run time like upstream's
+    executors (src/executor): dropout actually drops in training and is the
+    identity in inference; BatchNorm moving stats update during Module
+    training and drive eval-mode outputs."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.io import DataBatch
+    from mxnet_tpu.module import Module
+
+    # --- executor-level dropout
+    x = mx.sym.var("x", shape=(4, 50))
+    ex = mx.sym.Dropout(x, p=0.5).bind(
+        args={"x": nd.array(np.ones((4, 50), np.float32))})
+    infer = ex.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_array_equal(infer, np.ones((4, 50), np.float32))
+    train1 = ex.forward(is_train=True)[0].asnumpy()
+    train2 = ex.forward(is_train=True)[0].asnumpy()
+    assert (train1 == 0).any() and (train2 == 0).any()
+    assert not np.array_equal(train1, train2)  # fresh mask per call
+    assert set(np.unique(train1)) <= {0.0, 2.0}  # inverted scaling
+
+    # --- Module-level BN stat write-back
+    data = mx.sym.var("data")
+    net = mx.sym.BatchNorm(data, name="bn0", momentum=0.5)
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = Module(net)
+    mod.bind(data_shapes=[("data", (8, 4))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    rng = np.random.default_rng(0)
+    X = (rng.normal(size=(8, 4)) * 3.0 + 1.5).astype(np.float32)
+    Y = np.zeros(8, np.float32)
+    batch = DataBatch(data=[nd.array(X)], label=[nd.array(Y)])
+    mm0 = mod._arg_params["bn0_moving_mean"].asnumpy().copy()
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mm1 = mod._arg_params["bn0_moving_mean"].asnumpy()
+    # momentum blend toward the batch mean
+    want = 0.5 * mm0 + 0.5 * X.mean(0)
+    np.testing.assert_allclose(mm1, want, rtol=1e-4, atol=1e-5)
+    # eval-mode output uses the UPDATED stats (differs from before training)
+    out_a = mod.forward(batch, is_train=False)[0].asnumpy()
+    mod._arg_params["bn0_moving_mean"]._data = nd.array(mm0)._data
+    out_b = mod.forward(batch, is_train=False)[0].asnumpy()
+    assert not np.allclose(out_a, out_b)
+
+
+def test_executor_backward_after_eval_forward_keeps_key_alignment():
+    """Regression: an eval forward between a train forward and backward()
+    must not desync the key-cotangent stripping (the vjp remembers whether
+    ITS program was keyed)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    x = mx.sym.var("x", shape=(4, 8))
+    y = mx.sym.Dropout(x, p=0.5) * 2.0
+    ex = y.bind(args={"x": nd.array(np.ones((4, 8), np.float32))},
+                args_grad={"x": nd.array(np.zeros((4, 8), np.float32))})
+    ex.forward(is_train=True)
+    ex.forward(is_train=False)  # validation pass in between
+    ex.backward()
+    g = ex.grad_dict["x"].asnumpy()
+    assert g.dtype == np.float32
+    assert set(np.unique(g)) <= {0.0, 4.0}  # kept units: 2 / (1-p) = 4

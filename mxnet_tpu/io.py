@@ -1,6 +1,8 @@
 """Data iterators (ref: python/mxnet/io/io.py, src/io/iter_image_recordio_2.cc)."""
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 from .ndarray import NDArray, array
@@ -165,15 +167,50 @@ def _init_data(data, default_name):
     return out
 
 
+def _load_csv_f32(path):
+    """Parse a CSV into float32 via the native threaded reader
+    (src/engine_cc/csv_reader.cc), falling back to np.loadtxt when the .so
+    is missing/stale or the file is ragged. Single-column files squeeze to
+    1-D for loadtxt parity."""
+    import ctypes
+
+    from .engine import native_lib_path
+
+    so = native_lib_path()
+    if os.path.exists(so):
+        try:
+            lib = ctypes.CDLL(so)
+            lib.mxtpu_csv_open.restype = ctypes.c_void_p
+            lib.mxtpu_csv_open.argtypes = [ctypes.c_char_p,
+                                           ctypes.POINTER(ctypes.c_long),
+                                           ctypes.POINTER(ctypes.c_long)]
+            lib.mxtpu_csv_read.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+            lib.mxtpu_csv_close.argtypes = [ctypes.c_void_p]
+            rows, cols = ctypes.c_long(), ctypes.c_long()
+            h = lib.mxtpu_csv_open(str(path).encode(), ctypes.byref(rows),
+                                   ctypes.byref(cols))
+            if h:
+                out = np.empty((rows.value, cols.value), np.float32)
+                lib.mxtpu_csv_read(h, out.ctypes.data_as(ctypes.c_void_p))
+                lib.mxtpu_csv_close(h)
+                # full loadtxt shape parity: (N,1)->(N,), (1,M)->(M,),
+                # (1,1)->()
+                return out.squeeze() if 1 in out.shape else out
+        except (OSError, AttributeError):
+            pass
+    return np.loadtxt(path, delimiter=",", dtype=np.float32)
+
+
 class CSVIter(DataIter):
-    """(ref: src/io/iter_csv.cc)"""
+    """(ref: src/io/iter_csv.cc; hot path is the native C++ threaded parser
+    in src/engine_cc/csv_reader.cc)"""
 
     def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
                  batch_size=1, round_batch=True, **kwargs):
         super().__init__(batch_size)
-        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32)
+        data = _load_csv_f32(data_csv)
         data = data.reshape((-1,) + tuple(data_shape))
-        label = (np.loadtxt(label_csv, delimiter=",", dtype=np.float32)
+        label = (_load_csv_f32(label_csv)
                  if label_csv else np.zeros(len(data), np.float32))
         # round_batch=False yields the short final batch as-is ('keep'),
         # matching upstream CSVIter — NOT 'discard', which drops those rows

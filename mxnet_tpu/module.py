@@ -128,7 +128,9 @@ class Module:
         from .symbol import Group, Symbol, _attr_symbols
 
         self._aux_update_names = []
-        self._n_main_outputs = self._symbol._n_outputs \
+        # a _group's head count is its input list (Symbol._n_outputs stays
+        # at the constructor default for groups)
+        self._n_main_outputs = len(self._symbol._inputs) \
             if self._symbol._op == "_group" else 1
         items, seen, stack = [], set(), [self._symbol]
         while stack:
@@ -194,7 +196,13 @@ class Module:
                            else opt_mod.create(optimizer, **optimizer_params))
 
     def update(self):
+        aux = set(self._aux_update_names)
         for i, (n, p) in enumerate(sorted(self._arg_params.items())):
+            # aux states (BN moving stats) are written back by forward, not
+            # optimized — an optimizer step (esp. weight decay) would erode
+            # the statistics (upstream excludes aux from updates)
+            if n in aux:
+                continue
             g = self._exec.grad_dict.get(n)
             if g is None:
                 continue

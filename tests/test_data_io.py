@@ -557,3 +557,54 @@ def test_dataset_shard_and_sample():
 
     sub = ds.sample(gluon.data.SequentialSampler(4))
     assert len(sub) == 4 and float(sub[3]) == 3.0
+
+
+def test_native_csv_parser_parity(tmp_path):
+    """csv_reader.cc vs np.loadtxt on tricky floats, blank lines, and the
+    1-column squeeze; ragged files fall back to loadtxt's error."""
+    import pytest
+    from mxnet_tpu.io import _load_csv_f32
+
+    rng = np.random.default_rng(0)
+    a = rng.normal(size=(500, 7)).astype(np.float32)
+    a[0, 0] = 1.5e-30
+    a[1, 1] = -2.25e18
+    a[2, 2] = 0.0
+    p = tmp_path / "x.csv"
+    np.savetxt(p, a, delimiter=",", fmt="%.8g")
+    # blank lines are skipped like loadtxt
+    txt = p.read_text()
+    p.write_text(txt.replace("\n", "\n\n", 3))
+    got = _load_csv_f32(str(p))
+    ref = np.loadtxt(str(p), delimiter=",", dtype=np.float32)
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+    p1 = tmp_path / "one.csv"
+    np.savetxt(p1, a[:20, 0], delimiter=",", fmt="%.8g")
+    got1 = _load_csv_f32(str(p1))
+    assert got1.shape == (20,)
+    np.testing.assert_allclose(got1, np.loadtxt(str(p1), delimiter=",",
+                                                dtype=np.float32), rtol=1e-6)
+
+    # ragged file: native declines -> loadtxt raises a meaningful error
+    p2 = tmp_path / "bad.csv"
+    p2.write_text("1,2,3\n4,5\n")
+    with pytest.raises(ValueError):
+        _load_csv_f32(str(p2))
+
+
+def test_csviter_native_path(tmp_path):
+    from mxnet_tpu.io import CSVIter
+
+    rng = np.random.default_rng(1)
+    data = rng.normal(size=(10, 6)).astype(np.float32)
+    label = rng.integers(0, 3, 10).astype(np.float32)
+    dp, lp = tmp_path / "d.csv", tmp_path / "l.csv"
+    np.savetxt(dp, data, delimiter=",", fmt="%.8g")
+    np.savetxt(lp, label, delimiter=",", fmt="%.8g")
+    it = CSVIter(str(dp), (2, 3), label_csv=str(lp), batch_size=4)
+    b = it.next()
+    assert b.data[0].shape == (4, 2, 3)
+    np.testing.assert_allclose(b.data[0].asnumpy(),
+                               data[:4].reshape(4, 2, 3), rtol=1e-6)
+    np.testing.assert_allclose(b.label[0].asnumpy(), label[:4], rtol=1e-6)

@@ -273,3 +273,30 @@ def test_executor_backward_after_eval_forward_keeps_key_alignment():
     g = ex.grad_dict["x"].asnumpy()
     assert g.dtype == np.float32
     assert set(np.unique(g)) <= {0.0, 4.0}  # kept units: 2 / (1-p) = 4
+
+
+def test_module_group_outputs_preserved_with_bn():
+    """A Group-headed Module returns ALL heads, and the BN aux write-back
+    tail never bleeds into main outputs (regression: group head count)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.io import DataBatch
+    from mxnet_tpu.module import Module
+
+    d = mx.sym.var("data")
+    h = mx.sym.BatchNorm(d, name="bn0")
+    g = mx.sym.Group([mx.sym.relu(h), mx.sym.tanh(h)])
+    mod = Module(g, label_names=[])
+    mod.bind(data_shapes=[("data", (4, 3))])
+    mod.init_params()
+    batch = DataBatch(data=[nd.array(np.random.default_rng(0)
+                                     .normal(size=(4, 3))
+                                     .astype(np.float32))], label=[])
+    outs = mod.forward(batch, is_train=True)
+    assert len(outs) == 2
+    assert outs[0].shape == (4, 3) and outs[1].shape == (4, 3)
+    # moving stats hold stat-shaped values, not head tensors
+    assert mod._arg_params["bn0_moving_mean"].shape == (3,)
+    mod.backward([nd.array(np.ones((4, 3), np.float32)),
+                  nd.array(np.ones((4, 3), np.float32))])

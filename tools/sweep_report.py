@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Summarize the hardware sweep artifacts into tuning recommendations.
+
+Reads tools/flash_sweep_r3.json (flash-attention block sizes) and
+tools/batch_sweep_r3.jsonl (bench --batch/--remat configs) once the
+tpu_bench_loop has produced them, and prints:
+  - best (block_q, block_k) per sequence length vs the current defaults
+  - samples/s and MFU per bench config vs the persisted default-config runs
+Run: python tools/sweep_report.py  (host-only; no TPU access needed)
+"""
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def flash_report(path):
+    try:
+        data = json.load(open(path))
+    except OSError:
+        print("no flash sweep at %s yet" % path)
+        return
+    rows = data["rows"]
+    print("== flash sweep (%s, measured %s) ==" %
+          (data["config"].get("platform"), data["config"].get("measured_at")))
+    for seq in sorted({r["seq"] for r in rows}):
+        dense = [r for r in rows if r["seq"] == seq and r["kernel"] == "dense"]
+        flash = [r for r in rows if r["seq"] == seq and r["kernel"] == "flash"]
+        if not flash:
+            continue
+        best_f = min(flash, key=lambda r: r["fwd_ms"])
+        best_b = min(flash, key=lambda r: r["fwd_bwd_ms"])
+        line = ("seq %5d: best fwd bq=%d bk=%d (%.3f ms); "
+                "best fwd+bwd bq=%d bk=%d (%.3f ms)"
+                % (seq, best_f["block_q"], best_f["block_k"],
+                   best_f["fwd_ms"], best_b["block_q"], best_b["block_k"],
+                   best_b["fwd_bwd_ms"]))
+        if dense:
+            line += "; dense %.3f/%.3f ms" % (dense[0]["fwd_ms"],
+                                              dense[0]["fwd_bwd_ms"])
+        print(line)
+    print("current defaults: ops/pallas/flash_attention.py "
+          "block_q=256 block_k=512")
+
+
+def batch_report(path):
+    try:
+        lines = [l for l in open(path) if l.strip()]
+    except OSError:
+        print("no batch sweep at %s yet" % path)
+        return
+    print("== batch/remat sweep ==")
+    tag = None
+    for l in lines:
+        rec = json.loads(l)
+        if set(rec) == {"args"}:
+            tag = rec["args"]
+            continue
+        if "value" in rec:
+            print("%-28s %10.2f %s  mfu=%s  hbm_peak=%sGB%s"
+                  % (tag or rec.get("metric", "?"), rec["value"], rec["unit"],
+                     rec.get("mfu", "-"), rec.get("hbm_process_peak_gb", "-"),
+                     "  [REPLAYED]" if rec.get("replayed") else ""))
+            tag = None
+
+
+def main():
+    flash_report(os.path.join(HERE, "flash_sweep_r3.json"))
+    print()
+    batch_report(os.path.join(HERE, "batch_sweep_r3.jsonl"))
+    print()
+    try:
+        results = json.load(open(os.path.join(HERE, "..",
+                                              "BENCH_RESULTS.json")))
+        print("== persisted default-config results ==")
+        for mode, r in sorted(results.items()):
+            print("%-10s %10.2f %s  vs_baseline=%.2f  mfu=%s  (%s)"
+                  % (mode, r["value"], r["unit"], r["vs_baseline"],
+                     r.get("mfu", "-"), r["measured_at"]))
+    except OSError:
+        pass
+
+
+if __name__ == "__main__":
+    main()

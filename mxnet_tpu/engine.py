@@ -40,6 +40,17 @@ def native_lib_path():
     d, so = _lib_location()
     missing = (not os.path.exists(so)
                or not os.path.exists(os.path.join(d, "libmxtpu_im.so")))
+    if not missing:
+        # stale .so = ABI drift against the Python bindings; let make's own
+        # dependency rules decide (a no-op make is ~10ms)
+        try:
+            import glob
+            so_m = min(os.path.getmtime(so),
+                       os.path.getmtime(os.path.join(d, "libmxtpu_im.so")))
+            missing = any(os.path.getmtime(src) > so_m
+                          for src in glob.glob(os.path.join(d, "*.cc")))
+        except OSError:
+            missing = True
     if missing and not _make_attempted and os.path.exists(
             os.path.join(d, "Makefile")):
         _make_attempted = True

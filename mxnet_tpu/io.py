@@ -184,6 +184,7 @@ def _load_csv_f32(path):
             lib.mxtpu_csv_open.argtypes = [ctypes.c_char_p,
                                            ctypes.POINTER(ctypes.c_long),
                                            ctypes.POINTER(ctypes.c_long)]
+            lib.mxtpu_csv_read.restype = ctypes.c_int
             lib.mxtpu_csv_read.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
             lib.mxtpu_csv_close.argtypes = [ctypes.c_void_p]
             rows, cols = ctypes.c_long(), ctypes.c_long()
@@ -191,11 +192,12 @@ def _load_csv_f32(path):
                                    ctypes.byref(cols))
             if h:
                 out = np.empty((rows.value, cols.value), np.float32)
-                lib.mxtpu_csv_read(h, out.ctypes.data_as(ctypes.c_void_p))
+                ok = lib.mxtpu_csv_read(h, out.ctypes.data_as(ctypes.c_void_p))
                 lib.mxtpu_csv_close(h)
-                # full loadtxt shape parity: (N,1)->(N,), (1,M)->(M,),
-                # (1,1)->()
-                return out.squeeze() if 1 in out.shape else out
+                if ok:
+                    # full loadtxt shape parity: (N,1)->(N,), (1,M)->(M,),
+                    # (1,1)->()
+                    return out.squeeze() if 1 in out.shape else out
         except (OSError, AttributeError):
             pass
     return np.loadtxt(path, delimiter=",", dtype=np.float32)

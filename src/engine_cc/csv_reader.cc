@@ -1,11 +1,11 @@
 // Native CSV parser behind mx.io.CSVIter (the iter_csv.cc equivalent).
 //
-// Two passes over one slurped buffer: a cheap parallel newline scan fixes
-// each thread-chunk's row offset, then threads float-parse their lines with
-// std::from_chars (locale-free) DIRECTLY into the final row-major float32
-// matrix — no per-thread buffers, no merge copy. Exposed via a C ABI
-// (ctypes-bound in mxnet_tpu/io.py) with transparent Python fallback when
-// the .so is missing.
+// Two passes over one slurped buffer: a cheap parallel newline scan at open
+// fixes each thread-chunk's row offset (and reports dims to the caller), then
+// read() float-parses the lines with std::from_chars (locale-free) DIRECTLY
+// into the caller's row-major float32 matrix — no intermediate matrix, no
+// merge copy. Exposed via a C ABI (ctypes-bound in mxnet_tpu/io.py) with
+// transparent Python fallback when the .so is missing or read() declines.
 #include <algorithm>
 #include <charconv>
 #include <cstdio>
@@ -18,7 +18,9 @@
 namespace {
 
 struct CsvHandle {
-  std::vector<float> data;
+  std::string buf;
+  std::vector<const char*> bounds;   // nt+1 chunk boundaries at line starts
+  std::vector<long> chunk_rows;      // pass-1 row count per chunk
   long rows = 0;
   long cols = 0;
 };
@@ -35,28 +37,38 @@ long count_rows(const char* p, const char* end) {
   return rows;
 }
 
-// parse [begin, end) — whole lines — writing cols floats per row at dst
+// parse [begin, end) — whole lines — writing cols floats per row at dst.
+// STRICT grammar: comma-separated floats with optional blank padding, lines
+// ending in '\n' or '\r\n'. Anything else (empty field, '+1.5', text after
+// the last field, classic-Mac bare-'\r' endings, ragged rows) makes the
+// native path DECLINE so the loadtxt fallback decides — both builds must
+// agree on what a file means.
 bool parse_chunk(const char* p, const char* end, long cols, float* dst) {
   while (p < end) {
-    while (p < end && (*p == '\n' || *p == '\r')) ++p;
+    // skip blank lines ('\n' or '\r\n'); a bare '\r' is NOT a line ending
+    while (p < end && (*p == '\n' ||
+                       (*p == '\r' && p + 1 < end && p[1] == '\n')))
+      p += (*p == '\r') ? 2 : 1;
     if (p >= end) break;
     long field = 0;
-    while (p < end && *p != '\n') {
+    for (;;) {
       while (p < end && (*p == ' ' || *p == '\t')) ++p;
       float v = 0.0f;
       auto res = std::from_chars(p, end, v);
-      // anything from_chars rejects (empty field, '+1.5', text) makes the
-      // native path DECLINE so the loadtxt fallback decides — both builds
-      // must agree on what a file means
       if (res.ec != std::errc()) return false;
       p = res.ptr;
       if (field >= cols) return false;
       dst[field++] = v;
-      while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
-      if (p < end && *p == ',') ++p;
-      else break;
+      while (p < end && (*p == ' ' || *p == '\t')) ++p;
+      if (p < end && *p == ',') { ++p; continue; }
+      break;
     }
-    while (p < end && *p != '\n') ++p;
+    // only a line ending (or EOF) may follow the last field
+    if (p < end && *p == '\r') {
+      if (p + 1 < end && p[1] == '\n') ++p; else return false;
+    }
+    if (p < end && *p != '\n') return false;
+    if (p < end) ++p;
     if (field != cols) return false;
     dst += cols;
   }
@@ -73,72 +85,74 @@ void* mxtpu_csv_open(const char* path, long* out_rows, long* out_cols) {
   fseek(f, 0, SEEK_END);
   long n = ftell(f);
   fseek(f, 0, SEEK_SET);
-  std::string buf;
-  buf.resize(n);
-  if (n > 0 && fread(&buf[0], 1, n, f) != static_cast<size_t>(n)) {
+  auto* h = new CsvHandle();
+  h->buf.resize(n);
+  if (n > 0 && fread(&h->buf[0], 1, n, f) != static_cast<size_t>(n)) {
     fclose(f);
+    delete h;
     return nullptr;
   }
   fclose(f);
 
-  const char* start = buf.data();
-  const char* end = start + buf.size();
+  const char* start = h->buf.data();
+  const char* end = start + h->buf.size();
   const char* p = start;
   while (p < end && (*p == '\n' || *p == '\r')) ++p;
-  if (p >= end) return nullptr;
+  if (p >= end) { delete h; return nullptr; }
   long cols = 1;
   for (const char* q = p; q < end && *q != '\n'; ++q)
     if (*q == ',') ++cols;
 
   unsigned nt = std::max(1u, std::min(std::thread::hardware_concurrency(),
                                       16u));
-  if (buf.size() < (1 << 16)) nt = 1;  // not worth the fan-out
+  if (h->buf.size() < (1 << 16)) nt = 1;  // not worth the fan-out
   // chunk boundaries snapped forward to line starts
-  std::vector<const char*> bounds(nt + 1);
-  bounds[0] = start;
-  bounds[nt] = end;
+  h->bounds.resize(nt + 1);
+  h->bounds[0] = start;
+  h->bounds[nt] = end;
   for (unsigned i = 1; i < nt; ++i) {
-    const char* b = start + buf.size() * i / nt;
+    const char* b = start + h->buf.size() * i / nt;
     b = static_cast<const char*>(memchr(b, '\n', end - b));
-    bounds[i] = b ? b + 1 : end;
+    h->bounds[i] = b ? b + 1 : end;
   }
-  // pass 1: per-chunk row counts -> write offsets
-  std::vector<long> rows(nt, 0);
+  // pass 1: per-chunk row counts -> dims now, write offsets for read()
+  h->chunk_rows.assign(nt, 0);
   {
     std::vector<std::thread> ts;
     for (unsigned i = 0; i < nt; ++i)
-      ts.emplace_back([&, i]() { rows[i] = count_rows(bounds[i],
-                                                      bounds[i + 1]); });
-    for (auto& t : ts) t.join();
-  }
-  auto* h = new CsvHandle();
-  h->cols = cols;
-  for (unsigned i = 0; i < nt; ++i) h->rows += rows[i];
-  h->data.resize(static_cast<size_t>(h->rows) * cols);
-  // pass 2: parse straight into the final matrix
-  std::vector<char> ok(nt, 1);
-  {
-    std::vector<std::thread> ts;
-    long off = 0;
-    for (unsigned i = 0; i < nt; ++i) {
-      float* dst = h->data.data() + off * cols;
-      off += rows[i];
-      ts.emplace_back([&, i, dst]() {
-        ok[i] = parse_chunk(bounds[i], bounds[i + 1], cols, dst) ? 1 : 0;
+      ts.emplace_back([&, i]() {
+        h->chunk_rows[i] = count_rows(h->bounds[i], h->bounds[i + 1]);
       });
-    }
     for (auto& t : ts) t.join();
   }
-  for (unsigned i = 0; i < nt; ++i)
-    if (!ok[i]) { delete h; return nullptr; }  // ragged: Python reports it
+  h->cols = cols;
+  for (unsigned i = 0; i < nt; ++i) h->rows += h->chunk_rows[i];
   *out_rows = h->rows;
   *out_cols = h->cols;
   return h;
 }
 
-void mxtpu_csv_read(void* handle, float* dst) {
+// pass 2: parse straight into the caller's (rows x cols) float32 buffer.
+// Returns 1 on success, 0 to DECLINE (ragged/non-conforming file — the
+// Python side then re-reads via np.loadtxt, which reports or handles it).
+int mxtpu_csv_read(void* handle, float* dst) {
   auto* h = static_cast<CsvHandle*>(handle);
-  memcpy(dst, h->data.data(), h->data.size() * sizeof(float));
+  unsigned nt = static_cast<unsigned>(h->chunk_rows.size());
+  std::vector<char> ok(nt, 1);
+  std::vector<std::thread> ts;
+  long off = 0;
+  for (unsigned i = 0; i < nt; ++i) {
+    float* chunk_dst = dst + off * h->cols;
+    off += h->chunk_rows[i];
+    ts.emplace_back([&, i, chunk_dst]() {
+      ok[i] = parse_chunk(h->bounds[i], h->bounds[i + 1], h->cols,
+                          chunk_dst) ? 1 : 0;
+    });
+  }
+  for (auto& t : ts) t.join();
+  for (unsigned i = 0; i < nt; ++i)
+    if (!ok[i]) return 0;
+  return 1;
 }
 
 void mxtpu_csv_close(void* handle) { delete static_cast<CsvHandle*>(handle); }

@@ -592,6 +592,20 @@ def test_native_csv_parser_parity(tmp_path):
     with pytest.raises(ValueError):
         _load_csv_f32(str(p2))
 
+    # classic-Mac bare-'\r' endings: native declines (a '\r' not followed by
+    # '\n' is not a line ending in its strict grammar) -> loadtxt's
+    # universal-newline text mode reads all three rows
+    p3 = tmp_path / "mac.csv"
+    p3.write_bytes(b"1\r2\r3\r")
+    np.testing.assert_array_equal(_load_csv_f32(str(p3)),
+                                  np.array([1, 2, 3], np.float32))
+
+    # trailing non-numeric junk after a parsed field: decline, loadtxt raises
+    p4 = tmp_path / "junk.csv"
+    p4.write_text("1.5abc\n2.0\n")
+    with pytest.raises(ValueError):
+        _load_csv_f32(str(p4))
+
 
 def test_csviter_native_path(tmp_path):
     from mxnet_tpu.io import CSVIter

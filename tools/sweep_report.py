@@ -18,7 +18,8 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 def flash_report(path):
     try:
         data = json.load(open(path))
-    except OSError:
+    except (OSError, ValueError):
+        # ValueError: mid-write/truncated artifact — report what exists
         print("no flash sweep at %s yet" % path)
         return
     rows = data["rows"]
@@ -53,7 +54,10 @@ def batch_report(path):
     print("== batch/remat sweep ==")
     tag = None
     for l in lines:
-        rec = json.loads(l)
+        try:
+            rec = json.loads(l)
+        except ValueError:
+            continue  # truncated in-progress line
         if set(rec) == {"args"}:
             tag = rec["args"]
             continue
@@ -78,7 +82,7 @@ def main():
             print("%-10s %10.2f %s  vs_baseline=%.2f  mfu=%s  (%s)"
                   % (mode, r["value"], r["unit"], r["vs_baseline"],
                      r.get("mfu", "-"), r["measured_at"]))
-    except OSError:
+    except (OSError, ValueError):
         pass
 
 

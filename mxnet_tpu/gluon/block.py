@@ -22,6 +22,7 @@ from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .. import autograd, random as _random
 from .. import _trace
@@ -166,13 +167,70 @@ class Block:
         fn(self)
         return self
 
+    def _collect_params_with_prefix(self, prefix=""):
+        """Params keyed by STRUCTURAL names ('0.weight', 'body.1.bias')
+        relative to this block (ref: python/mxnet/gluon/block.py
+        _collect_params_with_prefix). Structural keys survive the global
+        auto-numbering differences between block instances (dense0_ vs
+        dense20_), which is what makes save_parameters portable."""
+        if prefix:
+            prefix += "."
+        ret = {}
+        bp = self._params._prefix
+        for gname, p in self._own_items():
+            local = gname[len(bp):] if bp and gname.startswith(bp) else gname
+            ret[prefix + local] = p
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
     def save_parameters(self, filename, deduplicate=False):
-        self.collect_params().save(filename, strip_prefix=self.prefix)
+        params = self._collect_params_with_prefix()
+        arg = {}
+        seen = {}
+        for name, p in params.items():
+            if p._data is None:
+                continue
+            if deduplicate and id(p) in seen:
+                continue
+            seen[id(p)] = name
+            arg[name] = np.asarray(p.data().asnumpy())
+        with open(filename, "wb") as f:  # exact filename (np.savez adds .npz)
+            np.savez(f, **arg)
 
     def load_parameters(self, filename, ctx=None, allow_missing=False,
                         ignore_extra=False, cast_dtype=False, dtype_source="current"):
-        self.collect_params().load(filename, ctx, allow_missing, ignore_extra,
-                                   restore_prefix=self.prefix)
+        params = self._collect_params_with_prefix()
+        loaded = dict(np.load(filename, allow_pickle=False))
+        if loaded and params and not (set(loaded) & set(params)):
+            # legacy file saved with global names (pre-structural format or
+            # ParameterDict.save): fall back to prefix-stripped matching
+            return self.collect_params().load(
+                filename, ctx, allow_missing, ignore_extra,
+                restore_prefix=self.prefix)
+        # alias groups: a shared Parameter appears under several structural
+        # names; save_parameters(deduplicate=True) writes only the first, so
+        # accept the value from ANY alias present in the file
+        by_id = {}
+        for name, p in params.items():
+            by_id.setdefault(id(p), []).append(name)
+        for name, p in params.items():
+            key = name if name in loaded else next(
+                (a for a in by_id[id(p)] if a in loaded), None)
+            if key is not None:
+                arr = loaded[key]
+                if cast_dtype and p._data is not None:
+                    want = (p.data().dtype if dtype_source == "current"
+                            else arr.dtype)
+                    arr = arr.astype(want)
+                p.set_data(NDArray(jnp.asarray(arr)))
+            elif not allow_missing:
+                raise KeyError("Parameter %s missing in file %s"
+                               % (name, filename))
+        if not ignore_extra:
+            extra = set(loaded) - set(params)
+            if extra:
+                raise KeyError("Extra parameters in file: %s" % sorted(extra))
 
     def register_forward_hook(self, hook):
         self._forward_hooks.append(hook)

@@ -1,111 +1,438 @@
-"""Estimator: Keras-style fit loop (ref: python/mxnet/gluon/contrib/estimator).
+"""Estimator: Keras-style fit loop with a composable event-handler system
+(ref: python/mxnet/gluon/contrib/estimator/estimator.py + event_handler.py).
 
-Wraps the imperative record/backward/step loop with metric tracking and event
-handlers (checkpointing, logging, early stopping).
+The loop itself is host-side orchestration — the device work (forward,
+backward, optimizer) stays on the jitted imperative path via Trainer, so the
+handler machinery adds no per-step device dispatches.
+
+Handlers implement any subset of the six event mixins (TrainBegin,
+EpochBegin, BatchBegin, BatchEnd, EpochEnd, TrainEnd); `fit` fires them in
+that order around the loop. The default set (MetricHandler, ValidationHandler
+when val_data is given, LoggingHandler, StoppingHandler) mirrors upstream's
+`_prepare_default_handlers`.
 """
 from __future__ import annotations
 
+import copy
+import os
+import re
 import time
+import warnings
 
 from ... import autograd
 from ... import metric as metric_mod
 from ..trainer import Trainer
 
-__all__ = ["Estimator", "LoggingHandler", "CheckpointHandler", "EarlyStoppingHandler"]
+__all__ = [
+    "Estimator", "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
+    "BatchBegin", "BatchEnd", "MetricHandler", "ValidationHandler",
+    "LoggingHandler", "StoppingHandler", "CheckpointHandler",
+    "EarlyStoppingHandler",
+]
 
 
-class _Event:
-    def __init__(self, estimator):
-        self.estimator = estimator
-        self.epoch = 0
-        self.batch = 0
-        self.stop = False
+# ---- event mixins (ref: event_handler.py: EventHandler ABCs) ----------------
+
+class TrainBegin:
+    def train_begin(self, estimator):
+        pass
 
 
-class LoggingHandler:
-    def __init__(self, log_interval=50):
+class TrainEnd:
+    def train_end(self, estimator):
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator):
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator):
+        pass
+
+
+class BatchBegin:
+    def batch_begin(self, estimator, batch=None):
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator, batch=None):
+        pass
+
+
+class StopTraining(Exception):
+    """Raised (internally) by handlers that set estimator.stop_training."""
+
+
+def _monitored_value(estimator, monitor, who):
+    """The monitored metric's current value, or None (with a one-time
+    warning) when `monitor` names no train/val metric — a typo must not
+    silently disable best-tracking/early-stopping."""
+    for m in estimator.train_metrics + estimator.val_metrics:
+        name, val = m.get()
+        if monitor is None or name == monitor:
+            # NaN = metric never updated (e.g. validation hasn't run yet);
+            # returning it would poison best-tracking via NaN comparisons
+            return None if val != val else val
+    warnings.warn("%s: monitored metric %r not found among %s"
+                  % (who, monitor,
+                     [m.get()[0] for m in estimator.train_metrics
+                      + estimator.val_metrics]))
+    return None
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    """Resets train metrics at epoch start and updates them per batch
+    (ref: event_handler.py:MetricHandler). Installed by default."""
+
+    def __init__(self, metrics):
+        self.metrics = metrics
+
+    def epoch_begin(self, estimator):
+        for m in self.metrics:
+            m.reset()
+
+    def batch_end(self, estimator, batch=None):
+        label, pred, loss = (estimator._last_label, estimator._last_pred,
+                             estimator._last_loss)
+        for m in self.metrics:
+            if isinstance(m, metric_mod.Loss):
+                m.update(0, loss)
+            else:
+                m.update(label, pred)
+
+
+class ValidationHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Runs `eval_fn` on val_data every `epoch_period` epochs (and/or every
+    `batch_period` batches) and stores results in estimator.val_metrics
+    (ref: event_handler.py:ValidationHandler)."""
+
+    def __init__(self, val_data, eval_fn, epoch_period=1, batch_period=None):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self._nbatch = 0
+
+    def train_begin(self, estimator):
+        self._nbatch = 0
+
+    def batch_end(self, estimator, batch=None):
+        self._nbatch += 1
+        if self.batch_period and self._nbatch % self.batch_period == 0:
+            self.eval_fn(self.val_data)
+
+    def epoch_end(self, estimator):
+        if self.epoch_period and (estimator.current_epoch + 1) \
+                % self.epoch_period == 0:
+            self.eval_fn(self.val_data)
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchEnd):
+    """Periodic throughput + metric logging
+    (ref: event_handler.py:LoggingHandler). log_interval in batches, or
+    'epoch' to log only at epoch boundaries."""
+
+    def __init__(self, log_interval=50, metrics=None):
         self.log_interval = log_interval
+        self.metrics = metrics
+        self._t_epoch = 0.0
+        self._samples = 0
 
-    def batch_end(self, ev):
-        if ev.batch % self.log_interval == 0:
-            vals = ", ".join("%s=%.4f" % (n, v)
-                             for n, v in ev.estimator.train_metrics.get_name_value())
-            print("epoch %d batch %d: %s" % (ev.epoch, ev.batch, vals))
+    def _vals(self, estimator):
+        ms = self.metrics if self.metrics is not None else \
+            (estimator.train_metrics + estimator.val_metrics)
+        return ", ".join("%s=%.4f" % (n, v)
+                         for m in ms for n, v in [m.get()])
 
-    def epoch_end(self, ev):
-        vals = ", ".join("%s=%.4f" % (n, v)
-                         for n, v in ev.estimator.train_metrics.get_name_value())
-        print("epoch %d done: %s" % (ev.epoch, vals))
+    def train_begin(self, estimator):
+        self._t_train = time.perf_counter()
+        print("[estimator] training begin: %d epochs" % (estimator.max_epoch,))
+
+    def train_end(self, estimator):
+        print("[estimator] training done in %.1fs: %s"
+              % (time.perf_counter() - self._t_train, self._vals(estimator)))
+
+    def epoch_begin(self, estimator):
+        self._t_epoch = time.perf_counter()
+        self._samples = 0
+
+    def batch_end(self, estimator, batch=None):
+        self._samples += estimator._last_batch_size
+        if self.log_interval != "epoch" \
+                and (estimator.current_batch + 1) % self.log_interval == 0:
+            dt = time.perf_counter() - self._t_epoch
+            print("epoch %d batch %d: %.1f samples/s, %s"
+                  % (estimator.current_epoch, estimator.current_batch,
+                     self._samples / max(dt, 1e-9), self._vals(estimator)))
+
+    def epoch_end(self, estimator):
+        dt = time.perf_counter() - self._t_epoch
+        print("epoch %d done in %.1fs: %s"
+              % (estimator.current_epoch, dt, self._vals(estimator)))
 
 
-class CheckpointHandler:
-    def __init__(self, model_dir, model_prefix="model", save_best=False):
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Stop at max_epoch/max_batch (ref: event_handler.py:StoppingHandler)."""
+
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self._nbatch = 0
+
+    def train_begin(self, estimator):
+        self._nbatch = 0
+        if self.max_epoch is not None:
+            estimator.max_epoch = self.max_epoch
+
+    def batch_end(self, estimator, batch=None):
+        self._nbatch += 1
+        if self.max_batch is not None and self._nbatch >= self.max_batch:
+            estimator.stop_training = True
+
+    def epoch_end(self, estimator):
+        if self.max_epoch is not None \
+                and estimator.current_epoch + 1 >= self.max_epoch:
+            estimator.stop_training = True
+
+
+class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Saves net params (+ trainer states) every epoch_period epochs or
+    batch_period batches; `save_best` keeps <prefix>-best.params per the
+    monitored metric; `resume_from_checkpoint` reloads the newest epoch file
+    (ref: event_handler.py:CheckpointHandler)."""
+
+    def __init__(self, model_dir, model_prefix="model", monitor=None,
+                 mode="min", save_best=False, epoch_period=1,
+                 batch_period=None, max_checkpoints=5,
+                 resume_from_checkpoint=False):
         self.model_dir = model_dir
         self.model_prefix = model_prefix
+        self.monitor = monitor
+        self.mode = mode
+        self.save_best = save_best
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.max_checkpoints = max_checkpoints
+        self.resume_from_checkpoint = resume_from_checkpoint
+        self.best = None
+        self._nbatch = 0
+        self._saved = []
 
-    def epoch_end(self, ev):
-        import os
-
+    def _save(self, estimator, tag, rotate=True):
         os.makedirs(self.model_dir, exist_ok=True)
-        ev.estimator.net.save_parameters(
-            "%s/%s-epoch%d.params" % (self.model_dir, self.model_prefix, ev.epoch))
+        path = os.path.join(self.model_dir,
+                            "%s-%s.params" % (self.model_prefix, tag))
+        estimator.net.save_parameters(path)
+        if estimator.trainer is not None:
+            try:
+                estimator.trainer.save_states(path[:-len(".params")]
+                                              + ".states")
+            except Exception as e:  # params saved; states are best-effort,
+                warnings.warn(       # but silence would corrupt a resume
+                    "CheckpointHandler: trainer state save failed (%r) — "
+                    "resuming from %s will reset optimizer state" % (e, path))
+        if rotate:
+            self._saved.append(path)
+            while len(self._saved) > self.max_checkpoints:
+                old = self._saved.pop(0)
+                for p in (old, old[:-len(".params")] + ".states"):
+                    if os.path.exists(p):
+                        os.remove(p)
+        return path
+
+    def train_begin(self, estimator):
+        self._nbatch = 0
+        if self.resume_from_checkpoint:
+            import glob
+            cands = glob.glob(os.path.join(
+                self.model_dir, self.model_prefix + "-epoch*.params"))
+            if cands:  # numeric sort: epoch11 is newer than epoch9
+                cands.sort(key=lambda f: int(
+                    re.search(r"epoch(\d+)\.params$", f).group(1)))
+                estimator.net.load_parameters(cands[-1])
+
+    def batch_end(self, estimator, batch=None):
+        self._nbatch += 1
+        if self.batch_period and self._nbatch % self.batch_period == 0:
+            self._save(estimator, "batch%d" % self._nbatch)
+
+    def epoch_end(self, estimator):
+        e = estimator.current_epoch
+        if self.epoch_period and (e + 1) % self.epoch_period == 0:
+            self._save(estimator, "epoch%d" % e)
+        if self.save_best:
+            val = _monitored_value(estimator, self.monitor,
+                                   "CheckpointHandler(save_best=True)")
+            if val is not None:
+                better = self.best is None or \
+                    (val < self.best if self.mode == "min" else val > self.best)
+                if better:
+                    self.best = val
+                    self._save(estimator, "best", rotate=False)
 
 
-class EarlyStoppingHandler:
-    def __init__(self, monitor="loss", patience=3, mode="min"):
+class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
+    """Stop when the monitored metric hasn't improved by min_delta for
+    `patience` epochs (ref: event_handler.py:EarlyStoppingHandler)."""
+
+    def __init__(self, monitor=None, min_delta=0.0, patience=3, mode="min",
+                 baseline=None):
+        self.monitor = monitor
+        self.min_delta = min_delta
         self.patience = patience
         self.mode = mode
+        self.baseline = baseline
         self.best = None
         self.waiting = 0
+        self.stopped_epoch = None
 
-    def epoch_end(self, ev):
-        pairs = ev.estimator.train_metrics.get_name_value()
-        val = pairs[0][1]
-        better = self.best is None or (val < self.best if self.mode == "min" else val > self.best)
+    def train_begin(self, estimator):
+        self.best = self.baseline
+        self.waiting = 0
+        self.stopped_epoch = None
+
+    def epoch_end(self, estimator):
+        val = _monitored_value(estimator, self.monitor,
+                               "EarlyStoppingHandler")
+        if val is None:
+            return
+        if self.mode == "min":
+            better = self.best is None or val < self.best - self.min_delta
+        else:
+            better = self.best is None or val > self.best + self.min_delta
         if better:
             self.best = val
             self.waiting = 0
         else:
             self.waiting += 1
             if self.waiting >= self.patience:
-                ev.stop = True
+                self.stopped_epoch = estimator.current_epoch
+                estimator.stop_training = True
+
+    def train_end(self, estimator):
+        if self.stopped_epoch is not None:
+            print("[estimator] early stop at epoch %d (best %s=%.4f)"
+                  % (self.stopped_epoch, self.monitor or "metric",
+                     self.best if self.best is not None else float("nan")))
+
+
+def _as_metric_list(metrics, default):
+    if metrics is None:
+        metrics = [default]
+    if not isinstance(metrics, (list, tuple)):
+        metrics = [metrics]
+    return [metric_mod.create(m) if isinstance(m, str) else m
+            for m in metrics]
 
 
 class Estimator:
-    def __init__(self, net, loss, train_metrics=None, trainer=None, context=None):
+    """fit/evaluate driver (ref: estimator.py:Estimator).
+
+    Attributes exposed to handlers: current_epoch, current_batch, max_epoch,
+    stop_training, train_metrics, val_metrics, net, trainer, and the
+    last-batch tensors (_last_label/_last_pred/_last_loss)."""
+
+    def __init__(self, net, loss, train_metrics=None, val_metrics=None,
+                 trainer=None, context=None):
         self.net = net
         self.loss = loss
-        self.train_metrics = metric_mod.CompositeEvalMetric(
-            train_metrics if isinstance(train_metrics, (list, tuple))
-            else [train_metrics] if train_metrics else ["accuracy"])
+        self.train_metrics = _as_metric_list(train_metrics, "accuracy")
+        # upstream clones train metrics as "validation X" when not given
+        self.val_metrics = _as_metric_list(
+            val_metrics, "accuracy") if val_metrics is not None else []
         self.trainer = trainer or Trainer(net.collect_params(), "adam")
+        self.stop_training = False
+        self.current_epoch = 0
+        self.current_batch = 0
+        self.max_epoch = 0
 
-    def fit(self, train_data, val_data=None, epochs=1, event_handlers=()):
-        ev = _Event(self)
+    # -- default handler assembly (ref: estimator.py:_prepare_default_handlers)
+    def _default_handlers(self, val_data, event_handlers, verbose):
+        handlers = list(event_handlers)
+        if not any(isinstance(h, MetricHandler) for h in handlers):
+            handlers.insert(0, MetricHandler(self.train_metrics))
+        if val_data is not None \
+                and not any(isinstance(h, ValidationHandler) for h in handlers):
+            if not self.val_metrics:
+                # upstream clones the train metrics as "validation X";
+                # deepcopy preserves custom names/kwargs that a registry
+                # round-trip through the display name would lose
+                self.val_metrics = []
+                for m in self.train_metrics:
+                    c = copy.deepcopy(m)
+                    c.name = "validation " + c.name
+                    c.reset()
+                    self.val_metrics.append(c)
+            # BEFORE user handlers: checkpoint/early-stop epoch_end must see
+            # THIS epoch's validation numbers, not last epoch's
+            handlers.insert(1, ValidationHandler(val_data, self.evaluate))
+        if verbose and not any(isinstance(h, LoggingHandler)
+                               for h in handlers):
+            handlers.append(LoggingHandler())
+        return handlers
+
+    def _fire(self, handlers, event, batch=None):
+        for h in handlers:
+            fn = getattr(h, event, None)
+            if fn is None:
+                continue
+            if event in ("batch_begin", "batch_end"):
+                fn(self, batch=batch)
+            else:
+                fn(self)
+
+    def fit(self, train_data, val_data=None, epochs=None, event_handlers=(),
+            batches=None, verbose=False):
+        """Train for `epochs` epochs and/or `batches` total batches —
+        whichever bound hits first stops the loop (upstream semantics)."""
+        if epochs is None and batches is None:
+            epochs = 1
+        self.stop_training = False
+        handlers = self._default_handlers(val_data, event_handlers, verbose)
+        if batches is not None:
+            handlers.append(StoppingHandler(max_batch=batches))
+        if epochs is None:
+            epochs = 1 << 30  # batch-bounded run
+        self.max_epoch = epochs
+        self._fire(handlers, "train_begin")
         for epoch in range(epochs):
-            ev.epoch = epoch
-            self.train_metrics.reset()
-            for i, (data, label) in enumerate(train_data):
-                ev.batch = i
+            self.current_epoch = epoch
+            self._fire(handlers, "epoch_begin")
+            for i, batch in enumerate(train_data):
+                data, label = batch[0], batch[1]
+                self.current_batch = i
+                self._fire(handlers, "batch_begin", batch)
                 with autograd.record():
-                    out = self.net(data)
-                    loss = self.loss(out, label)
+                    pred = self.net(data)
+                    loss = self.loss(pred, label)
                 loss.backward()
                 self.trainer.step(data.shape[0])
-                self.train_metrics.update(label, out)
-                for h in event_handlers:
-                    if hasattr(h, "batch_end"):
-                        h.batch_end(ev)
-            for h in event_handlers:
-                if hasattr(h, "epoch_end"):
-                    h.epoch_end(ev)
-            if ev.stop:
+                self._last_label, self._last_pred = label, pred
+                self._last_loss, self._last_batch_size = loss, data.shape[0]
+                self._fire(handlers, "batch_end", batch)
+                if self.stop_training:
+                    break
+            self._fire(handlers, "epoch_end")
+            if self.stop_training:
                 break
-        return self.train_metrics.get_name_value()
+        self._fire(handlers, "train_end")
+        return [m.get() for m in self.train_metrics]
 
     def evaluate(self, val_data, metrics=None):
-        m = metric_mod.CompositeEvalMetric(metrics or ["accuracy"])
-        for data, label in val_data:
-            out = self.net(data)
-            m.update(label, out)
-        return m.get_name_value()
+        ms = _as_metric_list(metrics, "accuracy") if metrics is not None \
+            else (self.val_metrics or _as_metric_list(None, "accuracy"))
+        for m in ms:
+            m.reset()
+        for batch in val_data:
+            data, label = batch[0], batch[1]
+            pred = self.net(data)
+            for m in ms:
+                if isinstance(m, metric_mod.Loss):
+                    m.update(0, self.loss(pred, label))
+                else:
+                    m.update(label, pred)
+        return [m.get() for m in ms]

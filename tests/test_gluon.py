@@ -126,6 +126,28 @@ def test_save_load_parameters(tmp_path):
     np.testing.assert_allclose(net(x).asnumpy(), net2(x).asnumpy(), rtol=1e-6)
 
 
+def test_save_load_parameters_across_auto_named_instances(tmp_path):
+    """save_parameters keys by STRUCTURAL names ('0.weight'), so a file saved
+    from one auto-named instance (dense0_) loads into a later one (dense7_)
+    — the upstream _collect_params_with_prefix contract
+    (ref: python/mxnet/gluon/block.py)."""
+    def build():
+        net = nn.Sequential()
+        net.add(nn.Dense(8, in_units=4), nn.Dense(2, in_units=8))
+        net.initialize()
+        return net
+
+    net = build()
+    f = str(tmp_path / "w.params")
+    net.save_parameters(f)
+    net2 = build()  # different global auto-numbering
+    assert ({p.name for p in net.collect_params().values()}
+            != {p.name for p in net2.collect_params().values()})
+    net2.load_parameters(f)
+    x = _x(2, 4)
+    np.testing.assert_allclose(net(x).asnumpy(), net2(x).asnumpy(), rtol=1e-6)
+
+
 def test_collect_params_select():
     net = nn.HybridSequential(prefix="s_")
     with net.name_scope():

@@ -195,8 +195,8 @@ class Block:
                 continue
             seen[id(p)] = name
             arg[name] = np.asarray(p.data().asnumpy())
-        with open(filename, "wb") as f:  # exact filename (np.savez adds .npz)
-            np.savez(f, **arg)
+        from ..util import save_npz_exact
+        save_npz_exact(filename, arg)
 
     def load_parameters(self, filename, ctx=None, allow_missing=False,
                         ignore_extra=False, cast_dtype=False, dtype_source="current"):
@@ -219,10 +219,12 @@ class Block:
                 (a for a in by_id[id(p)] if a in loaded), None)
             if key is not None:
                 arr = loaded[key]
-                if cast_dtype and p._data is not None:
-                    want = (p.data().dtype if dtype_source == "current"
-                            else arr.dtype)
-                    arr = arr.astype(want)
+                if cast_dtype and dtype_source == "saved":
+                    # the net takes the FILE's dtype; cast the parameter
+                    # first or set_data would cast the value right back
+                    p.cast(arr.dtype)
+                # dtype_source == "current": set_data's cast-to-param-dtype
+                # below is exactly those semantics
                 p.set_data(NDArray(jnp.asarray(arr)))
             elif not allow_missing:
                 raise KeyError("Parameter %s missing in file %s"

@@ -246,6 +246,7 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
 
     def train_begin(self, estimator):
         self._nbatch = 0
+        self._epoch_offset = 0
         if self.resume_from_checkpoint:
             import glob
             cands = glob.glob(os.path.join(
@@ -253,7 +254,16 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
             if cands:  # numeric sort: epoch11 is newer than epoch9
                 cands.sort(key=lambda f: int(
                     re.search(r"epoch(\d+)\.params$", f).group(1)))
-                estimator.net.load_parameters(cands[-1])
+                newest = cands[-1]
+                estimator.net.load_parameters(newest)
+                states = newest[:-len(".params")] + ".states"
+                if estimator.trainer is not None and os.path.exists(states):
+                    estimator.trainer.load_states(states)
+                # continue the numbering: the resumed run's saves must sort
+                # AFTER the run they resumed from, or a later resume (and
+                # rotation) would prefer the older run's files
+                self._epoch_offset = 1 + int(
+                    re.search(r"epoch(\d+)\.params$", newest).group(1))
 
     def batch_end(self, estimator, batch=None):
         self._nbatch += 1
@@ -263,7 +273,8 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
     def epoch_end(self, estimator):
         e = estimator.current_epoch
         if self.epoch_period and (e + 1) % self.epoch_period == 0:
-            self._save(estimator, "epoch%d" % e)
+            self._save(estimator,
+                       "epoch%d" % (e + getattr(self, "_epoch_offset", 0)))
         if self.save_best:
             val = _monitored_value(estimator, self.monitor,
                                    "CheckpointHandler(save_best=True)")
@@ -402,7 +413,9 @@ class Estimator:
         for epoch in range(epochs):
             self.current_epoch = epoch
             self._fire(handlers, "epoch_begin")
+            ran_batches = 0
             for i, batch in enumerate(train_data):
+                ran_batches += 1
                 data, label = batch[0], batch[1]
                 self.current_batch = i
                 self._fire(handlers, "batch_begin", batch)
@@ -418,6 +431,13 @@ class Estimator:
                     break
             self._fire(handlers, "epoch_end")
             if self.stop_training:
+                break
+            if ran_batches == 0:
+                # an empty epoch repeats forever (exhausted one-shot
+                # iterator / empty loader) — especially under the
+                # batch-bounded 2^30-epoch sentinel
+                warnings.warn("fit: train_data yielded no batches in epoch "
+                              "%d; stopping" % epoch)
                 break
         self._fire(handlers, "train_end")
         return [m.get() for m in self.train_metrics]

@@ -246,8 +246,8 @@ class ParameterDict:
                 continue
             n = name[len(strip_prefix):] if name.startswith(strip_prefix) else name
             arg[n] = np.asarray(p.data().asnumpy())
-        with open(filename, "wb") as f:  # exact filename (np.savez would add .npz)
-            np.savez(f, **arg)
+        from ..util import save_npz_exact
+        save_npz_exact(filename, arg)
 
     def load(self, filename, ctx=None, allow_missing=False,
              ignore_extra=False, restore_prefix=""):

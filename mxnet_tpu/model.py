@@ -26,13 +26,20 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
         arrs["arg:%s" % k] = v.asnumpy() if isinstance(v, NDArray) else np.asarray(v)
     for k, v in (aux_params or {}).items():
         arrs["aux:%s" % k] = v.asnumpy() if isinstance(v, NDArray) else np.asarray(v)
-    np.savez("%s-%04d.params.npz" % (prefix, epoch), **arrs)
+    # exact upstream filename (prefix-0001.params), written atomically
+    from .util import save_npz_exact
+    save_npz_exact("%s-%04d.params" % (prefix, epoch), arrs)
 
 
 def load_checkpoint(prefix, epoch):
     """(ref: model.py:load_checkpoint) → (symbol, arg_params, aux_params)."""
+    import os
+
     symbol = sym_mod.load("%s-symbol.json" % prefix)
-    data = np.load("%s-%04d.params.npz" % (prefix, epoch))
+    path = "%s-%04d.params" % (prefix, epoch)
+    if not os.path.exists(path) and os.path.exists(path + ".npz"):
+        path += ".npz"  # files written before the exact-name fix
+    data = np.load(path)
     arg_params, aux_params = {}, {}
     for k in data.files:
         kind, name = k.split(":", 1)

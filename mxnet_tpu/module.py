@@ -54,6 +54,11 @@ class Module:
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
                     allow_missing=False, force_init=False, allow_extra=False):
         assert self.binded
+        if arg_params is None and getattr(self, "_preloaded_params", None):
+            # Module.load stashed the checkpoint's params for bind time
+            pre_arg, pre_aux = self._preloaded_params
+            arg_params = dict(pre_arg)
+            arg_params.update(pre_aux or {})
         initializer = initializer or init_mod.Uniform(0.01)
         arg_names = self._symbol.list_arguments()
         # infer parameter shapes from data shapes via eval_shape with zeros
@@ -240,8 +245,22 @@ class Module:
         self._arg_params.update(arg_params or {})
 
     def save_checkpoint(self, prefix, epoch):
-        np.savez("%s-%04d.params.npz" % (prefix, epoch),
-                 **{k: v.asnumpy() for k, v in self._arg_params.items()})
+        """prefix-symbol.json + prefix-NNNN.params, the mx.model layout
+        (ref: module/module.py:save_checkpoint)."""
+        from . import model as _model
+        arg, aux = self.get_params()
+        _model.save_checkpoint(prefix, epoch, self._symbol, arg, aux)
+
+    @staticmethod
+    def load(prefix, epoch, data_names=("data",), label_names=("softmax_label",),
+             context=None, **kwargs):
+        """Rebuild a Module from a save_checkpoint layout
+        (ref: module/module.py:Module.load). Params apply at bind time."""
+        from . import model as _model
+        sym, arg, aux = _model.load_checkpoint(prefix, epoch)
+        mod = Module(sym, data_names, label_names, context, **kwargs)
+        mod._preloaded_params = (arg, aux)
+        return mod
 
 
 class BucketingModule(Module):

@@ -15,6 +15,21 @@ __all__ = ["makedirs", "set_np", "reset_np", "is_np_array", "is_np_shape",
            "getenv", "setenv"]
 
 
+def save_npz_exact(filename, arrays):
+    """np.savez under the EXACT filename (no automatic .npz suffix),
+    atomically: write to a temp file in the same directory, then rename —
+    a crash mid-save must not leave a truncated checkpoint behind."""
+    import numpy as _np
+    tmp = "%s.tmp%d" % (filename, os.getpid())
+    try:
+        with open(tmp, "wb") as f:
+            _np.savez(f, **arrays)
+        os.replace(tmp, filename)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+
+
 def makedirs(d):
     os.makedirs(os.path.expanduser(d), exist_ok=True)
 

@@ -231,6 +231,51 @@ def test_save_parameters_deduplicate_shared_params(tmp_path):
     np.testing.assert_allclose(net(x).asnumpy(), net2(x).asnumpy(), rtol=1e-6)
 
 
+def test_checkpoint_resume_continues_epoch_numbering(tmp_path):
+    """A resumed run's saves must sort after the run it resumed from, and
+    resume must restore trainer (optimizer) state, not just params."""
+    import os
+    est, net = _estimator()
+    ch = CheckpointHandler(str(tmp_path), model_prefix="m", max_checkpoints=20)
+    est.fit(_toy_data(), epochs=3, event_handlers=[ch])  # epoch0..2
+
+    est2, net2 = _estimator()
+    ch2 = CheckpointHandler(str(tmp_path), model_prefix="m",
+                            max_checkpoints=20, resume_from_checkpoint=True)
+    est2.fit(_toy_data(), epochs=2, event_handlers=[ch2])
+    files = sorted(os.listdir(tmp_path))
+    # run 2's two epochs saved as epoch3/epoch4, not epoch0/epoch1 again
+    assert "m-epoch3.params" in files and "m-epoch4.params" in files
+    assert "m-epoch2.params" in files  # run 1's newest still present
+    # trainer states were restored: adam's update counter advanced past 0
+    assert est2.trainer._optimizer.num_update > len(_toy_data()) * 2
+
+
+def test_fit_empty_loader_stops(recwarn):
+    est, _ = _estimator()
+    import warnings as _w
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        est.fit([], batches=10)  # 2^30-epoch sentinel must not spin
+    assert any("no batches" in str(w.message) for w in rec)
+
+
+def test_load_parameters_cast_dtype_saved(tmp_path):
+    """cast_dtype with dtype_source='saved' casts the NET to the file's
+    dtype (upstream semantics)."""
+    net = _toy_net()
+    net(nd.array(np.zeros((1, 8), np.float32)))  # materialize deferred shapes
+    net.cast("float16")
+    f = str(tmp_path / "w.params")
+    net.save_parameters(f)
+
+    net2 = _toy_net()  # float32
+    net2(nd.array(np.zeros((1, 8), np.float32)))
+    net2.load_parameters(f, cast_dtype=True, dtype_source="saved")
+    for p in net2.collect_params().values():
+        assert p.data().dtype == np.float16
+
+
 def test_logging_handler_prints(capsys):
     est, _ = _estimator()
     est.fit(_toy_data(), epochs=1,

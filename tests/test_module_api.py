@@ -300,3 +300,37 @@ def test_module_group_outputs_preserved_with_bn():
     assert mod._arg_params["bn0_moving_mean"].shape == (3,)
     mod.backward([nd.array(np.ones((4, 3), np.float32)),
                   nd.array(np.ones((4, 3), np.float32))])
+
+
+def test_module_save_checkpoint_and_load(tmp_path):
+    """Module.save_checkpoint writes the upstream prefix-symbol.json +
+    prefix-NNNN.params layout; Module.load rebuilds and reproduces outputs
+    (ref: module/module.py:save_checkpoint/load)."""
+    import os
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.io import DataBatch
+    from mxnet_tpu.module import Module
+
+    rng = np.random.default_rng(3)
+    d = mx.sym.var("data")
+    h = mx.sym.FullyConnected(d, num_hidden=8, name="fc1")
+    out = mx.sym.FullyConnected(mx.sym.relu(h), num_hidden=2, name="fc2")
+    mod = Module(out, label_names=[])
+    mod.bind(data_shapes=[("data", (4, 5))])
+    mod.init_params()
+    batch = DataBatch(data=[nd.array(rng.normal(size=(4, 5))
+                                     .astype(np.float32))], label=[])
+    ref = mod.forward(batch, is_train=False)[0].asnumpy()
+
+    prefix = str(tmp_path / "ckpt")
+    mod.save_checkpoint(prefix, 7)
+    assert os.path.exists(prefix + "-symbol.json")
+    assert os.path.exists(prefix + "-0007.params")  # exact upstream name
+
+    mod2 = Module.load(prefix, 7, label_names=[])
+    mod2.bind(data_shapes=[("data", (4, 5))])
+    mod2.init_params()
+    got = mod2.forward(batch, is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-6)

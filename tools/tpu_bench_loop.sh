@@ -19,6 +19,12 @@ while true; do
   # -k: a wedged jax ignores SIGTERM — follow up with SIGKILL or the loop
   # hangs forever on one probe (observed 2026-07-30 19:47Z)
   if timeout -k 10 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    # serialize against CPU-heavy work: a concurrent full pytest run slows
+    # host-side build/dispatch 3-5x and would depress every timed number
+    while pgrep -f "pytest tests" >/dev/null 2>&1; do
+      echo "[loop] $(date -u +%T) relay up but a test suite is running; waiting 60s"
+      sleep 60
+    done
     echo "[loop] $(date -u +%T) relay up; headline bert first"
     # headline FIRST: if the relay window is short, the number the driver
     # replays must be the bert one — don't let five secondary modes spend

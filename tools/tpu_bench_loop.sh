@@ -21,7 +21,9 @@ while true; do
   if timeout -k 10 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     # serialize against CPU-heavy work: a concurrent full pytest run slows
     # host-side build/dispatch 3-5x and would depress every timed number
-    while pgrep -f "pytest tests" >/dev/null 2>&1; do
+    # anchored: the harness driver's cmdline CONTAINS 'python -m pytest'
+    # as prose, so an unanchored pattern would wait on it forever
+    while pgrep -f "^[^ ]*python[^ ]* -m pytest" >/dev/null 2>&1; do
       echo "[loop] $(date -u +%T) relay up but a test suite is running; waiting 60s"
       sleep 60
     done

@@ -62,5 +62,9 @@ from .attribute import AttrScope
 from . import name
 from . import onnx  # import/export (ref: python/mxnet/onnx)
 from . import contrib  # mx.contrib.{ndarray,symbol,quantization,onnx,text}
+from . import executor  # Executor's upstream import location
+from . import registry  # generic register/alias/create machinery
+from . import libinfo  # native lib paths + parity version line
+from . import kvstore_server  # justified N/A: no PS role on this backend
 
 __all__ = ["nd", "gluon", "autograd", "cpu", "gpu", "tpu", "Context", "NDArray"]

@@ -1,0 +1,10 @@
+"""``mx.executor`` namespace (ref: python/mxnet/executor.py).
+
+The Executor class itself lives in symbol.py (it IS the graph executor:
+two jitted XLA programs, train/eval, plus the jitted VJP); this module
+gives it the upstream import location."""
+from __future__ import annotations
+
+from .symbol import Executor  # noqa: F401
+
+__all__ = ["Executor"]

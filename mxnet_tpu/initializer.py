@@ -18,18 +18,15 @@ __all__ = ["Initializer", "InitDesc", "Zero", "One", "Constant", "Uniform",
            "Normal", "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear",
            "LSTMBias", "Mixed", "create"]
 
-_REGISTRY = {}
-
-
 def register(klass):
-    _REGISTRY[klass.__name__.lower()] = klass
-    return klass
+    """Backed by the generic mx.registry machinery (ref: registry.py)."""
+    from . import registry as _reg
+    return _reg.get_register_func(Initializer, "initializer")(klass)
 
 
 def create(name, **kwargs):
-    if isinstance(name, Initializer):
-        return name
-    return _REGISTRY[name.lower()](**kwargs)
+    from . import registry as _reg
+    return _reg.get_create_func(Initializer, "initializer")(name, **kwargs)
 
 
 class InitDesc(str):
@@ -98,7 +95,8 @@ class Zero(Initializer):
 
 
 Zeros = Zero
-_REGISTRY["zeros"] = Zero
+from . import registry as _reg_mod
+_reg_mod.get_register_func(Initializer, "initializer")(Zero, "zeros")
 
 
 @register
@@ -108,7 +106,7 @@ class One(Initializer):
 
 
 Ones = One
-_REGISTRY["ones"] = One
+_reg_mod.get_register_func(Initializer, "initializer")(One, "ones")
 
 
 @register

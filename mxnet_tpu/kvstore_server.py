@@ -1,0 +1,33 @@
+"""``mx.kvstore_server`` (ref: python/mxnet/kvstore_server.py).
+
+Justified N/A, like ``dist_async`` (kvstore.py): upstream's dist training
+runs dedicated parameter-server processes (ps-lite roles scheduler/server/
+worker); the TPU-native distributed backend has NO server role — gradients
+reduce via XLA collectives over ICI/DCN inside the compiled step
+(parallel/*, DistKVStore), so every process is a worker and the "server"
+is the interconnect. This module exists so role-launching scripts fail
+loudly with that explanation instead of an ImportError."""
+from __future__ import annotations
+
+__all__ = ["KVStoreServer"]
+
+_RATIONALE = (
+    "TPU-native distributed training has no parameter-server role: "
+    "reduction happens via XLA collectives (psum/reduce_scatter) inside "
+    "the compiled train step across all workers (see mxnet_tpu/parallel "
+    "and kvstore.DistKVStore). Launch every process as a worker with "
+    "jax.distributed.initialize (tools/launch.py)."
+)
+
+
+class KVStoreServer:
+    """(ref: kvstore_server.py:KVStoreServer) — N/A on this backend."""
+
+    def __init__(self, kvstore=None):
+        raise RuntimeError(_RATIONALE)
+
+
+def _init_kvstore_server_module():
+    """Upstream calls this when DMLC_ROLE=server; here it explains why
+    there is no such role."""
+    raise RuntimeError(_RATIONALE)

@@ -9,12 +9,10 @@ __all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "F1", "MAE", "MSE", "RMSE",
            "CrossEntropy", "Perplexity", "PearsonCorrelation", "Loss",
            "CompositeEvalMetric", "create"]
 
-_REGISTRY = {}
-
-
 def register(klass):
-    _REGISTRY[klass.__name__.lower()] = klass
-    return klass
+    """Backed by the generic mx.registry machinery (ref: registry.py)."""
+    from . import registry as _reg
+    return _reg.get_register_func(EvalMetric, "metric")(klass)
 
 
 def create(metric, **kwargs):
@@ -24,7 +22,8 @@ def create(metric, **kwargs):
         return CompositeEvalMetric([create(m) for m in metric])
     if callable(metric):
         return CustomMetric(metric, **kwargs)
-    return _REGISTRY[metric.lower()](**kwargs)
+    from . import registry as _reg
+    return _reg.get_create_func(EvalMetric, "metric")(metric, **kwargs)
 
 
 def _np(x):

@@ -22,16 +22,18 @@ __all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "AdaGrad", "AdaDelta",
            "AdaMax", "FTML", "DCASGD", "LARS",
            "RMSProp", "Ftrl", "LAMB", "Signum", "SGLD", "create", "register"]
 
-_REGISTRY = {}
-
-
 def register(klass):
-    _REGISTRY[klass.__name__.lower()] = klass
-    return klass
+    """Backed by the generic mx.registry machinery (ref: registry.py) —
+    one registration mechanism across optimizer/initializer/metric."""
+    from . import registry as _reg
+    return _reg.get_register_func(Optimizer, "optimizer")(klass)
 
 
 def create(name, **kwargs):
-    return _REGISTRY[name.lower()](**kwargs)
+    """Accepts an Optimizer instance, a name, or a JSON config string
+    '{"type": "adam", "learning_rate": ...}' (ref: registry.py)."""
+    from . import registry as _reg
+    return _reg.get_create_func(Optimizer, "optimizer")(name, **kwargs)
 
 
 class Optimizer:

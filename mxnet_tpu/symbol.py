@@ -1084,3 +1084,51 @@ class Executor:
                     self.grad_dict[n]._data = self.grad_dict[n]._data + g
                 else:
                     self.grad_dict[n]._data = g
+
+    @property
+    def aux_dict(self):
+        """(ref: executor.py:Executor.aux_dict) — auxiliary states. BN
+        moving stats etc. live in arg_dict here (XLA treats them as plain
+        inputs; Module does the train-mode write-back), so this is empty by
+        construction; kept for API parity with code that iterates it."""
+        return {}
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        """(ref: executor.py:Executor.copy_params_from)"""
+        merged = dict(arg_params or {})
+        merged.update(aux_params or {})
+        for n, v in merged.items():
+            if n in self.arg_dict:
+                # fresh wrapper around the (immutable) buffer: the caller
+                # rebinding their NDArray's ._data later must not leak into
+                # this executor — upstream's copy contract
+                self.arg_dict[n] = NDArray(v._data) if isinstance(v, NDArray) \
+                    else NDArray(jnp.asarray(v))
+            elif not allow_extra_params:
+                raise ValueError("Executor has no argument %r" % n)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """(ref: executor.py:Executor.reshape). XLA programs are
+        shape-specialized, so a new shape simply means a new compiled
+        program on the next forward — which is why partial_shaping /
+        allow_up_sizing are accepted but moot here: upstream uses them to
+        police reuse of fixed-size CUDA buffers, and there is no buffer
+        reuse to police (args are re-materialized at the new shapes)."""
+        unknown = [n for n in kwargs if n not in self.arg_dict]
+        if unknown:
+            raise ValueError("reshape: no such argument(s) %s (have %s)"
+                             % (unknown, sorted(self.arg_dict)))
+        ex = Executor(self._sym, self._ctx, dict(self.arg_dict),
+                      dict(self.grad_dict), self._grad_req)
+        for n, shape in kwargs.items():
+            if tuple(ex.arg_dict[n].shape) != tuple(shape):
+                ex.arg_dict[n] = NDArray(jnp.zeros(shape,
+                                                   ex.arg_dict[n].dtype))
+        # fresh zero grads at each arg's (possibly new) shape: sharing the
+        # parent's grad arrays would corrupt it on 'write' and break
+        # broadcasting on 'add'
+        ex.grad_dict = {n: NDArray(jnp.zeros(ex.arg_dict[n].shape,
+                                             ex.arg_dict[n].dtype))
+                        for n, g in self.grad_dict.items() if g is not None}
+        return ex

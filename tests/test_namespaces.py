@@ -267,3 +267,77 @@ def test_sym_batchnorm_composes_single_output():
                                    .normal(size=(2, 3, 4, 4))
                                    .astype(np.float32)))
     assert out[0].shape == (2, 3, 4, 4)
+
+
+def test_registry_machinery():
+    """mx.registry register/alias/create incl. the JSON config form
+    (ref: python/mxnet/registry.py)."""
+    import pytest
+
+    import mxnet_tpu as mx
+
+    class Base:
+        def __init__(self, x=1):
+            self.x = x
+
+    register = mx.registry.get_register_func(Base, "thing")
+    alias = mx.registry.get_alias_func(Base, "thing")
+    create = mx.registry.get_create_func(Base, "thing")
+
+    @alias("alpha", "first")
+    class A(Base):
+        pass
+
+    class B(Base):
+        pass
+    register(B)
+
+    assert isinstance(create("A"), A)          # class name
+    assert isinstance(create("alpha"), A)      # alias, case-insensitive
+    assert isinstance(create("FIRST"), A)
+    assert isinstance(create("b"), B)
+    inst = create('{"type": "b", "x": 7}')     # JSON config form
+    assert isinstance(inst, B) and inst.x == 7
+    got = create(inst)                         # instance pass-through
+    assert got is inst
+    with pytest.raises(ValueError):
+        create("nope")
+    with pytest.raises(AssertionError):
+        register(dict)  # not a subclass
+
+
+def test_executor_namespace_and_parity_members():
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    assert mx.executor.Executor is mx.symbol.Executor
+    x = mx.sym.var("data")
+    w = mx.sym.var("w")
+    out = mx.sym.FullyConnected(x, w, mx.sym.var("b"), num_hidden=3)
+    ex = out.bind(args={"data": nd.array(np.ones((2, 4), np.float32)),
+                        "w": nd.array(np.zeros((3, 4), np.float32)),
+                        "b": nd.array(np.zeros(3, np.float32))})
+    assert ex.aux_dict == {}
+    ex.copy_params_from({"w": nd.array(np.ones((3, 4), np.float32))},
+                        allow_extra_params=False)
+    o = ex.forward()[0]
+    np.testing.assert_allclose(o.asnumpy(), np.full((2, 3), 4.0), rtol=1e-6)
+    # reshape returns a rebindable executor at the new shape
+    ex2 = ex.reshape(data=(5, 4))
+    assert ex2.arg_dict["data"].shape == (5, 4)
+    assert ex2.forward()[0].shape == (5, 3)
+
+
+def test_libinfo_and_kvstore_server():
+    import pytest
+
+    import mxnet_tpu as mx
+
+    assert mx.libinfo.__version__.startswith("1.9")
+    paths = mx.libinfo.find_lib_path()
+    # the repo builds its native helpers — discovery must actually find them
+    assert paths and all(p.endswith(".so") for p in paths)
+    with pytest.raises(RuntimeError, match="collectives"):
+        mx.kvstore_server.KVStoreServer()

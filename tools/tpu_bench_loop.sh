@@ -22,8 +22,9 @@ while true; do
     # serialize against CPU-heavy work: a concurrent full pytest run slows
     # host-side build/dispatch 3-5x and would depress every timed number
     # anchored: the harness driver's cmdline CONTAINS 'python -m pytest'
-    # as prose, so an unanchored pattern would wait on it forever
-    while pgrep -f "^[^ ]*python[^ ]* -m pytest" >/dev/null 2>&1; do
+    # as prose, so an unanchored pattern would wait on it forever; cover
+    # both 'python -m pytest' and the bare 'pytest' console script
+    while pgrep -f "^[^ ]*python[^ ]* (-m pytest|[^ ]*/pytest) " >/dev/null 2>&1; do
       echo "[loop] $(date -u +%T) relay up but a test suite is running; waiting 60s"
       sleep 60
     done

@@ -9,9 +9,6 @@ dispatch.
 """
 from __future__ import annotations
 
-import queue
-import threading
-
 import numpy as np
 
 from ...ndarray import NDArray, array
@@ -63,23 +60,33 @@ class DataLoader:
         yield from self._prefetch_iter()
 
     def _prefetch_iter(self):
-        q = queue.Queue(maxsize=self._prefetch)
-        sentinel = object()
+        """num_workers batches build CONCURRENTLY on a thread pool (numpy /
+        PIL decode release the GIL, so threads genuinely parallelize the
+        transform work upstream forks processes for), with a bounded
+        in-flight window and strict batch order: futures are consumed
+        oldest-first, refilling before each blocking wait."""
+        from concurrent.futures import ThreadPoolExecutor
+        from collections import deque
 
-        def worker():
-            try:
-                for indices in self._batch_sampler:
-                    q.put(self._make_batch(indices))
-            finally:
-                q.put(sentinel)
-
-        t = threading.Thread(target=worker, daemon=True)
-        t.start()
-        while True:
-            item = q.get()
-            if item is sentinel:
-                break
-            yield item
+        window = max(self._prefetch, self._num_workers)
+        pool = ThreadPoolExecutor(self._num_workers)
+        try:
+            futs = deque()
+            it = iter(self._batch_sampler)
+            for indices in it:
+                futs.append(pool.submit(self._make_batch, indices))
+                if len(futs) >= window:
+                    break
+            while futs:
+                f = futs.popleft()
+                nxt = next(it, None)
+                if nxt is not None:
+                    futs.append(pool.submit(self._make_batch, nxt))
+                yield f.result()
+        finally:
+            # an early `break` in the consumer must not stall on the whole
+            # in-flight window finishing its (possibly expensive) batches
+            pool.shutdown(wait=False, cancel_futures=True)
 
     def __len__(self):
         return len(self._batch_sampler)

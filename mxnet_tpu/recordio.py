@@ -131,8 +131,10 @@ class MXIndexedRecordIO(MXRecordIO):
         self._f.seek(self.idx[idx])
 
     def read_idx(self, idx):
-        self.seek(idx)
-        return self.read()
+        # atomic seek+read: DataLoader's thread-pool prefetch calls this
+        # concurrently on the shared handle, and an interleaved seek would
+        # hand this reader another record's bytes
+        return self.read_at(self.idx[idx])
 
     def write_idx(self, idx, buf):
         key = self.key_type(idx)

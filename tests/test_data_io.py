@@ -622,3 +622,55 @@ def test_csviter_native_path(tmp_path):
     np.testing.assert_allclose(b.data[0].asnumpy(),
                                data[:4].reshape(4, 2, 3), rtol=1e-6)
     np.testing.assert_allclose(b.label[0].asnumpy(), label[:4], rtol=1e-6)
+
+
+def test_dataloader_thread_pool_order_and_concurrency():
+    """num_workers>1 builds batches on several threads but yields them in
+    sampler order."""
+    import threading
+
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    import time
+
+    n = 64
+    xs = np.arange(n, dtype=np.float32).reshape(n, 1)
+    seen_threads = set()
+
+    class Spy(ArrayDataset):
+        def __getitem__(self, i):
+            seen_threads.add(threading.get_ident())
+            time.sleep(0.001)  # keep the queue non-empty so fan-out is real
+            return super().__getitem__(i)
+
+    loader = DataLoader(Spy(xs), batch_size=4, shuffle=False, num_workers=4)
+    out = np.concatenate([b.asnumpy() for b in loader])
+    np.testing.assert_array_equal(out, xs)  # strict order preserved
+    assert len(seen_threads) > 1  # work actually fanned out
+    # second epoch over the same loader works (fresh pool per epoch)
+    out2 = np.concatenate([b.asnumpy() for b in loader])
+    np.testing.assert_array_equal(out2, xs)
+
+
+def test_record_dataset_concurrent_readers(tmp_path):
+    """RecordFileDataset through a multi-worker DataLoader: concurrent
+    read_idx on the shared handle must stay record-atomic (regression for
+    the seek/read interleave race)."""
+    from mxnet_tpu import recordio
+    from mxnet_tpu.gluon.data import DataLoader
+    from mxnet_tpu.gluon.data.dataset import RecordFileDataset
+
+    rec = recordio.MXIndexedRecordIO(str(tmp_path / "t.idx"),
+                                     str(tmp_path / "t.rec"), "w")
+    n = 200
+    for i in range(n):
+        rec.write_idx(i, (b"%05d" % i) * 40)
+    rec.close()
+
+    ds = RecordFileDataset(str(tmp_path / "t.rec"))
+    loader = DataLoader(ds, batch_size=8, num_workers=8,
+                        batchify_fn=lambda recs: list(recs))
+    got = [r for batch in loader for r in batch]
+    assert len(got) == n
+    for i, r in enumerate(got):
+        assert r == (b"%05d" % i) * 40, "record %d corrupted/reordered" % i

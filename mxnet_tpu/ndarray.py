@@ -363,34 +363,52 @@ def _is_diff(x):
     return isinstance(x, NDArray) and jnp.issubdtype(x.dtype, jnp.inexact)
 
 
-_FAST_JIT = {}  # opname -> jitted fn with no static kwargs
+_FAST_JIT = {}  # opname -> jitted fn (the no-kwargs hot path)
+
+
+_profiler_mod = None  # lazy: profiler imports after ndarray in package init
 
 
 def invoke(opname, args, kwargs):
     """Imperative op invocation: unwrap → (record vjp | cached jit) → wrap.
     When the profiler runs, each dispatch is recorded as an 'operator' event
     (ref: MXNet profiler operator events from the engine)."""
-    from . import profiler as _profiler
-    if _profiler._running and _profiler._config["profile_imperative"]:
-        with _profiler.op_scope(opname):
+    global _profiler_mod
+    if _profiler_mod is None:
+        # cache the module object: a `from . import` here costs ~1us of
+        # importlib machinery on EVERY op dispatch
+        from . import profiler as _profiler_mod
+    if _profiler_mod._running and _profiler_mod._config["profile_imperative"]:
+        with _profiler_mod.op_scope(opname):
             return _invoke_impl(opname, args, kwargs)
     return _invoke_impl(opname, args, kwargs)
 
 
 def _invoke_impl(opname, args, kwargs):
     opdef = OP_REGISTRY[opname]
-    # fast path: attr-less call outside recording — the per-op hot loop
-    # (MXNet equivalent: cached-op handle lookup skipping full FFI parse).
+    # fast path: call outside recording — the per-op hot loop (MXNet
+    # equivalent: cached-op handle lookup skipping full FFI parse).
     # Skipped for rng/training ops (key injection) and multi-output ops.
-    if (not kwargs and opdef.n_outputs == 1 and not opdef.needs_rng
-            and not opdef.needs_training and not autograd.is_recording()):
-        f = _FAST_JIT.get(opname)
-        if f is None:
-            f = _FAST_JIT[opname] = jax.jit(opdef.fn)
-        out = f(*[a._data if type(a) is NDArray else a for a in args])
-        if isinstance(out, jax.Array):
-            return NDArray(out)
-        return jax.tree_util.tree_map(NDArray, out)
+    fast = (opdef.n_outputs == 1 and not opdef.needs_rng
+            and not opdef.needs_training and not autograd.is_recording())
+    if fast:
+        if not kwargs:
+            f = _FAST_JIT.get(opname)
+            if f is None:
+                f = _FAST_JIT[opname] = jax.jit(opdef.fn)
+        elif "out" not in kwargs and not any(
+                k in opdef.array_kwargs or isinstance(v, (NDArray, jax.Array))
+                for k, v in kwargs.items()):
+            # static kwargs (axis=1, keepdims=True, even axis=[0,1]) reuse
+            # base.jitted's cache — one jit cache for fast AND slow paths
+            f = jitted(opdef.fn, kwargs)
+        else:
+            f = None
+        if f is not None:
+            out = f(*[a._data if type(a) is NDArray else a for a in args])
+            if isinstance(out, jax.Array):
+                return NDArray(out)
+            return jax.tree_util.tree_map(NDArray, out)
     fn = opdef.fn
     kwargs = dict(kwargs)
     out_arr = kwargs.pop("out", None)

@@ -76,9 +76,9 @@ class BERTEncoderCell(HybridBlock):
         super().__init__(**kwargs)
         with self.name_scope():
             self.attention = BERTAttention(units, num_heads, dropout)
-            self.ln1 = nn.LayerNorm(in_channels=units)
+            self.ln1 = nn.LayerNorm(in_channels=units, epsilon=1e-12)
             self.ffn = BERTPositionwiseFFN(units, hidden_size, dropout)
-            self.ln2 = nn.LayerNorm(in_channels=units)
+            self.ln2 = nn.LayerNorm(in_channels=units, epsilon=1e-12)
 
     def hybrid_forward(self, F, x, mask=None):
         x = self.ln1(x + self.attention(x, mask))
@@ -97,7 +97,7 @@ class BERTEncoder(HybridBlock):
                                                    shape=(max_length, units),
                                                    init=init_mod.Normal(0.02))
             self.dropout = nn.Dropout(dropout) if dropout else None
-            self.ln = nn.LayerNorm(in_channels=units)
+            self.ln = nn.LayerNorm(in_channels=units, epsilon=1e-12)
             self.cells = nn.HybridSequential(prefix="")
             for i in range(num_layers):
                 self.cells.add(BERTEncoderCell(units, hidden_size, num_heads,
@@ -143,7 +143,7 @@ class BERTModel(HybridBlock):
                 self.decoder_transform = nn.Dense(units, activation="gelu",
                                                   flatten=False, in_units=units,
                                                   prefix="mlm_transform_")
-                self.decoder_ln = nn.LayerNorm(in_channels=units)
+                self.decoder_ln = nn.LayerNorm(in_channels=units, epsilon=1e-12)
                 self.decoder_bias = self.params.get("decoder_bias", shape=(vocab_size,),
                                                     init=init_mod.Zero())
             if use_classifier:

@@ -395,7 +395,9 @@ def _invoke_impl(opname, args, kwargs):
         if not kwargs:
             f = _FAST_JIT.get(opname)
             if f is None:
-                f = _FAST_JIT[opname] = jax.jit(opdef.fn)
+                # seed from base.jitted so the slow path's out= branch
+                # reuses the very same compiled callable
+                f = _FAST_JIT[opname] = jitted(opdef.fn, {})
         elif "out" not in kwargs and not any(
                 k in opdef.array_kwargs or isinstance(v, (NDArray, jax.Array))
                 for k, v in kwargs.items()):

@@ -1,0 +1,185 @@
+"""External-oracle model fidelity: our BERT vs HuggingFace transformers'
+BertModel (torch CPU) with transplanted weights — an independent
+implementation of the same architecture (ref: gluonnlp bert.py:BERTModel,
+which matches google-research/bert like HF does)."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+CFG = dict(vocab_size=97, hidden_size=32, num_hidden_layers=2,
+           num_attention_heads=4, intermediate_size=64,
+           max_position_embeddings=16, type_vocab_size=2,
+           hidden_act="gelu", hidden_dropout_prob=0.0,
+           attention_probs_dropout_prob=0.0, layer_norm_eps=1e-12)
+
+
+def _set(p, t):
+    from mxnet_tpu.ndarray import NDArray
+    import jax.numpy as jnp
+
+    arr = t.detach().numpy().astype(np.float32)
+    assert tuple(p.shape) == arr.shape, (p.name, p.shape, arr.shape)
+    p.set_data(NDArray(jnp.asarray(arr)))
+
+
+def _transplant(model, hf):
+    """HF BertModel state → our BERTModel params (fused qkv = [q;k;v] rows,
+    matching the (3, H, D) head split in BERTAttention)."""
+    sd = dict(hf.named_parameters())
+    _set(model.word_embed.weight, sd["embeddings.word_embeddings.weight"])
+    _set(model.token_type_embed.weight,
+         sd["embeddings.token_type_embeddings.weight"])
+    _set(model.encoder.position_weight,
+         sd["embeddings.position_embeddings.weight"])
+    _set(model.encoder.ln.gamma, sd["embeddings.LayerNorm.weight"])
+    _set(model.encoder.ln.beta, sd["embeddings.LayerNorm.bias"])
+    for i, cell in enumerate(model.encoder.cells):
+        pre = "encoder.layer.%d." % i
+        qw = sd[pre + "attention.self.query.weight"]
+        kw = sd[pre + "attention.self.key.weight"]
+        vw = sd[pre + "attention.self.value.weight"]
+        _set(cell.attention.qkv.weight, torch.cat([qw, kw, vw], dim=0))
+        _set(cell.attention.qkv.bias, torch.cat(
+            [sd[pre + "attention.self.query.bias"],
+             sd[pre + "attention.self.key.bias"],
+             sd[pre + "attention.self.value.bias"]], dim=0))
+        _set(cell.attention.attn_out.weight,
+             sd[pre + "attention.output.dense.weight"])
+        _set(cell.attention.attn_out.bias,
+             sd[pre + "attention.output.dense.bias"])
+        _set(cell.ln1.gamma, sd[pre + "attention.output.LayerNorm.weight"])
+        _set(cell.ln1.beta, sd[pre + "attention.output.LayerNorm.bias"])
+        _set(cell.ffn.ffn_1.weight, sd[pre + "intermediate.dense.weight"])
+        _set(cell.ffn.ffn_1.bias, sd[pre + "intermediate.dense.bias"])
+        _set(cell.ffn.ffn_2.weight, sd[pre + "output.dense.weight"])
+        _set(cell.ffn.ffn_2.bias, sd[pre + "output.dense.bias"])
+        _set(cell.ln2.gamma, sd[pre + "output.LayerNorm.weight"])
+        _set(cell.ln2.beta, sd[pre + "output.LayerNorm.bias"])
+    _set(model.pooler.weight, sd["pooler.dense.weight"])
+    _set(model.pooler.bias, sd["pooler.dense.bias"])
+
+
+def test_bert_matches_transformers():
+    from mxnet_tpu import nd
+    from mxnet_tpu.models.bert import BERTModel
+
+    torch.manual_seed(0)
+    hf = transformers.BertModel(transformers.BertConfig(**CFG))
+    hf.eval()
+
+    model = BERTModel(vocab_size=CFG["vocab_size"], token_type_vocab_size=2,
+                      units=32, hidden_size=64, num_layers=2, num_heads=4,
+                      dropout=0.0, max_length=16, use_decoder=False,
+                      use_classifier=False)
+    model.initialize()
+    rng = np.random.default_rng(0)
+    B, T = 3, 12
+    tok = rng.integers(0, CFG["vocab_size"], (B, T))
+    tt = rng.integers(0, 2, (B, T))
+    # warm the deferred params, then transplant
+    model(nd.array(tok.astype(np.int32)), nd.array(tt.astype(np.int32)),
+          nd.array(np.full(B, T, np.float32)))
+    _transplant(model, hf)
+
+    seq, pooled = model(nd.array(tok.astype(np.int32)),
+                        nd.array(tt.astype(np.int32)),
+                        nd.array(np.full(B, T, np.float32)))
+    with torch.no_grad():
+        ref = hf(input_ids=torch.tensor(tok), token_type_ids=torch.tensor(tt))
+    np.testing.assert_allclose(seq.asnumpy(), ref.last_hidden_state.numpy(),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(pooled.asnumpy(), ref.pooler_output.numpy(),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_bert_matches_transformers_with_padding():
+    """valid_length masking == HF attention_mask semantics."""
+    from mxnet_tpu import nd
+    from mxnet_tpu.models.bert import BERTModel
+
+    torch.manual_seed(1)
+    hf = transformers.BertModel(transformers.BertConfig(**CFG))
+    hf.eval()
+    model = BERTModel(vocab_size=CFG["vocab_size"], token_type_vocab_size=2,
+                      units=32, hidden_size=64, num_layers=2, num_heads=4,
+                      dropout=0.0, max_length=16, use_decoder=False,
+                      use_classifier=False)
+    model.initialize()
+    rng = np.random.default_rng(1)
+    B, T = 2, 10
+    lengths = np.array([10, 6])
+    tok = rng.integers(0, CFG["vocab_size"], (B, T))
+    tt = np.zeros((B, T), np.int64)
+    model(nd.array(tok.astype(np.int32)), nd.array(tt.astype(np.int32)),
+          nd.array(lengths.astype(np.float32)))
+    _transplant(model, hf)
+
+    seq, _ = model(nd.array(tok.astype(np.int32)),
+                   nd.array(tt.astype(np.int32)),
+                   nd.array(lengths.astype(np.float32)))
+    amask = (np.arange(T)[None, :] < lengths[:, None]).astype(np.int64)
+    with torch.no_grad():
+        ref = hf(input_ids=torch.tensor(tok),
+                 token_type_ids=torch.tensor(tt),
+                 attention_mask=torch.tensor(amask))
+    # compare only VALID positions (padded rows see different garbage)
+    for b in range(B):
+        L = lengths[b]
+        np.testing.assert_allclose(seq.asnumpy()[b, :L],
+                                   ref.last_hidden_state.numpy()[b, :L],
+                                   rtol=2e-4, atol=2e-5)
+
+
+GPT_CFG = dict(vocab_size=89, n_positions=16, n_embd=32, n_layer=2, n_head=4,
+               resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+               activation_function="gelu", layer_norm_epsilon=1e-5)
+
+
+def test_gpt_matches_transformers():
+    """Our GPTModel vs HF GPT2Model with transplanted weights (HF Conv1D
+    stores (in, out) — transposed into our Dense (out, in); the fused
+    c_attn column order [q|k|v] matches our qkv row order after the
+    transpose)."""
+    from mxnet_tpu import nd
+    from mxnet_tpu.models.gpt import GPTModel
+
+    torch.manual_seed(2)
+    hf = transformers.GPT2LMHeadModel(transformers.GPT2Config(**GPT_CFG))
+    hf.eval()
+    model = GPTModel(vocab_size=GPT_CFG["vocab_size"], units=32, num_layers=2,
+                     num_heads=4, max_length=16, dropout=0.0)
+    model.initialize()
+    rng = np.random.default_rng(2)
+    B, T = 3, 11
+    tok = rng.integers(0, GPT_CFG["vocab_size"], (B, T))
+    model(nd.array(tok.astype(np.int32)))  # materialize deferred shapes
+
+    sd = dict(hf.named_parameters())
+    sd = {k[len("transformer."):] if k.startswith("transformer.") else k: v
+          for k, v in sd.items()}
+    _set(model.word_embed.weight, sd["wte.weight"])
+    _set(model.pos_embed.weight, sd["wpe.weight"])
+    for i, blk in enumerate(model.blocks):
+        pre = "h.%d." % i
+        _set(blk.ln1.gamma, sd[pre + "ln_1.weight"])
+        _set(blk.ln1.beta, sd[pre + "ln_1.bias"])
+        _set(blk.attn.qkv.weight, sd[pre + "attn.c_attn.weight"].T)
+        _set(blk.attn.qkv.bias, sd[pre + "attn.c_attn.bias"])
+        _set(blk.attn.attn_out.weight, sd[pre + "attn.c_proj.weight"].T)
+        _set(blk.attn.attn_out.bias, sd[pre + "attn.c_proj.bias"])
+        _set(blk.ln2.gamma, sd[pre + "ln_2.weight"])
+        _set(blk.ln2.beta, sd[pre + "ln_2.bias"])
+        _set(blk.ffn_1.weight, sd[pre + "mlp.c_fc.weight"].T)
+        _set(blk.ffn_1.bias, sd[pre + "mlp.c_fc.bias"])
+        _set(blk.ffn_2.weight, sd[pre + "mlp.c_proj.weight"].T)
+        _set(blk.ffn_2.bias, sd[pre + "mlp.c_proj.bias"])
+    _set(model.ln_f.gamma, sd["ln_f.weight"])
+    _set(model.ln_f.beta, sd["ln_f.bias"])
+
+    logits = model(nd.array(tok.astype(np.int32)))  # tied LM head == HF's
+    with torch.no_grad():
+        ref = hf(input_ids=torch.tensor(tok)).logits.numpy()
+    np.testing.assert_allclose(logits.asnumpy(), ref, rtol=2e-4, atol=2e-4)

@@ -4,7 +4,8 @@ from .mesh import (make_mesh, named_sharding, replicated, use_mesh,  # noqa: F40
 from .data_parallel import (build_train_step, tree_optimizer_step,  # noqa: F401
                             replicate_params, shard_batch, block_loss_fn)
 from . import tensor_parallel  # noqa: F401
-from .tensor_parallel import shard_params, param_specs, constrain  # noqa: F401
+from .tensor_parallel import (shard_params, param_specs, constrain,  # noqa: F401
+                              psum_region_entry, psum_region_exit)
 from .ring_attention import ring_attention, full_attention  # noqa: F401
 from .ulysses import ulysses_attention  # noqa: F401
 from .pipeline import (pipeline_apply, pipeline_apply_interleaved,  # noqa: F401

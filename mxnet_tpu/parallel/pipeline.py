@@ -173,7 +173,8 @@ def pipeline_apply_interleaved(stage_fn, stage_params, microbatches, mesh,
 
 
 def pipeline_train_step_1f1b(stage_fn, loss_fn, stage_params, microbatches,
-                             targets, mesh, axis_name="pp"):
+                             targets, mesh, axis_name="pp",
+                             batch_axis=None, param_spec=None):
     """One-forward-one-backward (PipeDream-flush) pipelined training step.
 
     Unlike the GPipe schedule above (all forwards, then differentiate through
@@ -190,9 +191,20 @@ def pipeline_train_step_1f1b(stage_fn, loss_fn, stage_params, microbatches,
     stage_fn(params, x) -> y with y.shape == x.shape (uniform stages);
     loss_fn(y, target) -> scalar (per-microbatch mean).
     stage_params: leaves (n_stages, ...) sharded over `axis_name`.
-    microbatches: (n_micro, mb, ...); targets: (n_micro, ...) replicated.
+    microbatches: (n_micro, mb, ...); targets: (n_micro, ...) replicated —
+    except with ``batch_axis``, where BOTH microbatches and targets must be
+    (n_micro, mb, ...) with mb divisible by the data-axis size (they shard
+    together along axis 1).
     Returns (loss, grads) — loss the scalar mean over microbatches, grads
     stacked (n_stages, ...) like stage_params.
+
+    COMPOSITION (Megatron-style dp x tp x pp on ONE mesh): pass
+    ``batch_axis="dp"`` to shard the per-microbatch batch dim over a data
+    axis (loss/grads pmean over it — each dp rank pipelines its slice of
+    every microbatch), and ``param_spec`` (a pytree of PartitionSpecs whose
+    leading dim is `axis_name`) to ALSO shard stage weights over a tensor
+    axis; stage_fn then closes the tp math with its own lax.psum("tp"),
+    exactly like a non-pipelined tp layer.
     """
     sm = get_shard_map()
     n_micro = microbatches.shape[0]
@@ -268,10 +280,30 @@ def pipeline_train_step_1f1b(stage_fn, loss_fn, stage_params, microbatches,
         carry, _ = lax.scan(tick, init, None, length=ticks)
         loss_sum, gparams = carry[-2], carry[-1]
         loss = lax.psum(loss_sum, axis_name) / n_micro
+        if batch_axis is not None:
+            # every dp rank pipelined an equal batch slice of each
+            # microbatch; per-microbatch loss_fn means over the local
+            # slice, so the global numbers are the dp-mean
+            loss = lax.pmean(loss, batch_axis)
+            gparams = jax.tree_util.tree_map(
+                lambda g: lax.pmean(g, batch_axis), gparams)
         gparams = jax.tree_util.tree_map(lambda g: g[None], gparams)
         return loss, gparams
 
-    pspec = jax.tree_util.tree_map(lambda _: P(axis_name), stage_params,
-                                   is_leaf=lambda a: hasattr(a, "shape"))
-    f = sm(local, mesh, in_specs=(pspec, P(), P()), out_specs=(P(), pspec))
+    if param_spec is not None:
+        # every leaf must shard its leading (stage) dim over axis_name, or
+        # the per-rank `a[0]` below would silently run stage 0's weights on
+        # every pipeline stage
+        for spec in jax.tree_util.tree_leaves(
+                param_spec, is_leaf=lambda s: isinstance(s, P)):
+            if not len(spec) or spec[0] != axis_name:
+                raise ValueError(
+                    "param_spec leaf %r must lead with %r (the stage dim)"
+                    % (spec, axis_name))
+    pspec = param_spec if param_spec is not None else \
+        jax.tree_util.tree_map(lambda _: P(axis_name), stage_params,
+                               is_leaf=lambda a: hasattr(a, "shape"))
+    bspec = P(None, batch_axis) if batch_axis is not None else P()
+    f = sm(local, mesh, in_specs=(pspec, bspec, bspec),
+           out_specs=(P(), pspec))
     return f(stage_params, microbatches, targets)

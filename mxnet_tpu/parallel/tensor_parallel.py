@@ -9,8 +9,55 @@ from __future__ import annotations
 
 import re
 
+import functools
+
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def psum_region_exit(x, axis_name):
+    """Megatron row-parallel exit INSIDE shard_map: psum forward, IDENTITY
+    backward (the `g` operator of Megatron-LM fig. 3).
+
+    Needed because shard_map's raw ``lax.psum`` transposes to psum — when
+    every tp rank then computes the (replicated) loss redundantly, params
+    upstream of the collective would see grads multiplied by the tp size.
+    With identity backward, each rank keeps exactly its own cotangent copy,
+    which is the mathematically-single loss's gradient."""
+    return jax.lax.psum(x, axis_name)
+
+
+def _pre_exit_fwd(x, axis_name):
+    return jax.lax.psum(x, axis_name), None
+
+
+def _pre_exit_bwd(axis_name, _, g):
+    return (g,)
+
+
+psum_region_exit.defvjp(_pre_exit_fwd, _pre_exit_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def psum_region_entry(x, axis_name):
+    """Megatron column-parallel entry INSIDE shard_map: IDENTITY forward,
+    psum backward (the `f` operator). The region input is replicated over
+    tp; each rank's local math contributes only a PARTIAL input-cotangent,
+    so the true dx is their sum — without this, whatever sits upstream
+    (the previous pipeline stage, an embedding) gets rank-local partials."""
+    return x
+
+
+def _pre_entry_fwd(x, axis_name):
+    return x, None
+
+
+def _pre_entry_bwd(axis_name, _, g):
+    return (jax.lax.psum(g, axis_name),)
+
+
+psum_region_entry.defvjp(_pre_entry_fwd, _pre_entry_bwd)
 
 # BERT/Transformer sharding rules: param-name regex → PartitionSpec.
 # Dense weights are (out, in) as in MXNet FullyConnected.

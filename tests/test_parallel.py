@@ -530,3 +530,65 @@ def test_sequence_parallel_scope_gpt_matches_unsharded():
     with parallel.sequence_parallel_scope(mesh2, impl="ulysses"):
         u_l, _ = jax.value_and_grad(loss)(params, toks)
     np.testing.assert_allclose(float(u_l), float(ref_l), rtol=1e-5)
+
+
+def test_dp_tp_pp_composed_3d_mesh_matches_reference():
+    """FULL Megatron-style composition on ONE {dp:2, tp:2, pp:2} mesh:
+    microbatch rows sharded over dp, stage weights column/row-split over tp
+    (stage_fn closes with psum), stages over pp riding the 1F1B ring —
+    loss AND stacked grads must match the unsharded single-device oracle."""
+    from jax import lax
+
+    S, M, MB, U, H_ = 2, 5, 4, 4, 8  # stages, microbatches, rows, widths
+    mesh = parallel.make_mesh({"dp": 2, "tp": 2, "pp": 2})
+
+    from mxnet_tpu.parallel.tensor_parallel import (psum_region_entry,
+                                                    psum_region_exit)
+
+    def stage_fn(params, x):
+        x = psum_region_entry(x, "tp")  # Megatron `f`: dx sums over tp
+        h = jnp.tanh(x @ params["w1"] + params["b1"])  # w1 cols over tp
+        y = h @ params["w2"]                           # w2 rows over tp
+        # Megatron `g`: psum fwd, identity bwd (raw lax.psum would double
+        # the upstream grads under the per-rank redundant loss)
+        return psum_region_exit(y, "tp") + params["b2"]
+
+    def stage_fn_ref(params, x):
+        h = jnp.tanh(x @ params["w1"] + params["b1"])
+        return h @ params["w2"] + params["b2"]
+
+    def loss_fn(y, t):
+        return jnp.mean((y - t) ** 2)
+
+    rng = np.random.default_rng(5)
+    per_stage = [{
+        "w1": jnp.asarray(rng.normal(size=(U, H_)) * 0.4, jnp.float32),
+        "b1": jnp.zeros((H_,), jnp.float32),
+        "w2": jnp.asarray(rng.normal(size=(H_, U)) * 0.4, jnp.float32),
+        "b2": jnp.zeros((U,), jnp.float32),
+    } for _ in range(S)]
+    stacked = parallel.stack_stage_params(per_stage)
+    xs = jnp.asarray(rng.normal(size=(M, MB, U)), jnp.float32)
+    tg = jnp.asarray(rng.normal(size=(M, MB, U)), jnp.float32)
+
+    param_spec = {"w1": P("pp", None, "tp"), "b1": P("pp", "tp"),
+                  "w2": P("pp", "tp", None), "b2": P("pp")}
+    loss, grads = parallel.pipeline_train_step_1f1b(
+        stage_fn, loss_fn, stacked, xs, tg, mesh,
+        batch_axis="dp", param_spec=param_spec)
+
+    def ref_loss(stacked_params):
+        def one(x, t):
+            y = x
+            for i in range(S):
+                p = jax.tree_util.tree_map(lambda a: a[i], stacked_params)
+                y = stage_fn_ref(p, y)
+            return loss_fn(y, t)
+
+        return jnp.mean(jax.vmap(one)(xs, tg))
+
+    ref_l, ref_g = jax.value_and_grad(ref_loss)(stacked)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(ref_l), rtol=1e-5)
+    for k in ("w1", "b1", "w2", "b2"):
+        np.testing.assert_allclose(np.asarray(grads[k]), np.asarray(ref_g[k]),
+                                   atol=2e-5)

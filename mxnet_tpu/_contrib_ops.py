@@ -19,4 +19,7 @@ CONTRIB_OPS = {
     "BilinearResize2D": "BilinearResize2D",
     "Proposal": "Proposal",
     "MultiProposal": "MultiProposal",
+    "ROIAlign": "ROIAlign",
+    "ROIPooling": "ROIPooling",
+    "bipartite_matching": "bipartite_matching",
 }

@@ -374,3 +374,50 @@ def onnx_scatter_nd(data, indices, updates):
     # add-of-delta instead of set: pad rows all alias index 0 and must not
     # clobber a real update that also targets it
     return data.at[coords].add(delta)
+
+
+@register_op("bipartite_matching", n_outputs=2, nondiff=True)
+def bipartite_matching(x, *, threshold, is_ascend=False, topk=-1):
+    """Greedy global bipartite matching over a (B, N, M) score matrix
+    (ref: src/operator/contrib/bounding_box.cc:BipartiteMatching — the
+    GluonCV SSD/matcher primitive).
+
+    Repeatedly takes the globally best unused (row, col) edge whose score
+    passes ``threshold`` (>= when descending, <= when is_ascend) and pairs
+    them off. Returns (row_match (B, N) col index or -1,
+    col_match (B, M) row index or -1), float32 like upstream. ``topk`` > 0
+    caps the number of matches per batch row. Static shapes: the greedy
+    loop is a lax.fori_loop of min(N, M) [or topk] steps, so the whole op
+    jits once per shape — where upstream runs a CPU/GPU kernel with a
+    data-dependent loop."""
+    sign = 1.0 if is_ascend else -1.0
+
+    def one(s):
+        N, M = s.shape
+        steps = min(N, M) if topk <= 0 else min(topk, min(N, M))
+        keyed = s * sign  # minimize keyed == extremize s per direction
+        # explicit availability mask (not an inf sentinel in keyed): legit
+        # +/-inf scores stay matchable, and an exhausted matrix just
+        # no-ops the remaining loop steps instead of stalling on one cell
+        avail0 = ((s <= threshold) if is_ascend else (s >= threshold)) \
+            & ~jnp.isnan(s)
+
+        def step(_, carry):
+            avail, rm, cm = carry
+            masked = jnp.where(avail, keyed, jnp.inf)
+            flat = jnp.argmin(masked)
+            r, c = flat // M, flat % M
+            valid = avail[r, c]
+            rm = jnp.where(valid, rm.at[r].set(c.astype(jnp.float32)), rm)
+            cm = jnp.where(valid, cm.at[c].set(r.astype(jnp.float32)), cm)
+            avail = jnp.where(valid,
+                              avail.at[r, :].set(False).at[:, c].set(False),
+                              avail)
+            return avail, rm, cm
+
+        rm = jnp.full((N,), -1.0, jnp.float32)
+        cm = jnp.full((M,), -1.0, jnp.float32)
+        _, rm, cm = jax.lax.fori_loop(0, steps, step, (avail0, rm, cm))
+        return rm, cm
+
+    return jax.vmap(one)(x)

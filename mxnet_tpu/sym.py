@@ -6,12 +6,18 @@ import sys as _sys
 
 from .base import OP_REGISTRY as _REG
 from . import sym_contrib as contrib  # noqa: F401
-from .symbol import Symbol, var, Variable, Group, cond, _make  # noqa: F401
+from .symbol import (Symbol, var, Variable, Group, cond, _make,  # noqa: F401
+                     load)
 
 _mod = _sys.modules[__name__]
 
 
-_VISIBLE_SINGLE = {"BatchNorm"}  # multi-output ops upstream exposes as one
+# multi-output ops upstream exposes as one visible output — resolved by
+# OpDef IDENTITY so registry aliases (batch_norm) behave like their
+# CamelCase twins instead of silently diverging
+_VISIBLE_SINGLE = {n for n in _REG
+                   for v in ("BatchNorm",)
+                   if v in _REG and _REG[n] is _REG[v]}
 
 _TENSOR_SLOTS = {}  # opname -> (names of positional tensor params, required count)
 _NEVER_AUTO = {"key", "training", "out"}  # injected/internal, never a param var

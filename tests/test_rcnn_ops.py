@@ -198,3 +198,42 @@ def test_multi_proposal_alias():
                                       nd.array(im_info), **kw)
     np.testing.assert_allclose(r1.asnumpy(), r2.asnumpy())
     np.testing.assert_allclose(s1.asnumpy(), s2.asnumpy())
+
+
+def test_bipartite_matching_vs_numpy_oracle():
+    """Greedy global matcher == a straightforward numpy greedy loop
+    (ref: src/operator/contrib/bounding_box.cc)."""
+    import numpy as np
+
+    from mxnet_tpu import nd
+
+    rng = np.random.default_rng(7)
+    B, N, M = 3, 6, 4
+    x = rng.uniform(0, 1, (B, N, M)).astype(np.float32)
+
+    def oracle(s, threshold, is_ascend=False, topk=-1):
+        s = s.copy()
+        N, M = s.shape
+        rm = np.full(N, -1.0, np.float32)
+        cm = np.full(M, -1.0, np.float32)
+        steps = min(N, M) if topk <= 0 else min(topk, min(N, M))
+        for _ in range(steps):
+            best = s.min() if is_ascend else s.max()
+            if is_ascend and best > threshold:
+                break
+            if not is_ascend and best < threshold:
+                break
+            r, c = np.unravel_index(
+                s.argmin() if is_ascend else s.argmax(), s.shape)
+            rm[r], cm[c] = c, r
+            s[r, :] = np.inf if is_ascend else -np.inf
+            s[:, c] = np.inf if is_ascend else -np.inf
+        return rm, cm
+
+    for kw in ({"threshold": 0.3}, {"threshold": 0.3, "is_ascend": True},
+               {"threshold": 0.2, "topk": 2}, {"threshold": 0.99}):
+        rm, cm = nd.contrib.bipartite_matching(nd.array(x), **kw)
+        for b in range(B):
+            orm, ocm = oracle(x[b], **kw)
+            np.testing.assert_array_equal(rm.asnumpy()[b], orm, err_msg=str(kw))
+            np.testing.assert_array_equal(cm.asnumpy()[b], ocm, err_msg=str(kw))

@@ -1,0 +1,51 @@
+"""Keras-style training with gluon.contrib.estimator — the full
+event-handler workflow (ref: upstream gluon estimator examples).
+
+Runs on CPU or TPU; synthetic data so it needs no downloads:
+
+    python examples/train_estimator.py
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import Trainer, loss as gloss, nn
+from mxnet_tpu.gluon.contrib.estimator import (CheckpointHandler,
+                                               EarlyStoppingHandler,
+                                               Estimator, LoggingHandler)
+
+
+def make_data(n, seed):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 20)).astype(np.float32)
+    w = np.linspace(-1, 1, 20 * 5).reshape(20, 5).astype(np.float32)
+    y = (x @ w + 0.1 * rng.normal(size=(n, 5))).argmax(1)
+    return [(nd.array(x[i:i + 32]), nd.array(y[i:i + 32]))
+            for i in range(0, n, 32)]
+
+
+def main():
+    net = nn.Sequential()
+    net.add(nn.Dense(64, activation="relu"), nn.Dense(5))
+    net.initialize(mx.init.Xavier())
+
+    est = Estimator(net, gloss.SoftmaxCrossEntropyLoss(),
+                    train_metrics=mx.metric.Accuracy(),
+                    trainer=Trainer(net.collect_params(), "adam",
+                                    {"learning_rate": 1e-3}))
+    est.fit(make_data(2048, seed=0), val_data=make_data(512, seed=1),
+            epochs=20,
+            event_handlers=[
+                LoggingHandler(log_interval="epoch"),
+                CheckpointHandler("/tmp/est_ckpt", model_prefix="mlp",
+                                  save_best=True,
+                                  monitor="validation accuracy", mode="max",
+                                  max_checkpoints=3),
+                EarlyStoppingHandler(monitor="validation accuracy",
+                                     patience=5, mode="max"),
+            ])
+    print("final validation:", est.val_metrics[0].get())
+
+
+if __name__ == "__main__":
+    main()

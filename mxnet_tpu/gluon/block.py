@@ -30,6 +30,7 @@ from ..ndarray import NDArray
 from .parameter import Parameter, ParameterDict, DeferredInitializationError
 
 _naming = threading.local()
+_sym_trace = threading.local()  # .vars: {param name: sym var} during tracing
 
 
 def _auto_name(hint):
@@ -324,7 +325,16 @@ class HybridBlock(Block):
                 n: _sym.var(p.name,
                             shape=p.shape if p._shape_known() else None)
                 for n, p in self._reg_params.items()}
-            return self.hybrid_forward(_sym, *args, **pkwargs, **kwargs)
+            # flag the symbol trace for param_value (weight tying reaches
+            # CHILD-block params that aren't in this block's _reg_params)
+            prev = getattr(_sym_trace, "vars", None)
+            if prev is None:
+                _sym_trace.vars = {}
+            try:
+                return self.hybrid_forward(_sym, *args, **pkwargs, **kwargs)
+            finally:
+                if prev is None:
+                    _sym_trace.vars = None
 
         self._ensure_params(*args)
         if self._active:
@@ -450,11 +460,21 @@ class _NotReady(Exception):
 
 def param_value(param):
     """Mode-aware access to a Parameter's value: raw traced array inside a
-    hybridize trace, NDArray imperatively. Used for weight tying across
-    blocks (e.g. BERT's MLM decoder tied to word_embed)."""
+    hybridize trace, a named graph variable inside a SYMBOL trace (memoized
+    per name so repeated access yields one graph input), NDArray
+    imperatively. Used for weight tying across blocks (e.g. BERT's MLM
+    decoder tied to word_embed)."""
     tctx = _trace.current_trace()
     if tctx is not None and getattr(tctx, "param_store", None) is not None:
         return tctx.param_store[id(param)]
+    tvars = getattr(_sym_trace, "vars", None)
+    if tvars is not None:
+        if param.name not in tvars:
+            from .. import sym as _sym
+            tvars[param.name] = _sym.var(
+                param.name,
+                shape=param.shape if param._shape_known() else None)
+        return tvars[param.name]
     return param.data()
 
 

@@ -135,7 +135,24 @@ _ACT2ONNX = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
 
 @register_converter("Activation")
 def _act(ctx, s, ins, out):
-    ctx.emit(_ACT2ONNX[s._attrs.get("act_type", "relu")], ins[:1], [out])
+    act = s._attrs.get("act_type", "relu")
+    if act in ("gelu", "gelu_erf"):
+        # exact-erf gelu decomposed for opset 13 (ONNX Gelu is opset 20):
+        # 0.5 * x * (1 + erf(x / sqrt(2)))
+        inv = ctx.const("gelu_inv_sqrt2", np.float32(1.0 / np.sqrt(2.0)))
+        sc = ctx.fresh("gelu_scaled")
+        ctx.emit("Mul", [ins[0], inv], [sc])
+        er = ctx.fresh("gelu_erf")
+        ctx.emit("Erf", [sc], [er])
+        one = ctx.const("gelu_one", np.float32(1.0))
+        ad = ctx.fresh("gelu_1p")
+        ctx.emit("Add", [er, one], [ad])
+        half = ctx.const("gelu_half", np.float32(0.5))
+        hx = ctx.fresh("gelu_halfx")
+        ctx.emit("Mul", [ins[0], half], [hx])
+        ctx.emit("Mul", [hx, ad], [out])
+        return
+    ctx.emit(_ACT2ONNX[act], ins[:1], [out])
 
 
 @register_converter("LeakyReLU")
@@ -1789,6 +1806,10 @@ def export_model(model, params=None, input_shapes=None, input_types=None,
         raise ValueError("input_shapes is required")
     if not isinstance(input_shapes, dict):
         input_shapes = dict(zip(input_names, [tuple(s) for s in input_shapes]))
+    if input_types is not None and not isinstance(input_types, dict):
+        # pair by input_names order, NOT the shapes dict's insertion order
+        # (a dict-shapes caller may list names in a different order)
+        input_types = dict(zip(input_names, input_types))
 
     if isinstance(model, Symbol):
         sym_out = model
@@ -2052,3 +2073,54 @@ def _logsumexp_conv(ctx, s, ins, out):
 @register_converter("size_array")
 def _size_array_conv(ctx, s, ins, out):
     ctx.emit("Size", ins[:1], [out])
+
+
+@register_converter("scaled_dot_attention")
+def _sdpa_conv(ctx, s, ins, out):
+    """Decompose the attention seam into MatMul/Softmax — the lowering
+    upstream mx2onnx applies to gluonnlp's BERT interleaved-matmul ops
+    (python/mxnet/onnx/mx2onnx/_op_translations). q,k,v (B, H, T, D);
+    optional mask input (1=keep) becomes an additive -1e9; causal=True
+    bakes a (1, 1, Tq, Tk) triangular additive constant (shapes are static
+    at export like every symbol_to_onnx graph)."""
+    a = s._attrs
+    q_shape = s._inputs[0].shape
+    k_shape = s._inputs[1].shape
+    D = q_shape[-1]
+    scale = a.get("scale") or (1.0 / np.sqrt(D))
+
+    kt = ctx.fresh("kT")
+    ctx.emit("Transpose", [ins[1]], [kt], attrs={"perm": [0, 1, 3, 2]})
+    raw = ctx.fresh("scores")
+    ctx.emit("MatMul", [ins[0], kt], [raw])
+    sc = ctx.const("sdpa_scale", np.float32(scale))
+    scores = ctx.fresh("scaled")
+    ctx.emit("Mul", [raw, sc], [scores])
+    if a.get("causal"):
+        tq, tk = q_shape[-2], k_shape[-2]
+        tri = np.where(np.arange(tk)[None, :] <= np.arange(tq)[:, None],
+                       0.0, -1e9).astype(np.float32)[None, None]
+        add = ctx.const("causal_bias", tri)
+        nxt = ctx.fresh("causal_scores")
+        ctx.emit("Add", [scores, add], [nxt])
+        scores = nxt
+    if len(ins) > 3:  # boolean keep-mask input
+        mb = ctx.fresh("mask_bool")
+        ctx.emit("Cast", [ins[3]], [mb], attrs={"to": 9})
+        neg = ctx.const("sdpa_neg", np.float32(-1e9))
+        masked = ctx.fresh("masked_scores")
+        ctx.emit("Where", [mb, scores, neg], [masked])
+        scores = masked
+    probs = ctx.fresh("attn_probs")
+    ctx.emit("Softmax", [scores], [probs], attrs={"axis": -1})
+    ctx.emit("MatMul", [probs, ins[2]], [out])
+
+
+@register_converter("_arange")
+def _arange_conv(ctx, s, ins, out):
+    a = s._attrs
+    from ..base import resolve_dtype
+    arr = np.arange(a["start"], a["stop"], a.get("step", 1.0),
+                    dtype=np.dtype(resolve_dtype(a.get("dtype") or "float32")))
+    rep = int(a.get("repeat", 1))
+    ctx.initializers[out] = np.repeat(arr, rep) if rep != 1 else arr

@@ -138,6 +138,20 @@ def full(shape, val, dtype="float32", ctx=None, name=None, **kwargs):
     return _make("_filled", name=name, shape=tuple(shape), value=val, dtype=dtype)
 
 
+def arange(start, stop=None, step=1.0, repeat=1, dtype="float32", ctx=None,
+           name=None, **kwargs):
+    if kwargs:
+        # silently absorbing nd.arange kwargs would let traced graphs
+        # diverge from the imperative result
+        raise TypeError("sym.arange got unsupported kwargs %s"
+                        % sorted(kwargs))
+    if stop is None:
+        start, stop = 0, start
+    return _make("_arange", name=name, start=float(start), stop=float(stop),
+                 step=float(step), repeat=int(repeat),
+                 dtype=dtype or "float32")
+
+
 def __getattr__(name):
     if name in _REG:
         f = _builder(name)

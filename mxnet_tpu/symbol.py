@@ -630,6 +630,12 @@ def _filled(*, shape, value, dtype="float32"):
     return jnp.full(tuple(shape), value, resolve_dtype(dtype))
 
 
+@register_op("_arange")
+def _arange(*, start, stop, step=1.0, repeat=1, dtype="float32"):
+    out = jnp.arange(start, stop, step, dtype=resolve_dtype(dtype or "float32"))
+    return jnp.repeat(out, repeat) if repeat != 1 else out
+
+
 @register_op("_item")
 def _item(x, *, index):
     return x[index]

@@ -940,3 +940,72 @@ def test_clip_mixed_positional_keyword_export():
     mb = mxonnx.export_model(out, params={}, input_shapes={"data": x.shape})
     got = mxonnx.import_to_gluon(mb)(nd.array(x)).asnumpy()
     np.testing.assert_allclose(got, np.clip(x, -0.25, 0.75), rtol=1e-6)
+
+
+def test_bert_onnx_roundtrip(tmp_path):
+    """Flagship mx2onnx scenario: export a (small) BERT encoder graph to
+    ONNX and reimport — numerics match the source model (upstream exports
+    gluonnlp BERT through the same decomposed-attention lowering)."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.models.bert import BERTModel
+    from mxnet_tpu.onnx import export_model, import_model
+
+    model = BERTModel(vocab_size=53, token_type_vocab_size=2, units=16,
+                      hidden_size=32, num_layers=2, num_heads=2,
+                      dropout=0.0, max_length=12, use_decoder=False,
+                      use_classifier=False)
+    model.initialize()
+    rng = np.random.default_rng(0)
+    B, T = 2, 8
+    tok = rng.integers(0, 53, (B, T)).astype(np.int32)
+    tt = rng.integers(0, 2, (B, T)).astype(np.int32)
+    seq_ref, pooled_ref = model(nd.array(tok), nd.array(tt))
+
+    onnx_path = str(tmp_path / "bert.onnx")
+    export_model(model, input_shapes=[(B, T), (B, T)],
+                 input_types=[np.int32, np.int32],
+                 onnx_file=onnx_path, input_names=("inputs", "token_types"))
+
+    sym2, arg2, aux2 = import_model(onnx_path)
+    feed = dict(arg2)
+    feed.update(aux2)
+    feed["inputs"] = nd.array(tok)
+    feed["token_types"] = nd.array(tt)
+    outs = sym2.eval(**{k: (v if isinstance(v, nd.NDArray) else nd.array(v))
+                        for k, v in feed.items()})
+    got = {tuple(o.shape): o.asnumpy() for o in outs}
+    np.testing.assert_allclose(got[tuple(seq_ref.shape)], seq_ref.asnumpy(),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(got[tuple(pooled_ref.shape)],
+                               pooled_ref.asnumpy(), rtol=2e-4, atol=2e-5)
+
+
+def test_gpt_onnx_roundtrip(tmp_path):
+    """Causal decoder export: the scaled_dot_attention causal=True lowering
+    (baked triangular additive bias) + tied LM head round-trip."""
+    import numpy as np
+
+    from mxnet_tpu import nd
+    from mxnet_tpu.models.gpt import GPTModel
+    from mxnet_tpu.onnx import export_model, import_model
+
+    model = GPTModel(vocab_size=41, units=16, num_layers=2, num_heads=2,
+                     max_length=10, dropout=0.0)
+    model.initialize()
+    rng = np.random.default_rng(1)
+    B, T = 2, 7
+    tok = rng.integers(0, 41, (B, T)).astype(np.int32)
+    ref = model(nd.array(tok))
+
+    onnx_path = str(tmp_path / "gpt.onnx")
+    export_model(model, input_shapes=[(B, T)], input_types=[np.int32],
+                 onnx_file=onnx_path, input_names=("tokens",))
+    sym2, arg2, aux2 = import_model(onnx_path)
+    feed = {k: nd.array(v) for k, v in {**arg2, **aux2}.items()}
+    feed["tokens"] = nd.array(tok)
+    (out,) = sym2.eval(**feed)
+    np.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                               rtol=2e-4, atol=2e-5)

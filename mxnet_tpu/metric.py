@@ -343,3 +343,11 @@ def np(numpy_feval, name=None, allow_extra_outputs=False):
 
     feval.__name__ = name or getattr(numpy_feval, "__name__", "custom")
     return CustomMetric(feval, feval.__name__, allow_extra_outputs)
+
+
+# upstream's short registry aliases (ref: python/mxnet/metric.py @alias)
+from . import registry as _registry_mod
+_alias = _registry_mod.get_alias_func(EvalMetric, "metric")
+_alias("acc")(Accuracy)
+_alias("top_k_accuracy", "top_k_acc")(TopKAccuracy)
+_alias("ce")(CrossEntropy)

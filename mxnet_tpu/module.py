@@ -238,6 +238,96 @@ class Module:
                 em.update(batch.label[0], self._exec.outputs[0])
         return em.get()
 
+    # -- BaseModule conveniences (ref: module/base_module.py) ---------------
+
+    @property
+    def symbol(self):
+        return self._symbol
+
+    @property
+    def data_names(self):
+        return list(self._data_names)
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    @property
+    def data_shapes(self):
+        from .io import DataDesc
+        return [DataDesc(n, self._data_shapes[n]) for n in self._data_names
+                if n in getattr(self, "_data_shapes", {})]
+
+    @property
+    def label_shapes(self):
+        from .io import DataDesc
+        return [DataDesc(n, self._data_shapes[n]) for n in self._label_names
+                if n in getattr(self, "_data_shapes", {})]
+
+    @property
+    def output_shapes(self):
+        _, outs, _ = self._symbol.infer_shape(
+            **{n: s for n, s in getattr(self, "_data_shapes", {}).items()})
+        return list(zip(self.output_names, outs))
+
+    def forward_backward(self, data_batch):
+        """(ref: base_module.py:forward_backward)"""
+        self.forward(data_batch, is_train=True)
+        self.backward()
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        """(ref: base_module.py:update_metric)"""
+        eval_metric.update(labels[0] if isinstance(labels, (list, tuple))
+                           else labels, self.get_outputs()[0])
+
+    def predict(self, eval_data, num_batch=None, merge_batches=True,
+                reset=True, always_output_list=False):
+        """(ref: base_module.py:predict) — run inference over an iterator,
+        concatenating per-batch outputs along axis 0."""
+        if reset and hasattr(eval_data, "reset"):
+            eval_data.reset()
+        per_batch = []  # list over batches of the (pad-stripped) output list
+        for i, batch in enumerate(eval_data):
+            if num_batch is not None and i >= num_batch:
+                break
+            self.forward(batch, is_train=False)
+            outs = self.get_outputs()
+            pad = getattr(batch, "pad", 0) or 0
+            if pad:
+                outs = [NDArray(o._data[:o.shape[0] - pad]) for o in outs]
+            per_batch.append(outs)
+        if not per_batch:
+            return []
+        if not merge_batches:
+            # upstream contract: a list over batches (each a list of outputs)
+            return per_batch
+        merged = [NDArray(jnp.concatenate([outs[j]._data
+                                           for outs in per_batch], axis=0))
+                  for j in range(len(per_batch[0]))]
+        if len(merged) == 1 and not always_output_list:
+            return merged[0]
+        return merged
+
+    def score(self, eval_data, eval_metric, num_batch=None, reset=True):
+        """(ref: base_module.py:score)"""
+        em = metric_mod.create(eval_metric)
+        em.reset()
+        if reset and hasattr(eval_data, "reset"):
+            eval_data.reset()
+        for i, batch in enumerate(eval_data):
+            if num_batch is not None and i >= num_batch:
+                break
+            self.forward(batch, is_train=False)
+            out = self.get_outputs()[0]
+            label = batch.label[0] if isinstance(batch.label, (list, tuple)) \
+                else batch.label
+            pad = getattr(batch, "pad", 0) or 0
+            if pad:  # don't double-count the iterator's wrap-around rows
+                out = NDArray(out._data[:out.shape[0] - pad])
+                label = NDArray(label._data[:label.shape[0] - pad])
+            em.update(label, out)
+        return em.get_name_value()
+
     def get_params(self):
         return dict(self._arg_params), {}
 

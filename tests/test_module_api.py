@@ -334,3 +334,52 @@ def test_module_save_checkpoint_and_load(tmp_path):
     mod2.init_params()
     got = mod2.forward(batch, is_train=False)[0].asnumpy()
     np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_module_predict_score_and_properties():
+    """BaseModule conveniences: predict (pad-aware concat), score,
+    forward_backward/update_metric, and the shape/name properties
+    (ref: python/mxnet/module/base_module.py)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.module import Module
+
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(10, 6)).astype(np.float32)
+    Y = (X[:, 0] > 0).astype(np.float32)
+
+    d = mx.sym.var("data")
+    out = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(d, num_hidden=2, name="fc"), name="softmax")
+    mod = Module(out)
+    it = mx.io.NDArrayIter(X, Y, batch_size=4, last_batch_handle="pad")
+    mod.bind(data_shapes=[("data", (4, 6))], label_shapes=[("softmax_label", (4,))])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd", optimizer_params={"learning_rate": 0.1})
+
+    assert mod.data_names == ["data"]
+    assert mod.symbol is out
+    assert mod.data_shapes[0].shape == (4, 6)
+    assert dict(mod.output_shapes)[mod.output_names[0]] == (4, 2)
+
+    # predict concatenates and strips the final pad batch
+    preds = mod.predict(it)
+    assert preds.shape == (10, 2)
+    np.testing.assert_allclose(preds.asnumpy().sum(1), 1.0, rtol=1e-5)
+
+    # train a few epochs via forward_backward + update_metric
+    em = mx.metric.Accuracy()
+    for _ in range(15):
+        it.reset()
+        for batch in it:
+            mod.forward_backward(batch)
+            mod.update()
+            mod.update_metric(em, batch.label)
+    (name, acc), = mod.score(it, "accuracy")
+    assert name == "accuracy" and acc > 0.7
+    # composite metric: upstream flat (name, value) pairs
+    pairs = mod.score(it, ["accuracy", "crossentropy"])
+    assert [n for n, _ in pairs] == ["accuracy", "cross-entropy"]
+    # merge_batches=False: per-batch output lists, pad-stripped on the tail
+    per_batch = mod.predict(it, merge_batches=False)
+    assert len(per_batch) == 3 and per_batch[0][0].shape == (4, 2)
+    assert per_batch[-1][0].shape == (2, 2)

@@ -232,10 +232,13 @@ class Module:
             em.reset()
             train_data.reset()
             for batch in train_data:
-                self.forward(batch, is_train=True)
-                self.backward()
+                self.forward_backward(batch)
                 self.update()
-                em.update(batch.label[0], self._exec.outputs[0])
+                # pad-aware like score: the SAME metric over the SAME data
+                # must agree between the fit loop and score()
+                outs, labels = self._strip_pad(batch, self.get_outputs(),
+                                               list(batch.label or []))
+                em.update(labels, outs)
         return em.get()
 
     # -- BaseModule conveniences (ref: module/base_module.py) ---------------
@@ -276,9 +279,26 @@ class Module:
         self.backward()
 
     def update_metric(self, eval_metric, labels, pre_sliced=False):
-        """(ref: base_module.py:update_metric)"""
-        eval_metric.update(labels[0] if isinstance(labels, (list, tuple))
-                           else labels, self.get_outputs()[0])
+        """(ref: base_module.py:update_metric). All labels pair with all
+        main outputs (EvalMetric.update zips lists); pre_sliced flattens
+        upstream's per-device label slices."""
+        if not isinstance(labels, (list, tuple)):
+            labels = [labels]
+        elif pre_sliced:
+            labels = [l for sl in labels for l in
+                      (sl if isinstance(sl, (list, tuple)) else [sl])]
+        eval_metric.update(list(labels), self.get_outputs())
+
+    @staticmethod
+    def _strip_pad(batch, outs, labels):
+        """Drop an iterator's wrap-around rows so metrics don't
+        double-count them (predict strips identically)."""
+        pad = getattr(batch, "pad", 0) or 0
+        if not pad:
+            return outs, labels
+        outs = [NDArray(o._data[:o.shape[0] - pad]) for o in outs]
+        labels = [NDArray(l._data[:l.shape[0] - pad]) for l in labels]
+        return outs, labels
 
     def predict(self, eval_data, num_batch=None, merge_batches=True,
                 reset=True, always_output_list=False):
@@ -318,14 +338,9 @@ class Module:
             if num_batch is not None and i >= num_batch:
                 break
             self.forward(batch, is_train=False)
-            out = self.get_outputs()[0]
-            label = batch.label[0] if isinstance(batch.label, (list, tuple)) \
-                else batch.label
-            pad = getattr(batch, "pad", 0) or 0
-            if pad:  # don't double-count the iterator's wrap-around rows
-                out = NDArray(out._data[:out.shape[0] - pad])
-                label = NDArray(label._data[:label.shape[0] - pad])
-            em.update(label, out)
+            outs, labels = self._strip_pad(batch, self.get_outputs(),
+                                           list(batch.label or []))
+            em.update(labels, outs)
         return em.get_name_value()
 
     def get_params(self):

@@ -317,3 +317,68 @@ def test_nearest_upsampling_vs_torch():
     want = torch.nn.functional.interpolate(_t(x), scale_factor=3,
                                            mode="nearest").numpy()
     np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_losses_vs_torch():
+    """gluon.loss family vs torch.nn.functional — independent
+    implementations of the same definitions (ref: gluon/loss.py)."""
+    import torch
+    import torch.nn.functional as tF
+
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon import loss as gloss
+
+    rng = np.random.default_rng(0)
+    B, C = 8, 5
+    logits = rng.normal(size=(B, C)).astype(np.float32)
+    labels = rng.integers(0, C, B)
+    tl = torch.tensor(logits)
+    ty = torch.tensor(labels)
+
+    # SoftmaxCE (per-sample, like gluon)
+    got = gloss.SoftmaxCrossEntropyLoss()(nd.array(logits),
+                                          nd.array(labels)).asnumpy()
+    ref = tF.cross_entropy(tl, ty, reduction="none").numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    # Sigmoid BCE from logits
+    tgt = rng.integers(0, 2, (B, C)).astype(np.float32)
+    got = gloss.SigmoidBinaryCrossEntropyLoss()(
+        nd.array(logits), nd.array(tgt)).asnumpy()
+    ref = tF.binary_cross_entropy_with_logits(
+        tl, torch.tensor(tgt), reduction="none").mean(1).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    # Huber == smooth_l1 at rho=1 (gluon means over the sample dims)
+    pred = rng.normal(size=(B, C)).astype(np.float32) * 2
+    tgt2 = rng.normal(size=(B, C)).astype(np.float32)
+    got = gloss.HuberLoss(rho=1.0)(nd.array(pred), nd.array(tgt2)).asnumpy()
+    ref = tF.smooth_l1_loss(torch.tensor(pred), torch.tensor(tgt2),
+                            reduction="none").mean(1).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    # KLDiv (from_logits=True takes log-probs, upstream semantics)
+    logp = tF.log_softmax(tl, dim=1)
+    q = tF.softmax(torch.tensor(rng.normal(size=(B, C)).astype(np.float32)),
+                   dim=1)
+    got = gloss.KLDivLoss(from_logits=True)(
+        nd.array(logp.numpy()), nd.array(q.numpy())).asnumpy()
+    ref = tF.kl_div(logp, q, reduction="none").mean(1).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    # L2: gluon = mean of squares / 2
+    got = gloss.L2Loss()(nd.array(pred), nd.array(tgt2)).asnumpy()
+    ref = (tF.mse_loss(torch.tensor(pred), torch.tensor(tgt2),
+                       reduction="none") / 2).mean(1).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    # Triplet: gluon SUMS the squared distances over features (upstream
+    # loss.py), unlike torch's p=2-norm margin loss — explicit-math oracle
+    a = rng.normal(size=(B, C)).astype(np.float32)
+    p = rng.normal(size=(B, C)).astype(np.float32)
+    n = rng.normal(size=(B, C)).astype(np.float32)
+    got = gloss.TripletLoss(margin=1.0)(
+        nd.array(a), nd.array(p), nd.array(n)).asnumpy()
+    ref = np.maximum(((a - p) ** 2).sum(1) - ((a - n) ** 2).sum(1) + 1.0,
+                     0.0)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)

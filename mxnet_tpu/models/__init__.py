@@ -9,3 +9,4 @@ from . import faster_rcnn  # noqa: F401
 from . import gpt  # noqa: F401
 from . import yolo  # noqa: F401
 from . import fcn  # noqa: F401
+from . import pose  # noqa: F401

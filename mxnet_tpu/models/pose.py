@@ -1,0 +1,116 @@
+"""SimplePose — top-down human-pose estimation (GluonCV parity; ref:
+gluoncv/model_zoo/simple_pose/simple_pose_resnet.py, "Simple Baselines for
+Human Pose Estimation", Xiao et al. 2018).
+
+TPU-first details: the trunk is the shared model_zoo ResNet (stride-32
+features, no global pool); the head is 3 stride-2 deconvs + a 1x1 joint
+conv — all MXU-friendly convs. Target generation (per-joint gaussian
+heatmaps from keypoint coords, with visibility weights) and decode
+(heatmap argmax + quarter-pixel offset toward the second-best neighbor,
+the standard SimplePose post-processing) are BOTH jittable static-shape
+device ops — upstream generates targets in the CPU data pipeline
+(gluoncv/data/transforms/pose.py) and decodes on CPU; here the whole
+train step, assignment included, compiles into one XLA program like the
+YOLOv3 family (models/yolo.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from ..gluon.model_zoo.vision import get_resnet
+from ..base import register_op
+
+__all__ = ["SimplePoseResNet", "simple_pose_resnet18", "pose_target",
+           "heatmap_to_coords"]
+
+
+class SimplePoseResNet(HybridBlock):
+    def __init__(self, base_layers=18, num_joints=17, deconv_channels=256,
+                 num_deconv=3, **kwargs):
+        super().__init__(**kwargs)
+        self._num_joints = num_joints
+        with self.name_scope():
+            # build INSIDE the scope so trunk params carry this net's
+            # prefix (prefix-stable save/load + selector regexes), like
+            # fcn.py/faster_rcnn.py do with their backbones
+            backbone = get_resnet(1, base_layers)
+            # trunk = resnet features minus its GlobalAvgPool tail
+            self.backbone = nn.HybridSequential(prefix="trunk_")
+            children = list(backbone.features._children.values())[:-1]
+            for blk in children:
+                self.backbone.add(blk)
+            self.deconv = nn.HybridSequential(prefix="deconv_")
+            for _ in range(num_deconv):
+                self.deconv.add(nn.Conv2DTranspose(
+                    deconv_channels, kernel_size=4, strides=2, padding=1,
+                    use_bias=False))
+                self.deconv.add(nn.BatchNorm())
+                self.deconv.add(nn.Activation("relu"))
+            self.head = nn.Conv2D(num_joints, kernel_size=1)
+
+    def hybrid_forward(self, F, x):
+        x = self.backbone(x)
+        x = self.deconv(x)
+        return self.head(x)  # (B, J, H/4, W/4) for stride-32 trunk + 3 ups
+
+
+def simple_pose_resnet18(num_joints=17, **kwargs):
+    return SimplePoseResNet(18, num_joints, **kwargs)
+
+
+@register_op("pose_target", n_outputs=2, nondiff=True)
+def pose_target(keypoints, *, heatmap_h, heatmap_w, sigma=2.0):
+    """Gaussian heatmap targets from keypoints (B, J, 3) [x, y, visible]
+    in HEATMAP pixel coordinates → (targets (B, J, H, W),
+    weights (B, J, 1, 1)); invisible joints (v <= 0) get zero weight
+    (ref: gluoncv/data/transforms/pose.py:SimplePoseGaussianTargetGenerator)."""
+    ys = jnp.arange(heatmap_h, dtype=jnp.float32)[:, None]
+    xs = jnp.arange(heatmap_w, dtype=jnp.float32)[None, :]
+
+    def one_joint(kp):
+        x, y, v = kp[0], kp[1], kp[2]
+        g = jnp.exp(-((xs - x) ** 2 + (ys - y) ** 2) / (2.0 * sigma ** 2))
+        # joints whose 3-sigma window misses the map entirely are dropped
+        # like upstream's bounds check
+        inside = (x >= -3 * sigma) & (x < heatmap_w + 3 * sigma) \
+            & (y >= -3 * sigma) & (y < heatmap_h + 3 * sigma)
+        w = ((v > 0) & inside).astype(jnp.float32)
+        return g * w, w
+
+    t, w = jax.vmap(jax.vmap(one_joint))(keypoints)
+    return t, w[..., None, None]
+
+
+@register_op("heatmap_to_coords", n_outputs=2, nondiff=True)
+def heatmap_to_coords(heatmaps):
+    """Decode (B, J, H, W) heatmaps → (coords (B, J, 2) [x, y],
+    scores (B, J)), with the quarter-pixel shift toward the larger
+    neighbor (ref: gluoncv/utils/metrics/coco_keypoints + simple_pose
+    get_max_pred)."""
+    B, J, H, W = heatmaps.shape
+    flat = heatmaps.reshape(B, J, H * W)
+    idx = jnp.argmax(flat, axis=-1)
+    score = jnp.max(flat, axis=-1)
+    px = (idx % W).astype(jnp.float32)
+    py = (idx // W).astype(jnp.float32)
+
+    # quarter-offset: sign of the gradient between the two neighbors
+    def at(hm, y, x):
+        y = jnp.clip(y, 0, H - 1).astype(jnp.int32)
+        x = jnp.clip(x, 0, W - 1).astype(jnp.int32)
+        return hm[y, x]
+
+    def one(hm, x, y):
+        dx = at(hm, y, x + 1) - at(hm, y, x - 1)
+        dy = at(hm, y + 1, x) - at(hm, y - 1, x)
+        # border peaks skip the offset (upstream guards 1 < p < dim-1):
+        # coords must stay inside the map for eval/crop parity
+        ox = jnp.where((x > 0) & (x < W - 1), 0.25 * jnp.sign(dx), 0.0)
+        oy = jnp.where((y > 0) & (y < H - 1), 0.25 * jnp.sign(dy), 0.0)
+        return jnp.stack([x + ox, y + oy])
+
+    coords = jax.vmap(jax.vmap(one))(heatmaps, px, py)
+    return coords, score

@@ -1,0 +1,113 @@
+"""SimplePose family: heads, on-device targets/decode, training
+(ref: gluoncv simple_pose tests + data/transforms/pose.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.models.pose import (SimplePoseResNet, heatmap_to_coords,
+                                   pose_target, simple_pose_resnet18)
+
+
+def test_forward_shapes():
+    net = simple_pose_resnet18(num_joints=17)
+    net.initialize()
+    out = net(nd.array(np.zeros((2, 3, 128, 96), np.float32)))
+    # stride-32 trunk + 3 stride-2 deconvs = stride 4
+    assert out.shape == (2, 17, 32, 24)
+
+
+def test_pose_target_oracle():
+    """Gaussian targets vs a straightforward numpy loop."""
+    rng = np.random.default_rng(0)
+    B, J, H, W, sigma = 2, 4, 16, 12, 2.0
+    kps = np.zeros((B, J, 3), np.float32)
+    kps[..., 0] = rng.uniform(-2, W + 2, (B, J))
+    kps[..., 1] = rng.uniform(-2, H + 2, (B, J))
+    kps[..., 2] = rng.integers(0, 2, (B, J))
+    t, w = nd.pose_target(nd.array(kps), heatmap_h=H, heatmap_w=W,
+                          sigma=sigma)
+    ys, xs = np.mgrid[0:H, 0:W].astype(np.float32)
+    for b in range(B):
+        for j in range(J):
+            x, y, v = kps[b, j]
+            g = np.exp(-((xs - x) ** 2 + (ys - y) ** 2) / (2 * sigma ** 2))
+            vis = float(v > 0)  # all test points are within the 3-sigma pad
+            np.testing.assert_allclose(t.asnumpy()[b, j], g * vis, rtol=1e-5,
+                                       atol=1e-6)
+            assert w.asnumpy()[b, j, 0, 0] == vis
+
+
+def test_heatmap_decode_quarter_offset():
+    H, W = 8, 8
+    hm = np.zeros((1, 1, H, W), np.float32)
+    hm[0, 0, 3, 4] = 1.0
+    hm[0, 0, 3, 5] = 0.6  # pulls x by +0.25
+    hm[0, 0, 2, 4] = 0.3  # pulls y by -0.25
+    coords, score = nd.heatmap_to_coords(nd.array(hm))
+    np.testing.assert_allclose(coords.asnumpy()[0, 0], [4.25, 2.75])
+    assert score.asnumpy()[0, 0] == 1.0
+
+
+def test_pose_train_step_loss_decreases():
+    """Full SimplePose step — target assignment INSIDE the step — learns a
+    fixed pose batch."""
+    from mxnet_tpu.gluon import Trainer
+
+    rng = np.random.default_rng(1)
+    net = SimplePoseResNet(18, num_joints=5)
+    net.initialize()
+    x = nd.array(rng.normal(size=(2, 3, 64, 64)).astype(np.float32))
+    kps = np.zeros((2, 5, 3), np.float32)
+    kps[..., 0] = rng.uniform(2, 14, (2, 5))
+    kps[..., 1] = rng.uniform(2, 14, (2, 5))
+    kps[..., 2] = 1
+    kp = nd.array(kps)
+    net(x)  # materialize
+    tr = Trainer(net.collect_params(), "adam", {"learning_rate": 1e-3})
+
+    def step():
+        with autograd.record():
+            hm = net(x)
+            tgt, w = nd.pose_target(kp, heatmap_h=16, heatmap_w=16, sigma=2.0)
+            loss = ((hm - tgt) ** 2 * w).mean()
+        loss.backward()
+        tr.step(2)
+        return float(loss.asnumpy())
+
+    first = step()
+    for _ in range(8):
+        last = step()
+    assert last < first * 0.8, (first, last)
+
+
+def test_hybridize_parity():
+    net = simple_pose_resnet18(num_joints=3)
+    net.initialize()
+    x = nd.array(np.random.default_rng(2).normal(size=(1, 3, 64, 64))
+                 .astype(np.float32))
+    ref = net(x).asnumpy()
+    net.hybridize()
+    np.testing.assert_allclose(net(x).asnumpy(), ref, rtol=2e-5, atol=2e-5)
+
+
+def test_decode_border_peak_no_offset():
+    hm = np.zeros((1, 1, 8, 8), np.float32)
+    hm[0, 0, 0, 0] = 1.0  # corner peak: no quarter shift
+    coords, _ = nd.heatmap_to_coords(nd.array(hm))
+    np.testing.assert_allclose(coords.asnumpy()[0, 0], [0.0, 0.0])
+
+
+def test_trunk_params_carry_net_prefix():
+    net = SimplePoseResNet(18, num_joints=3, prefix="pose_")
+    names = list(net.collect_params())
+    assert any(n.startswith("pose_") for n in names)
+    # two instances must produce param sets that save/load across each other
+    net.initialize()
+    import tempfile, os
+    f = os.path.join(tempfile.mkdtemp(), "p.params")
+    net(nd.array(np.zeros((1, 3, 64, 64), np.float32)))
+    net.save_parameters(f)
+    net2 = SimplePoseResNet(18, num_joints=3, prefix="pose_")
+    net2.initialize()
+    net2(nd.array(np.zeros((1, 3, 64, 64), np.float32)))
+    net2.load_parameters(f)

@@ -349,10 +349,31 @@ def _flash_vjp_bwd(scale, causal, bq, bk, interpret, res, do):
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-def flash_attention(q, k, v, causal=False, scale=None, block_q=256,
-                    block_k=512, interpret=False, kv_valid_len=None):
+# Tuned (block_q, block_k) per sequence-length bucket — ONE table every
+# caller picks up. tools/flash_sweep.py measures candidates on hardware
+# (writing tools/flash_sweep_r3.json when it runs; tools/sweep_report.py
+# summarizes it) and its winners get recorded here. Keys are the smallest
+# seq the row applies to, scanned descending. Until a sweep lands, the
+# single row is the VMEM-friendly 256x512 starting point.
+BLOCK_DEFAULTS = {
+    0: (256, 512),
+}
+
+
+def _default_blocks(seq):
+    for lo in sorted(BLOCK_DEFAULTS, reverse=True):
+        if seq >= lo:
+            return BLOCK_DEFAULTS[lo]
+    return BLOCK_DEFAULTS[min(BLOCK_DEFAULTS)]
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
+                    block_k=None, interpret=False, kv_valid_len=None):
     """q,k,v: (B, H, T, D). D should be a multiple of 128 lanes ideally;
     T must be divisible by the chosen blocks (callers pad).
+
+    block_q/block_k default from the seq-bucketed BLOCK_DEFAULTS table
+    (where the committed hardware sweep lands its winners).
 
     kv_valid_len: optional (B,) int — BERT-style key-padding: each example
     attends only to K/V positions < its valid length (columns beyond are
@@ -360,6 +381,12 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=256,
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     Tq, Tk = q.shape[2], k.shape[2]
+    # bucket each axis by ITS length: cross-attention (short queries, long
+    # keys) must not take the long-seq row's block_q
+    if block_q is None:
+        block_q = _default_blocks(Tq)[0]
+    if block_k is None:
+        block_k = _default_blocks(Tk)[1]
     bq = _largest_divisor_block(Tq, block_q)
     bk = _largest_divisor_block(Tk, block_k)
     return _flash(q, k, v, kv_valid_len, float(scale), bool(causal), bq, bk,

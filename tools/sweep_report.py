@@ -41,8 +41,13 @@ def flash_report(path):
             line += "; dense %.3f/%.3f ms" % (dense[0]["fwd_ms"],
                                               dense[0]["fwd_bwd_ms"])
         print(line)
-    print("current defaults: ops/pallas/flash_attention.py "
-          "block_q=256 block_k=512")
+    try:
+        from mxnet_tpu.ops.pallas.flash_attention import BLOCK_DEFAULTS
+        print("current defaults (ops/pallas/flash_attention.py "
+              "BLOCK_DEFAULTS): %s" % (BLOCK_DEFAULTS,))
+    except Exception:
+        print("current defaults: see ops/pallas/flash_attention.py "
+              "BLOCK_DEFAULTS")
 
 
 def batch_report(path):

@@ -650,6 +650,10 @@ def main():
                 # record (its metric name travels with it, so the artifact
                 # stays honest about what was measured)
                 replay = [m for m in MODES if m in results][:1]
+                if not replay:
+                    _log("persisted results contain no current mode "
+                         "(keys: %s); aborting" % sorted(results))
+                    raise SystemExit(1)
                 _log("no saved %s record; substituting %s" % (mode, replay[0]))
             _log("relay wedged through %ds budget; REPLAYING last good "
                  "result(s) for %s" % (budget, ",".join(replay)))

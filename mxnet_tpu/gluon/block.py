@@ -187,11 +187,16 @@ class Block:
 
     def save_parameters(self, filename, deduplicate=False):
         params = self._collect_params_with_prefix()
+        uninit = [n for n, p in params.items() if p._data is None]
+        if uninit:
+            # silently writing a partial file defers the failure to a
+            # confusing load-time KeyError (upstream raises at save too)
+            raise RuntimeError(
+                "save_parameters: parameters %s are not initialized "
+                "(deferred shapes — run one forward first)" % uninit[:5])
         arg = {}
         seen = {}
         for name, p in params.items():
-            if p._data is None:
-                continue
             if deduplicate and id(p) in seen:
                 continue
             seen[id(p)] = name

@@ -67,21 +67,35 @@ class StopTraining(Exception):
     """Raised (internally) by handlers that set estimator.stop_training."""
 
 
+_HIGHER_BETTER = ("acc", "f1", "mcc", "auc", "map", "recall", "precision",
+                  "pearson", "correlation")
+
+
+def _resolve_mode(mode, name):
+    """'auto' (upstream default) infers the improvement direction from the
+    metric name: accuracy-like metrics maximize, losses minimize."""
+    if mode != "auto":
+        return mode
+    n = (name or "").lower()
+    return "max" if any(k in n for k in _HIGHER_BETTER) else "min"
+
+
 def _monitored_value(estimator, monitor, who):
-    """The monitored metric's current value, or None (with a one-time
-    warning) when `monitor` names no train/val metric — a typo must not
-    silently disable best-tracking/early-stopping."""
+    """(name, value) of the monitored metric, or (None, None) — with a
+    one-time warning when `monitor` names no train/val metric, because a
+    typo must not silently disable best-tracking/early-stopping."""
     for m in estimator.train_metrics + estimator.val_metrics:
-        name, val = m.get()
-        if monitor is None or name == monitor:
-            # NaN = metric never updated (e.g. validation hasn't run yet);
-            # returning it would poison best-tracking via NaN comparisons
-            return None if val != val else val
+        for name, val in m.get_name_value():  # flat even for composites
+            if monitor is None or name == monitor:
+                # NaN = metric never updated (e.g. validation hasn't run
+                # yet); returning it would poison best-tracking
+                return (None, None) if val != val else (name, val)
     warnings.warn("%s: monitored metric %r not found among %s"
                   % (who, monitor,
-                     [m.get()[0] for m in estimator.train_metrics
-                      + estimator.val_metrics]))
-    return None
+                     [n for m in estimator.train_metrics
+                      + estimator.val_metrics
+                      for n, _ in m.get_name_value()]))
+    return None, None
 
 
 class MetricHandler(EpochBegin, BatchEnd):
@@ -206,7 +220,7 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
     (ref: event_handler.py:CheckpointHandler)."""
 
     def __init__(self, model_dir, model_prefix="model", monitor=None,
-                 mode="min", save_best=False, epoch_period=1,
+                 mode="auto", save_best=False, epoch_period=1,
                  batch_period=None, max_checkpoints=5,
                  resume_from_checkpoint=False):
         self.model_dir = model_dir
@@ -276,11 +290,12 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
             self._save(estimator,
                        "epoch%d" % (e + getattr(self, "_epoch_offset", 0)))
         if self.save_best:
-            val = _monitored_value(estimator, self.monitor,
-                                   "CheckpointHandler(save_best=True)")
+            name, val = _monitored_value(estimator, self.monitor,
+                                         "CheckpointHandler(save_best=True)")
             if val is not None:
+                mode = _resolve_mode(self.mode, name)
                 better = self.best is None or \
-                    (val < self.best if self.mode == "min" else val > self.best)
+                    (val < self.best if mode == "min" else val > self.best)
                 if better:
                     self.best = val
                     self._save(estimator, "best", rotate=False)
@@ -290,7 +305,7 @@ class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
     """Stop when the monitored metric hasn't improved by min_delta for
     `patience` epochs (ref: event_handler.py:EarlyStoppingHandler)."""
 
-    def __init__(self, monitor=None, min_delta=0.0, patience=3, mode="min",
+    def __init__(self, monitor=None, min_delta=0.0, patience=3, mode="auto",
                  baseline=None):
         self.monitor = monitor
         self.min_delta = min_delta
@@ -307,11 +322,11 @@ class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
         self.stopped_epoch = None
 
     def epoch_end(self, estimator):
-        val = _monitored_value(estimator, self.monitor,
-                               "EarlyStoppingHandler")
+        name, val = _monitored_value(estimator, self.monitor,
+                                     "EarlyStoppingHandler")
         if val is None:
             return
-        if self.mode == "min":
+        if _resolve_mode(self.mode, name) == "min":
             better = self.best is None or val < self.best - self.min_delta
         else:
             better = self.best is None or val > self.best + self.min_delta
@@ -336,8 +351,15 @@ def _as_metric_list(metrics, default):
         metrics = [default]
     if not isinstance(metrics, (list, tuple)):
         metrics = [metrics]
-    return [metric_mod.create(m) if isinstance(m, str) else m
-            for m in metrics]
+    out = []
+    for m in metrics:
+        m = metric_mod.create(m) if isinstance(m, str) else m
+        if isinstance(m, metric_mod.CompositeEvalMetric):
+            # flatten: handlers monitor/log per-child (name, value) pairs
+            out.extend(m.metrics)
+        else:
+            out.append(m)
+    return out
 
 
 class Estimator:
@@ -378,9 +400,11 @@ class Estimator:
                     c.name = "validation " + c.name
                     c.reset()
                     self.val_metrics.append(c)
-            # BEFORE user handlers: checkpoint/early-stop epoch_end must see
-            # THIS epoch's validation numbers, not last epoch's
-            handlers.insert(1, ValidationHandler(val_data, self.evaluate))
+            # BEFORE any non-metric handler: checkpoint/early-stop
+            # epoch_end must see THIS epoch's validation numbers
+            at = next((i for i, h in enumerate(handlers)
+                       if not isinstance(h, MetricHandler)), len(handlers))
+            handlers.insert(at, ValidationHandler(val_data, self.evaluate))
         if verbose and not any(isinstance(h, LoggingHandler)
                                for h in handlers):
             handlers.append(LoggingHandler())

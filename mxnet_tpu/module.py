@@ -343,11 +343,25 @@ class Module:
             em.update(labels, outs)
         return em.get_name_value()
 
+    _AUX_SUFFIXES = ("moving_mean", "moving_var", "running_mean",
+                     "running_var")
+
+    def _is_aux(self, name):
+        return name in getattr(self, "_aux_update_names", ()) \
+            or name.endswith(self._AUX_SUFFIXES)
+
     def get_params(self):
-        return dict(self._arg_params), {}
+        """(arg_params, aux_params) with BN moving stats in the AUX dict —
+        the upstream split (executors store them as aux_states); internally
+        they live in _arg_params for the forward write-back."""
+        args = {n: v for n, v in self._arg_params.items()
+                if not self._is_aux(n)}
+        aux = {n: v for n, v in self._arg_params.items() if self._is_aux(n)}
+        return args, aux
 
     def set_params(self, arg_params, aux_params=None, **kwargs):
         self._arg_params.update(arg_params or {})
+        self._arg_params.update(aux_params or {})
 
     def save_checkpoint(self, prefix, epoch):
         """prefix-symbol.json + prefix-NNNN.params, the mx.model layout

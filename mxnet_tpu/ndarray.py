@@ -399,10 +399,13 @@ def _invoke_impl(opname, args, kwargs):
                 # reuses the very same compiled callable
                 f = _FAST_JIT[opname] = jitted(opdef.fn, {})
         elif "out" not in kwargs and not any(
-                k in opdef.array_kwargs or isinstance(v, (NDArray, jax.Array))
+                k in opdef.array_kwargs
+                or isinstance(v, (NDArray, jax.Array, np.ndarray))
                 for k, v in kwargs.items()):
             # static kwargs (axis=1, keepdims=True, even axis=[0,1]) reuse
-            # base.jitted's cache — one jit cache for fast AND slow paths
+            # base.jitted's cache — one jit cache for fast AND slow paths.
+            # np.ndarray values are excluded: baking them by value would
+            # recompile per distinct array
             f = jitted(opdef.fn, kwargs)
         else:
             f = None

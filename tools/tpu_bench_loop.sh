@@ -24,7 +24,7 @@ while true; do
     # anchored: the harness driver's cmdline CONTAINS 'python -m pytest'
     # as prose, so an unanchored pattern would wait on it forever; cover
     # both 'python -m pytest' and the bare 'pytest' console script
-    while pgrep -f "^[^ ]*python[^ ]* (-m pytest|[^ ]*/pytest) " >/dev/null 2>&1; do
+    while pgrep -f "^[^ ]*python[^ ]* (-m pytest|[^ ]*/pytest)( |$)" >/dev/null 2>&1; do
       echo "[loop] $(date -u +%T) relay up but a test suite is running; waiting 60s"
       sleep 60
     done

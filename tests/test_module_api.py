@@ -383,3 +383,43 @@ def test_module_predict_score_and_properties():
     per_batch = mod.predict(it, merge_batches=False)
     assert len(per_batch) == 3 and per_batch[0][0].shape == (4, 2)
     assert per_batch[-1][0].shape == (2, 2)
+
+
+def test_module_checkpoint_aux_split(tmp_path):
+    """BN moving stats save under 'aux:' keys in the mx.model layout and
+    round-trip through load_checkpoint/set_params
+    (ref: python/mxnet/model.py save_checkpoint arg/aux split)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.io import DataBatch
+    from mxnet_tpu.module import Module
+
+    d = mx.sym.var("data")
+    out = mx.sym.FullyConnected(mx.sym.BatchNorm(d, name="bn0"),
+                                num_hidden=2, name="fc")
+    mod = Module(out, label_names=[])
+    mod.bind(data_shapes=[("data", (4, 3))])
+    mod.init_params()
+    batch = DataBatch(data=[nd.array(np.random.default_rng(0)
+                                     .normal(size=(4, 3))
+                                     .astype(np.float32))], label=[])
+    mod.forward(batch, is_train=True)  # updates moving stats
+
+    args, aux = mod.get_params()
+    assert "bn0_moving_mean" in aux and "bn0_moving_var" in aux
+    assert not any(n.endswith(("moving_mean", "moving_var")) for n in args)
+
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 1)
+    _, arg2, aux2 = mx.model.load_checkpoint(prefix, 1)
+    assert "bn0_moving_mean" in aux2 and "fc_weight" in arg2
+    np.testing.assert_allclose(aux2["bn0_moving_mean"].asnumpy(),
+                               aux["bn0_moving_mean"].asnumpy())
+
+    mod2 = Module(out, label_names=[])
+    mod2.bind(data_shapes=[("data", (4, 3))])
+    mod2.init_params()
+    mod2.set_params(arg2, aux2)
+    ref = mod.forward(batch, is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(mod2.forward(batch, is_train=False)[0].asnumpy(),
+                               ref, rtol=1e-6)

@@ -101,6 +101,21 @@ MASKED = 20
 VOCAB = 30522
 
 
+def _xent_mean(logits, labels):
+    """Mean NLL over (rows, vocab) logits via the fused pallas softmax-xent
+    kernel (ops/pallas/softmax_xent.py): loss + logsumexp in ONE VMEM pass,
+    backward reuses the saved lse — versus XLA's materialized fp32
+    log_softmax + gather, the top non-matmul HBM sink in the LM losses
+    (VERDICT r3 next-round #2). Interpret mode keeps the CPU smoke path
+    runnable; the dispatch is trace-time, baked into the jitted step."""
+    from mxnet_tpu.base import is_tpu_backend
+    from mxnet_tpu.ops.pallas.softmax_xent import softmax_xent
+    vocab = logits.shape[-1]
+    nll = softmax_xent(logits.reshape(-1, vocab), labels.reshape(-1),
+                       not is_tpu_backend())
+    return jnp.mean(nll)
+
+
 def build(seq=SEQ, remat=False):
     # batch/mask sizes come from make_batch via the jit trace; only the
     # max sequence length specializes the model itself
@@ -122,11 +137,11 @@ def build(seq=SEQ, remat=False):
         with _trace.trace_scope(key, True) as t:
             t.param_store = {id(p): a for p, a in zip(plist, param_arrays)}
             seq, pooled, nsp_logits, mlm_logits = bert._call_traced(tok, tt, vl, mp)
-        mlm_lp = jax.nn.log_softmax(mlm_logits.astype(jnp.float32), axis=-1)
-        mlm_nll = -jnp.take_along_axis(mlm_lp, mlm_y[..., None], axis=-1)
+        # NSP stays on jnp: 2-class logits are lane-hostile for a pallas
+        # block and cost nothing either way
         nsp_lp = jax.nn.log_softmax(nsp_logits.astype(jnp.float32), axis=-1)
         nsp_nll = -jnp.take_along_axis(nsp_lp, nsp_y[:, None], axis=-1)
-        return jnp.mean(mlm_nll) + jnp.mean(nsp_nll)
+        return _xent_mean(mlm_logits, mlm_y) + jnp.mean(nsp_nll)
 
     params = [p.data()._data for p in plist]
     states = init_states(params)
@@ -294,8 +309,7 @@ def build_lstm():
     def traced_loss(batch):
         tokens, labels = batch  # (T, N) each
         logits = net._call_traced(tokens)  # (T, N, V)
-        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        return jnp.mean(-jnp.take_along_axis(lp, labels[..., None], axis=-1))
+        return _xent_mean(logits, labels)
 
     return _fused_train_step(net, opt, traced_loss, lr=1.0, wd=0.0)
 
@@ -362,8 +376,7 @@ def build_nmt():
     def traced_loss(batch):
         src, tgt, labels = batch
         logits = net._call_traced(src, tgt)  # (B, T_tgt, V)
-        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        return jnp.mean(-jnp.take_along_axis(lp, labels[..., None], axis=-1))
+        return _xent_mean(logits, labels)
 
     return _fused_train_step(net, opt, traced_loss, lr=1e-4, wd=0.0)
 
@@ -494,6 +507,20 @@ def _extras(results, skip_mode):
             for m, r in sorted(results.items()) if m != skip_mode}
 
 
+def _age_days(measured_at):
+    """Age of an ISO-Z timestamp in days (rounded), or None if unparsable."""
+    if not measured_at:
+        return None
+    try:
+        import calendar
+        # timegm, not mktime: both stamps are UTC; mktime's local-time
+        # interpretation would skew ages across DST transitions
+        then = calendar.timegm(time.strptime(measured_at, "%Y-%m-%dT%H:%M:%SZ"))
+        return round(max(0.0, (time.time() - then) / 86400.0), 2)
+    except ValueError:
+        return None
+
+
 def probe_backend(budget_s, probe_timeout=120):
     """Probe jax backend init in killable subprocesses until it answers or the
     budget runs out. The relay's failure mode is BLOCKING (not raising), so an
@@ -558,6 +585,7 @@ def run_mode(mode, results, smoke=False, iters=None, headline=False,
         "unit": unit,
         "vs_baseline": round(per_sec / baseline, 4),
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "fresh": True,
         "iters": iters,
         "batch": (batch_override or "default"),
         "remat": remat,
@@ -658,9 +686,17 @@ def main():
             _log("relay wedged through %ds budget; REPLAYING last good "
                  "result(s) for %s" % (budget, ",".join(replay)))
             for m in replay:
-                out = dict(results[m], replayed=True)
+                # self-describing staleness (VERDICT r3 Weak #3): a replayed
+                # record is NOT a fresh measurement and says so at top level,
+                # with its age, so a consumer reading parsed.value cannot
+                # mistake it for this round's number
+                out = dict(results[m], replayed=True, fresh=False)
+                out["age_days"] = _age_days(results[m].get("measured_at"))
                 if m != mode and mode != "all":
+                    # cross-mode substitution is unmistakable, not inferable
+                    # (ADVICE r3 bench.py item)
                     out["requested_mode"] = mode
+                    out["substituted_from"] = m
                 if m == "bert" or (mode != "all" and m == replay[0]):
                     out["extras"] = _extras(results, m)
                 print(json.dumps(out), flush=True)

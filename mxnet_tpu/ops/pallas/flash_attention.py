@@ -15,6 +15,8 @@ custom-VJP kernels). Falls back to the jnp reference off-TPU (ops/attention.py).
 from __future__ import annotations
 
 import functools
+import json
+import os
 
 import jax
 import jax.numpy as jnp
@@ -350,14 +352,40 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 # Tuned (block_q, block_k) per sequence-length bucket — ONE table every
-# caller picks up. tools/flash_sweep.py measures candidates on hardware
-# (writing tools/flash_sweep_r3.json when it runs; tools/sweep_report.py
-# summarizes it) and its winners get recorded here. Keys are the smallest
-# seq the row applies to, scanned descending. Until a sweep lands, the
-# single row is the VMEM-friendly 256x512 starting point.
+# caller picks up. Keys are the smallest seq the row applies to, scanned
+# descending. The tuned table is DATA, not code: tools/flash_sweep.py
+# measures candidates on hardware and (with --apply) writes the winners to
+# flash_blocks.json next to this file; import picks it up. Until a sweep
+# lands, the single fallback row is the VMEM-friendly 256x512 point.
 BLOCK_DEFAULTS = {
     0: (256, 512),
 }
+
+_BLOCKS_ARTIFACT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "flash_blocks.json")
+
+
+def _load_block_artifact(path=None):
+    """Replace BLOCK_DEFAULTS with the committed hardware-sweep winners.
+
+    The artifact maps seq-bucket lower bounds to [block_q, block_k] and
+    carries provenance ("swept_at", "source"). Malformed or absent files
+    leave the fallback table untouched — tuning must never break dispatch."""
+    global BLOCK_DEFAULTS
+    path = path or _BLOCKS_ARTIFACT
+    try:
+        with open(path) as f:
+            raw = json.load(f)
+        table = {int(k): (int(v[0]), int(v[1]))
+                 for k, v in raw["blocks"].items()}
+    except (OSError, ValueError, KeyError, TypeError):
+        return False
+    if table:
+        BLOCK_DEFAULTS = table
+    return bool(table)
+
+
+_load_block_artifact()
 
 
 def _default_blocks(seq):

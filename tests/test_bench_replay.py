@@ -1,0 +1,110 @@
+"""bench.py replay honesty + flash block-table artifact (VERDICT r3 #8, #2).
+
+Runs bench.py from a temp directory (RESULTS_PATH is derived from the
+script's location) with JAX_PLATFORMS=tpu so the backend probe fails fast on
+this CPU-only host, forcing the replay path against a synthetic results file.
+"""
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_replay(tmp_path, mode, results):
+    shutil.copy(os.path.join(REPO, "bench.py"), tmp_path / "bench.py")
+    (tmp_path / "BENCH_RESULTS.json").write_text(json.dumps(results))
+    env = dict(os.environ, JAX_PLATFORMS="tpu", BENCH_PROBE_BUDGET_S="1",
+               PYTHONPATH=REPO)
+    r = subprocess.run([sys.executable, str(tmp_path / "bench.py"), mode],
+                       capture_output=True, text=True, timeout=300, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+_REC = {"metric": "bert_base_seq512_train_samples_per_sec_per_chip",
+        "value": 180.46, "unit": "samples/s", "vs_baseline": 3.68,
+        "measured_at": "2026-07-30T01:04:46Z", "platform": "tpu"}
+
+
+def test_replay_is_marked_stale(tmp_path):
+    out = _run_replay(tmp_path, "bert512", {"bert512": _REC})
+    assert out["replayed"] is True
+    assert out["fresh"] is False
+    assert out["age_days"] >= 1.0  # measured_at is fixed in the past
+    assert "substituted_from" not in out  # same-mode replay
+
+
+def test_cross_mode_substitution_is_unmistakable(tmp_path):
+    out = _run_replay(tmp_path, "nmt", {"bert512": _REC})
+    assert out["replayed"] is True and out["fresh"] is False
+    assert out["requested_mode"] == "nmt"
+    assert out["substituted_from"] == "bert512"
+    # the record keeps ITS OWN metric name — never the requested mode's
+    assert out["metric"].startswith("bert_base_seq512")
+
+
+def test_age_days_parses_and_clamps():
+    sys.path.insert(0, REPO)
+    import bench
+    assert bench._age_days(None) is None
+    assert bench._age_days("not-a-date") is None
+    assert bench._age_days("2020-01-01T00:00:00Z") > 2000
+    import time
+    now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    assert bench._age_days(now) == 0.0
+
+
+def test_flash_block_artifact_roundtrip(tmp_path):
+    """apply_winners picks min-fwd_bwd_ms per seq; the loader installs the
+    table and the bucket scan serves the nearest lower bound."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import importlib
+
+    from mxnet_tpu.ops.pallas import flash_attention as fa
+    fs = importlib.import_module("flash_sweep")
+
+    rows = [
+        {"seq": 512, "kernel": "dense", "fwd_bwd_ms": 9.0},
+        {"seq": 512, "kernel": "flash", "block_q": 256, "block_k": 512,
+         "fwd_bwd_ms": 5.0},
+        {"seq": 512, "kernel": "flash", "block_q": 512, "block_k": 256,
+         "fwd_bwd_ms": 4.0},
+        {"seq": 2048, "kernel": "flash", "block_q": 128, "block_k": 512,
+         "fwd_bwd_ms": 40.0},
+    ]
+    saved_path, saved_table = fa._BLOCKS_ARTIFACT, dict(fa.BLOCK_DEFAULTS)
+    try:
+        fa._BLOCKS_ARTIFACT = str(tmp_path / "flash_blocks.json")
+        assert fs.apply_winners(rows, source="unit") == 0
+        assert fa._load_block_artifact()
+        assert fa.BLOCK_DEFAULTS[512] == (512, 256)
+        assert fa.BLOCK_DEFAULTS[2048] == (128, 512)
+        assert fa.BLOCK_DEFAULTS[0] == (512, 256)  # smallest seq = catch-all
+        assert fa._default_blocks(768) == (512, 256)
+        assert fa._default_blocks(4096) == (128, 512)
+        # malformed artifact leaves the installed table untouched
+        (tmp_path / "flash_blocks.json").write_text("{broken")
+        assert not fa._load_block_artifact()
+        assert fa.BLOCK_DEFAULTS[512] == (512, 256)
+    finally:
+        fa._BLOCKS_ARTIFACT = saved_path
+        fa.BLOCK_DEFAULTS = saved_table
+
+
+def test_apply_winners_no_flash_rows_is_noop(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import importlib
+
+    from mxnet_tpu.ops.pallas import flash_attention as fa
+    fs = importlib.import_module("flash_sweep")
+    saved_path = fa._BLOCKS_ARTIFACT
+    try:
+        fa._BLOCKS_ARTIFACT = str(tmp_path / "flash_blocks.json")
+        assert fs.apply_winners([{"seq": 512, "kernel": "dense",
+                                  "fwd_bwd_ms": 9.0}], source="unit") == 1
+        assert not os.path.exists(fa._BLOCKS_ARTIFACT)
+    finally:
+        fa._BLOCKS_ARTIFACT = saved_path

@@ -46,7 +46,20 @@ def main():
                     help="also write structured sweep results to PATH "
                          "(committed as the evidence artifact for the "
                          "default block-size choice)")
+    ap.add_argument("--apply", action="store_true",
+                    help="after the sweep, write the per-seq winners into "
+                         "mxnet_tpu/ops/pallas/flash_blocks.json so "
+                         "flash_attention's BLOCK_DEFAULTS picks them up")
+    ap.add_argument("--apply-from", default=None, metavar="SWEEP_JSON",
+                    help="skip measuring; fold an existing sweep artifact "
+                         "into flash_blocks.json and exit")
     args = ap.parse_args()
+    if args.apply_from:
+        with open(args.apply_from) as f:
+            data = json.load(f)
+        return apply_winners(data["rows"], source=os.path.basename(
+            args.apply_from), measured_at=data.get("config", {}).get(
+            "measured_at"))
     rows = []
 
     from mxnet_tpu.ops.attention import _reference_attention
@@ -134,7 +147,41 @@ def main():
             json.dump({"config": meta, "rows": rows}, f, indent=1)
             f.write("\n")
         print("wrote %d rows to %s" % (len(rows), args.json))
+    if args.apply:
+        return apply_winners(
+            rows, source=os.path.basename(args.json or "sweep"),
+            measured_at=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+
+
+def apply_winners(rows, source, measured_at=None):
+    """Pick the fastest (block_q, block_k) per swept seq by fwd+bwd time and
+    write them into the package block-table artifact. Bucket keys are the
+    swept seqs themselves; the smallest seq's winner also becomes the 0
+    (catch-all) row so shorter sequences inherit the nearest tuning."""
+    from mxnet_tpu.ops.pallas import flash_attention as fa
+    winners = {}
+    for r in rows:
+        if r.get("kernel") != "flash" or "fwd_bwd_ms" not in r:
+            continue
+        seq = int(r["seq"])
+        if seq not in winners or r["fwd_bwd_ms"] < winners[seq]["fwd_bwd_ms"]:
+            winners[seq] = r
+    if not winners:
+        print("no flash rows to apply; leaving flash_blocks.json untouched")
+        return 1
+    blocks = {str(s): [w["block_q"], w["block_k"]]
+              for s, w in winners.items()}
+    blocks["0"] = blocks[str(min(winners))]
+    art = {"blocks": blocks, "source": source,
+           "swept_at": measured_at,
+           "note": "winners by min fwd_bwd_ms per seq; written by "
+                   "tools/flash_sweep.py --apply"}
+    with open(fa._BLOCKS_ARTIFACT, "w") as f:
+        json.dump(art, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print("applied block winners to %s: %s" % (fa._BLOCKS_ARTIFACT, blocks))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main() or 0)

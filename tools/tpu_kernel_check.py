@@ -6,10 +6,14 @@ The pytest suite runs the kernels in interpret mode on the CPU mesh
 Mosaic lowering/tiling. This script runs the same checks compiled for the
 real TPU chip; run it whenever the axon relay is up:
 
-    python tools/tpu_kernel_check.py
+    python tools/tpu_kernel_check.py [--json PATH]
 
-Exits 0 and prints PASS lines on success; raises on numeric mismatch.
+Exits 0 and prints PASS lines on success; nonzero on numeric mismatch.
+--json writes a structured record of every check (name, max error, tolerance,
+platform, timestamp) — the committable evidence artifact that the
+non-interpret Mosaic lowering ran on hardware (VERDICT r3 next-round #3).
 """
+import json
 import os
 import sys
 import time
@@ -26,6 +30,15 @@ def log(msg):
 
 
 def main():
+    json_path = None
+    argv = sys.argv[1:]
+    if "--json" in argv:
+        i = argv.index("--json")
+        if i + 1 >= len(argv):
+            print("usage: tpu_kernel_check.py [--json PATH]", file=sys.stderr)
+            return 2
+        json_path = argv[i + 1]
+
     t0 = time.time()
     devs = jax.devices()
     log("devices: %s (%.1fs)" % (devs, time.time() - t0))
@@ -33,13 +46,24 @@ def main():
         log("no accelerator present; nothing to check")
         return 1
 
-    from mxnet_tpu.ops.pallas.flash_attention import flash_attention
+    rows = []
+
+    def record(name, err, tol):
+        ok = err < tol
+        rows.append({"check": name, "max_err": float("%.3e" % err),
+                     "tol": tol, "pass": bool(ok)})
+        log("%s %s (maxerr %.2e, tol %g)"
+            % (name, "PASS" if ok else "FAIL", err, tol))
+        return ok
+
+    from mxnet_tpu.ops.pallas.flash_attention import (BLOCK_DEFAULTS,
+                                                      flash_attention)
     from mxnet_tpu.ops.pallas.layernorm import fused_layernorm
     from mxnet_tpu.ops.pallas.softmax_xent import softmax_xent
     from mxnet_tpu.parallel import full_attention
     from mxnet_tpu.ops.functional import LayerNorm
 
-    # flash attention fwd + bwd
+    # flash attention fwd + bwd (non-interpret Mosaic lowering)
     B, H, T, D = 2, 4, 512, 128
     ks = jax.random.split(jax.random.PRNGKey(0), 4)
     q, k, v = (jax.random.normal(kk, (B, H, T, D), jnp.float32) for kk in ks[:3])
@@ -47,9 +71,8 @@ def main():
     for causal in (False, True):
         out = jax.jit(lambda a, b, c: flash_attention(a, b, c, causal=causal))(q, k, v)
         ref = full_attention(q, k, v, causal=causal)
-        err = float(jnp.abs(out - ref).max())
-        assert err < 2e-3, ("flash fwd", causal, err)
-        log("flash fwd causal=%s PASS (maxerr %.2e)" % (causal, err))
+        record("flash_fwd_causal=%s" % causal,
+               float(jnp.abs(out - ref).max()), 2e-3)
 
         grads = jax.jit(jax.grad(
             lambda a, b, c: jnp.sum(flash_attention(a, b, c, causal=causal) * ct),
@@ -58,9 +81,16 @@ def main():
             lambda a, b, c: jnp.sum(full_attention(a, b, c, causal=causal) * ct),
             argnums=(0, 1, 2))(q, k, v)
         for g, r, name in zip(grads, refs, ("dq", "dk", "dv")):
-            err = float(jnp.abs(g - r).max())
-            assert err < 5e-3, ("flash bwd", name, causal, err)
-        log("flash bwd causal=%s PASS" % causal)
+            record("flash_bwd_%s_causal=%s" % (name, causal),
+                   float(jnp.abs(g - r).max()), 5e-3)
+
+    # key-padding (kv_valid_len) path — the BERT bench configuration
+    from mxnet_tpu.ops.attention import _reference_attention
+    vl = jnp.asarray([384.0, 512.0], jnp.float32)
+    mask = jnp.arange(T)[None, None, None, :] < vl[:, None, None, None]
+    out = jax.jit(lambda a, b, c: flash_attention(a, b, c, kv_valid_len=vl))(q, k, v)
+    ref = _reference_attention(q, k, v, mask)
+    record("flash_fwd_kv_valid_len", float(jnp.abs(out - ref).max()), 2e-3)
 
     # fused layernorm
     x = jax.random.normal(jax.random.PRNGKey(1), (256, 1024), jnp.float32)
@@ -68,22 +98,35 @@ def main():
     b = jax.random.normal(jax.random.PRNGKey(3), (1024,))
     out = jax.jit(fused_layernorm)(x, g, b)
     ref = LayerNorm(x, g, b)
-    err = float(jnp.abs(out - ref).max())
-    assert err < 1e-3, ("layernorm", err)
-    log("fused layernorm PASS (maxerr %.2e)" % err)
+    record("fused_layernorm", float(jnp.abs(out - ref).max()), 1e-3)
 
-    # fused softmax cross-entropy
+    # fused softmax cross-entropy fwd + bwd, at the bench's real vocab width
     rng = np.random.RandomState(3)
-    logits = jnp.asarray(rng.randn(128, 1024).astype(np.float32))
-    labels = jnp.asarray(rng.randint(0, 1024, 128).astype(np.int32))
+    logits = jnp.asarray(rng.randn(128, 30522).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, 30522, 128).astype(np.int32))
     loss = jax.jit(lambda lg: softmax_xent(lg, labels))(logits)
     ref = -jax.nn.log_softmax(logits)[jnp.arange(128), labels]
-    err = float(jnp.abs(loss - ref).max())
-    assert err < 1e-4, ("softmax_xent", err)
-    log("fused softmax-xent PASS (maxerr %.2e)" % err)
+    record("softmax_xent_fwd_V30522", float(jnp.abs(loss - ref).max()), 1e-4)
 
-    log("ALL PALLAS KERNELS PASS ON %s" % devs[0].platform)
-    return 0
+    dx = jax.jit(jax.grad(lambda lg: softmax_xent(lg, labels).mean()))(logits)
+    dref = jax.grad(
+        lambda lg: (-jax.nn.log_softmax(lg)[jnp.arange(128), labels]).mean())(logits)
+    record("softmax_xent_bwd_V30522", float(jnp.abs(dx - dref).max()), 1e-6)
+
+    ok = all(r["pass"] for r in rows)
+    log("%s ON %s" % ("ALL PALLAS KERNELS PASS" if ok else "FAILURES PRESENT",
+                      devs[0].platform))
+    if json_path:
+        art = {"platform": devs[0].platform,
+               "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+               "block_defaults": {str(k): list(vv)
+                                  for k, vv in BLOCK_DEFAULTS.items()},
+               "all_pass": ok, "checks": rows}
+        with open(json_path, "w") as f:
+            json.dump(art, f, indent=1)
+            f.write("\n")
+        log("wrote %d checks to %s" % (len(rows), json_path))
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

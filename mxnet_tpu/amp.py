@@ -46,19 +46,63 @@ def _fix_norms(block):
 
 
 class LossScaler:
-    """API-compat only: bf16 needs no loss scaling (exponent range == fp32)."""
+    """Dynamic loss scaling for fp16 (ref: python/mxnet/amp/loss_scaler.py).
 
-    def __init__(self, init_scale=1.0, **kwargs):
-        self.loss_scale = 1.0
+    bf16 — the TPU default — needs NO scaling (exponent range == fp32), so
+    ``convert_hybrid_block`` never engages this class; construct it with
+    ``init_scale=1`` for a no-op. For float16 the upstream semantics apply:
+    multiply the loss by ``loss_scale``, check grads with the fused
+    ``multi_all_finite`` reduction, halve on overflow (skipping the step),
+    and double again after ``scale_window`` clean steps."""
+
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000, min_scale=1.0, max_scale=2.0 ** 24,
+                 **kwargs):
+        self.loss_scale = float(init_scale)
+        self._factor = float(scale_factor)
+        self._window = int(scale_window)
+        self._min, self._max = float(min_scale), float(max_scale)
+        self._unskipped = 0
 
     def scale(self, loss):
-        return loss
+        if self.loss_scale == 1.0:
+            return loss
+        return loss * self.loss_scale
 
     def unscale(self, grads):
-        return grads
+        if self.loss_scale == 1.0:
+            return grads
+        inv = 1.0 / self.loss_scale
+        one = lambda g: g * inv  # NDArray.__mul__ and jnp both handle this
+        return one(grads) if not isinstance(grads, (list, tuple)) \
+            else type(grads)(one(g) for g in grads)
+
+    def has_overflow(self, grads):
+        """True if any grad element is non-finite — ONE fused device
+        reduction over the whole list (ops/legacy_ops.py multi_all_finite),
+        a single scalar transfer instead of per-array syncs."""
+        from .ops.legacy_ops import multi_all_finite
+        if not isinstance(grads, (list, tuple)):
+            grads = [grads]
+        raw = [g._data if hasattr(g, "_data") else g for g in grads]
+        if not raw:
+            return False
+        return bool(float(multi_all_finite(*raw)[0]) == 0.0)
 
     def update(self, overflow=False):
-        pass
+        """Post-step adjustment; returns the (possibly new) scale. The step
+        itself should be SKIPPED by the caller when ``overflow`` — upstream
+        trainers drop the update and only touch the scale."""
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._factor, self._min)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._window:
+                self.loss_scale = min(self.loss_scale * self._factor,
+                                      self._max)
+                self._unskipped = 0
+        return self.loss_scale
 
 
 def scale_loss(loss, trainer):

@@ -370,5 +370,64 @@ class Function:
         return raw
 
 
-def get_symbol(x):  # MXNet API parity; no nnvm graph here
-    raise NotImplementedError("use mxnet_tpu.symbol for graph capture")
+def get_symbol(x):
+    """The recorded computation history of ``x`` as a Symbol (ref:
+    python/mxnet/autograd.py:get_symbol, which dumps the nnvm graph).
+
+    The tape is pruned to the subgraph ``x`` depends on and replayed as one
+    pure jax closure wrapped in a single ``_callable`` graph node whose
+    inputs are the tape's leaf arrays, exposed as variables ``arg0..argN``
+    in first-use order. The result evals / binds / differentiates like any
+    Symbol; it cannot serialize to json (host closure, not registry ops)."""
+    from .ndarray import NDArray
+    from . import symbol as _symbol
+
+    if not isinstance(x, NDArray):
+        raise TypeError("get_symbol expects an NDArray, got %r" % type(x))
+
+    needed = {id(x)}
+    tape = []
+    for node in reversed(_tape()):
+        if any(id(o) in needed for o in node.outputs):
+            if node.primal_fn is None:
+                raise NotImplementedError(
+                    "get_symbol across an imperative CustomOp tape node is "
+                    "not supported (its forward is not jax-traceable)")
+            tape.append(node)
+            needed.update(id(i) for i in node.inputs)
+    tape.reverse()
+    if not tape:
+        raise ValueError(
+            "array has no recorded computation history; call get_symbol on "
+            "an output computed under autograd.record()")
+
+    produced, leaves, seen = set(), [], set()
+    for node in tape:
+        for inp in node.inputs:
+            if id(inp) not in produced and id(inp) not in seen:
+                seen.add(id(inp))
+                leaves.append(inp)
+        for o in node.outputs:
+            produced.add(id(o))
+
+    # capture only (primal_fn, input ids, output ids) — NOT the TapeNodes:
+    # their vjp closures pin every forward residual, and the NDArrays pin
+    # device buffers; every input is either a leaf or produced earlier, so
+    # ids are enough to wire the replay
+    steps = [(node.primal_fn, [id(i) for i in node.inputs],
+              [id(o) for o in node.outputs]) for node in tape]
+    leaf_ids, x_id = [id(l) for l in leaves], id(x)
+    arg_vars = [_symbol.var("arg%d" % k, shape=l.shape, dtype=l.dtype)
+                for k, l in enumerate(leaves)]
+    del tape, leaves, needed, produced, seen, x
+
+    def replay(*leaf_vals):
+        env = dict(zip(leaf_ids, leaf_vals))
+        for primal_fn, in_ids, out_ids in steps:
+            flat = jax.tree_util.tree_leaves(primal_fn(*[env[i]
+                                                         for i in in_ids]))
+            for o, v in zip(out_ids, flat):
+                env[o] = v
+        return env[x_id]
+    return _symbol.Symbol(op="_callable", inputs=arg_vars,
+                          attrs={"fn": replay}, name="autograd_history")

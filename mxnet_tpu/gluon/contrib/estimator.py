@@ -84,12 +84,37 @@ def _monitored_value(estimator, monitor, who):
     """(name, value) of the monitored metric, or (None, None) — with a
     one-time warning when `monitor` names no train/val metric, because a
     typo must not silently disable best-tracking/early-stopping."""
-    for m in estimator.train_metrics + estimator.val_metrics:
+    # default monitor prefers VALIDATION metrics: best-checkpoint /
+    # early-stop against a train metric would happily save an overfit model
+    # (ADVICE r3). A NaN (never-updated) metric is skipped, so before the
+    # first validation pass the train metric stands in — with a one-time
+    # warning, since silently tracking train for a whole run is the exact
+    # failure mode this ordering exists to prevent.
+    ordered = (estimator.val_metrics + estimator.train_metrics
+               if monitor is None
+               else estimator.train_metrics + estimator.val_metrics)
+    n_val = len(estimator.val_metrics)
+    matched_nan = False
+    for mi, m in enumerate(ordered):
         for name, val in m.get_name_value():  # flat even for composites
             if monitor is None or name == monitor:
-                # NaN = metric never updated (e.g. validation hasn't run
-                # yet); returning it would poison best-tracking
-                return (None, None) if val != val else (name, val)
+                if val != val:  # NaN = never updated; keep searching
+                    matched_nan = True
+                    continue
+                if monitor is None and estimator.val_metrics \
+                        and mi >= n_val \
+                        and not getattr(estimator, "_warned_train_monitor",
+                                        False):
+                    estimator._warned_train_monitor = True
+                    warnings.warn(
+                        "%s: validation metrics have no value yet; "
+                        "monitoring TRAIN metric %r until validation runs"
+                        % (who, name))
+                return name, val
+    if monitor is None or matched_nan:
+        # nothing has a value yet (e.g. before the first batch) — skip this
+        # round rather than warn about a typo that isn't one
+        return None, None
     warnings.warn("%s: monitored metric %r not found among %s"
                   % (who, monitor,
                      [n for m in estimator.train_metrics

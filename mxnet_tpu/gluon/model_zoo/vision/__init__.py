@@ -2,21 +2,23 @@
 from .resnet import (ResNetV1, ResNetV2, resnet18_v1, resnet34_v1,  # noqa: F401
                      resnet50_v1, resnet101_v1, resnet152_v1, resnet18_v2,
                      resnet34_v2, resnet50_v2, resnet101_v2, resnet152_v2,
-                     get_resnet)
+                     resnet18_v1b, resnet34_v1b, resnet50_v1b, resnet101_v1b,
+                     resnet152_v1b, get_resnet)
 
 _models = {}
 
 
 def get_model(name, **kwargs):
-    """(ref: model_zoo/vision/__init__.py:get_model)"""
+    """(ref: model_zoo/vision/__init__.py:get_model)
+
+    ``pretrained`` accepts a PATH instead of the reference's downloadable
+    model store (zero-egress here): a native ``.params``/``.npz`` file, or a
+    torch checkpoint routed through ``gluon.model_zoo.convert`` (torchvision
+    resnets today). ``pretrained=True`` still refuses loudly."""
     from . import resnet, vgg, alexnet, mobilenet, squeezenet, densenet, inception
 
-    if kwargs.pop("pretrained", False):
-        # no model store is reachable (zero-egress TPU pods); silently
-        # returning random weights would be far worse than failing
-        raise ValueError(
-            "pretrained weights are not bundled; construct the model and "
-            "load a checkpoint explicitly with net.load_parameters(path)")
+    from ..convert import load_pretrained, resolve_pretrained
+    pretrained = resolve_pretrained(kwargs.pop("pretrained", False))
 
     registry = {
         "resnet18_v1": resnet.resnet18_v1, "resnet34_v1": resnet.resnet34_v1,
@@ -25,6 +27,9 @@ def get_model(name, **kwargs):
         "resnet18_v2": resnet.resnet18_v2, "resnet34_v2": resnet.resnet34_v2,
         "resnet50_v2": resnet.resnet50_v2, "resnet101_v2": resnet.resnet101_v2,
         "resnet152_v2": resnet.resnet152_v2,
+        "resnet18_v1b": resnet.resnet18_v1b, "resnet34_v1b": resnet.resnet34_v1b,
+        "resnet50_v1b": resnet.resnet50_v1b, "resnet101_v1b": resnet.resnet101_v1b,
+        "resnet152_v1b": resnet.resnet152_v1b,
         "vgg11": vgg.vgg11, "vgg13": vgg.vgg13, "vgg16": vgg.vgg16,
         "vgg19": vgg.vgg19, "vgg11_bn": vgg.vgg11_bn, "vgg13_bn": vgg.vgg13_bn,
         "vgg16_bn": vgg.vgg16_bn, "vgg19_bn": vgg.vgg19_bn,
@@ -43,4 +48,7 @@ def get_model(name, **kwargs):
     }
     if name.lower() not in registry:
         raise ValueError("model %s not found; available: %s" % (name, sorted(registry)))
-    return registry[name.lower()](**kwargs)
+    net = registry[name.lower()](**kwargs)
+    if pretrained:
+        load_pretrained(net, pretrained, name.lower())
+    return net

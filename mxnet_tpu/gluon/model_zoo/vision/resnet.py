@@ -11,7 +11,9 @@ from ... import nn
 
 __all__ = ["ResNetV1", "ResNetV2", "get_resnet",
            "resnet18_v1", "resnet34_v1", "resnet50_v1", "resnet101_v1", "resnet152_v1",
-           "resnet18_v2", "resnet34_v2", "resnet50_v2", "resnet101_v2", "resnet152_v2"]
+           "resnet18_v2", "resnet34_v2", "resnet50_v2", "resnet101_v2", "resnet152_v2",
+           "resnet18_v1b", "resnet34_v1b", "resnet50_v1b", "resnet101_v1b",
+           "resnet152_v1b"]
 
 
 def _conv3x3(channels, stride, in_channels):
@@ -60,6 +62,44 @@ class BottleneckV1(HybridBlock):
             self.downsample = nn.HybridSequential(prefix="")
             self.downsample.add(nn.Conv2D(channels, kernel_size=1, strides=stride,
                                           use_bias=False, in_channels=in_channels))
+            self.downsample.add(nn.BatchNorm())
+        else:
+            self.downsample = None
+
+    def hybrid_forward(self, F, x):
+        residual = x
+        x = self.body(x)
+        if self.downsample is not None:
+            residual = self.downsample(residual)
+        return F.Activation(x + residual, act_type="relu")
+
+
+class BottleneckV1b(HybridBlock):
+    """Torchvision-style ("v1.5") bottleneck: stride on the 3x3 conv rather
+    than the first 1x1, all convs bias-free — the layout torchvision's
+    pretrained resnet50/101/152 weights were trained with. GluonCV ships this
+    as resnet*_v1b for exactly this interop reason (ref: gluoncv
+    model_zoo/resnetv1b.py); here it is the transplant target for
+    ``model_zoo.convert`` torchvision checkpoints."""
+
+    def __init__(self, channels, stride, downsample=False, in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self.body = nn.HybridSequential(prefix="")
+        self.body.add(nn.Conv2D(channels // 4, kernel_size=1, strides=1,
+                                use_bias=False))
+        self.body.add(nn.BatchNorm())
+        self.body.add(nn.Activation("relu"))
+        self.body.add(_conv3x3(channels // 4, stride, channels // 4))
+        self.body.add(nn.BatchNorm())
+        self.body.add(nn.Activation("relu"))
+        self.body.add(nn.Conv2D(channels, kernel_size=1, strides=1,
+                                use_bias=False))
+        self.body.add(nn.BatchNorm())
+        if downsample:
+            self.downsample = nn.HybridSequential(prefix="")
+            self.downsample.add(nn.Conv2D(channels, kernel_size=1,
+                                          strides=stride, use_bias=False,
+                                          in_channels=in_channels))
             self.downsample.add(nn.BatchNorm())
         else:
             self.downsample = None
@@ -218,16 +258,45 @@ resnet_block_versions = [
 
 
 def get_resnet(version, num_layers, pretrained=False, ctx=None, **kwargs):
-    if pretrained:
-        # no model store is reachable (zero-egress); silently returning
-        # random weights would masquerade as ImageNet initialization
-        raise ValueError(
-            "pretrained weights are not bundled; construct the model and "
-            "load a checkpoint explicitly with net.load_parameters(path)")
+    from ..convert import load_pretrained, resolve_pretrained
+    path = resolve_pretrained(pretrained)
     block_type, layers, channels = resnet_spec[num_layers]
     net = resnet_net_versions[version - 1](
         resnet_block_versions[version - 1][block_type], layers, channels, **kwargs)
+    if path:
+        load_pretrained(net, path, "resnet%d_v%d" % (num_layers, version))
     return net
+
+
+def _resnet_v1b(num_layers, pretrained=False, ctx=None, **kwargs):
+    from ..convert import load_pretrained, resolve_pretrained
+    path = resolve_pretrained(pretrained)
+    block_type, layers, channels = resnet_spec[num_layers]
+    blocks = {"basic_block": BasicBlockV1, "bottle_neck": BottleneckV1b}
+    net = ResNetV1(blocks[block_type], layers, channels, **kwargs)
+    if path:
+        load_pretrained(net, path, "resnet%d_v1b" % num_layers)
+    return net
+
+
+def resnet18_v1b(**kwargs):
+    return _resnet_v1b(18, **kwargs)
+
+
+def resnet34_v1b(**kwargs):
+    return _resnet_v1b(34, **kwargs)
+
+
+def resnet50_v1b(**kwargs):
+    return _resnet_v1b(50, **kwargs)
+
+
+def resnet101_v1b(**kwargs):
+    return _resnet_v1b(101, **kwargs)
+
+
+def resnet152_v1b(**kwargs):
+    return _resnet_v1b(152, **kwargs)
 
 
 def resnet18_v1(**kwargs):

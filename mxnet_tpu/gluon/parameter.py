@@ -108,6 +108,15 @@ class Parameter:
     def set_data(self, data):
         if not isinstance(data, NDArray):
             data = NDArray(jnp.asarray(data, dtype=self.dtype))
+        # a fully-known shape is a contract: silently swapping in a
+        # wrong-shaped array would defer the failure to an obscure XLA
+        # error at the next forward (and leave grad/_shape stale)
+        if self._shape and all(d > 0 for d in self._shape) \
+                and tuple(data.shape) != tuple(self._shape):
+            raise ValueError(
+                "Parameter %r: cannot set_data with shape %s; parameter "
+                "shape is %s" % (self.name, tuple(data.shape),
+                                 tuple(self._shape)))
         if self._data is None:
             self._shape = tuple(data.shape)
             self._data = data

@@ -359,9 +359,46 @@ class Module:
         aux = {n: v for n, v in self._arg_params.items() if self._is_aux(n)}
         return args, aux
 
-    def set_params(self, arg_params, aux_params=None, **kwargs):
-        self._arg_params.update(arg_params or {})
-        self._arg_params.update(aux_params or {})
+    def set_params(self, arg_params, aux_params=None, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        """Write values IN PLACE: the bound executor's arg_dict holds the
+        same NDArray objects as _arg_params (bind shares, forward reads
+        arg_dict), so replacing dict entries after bind would be a silent
+        no-op for subsequent forwards — upstream set_params writes through
+        to the executors (ref: module/module.py:set_params).
+
+        ``allow_extra=False`` rejects names the module doesn't know (a typo
+        would otherwise land in a dead dict entry no executor reads);
+        ``allow_missing=False`` requires every module parameter present."""
+        given = dict(arg_params or {})
+        given.update(aux_params or {})
+        if self._arg_params:
+            extra = sorted(set(given) - set(self._arg_params))
+            if extra and not allow_extra:
+                raise ValueError(
+                    "set_params: unknown parameter(s) %s (module has %s...); "
+                    "pass allow_extra=True to ignore"
+                    % (extra[:5], sorted(self._arg_params)[:5]))
+            missing = sorted(set(self._arg_params) - set(given))
+            if missing and not allow_missing:
+                raise ValueError(
+                    "set_params: missing parameter(s) %s; pass "
+                    "allow_missing=True to keep current values"
+                    % (missing[:5],))
+        for n, v in given.items():
+            if self._arg_params and n not in self._arg_params:
+                continue  # allow_extra: ignored, like upstream
+            new = v._data if isinstance(v, NDArray) else jnp.asarray(v)
+            cur = self._arg_params.get(n)
+            if cur is None:
+                self._arg_params[n] = v if isinstance(v, NDArray) \
+                    else NDArray(new)
+            else:
+                if tuple(new.shape) != tuple(cur._data.shape):
+                    raise ValueError(
+                        "set_params: %r has shape %s; module expects %s"
+                        % (n, tuple(new.shape), tuple(cur._data.shape)))
+                cur._data = new.astype(cur._data.dtype)
 
     def save_checkpoint(self, prefix, epoch):
         """prefix-symbol.json + prefix-NNNN.params, the mx.model layout

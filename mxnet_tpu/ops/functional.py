@@ -67,6 +67,14 @@ erf = _u("erf", jsp.erf)
 erfinv = _u("erfinv", jsp.erfinv)
 gammaln = _u("gammaln", jsp.gammaln)
 gamma = _u("gamma", lambda x: jnp.exp(jsp.gammaln(x)))
+digamma = _u("digamma", jsp.digamma)
+
+
+@register_op("polygamma")
+def polygamma(n, x):
+    """n-th derivative of digamma at x (ref: special_functions-inl.h); n is a
+    static non-negative int order, x the array argument."""
+    return jsp.polygamma(jnp.asarray(n), x)
 sigmoid = _u("sigmoid", jax.nn.sigmoid)
 softsign = _u("softsign", jax.nn.soft_sign)
 relu = _u("relu", jax.nn.relu)

@@ -378,7 +378,7 @@ def _load_block_artifact(path=None):
             raw = json.load(f)
         table = {int(k): (int(v[0]), int(v[1]))
                  for k, v in raw["blocks"].items()}
-    except (OSError, ValueError, KeyError, TypeError):
+    except Exception:  # malformed in ANY way — tuning must not break import
         return False
     if table:
         BLOCK_DEFAULTS = table

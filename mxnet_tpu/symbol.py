@@ -260,6 +260,11 @@ class Symbol:
         def ser(s, nodes, index):
             if id(s) in index:
                 return index[id(s)]
+            if s._op == "_callable":
+                raise ValueError(
+                    "symbol %r wraps a host closure (autograd.get_symbol "
+                    "tape capture) and cannot be serialized to json; "
+                    "rebuild the graph with symbol ops to save it" % s.name)
             # children first so inputs reference earlier node ids
             child_ids = [ser(i, nodes, index) for i in s._inputs]
             nid_attrs = {}
@@ -437,6 +442,12 @@ def _eval(sym, env, cache, keyctx=None, shared=frozenset()):
         val = env[sym.name]
     elif sym._op == "_group":
         val = [_eval(i, env, cache, keyctx, shared) for i in sym._inputs]
+    elif sym._op == "_callable":
+        # a host jax-traceable closure wrapped as one graph node — produced
+        # by autograd.get_symbol (tape capture); evals/binds/differentiates
+        # like any registry op but cannot serialize
+        ins = [_eval(i, env, cache, keyctx, shared) for i in sym._inputs]
+        val = sym._attrs["fn"](*ins)
     elif sym._op == "_item":
         parent = _eval(sym._inputs[0], env, cache, keyctx, shared)
         idx = sym._attrs["index"]

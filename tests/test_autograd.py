@@ -317,3 +317,43 @@ def test_function_multi_input_output():
     out.backward()
     np.testing.assert_allclose(a.grad.asnumpy(), 1.0 + b.asnumpy(), rtol=1e-6)
     np.testing.assert_allclose(b.grad.asnumpy(), 1.0 + a.asnumpy(), rtol=1e-6)
+
+def test_get_symbol_captures_tape():
+    """autograd.get_symbol returns a Symbol of the recorded history
+    (ref: python/mxnet/autograd.py:get_symbol): eval matches the recorded
+    forward, gradients flow through bind/backward, json refuses loudly."""
+    import pytest
+    from mxnet_tpu import autograd, nd
+
+    a = nd.array(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    b = nd.array(np.array([[0.5, 0.5], [0.5, 0.5]], np.float32))
+    with autograd.record():
+        y = (a * b + nd.sqrt(a)).sum(axis=1)
+    sym = autograd.get_symbol(y)
+
+    names = sym.list_arguments()
+    assert names == ["arg0", "arg1"]
+    outs = sym.eval(**{names[0]: a, names[1]: b})
+    np.testing.assert_allclose(outs[0].asnumpy(), y.asnumpy(), rtol=1e-6)
+
+    # gradient through the captured graph == autograd on the original
+    ex = sym.bind(args={names[0]: a, names[1]: b},
+                  args_grad={names[0]: nd.zeros(a.shape),
+                             names[1]: nd.zeros(b.shape)})
+    ex.forward(is_train=True)
+    ex.backward(nd.ones(y.shape))
+    want_da = (b.asnumpy() + 0.5 / np.sqrt(a.asnumpy()))
+    np.testing.assert_allclose(ex.grad_dict[names[0]].asnumpy(),
+                               want_da, rtol=1e-5)
+
+    with pytest.raises(ValueError, match="host closure"):
+        sym.tojson()
+
+
+def test_get_symbol_requires_history():
+    import pytest
+    from mxnet_tpu import autograd, nd
+
+    x = nd.array(np.ones((2,), np.float32))
+    with pytest.raises(ValueError, match="no recorded"):
+        autograd.get_symbol(x)

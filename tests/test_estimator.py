@@ -290,3 +290,35 @@ def test_logging_epoch_only(capsys):
             event_handlers=[LoggingHandler(log_interval="epoch")])
     out = capsys.readouterr().out
     assert "samples/s" not in out and "epoch 0 done" in out
+
+def test_default_monitor_prefers_validation_metric():
+    """monitor=None must track a VALIDATION metric when one has a value
+    (ADVICE r3): save-best/early-stop on a train metric rewards overfitting."""
+    from mxnet_tpu.gluon.contrib.estimator import _monitored_value
+
+    est, _ = _estimator()
+    # train acc deliberately 0.0 so val (1.0) is distinguishable below
+    est.train_metrics[0].update(nd.array([1, 1]), nd.array(np.eye(3)[[0, 0]]))
+    # no validation configured at all -> train metric is the only candidate
+    name, _ = _monitored_value(est, None, "test")
+    assert name == est.train_metrics[0].get()[0]
+
+    # validation configured but not yet run (NaN) -> train stands in,
+    # loudly (one-time warning), never silently for the whole run
+    import warnings as _w
+    est.val_metrics = [mx.metric.Accuracy()]
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        name, val = _monitored_value(est, None, "test")
+    assert name == est.train_metrics[0].get()[0] and val == 0.0
+    assert any("TRAIN metric" in str(r.message) for r in rec)
+
+    est.val_metrics[0].update(nd.array([1, 2]), nd.array(np.eye(3)[[1, 2]]))
+    name, val = _monitored_value(est, None, "test")
+    assert name == est.val_metrics[0].get()[0]
+    assert val == 1.0
+
+    # explicit monitor still finds train metrics
+    tname = est.train_metrics[0].get()[0]
+    name, val = _monitored_value(est, tname, "test")
+    assert name == tname

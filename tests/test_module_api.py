@@ -423,3 +423,58 @@ def test_module_checkpoint_aux_split(tmp_path):
     ref = mod.forward(batch, is_train=False)[0].asnumpy()
     np.testing.assert_allclose(mod2.forward(batch, is_train=False)[0].asnumpy(),
                                ref, rtol=1e-6)
+
+def test_set_params_after_bind_takes_effect():
+    """set_params on a BOUND module must write through to the executor
+    (ADVICE r3): forward reads the bound arg NDArrays, so post-bind
+    set_params has to update values in place, not swap dict entries."""
+    data = sym.var("data")
+    fw = sym.var("fc_weight")
+    fb = sym.var("fc_bias")
+    out = sym.FullyConnected(data, fw, fb, num_hidden=3)
+    m = Module(out, data_names=("data",), label_names=())
+    m.bind([("data", (2, 4))], for_training=False)
+    m.init_params()
+    x = nd.array(np.ones((2, 4), np.float32))
+    first = m.forward(DataBatch([x], None), is_train=False)[0].asnumpy()
+
+    w = np.full((3, 4), 0.5, np.float32)
+    b = np.arange(3, dtype=np.float32)
+    m.set_params({"fc_weight": nd.array(w), "fc_bias": nd.array(b)})
+    got = m.forward(DataBatch([x], None), is_train=False)[0].asnumpy()
+    want = np.ones((2, 4), np.float32) @ w.T + b
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    assert not np.allclose(first, got)
+
+
+def test_set_params_shape_mismatch_raises():
+    data = sym.var("data")
+    fw = sym.var("fc_weight")
+    fb = sym.var("fc_bias")
+    out = sym.FullyConnected(data, fw, fb, num_hidden=3)
+    m = Module(out, data_names=("data",), label_names=())
+    m.bind([("data", (2, 4))], for_training=False)
+    m.init_params()
+    import pytest
+    with pytest.raises(ValueError, match="fc_weight"):
+        m.set_params({"fc_weight": nd.array(np.zeros((5, 4), np.float32))},
+                     allow_missing=True)
+
+
+def test_set_params_rejects_unknown_and_missing_names():
+    import pytest
+    data = sym.var("data")
+    fw = sym.var("fc_weight")
+    fb = sym.var("fc_bias")
+    out = sym.FullyConnected(data, fw, fb, num_hidden=3)
+    m = Module(out, data_names=("data",), label_names=())
+    m.bind([("data", (2, 4))], for_training=False)
+    m.init_params()
+    w = nd.array(np.zeros((3, 4), np.float32))
+    b = nd.array(np.zeros((3,), np.float32))
+    with pytest.raises(ValueError, match="unknown parameter"):
+        m.set_params({"fc_weigth": w, "fc_bias": b})  # typo must not be a no-op
+    with pytest.raises(ValueError, match="missing parameter"):
+        m.set_params({"fc_weight": w})
+    m.set_params({"fc_weight": w}, allow_missing=True)  # explicit opt-in ok
+    m.set_params({"fc_weight": w, "fc_bias": b, "junk": b}, allow_extra=True)

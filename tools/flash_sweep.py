@@ -176,9 +176,11 @@ def apply_winners(rows, source, measured_at=None):
            "swept_at": measured_at,
            "note": "winners by min fwd_bwd_ms per seq; written by "
                    "tools/flash_sweep.py --apply"}
-    with open(fa._BLOCKS_ARTIFACT, "w") as f:
+    tmp = fa._BLOCKS_ARTIFACT + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(art, f, indent=1, sort_keys=True)
         f.write("\n")
+    os.replace(tmp, fa._BLOCKS_ARTIFACT)  # atomic: never a half-written table
     print("applied block winners to %s: %s" % (fa._BLOCKS_ARTIFACT, blocks))
     return 0
 

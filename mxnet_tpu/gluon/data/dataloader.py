@@ -15,7 +15,7 @@ from ...ndarray import NDArray, array
 from .dataset import Dataset
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
 
-__all__ = ["DataLoader", "default_batchify_fn"]
+__all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
 
 
 def default_batchify_fn(data):
@@ -29,6 +29,44 @@ def default_batchify_fn(data):
     if arr.dtype == np.float64:
         arr = arr.astype(np.float32)
     return array(arr)
+
+
+def default_mp_batchify_fn(data):
+    """Batchify that stays in NUMPY — what worker processes return (ref:
+    dataloader.py:default_mp_batchify_fn, which uses shared-memory mx
+    arrays): device arrays must not be created in (or pickled back from)
+    forked children; the parent converts once per batch."""
+    if isinstance(data[0], NDArray):
+        return np.stack([d.asnumpy() for d in data])
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_mp_batchify_fn(list(i)) for i in data]
+    arr = np.asarray(data)
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    return arr
+
+
+_worker_dataset = None
+
+
+def _worker_initializer(dataset):
+    # runs once per worker process; the dataset rides the initargs pickle
+    # (fork start method shares it copy-on-write anyway)
+    global _worker_dataset
+    _worker_dataset = dataset
+
+
+def _worker_fn(indices, batchify_fn):
+    return batchify_fn([_worker_dataset[i] for i in indices])
+
+
+def _to_device(batch):
+    if isinstance(batch, np.ndarray):
+        return array(batch)
+    if isinstance(batch, (list, tuple)):
+        return [_to_device(b) for b in batch]
+    return batch
 
 
 class DataLoader:
@@ -45,6 +83,8 @@ class DataLoader:
                 raise ValueError("shuffle must be False with custom sampler")
             batch_sampler = BatchSampler(sampler, batch_size, last_batch or "keep")
         self._batch_sampler = batch_sampler
+        self._thread_pool = thread_pool
+        self._user_batchify = batchify_fn
         self._batchify_fn = batchify_fn or default_batchify_fn
         self._num_workers = num_workers
         self._prefetch = max(0, prefetch if prefetch is not None else 2 * max(num_workers, 1))
@@ -57,7 +97,10 @@ class DataLoader:
             for indices in self._batch_sampler:
                 yield self._make_batch(indices)
             return
-        yield from self._prefetch_iter()
+        if self._thread_pool:
+            yield from self._prefetch_iter()
+        else:
+            yield from self._mp_iter()
 
     def _prefetch_iter(self):
         """num_workers batches build CONCURRENTLY on a thread pool (numpy /
@@ -86,6 +129,50 @@ class DataLoader:
         finally:
             # an early `break` in the consumer must not stall on the whole
             # in-flight window finishing its (possibly expensive) batches
+            pool.shutdown(wait=False, cancel_futures=True)
+
+    def _mp_iter(self):
+        """thread_pool=False: num_workers PROCESSES, sidestepping the GIL
+        for pure-Python transforms (upstream's default worker model; the
+        thread pool remains best for native decode paths that release the
+        GIL). Workers batchify in numpy (default_mp_batchify_fn); the parent
+        converts to device arrays. Same bounded window + strict order as
+        the thread path. Dataset (and a custom batchify_fn) must pickle, and
+        the entry script needs the standard ``if __name__ == "__main__"``
+        guard: workers are SPAWNED, not forked — forking after jax has
+        initialized deadlocks on locks the PJRT client's threads hold across
+        fork (observed with the axon relay client), so each worker is a
+        fresh interpreter that simply never touches the jax backend."""
+        import multiprocessing
+        from collections import deque
+        from concurrent.futures import ProcessPoolExecutor
+
+        batchify = self._user_batchify or default_mp_batchify_fn
+        if batchify is default_batchify_fn:
+            # the device-array batchify must not run in workers: each child
+            # would initialize its own backend client and try to pickle
+            # device arrays back — numpy until the parent converts
+            batchify = default_mp_batchify_fn
+        window = max(self._prefetch, self._num_workers)
+        pool = ProcessPoolExecutor(self._num_workers,
+                                   mp_context=multiprocessing.get_context(
+                                       "spawn"),
+                                   initializer=_worker_initializer,
+                                   initargs=(self._dataset,))
+        try:
+            futs = deque()
+            it = iter(self._batch_sampler)
+            for indices in it:
+                futs.append(pool.submit(_worker_fn, indices, batchify))
+                if len(futs) >= window:
+                    break
+            while futs:
+                f = futs.popleft()
+                nxt = next(it, None)
+                if nxt is not None:
+                    futs.append(pool.submit(_worker_fn, nxt, batchify))
+                yield _to_device(f.result())
+        finally:
             pool.shutdown(wait=False, cancel_futures=True)
 
     def __len__(self):

@@ -172,6 +172,16 @@ def resolve_pretrained(pretrained):
     return pretrained or None
 
 
+def build_with_pretrained(factory, name, pretrained, **kwargs):
+    """The ONE pretrained code path every zoo factory routes through:
+    validate ``pretrained`` before construction, build, then load."""
+    path = resolve_pretrained(pretrained)
+    net = factory(**kwargs)
+    if path:
+        load_pretrained(net, path, name)
+    return net
+
+
 _RESNET_NAME = re.compile(r"^resnet(\d+)_v(1b?|2)$")
 
 

@@ -17,8 +17,8 @@ def get_model(name, **kwargs):
     resnets today). ``pretrained=True`` still refuses loudly."""
     from . import resnet, vgg, alexnet, mobilenet, squeezenet, densenet, inception
 
-    from ..convert import load_pretrained, resolve_pretrained
-    pretrained = resolve_pretrained(kwargs.pop("pretrained", False))
+    from ..convert import build_with_pretrained
+    pretrained = kwargs.pop("pretrained", False)
 
     registry = {
         "resnet18_v1": resnet.resnet18_v1, "resnet34_v1": resnet.resnet34_v1,
@@ -48,7 +48,5 @@ def get_model(name, **kwargs):
     }
     if name.lower() not in registry:
         raise ValueError("model %s not found; available: %s" % (name, sorted(registry)))
-    net = registry[name.lower()](**kwargs)
-    if pretrained:
-        load_pretrained(net, pretrained, name.lower())
-    return net
+    return build_with_pretrained(registry[name.lower()], name.lower(),
+                                 pretrained, **kwargs)

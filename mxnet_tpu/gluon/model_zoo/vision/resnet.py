@@ -258,25 +258,22 @@ resnet_block_versions = [
 
 
 def get_resnet(version, num_layers, pretrained=False, ctx=None, **kwargs):
-    from ..convert import load_pretrained, resolve_pretrained
-    path = resolve_pretrained(pretrained)
+    from ..convert import build_with_pretrained
     block_type, layers, channels = resnet_spec[num_layers]
-    net = resnet_net_versions[version - 1](
-        resnet_block_versions[version - 1][block_type], layers, channels, **kwargs)
-    if path:
-        load_pretrained(net, path, "resnet%d_v%d" % (num_layers, version))
-    return net
+    return build_with_pretrained(
+        lambda **kw: resnet_net_versions[version - 1](
+            resnet_block_versions[version - 1][block_type], layers, channels,
+            **kw),
+        "resnet%d_v%d" % (num_layers, version), pretrained, **kwargs)
 
 
 def _resnet_v1b(num_layers, pretrained=False, ctx=None, **kwargs):
-    from ..convert import load_pretrained, resolve_pretrained
-    path = resolve_pretrained(pretrained)
+    from ..convert import build_with_pretrained
     block_type, layers, channels = resnet_spec[num_layers]
     blocks = {"basic_block": BasicBlockV1, "bottle_neck": BottleneckV1b}
-    net = ResNetV1(blocks[block_type], layers, channels, **kwargs)
-    if path:
-        load_pretrained(net, path, "resnet%d_v1b" % num_layers)
-    return net
+    return build_with_pretrained(
+        lambda **kw: ResNetV1(blocks[block_type], layers, channels, **kw),
+        "resnet%d_v1b" % num_layers, pretrained, **kwargs)
 
 
 def resnet18_v1b(**kwargs):

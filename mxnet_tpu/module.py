@@ -372,27 +372,32 @@ class Module:
         ``allow_missing=False`` requires every module parameter present."""
         given = dict(arg_params or {})
         given.update(aux_params or {})
-        if self._arg_params:
-            extra = sorted(set(given) - set(self._arg_params))
+        known = set(self._arg_params)  # snapshot BEFORE mutating in the loop
+        if known:
+            extra = sorted(set(given) - known)
             if extra and not allow_extra:
                 raise ValueError(
                     "set_params: unknown parameter(s) %s (module has %s...); "
                     "pass allow_extra=True to ignore"
-                    % (extra[:5], sorted(self._arg_params)[:5]))
-            missing = sorted(set(self._arg_params) - set(given))
+                    % (extra[:5], sorted(known)[:5]))
+            missing = sorted(known - set(given))
             if missing and not allow_missing:
                 raise ValueError(
                     "set_params: missing parameter(s) %s; pass "
                     "allow_missing=True to keep current values"
                     % (missing[:5],))
         for n, v in given.items():
-            if self._arg_params and n not in self._arg_params:
+            if known and n not in known:
                 continue  # allow_extra: ignored, like upstream
             new = v._data if isinstance(v, NDArray) else jnp.asarray(v)
             cur = self._arg_params.get(n)
             if cur is None:
                 self._arg_params[n] = v if isinstance(v, NDArray) \
                     else NDArray(new)
+            elif not force_init:
+                import warnings
+                warnings.warn("set_params: %r already initialized and "
+                              "force_init=False; keeping current value" % n)
             else:
                 if tuple(new.shape) != tuple(cur._data.shape):
                     raise ValueError(

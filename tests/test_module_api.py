@@ -478,3 +478,41 @@ def test_set_params_rejects_unknown_and_missing_names():
         m.set_params({"fc_weight": w})
     m.set_params({"fc_weight": w}, allow_missing=True)  # explicit opt-in ok
     m.set_params({"fc_weight": w, "fc_bias": b, "junk": b}, allow_extra=True)
+
+
+def test_set_params_before_bind_keeps_all_entries():
+    """Pre-bind set_params (empty _arg_params) must store EVERY given param
+    — regression: the allow_extra skip once re-checked membership against
+    the dict it was filling, dropping all but the first entry."""
+    data = sym.var("data")
+    fw = sym.var("fc_weight")
+    fb = sym.var("fc_bias")
+    out = sym.FullyConnected(data, fw, fb, num_hidden=3)
+    m = Module(out, data_names=("data",), label_names=())
+    w = nd.array(np.full((3, 4), 0.25, np.float32))
+    b = nd.array(np.arange(3, dtype=np.float32))
+    m.set_params({"fc_weight": w, "fc_bias": b})
+    assert set(m._arg_params) == {"fc_weight", "fc_bias"}
+
+    m.bind([("data", (2, 4))], for_training=False)
+    x = nd.array(np.ones((2, 4), np.float32))
+    got = m.forward(DataBatch([x], None), is_train=False)[0].asnumpy()
+    want = np.ones((2, 4), np.float32) @ w.asnumpy().T + b.asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_set_params_force_init_false_keeps_values():
+    import pytest
+    data = sym.var("data")
+    fw = sym.var("fc_weight")
+    fb = sym.var("fc_bias")
+    out = sym.FullyConnected(data, fw, fb, num_hidden=3)
+    m = Module(out, data_names=("data",), label_names=())
+    m.bind([("data", (2, 4))], for_training=False)
+    m.init_params()
+    before = m._arg_params["fc_weight"].asnumpy().copy()
+    with pytest.warns(UserWarning, match="force_init"):
+        m.set_params({"fc_weight": nd.array(np.zeros((3, 4), np.float32)),
+                      "fc_bias": nd.array(np.zeros(3, np.float32))},
+                     force_init=False)
+    np.testing.assert_allclose(m._arg_params["fc_weight"].asnumpy(), before)

@@ -549,13 +549,25 @@ def probe_backend(budget_s, probe_timeout=120):
         sleep_s = min(int(sleep_s * 1.5), 300)
 
 
+def _make_key():
+    """Step RNG key. Default is the 'rbg' generator: threefry (jax's
+    default) burns real ALU time producing dropout bits — material at 12
+    layers x several dropout sites per step on TPU — while rbg uses the
+    hardware RNG instruction. BENCH_PRNG=threefry opts back out (the
+    training numerics are dropout noise either way)."""
+    impl = os.environ.get("BENCH_PRNG", "rbg")
+    if impl == "threefry":
+        return "threefry", jax.random.PRNGKey(0)
+    return impl, jax.random.key(0, impl=impl)
+
+
 def run_mode(mode, results, smoke=False, iters=None, headline=False,
              batch_override=None, remat=False):
     rng = np.random.default_rng(0)
     _log("building model + train step (%s)..." % mode)
     (step, params, states, batch, units, metric, unit, baseline,
      mfu_fn) = _mode_spec(mode, rng, smoke, batch_override, remat)
-    key = jax.random.PRNGKey(0)
+    prng_impl, key = _make_key()
 
     # warmup / compile. NOTE: under the axon relay block_until_ready can
     # return before remote execution finishes, so timing is gated by a HOST
@@ -589,6 +601,7 @@ def run_mode(mode, results, smoke=False, iters=None, headline=False,
         "iters": iters,
         "batch": (batch_override or "default"),
         "remat": remat,
+        "prng": prng_impl,
         "platform": jax.devices()[0].platform,
     }
     if mfu_fn is not None:

@@ -1,0 +1,55 @@
+"""Load external pretrained weights without a model store.
+
+The reference downloads zoo weights from its model store; TPU pods here are
+zero-egress, so ``mxnet_tpu`` CONVERTS checkpoints you already have:
+
+  1. torchvision resnet/mobilenet checkpoints (.pth)  -> vision zoo models
+  2. HuggingFace BERT checkpoints                     -> models.bert.BERTModel
+  3. one-time conversion to a native .params file     -> plain load_parameters
+
+Run:  python examples/load_pretrained.py /path/to/resnet18.pth
+(the demo falls back to generating a torch checkpoint with
+tools/torch_resnet_ref.py when no path is given).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from mxnet_tpu import nd
+from mxnet_tpu.gluon.model_zoo.vision import get_model
+
+
+def main():
+    if len(sys.argv) > 1:
+        ckpt = sys.argv[1]
+    else:  # demo: fabricate a torchvision-layout checkpoint
+        import torch
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))), "tools"))
+        import torch_resnet_ref as tref
+        ckpt = "/tmp/resnet18_demo.pth"
+        torch.save(tref.resnet18().state_dict(), ckpt)
+        print("no checkpoint given; wrote a demo torchvision-layout "
+              "checkpoint to %s" % ckpt)
+
+    # 1. straight into a model (torchvision basic-block resnets map onto
+    #    *_v1; bottleneck resnets onto *_v1b — the v1.5 stride layout)
+    net = get_model("resnet18_v1", pretrained=ckpt)
+    x = nd.array(np.random.default_rng(0)
+                 .normal(size=(1, 3, 224, 224)).astype(np.float32))
+    print("resnet18_v1 logits[0,:5] =", net(x).asnumpy()[0, :5])
+
+    # 2. convert ONCE to a native file, then load natively forever
+    net.save_parameters("/tmp/resnet18_native.params")
+    net2 = get_model("resnet18_v1", pretrained="/tmp/resnet18_native.params")
+    assert np.allclose(net2(x).asnumpy(), net(x).asnumpy())
+    print("native .params round-trip OK "
+          "(or: python -m mxnet_tpu.gluon.model_zoo.convert "
+          "resnet18_v1 %s out.params)" % ckpt)
+
+
+if __name__ == "__main__":
+    main()

@@ -87,6 +87,7 @@ class DataLoader:
         self._user_batchify = batchify_fn
         self._batchify_fn = batchify_fn or default_batchify_fn
         self._num_workers = num_workers
+        self._mp_pool = None
         self._prefetch = max(0, prefetch if prefetch is not None else 2 * max(num_workers, 1))
 
     def _make_batch(self, indices):
@@ -154,13 +155,18 @@ class DataLoader:
             # device arrays back — numpy until the parent converts
             batchify = default_mp_batchify_fn
         window = max(self._prefetch, self._num_workers)
-        pool = ProcessPoolExecutor(self._num_workers,
-                                   mp_context=multiprocessing.get_context(
-                                       "spawn"),
-                                   initializer=_worker_initializer,
-                                   initargs=(self._dataset,))
+        if self._mp_pool is None:
+            # the pool outlives one epoch: spawn pays a full interpreter
+            # start + package import per worker, so it is created once per
+            # loader (workers are stateless beyond the pickled dataset)
+            self._mp_pool = ProcessPoolExecutor(
+                self._num_workers,
+                mp_context=multiprocessing.get_context("spawn"),
+                initializer=_worker_initializer,
+                initargs=(self._dataset,))
+        pool = self._mp_pool
+        futs = deque()
         try:
-            futs = deque()
             it = iter(self._batch_sampler)
             for indices in it:
                 futs.append(pool.submit(_worker_fn, indices, batchify))
@@ -173,6 +179,14 @@ class DataLoader:
                     futs.append(pool.submit(_worker_fn, nxt, batchify))
                 yield _to_device(f.result())
         finally:
+            # early break: drop this epoch's in-flight work but KEEP the
+            # pool for the next epoch
+            for f in futs:
+                f.cancel()
+
+    def __del__(self):
+        pool = self.__dict__.get("_mp_pool")
+        if pool is not None:
             pool.shutdown(wait=False, cancel_futures=True)
 
     def __len__(self):

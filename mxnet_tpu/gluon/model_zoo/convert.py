@@ -90,6 +90,31 @@ def convert_torchvision_resnet(state):
     return out
 
 
+def convert_torchvision_generic(state, rename=None):
+    """torchvision-style state_dict -> structural keys, for models whose
+    module paths already mirror ours 1:1 (``MobileNetV2TV``): BatchNorm
+    tensors rename via running_mean-prefix detection (a BN's .weight is
+    gamma; a conv's .weight is a weight), everything else passes through,
+    ``rename`` maps leading module paths (e.g. ``classifier.1`` ->
+    ``output``)."""
+    bn = {k[: -len(".running_mean")]
+          for k in state if k.endswith(".running_mean")}
+    out = {}
+    for k, v in state.items():
+        if k.endswith("num_batches_tracked"):
+            continue
+        orig_pre, _, attr = k.rpartition(".")
+        path = k
+        for old, new in (rename or {}).items():
+            if path == old or path.startswith(old + "."):
+                path = new + path[len(old):]
+                break  # one rename per key — chained maps must not cascade
+        pre = path.rpartition(".")[0]
+        name = _BN[attr] if orig_pre in bn and attr in _BN else attr
+        out[pre + "." + name] = _to_np(v)
+    return out
+
+
 def apply_converted(net, mapping, strict=True):
     """Push {structural key: array} into a Block's parameters.
 
@@ -197,6 +222,9 @@ def load_pretrained(net, path, name):
         raise ValueError("unrecognized checkpoint extension in %r "
                          "(.params/.npz native, .pth/.pt/.bin torch)" % p)
     state = load_torch_state(p)
+    if name == "mobilenet_v2_tv":
+        return apply_converted(net, convert_torchvision_generic(
+            state, rename={"classifier.1": "output"}))
     m = _RESNET_NAME.match(name)
     if m:
         ver = m.group(2)
@@ -213,8 +241,8 @@ def load_pretrained(net, path, name):
         return apply_converted(net, convert_torchvision_resnet(state))
     raise ValueError(
         "no torch converter registered for model %r; supported: resnet*_v1 "
-        "(basic blocks), resnet*_v1b (bottlenecks), and transplant_hf_bert "
-        "for BERT checkpoints" % name)
+        "(basic blocks), resnet*_v1b (bottlenecks), mobilenet_v2_tv, and "
+        "transplant_hf_bert for BERT checkpoints" % name)
 
 
 def _main(argv):

@@ -14,7 +14,8 @@ def get_model(name, **kwargs):
     ``pretrained`` accepts a PATH instead of the reference's downloadable
     model store (zero-egress here): a native ``.params``/``.npz`` file, or a
     torch checkpoint routed through ``gluon.model_zoo.convert`` (torchvision
-    resnets today). ``pretrained=True`` still refuses loudly."""
+    resnets and mobilenet_v2_tv today). ``pretrained=True`` still refuses
+    loudly."""
     from . import resnet, vgg, alexnet, mobilenet, squeezenet, densenet, inception
 
     from ..convert import build_with_pretrained
@@ -36,6 +37,7 @@ def get_model(name, **kwargs):
         "alexnet": alexnet.alexnet,
         "mobilenet1.0": mobilenet.mobilenet1_0, "mobilenet0.75": mobilenet.mobilenet0_75,
         "mobilenet0.5": mobilenet.mobilenet0_5, "mobilenet0.25": mobilenet.mobilenet0_25,
+        "mobilenet_v2_tv": mobilenet.mobilenet_v2_tv,
         "mobilenetv2_1.0": mobilenet.mobilenet_v2_1_0,
         "mobilenetv2_0.75": mobilenet.mobilenet_v2_0_75,
         "mobilenetv2_0.5": mobilenet.mobilenet_v2_0_5,

@@ -8,8 +8,9 @@ from __future__ import annotations
 from ...block import HybridBlock
 from ... import nn
 
-__all__ = ["MobileNet", "MobileNetV2", "mobilenet1_0", "mobilenet0_75",
-           "mobilenet0_5", "mobilenet0_25", "mobilenet_v2_1_0", "mobilenet_v2_0_5"]
+__all__ = ["MobileNet", "MobileNetV2", "MobileNetV2TV", "mobilenet1_0",
+           "mobilenet0_75", "mobilenet0_5", "mobilenet0_25",
+           "mobilenet_v2_1_0", "mobilenet_v2_0_5", "mobilenet_v2_tv"]
 
 
 def _conv_block(out, channels, kernel=3, stride=1, pad=1, num_group=1, active=True):
@@ -86,6 +87,86 @@ class MobileNetV2(HybridBlock):
         x = self.features(x)
         x = self.output(x)
         return self.flat(x)
+
+
+def _conv_bn_relu6(channels, kernel=3, stride=1, pad=1, groups=1):
+    """torchvision's ConvBNReLU triple as one HybridSequential, so the
+    structural indices (.0 conv, .1 bn) line up with its state_dict."""
+    out = nn.HybridSequential(prefix="")
+    out.add(nn.Conv2D(channels, kernel, stride, pad, groups=groups,
+                      use_bias=False))
+    out.add(nn.BatchNorm())
+    out.add(nn.Activation("relu6"))
+    return out
+
+
+class InvertedResidualTV(HybridBlock):
+    """torchvision MobileNetV2 block: relu6, NO expansion conv at t=1, and
+    the exact submodule layout (``conv.0`` expand / ``conv.1`` depthwise /
+    trailing project conv + bn) of torchvision.models.mobilenetv2 — the
+    transplant target for real torchvision checkpoints, which our upstream-
+    layout ``LinearBottleneck`` (always-expand, plain relu) is not."""
+
+    def __init__(self, in_channels, channels, t, stride, **kwargs):
+        super().__init__(**kwargs)
+        self.use_shortcut = stride == 1 and in_channels == channels
+        hidden = in_channels * t
+        with self.name_scope():
+            self.conv = nn.HybridSequential(prefix="")
+            if t != 1:
+                self.conv.add(_conv_bn_relu6(hidden, kernel=1, pad=0))
+            self.conv.add(_conv_bn_relu6(hidden, stride=stride, groups=hidden))
+            self.conv.add(nn.Conv2D(channels, 1, use_bias=False))
+            self.conv.add(nn.BatchNorm())
+
+    def hybrid_forward(self, F, x):
+        out = self.conv(x)
+        return out + x if self.use_shortcut else out
+
+
+class MobileNetV2TV(HybridBlock):
+    """MobileNetV2 in torchvision's exact layout (ref: upstream ships this
+    family pretrained via the model store; torchvision.models.mobilenet_v2
+    is the checkpoint source reachable offline). features.0 stem /
+    features.1-17 inverted residuals / features.18 head mirror the
+    torchvision indices so ``model_zoo.convert`` maps weights 1:1."""
+
+    # (t, c, n, s) — torchvision inverted_residual_setting
+    _SETTING = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+                (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+
+    def __init__(self, multiplier=1.0, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+
+        def _c(ch):
+            # torchvision _make_divisible(ch * multiplier, 8)
+            v = max(8, int(ch * multiplier + 4) // 8 * 8)
+            if v < 0.9 * ch * multiplier:
+                v += 8
+            return v
+
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="")
+            in_c = _c(32)
+            self.features.add(_conv_bn_relu6(in_c, stride=2))
+            for t, c, n, s in self._SETTING:
+                out_c = _c(c)
+                for i in range(n):
+                    self.features.add(InvertedResidualTV(
+                        in_c, out_c, t, s if i == 0 else 1))
+                    in_c = out_c
+            last = _c(1280) if multiplier > 1.0 else 1280
+            self.features.add(_conv_bn_relu6(last, kernel=1, pad=0))
+            self.output = nn.Dense(classes, in_units=last)
+
+    def hybrid_forward(self, F, x):
+        x = self.features(x)
+        x = F.mean(x, axis=(2, 3))  # torchvision adaptive avg pool to 1x1
+        return self.output(x)
+
+
+def mobilenet_v2_tv(**kw):
+    return MobileNetV2TV(1.0, **kw)
 
 
 def mobilenet1_0(**kw):

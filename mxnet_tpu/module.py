@@ -386,24 +386,28 @@ class Module:
                     "set_params: missing parameter(s) %s; pass "
                     "allow_missing=True to keep current values"
                     % (missing[:5],))
+        kept = []
         for n, v in given.items():
             if known and n not in known:
                 continue  # allow_extra: ignored, like upstream
             new = v._data if isinstance(v, NDArray) else jnp.asarray(v)
             cur = self._arg_params.get(n)
+            if cur is not None and tuple(new.shape) != tuple(cur._data.shape):
+                raise ValueError(
+                    "set_params: %r has shape %s; module expects %s"
+                    % (n, tuple(new.shape), tuple(cur._data.shape)))
             if cur is None:
                 self._arg_params[n] = v if isinstance(v, NDArray) \
                     else NDArray(new)
             elif not force_init:
-                import warnings
-                warnings.warn("set_params: %r already initialized and "
-                              "force_init=False; keeping current value" % n)
+                kept.append(n)
             else:
-                if tuple(new.shape) != tuple(cur._data.shape):
-                    raise ValueError(
-                        "set_params: %r has shape %s; module expects %s"
-                        % (n, tuple(new.shape), tuple(cur._data.shape)))
                 cur._data = new.astype(cur._data.dtype)
+        if kept:
+            import warnings
+            warnings.warn("set_params: force_init=False kept %d already-"
+                          "initialized parameter(s) (e.g. %r)"
+                          % (len(kept), kept[0]))
 
     def save_checkpoint(self, prefix, epoch):
         """prefix-symbol.json + prefix-NNNN.params, the mx.model layout

@@ -745,6 +745,9 @@ def Activation(x, *, act_type="relu"):
         return jax.nn.gelu(x, approximate=True)
     if act_type == "swish" or act_type == "silu":
         return jax.nn.silu(x)
+    if act_type == "relu6":
+        from .extra import relu6 as _relu6  # ONE relu6 definition
+        return _relu6(x)
     raise ValueError("unknown act_type %r" % act_type)
 
 

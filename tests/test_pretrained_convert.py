@@ -137,3 +137,21 @@ def test_hf_bert_state_dict_transplant():
                  token_type_ids=torch.tensor(tt.astype(np.int64)))
     np.testing.assert_allclose(seq.asnumpy(), ref.last_hidden_state.numpy(),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_torchvision_mobilenet_v2_numeric_oracle(tmp_path):
+    """MobileNetV2TV + convert_torchvision_generic vs the torchvision-naming
+    torch reference: full pretrained=<path> flow, randomized BN stats."""
+    import torch_mobilenet_ref as tmref
+    from mxnet_tpu.gluon.model_zoo.vision import get_model
+
+    torch.manual_seed(2)
+    tm = tmref.randomize_bn_stats(tmref.mobilenet_v2(num_classes=9), seed=2)
+    ckpt = tmp_path / "mbv2.pth"
+    torch.save(tm.state_dict(), ckpt)
+
+    net = get_model("mobilenet_v2_tv", pretrained=str(ckpt), classes=9)
+    x = np.random.default_rng(2).normal(size=(2, 3, 64, 64)).astype(np.float32)
+    ref = _torch_logits(tm, x)
+    got = _our_logits(net, x)
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)

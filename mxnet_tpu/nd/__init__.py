@@ -44,6 +44,8 @@ _UPDATE_STATE_ARGS = {
     "sgd_update": (), "signsgd_update": (),
     "sgd_mom_update": (2,), "rmsprop_update": (2,), "signum_update": (2,),
     "adam_update": (2, 3), "ftrl_update": (2, 3), "mp_sgd_update": (2,),
+    "lamb_update_phase1": (2, 3), "mp_lamb_update_phase1": (2, 3),
+    "mp_lamb_update_phase2": (4,),
 }
 
 

@@ -183,6 +183,27 @@ _reg_random("random_poisson",
 _reg_random("random_negative_binomial",
             lambda key, shp, dt, k=1, p=0.5:
             _rk.k_negative_binomial(key, shp, dt, k, p))
+# flat alias (upstream registers `normal` alongside random_normal)
+_reg_random("normal",
+            lambda key, shp, dt, loc=0.0, scale=1.0:
+            _rk.k_normal(key, shp, dt, loc, scale))
+
+
+def _k_gnb(key, shp, dt, mu, alpha):
+    """Gamma-Poisson mixture (ref: sample_op.cc
+    GeneralizedNegativeBinomialSampler): lam ~ Gamma(1/alpha, mu*alpha),
+    x ~ Poisson(lam); alpha == 0 is the Poisson(mu) limit (upstream's
+    degenerate case)."""
+    if alpha <= 0:
+        return jax.random.poisson(key, mu, shp).astype(dt)
+    k1, k2 = jax.random.split(key)
+    lam = jax.random.gamma(k1, 1.0 / alpha, shp) * (mu * alpha)
+    return jax.random.poisson(k2, lam, shp).astype(dt)
+
+
+_reg_random("random_generalized_negative_binomial",
+            lambda key, shp, dt, mu=1.0, alpha=1.0:
+            _k_gnb(key, shp, dt, mu, alpha))
 
 
 @register_op("random_randint", needs_rng=True, nondiff=True)
@@ -312,6 +333,102 @@ def adam_update(weight, grad, mean, var, *, lr, beta1=0.9, beta2=0.999,
     m = beta1 * mean + (1 - beta1) * g
     v = beta2 * var + (1 - beta2) * jnp.square(g)
     return weight - lr * m / (jnp.sqrt(v) + epsilon), m, v
+
+
+@register_op("lamb_update_phase1", nondiff=True, n_outputs=3)
+def lamb_update_phase1(weight, grad, mean, var, *, beta1=0.9, beta2=0.999,
+                       epsilon=1e-6, t=1, bias_correction=True, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=-1.0):
+    """LAMB raw update direction (ref: optimizer_op.cc LambUpdatePhaseOne):
+    adam moments + decoupled wd, NO lr yet — phase 2 applies the
+    layerwise trust ratio. Returns (g, new_mean, new_var)."""
+    g = _clip(grad * rescale_grad, clip_gradient)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    if bias_correction:
+        mh = m / (1.0 - beta1 ** t)
+        vh = v / (1.0 - beta2 ** t)
+    else:
+        mh, vh = m, v
+    return mh / (jnp.sqrt(vh) + epsilon) + wd * weight, m, v
+
+
+def _lamb_trust(weight_norm, g_norm, lr, lower_bound, upper_bound):
+    r1 = weight_norm
+    if lower_bound is not None and lower_bound > 0:
+        r1 = jnp.maximum(r1, lower_bound)
+    if upper_bound is not None and upper_bound > 0:
+        r1 = jnp.minimum(r1, upper_bound)
+    ratio = jnp.where((r1 > 0) & (g_norm > 0), r1 / g_norm, 1.0)
+    return lr * ratio
+
+
+@register_op("lamb_update_phase2", nondiff=True)
+def lamb_update_phase2(weight, g, r1, r2, *, lr, lower_bound=-1.0,
+                       upper_bound=-1.0):
+    """(ref: optimizer_op.cc LambUpdatePhaseTwo) r1/r2 = ||weight||/||g||
+    as computed by the caller (upstream chains norm ops)."""
+    return weight - _lamb_trust(r1, r2, lr, lower_bound, upper_bound) * g
+
+
+@register_op("mp_lamb_update_phase1", nondiff=True, n_outputs=3)
+def mp_lamb_update_phase1(weight, grad, mean, var, weight32, *, beta1=0.9,
+                          beta2=0.999, epsilon=1e-6, t=1,
+                          bias_correction=True, wd=0.0, rescale_grad=1.0,
+                          clip_gradient=-1.0):
+    """Multi-precision phase 1: moments/update in fp32 against the master
+    copy; the low-precision weight is only a cast source."""
+    g = _clip(grad.astype(jnp.float32) * rescale_grad, clip_gradient)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    if bias_correction:
+        mh = m / (1.0 - beta1 ** t)
+        vh = v / (1.0 - beta2 ** t)
+    else:
+        mh, vh = m, v
+    return mh / (jnp.sqrt(vh) + epsilon) + wd * weight32, m, v
+
+
+@register_op("mp_lamb_update_phase2", nondiff=True, n_outputs=2)
+def mp_lamb_update_phase2(weight, g, r1, r2, weight32, *, lr,
+                          lower_bound=-1.0, upper_bound=-1.0):
+    """Multi-precision phase 2: step the fp32 master, emit the cast weight.
+    Returns (new_weight, new_weight32)."""
+    new32 = weight32 - _lamb_trust(r1, r2, lr, lower_bound, upper_bound) * g
+    return new32.astype(weight.dtype), new32
+
+
+@register_op("multi_lars", nondiff=True)
+def multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, *, eta, eps,
+               rescale_grad=1.0):
+    """Per-tensor LARS learning rates in ONE fused op over the stacked
+    norms (ref: optimizer_op.cc MultiLars; pairs with multi_sum_sq)."""
+    w_norm = jnp.sqrt(weights_sum_sq)
+    g_norm = jnp.sqrt(grads_sum_sq) * rescale_grad
+    ratio = jnp.where(
+        (w_norm > 0) & (g_norm > 0),
+        eta * w_norm / (g_norm + wds * w_norm + eps), 1.0)
+    return lrs * ratio
+
+
+@register_op("preloaded_multi_sgd_update", nondiff=True)
+def preloaded_multi_sgd_update(*arrays, num_weights=None, rescale_grad=1.0,
+                               clip_gradient=-1.0):
+    """Fused SGD over many tensors with lrs/wds as DEVICE arrays (ref:
+    optimizer_op.cc PreloadedMultiSGDUpdate — the 'preloaded' part is
+    exactly that lrs/wds stay on device, no per-tensor host scalars).
+    arrays = [w0, g0, w1, g1, ..., lrs, wds]; returns the updated weights
+    as ONE list output (the arity varies with num_weights, so this is a
+    single grouped result rather than positional heads)."""
+    if num_weights is None:
+        num_weights = (len(arrays) - 2) // 2
+    lrs, wds = arrays[-2], arrays[-1]
+    outs = []
+    for i in range(num_weights):
+        w, g = arrays[2 * i], arrays[2 * i + 1]
+        g = _clip(g * rescale_grad, clip_gradient)
+        outs.append(w - lrs[i] * (g + wds[i] * w))
+    return outs
 
 
 @register_op("rmsprop_update", nondiff=True, n_outputs=2)

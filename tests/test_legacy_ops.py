@@ -434,3 +434,132 @@ def test_sym_cond_thunk_form():
     feed = {"p": nd.array(np.array([0.0], np.float32)),
             "x": nd.array(np.array([1.0, 2.0], np.float32))}
     np.testing.assert_allclose(c.eval(**feed)[0].asnumpy(), [3.0, 6.0])
+
+
+def test_lamb_update_phases_match_reference_math():
+    """(ref: optimizer_op.cc LambUpdatePhaseOne/Two) two-phase LAMB: phase1
+    emits the adam-moment + decoupled-wd direction, phase2 applies the
+    layerwise trust ratio — composed, one step matches a numpy LAMB."""
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(6, 4)).astype(np.float32)
+    g = rng.normal(size=(6, 4)).astype(np.float32)
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    b1, b2, eps, wd, lr, t = 0.9, 0.999, 1e-6, 0.01, 0.02, 1
+
+    upd, m2, v2 = nd.lamb_update_phase1(
+        nd.array(w), nd.array(g), nd.array(m), nd.array(v),
+        beta1=b1, beta2=b2, epsilon=eps, t=t, wd=wd)
+
+    # numpy oracle
+    m_ref = (1 - b1) * g
+    v_ref = (1 - b2) * g * g
+    mh = m_ref / (1 - b1 ** t)
+    vh = v_ref / (1 - b2 ** t)
+    upd_ref = mh / (np.sqrt(vh) + eps) + wd * w
+    np.testing.assert_allclose(upd.asnumpy(), upd_ref, rtol=1e-5)
+    np.testing.assert_allclose(m2.asnumpy(), m_ref, rtol=1e-6)
+    np.testing.assert_allclose(v2.asnumpy(), v_ref, rtol=1e-6)
+
+    r1 = float(np.linalg.norm(w))
+    r2 = float(np.linalg.norm(upd_ref))
+    new_w = nd.lamb_update_phase2(nd.array(w), upd, nd.array(np.float32(r1)),
+                                  nd.array(np.float32(r2)), lr=lr)
+    np.testing.assert_allclose(new_w.asnumpy(),
+                               w - lr * (r1 / r2) * upd_ref, rtol=1e-5)
+
+    # trust-ratio degenerate cases: zero weight norm -> ratio 1
+    new_w0 = nd.lamb_update_phase2(
+        nd.array(np.zeros_like(w)), upd, nd.array(np.float32(0.0)),
+        nd.array(np.float32(r2)), lr=lr)
+    np.testing.assert_allclose(new_w0.asnumpy(), -lr * upd_ref, rtol=1e-5)
+
+
+def test_mp_lamb_keeps_fp32_master():
+    rng = np.random.default_rng(1)
+    w32 = rng.normal(size=(8,)).astype(np.float32)
+    w16 = w32.astype(np.float16)
+    g = rng.normal(size=(8,)).astype(np.float16)
+    m = np.zeros(8, np.float32)
+    v = np.zeros(8, np.float32)
+    upd, m2, v2 = nd.mp_lamb_update_phase1(
+        nd.array(w16), nd.array(g), nd.array(m), nd.array(v),
+        nd.array(w32), t=1, wd=0.0)
+    assert upd.dtype == np.float32
+    r1 = np.float32(np.linalg.norm(w32))
+    r2 = np.float32(np.linalg.norm(upd.asnumpy()))
+    new_w, new_w32 = nd.mp_lamb_update_phase2(
+        nd.array(w16), upd, nd.array(r1), nd.array(r2), nd.array(w32),
+        lr=0.01)
+    assert new_w.dtype == np.float16 and new_w32.dtype == np.float32
+    np.testing.assert_allclose(new_w.asnumpy(),
+                               new_w32.asnumpy().astype(np.float16))
+
+
+def test_multi_lars_and_preloaded_sgd():
+    rng = np.random.default_rng(2)
+    ws = [rng.normal(size=(4, 3)).astype(np.float32),
+          rng.normal(size=(5,)).astype(np.float32)]
+    gs = [rng.normal(size=(4, 3)).astype(np.float32),
+          rng.normal(size=(5,)).astype(np.float32)]
+    wsq = nd.multi_sum_sq(nd.array(ws[0]), nd.array(ws[1]))
+    gsq = nd.multi_sum_sq(nd.array(gs[0]), nd.array(gs[1]))
+    base_lr = np.array([0.1, 0.1], np.float32)
+    wds = np.array([1e-4, 0.0], np.float32)
+    lrs = nd.multi_lars(nd.array(base_lr), wsq, gsq, nd.array(wds),
+                        eta=0.001, eps=1e-9)
+    wn = np.array([np.linalg.norm(w) for w in ws])
+    gn = np.array([np.linalg.norm(g) for g in gs])
+    ref = base_lr * 0.001 * wn / (gn + wds * wn + 1e-9)
+    np.testing.assert_allclose(lrs.asnumpy(), ref, rtol=1e-5)
+
+    outs = nd.preloaded_multi_sgd_update(
+        nd.array(ws[0]), nd.array(gs[0]), nd.array(ws[1]), nd.array(gs[1]),
+        lrs, nd.array(wds), num_weights=2)
+    for i, o in enumerate(outs):
+        ref_w = ws[i] - lrs.asnumpy()[i] * (gs[i] + wds[i] * ws[i])
+        np.testing.assert_allclose(o.asnumpy(), ref_w, rtol=1e-5)
+
+
+def test_generalized_negative_binomial_moments():
+    """GNB(mu, alpha): mean mu, variance mu + alpha*mu^2."""
+    import mxnet_tpu as mx
+    mx.random.seed(7)
+    x = nd.random_generalized_negative_binomial(
+        mu=4.0, alpha=0.25, shape=(20000,)).asnumpy()
+    assert abs(x.mean() - 4.0) < 0.15
+    assert abs(x.var() - (4.0 + 0.25 * 16.0)) < 0.8
+    # flat `normal` alias exists and draws at the right loc/scale
+    y = nd.normal(loc=2.0, scale=0.5, shape=(20000,)).asnumpy()
+    assert abs(y.mean() - 2.0) < 0.05 and abs(y.std() - 0.5) < 0.05
+
+
+def test_lamb_states_write_back_in_place():
+    """The nd facade's in-place state contract (nd/__init__.py
+    _UPDATE_STATE_ARGS) covers the LAMB phase kernels: a legacy call site
+    that reuses its mean/var (or the fp32 master) arrays must see them
+    advance."""
+    rng = np.random.default_rng(3)
+    w = nd.array(rng.normal(size=(4,)).astype(np.float32))
+    g = nd.array(rng.normal(size=(4,)).astype(np.float32))
+    mean = nd.zeros((4,))
+    var = nd.zeros((4,))
+    nd.lamb_update_phase1(w, g, mean, var, t=1)
+    assert abs(mean.asnumpy()).max() > 0
+    assert abs(var.asnumpy()).max() > 0
+
+    w32 = nd.array(w.asnumpy().astype(np.float32))
+    before = w32.asnumpy().copy()
+    upd = nd.array(np.ones(4, np.float32))
+    r = nd.array(np.float32(1.0))
+    nd.mp_lamb_update_phase2(w, upd, r, r, w32, lr=0.1)
+    assert not np.allclose(w32.asnumpy(), before)  # master stepped in place
+
+
+def test_gnb_alpha_zero_is_poisson():
+    import mxnet_tpu as mx
+    mx.random.seed(11)
+    x = nd.random_generalized_negative_binomial(
+        mu=3.0, alpha=0.0, shape=(20000,)).asnumpy()
+    assert abs(x.mean() - 3.0) < 0.1
+    assert abs(x.var() - 3.0) < 0.3  # Poisson limit: var == mean

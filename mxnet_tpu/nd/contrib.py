@@ -20,3 +20,17 @@ def _wrap(opname):
 
 for _alias, _op in CONTRIB_OPS.items():
     globals()[_alias] = _wrap(_op)
+
+
+def boolean_mask(data, index, axis=0):
+    """(ref: contrib/boolean_mask.cc) rows of data where index != 0.
+
+    The output SHAPE depends on index's VALUES, which XLA cannot compile —
+    this runs eagerly on host indices and is nondifferentiable here. Inside
+    jit/hybridize, mask with `where` (static shape) instead."""
+    import numpy as np
+
+    from ..ndarray import array
+
+    idx = np.flatnonzero(index.asnumpy())
+    return array(np.take(data.asnumpy(), idx, axis=axis))

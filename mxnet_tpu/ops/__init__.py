@@ -9,6 +9,7 @@ from . import rcnn  # noqa: F401
 from . import spatial  # noqa: F401
 from . import extra  # noqa: F401
 from . import legacy_ops  # noqa: F401
+from . import contrib_extra  # noqa: F401
 from .functional import *  # noqa: F401,F403
 
 # Upstream exposes every CamelCase op under a snake_case name too

@@ -210,3 +210,156 @@ def test_digamma_polygamma_scipy_oracle():
     np.testing.assert_allclose(xa.grad.asnumpy(),
                                ss.polygamma(1, x).astype(np.float32),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_contrib_long_tail_utility_ops():
+    """arange_like / index_array / index_copy / allclose / div_sqrt_dim /
+    gradientmultiplier (ref: src/operator/contrib/*)."""
+    from mxnet_tpu import autograd, nd
+
+    c = nd.contrib
+    x = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_allclose(c.arange_like(x).asnumpy(),
+                               np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_allclose(c.arange_like(x, axis=1, start=2.0).asnumpy(),
+                               [2, 3, 4, 5])
+    # repeat repeats each VALUE (nd.arange semantics)
+    np.testing.assert_allclose(c.arange_like(x, repeat=2).asnumpy().ravel(),
+                               np.repeat(np.arange(6), 2))
+    np.testing.assert_allclose(c.arange_like(x, axis=1, repeat=2).asnumpy(),
+                               [0, 0, 1, 1])
+    ia = c.index_array(x).asnumpy()
+    assert ia.shape == (3, 4, 2) and ia[2, 1].tolist() == [2, 1]
+
+    old = nd.zeros((4, 3))
+    new = nd.array(np.ones((2, 3), np.float32))
+    out = c.index_copy(old, nd.array(np.array([1, 3], np.int32)), new)
+    assert out.asnumpy()[[1, 3]].sum() == 6 and out.asnumpy()[[0, 2]].sum() == 0
+
+    assert float(c.allclose(x, x).asnumpy()[0]) == 1.0
+    assert float(c.allclose(x, x + 1).asnumpy()[0]) == 0.0
+
+    np.testing.assert_allclose(c.div_sqrt_dim(x).asnumpy(),
+                               x.asnumpy() / 2.0, rtol=1e-6)
+
+    a = nd.array(np.array([3.0], np.float32))
+    a.attach_grad()
+    with autograd.record():
+        y = c.gradientmultiplier(a, scalar=-0.5)
+    y.backward()
+    np.testing.assert_allclose(y.asnumpy(), [3.0])      # identity forward
+    np.testing.assert_allclose(a.grad.asnumpy(), [-0.5])  # scaled backward
+
+
+def test_contrib_boolean_mask_and_quantize_v2():
+    from mxnet_tpu import nd
+
+    c = nd.contrib
+    data = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    keep = nd.array(np.array([0, 1, 0, 1], np.float32))
+    out = c.boolean_mask(data, keep).asnumpy()
+    np.testing.assert_allclose(out, data.asnumpy()[[1, 3]])
+
+    q, qmin, qmax = c.quantize_v2(data, min_calib_range=-11.0,
+                                  max_calib_range=11.0)
+    assert q.dtype == np.int8
+    np.testing.assert_allclose(q.asnumpy()[-1, -1], 127)
+    deq = q.asnumpy().astype(np.float32) * 11.0 / 127.0
+    np.testing.assert_allclose(deq, data.asnumpy(), atol=0.06)
+
+
+def test_contrib_box_encode_decode_roundtrip():
+    from mxnet_tpu import nd
+
+    c = nd.contrib
+    rng = np.random.default_rng(0)
+    anchors = np.zeros((1, 5, 4), np.float32)
+    lo = rng.uniform(0, 0.5, (1, 5, 2)).astype(np.float32)
+    anchors[..., :2] = lo
+    anchors[..., 2:] = lo + rng.uniform(0.1, 0.4, (1, 5, 2)).astype(np.float32)
+    refs = anchors + 0.03  # gt = shifted anchors
+    samples = np.ones((1, 5), np.float32)
+    matches = np.arange(5, dtype=np.float32)[None]
+
+    t, mask = c.box_encode(nd.array(samples), nd.array(matches),
+                           nd.array(anchors), nd.array(refs))
+    assert mask.asnumpy().min() == 1.0
+    dec = c.box_decode(t, nd.array(anchors)).asnumpy()
+    np.testing.assert_allclose(dec, refs, atol=1e-5)
+
+
+def test_contrib_fft_ifft_roundtrip():
+    from mxnet_tpu import nd
+
+    c = nd.contrib
+    x = nd.array(np.random.default_rng(1)
+                 .normal(size=(3, 8)).astype(np.float32))
+    f = c.fft(x)
+    assert f.shape == (3, 16)
+    # upstream (cuFFT) convention: unnormalized — ifft(fft(x)) == n * x
+    back = c.ifft(f).asnumpy()
+    np.testing.assert_allclose(back, 8 * x.asnumpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_contrib_interleaved_matmul_matches_reference_attention():
+    """The four transformer.cc interleaved ops compose into standard
+    multi-head attention — verified against a plain einsum reference."""
+    from mxnet_tpu import nd
+
+    c = nd.contrib
+    L, B, H, D = 6, 2, 2, 4
+    rng = np.random.default_rng(2)
+    qkv = rng.normal(size=(L, B, H * 3 * D)).astype(np.float32)
+
+    scores = c.interleaved_matmul_selfatt_qk(nd.array(qkv), heads=H)
+    assert scores.shape == (B * H, L, L)
+
+    # reference from the documented interleaved layout
+    x = qkv.reshape(L, B, H, 3, D)
+    q, k, v = x[..., 0, :], x[..., 1, :], x[..., 2, :]
+    ref = np.einsum("lbhd,mbhd->bhlm", q / np.sqrt(D), k).reshape(B * H, L, L)
+    np.testing.assert_allclose(scores.asnumpy(), ref, rtol=1e-5, atol=1e-5)
+
+    att = np.exp(ref) / np.exp(ref).sum(-1, keepdims=True)
+    out = c.interleaved_matmul_selfatt_valatt(nd.array(qkv), nd.array(att),
+                                              heads=H)
+    ref_out = np.einsum("bhlm,mbhd->lbhd",
+                        att.reshape(B, H, L, L), v).reshape(L, B, H * D)
+    np.testing.assert_allclose(out.asnumpy(), ref_out, rtol=1e-5, atol=1e-5)
+
+    # encdec: q (Lq,B,H*D), kv (M,B,H*2*D)
+    Lq, M = 3, 5
+    qe = rng.normal(size=(Lq, B, H * D)).astype(np.float32)
+    kve = rng.normal(size=(M, B, H * 2 * D)).astype(np.float32)
+    s2 = c.interleaved_matmul_encdec_qk(nd.array(qe), nd.array(kve), heads=H)
+    kv = kve.reshape(M, B, H, 2, D)
+    ref2 = np.einsum("lbhd,mbhd->bhlm", qe.reshape(Lq, B, H, D) / np.sqrt(D),
+                     kv[..., 0, :]).reshape(B * H, Lq, M)
+    np.testing.assert_allclose(s2.asnumpy(), ref2, rtol=1e-5, atol=1e-5)
+    att2 = np.exp(ref2) / np.exp(ref2).sum(-1, keepdims=True)
+    o2 = c.interleaved_matmul_encdec_valatt(nd.array(kve), nd.array(att2),
+                                            heads=H)
+    ref_o2 = np.einsum("bhlm,mbhd->lbhd", att2.reshape(B, H, Lq, M),
+                       kv[..., 1, :]).reshape(Lq, B, H * D)
+    np.testing.assert_allclose(o2.asnumpy(), ref_o2, rtol=1e-5, atol=1e-5)
+
+
+def test_group_adagrad_update():
+    from mxnet_tpu import nd
+
+    rng = np.random.default_rng(3)
+    w = rng.normal(size=(5, 4)).astype(np.float32)
+    g = rng.normal(size=(5, 4)).astype(np.float32)
+    h = np.zeros((5, 1), np.float32)
+    new_w, new_h = nd.contrib.group_adagrad_update(
+        nd.array(w), nd.array(g), nd.array(h), lr=0.1)
+    h_ref = (g ** 2).mean(axis=1, keepdims=True)
+    np.testing.assert_allclose(new_h.asnumpy(), h_ref, rtol=1e-6)
+    np.testing.assert_allclose(new_w.asnumpy(),
+                               w - 0.1 * g / (np.sqrt(h_ref) + 1e-5),
+                               rtol=1e-5)
+
+
+def test_nn_exposes_block_bases():
+    from mxnet_tpu.gluon import nn
+    assert nn.HybridBlock is not None and nn.Block is not None

@@ -2,8 +2,9 @@ from .basic_layers import *  # noqa: F401,F403
 from .conv_layers import *  # noqa: F401,F403
 from .basic_layers import __all__ as _b
 from .conv_layers import __all__ as _c
-# `class Net(nn.HybridBlock)` is the dominant upstream idiom — the base
-# classes resolve from nn as well as from gluon itself
-from ..block import Block, HybridBlock  # noqa: F401
+# `class Net(nn.HybridBlock)` / `nn.SymbolBlock.imports(...)` are the
+# dominant upstream idioms — the base classes resolve from nn as well as
+# from gluon itself
+from ..block import Block, HybridBlock, SymbolBlock  # noqa: F401
 
-__all__ = list(_b) + list(_c) + ["Block", "HybridBlock"]
+__all__ = list(_b) + list(_c) + ["Block", "HybridBlock", "SymbolBlock"]

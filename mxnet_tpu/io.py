@@ -226,6 +226,52 @@ class CSVIter(DataIter):
         return self._inner.next()
 
 
+def _read_idx_ubyte(path):
+    """Parse an IDX (ubyte) file — the MNIST container format: big-endian
+    magic (dtype + ndim), per-dim sizes, raw payload. Transparent .gz."""
+    import gzip
+    import struct
+    opener = gzip.open if str(path).endswith(".gz") else open
+    with opener(path, "rb") as f:
+        raw = f.read()
+    zero, dtype_code, ndim = struct.unpack(">HBB", raw[:4])
+    if zero != 0 or dtype_code != 0x08:
+        raise ValueError("%s is not an unsigned-byte IDX file" % path)
+    dims = struct.unpack(">" + "I" * ndim, raw[4:4 + 4 * ndim])
+    return np.frombuffer(raw[4 + 4 * ndim:], np.uint8).reshape(dims)
+
+
+class MNISTIter(DataIter):
+    """(ref: src/io/iter_mnist.cc) built-in IDX-ubyte reader: images scale
+    to [0,1] fp32, ``flat`` yields (N, 784) instead of (N, 1, 28, 28);
+    shuffle/seed and the partial-input contract match upstream."""
+
+    def __init__(self, image, label, batch_size=128, shuffle=False,
+                 flat=False, seed=0, silent=True, num_parts=1, part_index=0,
+                 **kwargs):
+        super().__init__(batch_size)
+        data = _read_idx_ubyte(image).astype(np.float32) / 255.0
+        lab = _read_idx_ubyte(label).astype(np.float32)
+        if num_parts > 1:
+            # distributed sharding (upstream MNISTIterParam): strided slice
+            # so every part sees the class mix
+            data = data[part_index::num_parts]
+            lab = lab[part_index::num_parts]
+        data = data.reshape(len(data), -1) if flat \
+            else data.reshape(len(data), 1, data.shape[1], data.shape[2])
+        if shuffle:
+            order = np.random.RandomState(seed).permutation(len(data))
+            data, lab = data[order], lab[order]
+        self._inner = NDArrayIter(data, lab, batch_size,
+                                  last_batch_handle="pad")
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+
 class _RecordIterBase(DataIter):
     """Shared .rec machinery: lazy byte-offset reads (multi-GB files never
     load into host memory), shuffle order, cursor. Subclasses provide

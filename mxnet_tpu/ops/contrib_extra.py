@@ -35,7 +35,7 @@ def index_array(data, *, axes=None):
     """(ref: contrib/index_array.cc) element coordinates of data: shape
     data.shape + (len(axes),). int32 (TPU-native; upstream emits int64)."""
     nd_ = data.ndim
-    axes = tuple(range(nd_)) if axes is None else tuple(axes)
+    axes = tuple(range(nd_)) if axes is None else tuple(a % nd_ for a in axes)
     grids = [lax.broadcasted_iota(jnp.int32, data.shape, a) for a in axes]
     return jnp.stack(grids, axis=-1)
 
@@ -72,13 +72,22 @@ def gradientmultiplier(data, *, scalar=1.0):
 def quantize_v2(data, *, out_type="int8", min_calib_range=None,
                 max_calib_range=None):
     """(ref: quantization/quantize_v2.cc) affine uint8 / symmetric int8
-    quantization; calibrated when ranges are given, else from data."""
+    quantization; calibrated when ranges are given, else from data.
+    out_type='auto' picks uint8 for an all-non-negative calibrated range
+    (upstream's rule), int8 otherwise."""
+    if out_type not in ("auto", "int8", "uint8"):
+        raise ValueError("out_type must be auto/int8/uint8, got %r"
+                         % (out_type,))
     if min_calib_range is not None and max_calib_range is not None:
         dmin = jnp.asarray(min_calib_range, jnp.float32)
         dmax = jnp.asarray(max_calib_range, jnp.float32)
+        if out_type == "auto":
+            out_type = "uint8" if min_calib_range >= 0 else "int8"
     else:
         dmin = jnp.min(data).astype(jnp.float32)
         dmax = jnp.max(data).astype(jnp.float32)
+        if out_type == "auto":
+            out_type = "int8"  # data-dependent sign can't pick a dtype under jit
     if out_type == "uint8":
         scale = 255.0 / jnp.maximum(dmax - dmin, 1e-20)
         q = jnp.clip(jnp.round((data - dmin) * scale), 0, 255).astype(jnp.uint8)

@@ -720,3 +720,47 @@ def test_dataloader_process_workers_early_break():
     first = next(it)
     assert first[0].shape == (4, 6)
     del it  # early abandon must not hang the pool shutdown
+
+
+def test_mnist_iter_reads_idx_ubyte(tmp_path):
+    """MNISTIter parses the IDX container (ref: src/io/iter_mnist.cc):
+    gz + raw, flat + image layouts, [0,1] scaling."""
+    import gzip
+    import struct
+
+    from mxnet_tpu.io import MNISTIter
+
+    rng = np.random.default_rng(0)
+    imgs = rng.integers(0, 256, (10, 28, 28)).astype(np.uint8)
+    labs = rng.integers(0, 10, (10,)).astype(np.uint8)
+
+    img_path = tmp_path / "images-idx3-ubyte.gz"
+    with gzip.open(img_path, "wb") as f:
+        f.write(struct.pack(">HBBIII", 0, 8, 3, 10, 28, 28) + imgs.tobytes())
+    lab_path = tmp_path / "labels-idx1-ubyte"
+    lab_path.write_bytes(struct.pack(">HBBI", 0, 8, 1, 10) + labs.tobytes())
+
+    it = MNISTIter(image=str(img_path), label=str(lab_path), batch_size=4,
+                   flat=False)
+    batch = it.next()
+    assert batch.data[0].shape == (4, 1, 28, 28)
+    np.testing.assert_allclose(batch.data[0].asnumpy()[0, 0],
+                               imgs[0] / 255.0, rtol=1e-6)
+    np.testing.assert_allclose(batch.label[0].asnumpy(), labs[:4])
+
+    flat = MNISTIter(image=str(img_path), label=str(lab_path), batch_size=10,
+                     flat=True)
+    assert flat.next().data[0].shape == (10, 784)
+
+    sh = MNISTIter(image=str(img_path), label=str(lab_path), batch_size=10,
+                   shuffle=True, seed=1)
+    got = sh.next().label[0].asnumpy()
+    assert sorted(got.tolist()) == sorted(labs.tolist())
+
+    # distributed sharding: parts partition the set with no overlap
+    p0 = MNISTIter(image=str(img_path), label=str(lab_path), batch_size=5,
+                   num_parts=2, part_index=0).next().label[0].asnumpy()
+    p1 = MNISTIter(image=str(img_path), label=str(lab_path), batch_size=5,
+                   num_parts=2, part_index=1).next().label[0].asnumpy()
+    np.testing.assert_allclose(np.sort(np.concatenate([p0, p1])),
+                               np.sort(labs))

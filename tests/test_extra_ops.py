@@ -230,6 +230,7 @@ def test_contrib_long_tail_utility_ops():
                                [0, 0, 1, 1])
     ia = c.index_array(x).asnumpy()
     assert ia.shape == (3, 4, 2) and ia[2, 1].tolist() == [2, 1]
+    assert c.index_array(x, axes=(-1,)).asnumpy()[1, 3].tolist() == [3]
 
     old = nd.zeros((4, 3))
     new = nd.array(np.ones((2, 3), np.float32))
@@ -260,6 +261,13 @@ def test_contrib_boolean_mask_and_quantize_v2():
     out = c.boolean_mask(data, keep).asnumpy()
     np.testing.assert_allclose(out, data.asnumpy()[[1, 3]])
 
+    import pytest
+    with pytest.raises(ValueError, match="out_type"):
+        c.quantize_v2(data, out_type="unit8")
+    # auto + non-negative calibrated range -> uint8 (upstream rule)
+    qa, _, _ = c.quantize_v2(data, out_type="auto", min_calib_range=0.0,
+                             max_calib_range=11.0)
+    assert qa.dtype == np.uint8
     q, qmin, qmax = c.quantize_v2(data, min_calib_range=-11.0,
                                   max_calib_range=11.0)
     assert q.dtype == np.int8

@@ -1,5 +1,6 @@
 from .dataset import Dataset, SimpleDataset, ArrayDataset, RecordFileDataset  # noqa: F401
-from .sampler import Sampler, SequentialSampler, RandomSampler, BatchSampler  # noqa: F401
+from .sampler import (Sampler, SequentialSampler, RandomSampler,  # noqa: F401
+                      BatchSampler, FilterSampler)
 from .dataloader import DataLoader  # noqa: F401
 from .prefetcher import DevicePrefetcher  # noqa: F401
 from . import vision  # noqa: F401

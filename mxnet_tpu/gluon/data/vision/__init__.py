@@ -1,3 +1,4 @@
 from . import transforms  # noqa: F401
 from .datasets import (MNIST, FashionMNIST, CIFAR10, CIFAR100,  # noqa: F401
-                       ImageFolderDataset, ImageRecordDataset)
+                       ImageFolderDataset, ImageListDataset,
+                       ImageRecordDataset)

@@ -15,7 +15,8 @@ import numpy as np
 
 from ..dataset import Dataset
 
-__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100", "ImageFolderDataset"]
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100", "ImageFolderDataset",
+           "ImageListDataset"]
 
 
 class _DownloadedDataset(Dataset):
@@ -167,6 +168,39 @@ class ImageFolderDataset(Dataset):
         img = np.load(path) if path.endswith(".npy") else imread_np(path)
         if self._transform is not None:
             return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)
+
+
+class ImageListDataset(Dataset):
+    """(ref: datasets.py:ImageListDataset) images named by a .lst file
+    (tab-separated: index, label..., relpath — the im2rec format) or an
+    in-memory list of [label(s)..., relpath] entries."""
+
+    def __init__(self, root=".", imglist=None, flag=1):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self.items = []
+        if isinstance(imglist, str):
+            with open(imglist) as f:
+                lines = [ln.split("\t") for ln in f.read().splitlines()
+                         if ln.strip()]
+            entries = [ln[1:] for ln in lines]  # drop the leading index
+        else:
+            entries = [[str(v) for v in row] for row in (imglist or [])]
+        for row in entries:
+            *labels, path = row
+            lab = np.array([float(v) for v in labels], np.float32)
+            self.items.append((os.path.join(self._root, path),
+                               lab[0] if lab.size == 1 else lab))
+
+    def __getitem__(self, idx):
+        from ....image import imread_np
+
+        path, label = self.items[idx]
+        img = imread_np(path, self._flag)  # handles .npy internally
         return img, label
 
     def __len__(self):

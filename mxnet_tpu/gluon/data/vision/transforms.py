@@ -12,7 +12,7 @@ from ....ndarray import NDArray, array
 from ...block import Block
 
 __all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
-           "CropResize",
+           "CropResize", "RandomCrop",
            "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
            "RandomBrightness", "RandomContrast", "RandomSaturation",
            "RandomHue", "RandomColorJitter", "RandomLighting", "RandomGray"]
@@ -176,3 +176,28 @@ class RandomColorJitter:
     def __call__(self, x):
         x = self._aug(x)
         return self._hue(x) if self._hue is not None else x
+
+
+class RandomCrop:
+    """(ref: transforms.py:RandomCrop) random (th, tw) crop, optionally
+    zero-padding all four sides first (the CIFAR pad-4-crop-32 recipe)."""
+
+    def __init__(self, size, pad=None, interpolation=1):
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._pad = pad
+        self._interp = interpolation
+
+    def __call__(self, x):
+        a = _np(x)
+        if self._pad:
+            p = self._pad
+            a = np.pad(a, ((p, p), (p, p)) + ((0, 0),) * (a.ndim - 2))
+        h, w = a.shape[:2]
+        tw, th = self._size
+        if h < th or w < tw:
+            # upstream upscales so the crop always has the requested size
+            a = _resize(a, (max(w, tw), max(h, th)))
+            h, w = a.shape[:2]
+        y0 = np.random.randint(0, h - th + 1)
+        x0 = np.random.randint(0, w - tw + 1)
+        return array(a[y0:y0 + th, x0:x0 + tw])

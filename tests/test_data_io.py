@@ -764,3 +764,44 @@ def test_mnist_iter_reads_idx_ubyte(tmp_path):
                    num_parts=2, part_index=1).next().label[0].asnumpy()
     np.testing.assert_allclose(np.sort(np.concatenate([p0, p1])),
                                np.sort(labs))
+
+
+def test_filter_sampler_image_list_dataset_random_crop(tmp_path):
+    """The last gluon.data.vision surface nubs: FilterSampler,
+    ImageListDataset (.lst format), transforms.RandomCrop (pad-and-crop)."""
+    from mxnet_tpu.gluon.data import DataLoader, FilterSampler
+    from mxnet_tpu.gluon.data.vision import ImageListDataset
+    from mxnet_tpu.gluon.data.vision.transforms import RandomCrop
+
+    rng = np.random.default_rng(0)
+    paths = []
+    for i in range(6):
+        p = tmp_path / ("img%d.npy" % i)
+        np.save(p, rng.normal(size=(8, 8, 3)).astype(np.float32))
+        paths.append(p.name)
+    lst = tmp_path / "data.lst"
+    lst.write_text("".join("%d\t%d\t%s\n" % (i, i % 2, p)
+                           for i, p in enumerate(paths)))
+
+    ds = ImageListDataset(root=str(tmp_path), imglist=str(lst))
+    assert len(ds) == 6
+    img, lab = ds[3]
+    assert img.shape == (8, 8, 3) and lab == 1.0
+
+    odd = FilterSampler(lambda s: s[1] == 1.0, ds)
+    assert len(odd) == 3
+    got = [ds[i][1] for i in odd]
+    assert got == [1.0, 1.0, 1.0]
+
+    crop = RandomCrop(4, pad=2)
+    out = crop(ds[0][0])
+    assert out.shape == (4, 4, 3)
+    # smaller-than-target input upscales first (upstream behavior)
+    big = RandomCrop(16)(ds[0][0])
+    assert big.shape == (16, 16, 3)
+
+    # in-memory imglist form + DataLoader integration
+    ds2 = ImageListDataset(root=str(tmp_path),
+                           imglist=[[0, paths[0]], [1, paths[1]]])
+    batches = list(DataLoader(ds2, batch_size=2))
+    assert batches[0][0].shape == (2, 8, 8, 3)

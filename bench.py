@@ -108,6 +108,12 @@ def _xent_mean(logits, labels):
     log_softmax + gather, the top non-matmul HBM sink in the LM losses
     (VERDICT r3 next-round #2). Interpret mode keeps the CPU smoke path
     runnable; the dispatch is trace-time, baked into the jitted step."""
+    if os.environ.get("BENCH_NO_PALLAS_XENT"):
+        # escape hatch: if the Mosaic lowering ever fails on hardware, the
+        # loop retries the mode with this set rather than losing the window
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return jnp.mean(-jnp.take_along_axis(
+            lp.reshape(-1, lp.shape[-1]), labels.reshape(-1, 1), axis=-1))
     from mxnet_tpu.base import is_tpu_backend
     from mxnet_tpu.ops.pallas.softmax_xent import softmax_xent
     vocab = logits.shape[-1]

@@ -41,6 +41,12 @@ while true; do
     # window before it lands
     BENCH_PROBE_BUDGET_S=600 timeout -k 30 3600 python bench.py bert
     hrc=$?
+    if [ $hrc -ne 0 ]; then
+      echo "[loop] headline failed (rc=$hrc); retrying without pallas xent"
+      BENCH_NO_PALLAS_XENT=1 BENCH_PROBE_BUDGET_S=600 \
+        timeout -k 30 3600 python bench.py bert
+      hrc=$?
+    fi
     echo "[loop] $(date -u +%T) headline rc=$hrc; flash sweep + apply"
     # sweep BEFORE 'bench all': --apply writes the tuned block table that
     # the bert512 flash path then picks up, so the persisted six-mode

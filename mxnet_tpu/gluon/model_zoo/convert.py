@@ -7,6 +7,8 @@ replaced by CONVERTERS from checkpoint files users already have on disk:
 - torchvision ``resnet*.pth`` state dicts -> the vision zoo's resnet
   family (``resnet18/34_v1`` exactly; ``resnet50/101/152_v1b`` — the
   torchvision "v1.5" stride placement lives in ``BottleneckV1b``)
+- torchvision ``vgg11/13/16/19`` (plain + ``_bn``), ``alexnet``, and
+  ``mobilenet_v2_tv`` via the generic structural converter
 - HuggingFace ``BertModel`` state dicts -> ``models.bert.BERTModel``
   (fused-qkv transplant, same mapping the HF oracle tests prove to 2e-4)
 
@@ -92,7 +94,8 @@ def convert_torchvision_resnet(state):
 
 def convert_torchvision_generic(state, rename=None):
     """torchvision-style state_dict -> structural keys, for models whose
-    module paths already mirror ours 1:1 (``MobileNetV2TV``): BatchNorm
+    module paths mirror ours up to a prefix rename (``MobileNetV2TV``,
+    vgg, alexnet): BatchNorm
     tensors rename via running_mean-prefix detection (a BN's .weight is
     gamma; a conv's .weight is a weight), everything else passes through,
     ``rename`` maps leading module paths (e.g. ``classifier.1`` ->
@@ -225,6 +228,29 @@ def load_pretrained(net, path, name):
     if name == "mobilenet_v2_tv":
         return apply_converted(net, convert_torchvision_generic(
             state, rename={"classifier.1": "output"}))
+    if re.match(r"^vgg(11|13|16|19)(_bn)?$", name):
+        # conv/bn module indices already align (both feature Sequentials
+        # hold conv / [bn] / relu / maxpool positionally); only
+        # torchvision's split-off classifier remaps onto our trailing
+        # denses. NOTE: torchvision's AdaptiveAvgPool before the classifier
+        # is identity at the canonical 224 input, which these weights
+        # assume.
+        dense_idx = [k for k, ch in net.features._children.items()
+                     if type(ch).__name__ == "Dense"]
+        rename = {"classifier.0": "features.%s" % dense_idx[0],
+                  "classifier.3": "features.%s" % dense_idx[1],
+                  "classifier.6": "output"}
+        return apply_converted(net, convert_torchvision_generic(
+            state, rename=rename))
+    if name == "alexnet":
+        # our convs fuse their relu (no separate ReLU modules), shifting
+        # feature indices; the map is static for this fixed architecture
+        rename = {"features.0": "features.0", "features.3": "features.2",
+                  "features.6": "features.4", "features.8": "features.5",
+                  "features.10": "features.6", "classifier.1": "features.9",
+                  "classifier.4": "features.11", "classifier.6": "output"}
+        return apply_converted(net, convert_torchvision_generic(
+            state, rename=rename))
     m = _RESNET_NAME.match(name)
     if m:
         ver = m.group(2)
@@ -241,8 +267,9 @@ def load_pretrained(net, path, name):
         return apply_converted(net, convert_torchvision_resnet(state))
     raise ValueError(
         "no torch converter registered for model %r; supported: resnet*_v1 "
-        "(basic blocks), resnet*_v1b (bottlenecks), mobilenet_v2_tv, and "
-        "transplant_hf_bert for BERT checkpoints" % name)
+        "(basic blocks), resnet*_v1b (bottlenecks), vgg11/13/16/19[_bn], "
+        "alexnet, mobilenet_v2_tv, and transplant_hf_bert for BERT "
+        "checkpoints" % name)
 
 
 def _main(argv):

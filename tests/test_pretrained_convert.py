@@ -95,7 +95,7 @@ def test_unconverted_family_raises(tmp_path):
     from mxnet_tpu.gluon.model_zoo.vision import get_model
     torch.save({"features.0.weight": torch.zeros(1)}, tmp_path / "x.pth")
     with pytest.raises(ValueError, match="no torch converter"):
-        get_model("vgg11", pretrained=str(tmp_path / "x.pth"))
+        get_model("densenet121", pretrained=str(tmp_path / "x.pth"))
 
 
 def test_hf_bert_state_dict_transplant():
@@ -152,6 +152,46 @@ def test_torchvision_mobilenet_v2_numeric_oracle(tmp_path):
 
     net = get_model("mobilenet_v2_tv", pretrained=str(ckpt), classes=9)
     x = np.random.default_rng(2).normal(size=(2, 3, 64, 64)).astype(np.float32)
+    ref = _torch_logits(tm, x)
+    got = _our_logits(net, x)
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.parametrize("bn", [False, True])
+def test_torchvision_vgg11_numeric_oracle(tmp_path, bn):
+    """vgg11/vgg11_bn via the generic converter + classifier rename, at the
+    canonical 224 input where torchvision's avgpool is identity."""
+    import torch_vgg_ref as tvref
+    from mxnet_tpu.gluon.model_zoo.vision import get_model
+
+    torch.manual_seed(4)
+    tm = tvref.vgg(11, batch_norm=bn, num_classes=7)
+    if bn:
+        tvref.randomize_bn_stats(tm, seed=4)
+    ckpt = tmp_path / "vgg11.pth"
+    torch.save(tm.state_dict(), ckpt)
+
+    name = "vgg11_bn" if bn else "vgg11"
+    net = get_model(name, pretrained=str(ckpt), classes=7)
+    x = np.random.default_rng(4).normal(
+        size=(1, 3, 224, 224)).astype(np.float32) * 0.1
+    ref = _torch_logits(tm, x)
+    got = _our_logits(net, x)
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-4)
+
+
+def test_torchvision_alexnet_numeric_oracle(tmp_path):
+    import torch_alexnet_ref as taref
+    from mxnet_tpu.gluon.model_zoo.vision import get_model
+
+    torch.manual_seed(5)
+    tm = taref.alexnet(num_classes=6)
+    ckpt = tmp_path / "alexnet.pth"
+    torch.save(tm.state_dict(), ckpt)
+
+    net = get_model("alexnet", pretrained=str(ckpt), classes=6)
+    x = np.random.default_rng(5).normal(
+        size=(2, 3, 224, 224)).astype(np.float32) * 0.1
     ref = _torch_logits(tm, x)
     got = _our_logits(net, x)
     np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)

@@ -41,11 +41,19 @@ while true; do
     # window before it lands
     BENCH_PROBE_BUDGET_S=600 timeout -k 30 3600 python bench.py bert
     hrc=$?
-    if [ $hrc -ne 0 ]; then
+    # rc=124/137 is a timeout (wedge — the flag can't help and the retry
+    # would burn another hour); anything else may be a Mosaic lowering
+    # failure, which the jnp-loss fallback fixes — and if it does, keep
+    # the flag exported so bench all + the sweeps don't re-hit it
+    if [ $hrc -ne 0 ] && [ $hrc -ne 124 ] && [ $hrc -ne 137 ]; then
       echo "[loop] headline failed (rc=$hrc); retrying without pallas xent"
       BENCH_NO_PALLAS_XENT=1 BENCH_PROBE_BUDGET_S=600 \
         timeout -k 30 3600 python bench.py bert
       hrc=$?
+      if [ $hrc -eq 0 ]; then
+        export BENCH_NO_PALLAS_XENT=1
+        echo "[loop] pallas xent disabled for the rest of the sequence"
+      fi
     fi
     echo "[loop] $(date -u +%T) headline rc=$hrc; flash sweep + apply"
     # sweep BEFORE 'bench all': --apply writes the tuned block table that

@@ -7,8 +7,10 @@ replaced by CONVERTERS from checkpoint files users already have on disk:
 - torchvision ``resnet*.pth`` state dicts -> the vision zoo's resnet
   family (``resnet18/34_v1`` exactly; ``resnet50/101/152_v1b`` — the
   torchvision "v1.5" stride placement lives in ``BottleneckV1b``)
-- torchvision ``vgg11/13/16/19`` (plain + ``_bn``), ``alexnet``, and
-  ``mobilenet_v2_tv`` via the generic structural converter
+- torchvision ``vgg11/13/16/19`` (plain + ``_bn``), ``alexnet``,
+  ``squeezenet1.0/1.1``, ``densenet121/161/169/201``, and
+  ``mobilenet_v2_tv`` via structural converters (inception is the one
+  unconverted family)
 - HuggingFace ``BertModel`` state dicts -> ``models.bert.BERTModel``
   (fused-qkv transplant, same mapping the HF oracle tests prove to 2e-4)
 
@@ -115,6 +117,48 @@ def convert_torchvision_generic(state, rename=None):
         pre = path.rpartition(".")[0]
         name = _BN[attr] if orig_pre in bn and attr in _BN else attr
         out[pre + "." + name] = _to_np(v)
+    return out
+
+
+def convert_torchvision_densenet(state):
+    """torchvision densenet state_dict -> our positional DenseNet layout:
+    denseblock{i}/denselayer{j}.{norm1,conv1,norm2,conv2} land in
+    features.{4+2(i-1)}.{j-1}.body.{0,2,3,5}; transitions at the odd
+    indices between blocks; conv0/norm0/norm5/classifier at the fixed
+    stem/head positions."""
+    sub = {"norm1": "body.0", "conv1": "body.2",
+           "norm2": "body.3", "conv2": "body.5"}
+    out = {}
+    for k, v in state.items():
+        if k.endswith("num_batches_tracked"):
+            continue
+        m = re.match(
+            r"^features\.denseblock(\d+)\.denselayer(\d+)\.(\w+)\.(\w+)$", k)
+        if m:
+            bi, lj, mod, attr = (int(m.group(1)), int(m.group(2)),
+                                 m.group(3), m.group(4))
+            name = _BN[attr] if mod.startswith("norm") else attr
+            out["features.%d.%d.%s.%s"
+                % (4 + 2 * (bi - 1), lj - 1, sub[mod], name)] = _to_np(v)
+            continue
+        m = re.match(r"^features\.transition(\d+)\.(norm|conv)\.(\w+)$", k)
+        if m:
+            ti, mod, attr = int(m.group(1)), m.group(2), m.group(3)
+            pos = 0 if mod == "norm" else 2
+            name = _BN[attr] if mod == "norm" else attr
+            out["features.%d.%d.%s"
+                % (5 + 2 * (ti - 1), pos, name)] = _to_np(v)
+            continue
+        if k == "features.conv0.weight":
+            out["features.0.weight"] = _to_np(v)
+        elif k.startswith("features.norm0."):
+            out["features.1.%s" % _BN[k.rsplit(".", 1)[1]]] = _to_np(v)
+        elif k.startswith("features.norm5."):
+            out["features.11.%s" % _BN[k.rsplit(".", 1)[1]]] = _to_np(v)
+        elif k in ("classifier.weight", "classifier.bias"):
+            out["output.%s" % k.split(".")[1]] = _to_np(v)
+        else:
+            raise KeyError("unrecognized torchvision densenet key %r" % k)
     return out
 
 
@@ -235,11 +279,28 @@ def load_pretrained(net, path, name):
         # denses. NOTE: torchvision's AdaptiveAvgPool before the classifier
         # is identity at the canonical 224 input, which these weights
         # assume.
+        from .. import nn
         dense_idx = [k for k, ch in net.features._children.items()
-                     if type(ch).__name__ == "Dense"]
+                     if isinstance(ch, nn.Dense)]
         rename = {"classifier.0": "features.%s" % dense_idx[0],
                   "classifier.3": "features.%s" % dense_idx[1],
                   "classifier.6": "output"}
+        return apply_converted(net, convert_torchvision_generic(
+            state, rename=rename))
+    if re.match(r"^densenet(121|161|169|201)$", name):
+        return apply_converted(net, convert_torchvision_densenet(state))
+    if name in ("squeezenet1.0", "squeezenet1.1"):
+        # torchvision holds ReLU modules inline (shifting Fire indices)
+        # and names the expands expand1x1/expand3x3 (ours: expand1/expand3)
+        idx = ({3: 2, 4: 3, 5: 4, 7: 6, 8: 7, 9: 8, 10: 9, 12: 11}
+               if name.endswith("1.0")
+               else {3: 2, 4: 3, 6: 5, 7: 6, 9: 8, 10: 9, 11: 10, 12: 11})
+        rename = {"features.%d" % k: "features.%d" % v
+                  for k, v in idx.items()}
+        rename["classifier.1"] = "output.0"
+        state = {k.replace(".expand1x1.", ".expand1.")
+                  .replace(".expand3x3.", ".expand3."): v
+                 for k, v in state.items()}
         return apply_converted(net, convert_torchvision_generic(
             state, rename=rename))
     if name == "alexnet":
@@ -268,8 +329,10 @@ def load_pretrained(net, path, name):
     raise ValueError(
         "no torch converter registered for model %r; supported: resnet*_v1 "
         "(basic blocks), resnet*_v1b (bottlenecks), vgg11/13/16/19[_bn], "
-        "alexnet, mobilenet_v2_tv, and transplant_hf_bert for BERT "
-        "checkpoints" % name)
+        "alexnet, squeezenet1.0/1.1, densenet121/161/169/201, "
+        "mobilenet_v2_tv, and transplant_hf_bert for BERT checkpoints "
+        "(inception is the one unconverted family: torchvision's "
+        "InceptionV3 differs architecturally)" % name)
 
 
 def _main(argv):

@@ -95,7 +95,7 @@ def test_unconverted_family_raises(tmp_path):
     from mxnet_tpu.gluon.model_zoo.vision import get_model
     torch.save({"features.0.weight": torch.zeros(1)}, tmp_path / "x.pth")
     with pytest.raises(ValueError, match="no torch converter"):
-        get_model("densenet121", pretrained=str(tmp_path / "x.pth"))
+        get_model("inceptionv3", pretrained=str(tmp_path / "x.pth"))
 
 
 def test_hf_bert_state_dict_transplant():
@@ -195,3 +195,38 @@ def test_torchvision_alexnet_numeric_oracle(tmp_path):
     ref = _torch_logits(tm, x)
     got = _our_logits(net, x)
     np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.parametrize("ver", ["1.0", "1.1"])
+def test_torchvision_squeezenet_numeric_oracle(tmp_path, ver):
+    import torch_squeezenet_ref as tsref
+    from mxnet_tpu.gluon.model_zoo.vision import get_model
+
+    torch.manual_seed(7)
+    tm = getattr(tsref, "squeezenet" + ver.replace(".", "_"))(num_classes=8)
+    ckpt = tmp_path / "sq.pth"
+    torch.save(tm.state_dict(), ckpt)
+
+    net = get_model("squeezenet" + ver, pretrained=str(ckpt), classes=8)
+    x = np.random.default_rng(7).normal(
+        size=(2, 3, 224, 224)).astype(np.float32) * 0.1
+    ref = _torch_logits(tm, x)
+    got = _our_logits(net, x)
+    np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+
+def test_torchvision_densenet121_numeric_oracle(tmp_path):
+    import torch_densenet_ref as tdref
+    from mxnet_tpu.gluon.model_zoo.vision import get_model
+
+    torch.manual_seed(8)
+    tm = tdref.randomize_bn_stats(tdref.densenet121(num_classes=5), seed=8)
+    ckpt = tmp_path / "d121.pth"
+    torch.save(tm.state_dict(), ckpt)
+
+    net = get_model("densenet121", pretrained=str(ckpt), classes=5)
+    x = np.random.default_rng(8).normal(
+        size=(1, 3, 64, 64)).astype(np.float32)
+    ref = _torch_logits(tm, x)
+    got = _our_logits(net, x)
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-4)

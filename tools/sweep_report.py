@@ -1,9 +1,10 @@
 #!/usr/bin/env python
 """Summarize the hardware sweep artifacts into tuning recommendations.
 
-Reads tools/flash_sweep_r3.json (flash-attention block sizes) and
-tools/batch_sweep_r3.jsonl (bench --batch/--remat configs) once the
-tpu_bench_loop has produced them, and prints:
+Reads the newest round's sweep artifacts (tools/flash_sweep_r*.json for
+flash-attention block sizes, tools/batch_sweep_r*.jsonl for bench
+--batch/--remat configs) once the tpu_bench_loop has produced them, and
+prints:
   - best (block_q, block_k) per sequence length vs the current defaults
   - samples/s and MFU per bench config vs the persisted default-config runs
 Run: python tools/sweep_report.py  (host-only; no TPU access needed)
@@ -74,10 +75,16 @@ def batch_report(path):
             tag = None
 
 
+def _newest(pattern):
+    import glob
+    hits = sorted(glob.glob(os.path.join(HERE, pattern)))
+    return hits[-1] if hits else os.path.join(HERE, pattern.replace("r*", "r4"))
+
+
 def main():
-    flash_report(os.path.join(HERE, "flash_sweep_r3.json"))
+    flash_report(_newest("flash_sweep_r*.json"))
     print()
-    batch_report(os.path.join(HERE, "batch_sweep_r3.jsonl"))
+    batch_report(_newest("batch_sweep_r*.jsonl"))
     print()
     try:
         results = json.load(open(os.path.join(HERE, "..",

@@ -8,9 +8,8 @@ replaced by CONVERTERS from checkpoint files users already have on disk:
   family (``resnet18/34_v1`` exactly; ``resnet50/101/152_v1b`` — the
   torchvision "v1.5" stride placement lives in ``BottleneckV1b``)
 - torchvision ``vgg11/13/16/19`` (plain + ``_bn``), ``alexnet``,
-  ``squeezenet1.0/1.1``, ``densenet121/161/169/201``, and
-  ``mobilenet_v2_tv`` via structural converters (inception is the one
-  unconverted family)
+  ``squeezenet1.0/1.1``, ``densenet121/161/169/201``, ``inceptionv3``,
+  and ``mobilenet_v2_tv`` via structural converters — every zoo family
 - HuggingFace ``BertModel`` state dicts -> ``models.bert.BERTModel``
   (fused-qkv transplant, same mapping the HF oracle tests prove to 2e-4)
 
@@ -162,6 +161,75 @@ def convert_torchvision_densenet(state):
     return out
 
 
+def _inception_prefix_map():
+    """torchvision InceptionV3 BasicConv2d module paths -> our positional
+    paths. Both nets share the same compute graph; torchvision names blocks
+    (Mixed_5b.branch5x5_1) where ours nests positionally
+    (features.7.branch1.0)."""
+    m = {"Conv2d_1a_3x3": "features.0", "Conv2d_2a_3x3": "features.1",
+         "Conv2d_2b_3x3": "features.2", "Conv2d_3b_1x1": "features.4",
+         "Conv2d_4a_3x3": "features.5"}
+    for i, name in enumerate(("Mixed_5b", "Mixed_5c", "Mixed_5d")):
+        our = "features.%d" % (7 + i)
+        m[name + ".branch1x1"] = our + ".branch0"
+        m[name + ".branch5x5_1"] = our + ".branch1.0"
+        m[name + ".branch5x5_2"] = our + ".branch1.1"
+        for j in range(1, 4):
+            m[name + ".branch3x3dbl_%d" % j] = our + ".branch2.%d" % (j - 1)
+        m[name + ".branch_pool"] = our + ".branch3.1"
+    m["Mixed_6a.branch3x3"] = "features.10.branch0"
+    for j in range(1, 4):
+        m["Mixed_6a.branch3x3dbl_%d" % j] = "features.10.branch1.%d" % (j - 1)
+    for i, name in enumerate(("Mixed_6b", "Mixed_6c", "Mixed_6d", "Mixed_6e")):
+        our = "features.%d" % (11 + i)
+        m[name + ".branch1x1"] = our + ".branch0"
+        for j in range(1, 4):
+            m[name + ".branch7x7_%d" % j] = our + ".branch1.%d" % (j - 1)
+        for j in range(1, 6):
+            m[name + ".branch7x7dbl_%d" % j] = our + ".branch2.%d" % (j - 1)
+        m[name + ".branch_pool"] = our + ".branch3.1"
+    m["Mixed_7a.branch3x3_1"] = "features.15.branch0.0"
+    m["Mixed_7a.branch3x3_2"] = "features.15.branch0.1"
+    for j in range(1, 5):
+        m["Mixed_7a.branch7x7x3_%d" % j] = "features.15.branch1.%d" % (j - 1)
+    for i, name in enumerate(("Mixed_7b", "Mixed_7c")):
+        our = "features.%d" % (16 + i)
+        m[name + ".branch1x1"] = our + ".branch0"
+        m[name + ".branch3x3_1"] = our + ".branch1.pre"
+        m[name + ".branch3x3_2a"] = our + ".branch1.a"
+        m[name + ".branch3x3_2b"] = our + ".branch1.b"
+        m[name + ".branch3x3dbl_1"] = our + ".branch2.p1"
+        m[name + ".branch3x3dbl_2"] = our + ".branch2.p2"
+        m[name + ".branch3x3dbl_3a"] = our + ".branch2.a"
+        m[name + ".branch3x3dbl_3b"] = our + ".branch2.b"
+        m[name + ".branch_pool"] = our + ".branch3.1"
+    return m
+
+
+def convert_torchvision_inception(state):
+    """torchvision inception_v3 state_dict -> our Inception3. AuxLogits.*
+    is dropped (training-time aux head; we ship the main tower only)."""
+    m = _inception_prefix_map()
+    out = {}
+    for k, v in state.items():
+        if k.endswith("num_batches_tracked") or k.startswith("AuxLogits."):
+            continue
+        if k in ("fc.weight", "fc.bias"):
+            out["output.%s" % k.split(".")[1]] = _to_np(v)
+            continue
+        if k.endswith(".conv.weight"):
+            blk, suffix = k[: -len(".conv.weight")], ".0.weight"
+        elif ".bn." in k:
+            blk, attr = k.rsplit(".bn.", 1)
+            suffix = ".1.%s" % _BN[attr]
+        else:
+            blk = None
+        if blk is None or blk not in m:
+            raise KeyError("unrecognized torchvision inception key %r" % k)
+        out[m[blk] + suffix] = _to_np(v)
+    return out
+
+
 def apply_converted(net, mapping, strict=True):
     """Push {structural key: array} into a Block's parameters.
 
@@ -289,6 +357,8 @@ def load_pretrained(net, path, name):
             state, rename=rename))
     if re.match(r"^densenet(121|161|169|201)$", name):
         return apply_converted(net, convert_torchvision_densenet(state))
+    if name == "inceptionv3":
+        return apply_converted(net, convert_torchvision_inception(state))
     if name in ("squeezenet1.0", "squeezenet1.1"):
         # torchvision holds ReLU modules inline (shifting Fire indices)
         # and names the expands expand1x1/expand3x3 (ours: expand1/expand3)
@@ -329,10 +399,9 @@ def load_pretrained(net, path, name):
     raise ValueError(
         "no torch converter registered for model %r; supported: resnet*_v1 "
         "(basic blocks), resnet*_v1b (bottlenecks), vgg11/13/16/19[_bn], "
-        "alexnet, squeezenet1.0/1.1, densenet121/161/169/201, "
-        "mobilenet_v2_tv, and transplant_hf_bert for BERT checkpoints "
-        "(inception is the one unconverted family: torchvision's "
-        "InceptionV3 differs architecturally)" % name)
+        "alexnet, squeezenet1.0/1.1, densenet121/161/169/201, inceptionv3, "
+        "mobilenet_v2_tv, and transplant_hf_bert for BERT checkpoints"
+        % name)
 
 
 def _main(argv):

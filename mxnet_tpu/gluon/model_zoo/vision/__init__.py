@@ -13,9 +13,10 @@ def get_model(name, **kwargs):
 
     ``pretrained`` accepts a PATH instead of the reference's downloadable
     model store (zero-egress here): a native ``.params``/``.npz`` file, or a
-    torch checkpoint routed through ``gluon.model_zoo.convert`` (torchvision
-    resnets and mobilenet_v2_tv today). ``pretrained=True`` still refuses
-    loudly."""
+    torch checkpoint routed through ``gluon.model_zoo.convert`` — every zoo
+    family converts (torchvision resnet/vgg/alexnet/squeezenet/densenet/
+    inception checkpoints, plus the mobilenet_v2_tv variant).
+    ``pretrained=True`` still refuses loudly."""
     from . import resnet, vgg, alexnet, mobilenet, squeezenet, densenet, inception
 
     from ..convert import build_with_pretrained

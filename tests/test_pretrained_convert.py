@@ -92,10 +92,14 @@ def test_pretrained_true_still_refuses_loudly():
 
 
 def test_unconverted_family_raises(tmp_path):
+    # every registered zoo family now converts; an unknown model name is
+    # the remaining refusal path
+    from mxnet_tpu.gluon.model_zoo.convert import load_pretrained
     from mxnet_tpu.gluon.model_zoo.vision import get_model
     torch.save({"features.0.weight": torch.zeros(1)}, tmp_path / "x.pth")
+    net = get_model("resnet18_v1")
     with pytest.raises(ValueError, match="no torch converter"):
-        get_model("inceptionv3", pretrained=str(tmp_path / "x.pth"))
+        load_pretrained(net, str(tmp_path / "x.pth"), "mystery_model")
 
 
 def test_hf_bert_state_dict_transplant():
@@ -227,6 +231,28 @@ def test_torchvision_densenet121_numeric_oracle(tmp_path):
     net = get_model("densenet121", pretrained=str(ckpt), classes=5)
     x = np.random.default_rng(8).normal(
         size=(1, 3, 64, 64)).astype(np.float32)
+    ref = _torch_logits(tm, x)
+    got = _our_logits(net, x)
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-4)
+
+
+def test_torchvision_inception_v3_numeric_oracle(tmp_path):
+    """The last zoo family: torchvision InceptionV3 -> our Inception3 (same
+    compute graph, named vs positional modules); AuxLogits keys dropped."""
+    import torch_inception_ref as tiref
+    from mxnet_tpu.gluon.model_zoo.vision import get_model
+
+    torch.manual_seed(9)
+    tm = tiref.randomize_bn_stats(tiref.inception_v3(num_classes=4), seed=9)
+    state = tm.state_dict()
+    # real torchvision checkpoints carry the aux head; must be ignored
+    state["AuxLogits.conv0.conv.weight"] = torch.zeros(1)
+    ckpt = tmp_path / "inc.pth"
+    torch.save(state, ckpt)
+
+    net = get_model("inceptionv3", pretrained=str(ckpt), classes=4)
+    x = np.random.default_rng(9).normal(
+        size=(1, 3, 299, 299)).astype(np.float32) * 0.1
     ref = _torch_logits(tm, x)
     got = _our_logits(net, x)
     np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-4)

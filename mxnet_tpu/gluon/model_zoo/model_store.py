@@ -1,0 +1,41 @@
+"""API-parity shim for the reference's downloadable model store (ref:
+python/mxnet/gluon/model_zoo/model_store.py).
+
+TPU pods here are zero-egress, so there is no store to download from; every
+entry point exists (ported code imports and calls them) but points at the
+converter workflow instead: convert a torchvision / HF checkpoint once with
+``gluon.model_zoo.convert`` (all 8 vision families supported), then load the
+native ``.params`` file.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_model_file", "purge"]
+
+_HELP = (
+    "the model store is unreachable (zero-egress); convert a checkpoint you "
+    "have instead: get_model(%r, pretrained='/path/to/ckpt.pth') or "
+    "`python -m mxnet_tpu.gluon.model_zoo.convert %s ckpt.pth out.params` "
+    "(see gluon.model_zoo.convert)")
+
+
+def get_model_file(name, root=os.path.join("~", ".mxnet", "models")):
+    """Return a previously converted ``<name>.params`` from ``root`` if one
+    exists; otherwise raise with the converter recipe (no downloads)."""
+    root = os.path.expanduser(root)
+    path = os.path.join(root, "%s.params" % name)
+    if os.path.exists(path):
+        return path
+    raise FileNotFoundError(
+        ("%s not found in %s; " % (name, root)) + _HELP % (name, name))
+
+
+def purge(root=os.path.join("~", ".mxnet", "models")):
+    """Remove converted .params files from ``root`` (ref: model_store.purge)."""
+    root = os.path.expanduser(root)
+    if not os.path.isdir(root):
+        return
+    for f in os.listdir(root):
+        if f.endswith(".params"):
+            os.remove(os.path.join(root, f))

@@ -256,3 +256,18 @@ def test_torchvision_inception_v3_numeric_oracle(tmp_path):
     ref = _torch_logits(tm, x)
     got = _our_logits(net, x)
     np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-4)
+
+
+def test_model_store_shim(tmp_path):
+    """model_store API exists (ported code imports it) and serves CONVERTED
+    files; absent files raise with the converter recipe, never download."""
+    from mxnet_tpu.gluon.model_zoo import model_store
+
+    with pytest.raises(FileNotFoundError, match="convert"):
+        model_store.get_model_file("resnet18_v1", root=str(tmp_path))
+
+    (tmp_path / "resnet18_v1.params").write_bytes(b"x")
+    got = model_store.get_model_file("resnet18_v1", root=str(tmp_path))
+    assert got.endswith("resnet18_v1.params")
+    model_store.purge(root=str(tmp_path))
+    assert not list(tmp_path.glob("*.params"))

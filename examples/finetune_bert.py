@@ -41,6 +41,10 @@ def main():
     ap.add_argument("--lr", type=float, default=5e-5)
     ap.add_argument("--tiny", action="store_true",
                     help="2-layer 128-wide trunk (CPU-friendly smoke)")
+    ap.add_argument("--pretrained", default=None, metavar="CKPT",
+                    help="HF BertModel checkpoint (.pth/.bin torch state "
+                         "dict) transplanted into the trunk before "
+                         "fine-tuning (gluon.model_zoo.convert)")
     args = ap.parse_args()
 
     vocab = 1000 if args.tiny else 30522
@@ -55,6 +59,16 @@ def main():
                          use_decoder=False, use_classifier=False)
     net = BERTClassifier(bert, num_classes=2, dropout=0.1)
     net.initialize()
+    if args.pretrained:
+        # real fine-tuning: transplant an HF BERT checkpoint into the trunk
+        # (warm the deferred shapes with one forward first)
+        from mxnet_tpu.gluon.model_zoo.convert import (load_torch_state,
+                                                       transplant_hf_bert)
+        tok, tt, vl, _ = synthetic_batch(np.random.default_rng(0),
+                                         2, args.seq, vocab)
+        net(tok, tt, vl)
+        transplant_hf_bert(bert, load_torch_state(args.pretrained))
+        print("transplanted pretrained trunk from %s" % args.pretrained)
     net.hybridize()
 
     trainer = gluon.Trainer(net.collect_params(), "adam",

@@ -10,8 +10,9 @@ replaced by CONVERTERS from checkpoint files users already have on disk:
 - torchvision ``vgg11/13/16/19`` (plain + ``_bn``), ``alexnet``,
   ``squeezenet1.0/1.1``, ``densenet121/161/169/201``, ``inceptionv3``,
   and ``mobilenet_v2_tv`` via structural converters — every zoo family
-- HuggingFace ``BertModel`` state dicts -> ``models.bert.BERTModel``
-  (fused-qkv transplant, same mapping the HF oracle tests prove to 2e-4)
+- HuggingFace ``BertModel`` / ``GPT2Model`` state dicts ->
+  ``models.bert.BERTModel`` / ``models.gpt.GPTModel`` (fused-qkv
+  transplants, the mappings the HF oracle tests prove to 2e-4)
 
 ``get_model(name, pretrained="/path/to/ckpt.pth")`` routes through
 ``load_pretrained``; the CLI converts once into a native ``.params`` file:
@@ -24,8 +25,10 @@ import re
 
 import numpy as np
 
-__all__ = ["convert_torchvision_resnet", "apply_converted", "load_pretrained",
-           "transplant_hf_bert", "load_torch_state"]
+__all__ = ["convert_torchvision_resnet", "convert_torchvision_generic",
+           "convert_torchvision_densenet", "convert_torchvision_inception",
+           "apply_converted", "load_pretrained", "transplant_hf_bert",
+           "transplant_hf_gpt2", "load_torch_state"]
 
 # torch BatchNorm attr -> our BatchNorm param suffix
 _BN = {"weight": "gamma", "bias": "beta",
@@ -320,6 +323,46 @@ def build_with_pretrained(factory, name, pretrained, **kwargs):
     if path:
         load_pretrained(net, path, name)
     return net
+
+
+def transplant_hf_gpt2(model, state):
+    """HuggingFace ``GPT2Model``/``GPT2LMHeadModel`` tensors -> our
+    ``models.gpt.GPTModel``. HF's Conv1D stores (in, out) — transposed into
+    our Dense (out, in); the fused ``c_attn`` column order [q|k|v] matches
+    our qkv row order after the transpose. ``state`` is any name->array
+    mapping (optionally with the ``transformer.`` prefix the LM-head
+    checkpoints carry)."""
+    state = {k[len("transformer."):] if k.startswith("transformer.") else k: v
+             for k, v in state.items()}
+
+    def get(name, transpose=False):
+        v = _to_np(state[name])
+        return v.T if transpose else v
+
+    def set_(p, arr):
+        from ...ndarray import NDArray
+        import jax.numpy as jnp
+        p.set_data(NDArray(jnp.asarray(arr, dtype=np.float32)))
+
+    set_(model.word_embed.weight, get("wte.weight"))
+    set_(model.pos_embed.weight, get("wpe.weight"))
+    for i, blk in enumerate(model.blocks):
+        pre = "h.%d." % i
+        set_(blk.ln1.gamma, get(pre + "ln_1.weight"))
+        set_(blk.ln1.beta, get(pre + "ln_1.bias"))
+        set_(blk.attn.qkv.weight, get(pre + "attn.c_attn.weight", True))
+        set_(blk.attn.qkv.bias, get(pre + "attn.c_attn.bias"))
+        set_(blk.attn.attn_out.weight, get(pre + "attn.c_proj.weight", True))
+        set_(blk.attn.attn_out.bias, get(pre + "attn.c_proj.bias"))
+        set_(blk.ln2.gamma, get(pre + "ln_2.weight"))
+        set_(blk.ln2.beta, get(pre + "ln_2.bias"))
+        set_(blk.ffn_1.weight, get(pre + "mlp.c_fc.weight", True))
+        set_(blk.ffn_1.bias, get(pre + "mlp.c_fc.bias"))
+        set_(blk.ffn_2.weight, get(pre + "mlp.c_proj.weight", True))
+        set_(blk.ffn_2.bias, get(pre + "mlp.c_proj.bias"))
+    set_(model.ln_f.gamma, get("ln_f.weight"))
+    set_(model.ln_f.beta, get("ln_f.bias"))
+    return model
 
 
 _RESNET_NAME = re.compile(r"^resnet(\d+)_v(1b?|2)$")

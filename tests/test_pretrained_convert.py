@@ -271,3 +271,34 @@ def test_model_store_shim(tmp_path):
     assert got.endswith("resnet18_v1.params")
     model_store.purge(root=str(tmp_path))
     assert not list(tmp_path.glob("*.params"))
+
+
+def test_hf_gpt2_state_dict_transplant():
+    """transplant_hf_gpt2 from a raw LM-head state dict (transformer.
+    prefix, Conv1D transposes) matches HF logits — the production twin of
+    test_hf_oracle's in-test GPT mapping."""
+    transformers = pytest.importorskip("transformers")
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon.model_zoo.convert import transplant_hf_gpt2
+    from mxnet_tpu.models.gpt import GPTModel
+
+    cfg = dict(vocab_size=211, n_positions=16, n_embd=32, n_layer=2,
+               n_head=4, resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+               layer_norm_epsilon=1e-5)
+    torch.manual_seed(3)
+    hf = transformers.GPT2LMHeadModel(transformers.GPT2Config(**cfg))
+    hf.eval()
+    state = {k: v.detach().numpy() for k, v in hf.named_parameters()}
+
+    model = GPTModel(vocab_size=211, units=32, num_layers=2, num_heads=4,
+                     max_length=16, dropout=0.0)
+    model.initialize()
+    rng = np.random.default_rng(3)
+    tok = rng.integers(0, 211, (2, 9)).astype(np.int32)
+    model(nd.array(tok))  # materialize deferred shapes
+    transplant_hf_gpt2(model, state)
+
+    logits = model(nd.array(tok))
+    with torch.no_grad():
+        ref = hf(input_ids=torch.tensor(tok.astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(logits.asnumpy(), ref, rtol=2e-4, atol=2e-4)

@@ -237,3 +237,38 @@ class Mixed:
                 init(name, arr)
                 return
         raise ValueError("no initializer matched %r" % str(name))
+
+
+class Load:
+    """Initialize parameters from a dict of saved arrays by name, falling
+    back to ``default_init`` for names not in the dict (ref:
+    python/mxnet/initializer.py:Load)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        self.param = {str(k): v for k, v in dict(param).items()}
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr):
+        name = str(name)
+        key = name if name in self.param else             (name.split(":", 1)[-1] if name.split(":", 1)[-1] in self.param
+             else None)
+        if key is not None:
+            src = self.param[key]
+            src_shape = tuple(getattr(src, "shape", ()))
+            if src_shape != tuple(arr.shape):
+                raise ValueError(
+                    "Parameter %r cannot be initialized from loading: "
+                    "shape %s != expected %s"
+                    % (name, src_shape, tuple(arr.shape)))
+            data = src._data if hasattr(src, "_data") else jnp.asarray(
+                numpy.asarray(src))
+            arr._data = data.astype(arr._data.dtype)
+            if self.verbose:
+                print("Initialized %s by loading" % name)
+        else:
+            if self.default_init is None:
+                raise ValueError(
+                    "Cannot Initialize parameter %r: not found in the "
+                    "loaded dict and no default_init given" % name)
+            self.default_init(name, arr)

@@ -230,6 +230,15 @@ class CrossEntropy(EvalMetric):
 
 
 @register
+class NegativeLogLikelihood(CrossEntropy):
+    """Mean -log p(label) (ref: python/mxnet/metric.py:NegativeLogLikelihood)
+    — CrossEntropy under its upstream alias/name."""
+
+    def __init__(self, eps=1e-12, name="nll-loss", **kwargs):
+        super().__init__(eps=eps, name=name, **kwargs)
+
+
+@register
 class Perplexity(CrossEntropy):
     def __init__(self, ignore_label=None, name="perplexity", **kwargs):
         super().__init__(name=name, **kwargs)
@@ -351,3 +360,25 @@ _alias = _registry_mod.get_alias_func(EvalMetric, "metric")
 _alias("acc")(Accuracy)
 _alias("top_k_accuracy", "top_k_acc")(TopKAccuracy)
 _alias("ce")(CrossEntropy)
+
+
+def check_label_shapes(labels, preds, wrap=False, shape=False):
+    """(ref: python/mxnet/metric.py:check_label_shapes) raise when the
+    label/pred list lengths (or full shapes, with ``shape=True``) disagree;
+    with ``wrap``, single arrays are returned wrapped in lists."""
+    if isinstance(labels, (NDArray, numpy.ndarray)):
+        labels = [labels]
+    if isinstance(preds, (NDArray, numpy.ndarray)):
+        preds = [preds]
+    ln, pn = len(labels), len(preds)
+    if ln != pn:
+        raise ValueError("Shape of labels %d does not match shape of "
+                         "predictions %d" % (ln, pn))
+    if shape:
+        for l, p in zip(labels, preds):
+            if tuple(l.shape) != tuple(p.shape):
+                raise ValueError("Shape of labels %s does not match shape "
+                                 "of predictions %s"
+                                 % (tuple(l.shape), tuple(p.shape)))
+    if wrap:
+        return labels, preds

@@ -1,5 +1,6 @@
 """mx.name / mx.attribute / mx.runtime top-level API parity (ref:
 python/mxnet/name.py, attribute.py, runtime.py)."""
+import numpy as np
 import pytest
 
 import mxnet_tpu as mx
@@ -341,3 +342,55 @@ def test_libinfo_and_kvstore_server():
     assert paths and all(p.endswith(".so") for p in paths)
     with pytest.raises(RuntimeError, match="collectives"):
         mx.kvstore_server.KVStoreServer()
+
+
+def test_metric_nll_and_check_label_shapes():
+    import pytest
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    m = mx.metric.NegativeLogLikelihood()
+    probs = np.array([[0.2, 0.8], [0.9, 0.1]], np.float32)
+    m.update(nd.array(np.array([1, 0])), nd.array(probs))
+    want = -(np.log(0.8) + np.log(0.9)) / 2
+    assert abs(m.get()[1] - want) < 1e-6
+    assert mx.metric.create("negativeloglikelihood") is not None
+
+    ls, ps = mx.metric.check_label_shapes(nd.zeros((2,)), nd.zeros((2, 3)),
+                                          wrap=True)
+    assert isinstance(ls, list) and isinstance(ps, list)
+    with pytest.raises(ValueError, match="does not match"):
+        mx.metric.check_label_shapes([nd.zeros((2,))], [])
+    with pytest.raises(ValueError, match="does not match"):
+        mx.metric.check_label_shapes(nd.zeros((2,)), nd.zeros((3,)),
+                                     shape=True)
+
+
+def test_initializer_load():
+    import pytest
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon import nn
+
+    net = nn.Dense(3, in_units=4)
+    w = np.arange(12, dtype=np.float32).reshape(3, 4)
+    b = np.ones(3, np.float32)
+    net.initialize()
+    names = list(net.collect_params().keys())
+    wname = [n for n in names if n.endswith("weight")][0]
+    bname = [n for n in names if n.endswith("bias")][0]
+
+    net.initialize(mx.initializer.Load({wname: nd.array(w),
+                                        bname: nd.array(b)}),
+                   force_reinit=True)
+    np.testing.assert_allclose(net.weight.data().asnumpy(), w)
+    # gluon semantics: the bias keeps its param-level zero init under
+    # a global initializer; direct invocation loads it
+    mx.initializer.Load({bname: nd.array(b)})(bname, net.bias.data())
+    np.testing.assert_allclose(net.bias.data().asnumpy(), b)
+
+    with pytest.raises(ValueError, match="not found"):
+        nn.Dense(2, in_units=2).initialize(
+            mx.initializer.Load({}), force_reinit=True)

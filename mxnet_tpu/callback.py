@@ -50,6 +50,18 @@ class BatchEndParam:
         self.locals = locals
 
 
+def module_checkpoint(mod, prefix, period=1, save_optimizer_states=False):
+    """Epoch-end callback saving a Module in the upstream checkpoint layout
+    (ref: callback.py:module_checkpoint → mod.save_checkpoint)."""
+    period = max(int(period), 1)
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            mod.save_checkpoint(prefix, iter_no + 1)
+
+    return _callback
+
+
 def do_checkpoint(prefix, period=1):
     """(ref: callback.py:do_checkpoint)"""
 

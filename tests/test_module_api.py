@@ -516,3 +516,34 @@ def test_set_params_force_init_false_keeps_values():
                       "fc_bias": nd.array(np.zeros(3, np.float32))},
                      force_init=False)
     np.testing.assert_allclose(m._arg_params["fc_weight"].asnumpy(), before)
+
+
+def test_callback_module_checkpoint(tmp_path):
+    """(ref: callback.py:module_checkpoint) saves the upstream
+    prefix-symbol.json + prefix-NNNN.params layout from a bound Module."""
+    import os
+
+    from mxnet_tpu import callback
+
+    data = sym.var("data")
+    out = sym.FullyConnected(data, sym.var("fc_weight"), sym.var("fc_bias"),
+                             num_hidden=3)
+    m = Module(out, data_names=("data",), label_names=())
+    m.bind([("data", (2, 4))], for_training=False)
+    m.init_params()
+    cb = callback.module_checkpoint(m, str(tmp_path / "ck"), period=2)
+    cb(0)  # epoch 1: not a period multiple
+    assert not os.path.exists(str(tmp_path / "ck-0001.params"))
+    cb(1)  # epoch 2
+    assert os.path.exists(str(tmp_path / "ck-0002.params"))
+    assert os.path.exists(str(tmp_path / "ck-symbol.json"))
+
+    m2 = Module.load(str(tmp_path / "ck"), 2, data_names=("data",),
+                     label_names=())
+    m2.bind([("data", (2, 4))], for_training=False)
+    m2.init_params()  # applies the preloaded checkpoint params
+    x = nd.array(np.ones((2, 4), np.float32))
+    np.testing.assert_allclose(
+        m2.forward(DataBatch([x], None), is_train=False)[0].asnumpy(),
+        m.forward(DataBatch([x], None), is_train=False)[0].asnumpy(),
+        rtol=1e-6)

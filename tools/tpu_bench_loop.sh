@@ -1,13 +1,13 @@
 #!/bin/bash
-# Waits for the axon TPU relay to answer, then runs the full round-4
+# Waits for the axon TPU relay to answer, then runs the full round-5
 # measurement sequence exactly once:
 #   1. headline bert (the number the driver replays must land first)
 #   2. flash-attention block sweep --apply (winners land in
 #      mxnet_tpu/ops/pallas/flash_blocks.json so every later bench is tuned)
 #   3. bench.py all — all six modes, persisted to BENCH_RESULTS.json
-#   4. batch/remat MFU sweep (tools/batch_sweep_r4.jsonl)
+#   4. batch/remat MFU sweep (tools/batch_sweep_r5.jsonl)
 #   5. hardware pallas tests + tools/tpu_kernel_check.py
-#      (tools/tpu_kernel_check_r4.json evidence artifact)
+#      (tools/tpu_kernel_check_r5.json evidence artifact)
 # The relay wedges for hours at a time (VERDICT r2 Weak #4), so this is
 # designed to be left running in the background all round: probe cheaply,
 # act the moment the relay recovers.
@@ -16,7 +16,7 @@
 # a pkill in the same compound command self-matches and kills it)
 set -u
 cd "$(dirname "$0")/.."
-LOG=${TPU_LOOP_LOG:-/tmp/tpu_measurements_r4.log}
+LOG=${TPU_LOOP_LOG:-/tmp/tpu_measurements_r5.log}
 exec >>"$LOG" 2>&1
 
 LOOP_START=$(date -u +%FT%TZ)
@@ -68,7 +68,7 @@ sys.exit(0 if (b.get('swept_at') or '') >= '$LOOP_START' else 1)" 2>/dev/null; t
       echo "[loop] $(date -u +%T) block table already swept this run; skipping"
     else
       timeout -k 30 3600 python tools/flash_sweep.py --seq 512 1024 2048 \
-        --json tools/flash_sweep_r4.json --apply \
+        --json tools/flash_sweep_r5.json --apply \
         || echo "[loop] flash sweep failed (rerun manually)"
     fi
     echo "[loop] $(date -u +%T) sweep done; running bench all"
@@ -85,7 +85,7 @@ import json, sys
 r = json.load(open('BENCH_RESULTS.json')).get('bert', {})
 sys.exit(0 if r.get('measured_at', '') >= '$LOOP_START' else 1)" 2>/dev/null; then
       echo "[loop] $(date -u +%T) bench all rc=$rc with headline saved; batch/remat sweep (MFU hunt)"
-      SWEEP_OUT=tools/batch_sweep_r4.jsonl
+      SWEEP_OUT=tools/batch_sweep_r5.jsonl
       : > "$SWEEP_OUT"
       for args in "bert --batch=64" "bert --batch=128" "bert --batch=256" \
                   "bert512 --batch=32" "bert512 --batch=32 --remat" \
@@ -112,7 +112,7 @@ sys.exit(0 if r.get('measured_at', '') >= '$LOOP_START' else 1)" 2>/dev/null; th
         echo "[loop] pallas hw tests NOT green (rc=$rc): $(tail -1 /tmp/pallas_hw_tests.log)"
       fi
       timeout -k 30 1800 python tools/tpu_kernel_check.py \
-        --json tools/tpu_kernel_check_r4.json \
+        --json tools/tpu_kernel_check_r5.json \
         && echo "[loop] kernel check artifact written" \
         || echo "[loop] kernel check FAILED (rc=$?)"
       echo "[loop] $(date -u +%T) sequence complete"

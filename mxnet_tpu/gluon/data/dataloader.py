@@ -50,9 +50,58 @@ def default_mp_batchify_fn(data):
 _worker_dataset = None
 
 
+def _pin_worker_to_cpu():
+    """Workers must never acquire the accelerator: libtpu is single-process-
+    exclusive, so a spawned child initializing its own TPU client would
+    wedge against the parent that already holds the chip. The env var alone
+    is not enough when a sitecustomize re-exports JAX_PLATFORMS at
+    interpreter start, so the live config is updated too (a no-op if the
+    backend somehow initialized already, in which case nothing here can
+    help and the env var at least covers grandchildren)."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # pragma: no cover - jax absent or config frozen
+        pass
+
+
+def _unpickle_pinned(payload):
+    import pickle
+
+    _pin_worker_to_cpu()
+    return pickle.loads(payload)
+
+
+class _CpuPinnedPayload:
+    """Pickles as (pin-CPU, then unpickle the wrapped object).
+
+    ProcessPoolExecutor unpickles initargs BEFORE calling the initializer,
+    so a dataset holding NDArray members (e.g. ArrayDataset) would
+    otherwise initialize the worker's jax backend — on the inherited
+    accelerator platform — during process bootstrap, before any pin could
+    run. Nesting the dataset bytes inside this wrapper makes the CPU pin
+    part of the unpickle itself: it is guaranteed to run first."""
+
+    def __init__(self, obj):
+        self.obj = obj
+
+    def __reduce__(self):
+        import pickle
+
+        return _unpickle_pinned, (pickle.dumps(self.obj),)
+
+
 def _worker_initializer(dataset):
     # runs once per worker process; the dataset rides the initargs pickle
-    # (fork start method shares it copy-on-write anyway)
+    # (wrapped in _CpuPinnedPayload, so by the time it is reconstructed the
+    # backend is already pinned). Pin again for the array-free case where
+    # the dataset pickle never triggered the wrapper's import path —
+    # __getitem__ may still create NDArrays later (ToTensor & friends).
+    _pin_worker_to_cpu()
     global _worker_dataset
     _worker_dataset = dataset
 
@@ -163,7 +212,10 @@ class DataLoader:
                 self._num_workers,
                 mp_context=multiprocessing.get_context("spawn"),
                 initializer=_worker_initializer,
-                initargs=(self._dataset,))
+                # _CpuPinnedPayload: the CPU pin must precede the dataset
+                # unpickle itself (initargs deserialize before the
+                # initializer runs)
+                initargs=(_CpuPinnedPayload(self._dataset),))
         pool = self._mp_pool
         futs = deque()
         try:

@@ -712,6 +712,75 @@ def test_dataloader_process_workers_order_and_values():
         np.testing.assert_allclose(sy.asnumpy(), my.asnumpy(), rtol=1e-6)
 
 
+class _EnvRecorder:
+    """Records JAX_PLATFORMS at UNPICKLE time — i.e. during the worker's
+    initargs deserialization, which happens before the initializer runs."""
+
+    def __init__(self):
+        self.env_at_unpickle = None
+
+    def __getstate__(self):
+        return {}
+
+    def __setstate__(self, state):
+        import os
+
+        self.env_at_unpickle = os.environ.get("JAX_PLATFORMS")
+
+
+class _DeviceArrayDataset:
+    """__getitem__ returns NDArray, like any transformed vision dataset —
+    the case where workers create jax arrays and MUST be pinned to CPU."""
+
+    def __init__(self):
+        self._rec = _EnvRecorder()
+
+    def __len__(self):
+        return 12
+
+    def __getitem__(self, i):
+        from mxnet_tpu.ndarray import array
+
+        return array(np.full((3,), float(i), np.float32))
+
+
+def _probe_worker_backend(indices, batchify_fn):
+    import os
+
+    import jax
+
+    from mxnet_tpu.gluon.data import dataloader
+
+    # force backend init the way a transform would, then report it
+    _ = dataloader._worker_dataset[indices[0]]
+    return (os.environ.get("JAX_PLATFORMS"), jax.default_backend(),
+            dataloader._worker_dataset._rec.env_at_unpickle)
+
+
+def test_dataloader_process_workers_pinned_to_cpu():
+    """Spawned workers must never initialize an accelerator backend:
+    _worker_initializer pins JAX_PLATFORMS=cpu + jax.config before any
+    array creation (libtpu is single-process-exclusive, so a worker
+    grabbing the device would wedge against the parent)."""
+    from mxnet_tpu.gluon.data import DataLoader
+
+    ds = _DeviceArrayDataset()
+    loader = DataLoader(ds, batch_size=4, num_workers=2, thread_pool=False)
+    batches = list(loader)  # NDArray-returning dataset through the mp path
+    assert len(batches) == 3
+    np.testing.assert_allclose(batches[0].asnumpy()[:, 0], [0.0, 1.0, 2.0, 3.0])
+    # peek inside a live worker: backend must be cpu, env pinned, and the
+    # pin must have been in place BEFORE the dataset unpickled (initargs
+    # deserialize ahead of the initializer — _CpuPinnedPayload guarantees
+    # the ordering; a dataset holding device arrays would otherwise init
+    # the accelerator backend during worker bootstrap)
+    env, backend, env_at_unpickle = loader._mp_pool.submit(
+        _probe_worker_backend, [0], None).result()
+    assert env == "cpu"
+    assert backend == "cpu"
+    assert env_at_unpickle == "cpu"
+
+
 def test_dataloader_process_workers_early_break():
     from mxnet_tpu.gluon.data import DataLoader
 

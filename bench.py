@@ -106,20 +106,20 @@ def _xent_mean(logits, labels):
     kernel (ops/pallas/softmax_xent.py): loss + logsumexp in ONE VMEM pass,
     backward reuses the saved lse — versus XLA's materialized fp32
     log_softmax + gather, the top non-matmul HBM sink in the LM losses
-    (VERDICT r3 next-round #2). Interpret mode keeps the CPU smoke path
-    runnable; the dispatch is trace-time, baked into the jitted step."""
+    (VERDICT r3 next-round #2). Routed through the registry op the gluon
+    loss uses (VERDICT r4 next #3): TPU gates into the kernel, CPU smoke
+    takes the jnp fallback (kernel parity is pinned in tests)."""
     if os.environ.get("BENCH_NO_PALLAS_XENT"):
         # escape hatch: if the Mosaic lowering ever fails on hardware, the
         # loop retries the mode with this set rather than losing the window
         lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         return jnp.mean(-jnp.take_along_axis(
             lp.reshape(-1, lp.shape[-1]), labels.reshape(-1, 1), axis=-1))
-    from mxnet_tpu.base import is_tpu_backend
-    from mxnet_tpu.ops.pallas.softmax_xent import softmax_xent
-    vocab = logits.shape[-1]
-    nll = softmax_xent(logits.reshape(-1, vocab), labels.reshape(-1),
-                       not is_tpu_backend())
-    return jnp.mean(nll)
+    # the USER path (same op gluon.loss.SoftmaxCrossEntropyLoss hits): on
+    # TPU it gates into the pallas kernel, lane-aligning V internally —
+    # the bench measures what real training gets, no special-casing
+    from mxnet_tpu.ops.functional import softmax_xent_rows
+    return jnp.mean(softmax_xent_rows(logits, labels))
 
 
 def build(seq=SEQ, remat=False):

@@ -84,8 +84,12 @@ SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
 
 
 class SoftmaxCrossEntropyLoss(Loss):
-    """(ref: loss.py:SoftmaxCrossEntropyLoss). On TPU the log_softmax + pick
-    fuses into one XLA kernel; labels stay on device."""
+    """(ref: loss.py:SoftmaxCrossEntropyLoss). The sparse-label raw-logits
+    case — LM/classification training — routes through the registry's
+    ``softmax_xent_rows``, whose TPU gate is the fused pallas softmax-xent
+    kernel (one HBM pass of the logits + lse-reusing backward instead of
+    XLA's materialized log_softmax + gather). Other configurations keep the
+    log_softmax formulation, which XLA fuses."""
 
     def __init__(self, axis=-1, sparse_label=True, from_logits=False,
                  weight=None, batch_axis=0, **kwargs):
@@ -95,11 +99,13 @@ class SoftmaxCrossEntropyLoss(Loss):
         self._from_logits = from_logits
 
     def hybrid_forward(self, F, pred, label, sample_weight=None):
-        if not self._from_logits:
-            pred = F.log_softmax(pred, axis=self._axis)
-        if self._sparse_label:
+        if self._sparse_label and not self._from_logits:
+            loss = F.softmax_xent_rows(pred, label, axis=self._axis)
+        elif self._sparse_label:
             loss = -F.pick(pred, label, axis=self._axis, keepdims=False)
         else:
+            if not self._from_logits:
+                pred = F.log_softmax(pred, axis=self._axis)
             label = F.reshape(label, shape=pred.shape)
             loss = -F.sum(pred * label, axis=self._axis)
         loss = _apply_weighting(F, loss, self._weight, sample_weight)

@@ -7,6 +7,7 @@ over the head's logits.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -161,12 +162,18 @@ class Module:
 
     def backward(self, out_grads=None):
         if out_grads is None and self._symbol._op == "SoftmaxOutput":
-            # MXNet semantics: d(logits) = softmax - one_hot(label)
+            # MXNet semantics: d(logits) = softmax - one_hot(label). The
+            # probs are a mandated output of the head, so the grad from them
+            # is already a single elementwise pass — the same one-pass
+            # backward the fused pallas xent kernel (ops/pallas/softmax_xent)
+            # achieves by reconstructing p from its saved lse. one_hot via
+            # iota-compare, NOT .at[].set(): scatter is a serialized op on
+            # TPU, the compare fuses into the subtract.
             prob = self._exec.outputs[0]._data
             label = self._last_feed[self._label_names[0]]
             label = label._data if isinstance(label, NDArray) else jnp.asarray(label)
-            onehot = jnp.zeros_like(prob).at[
-                jnp.arange(prob.shape[0]), label.astype(jnp.int32)].set(1.0)
+            cols = jax.lax.broadcasted_iota(jnp.int32, prob.shape, prob.ndim - 1)
+            onehot = (cols == label.astype(jnp.int32)[:, None]).astype(prob.dtype)
             grad = (prob - onehot) / prob.shape[0]
             out_grads = [NDArray(grad)]
         elif out_grads is None:
@@ -373,33 +380,44 @@ class Module:
         given = dict(arg_params or {})
         given.update(aux_params or {})
         known = set(self._arg_params)  # snapshot BEFORE mutating in the loop
-        if known:
-            extra = sorted(set(given) - known)
-            if extra and not allow_extra:
-                raise ValueError(
-                    "set_params: unknown parameter(s) %s (module has %s...); "
-                    "pass allow_extra=True to ignore"
-                    % (extra[:5], sorted(known)[:5]))
-            missing = sorted(known - set(given))
-            if missing and not allow_missing:
-                raise ValueError(
-                    "set_params: missing parameter(s) %s; pass "
-                    "allow_missing=True to keep current values"
-                    % (missing[:5],))
+        if not known:
+            # pre-bind there is nothing to validate names against, so a
+            # typo'd name cannot be caught and would become a dead dict
+            # entry — warn LOUDLY (ADVICE r4) while keeping the documented
+            # pre-bind flow (values apply at bind time)
+            import warnings
+
+            warnings.warn(
+                "set_params before bind/init_params: parameter names cannot "
+                "be validated against the module — a misspelled name would "
+                "be silently unused; prefer binding first")
+            for n, v in given.items():
+                self._arg_params[n] = v if isinstance(v, NDArray) \
+                    else NDArray(jnp.asarray(v))
+            return
+        extra = sorted(set(given) - known)
+        if extra and not allow_extra:
+            raise ValueError(
+                "set_params: unknown parameter(s) %s (module has %s...); "
+                "pass allow_extra=True to ignore"
+                % (extra[:5], sorted(known)[:5]))
+        missing = sorted(known - set(given))
+        if missing and not allow_missing:
+            raise ValueError(
+                "set_params: missing parameter(s) %s; pass "
+                "allow_missing=True to keep current values"
+                % (missing[:5],))
         kept = []
         for n, v in given.items():
-            if known and n not in known:
+            if n not in known:
                 continue  # allow_extra: ignored, like upstream
             new = v._data if isinstance(v, NDArray) else jnp.asarray(v)
-            cur = self._arg_params.get(n)
-            if cur is not None and tuple(new.shape) != tuple(cur._data.shape):
+            cur = self._arg_params[n]
+            if tuple(new.shape) != tuple(cur._data.shape):
                 raise ValueError(
                     "set_params: %r has shape %s; module expects %s"
                     % (n, tuple(new.shape), tuple(cur._data.shape)))
-            if cur is None:
-                self._arg_params[n] = v if isinstance(v, NDArray) \
-                    else NDArray(new)
-            elif not force_init:
+            if not force_init:
                 kept.append(n)
             else:
                 cur._data = new.astype(cur._data.dtype)

@@ -232,6 +232,12 @@ def cumsum(x, *, axis=None, dtype=None):
     return jnp.cumsum(x, axis=axis, dtype=resolve_dtype(dtype))
 
 
+@register_op("cumprod")
+def cumprod(x, *, axis=None, dtype=None):
+    """(ref: np_cumprod — upstream's mx.np surface; flat nd alias here)."""
+    return jnp.cumprod(x, axis=axis, dtype=resolve_dtype(dtype))
+
+
 @register_op("L2Normalization")
 def L2Normalization(x, *, eps=1e-10, mode="instance"):
     if mode == "instance":
@@ -431,6 +437,12 @@ def one_hot(indices, *, depth, on_value=1.0, off_value=0.0, dtype="float32"):
 @register_op("diag")
 def diag(x, *, k=0):
     return jnp.diag(x, k=k) if x.ndim <= 2 else jnp.diagonal(x, offset=k)
+
+
+@register_op("trace")
+def trace(x, *, offset=0, axis1=0, axis2=1):
+    """Sum along a diagonal (ref: np_trace_op.cc; flat nd alias here)."""
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
 
 
 @register_op("depth_to_space")
@@ -784,21 +796,40 @@ def log_softmax(x, *, axis=-1):
 
 @register_op("softmax_cross_entropy")
 def softmax_cross_entropy(logits, labels):
-    """(ref: src/operator/loss_binary_op.cc). On TPU at MXU-aligned vocab
-    widths the fused pallas kernel (ops/pallas/softmax_xent.py) computes the
-    row NLLs in one HBM pass of the logits instead of three."""
-    # deterministic gate: a trace-time try/except cannot catch Mosaic
-    # compile failures (they surface at jit-compile time), so the fused path
-    # is taken only for configurations the kernel handles by construction
-    # (2-D, lane-aligned V; rows-per-block is VMEM-capped inside the kernel)
-    if (is_tpu_backend() and logits.ndim == 2
-            and logits.shape[-1] % 128 == 0):
+    """(ref: src/operator/loss_binary_op.cc). On TPU the fused pallas kernel
+    (ops/pallas/softmax_xent.py) computes the row NLLs in one HBM pass of
+    the logits instead of three."""
+    return jnp.sum(softmax_xent_rows(logits, labels))
+
+
+@register_op("softmax_xent_rows")
+def softmax_xent_rows(logits, labels, *, axis=-1):
+    """Per-row sparse-label NLL under softmax — the shared hot path behind
+    softmax_cross_entropy, gluon.loss.SoftmaxCrossEntropyLoss, and the LM
+    benches. logits (..., V) along ``axis``, int labels shaped like logits
+    minus that axis; returns fp32 NLLs in the labels' shape.
+
+    Gate is deterministic at trace time (a try/except cannot catch Mosaic
+    compile failures, which surface at jit-compile time): the fused kernel
+    runs on TPU for any V — it lane-aligns internally — while non-TPU
+    backends take the jnp path (interpret-mode kernel parity is pinned by
+    tests/test_kernels.py)."""
+    axis = axis % logits.ndim
+    if axis != logits.ndim - 1:
+        logits = jnp.moveaxis(logits, axis, -1)
+    rows_shape = logits.shape[:-1]
+    flat = logits.reshape((-1, logits.shape[-1]))
+    lab = labels.astype(jnp.int32).reshape((-1,))
+    if is_tpu_backend():
         from .pallas.softmax_xent import softmax_xent as _fused
 
-        return jnp.sum(_fused(logits, labels))
-    lp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(lp, labels.astype(jnp.int32)[:, None], axis=-1)
-    return jnp.sum(nll)
+        nll = _fused(flat, lab)
+    else:
+        # fp32 like the kernel (which does fp32 math and returns fp32
+        # regardless of logits dtype) — backends must agree in precision
+        lp = jax.nn.log_softmax(flat.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(lp, lab[:, None], axis=-1)[:, 0]
+    return nll.reshape(rows_shape)
 
 
 @jax.custom_vjp

@@ -51,6 +51,23 @@ def _block_rows(R, V, want=128, vmem_budget=2 << 20):
     return max(br, 1)
 
 
+_PAD_NEG = -1e30  # finite: exp(_PAD_NEG - m) underflows to 0, no inf-inf NaN
+
+
+def _pad_lanes(logits):
+    """Lane-align V to a multiple of 128 by padding with a large negative
+    constant. Mosaic's guarantees are simplest (and fastest) for aligned
+    lane dims, and real vocabularies (BERT 30522, GPT-2 50257) are NOT
+    aligned — padding costs one fused pad (+<0.3% lanes) and keeps the
+    kernel itself aligned by construction. Padded lanes contribute
+    exp(-1e30 - m) = 0 to the row lse and can never be a label."""
+    V = logits.shape[-1]
+    pad = (-V) % 128
+    if pad:
+        logits = jnp.pad(logits, ((0, 0), (0, pad)), constant_values=_PAD_NEG)
+    return logits, V
+
+
 def _run_fwd(logits, labels, interpret=False):
     R, V = logits.shape
     br = _block_rows(R, V)
@@ -90,19 +107,23 @@ def _run_bwd(logits, labels, lse, dy, interpret=False):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
 def softmax_xent(logits, labels, interpret=False):
     """Per-row NLL of int labels under softmax(logits). logits (N, V) any
-    float dtype, labels (N,) int. Returns (N,) fp32."""
-    return _run_fwd(logits, labels, interpret)[0]
+    float dtype and ANY V (lane-aligned internally), labels (N,) int.
+    Returns (N,) fp32."""
+    padded, _ = _pad_lanes(logits)
+    return _run_fwd(padded, labels, interpret)[0]
 
 
 def _sx_fwd(logits, labels, interpret):
-    loss, lse = _run_fwd(logits, labels, interpret)
-    return loss, (logits, labels, lse)
+    padded, v_real = _pad_lanes(logits)
+    loss, lse = _run_fwd(padded, labels, interpret)
+    return loss, (padded, v_real, labels, lse)
 
 
 def _sx_bwd(interpret, res, dy):
-    logits, labels, lse = res
-    dx = _run_bwd(logits, labels, lse, dy, interpret)
-    return dx, None
+    padded, v_real, labels, lse = res
+    dx = _run_bwd(padded, labels, lse, dy, interpret)
+    # padded lanes carry p·dy (p=0 there), so the slice drops exact zeros
+    return dx[:, :v_real], None
 
 
 softmax_xent.defvjp(_sx_fwd, _sx_bwd)

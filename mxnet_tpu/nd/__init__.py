@@ -46,6 +46,9 @@ _UPDATE_STATE_ARGS = {
     "adam_update": (2, 3), "ftrl_update": (2, 3), "mp_sgd_update": (2,),
     "lamb_update_phase1": (2, 3), "mp_lamb_update_phase1": (2, 3),
     "mp_lamb_update_phase2": (4,),
+    "mp_sgd_mom_update": (2, 3), "nag_mom_update": (2,),
+    "mp_nag_mom_update": (2, 3), "ftml_update": (2, 3, 4),
+    "rmspropalex_update": (2, 3, 4),
 }
 
 
@@ -67,6 +70,66 @@ def _make_update(opname, state_pos):
 
 for _name, _pos in _UPDATE_STATE_ARGS.items():
     setattr(_mod, _name, _make_update(_name, _pos))
+
+
+# The multi-weight update family returns ONE grouped list (weights first,
+# then states group-major — see ops/legacy_ops.py _multi_sgd); the facade
+# writes every weight and state back into the passed arrays, restoring the
+# upstream in-place contract for legacy call sites.
+_MULTI_UPDATE_LAYOUT = {
+    # opname: (stride, has_mom, mp, preloaded lrs/wds tail)
+    "multi_sgd_update": (2, False, False, False),
+    "multi_sgd_mom_update": (3, True, False, False),
+    "multi_mp_sgd_update": (3, False, True, False),
+    "multi_mp_sgd_mom_update": (4, True, True, False),
+    "preloaded_multi_sgd_update": (2, False, False, True),
+    "preloaded_multi_sgd_mom_update": (3, True, False, True),
+    "preloaded_multi_mp_sgd_update": (3, False, True, True),
+    "preloaded_multi_mp_sgd_mom_update": (4, True, True, True),
+}
+
+
+def _make_multi_update(opname, stride, has_mom, mp, preloaded):
+    def f(*arrays, out=None, **kwargs):
+        res = invoke(opname, arrays, kwargs)
+        body = arrays[:-2] if preloaded else arrays
+        num = len(body) // stride
+        ws, states = res[:num], res[num:]
+        si = 0
+        for i in range(num):
+            body[stride * i]._data = ws[i]._data
+            if has_mom:
+                body[stride * i + 2]._data = states[si]._data
+                si += 1
+            if mp:
+                body[stride * i + stride - 1]._data = states[si]._data
+                si += 1
+        if out is not None:
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            for o, w in zip(outs, ws):
+                o._data = w._data
+        return res
+
+    f.__name__ = opname
+    return f
+
+
+for _name, _layout in _MULTI_UPDATE_LAYOUT.items():
+    setattr(_mod, _name, _make_multi_update(_name, *_layout))
+
+
+def reset_arrays(*arrays, num_arrays=None):
+    """Zero every input array IN PLACE — upstream's grad-clearing fast path
+    (ref: src/operator/contrib/reset_arrays.cc, one kernel launch for a
+    whole grad list). Imperative-only, like the *_update in-place
+    contracts: a symbol has no storage to reset."""
+    if num_arrays is not None and int(num_arrays) != len(arrays):
+        raise ValueError("num_arrays=%s but %d arrays given"
+                         % (num_arrays, len(arrays)))
+    import jax.numpy as _jnp
+
+    for a in arrays:
+        a._data = _jnp.zeros_like(a._data)
 
 
 def _sample_multinomial_dispatch(data, *args, get_prob=False, **kwargs):

@@ -479,3 +479,196 @@ def mp_sgd_update(weight, grad, weight32, *, lr, wd=0.0, rescale_grad=1.0,
         + wd * weight32
     new32 = weight32 - lr * g
     return new32.astype(weight.dtype), new32
+
+
+@register_op("mp_sgd_mom_update", nondiff=True, n_outputs=3)
+def mp_sgd_mom_update(weight, grad, mom, weight32, *, lr, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                      lazy_update=True):
+    """Momentum SGD with fp32 master weight + fp32 momentum (ref:
+    optimizer_op.cc MP_SGDMomUpdate). Returns (new lp weight, mom, w32)."""
+    g = _clip(grad.astype(jnp.float32) * rescale_grad, clip_gradient) \
+        + wd * weight32
+    new_mom = momentum * mom - lr * g
+    new32 = weight32 + new_mom
+    return new32.astype(weight.dtype), new_mom, new32
+
+
+@register_op("nag_mom_update", nondiff=True, n_outputs=2)
+def nag_mom_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    """Nesterov momentum (ref: optimizer_op.cc NAGMomUpdate): the weight
+    steps along grad + momentum*new_mom (the look-ahead term)."""
+    g = _clip(grad * rescale_grad, clip_gradient) + wd * weight
+    new_mom = momentum * mom + g
+    return weight - lr * (g + momentum * new_mom), new_mom
+
+
+@register_op("mp_nag_mom_update", nondiff=True, n_outputs=3)
+def mp_nag_mom_update(weight, grad, mom, weight32, *, lr, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """(ref: optimizer_op.cc MP_NAGMomUpdate)."""
+    g = _clip(grad.astype(jnp.float32) * rescale_grad, clip_gradient) \
+        + wd * weight32
+    new_mom = momentum * mom + g
+    new32 = weight32 - lr * (g + momentum * new_mom)
+    return new32.astype(weight.dtype), new_mom, new32
+
+
+@register_op("ftml_update", nondiff=True, n_outputs=4)
+def ftml_update(weight, grad, d, v, z, *, lr, t, beta1=0.6, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_grad=-1.0):
+    """Follow The Moving Leader (ref: optimizer_op.cc FTMLUpdate).
+    Returns (weight, d, v, z); ``t`` is the 1-based step count."""
+    g = _clip(grad * rescale_grad, clip_grad) + wd * weight
+    new_v = beta2 * v + (1 - beta2) * jnp.square(g)
+    d_t = (1 - beta1 ** t) / lr * (
+        jnp.sqrt(new_v / (1 - beta2 ** t)) + epsilon)
+    sigma = d_t - beta1 * d
+    new_z = beta1 * z + (1 - beta1) * g - sigma * weight
+    return -new_z / d_t, d_t, new_v, new_z
+
+
+@register_op("rmspropalex_update", nondiff=True, n_outputs=4)
+def rmspropalex_update(weight, grad, n, g, delta, *, lr, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0):
+    """Centered RMSProp, Graves 2013 (ref: optimizer_op.cc
+    RMSPropAlexUpdate). Returns (weight, n, g, delta)."""
+    grd = _clip(grad * rescale_grad, clip_gradient) + wd * weight
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(grd)
+    new_g = gamma1 * g + (1 - gamma1) * grd
+    new_delta = gamma2 * delta - lr * grd / jnp.sqrt(
+        new_n - jnp.square(new_g) + epsilon)
+    new_w = weight + new_delta
+    if clip_weights > 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w, new_n, new_g, new_delta
+
+
+def _multi_sgd(arrays, stride, lrs, wds, rescale_grad, clip_gradient,
+               momentum=None, mp=False):
+    """Shared body of the multi_/preloaded_multi_ SGD family (ref:
+    optimizer_op.cc MultiSGDUpdate/PreloadedMultiSGDUpdate et al.):
+    per-weight groups of ``stride`` arrays, host or device lrs/wds.
+    Returns updated weights first, then updated states group-major."""
+    num = len(arrays) // stride
+    ws, states = [], []
+    for i in range(num):
+        grp = arrays[stride * i:stride * i + stride]
+        w, grad = grp[0], grp[1]
+        w32 = grp[-1] if mp else w
+        g = _clip(grad.astype(w32.dtype) * rescale_grad, clip_gradient) \
+            + wds[i] * w32
+        if momentum is None:
+            new32 = w32 - lrs[i] * g
+            ws.append(new32.astype(w.dtype))
+            if mp:
+                states.append(new32)
+        else:
+            mom = grp[2]
+            new_mom = momentum * mom - lrs[i] * g
+            new32 = w32 + new_mom
+            ws.append(new32.astype(w.dtype))
+            states.append(new_mom)
+            if mp:
+                states.append(new32)
+    return ws + states
+
+
+@register_op("multi_sgd_update", nondiff=True)
+def multi_sgd_update(*arrays, lrs, wds, num_weights=None, rescale_grad=1.0,
+                     clip_gradient=-1.0):
+    """[w0,g0, w1,g1, ...] with HOST lr/wd lists (ref: optimizer_op.cc
+    MultiSGDUpdate). One grouped list output: the updated weights."""
+    return _multi_sgd(arrays, 2, lrs, wds, rescale_grad, clip_gradient)
+
+
+@register_op("multi_sgd_mom_update", nondiff=True)
+def multi_sgd_mom_update(*arrays, lrs, wds, momentum=0.0, num_weights=None,
+                         rescale_grad=1.0, clip_gradient=-1.0):
+    """[w0,g0,m0, ...]; returns updated weights then updated momenta."""
+    return _multi_sgd(arrays, 3, lrs, wds, rescale_grad, clip_gradient,
+                      momentum=momentum)
+
+
+@register_op("multi_mp_sgd_update", nondiff=True)
+def multi_mp_sgd_update(*arrays, lrs, wds, num_weights=None,
+                        rescale_grad=1.0, clip_gradient=-1.0):
+    """[w0,g0,w32_0, ...]; returns updated lp weights then fp32 masters."""
+    return _multi_sgd(arrays, 3, lrs, wds, rescale_grad, clip_gradient,
+                      mp=True)
+
+
+@register_op("multi_mp_sgd_mom_update", nondiff=True)
+def multi_mp_sgd_mom_update(*arrays, lrs, wds, momentum=0.0,
+                            num_weights=None, rescale_grad=1.0,
+                            clip_gradient=-1.0):
+    """[w0,g0,m0,w32_0, ...]; weights, then (mom, w32) pairs group-major."""
+    return _multi_sgd(arrays, 4, lrs, wds, rescale_grad, clip_gradient,
+                      momentum=momentum, mp=True)
+
+
+def _split_preloaded(arrays):
+    return arrays[:-2], arrays[-2], arrays[-1]
+
+
+@register_op("preloaded_multi_sgd_mom_update", nondiff=True)
+def preloaded_multi_sgd_mom_update(*arrays, momentum=0.0, num_weights=None,
+                                   rescale_grad=1.0, clip_gradient=-1.0):
+    """[w0,g0,m0, ..., lrs, wds] with DEVICE lr/wd vectors (ref:
+    optimizer_op.cc PreloadedMultiSGDMomUpdate)."""
+    body, lrs, wds = _split_preloaded(arrays)
+    return _multi_sgd(body, 3, lrs, wds, rescale_grad, clip_gradient,
+                      momentum=momentum)
+
+
+@register_op("preloaded_multi_mp_sgd_update", nondiff=True)
+def preloaded_multi_mp_sgd_update(*arrays, num_weights=None,
+                                  rescale_grad=1.0, clip_gradient=-1.0):
+    """[w0,g0,w32_0, ..., lrs, wds]."""
+    body, lrs, wds = _split_preloaded(arrays)
+    return _multi_sgd(body, 3, lrs, wds, rescale_grad, clip_gradient,
+                      mp=True)
+
+
+@register_op("preloaded_multi_mp_sgd_mom_update", nondiff=True)
+def preloaded_multi_mp_sgd_mom_update(*arrays, momentum=0.0,
+                                      num_weights=None, rescale_grad=1.0,
+                                      clip_gradient=-1.0):
+    """[w0,g0,m0,w32_0, ..., lrs, wds]."""
+    body, lrs, wds = _split_preloaded(arrays)
+    return _multi_sgd(body, 4, lrs, wds, rescale_grad, clip_gradient,
+                      momentum=momentum, mp=True)
+
+
+@register_op("all_finite", nondiff=True)
+def all_finite(data, *, init_output=True):
+    """Scalar 1.0 iff every element is finite (ref: contrib/all_finite.cc;
+    single-array sibling of multi_all_finite)."""
+    return jnp.isfinite(data).all().astype(jnp.float32).reshape(1)
+
+
+@register_op("amp_cast")
+def amp_cast(x, *, dtype):
+    """Differentiable dtype cast inserted by AMP (ref: tensor/amp_cast.h)."""
+    return x.astype(resolve_dtype(dtype))
+
+
+@register_op("amp_multicast")
+def amp_multicast(*arrays, num_outputs=None, cast_narrow=False):
+    """Cast every FLOAT input to the widest (or, with cast_narrow, the
+    narrowest) floating dtype among them; non-float inputs pass through
+    untouched — AMP never casts integers (ref: tensor/amp_cast.h
+    AMPMultiCast)."""
+    fdts = [a.dtype for a in arrays if jnp.issubdtype(a.dtype, jnp.floating)]
+    if not fdts:
+        return list(arrays)
+    pick_fn = min if cast_narrow else max
+    target = pick_fn(fdts, key=lambda d: jnp.finfo(d).bits)
+    return [a.astype(target) if jnp.issubdtype(a.dtype, jnp.floating) else a
+            for a in arrays]
+
+
+# deprecated pre-1.0 alias still exposed by upstream's registry
+register_op("Softmax")(F.SoftmaxOutput)

@@ -462,7 +462,20 @@ def test_upstream_nd_surface_probe():
     slice_axis slice_like smooth_l1 softmax softmax_cross_entropy softmin
     softsign sort space_to_depth split sqrt square squeeze stack
     stop_gradient sum swapaxes take tan tanh tile topk transpose trunc
-    unravel_index where zeros zeros_like khatri_rao im2col col2im""".split()
+    unravel_index where zeros zeros_like khatri_rao im2col col2im
+    reset_arrays trace cumprod Softmax all_finite amp_cast amp_multicast
+    ftml_update nag_mom_update mp_nag_mom_update mp_sgd_mom_update
+    rmspropalex_update multi_sgd_update multi_sgd_mom_update
+    multi_mp_sgd_update multi_mp_sgd_mom_update
+    preloaded_multi_sgd_mom_update preloaded_multi_mp_sgd_update
+    preloaded_multi_mp_sgd_mom_update add_n argmax_channel batch_take
+    choose_element_0index fill_element_0index arange_like
+    LinearRegressionOutput LogisticRegressionOutput MAERegressionOutput
+    MakeLoss SVMOutput SequenceLast SequenceMask SequenceReverse
+    SliceChannel SoftmaxActivation SoftmaxOutput SpatialTransformer
+    SwapAxis UpSampling BilinearSampler GridGenerator Correlation
+    InstanceNorm LayerNorm GroupNorm LRN L2Normalization
+    IdentityAttachKLSparseReg log_sigmoid mish""".split()
     missing = [n for n in names if not hasattr(nd, n)]
     assert not missing, missing
 

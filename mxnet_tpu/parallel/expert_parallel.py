@@ -22,7 +22,7 @@ from jax.sharding import PartitionSpec as P
 from .mesh import get_shard_map
 
 
-def _moe_local(x, router_w, w1, w2, *, axis_name, capacity):
+def _moe_local(x, router_w, w1, w2, *, axis_name, capacity, mean_axes):
     """Per-device: x (t, C) local tokens; router_w (C, E);
     w1 (e_local, C, H); w2 (e_local, H, C)."""
     n = lax.psum(1, axis_name)
@@ -62,7 +62,10 @@ def _moe_local(x, router_w, w1, w2, *, axis_name, capacity):
 
     # combine with gates
     out = jnp.einsum("tec,ecd->td", disp, y) * gate[:, None]
-    aux = _load_balance_loss(probs, onehot, E)
+    # the Switch aux loss is defined over the GLOBAL batch: average across
+    # every shard (ep, and dp when composed) so the P() out-spec's
+    # one-device copy is the true global value
+    aux = lax.pmean(_load_balance_loss(probs, onehot, E), mean_axes)
     return out.astype(x.dtype), aux
 
 
@@ -73,20 +76,31 @@ def _load_balance_loss(probs, onehot, E):
     return E * jnp.sum(f * p)
 
 
-def moe_ffn(x, router_w, w1, w2, mesh, axis_name="ep", capacity_factor=2.0):
+def moe_ffn(x, router_w, w1, w2, mesh, axis_name="ep", capacity_factor=2.0,
+            batch_axis=None):
     """x: (T, C) tokens sharded over `axis_name`; router_w (C, E) replicated;
     w1 (E, C, H), w2 (E, H, C) sharded over `axis_name` on dim 0.
-    Returns (y (T, C) sharded like x, aux_loss scalar)."""
+    Returns (y (T, C) sharded like x, aux_loss scalar).
+
+    With ``batch_axis`` (ep × dp composition) tokens shard over BOTH axes —
+    each dp replica routes its batch shard through its own ep all-to-all
+    against the dp-replicated experts, the standard MoE data-parallel
+    layout; the aux loss is pmean'd to the global value either way."""
     n = mesh.shape[axis_name]
     E = router_w.shape[1]
     assert E % n == 0, "num experts must divide ep axis"
-    t_local = x.shape[0] // n
+    shards = n * (mesh.shape[batch_axis] if batch_axis else 1)
+    t_local = x.shape[0] // shards
     capacity = max(1, int(capacity_factor * t_local / E))
+    token_spec = (P((batch_axis, axis_name), None) if batch_axis
+                  else P(axis_name, None))
+    mean_axes = (batch_axis, axis_name) if batch_axis else (axis_name,)
     sm = get_shard_map()
-    f = sm(functools.partial(_moe_local, axis_name=axis_name, capacity=capacity),
+    f = sm(functools.partial(_moe_local, axis_name=axis_name,
+                             capacity=capacity, mean_axes=mean_axes),
            mesh=mesh,
-           in_specs=(P(axis_name, None), P(), P(axis_name, None, None),
+           in_specs=(token_spec, P(), P(axis_name, None, None),
                      P(axis_name, None, None)),
-           out_specs=(P(axis_name, None), P()))
+           out_specs=(token_spec, P()))
     y, aux = f(x, router_w, w1, w2)
     return y, jnp.mean(aux)

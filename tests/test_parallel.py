@@ -172,6 +172,35 @@ def test_moe_expert_parallel_matches_reference():
     assert float(aux) > 0
 
 
+def test_moe_expert_parallel_composed_with_dp():
+    """ep × dp (VERDICT r4 next #6): tokens sharded over BOTH axes, each dp
+    replica routing through its own ep all-to-all against dp-replicated
+    experts — must match the unsharded per-token reference exactly (routing
+    is per-token, capacity ample)."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from mxnet_tpu.parallel.expert_parallel import moe_ffn
+
+    mesh = parallel.make_mesh({"dp": 2, "ep": 4})
+    T, C, H, E = 64, 16, 32, 8
+    ks = jax.random.split(jax.random.PRNGKey(5), 4)
+    x = jax.random.normal(ks[0], (T, C))
+    rw = jax.random.normal(ks[1], (C, E)) * 0.5
+    w1 = jax.random.normal(ks[2], (E, C, H)) * 0.3
+    w2 = jax.random.normal(ks[3], (E, H, C)) * 0.3
+    xs = jax.device_put(x, NamedSharding(mesh, P(("dp", "ep"), None)))
+    y, aux = moe_ffn(xs, rw, w1, w2, mesh, capacity_factor=float(E),
+                     batch_axis="dp")
+    p = jax.nn.softmax(x @ rw, -1)
+    e = jnp.argmax(p, -1)
+    g = jnp.max(p, -1)
+    ref = jnp.stack([g[t] * (jax.nn.relu(x[t] @ w1[e[t]]) @ w2[e[t]])
+                     for t in range(T)])
+    assert float(jnp.abs(np.asarray(y) - ref).max()) < 1e-4
+    assert float(aux) > 0
+
+
 def test_kvstore_local_push_pull():
     kv = mx.kvstore.create("local")
     kv.init(3, nd.ones((2, 2)))

@@ -42,15 +42,24 @@ def _to_np(v):
 
 
 def load_torch_state(path):
-    """``torch.load`` a checkpoint and unwrap the common nesting conventions
-    ({"state_dict": ...}, {"model": ...}) down to a flat name->tensor dict."""
+    """``torch.load`` a checkpoint and unwrap the common conventions down to
+    a flat name->fp32-tensor dict: {"state_dict": ...}/{"model": ...}
+    nesting, ``module.`` DataParallel prefixes, and fp16/bf16 checkpoints
+    (converters and BatchNorm stats expect fp32 math)."""
     import torch
     state = torch.load(path, map_location="cpu", weights_only=True)
     for key in ("state_dict", "model"):
         if isinstance(state, dict) and key in state \
                 and isinstance(state[key], dict):
             state = state[key]
-    return state
+    if not isinstance(state, dict):  # bare tensor/list checkpoints: as-is
+        return state
+    if state and all(isinstance(k, str) and k.startswith("module.")
+                     for k in state):
+        state = {k[len("module."):]: v for k, v in state.items()}
+    return {k: (v.float() if isinstance(v, torch.Tensor)
+                and v.is_floating_point() else v)
+            for k, v in state.items()}
 
 
 def convert_torchvision_resnet(state):
@@ -453,9 +462,13 @@ def _main(argv):
         raise SystemExit("usage: python -m mxnet_tpu.gluon.model_zoo.convert "
                          "<model_name> <torch_ckpt> <out.params>")
     name, ckpt, out = argv
+    from . import model_store
     from .vision import get_model
     net = get_model(name, pretrained=ckpt)
     net.save_parameters(out)
+    # sidecar marker: makes the output eligible for model_store.purge
+    # without exposing hand-placed .params files to deletion
+    model_store.mark_managed(out)
     print("converted %s -> %s (%s)" % (ckpt, out, name))
 
 

@@ -11,7 +11,16 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["get_model_file", "purge"]
+__all__ = ["get_model_file", "mark_managed", "purge"]
+
+_MARKER_SUFFIX = ".mxnet-store"
+
+
+def mark_managed(path):
+    """Record that ``path`` was produced by the store/converter workflow (a
+    zero-byte sidecar), making it eligible for :func:`purge`. The converter
+    CLI calls this for its outputs."""
+    open(path + _MARKER_SUFFIX, "w").close()
 
 _HELP = (
     "the model store is unreachable (zero-egress); convert a checkpoint you "
@@ -32,10 +41,32 @@ def get_model_file(name, root=os.path.join("~", ".mxnet", "models")):
 
 
 def purge(root=os.path.join("~", ".mxnet", "models")):
-    """Remove converted .params files from ``root`` (ref: model_store.purge)."""
+    """Remove store-managed .params files from ``root`` (ref:
+    model_store.purge). Upstream purges only its own downloaded cache
+    entries; the equivalent here is files carrying the converter's sidecar
+    marker — a ``.params`` the user placed in ``root`` by hand is NOT the
+    store's to delete."""
     root = os.path.expanduser(root)
     if not os.path.isdir(root):
         return
-    for f in os.listdir(root):
+    skipped = []
+    for f in sorted(os.listdir(root)):
         if f.endswith(".params"):
+            if os.path.exists(os.path.join(root, f + _MARKER_SUFFIX)):
+                os.remove(os.path.join(root, f))
+                os.remove(os.path.join(root, f + _MARKER_SUFFIX))
+            else:
+                skipped.append(f)
+    # fresh listing: markers whose .params is gone (deleted by hand, or just
+    # now) are stale — clean them up
+    for f in os.listdir(root):
+        if f.endswith(_MARKER_SUFFIX) and not os.path.exists(
+                os.path.join(root, f[:-len(_MARKER_SUFFIX)])):
             os.remove(os.path.join(root, f))
+    if skipped:
+        import warnings
+
+        warnings.warn(
+            "model_store.purge left %d unmanaged .params in place (%s...): "
+            "the store only deletes files it wrote; remove by hand or "
+            "mark_managed() first" % (len(skipped), skipped[0]))

@@ -363,22 +363,22 @@ _alias("ce")(CrossEntropy)
 
 
 def check_label_shapes(labels, preds, wrap=False, shape=False):
-    """(ref: python/mxnet/metric.py:check_label_shapes) raise when the
-    label/pred list lengths (or full shapes, with ``shape=True``) disagree;
-    with ``wrap``, single arrays are returned wrapped in lists."""
-    if isinstance(labels, (NDArray, numpy.ndarray)):
-        labels = [labels]
-    if isinstance(preds, (NDArray, numpy.ndarray)):
-        preds = [preds]
-    ln, pn = len(labels), len(preds)
-    if ln != pn:
-        raise ValueError("Shape of labels %d does not match shape of "
-                         "predictions %d" % (ln, pn))
-    if shape:
-        for l, p in zip(labels, preds):
-            if tuple(l.shape) != tuple(p.shape):
-                raise ValueError("Shape of labels %s does not match shape "
-                                 "of predictions %s"
-                                 % (tuple(l.shape), tuple(p.shape)))
+    """(ref: python/mxnet/metric.py:check_label_shapes). Upstream compares
+    ``len()`` BEFORE any wrapping — for a single array that is its batch
+    dim, so a batch-size mismatch between two bare arrays raises here, not
+    just list-length mismatches. ``shape=True`` compares full ``.shape``
+    attributes directly; always returns ``(labels, preds)``, wrapped in
+    lists only when ``wrap=True``."""
+    if not shape:
+        label_shape, pred_shape = len(labels), len(preds)
+    else:
+        label_shape, pred_shape = tuple(labels.shape), tuple(preds.shape)
+    if label_shape != pred_shape:
+        raise ValueError("Shape of labels %s does not match shape of "
+                         "predictions %s" % (label_shape, pred_shape))
     if wrap:
-        return labels, preds
+        if isinstance(labels, (NDArray, numpy.ndarray)):
+            labels = [labels]
+        if isinstance(preds, (NDArray, numpy.ndarray)):
+            preds = [preds]
+    return labels, preds

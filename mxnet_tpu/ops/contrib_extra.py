@@ -60,12 +60,30 @@ def div_sqrt_dim(data):
     return data / jnp.sqrt(jnp.asarray(data.shape[-1], data.dtype))
 
 
+@jax.custom_vjp
+def _grad_multiply(data, scalar):
+    return data
+
+
+def _gm_fwd(data, scalar):
+    return data, scalar
+
+
+def _gm_bwd(scalar, g):
+    return g * scalar.astype(g.dtype), None
+
+
+_grad_multiply.defvjp(_gm_fwd, _gm_bwd)
+
+
 @register_op("gradientmultiplier")
 def gradientmultiplier(data, *, scalar=1.0):
-    """(ref: contrib/gradient_multiplier_op.cc) identity forward, gradient
-    scaled by `scalar` (the GRL trick at scalar < 0)."""
-    s = jnp.asarray(scalar, data.dtype)
-    return data * s + lax.stop_gradient(data - data * s)
+    """(ref: contrib/gradient_multiplier_op.cc) BIT-EXACT identity forward,
+    gradient scaled by `scalar` (the GRL trick at scalar < 0). custom_vjp,
+    not the ``x*s + stop_gradient(x - x*s)`` algebra: upstream applies the
+    scale only in backward, and the algebraic form drifts by a rounding ulp
+    (a + (b - a) != b in floating point, ADVICE r4)."""
+    return _grad_multiply(data, jnp.asarray(scalar, data.dtype))
 
 
 @register_op("quantize_v2", nondiff=True, n_outputs=3)

@@ -251,6 +251,17 @@ def test_contrib_long_tail_utility_ops():
     np.testing.assert_allclose(y.asnumpy(), [3.0])      # identity forward
     np.testing.assert_allclose(a.grad.asnumpy(), [-0.5])  # scaled backward
 
+    # BIT-exact identity (ADVICE r4): the x*s + stop_grad(x - x*s) algebra
+    # drifts an ulp at awkward value/scale pairs; custom_vjp must not
+    v = np.float32(0.1)
+    b = nd.array(np.array([v], np.float32))
+    b.attach_grad()
+    with autograd.record():
+        z = c.gradientmultiplier(b, scalar=0.3)
+    z.backward()
+    assert z.asnumpy()[0] == v
+    np.testing.assert_allclose(b.grad.asnumpy(), [0.3], rtol=1e-6)
+
 
 def test_contrib_boolean_mask_and_quantize_v2():
     from mxnet_tpu import nd

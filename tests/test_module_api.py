@@ -424,6 +424,23 @@ def test_module_checkpoint_aux_split(tmp_path):
     np.testing.assert_allclose(mod2.forward(batch, is_train=False)[0].asnumpy(),
                                ref, rtol=1e-6)
 
+def test_set_params_before_bind_warns():
+    """Pre-bind there are no known names to validate against, so set_params
+    must warn loudly that typo'd names cannot be caught (ADVICE r4) while
+    keeping the documented apply-at-bind flow."""
+    import pytest
+
+    from mxnet_tpu import nd, sym
+    from mxnet_tpu.module import Module
+
+    data = sym.var("data")
+    out = sym.FullyConnected(data, name="fc", num_hidden=2)
+    mod = Module(out, label_names=[])
+    with pytest.warns(UserWarning, match="before bind"):
+        mod.set_params({"fc_weight": nd.zeros((2, 3))})
+    assert "fc_weight" in mod._arg_params
+
+
 def test_set_params_after_bind_takes_effect():
     """set_params on a BOUND module must write through to the executor
     (ADVICE r3): forward reads the bound arg NDArrays, so post-bind

@@ -365,6 +365,14 @@ def test_metric_nll_and_check_label_shapes():
     with pytest.raises(ValueError, match="does not match"):
         mx.metric.check_label_shapes(nd.zeros((2,)), nd.zeros((3,)),
                                      shape=True)
+    # upstream semantics (ADVICE r4): bare-array batch mismatch raises via
+    # len() even without shape=True, and the pair is ALWAYS returned —
+    # unwrapped when wrap=False
+    with pytest.raises(ValueError, match="does not match"):
+        mx.metric.check_label_shapes(nd.zeros((2,)), nd.zeros((3, 4)))
+    l0, p0 = nd.zeros((2,)), nd.zeros((2, 3))
+    ls, ps = mx.metric.check_label_shapes(l0, p0)
+    assert ls is l0 and ps is p0
 
 
 def test_initializer_load():

@@ -16,7 +16,22 @@ import jax.numpy as jnp
 
 from ..base import is_tpu_backend, register_op
 
-_FLASH_MIN_LEN = 256  # below this, XLA's fused unblocked attention wins
+_FLASH_MIN_LEN = 256  # static GUESS, used only until a hardware sweep lands
+
+
+def _flash_min_len():
+    """Measured flash-vs-dense crossover from the sweep artifact when one
+    exists (flash_blocks.json "min_len", written by flash_sweep --apply),
+    else the static guess. The headline bert runs at seq 128 — whether it
+    takes the flash kernel is hardware's call, not a constant's."""
+    try:
+        from .pallas import flash_attention as _fa
+
+        if _fa.MIN_LEN is not None:
+            return _fa.MIN_LEN
+    except Exception:  # pragma: no cover - pallas import unavailable
+        pass
+    return _FLASH_MIN_LEN
 
 import threading
 
@@ -193,7 +208,7 @@ def scaled_dot_attention(q, k, v, mask=None, *, causal=False, scale=None,
                  scale=scale)
         return jax.device_put(out, orig if orig is not None
                               else mesh.devices.flat[0])
-    if (is_tpu_backend() and q.shape[2] >= _FLASH_MIN_LEN
+    if (is_tpu_backend() and q.shape[2] >= _flash_min_len()
             and (mask is None or prefix_mask)):
         try:
             from .pallas.flash_attention import flash_attention

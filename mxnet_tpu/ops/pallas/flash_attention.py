@@ -361,6 +361,11 @@ BLOCK_DEFAULTS = {
     0: (256, 512),
 }
 
+# Measured flash-vs-dense crossover seq from the sweep artifact ("min_len"),
+# or None until a hardware sweep lands — attention.py's gate falls back to
+# its static _FLASH_MIN_LEN guess while this is None.
+MIN_LEN = None
+
 _BLOCKS_ARTIFACT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "flash_blocks.json")
 
@@ -369,20 +374,42 @@ def _load_block_artifact(path=None):
     """Replace BLOCK_DEFAULTS with the committed hardware-sweep winners.
 
     The artifact maps seq-bucket lower bounds to [block_q, block_k] and
-    carries provenance ("swept_at", "source"). Malformed or absent files
-    leave the fallback table untouched — tuning must never break dispatch."""
-    global BLOCK_DEFAULTS
+    carries provenance ("swept_at", "source"). An ABSENT default artifact
+    leaves the fallback table untouched silently (tuning must never break
+    import); a PRESENT-but-malformed file warns — a corrupted
+    ``flash_sweep --apply`` output silently reverting every bench to the
+    untuned table is exactly the failure that must not be quiet (ADVICE
+    r4). An explicit ``path`` argument raises on any failure: the caller
+    asked for that file specifically."""
+    global BLOCK_DEFAULTS, MIN_LEN
+    explicit = path is not None
     path = path or _BLOCKS_ARTIFACT
+    if not os.path.exists(path):
+        if explicit:
+            raise FileNotFoundError("flash block artifact %r not found" % path)
+        return False
     try:
         with open(path) as f:
             raw = json.load(f)
         table = {int(k): (int(v[0]), int(v[1]))
                  for k, v in raw["blocks"].items()}
-    except Exception:  # malformed in ANY way — tuning must not break import
+        if not table:
+            raise ValueError("empty 'blocks' table")
+    except Exception as e:
+        if explicit:
+            raise ValueError(
+                "flash block artifact %r is malformed: %s" % (path, e)) from e
+        import warnings
+
+        warnings.warn(
+            "ignoring malformed flash block artifact %s (%s); "
+            "falling back to the untuned table" % (path, e))
         return False
-    if table:
-        BLOCK_DEFAULTS = table
-    return bool(table)
+    BLOCK_DEFAULTS = table
+    # reset too: a reloaded artifact without min_len must not leave a stale
+    # crossover from a superseded sweep paired with the new block table
+    MIN_LEN = raw["min_len"] if isinstance(raw.get("min_len"), int) else None
+    return True
 
 
 _load_block_artifact()

@@ -10,6 +10,8 @@ import shutil
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -67,6 +69,9 @@ def test_flash_block_artifact_roundtrip(tmp_path):
     fs = importlib.import_module("flash_sweep")
 
     rows = [
+        {"seq": 128, "kernel": "dense", "fwd_bwd_ms": 1.0},
+        {"seq": 128, "kernel": "flash", "block_q": 128, "block_k": 128,
+         "fwd_bwd_ms": 1.5},  # flash LOSES at 128
         {"seq": 512, "kernel": "dense", "fwd_bwd_ms": 9.0},
         {"seq": 512, "kernel": "flash", "block_q": 256, "block_k": 512,
          "fwd_bwd_ms": 5.0},
@@ -76,22 +81,47 @@ def test_flash_block_artifact_roundtrip(tmp_path):
          "fwd_bwd_ms": 40.0},
     ]
     saved_path, saved_table = fa._BLOCKS_ARTIFACT, dict(fa.BLOCK_DEFAULTS)
+    saved_min = fa.MIN_LEN
     try:
         fa._BLOCKS_ARTIFACT = str(tmp_path / "flash_blocks.json")
         assert fs.apply_winners(rows, source="unit") == 0
         assert fa._load_block_artifact()
         assert fa.BLOCK_DEFAULTS[512] == (512, 256)
         assert fa.BLOCK_DEFAULTS[2048] == (128, 512)
-        assert fa.BLOCK_DEFAULTS[0] == (512, 256)  # smallest seq = catch-all
+        assert fa.BLOCK_DEFAULTS[0] == (128, 128)  # smallest seq = catch-all
         assert fa._default_blocks(768) == (512, 256)
         assert fa._default_blocks(4096) == (128, 512)
-        # malformed artifact leaves the installed table untouched
+        # measured crossover: flash lost at 128, won at 512 → the gate's
+        # min length becomes 512, overriding attention's static guess
+        assert fa.MIN_LEN == 512
+        from mxnet_tpu.ops import attention as A
+        assert A._flash_min_len() == 512
+        # flash winning at no consistent suffix (loses at the largest
+        # compared seq) → min_len NOT written; reload resets the stale one
+        bad = [{"seq": 512, "kernel": "dense", "fwd_bwd_ms": 1.0},
+               {"seq": 512, "kernel": "flash", "block_q": 256,
+                "block_k": 512, "fwd_bwd_ms": 2.0}]
+        assert fs.apply_winners(bad, source="unit") == 0
+        assert fa._load_block_artifact()
+        assert fa.MIN_LEN is None
+        assert A._flash_min_len() == A._FLASH_MIN_LEN
+        # malformed artifact leaves the installed table untouched — but
+        # LOUDLY (ADVICE r4): a corrupted --apply output must not silently
+        # revert benches to the untuned table
         (tmp_path / "flash_blocks.json").write_text("{broken")
-        assert not fa._load_block_artifact()
-        assert fa.BLOCK_DEFAULTS[512] == (512, 256)
+        with pytest.warns(UserWarning, match="malformed"):
+            assert not fa._load_block_artifact()
+        assert fa.BLOCK_DEFAULTS[512] == (256, 512)  # last good table kept
+        # an EXPLICIT path raises instead of warning: the caller asked for
+        # that specific file
+        with pytest.raises(ValueError, match="malformed"):
+            fa._load_block_artifact(str(tmp_path / "flash_blocks.json"))
+        with pytest.raises(FileNotFoundError):
+            fa._load_block_artifact(str(tmp_path / "nope.json"))
     finally:
         fa._BLOCKS_ARTIFACT = saved_path
         fa.BLOCK_DEFAULTS = saved_table
+        fa.MIN_LEN = saved_min
 
 
 def test_apply_winners_no_flash_rows_is_noop(tmp_path):

@@ -67,7 +67,7 @@ b = json.load(open('mxnet_tpu/ops/pallas/flash_blocks.json'))
 sys.exit(0 if (b.get('swept_at') or '') >= '$LOOP_START' else 1)" 2>/dev/null; then
       echo "[loop] $(date -u +%T) block table already swept this run; skipping"
     else
-      timeout -k 30 3600 python tools/flash_sweep.py --seq 512 1024 2048 \
+      timeout -k 30 3600 python tools/flash_sweep.py --seq 128 256 512 1024 2048 \
         --json tools/flash_sweep_r5.json --apply \
         || echo "[loop] flash sweep failed (rerun manually)"
     fi

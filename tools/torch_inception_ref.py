@@ -127,6 +127,25 @@ class InceptionE(nn.Module):
         return torch.cat([b1, b3, bd, bp], 1)
 
 
+class InceptionAux(nn.Module):
+    """Training-time aux head — present in every torchvision inception_v3
+    checkpoint (aux_logits=True is the pretrained configuration), so the
+    oracle must carry its keys for the converter's drop path to be
+    exercised against a realistic key set."""
+
+    def __init__(self, in_c, num_classes):
+        super().__init__()
+        self.conv0 = BasicConv2d(in_c, 128, kernel_size=1)
+        self.conv1 = BasicConv2d(128, 768, kernel_size=5)
+        self.fc = nn.Linear(768, num_classes)
+
+    def forward(self, x):
+        x = F.avg_pool2d(x, kernel_size=5, stride=3)
+        x = self.conv1(self.conv0(x))
+        x = F.adaptive_avg_pool2d(x, (1, 1)).flatten(1)
+        return self.fc(x)
+
+
 class Inception3(nn.Module):
     def __init__(self, num_classes=1000):
         super().__init__()
@@ -143,6 +162,7 @@ class Inception3(nn.Module):
         self.Mixed_6c = InceptionC(768, 160)
         self.Mixed_6d = InceptionC(768, 160)
         self.Mixed_6e = InceptionC(768, 192)
+        self.AuxLogits = InceptionAux(768, num_classes)
         self.Mixed_7a = InceptionD(768)
         self.Mixed_7b = InceptionE(1280)
         self.Mixed_7c = InceptionE(2048)

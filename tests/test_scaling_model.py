@@ -58,11 +58,20 @@ def test_hlo_collective_parser():
 
 
 def test_committed_artifact_consistent():
-    path = os.path.join(REPO, "tools", "scaling_model_r4.json")
+    path = os.path.join(REPO, "tools", "scaling_model_r5.json")
     assert os.path.exists(path), "run tools/scaling_model.py to regenerate"
     with open(path) as f:
         art = json.load(f)
-    assert art["baseline_row"]["model_prediction_overlap0.9"] >= 0.90
+    base = art["baseline_row"]
+    assert base["model_prediction_overlap0.9"] >= 0.90
+    # r5 hardening (VERDICT r4 #7): the model must state its worst case and
+    # where it CAN fail, not only validate
+    assert "met_under_worst_case" in base
+    assert "structural_note" in base
+    dcn = base["dcn_sensitivity_8_to_1024_worst_case"]
+    assert any(not v["meets_0.90"] for v in dcn.values()), \
+        "model has no failure point — it cannot validate the target"
+    assert any(v["meets_0.90"] for v in dcn.values())
     inv = art["composed_step_collectives"]["inventory"]
     # the composed dp x tp x pp program must actually communicate on all
     # three axes: tp/dp psums -> all-reduce, pp ring -> collective-permute
@@ -70,3 +79,43 @@ def test_committed_artifact_consistent():
     assert "collective-permute" in inv \
         and inv["collective-permute"]["count"] > 0
     assert all(g == 2 for g in inv["all-reduce"]["group_sizes"])  # axis size 2
+
+
+def test_tp_pp_dcn_terms():
+    """The r5 terms behave physically: tp collectives grow with tp and sit
+    on the critical path; the pp bubble is (S-1)/M; DCN kicks in past one
+    pod and slows the cross-pod all-reduce."""
+    import scaling_model as sm
+
+    assert sm.tp_collective_time(1) == 0.0
+    assert sm.tp_collective_time(8) > sm.tp_collective_time(2) > 0
+    assert sm.pp_bubble_overhead(1, 32) == 0.0
+    assert abs(sm.pp_bubble_overhead(4, 32) - 3 / 32) < 1e-12
+    assert sm.dcn_allreduce_time(4.4e8, 256) == 0.0
+    assert sm.dcn_allreduce_time(4.4e8, 1024) > 0
+    # strategy table: tp/pp terms surface in step time
+    t_c = 0.04
+    dp = sm.strategy_step_time(256, 0.0, t_c)
+    tp8 = sm.strategy_step_time(256, 0.0, t_c, tp=8)
+    pp4 = sm.strategy_step_time(256, 0.0, t_c, pp=4)
+    assert tp8["t_tp_collectives_ms"] > 0 and dp["t_tp_collectives_ms"] == 0
+    assert pp4["t_pp_bubble_ms"] > 0
+    # sharded grads: smaller exposed dp all-reduce under tp/pp
+    assert tp8["t_dp_allreduce_ms"] < dp["t_dp_allreduce_ms"]
+
+
+def test_required_overlap_is_honest():
+    """required_overlap_for scans the same formulas as the curve: at an mfu
+    where the worst case already meets 0.90 it returns 0.0; an absurdly
+    slow DCN pushes the requirement toward full overlap (it always lands
+    in [0,1] — at overlap 1.0 nothing is exposed)."""
+    import scaling_model as sm
+
+    assert sm.required_overlap_for(0.90, [8, 256], 0.4) == 0.0
+    saved = sm.DCN_GBYTES_PER_HOST
+    try:
+        sm.DCN_GBYTES_PER_HOST = 0.01
+        need = sm.required_overlap_for(0.90, [8, 1024], 0.4)
+        assert need is not None and need > 0.9  # always lands in [0,1]
+    finally:
+        sm.DCN_GBYTES_PER_HOST = saved

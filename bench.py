@@ -634,6 +634,22 @@ def run_mode(mode, results, smoke=False, iters=None, headline=False,
         out["extras"] = _extras(results, mode)
     print(json.dumps(out), flush=True)
 
+    prof_dir = os.environ.get("BENCH_PROFILE_DIR")
+    if prof_dir:
+        # AFTER the result is persisted AND printed: a relay wedge during
+        # this best-effort capture is a hang the except cannot see — the
+        # watchdog os._exit must never cost the measurement it follows
+        try:
+            os.makedirs(prof_dir, exist_ok=True)
+            with jax.profiler.trace(os.path.join(prof_dir, mode)):
+                for i in range(3):
+                    params, states, loss = step(
+                        params, states, jnp.int32(1000 + i), key, batch)
+                float(loss)
+            _log("profile trace written under %s/%s" % (prof_dir, mode))
+        except Exception as e:
+            _log("profile capture failed (non-fatal): %r" % e)
+
 
 def main():
     args = [a for a in sys.argv[1:] if not a.startswith("--")]

@@ -672,3 +672,75 @@ def amp_multicast(*arrays, num_outputs=None, cast_narrow=False):
 
 # deprecated pre-1.0 alias still exposed by upstream's registry
 register_op("Softmax")(F.SoftmaxOutput)
+
+
+# ------------------------------------------------ r5 long-tail closures
+def _syevd(a):
+    """Upstream syevd returns (U, lambda) with ROWS of U the eigenvectors
+    (ref: la_op.cc syevd: A = U^T diag(L) U); jnp.linalg.eigh returns
+    (w, v) with columns of v the eigenvectors, so U = v^T."""
+    w, v = jnp.linalg.eigh(a)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+_reg_linalg("linalg_syevd", _syevd, n_outputs=2)
+
+
+@register_op("onehot_encode", nondiff=True)
+def onehot_encode(indices, out_like):
+    """Legacy one-hot into a preallocated-shaped output (ref:
+    ndarray_function.cc onehot_encode: (N,) indices, (N, C) out)."""
+    C = out_like.shape[-1]
+    cols = jax.lax.broadcasted_iota(jnp.int32, (indices.shape[0], C), 1)
+    return (cols == indices.astype(jnp.int32)[:, None]).astype(out_like.dtype)
+
+
+@register_op("softmax_with_length")
+def softmax_with_length(data, length, *, axis=-1, temperature=None):
+    """Softmax over ``axis`` with per-sequence valid lengths: positions at
+    or past ``length`` get zero probability (ref: nn/softmax-inl.h
+    SoftmaxWithLength). data (B, ..., T) with lengths broadcast along the
+    leading dim."""
+    if temperature is not None and temperature != 1.0:
+        data = data / temperature
+    ax = axis % data.ndim
+    T = data.shape[ax]
+    iota_shape = [1] * data.ndim
+    iota_shape[ax] = T
+    pos = jax.lax.broadcasted_iota(jnp.int32, tuple(iota_shape), ax)
+    lshape = [data.shape[0]] + [1] * (data.ndim - 1)
+    valid = pos < length.astype(jnp.int32).reshape(lshape)
+    masked = jnp.where(valid, data, -jnp.inf)
+    out = jax.nn.softmax(masked, axis=ax)
+    return jnp.where(valid, out, 0.0)
+
+
+def _alias_op(new, old):
+    """Registry alias preserving EVERY OpDef field (rng, arity, and any
+    future ones) — upstream's NNVM add_alias."""
+    from ..base import OP_REGISTRY
+    OP_REGISTRY[new] = OP_REGISTRY[old]._replace(name=new)
+
+
+# deprecated/legacy flat aliases still exposed by upstream's registry
+_alias_op("uniform", "random_uniform")
+_alias_op("exponential", "random_exponential")
+_alias_op("poisson", "random_poisson")
+_alias_op("max_axis", "max")
+_alias_op("min_axis", "min")
+_alias_op("BatchNorm_v1", "BatchNorm")
+
+
+@register_op("cast_storage")
+def cast_storage(data, *, stype="default"):
+    """Symbolic-surface parity shim (ref: tensor/cast_storage.cc). The
+    imperative nd.cast_storage (sparse.py) converts between real storage
+    classes; inside a traced/symbolic graph every array is dense, so
+    'default' is the identity and sparse targets refuse loudly rather than
+    silently densifying."""
+    if stype != "default":
+        raise ValueError(
+            "cast_storage(stype=%r) inside a traced graph: the symbolic "
+            "executor is dense-only; convert imperatively with "
+            "nd.cast_storage" % (stype,))
+    return data

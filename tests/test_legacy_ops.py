@@ -738,3 +738,32 @@ def test_new_update_kernels_write_states_in_place():
                                momentum=0.9)
     assert not np.allclose(w32.asnumpy(), w32_before)
     assert abs(mm.asnumpy()).max() > 0
+
+
+def test_r5_tail_ops_numeric():
+    """softmax_with_length masks past the valid length; onehot_encode is the
+    legacy one-hot; linalg_syevd reconstructs A = U^T diag(L) U; the flat
+    random aliases (uniform/exponential/poisson) keep the rng contract."""
+    x = nd.array(np.array([[1., 2., 3., 4.], [2., 2., 9., 9.]], np.float32))
+    s = nd.softmax_with_length(x, nd.array(np.array([2, 3], np.float32)))
+    s = s.asnumpy()
+    np.testing.assert_allclose(s[0, :2].sum(), 1.0, rtol=1e-5)
+    assert s[0, 2:].sum() == 0 and s[1, 3] == 0
+    np.testing.assert_allclose(s[1, :3].sum(), 1.0, rtol=1e-5)
+
+    oh = nd.onehot_encode(nd.array(np.array([1, 0], np.float32)),
+                          nd.zeros((2, 3)))
+    assert oh.asnumpy().tolist() == [[0, 1, 0], [1, 0, 0]]
+
+    spd = _spd(4, seed=9)
+    U, lam = nd.linalg_syevd(nd.array(spd))
+    rec = U.asnumpy().T @ np.diag(lam.asnumpy()) @ U.asnumpy()
+    np.testing.assert_allclose(rec, spd, atol=1e-3)
+
+    import mxnet_tpu as mx
+    mx.random.seed(3)
+    u = nd.uniform(low=2.0, high=4.0, shape=(800,)).asnumpy()
+    assert (u >= 2).all() and (u < 4).all()
+    p = nd.poisson(lam=5.0, shape=(2000,)).asnumpy()
+    assert abs(p.mean() - 5.0) < 0.4
+    np.testing.assert_allclose(nd.max_axis(x, axis=1).asnumpy(), [4., 9.])

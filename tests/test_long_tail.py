@@ -475,9 +475,22 @@ def test_upstream_nd_surface_probe():
     SliceChannel SoftmaxActivation SoftmaxOutput SpatialTransformer
     SwapAxis UpSampling BilinearSampler GridGenerator Correlation
     InstanceNorm LayerNorm GroupNorm LRN L2Normalization
-    IdentityAttachKLSparseReg log_sigmoid mish""".split()
+    IdentityAttachKLSparseReg log_sigmoid mish BatchNorm_v1 uniform
+    exponential poisson max_axis min_axis onehot_encode softmax_with_length
+    linalg_syevd ctc_loss CTCLoss Deconvolution ElementWiseSum
+    broadcast_axes broadcast_logical_and broadcast_logical_or
+    broadcast_logical_xor broadcast_lesser broadcast_lesser_equal
+    broadcast_greater_equal""".split()
     missing = [n for n in names if not hasattr(nd, n)]
     assert not missing, missing
+    # the same flat surface exists symbolically (upstream generates both
+    # front-ends from one registry; so does this repo) — imperative-only
+    # contracts (in-place reset_arrays) are the documented exception
+    from mxnet_tpu import sym
+
+    sym_missing = [n for n in names
+                   if n != "reset_arrays" and not hasattr(sym, n)]
+    assert not sym_missing, sym_missing
 
 
 def test_upstream_contrib_surface_probe():

@@ -39,7 +39,8 @@ while true; do
     # headline FIRST: if the relay window is short, the number the driver
     # replays must be the bert one — don't let secondary work spend the
     # window before it lands
-    BENCH_PROBE_BUDGET_S=600 timeout -k 30 3600 python bench.py bert
+    BENCH_PROFILE_DIR=/tmp/profile_r5 \
+      BENCH_PROBE_BUDGET_S=600 timeout -k 30 3600 python bench.py bert
     hrc=$?
     # rc=124/137 is a timeout (wedge — the flag can't help and the retry
     # would burn another hour); anything else may be a Mosaic lowering

@@ -132,6 +132,16 @@ def reset_arrays(*arrays, num_arrays=None):
         a._data = _jnp.zeros_like(a._data)
 
 
+def onehot_encode(indices, out):
+    """Write the one-hot encoding of ``indices`` INTO ``out`` and return it
+    — the upstream in-place ndarray-function contract (ref:
+    ndarray_function.cc onehot_encode). The registry op stays pure for the
+    symbolic surface."""
+    res = invoke("onehot_encode", (indices, out), {})
+    out._data = res._data
+    return out
+
+
 def _sample_multinomial_dispatch(data, *args, get_prob=False, **kwargs):
     # get_prob changes the op's arity — route to the matching registry entry
     if get_prob:

@@ -183,10 +183,7 @@ _reg_random("random_poisson",
 _reg_random("random_negative_binomial",
             lambda key, shp, dt, k=1, p=0.5:
             _rk.k_negative_binomial(key, shp, dt, k, p))
-# flat alias (upstream registers `normal` alongside random_normal)
-_reg_random("normal",
-            lambda key, shp, dt, loc=0.0, scale=1.0:
-            _rk.k_normal(key, shp, dt, loc, scale))
+
 
 
 def _k_gnb(key, shp, dt, mu, alpha):
@@ -697,18 +694,17 @@ def onehot_encode(indices, out_like):
 
 @register_op("softmax_with_length")
 def softmax_with_length(data, length, *, axis=-1, temperature=None):
-    """Softmax over ``axis`` with per-sequence valid lengths: positions at
-    or past ``length`` get zero probability (ref: nn/softmax-inl.h
-    SoftmaxWithLength). data (B, ..., T) with lengths broadcast along the
-    leading dim."""
+    """Softmax over ``axis`` with valid lengths: positions at or past
+    ``length`` get zero probability (ref: nn/softmax-inl.h
+    SoftmaxWithLength). ``length`` is shaped like ``data`` minus the
+    softmax axis (upstream's contract) — e.g. (B,) for (B, T) scores,
+    (B, H) for (B, H, T); a size mismatch fails the reshape loudly."""
     if temperature is not None and temperature != 1.0:
         data = data / temperature
     ax = axis % data.ndim
-    T = data.shape[ax]
-    iota_shape = [1] * data.ndim
-    iota_shape[ax] = T
-    pos = jax.lax.broadcasted_iota(jnp.int32, tuple(iota_shape), ax)
-    lshape = [data.shape[0]] + [1] * (data.ndim - 1)
+    pos = jax.lax.broadcasted_iota(jnp.int32, data.shape, ax)
+    lshape = list(data.shape)
+    lshape[ax] = 1
     valid = pos < length.astype(jnp.int32).reshape(lshape)
     masked = jnp.where(valid, data, -jnp.inf)
     out = jax.nn.softmax(masked, axis=ax)
@@ -723,6 +719,7 @@ def _alias_op(new, old):
 
 
 # deprecated/legacy flat aliases still exposed by upstream's registry
+_alias_op("normal", "random_normal")
 _alias_op("uniform", "random_uniform")
 _alias_op("exponential", "random_exponential")
 _alias_op("poisson", "random_poisson")

@@ -751,9 +751,18 @@ def test_r5_tail_ops_numeric():
     assert s[0, 2:].sum() == 0 and s[1, 3] == 0
     np.testing.assert_allclose(s[1, :3].sum(), 1.0, rtol=1e-5)
 
-    oh = nd.onehot_encode(nd.array(np.array([1, 0], np.float32)),
-                          nd.zeros((2, 3)))
-    assert oh.asnumpy().tolist() == [[0, 1, 0], [1, 0, 0]]
+    out_buf = nd.zeros((2, 3))
+    oh = nd.onehot_encode(nd.array(np.array([1, 0], np.float32)), out_buf)
+    assert oh is out_buf  # upstream in-place ndarray-function contract
+    assert out_buf.asnumpy().tolist() == [[0, 1, 0], [1, 0, 0]]
+
+    # upstream length contract: shaped like data minus the softmax axis
+    x3 = nd.array(np.random.RandomState(0).randn(2, 3, 5).astype(np.float32))
+    l2 = nd.array(np.array([[1, 2, 3], [5, 4, 1]], np.float32))
+    s3 = nd.softmax_with_length(x3, l2).asnumpy()
+    np.testing.assert_allclose(s3.sum(axis=-1), np.ones((2, 3)), rtol=1e-5)
+    assert s3[0, 0, 1:].sum() == 0 and s3[1, 2, 1:].sum() == 0
+    assert s3[1, 0].min() > 0  # full length: nothing masked
 
     spd = _spd(4, seed=9)
     U, lam = nd.linalg_syevd(nd.array(spd))

@@ -1,0 +1,53 @@
+"""tools/profile_analyze.py — trace summarizer for bench profile captures."""
+import gzip
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def _trace():
+    return {"traceEvents": [
+        {"ph": "M", "name": "thread_name", "pid": 1, "tid": 7,
+         "args": {"name": "TPU:0 XLA Ops"}},
+        # nested: parent 0..100, child 10..40 — busy must be 100, not 130
+        {"ph": "X", "name": "fusion.1", "pid": 1, "tid": 7,
+         "ts": 0.0, "dur": 100.0},
+        {"ph": "X", "name": "dot.2", "pid": 1, "tid": 7,
+         "ts": 10.0, "dur": 30.0},
+        # gap 100..150, then a collective 150..250
+        {"ph": "X", "name": "all-reduce.3", "pid": 1, "tid": 7,
+         "ts": 150.0, "dur": 100.0},
+    ]}
+
+
+def test_summarize_union_and_collectives():
+    import importlib
+
+    pa = importlib.import_module("profile_analyze")
+    lanes = pa.summarize(_trace(), top=5)
+    assert len(lanes) == 1
+    lane = lanes[0]
+    assert lane["lane"] == "TPU:0 XLA Ops"
+    # union busy: [0,100] + [150,250] = 200us over a 250us span
+    assert abs(lane["busy_ms"] - 0.2) < 1e-6
+    assert abs(lane["span_ms"] - 0.25) < 1e-6
+    assert abs(lane["utilization"] - 0.8) < 1e-3
+    assert abs(lane["collective_ms"] - 0.1) < 1e-6
+    names = [o["name"] for o in lane["top_ops"]]
+    assert names[0] in ("fusion.1", "all-reduce.3")
+
+
+def test_load_trace_roundtrip(tmp_path):
+    import importlib
+
+    pa = importlib.import_module("profile_analyze")
+    d = tmp_path / "bert" / "plugins" / "profile" / "2026_01_01"
+    d.mkdir(parents=True)
+    with gzip.open(d / "vm.trace.json.gz", "wt") as f:
+        json.dump(_trace(), f)
+    trace, path = pa.load_trace(str(tmp_path / "bert"))
+    assert path.endswith(".trace.json.gz")
+    assert pa.summarize(trace)[0]["collective_ms"] > 0

@@ -152,10 +152,16 @@ def build(seq=SEQ, remat=False):
     params = [p.data()._data for p in plist]
     states = init_states(params)
     if remat:
-        # rematerialize the forward during backward: activation HBM drops
-        # from O(layers) to O(1) per microbatch, buying larger batches
-        # (the --batch sweep) at ~1.3x FLOPs
-        loss_fn = jax.checkpoint(loss_fn)
+        # rematerialize activations during backward to buy larger batches
+        # (the --batch sweep). remat is the POLICY string: 'dots' (default)
+        # saves matmul outputs — cheap to store, expensive to recompute —
+        # and recomputes only the elementwise tail, the standard TPU LLM
+        # recipe; 'full' (--remat=full) saves nothing (~2x forward FLOPs),
+        # kept for the memory-extreme comparison
+        # bool True (programmatic callers) means the default policy
+        policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                  if remat in (True, "dots") else None)
+        loss_fn = jax.checkpoint(loss_fn, policy=policy)
 
     # donate params+opt state: step i+1 overwrites step i's buffers in place
     # instead of allocating a second copy of every weight/moment in HBM
@@ -585,8 +591,10 @@ def run_mode(mode, results, smoke=False, iters=None, headline=False,
     float(loss)
     _log("compile + first step done; timing...")
 
-    remat = remat and mode in ("bert", "bert512")  # only the bert builds
-    # thread jax.checkpoint; other modes must not claim remat in the record
+    # only the bert builds thread jax.checkpoint; other modes must not
+    # claim remat in the record. Keep the POLICY string intact ("x and y"
+    # would collapse it to the boolean y).
+    remat = remat if mode in ("bert", "bert512") else False
     iters = iters or (3 if smoke else 50)
     t0 = time.perf_counter()
     for i in range(iters):
@@ -606,7 +614,8 @@ def run_mode(mode, results, smoke=False, iters=None, headline=False,
         "fresh": True,
         "iters": iters,
         "batch": (batch_override or "default"),
-        "remat": remat,
+        "remat": bool(remat),
+        "remat_policy": ("dots" if remat is True else remat) or None,
         "prng": prng_impl,
         "platform": jax.devices()[0].platform,
     }
@@ -655,7 +664,15 @@ def main():
     args = [a for a in sys.argv[1:] if not a.startswith("--")]
     flags = {a for a in sys.argv[1:] if a.startswith("--")}
     smoke = "--smoke" in flags
-    remat = "--remat" in flags
+    remat = "dots" if "--remat" in flags else False
+    for f in flags:
+        if f.startswith("--remat="):
+            remat = f.split("=", 1)[1]
+            if remat not in ("dots", "full"):
+                # same convention as the mode check: a typo must abort
+                # loudly, never run one policy while recording another
+                raise SystemExit("--remat= takes dots or full, got %r"
+                                 % remat)
     if "--cpu" in flags:
         jax.config.update("jax_platforms", "cpu")
     mode = args[0] if args else "bert"

@@ -103,7 +103,8 @@ sys.exit(0 if r.get('measured_at', '') >= '$LOOP_START' else 1)" 2>/dev/null; th
       : > "$SWEEP_OUT"
       for args in "bert --batch=64" "bert --batch=128" "bert --batch=256" \
                   "bert512 --batch=32" "bert512 --batch=32 --remat" \
-                  "bert512 --batch=64 --remat" "bert512 --batch=128 --remat"; do
+                  "bert512 --batch=64 --remat" "bert512 --batch=128 --remat" \
+                  "bert512 --batch=64 --remat=full"; do
         echo "[loop] bench $args"
         # durable copy in-repo (the /tmp loop log is not) — one JSON line per
         # config, tagged with its args

@@ -120,6 +120,23 @@ def num_tpus():
     return num_gpus()
 
 
+def gpu_memory_info(device_id=0):
+    """(free, total) bytes on the accelerator (ref: context.py:
+    gpu_memory_info). On TPU this reads the device's HBM allocator stats;
+    raises when no accelerator exists, like upstream on a CPU-only host."""
+    devs = _accel_devices()
+    if not 0 <= device_id < len(devs):
+        raise RuntimeError("no accelerator device %d" % device_id)
+    stats = devs[device_id].memory_stats() or {}
+    total = stats.get("bytes_limit", 0)
+    used = stats.get("bytes_in_use", 0)
+    if not total:  # upstream raises on hosts without accelerator memory
+        raise RuntimeError(
+            "device %r reports no memory stats (no accelerator HBM)"
+            % (devs[device_id],))
+    return (total - used, total)
+
+
 def current_context():
     return Context.default_ctx()
 

@@ -16,9 +16,31 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 
 
+_bulk_size = 15  # upstream default (MXNET_ENGINE_BULK_SIZE)
+
+
 def set_bulk_size(size):
-    """XLA fuses inside jit; bulking is a no-op (ref: engine.cc:SetBulkSize)."""
-    return size
+    """Returns the PREVIOUS size, like upstream (ref: engine.cc:
+    SetBulkSize). XLA fuses inside jit, so the value is bookkeeping only."""
+    global _bulk_size
+    prev, _bulk_size = _bulk_size, size
+    return prev
+
+
+class bulk:
+    """Context manager form (ref: python/mxnet/engine.py:bulk): upstream
+    batches engine pushes inside the scope; XLA's jit fusion already does
+    the equivalent, so this scope only mirrors the API."""
+
+    def __init__(self, size):
+        self._size = size
+
+    def __enter__(self):
+        self._prev = set_bulk_size(self._size)
+        return self
+
+    def __exit__(self, *a):
+        set_bulk_size(self._prev)
 
 
 def _lib_location():

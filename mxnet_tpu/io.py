@@ -8,8 +8,9 @@ import numpy as np
 from .ndarray import NDArray, array
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
-           "ImageRecordIter", "PrefetchingIter", "ResizeIter", "LibSVMIter",
-           "ImageDetRecordIter", "pack_det_label"]
+           "ImageRecordIter", "ImageRecordUInt8Iter", "PrefetchingIter",
+           "ResizeIter", "LibSVMIter", "ImageDetRecordIter",
+           "pack_det_label"]
 
 
 class DataDesc:
@@ -403,6 +404,8 @@ class ImageRecordIter(_RecordIterBase):
     to the per-image PIL/augmenter path (image.py) for anything richer
     (rand_crop, color jitter via ImageIter) or when the .so isn't built."""
 
+    _raw_uint8 = False  # ImageRecordUInt8Iter skips the float round-trip
+
     def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
                  shuffle=False, rand_crop=False, rand_mirror=False, mean_r=0.0,
                  mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0, std_b=1.0,
@@ -439,7 +442,10 @@ class ImageRecordIter(_RecordIterBase):
             raise StopIteration
         self._cursor += self.batch_size
         data, labels = got
-        x = (data.astype(np.float32) - self._mean) / self._std
+        if self._raw_uint8:
+            x = data  # already uint8 CHW from the decoder: no float round-trip
+        else:
+            x = (data.astype(np.float32) - self._mean) / self._std
         if self._label_width == 1:
             labels = labels.ravel()
         return DataBatch([array(x)], [array(labels)])
@@ -466,6 +472,32 @@ class ImageRecordIter(_RecordIterBase):
 
     def _collate_labels(self, labels):
         return np.asarray(labels, np.float32)
+
+
+class ImageRecordUInt8Iter(ImageRecordIter):
+    """uint8 twin of ImageRecordIter (ref: src/io/iter_image_recordio_2.cc
+    ImageRecordUInt8Iter): decoded pixels pass through UN-normalized as
+    uint8 — the quantized-inference input pipeline. Mean/std kwargs are
+    rejected like upstream (the op has no normalization parameters)."""
+
+    _raw_uint8 = True  # native pipe hands its uint8 buffer straight through
+
+    def __init__(self, path_imgrec, data_shape, batch_size, **kwargs):
+        bad = [k for k in kwargs
+               if k.startswith(("mean_", "std_"))]
+        if bad:
+            raise TypeError("ImageRecordUInt8Iter takes no normalization "
+                            "parameters (got %s); it yields raw uint8"
+                            % bad)
+        super().__init__(path_imgrec, data_shape, batch_size, **kwargs)
+
+    def next(self):
+        batch = super().next()
+        # the python-augmenter fallback emits floats; the pipe path is
+        # already uint8 and passes through untouched
+        batch.data = [d if str(d.dtype) == "uint8" else d.astype("uint8")
+                      for d in batch.data]
+        return batch
 
 
 class PrefetchingIter(DataIter):

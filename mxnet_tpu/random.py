@@ -53,3 +53,42 @@ def set_state(st):
 
 
 __all__ = ["seed", "next_key", "split", "get_state", "set_state"]
+
+
+# ---------------------------------------------------------------- samplers
+# Upstream mx.random re-exports the nd.random samplers at module level
+# (ref: python/mxnet/random.py) — delegation is lazy to avoid an import
+# cycle with the nd facade.
+def _delegate(name):
+    def f(*args, **kwargs):
+        from . import nd
+
+        return getattr(nd.random, name)(*args, **kwargs)
+
+    f.__name__ = name
+    f.__doc__ = "mx.random.%s — delegates to nd.random.%s" % (name, name)
+    return f
+
+
+uniform = _delegate("uniform")
+normal = _delegate("normal")
+randn = _delegate("randn")
+randint = _delegate("randint")
+exponential = _delegate("exponential")
+gamma = _delegate("gamma")
+poisson = _delegate("poisson")
+negative_binomial = _delegate("negative_binomial")
+multinomial = _delegate("multinomial")
+
+
+def shuffle(data):
+    """Random permutation along the first axis (ref: random.py:shuffle —
+    upstream shuffles IN PLACE and returns None; same contract here)."""
+    from . import nd
+
+    data._data = nd.shuffle(data)._data
+
+
+__all__ += ["uniform", "normal", "randn", "randint", "exponential",
+            "gamma", "poisson", "negative_binomial", "multinomial",
+            "shuffle"]

@@ -11,6 +11,28 @@ def default_context():
     return current_context()
 
 
+def set_default_context(ctx):
+    """(ref: test_utils.py:set_default_context) — make ``ctx`` the ambient
+    default for factory calls outside explicit Context scopes."""
+    from . import context as _ctx_mod
+
+    _ctx_mod._default = ctx
+
+
+def list_gpus():
+    """(ref: test_utils.py:list_gpus) — REAL accelerator ordinals (the cpu
+    fallback device does not count). mx.gpu() is the accelerator alias
+    here, so the standard upstream gate ``mx.gpu() if list_gpus() else
+    mx.cpu()`` keeps selecting the TPU on TPU hosts and cpu elsewhere."""
+    from .context import _accel_devices
+
+    try:
+        devs = _accel_devices()
+    except RuntimeError:
+        return []
+    return [d.id for d in devs if d.platform != "cpu"]
+
+
 def _np(x):
     return x.asnumpy() if isinstance(x, NDArray) else np.asarray(x)
 

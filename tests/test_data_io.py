@@ -874,3 +874,39 @@ def test_filter_sampler_image_list_dataset_random_crop(tmp_path):
                            imglist=[[0, paths[0]], [1, paths[1]]])
     batches = list(DataLoader(ds2, batch_size=2))
     assert batches[0][0].shape == (2, 8, 8, 3)
+
+
+def test_image_record_uint8_iter(tmp_path):
+    """ImageRecordUInt8Iter yields raw uint8 pixels (no normalization) and
+    rejects mean/std kwargs, like upstream's quantized-input iterator."""
+    from mxnet_tpu import recordio
+    from mxnet_tpu.io import ImageRecordUInt8Iter
+
+    try:
+        from PIL import Image
+    except Exception:
+        pytest.skip("PIL unavailable")
+    import io as _io
+
+    path = str(tmp_path / "u8.rec")
+    rec = recordio.MXRecordIO(path, "w")
+    rng = np.random.default_rng(1)
+    for i in range(4):
+        arr = rng.integers(0, 255, (8, 8, 3)).astype(np.uint8)
+        buf = _io.BytesIO()
+        Image.fromarray(arr).save(buf, format="PNG")
+        rec.write(recordio.pack(recordio.IRHeader(0, float(i), i, 0),
+                                buf.getvalue()))
+    rec.close()
+
+    it = ImageRecordUInt8Iter(path_imgrec=path, data_shape=(3, 8, 8),
+                              batch_size=2)
+    batch = it.next()
+    data = batch.data[0].asnumpy()
+    assert data.dtype == np.uint8
+    assert data.shape == (2, 3, 8, 8)
+    assert data.max() > 1  # raw pixel range, not normalized floats
+
+    with pytest.raises(TypeError, match="normalization"):
+        ImageRecordUInt8Iter(path_imgrec=path, data_shape=(3, 8, 8),
+                             batch_size=2, mean_r=1.0)

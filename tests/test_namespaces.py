@@ -402,3 +402,38 @@ def test_initializer_load():
     with pytest.raises(ValueError, match="not found"):
         nn.Dense(2, in_units=2).initialize(
             mx.initializer.Load({}), force_reinit=True)
+
+
+def test_r5_module_level_api_grab_bag():
+    """Upstream module-level conveniences: mx.random samplers (delegating
+    to nd.random), in-place mx.random.shuffle, engine.bulk scope,
+    test_utils.list_gpus/set_default_context, context.gpu_memory_info."""
+    import pytest
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    mx.random.seed(1)
+    u = mx.random.uniform(0, 1, shape=(200,)).asnumpy()
+    assert (u >= 0).all() and (u < 1).all()
+    assert mx.random.randn(2, 3).shape == (2, 3)
+    a = nd.array(np.arange(8, dtype=np.float32))
+    before = a.asnumpy().copy()
+    assert mx.random.shuffle(a) is None  # upstream shuffles IN PLACE
+    assert sorted(a.asnumpy().tolist()) == before.tolist()
+
+    with mx.engine.bulk(8):
+        nd.ones((2,))
+    assert mx.test_utils.list_gpus() == []
+
+    from mxnet_tpu import context as ctx_mod
+    saved = ctx_mod._default
+    try:
+        mx.test_utils.set_default_context(mx.cpu())
+        assert mx.context.current_context().device_type == "cpu"
+    finally:
+        ctx_mod._default = saved
+
+    # cpu-only host: no accelerator HBM stats — raises like upstream
+    with pytest.raises(RuntimeError):
+        mx.context.gpu_memory_info(0)

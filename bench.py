@@ -95,7 +95,9 @@ V5E_PEAK_BF16_FLOPS = 197e12  # per-chip bf16 peak, TPU v5e
 
 BASELINE_SAMPLES_PER_SEC = 250.0  # MXNet+A100 BERT-base phase-1 (BASELINE.md)
 
-BATCH = 32
+# 64 won the r5 hardware batch sweep (tools/batch_sweep_r5.jsonl:
+# 32→1260 samples/s @0.447 MFU, 64→1443 @0.512, 128→1300, 256→1199)
+BATCH = 64
 SEQ = 128
 MASKED = 20
 VOCAB = 30522
@@ -432,8 +434,25 @@ def _build_on_host(thunk):
     return step, params, states
 
 
+# XLA cost-analysis train FLOPs per unit for the non-bert modes
+# (tools/roofline_r5.json — backend-independent: flops depend on the model
+# math, not the lowering; the bert modes keep their closed-form analytic
+# count, which agrees with cost analysis within 4%).
+COST_FLOPS_PER_UNIT = {
+    "resnet50": 23.52e9,   # per image
+    "lstm": 60.36e6,       # per token
+    "ssd512": 330.0e9,     # per image
+    "nmt": 187.9e6,        # per token
+}
+
+
+def _cost_mfu(mode):
+    f = COST_FLOPS_PER_UNIT[mode]
+    return lambda v: v * f / V5E_PEAK_BF16_FLOPS
+
+
 # mode -> (build_fn(smoke) -> (step, params, states, batch, units_per_step,
-#          metric, unit, baseline, mfu_fn or None))
+#          metric, unit, baseline, mfu_fn or None, resolved_batch))
 def _mode_spec(mode, rng, smoke=False, batch_override=None, remat=False):
     def _b(default):
         return batch_override or (default)
@@ -445,7 +464,7 @@ def _mode_spec(mode, rng, smoke=False, batch_override=None, remat=False):
                 "bert_base_pretrain_samples_per_sec_per_chip", "samples/s",
                 BASELINE_SAMPLES_PER_SEC,
                 lambda v: v * _bert_train_flops_per_sample(SEQ, MASKED)
-                / V5E_PEAK_BF16_FLOPS)
+                / V5E_PEAK_BF16_FLOPS, b)
     if mode == "bert512":
         b = _b(2 if smoke else BERT512_BATCH)
         step, params, states = _build_on_host(
@@ -456,25 +475,25 @@ def _mode_spec(mode, rng, smoke=False, batch_override=None, remat=False):
                 BERT512_BASELINE,
                 lambda v: v * _bert_train_flops_per_sample(BERT512_SEQ,
                                                            BERT512_MASKED)
-                / V5E_PEAK_BF16_FLOPS)
+                / V5E_PEAK_BF16_FLOPS, b)
     if mode == "resnet50":
         b = _b(2 if smoke else RESNET_BATCH)
         step, params, states = _build_on_host(build_resnet)
         return (step, params, states, make_resnet_batch(rng, b), b,
                 "resnet50_train_images_per_sec_per_chip", "images/s",
-                RESNET_BASELINE_IMG_PER_SEC, None)
+                RESNET_BASELINE_IMG_PER_SEC, _cost_mfu("resnet50"), b)
     if mode == "lstm":
         b = _b(4 if smoke else LSTM_BATCH)
         step, params, states = _build_on_host(build_lstm)
         return (step, params, states, make_lstm_batch(rng, b), b * LSTM_BPTT,
                 "lstm_ptb_train_tokens_per_sec_per_chip", "tokens/s",
-                LSTM_BASELINE_TOK_PER_SEC, None)
+                LSTM_BASELINE_TOK_PER_SEC, _cost_mfu("lstm"), b)
     if mode == "ssd512":
         b = _b(1 if smoke else SSD_BATCH)
         step, params, states = _build_on_host(build_ssd)
         return (step, params, states, make_ssd_batch(rng, b), b,
                 "ssd512_vgg16_train_images_per_sec_per_chip", "images/s",
-                SSD_BASELINE_IMG_PER_SEC, None)
+                SSD_BASELINE_IMG_PER_SEC, _cost_mfu("ssd512"), b)
     if mode == "nmt":
         b = _b(2 if smoke else NMT_BATCH)
         src_len = 16 if smoke else NMT_SRC_LEN
@@ -483,7 +502,7 @@ def _mode_spec(mode, rng, smoke=False, batch_override=None, remat=False):
         return (step, params, states, make_nmt_batch(rng, b, src_len, tgt_len),
                 b * (src_len + tgt_len),
                 "transformer_nmt_train_tokens_per_sec_per_chip", "tokens/s",
-                NMT_BASELINE_TOK_PER_SEC, None)
+                NMT_BASELINE_TOK_PER_SEC, _cost_mfu("nmt"), b)
     raise SystemExit("unknown mode %r" % mode)
 
 
@@ -578,7 +597,8 @@ def run_mode(mode, results, smoke=False, iters=None, headline=False,
     rng = np.random.default_rng(0)
     _log("building model + train step (%s)..." % mode)
     (step, params, states, batch, units, metric, unit, baseline,
-     mfu_fn) = _mode_spec(mode, rng, smoke, batch_override, remat)
+     mfu_fn, resolved_batch) = _mode_spec(mode, rng, smoke, batch_override,
+                                          remat)
     prng_impl, key = _make_key()
 
     # warmup / compile. NOTE: under the axon relay block_until_ready can
@@ -613,13 +633,18 @@ def run_mode(mode, results, smoke=False, iters=None, headline=False,
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "fresh": True,
         "iters": iters,
-        "batch": (batch_override or "default"),
+        # the resolved literal, never the string "default": a later change
+        # of a default constant must not silently re-label an old record
+        # (the committed bert 1260.5 was batch 32; BATCH is now 64)
+        "batch": resolved_batch,
         "remat": bool(remat),
         "remat_policy": ("dots" if remat is True else remat) or None,
         "prng": prng_impl,
         "platform": jax.devices()[0].platform,
     }
-    if mfu_fn is not None:
+    # not in smoke: the flops/unit constants assume full bench shapes (nmt
+    # smoke shrinks src/tgt 64->16, whose attention flops differ)
+    if mfu_fn is not None and not smoke:
         rec["mfu"] = round(mfu_fn(per_sec), 4)
     try:
         from mxnet_tpu.profiler import device_memory_summary

@@ -6,6 +6,15 @@ empty PALLAS_AXON_POOL_IPS disables it so tests run on
 """
 import os
 
+# Save the session's accelerator env BEFORE pinning the suite to CPU:
+# test_pallas_tpu.py re-launches subprocesses with these originals so the
+# hardware-gated kernel tests can reach the relay (without this they
+# inherit the cpu pin and silently self-skip even when the TPU is up —
+# observed r5).
+for _k in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS", "XLA_FLAGS"):
+    if "MXTPU_ORIG_" + _k not in os.environ:
+        os.environ["MXTPU_ORIG_" + _k] = os.environ.get(_k, "<MXTPU-UNSET>")
+
 os.environ["PALLAS_AXON_POOL_IPS"] = ""
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")

@@ -18,8 +18,19 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _accel_env():
+    """Session env with conftest's CPU pin undone.
+
+    conftest.py overwrites PALLAS_AXON_POOL_IPS / JAX_PLATFORMS / XLA_FLAGS
+    to force the virtual CPU mesh, saving the originals under MXTPU_ORIG_*.
+    Subprocesses must get the ORIGINALS back or the TPU probe sees the cpu
+    pin and these tests self-skip with the relay up (observed r5)."""
     env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)  # drop the virtual-device forcing
+    for k in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS", "XLA_FLAGS"):
+        if "MXTPU_ORIG_" + k in env:  # conftest ran and pinned; undo it
+            orig = env.pop("MXTPU_ORIG_" + k)
+            env.pop(k, None)
+            if orig != "<MXTPU-UNSET>":
+                env[k] = orig
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
     return env
 
@@ -61,17 +72,28 @@ _KERNEL_SCRIPT = textwrap.dedent("""
     def floss(f):
         return lambda q, k, v: (f(q, k, v) * jnp.arange(D)).sum()
 
+    # Oracle-relative criterion (r5): on the MXU both flash and XLA's dense
+    # attention run default-precision matmuls whose rounding vs a
+    # precision=HIGHEST oracle is ~1e-2-scale; absolute tolerances are
+    # always wrong on one side. Invariant: flash is no less accurate than
+    # XLA's own dense lowering at the same dtype.
+    def assert_rel(got, ref, oracle, margin=1.5, floor=1e-5):
+        e_got = float(jnp.abs(got - oracle).max())
+        e_ref = float(jnp.abs(ref - oracle).max())
+        assert e_got <= max(margin * e_ref, floor), (e_got, e_ref)
+
+    with jax.default_matmul_precision("highest"):
+        oracle = jax.jit(dense)(q, k, v)
+        g_oracle = jax.jit(jax.grad(floss(dense), argnums=(0, 1, 2)))(q, k, v)
     out = flash_attention(q, k, v, interpret=False)
-    ref = dense(q, k, v)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                               rtol=2e-2, atol=2e-3)
+    ref = jax.jit(dense)(q, k, v)
+    assert_rel(out, ref, oracle)
     g1 = jax.grad(floss(lambda a, b, c: flash_attention(a, b, c,
                                                         interpret=False)),
                   argnums=(0, 1, 2))(q, k, v)
-    g2 = jax.grad(floss(dense), argnums=(0, 1, 2))(q, k, v)
-    for a, b in zip(g1, g2):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=5e-2, atol=5e-3)
+    g2 = jax.jit(jax.grad(floss(dense), argnums=(0, 1, 2)))(q, k, v)
+    for a, b, o in zip(g1, g2, g_oracle):
+        assert_rel(a, b, o)
     print("FLASH_OK")
 
     # ---- flash attention with kv_valid_len (key-padding) ------------------
@@ -83,9 +105,10 @@ _KERNEL_SCRIPT = textwrap.dedent("""
         return jnp.einsum("bhqk,bhkd->bhqd",
                           jax.nn.softmax(jnp.where(mask, s, -1e30), -1), v)
 
+    with jax.default_matmul_precision("highest"):
+        oracle_vl = jax.jit(dense_vl)(q, k, v)
     om = flash_attention(q, k, v, interpret=False, kv_valid_len=vl)
-    np.testing.assert_allclose(np.asarray(om), np.asarray(dense_vl(q, k, v)),
-                               rtol=2e-2, atol=2e-3)
+    assert_rel(om, jax.jit(dense_vl)(q, k, v), oracle_vl)
     gm = jax.grad(floss(lambda a, b, c: flash_attention(
         a, b, c, interpret=False, kv_valid_len=vl)), argnums=(1,))(q, k, v)
     np.testing.assert_array_equal(np.asarray(gm[0][0, :, 300:, :]), 0.0)

@@ -30,11 +30,21 @@ import re
 import sys
 import time
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # BEFORE bench import (it reads the env)
+# --backend must be honored BEFORE jax/bench import (both read the env).
+# 'tpu' compiles through the axon relay against the real XLA:TPU backend —
+# nothing executes, but fusion choices and cost analysis are the chip's own.
+_BACKEND = "cpu"
+for _i, _a in enumerate(sys.argv):
+    if _a == "--backend" and _i + 1 < len(sys.argv):
+        _BACKEND = sys.argv[_i + 1]
+    elif _a.startswith("--backend="):
+        _BACKEND = _a.split("=", 1)[1]
+os.environ["JAX_PLATFORMS"] = "tpu,cpu" if _BACKEND == "tpu" else "cpu"
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")  # sitecustomize may have latched
+if _BACKEND == "cpu":
+    jax.config.update("jax_platforms", "cpu")  # sitecustomize may have latched
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -181,7 +191,7 @@ def aggregate_sinks(hlo_text, k=5):
 def analyze_mode(mode, smoke=False):
     rng = np.random.default_rng(0)
     (step, params, states, batch, units, metric, unit, baseline,
-     mfu_fn) = bench._mode_spec(mode, rng, smoke=smoke)
+     mfu_fn, _batch_n) = bench._mode_spec(mode, rng, smoke=smoke)
     import jax.numpy as jnp
 
     key = jax.random.PRNGKey(0)
@@ -219,18 +229,45 @@ def main(argv=None):
                     help="tiny shapes (CI); the committed artifact uses "
                     "the real bench shapes")
     ap.add_argument("--json", default=None, help="artifact output path")
+    ap.add_argument("--backend", default="cpu", choices=["cpu", "tpu"],
+                    help="tpu = compile (never execute) against the real "
+                         "XLA:TPU backend through the relay; cpu = "
+                         "relay-independent fallback")
     args = ap.parse_args(argv)
+    if args.backend != _BACKEND:
+        # argparse accepted a spelling (abbreviation, main(argv=...)) that
+        # the import-time env scan missed — the backend pin happens before
+        # jax import, so it cannot be fixed up here; refuse loudly instead
+        # of silently generating a CPU artifact labeled tpu
+        raise SystemExit(
+            "--backend must be passed on the command line as "
+            "'--backend %s' or '--backend=%s' (import-time env pin saw %r)"
+            % (args.backend, args.backend, _BACKEND))
 
+    if _BACKEND == "tpu":
+        backend_note = (
+            "tpu-compiled (XLA:TPU fusion + cost analysis — the chip's own "
+            "view; pallas kernels are opaque custom-calls whose internal "
+            "HBM traffic cost analysis cannot see, so bytes on those paths "
+            "are a lower bound)")
+        ceiling_note = (
+            "ceilings derive from XLA:TPU's own 'bytes accessed'; they are "
+            "the roofline for THIS compiled program (a lower-traffic "
+            "rewrite can raise them)")
+    else:
+        backend_note = ("cpu-lowered (pallas-gated kernels appear as jnp "
+                        "fallbacks; bytes for those paths are an upper bound)")
+        ceiling_note = (
+            "XLA:CPU 'bytes accessed' counts the weakly-fused "
+            "CPU pipeline's traffic, so these ceilings are NOT "
+            "upper bounds for TPU (bert512 MEASURED 0.276 MFU "
+            "on hardware vs the 0.11 cpu-derived ceiling). Use "
+            "them to RANK modes/sinks; the true TPU roofline "
+            "needs the TPU-compiled HLO, blocked on the relay.")
     out = {
         "generated_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-        "backend": "cpu-lowered (pallas-gated kernels appear as jnp "
-                   "fallbacks; bytes for those paths are an upper bound)",
-        "ceiling_caveat": "XLA:CPU 'bytes accessed' counts the weakly-fused "
-                          "CPU pipeline's traffic, so these ceilings are NOT "
-                          "upper bounds for TPU (bert512 MEASURED 0.276 MFU "
-                          "on hardware vs the 0.11 cpu-derived ceiling). Use "
-                          "them to RANK modes/sinks; the true TPU roofline "
-                          "needs the TPU-compiled HLO, blocked on the relay.",
+        "backend": backend_note,
+        "ceiling_caveat": ceiling_note,
         "v5e_peak_bf16_flops": V5E_PEAK_FLOPS,
         "v5e_hbm_bytes_per_s": V5E_HBM_BYTES_PER_S,
         "critical_intensity_flops_per_byte": round(CRITICAL_INTENSITY, 1),

@@ -56,6 +56,25 @@ def main():
             % (name, "PASS" if ok else "FAIL", err, tol))
         return ok
 
+    def record_rel(name, err, xla_err, margin=1.5, floor=1e-5):
+        """Oracle-relative criterion: on real MXUs BOTH flash and XLA's
+        dense attention run default-precision matmuls, whose rounding
+        against a precision=HIGHEST oracle reaches ~1e-2 (causal f32,
+        measured r5) — an absolute tolerance can only be wrong on one
+        side. The invariant that matters: the kernel is no less accurate
+        than what XLA itself does at the same dtype."""
+        tol = max(xla_err * margin, floor)
+        ok = err <= tol
+        rows.append({"check": name, "max_err": float("%.3e" % err),
+                     "xla_default_err": float("%.3e" % xla_err),
+                     "tol": float("%.3e" % tol), "pass": bool(ok),
+                     "criterion": "flash_err <= max(%.1fx XLA-default err, "
+                                  "%g) vs precision=HIGHEST oracle"
+                                  % (margin, floor)})
+        log("%s %s (maxerr %.2e vs XLA-default %.2e, tol %.2e)"
+            % (name, "PASS" if ok else "FAIL", err, xla_err, tol))
+        return ok
+
     from mxnet_tpu.ops.pallas.flash_attention import (BLOCK_DEFAULTS,
                                                       flash_attention)
     from mxnet_tpu.ops.pallas.layernorm import fused_layernorm
@@ -69,28 +88,49 @@ def main():
     q, k, v = (jax.random.normal(kk, (B, H, T, D), jnp.float32) for kk in ks[:3])
     ct = jax.random.normal(ks[3], (B, H, T, D), jnp.float32)
     for causal in (False, True):
-        out = jax.jit(lambda a, b, c: flash_attention(a, b, c, causal=causal))(q, k, v)
-        ref = full_attention(q, k, v, causal=causal)
-        record("flash_fwd_causal=%s" % causal,
-               float(jnp.abs(out - ref).max()), 2e-3)
+        def fl_fwd(a, b, c, causal=causal):
+            return flash_attention(a, b, c, causal=causal)
 
-        grads = jax.jit(jax.grad(
-            lambda a, b, c: jnp.sum(flash_attention(a, b, c, causal=causal) * ct),
-            argnums=(0, 1, 2)))(q, k, v)
-        refs = jax.grad(
-            lambda a, b, c: jnp.sum(full_attention(a, b, c, causal=causal) * ct),
-            argnums=(0, 1, 2))(q, k, v)
-        for g, r, name in zip(grads, refs, ("dq", "dk", "dv")):
-            record("flash_bwd_%s_causal=%s" % (name, causal),
-                   float(jnp.abs(g - r).max()), 5e-3)
+        def xla_fwd(a, b, c, causal=causal):
+            return full_attention(a, b, c, causal=causal)
+
+        def fl_loss(a, b, c, causal=causal):
+            return jnp.sum(flash_attention(a, b, c, causal=causal) * ct)
+
+        def xla_loss(a, b, c, causal=causal):
+            return jnp.sum(full_attention(a, b, c, causal=causal) * ct)
+
+        with jax.default_matmul_precision("highest"):
+            oracle = jax.jit(xla_fwd)(q, k, v)
+            g_oracle = jax.jit(jax.grad(xla_loss, argnums=(0, 1, 2)))(q, k, v)
+        out = jax.jit(fl_fwd)(q, k, v)
+        ref = jax.jit(xla_fwd)(q, k, v)
+        record_rel("flash_fwd_causal=%s" % causal,
+                   float(jnp.abs(out - oracle).max()),
+                   float(jnp.abs(ref - oracle).max()))
+
+        grads = jax.jit(jax.grad(fl_loss, argnums=(0, 1, 2)))(q, k, v)
+        refs = jax.jit(jax.grad(xla_loss, argnums=(0, 1, 2)))(q, k, v)
+        for g, r, o, name in zip(grads, refs, g_oracle, ("dq", "dk", "dv")):
+            record_rel("flash_bwd_%s_causal=%s" % (name, causal),
+                       float(jnp.abs(g - o).max()),
+                       float(jnp.abs(r - o).max()))
 
     # key-padding (kv_valid_len) path — the BERT bench configuration
     from mxnet_tpu.ops.attention import _reference_attention
     vl = jnp.asarray([384.0, 512.0], jnp.float32)
     mask = jnp.arange(T)[None, None, None, :] < vl[:, None, None, None]
+
+    def xla_vl(a, b, c):
+        return _reference_attention(a, b, c, mask)
+
+    with jax.default_matmul_precision("highest"):
+        oracle = jax.jit(xla_vl)(q, k, v)
     out = jax.jit(lambda a, b, c: flash_attention(a, b, c, kv_valid_len=vl))(q, k, v)
-    ref = _reference_attention(q, k, v, mask)
-    record("flash_fwd_kv_valid_len", float(jnp.abs(out - ref).max()), 2e-3)
+    ref = jax.jit(xla_vl)(q, k, v)
+    record_rel("flash_fwd_kv_valid_len",
+               float(jnp.abs(out - oracle).max()),
+               float(jnp.abs(ref - oracle).max()))
 
     # fused layernorm
     x = jax.random.normal(jax.random.PRNGKey(1), (256, 1024), jnp.float32)

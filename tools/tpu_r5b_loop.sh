@@ -1,0 +1,63 @@
+#!/bin/bash
+# Round-5 SECOND measurement pass. The first window (08:29-09:13Z) captured
+# the full loop sequence: headline bert 1262.9 @ 0.448 MFU, all six modes,
+# the batch/remat sweep (batch 64 -> 1442.55 @ 0.512 MFU) and the kernel
+# check — but the flash sweep ran with dispatch-dominated timings (see
+# flash_sweep.py time_fn docstring) and the relay wedged before the
+# corrected slope-timing sweep finished. This loop arms the remaining work:
+#   1. corrected flash sweep + --apply (real kernel timings)
+#   2. bert headline re-measure at the new default batch 64 (tuned table)
+#   3. bert512 re-measure (picks up any min_len change from the sweep)
+#   4. resnet50 --batch=256 (the 0.80x config; batch is the cheap lever)
+#   5. ssd512 --batch=64
+#   6. TPU-compiled roofline artifact (compile-only, cost analysis)
+#
+# Usage: setsid nohup bash tools/tpu_r5b_loop.sh &
+set -u
+cd "$(dirname "$0")/.."
+LOG=${TPU_LOOP_LOG:-/tmp/tpu_measurements_r5b.log}
+exec >>"$LOG" 2>&1
+
+LOOP_START=$(date -u +%FT%TZ)
+echo "[r5b] started $LOOP_START pid $$"
+while true; do
+  echo "[r5b] $(date -u +%T) probing relay..."
+  if timeout -k 10 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    while pgrep -f "^[^ ]*python[^ ]* (-m pytest|[^ ]*/pytest)( |$)" >/dev/null 2>&1; do
+      echo "[r5b] $(date -u +%T) relay up but a test suite is running; waiting 60s"
+      sleep 60
+    done
+    echo "[r5b] $(date -u +%T) relay up; corrected flash sweep"
+    if python -c "
+import json, sys
+b = json.load(open('mxnet_tpu/ops/pallas/flash_blocks.json'))
+sys.exit(0 if (b.get('swept_at') or '') >= '$LOOP_START' else 1)" 2>/dev/null; then
+      echo "[r5b] block table already swept this run; skipping"
+    else
+      timeout -k 30 2400 python tools/flash_sweep.py \
+        --seq 128 256 512 1024 2048 --iters 50 \
+        --json tools/flash_sweep_r5.json --apply \
+        || { echo "[r5b] sweep failed/wedged (rc=$?); re-probing"; sleep 60; continue; }
+    fi
+    echo "[r5b] $(date -u +%T) sweep applied; bert headline at default batch 64"
+    BENCH_PROBE_BUDGET_S=600 timeout -k 30 3600 python bench.py bert \
+      || { echo "[r5b] headline failed (rc=$?); re-probing"; sleep 60; continue; }
+    echo "[r5b] $(date -u +%T) bert512 re-measure (post-sweep gate)"
+    BENCH_PROBE_BUDGET_S=300 timeout -k 30 2400 python bench.py bert512 \
+      || echo "[r5b] bert512 failed (rc=$?)"
+    echo "[r5b] $(date -u +%T) resnet50 batch sweep"
+    BENCH_PROFILE_DIR=/tmp/profile_r5 BENCH_PROBE_BUDGET_S=300 \
+      timeout -k 30 2400 python bench.py resnet50 --batch=256 \
+      || echo "[r5b] resnet50 b256 failed (rc=$?)"
+    echo "[r5b] $(date -u +%T) ssd512 batch sweep"
+    BENCH_PROBE_BUDGET_S=300 timeout -k 30 2400 python bench.py ssd512 --batch=64 \
+      || echo "[r5b] ssd512 b64 failed (rc=$?)"
+    echo "[r5b] $(date -u +%T) TPU-compiled roofline (compile-only)"
+    timeout -k 30 3600 python tools/roofline.py --backend tpu \
+      --json tools/roofline_r5_tpu.json \
+      || echo "[r5b] tpu roofline failed (rc=$?)"
+    echo "[r5b] $(date -u +%T) sequence complete"
+    exit 0
+  fi
+  sleep 180
+done

@@ -1,0 +1,94 @@
+"""tools/profile_hlo_map.py — trace×HLO join that names the time sinks."""
+import gzip
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+_HLO = """\
+HloModule jit_step, entry_computation_layout={(f32[8,8]{1,0})->f32[8,8]{1,0}}
+
+%fused_computation.1 (param_0.1: f32[8,8], param_1.2: f32[8,8]) -> f32[8,8] {
+  %param_0.1 = f32[8,8]{1,0} parameter(0)
+  %param_1.2 = f32[8,8]{1,0} parameter(1)
+  ROOT %dot.9 = f32[8,8]{1,0} dot(%param_0.1, %param_1.2), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+}
+
+%fused_computation.2 (param_0.3: f32[8,8]) -> f32[8] {
+  %param_0.3 = f32[8,8]{1,0} parameter(0)
+  %convert.5 = f32[8,8]{1,0} convert(%param_0.3)
+  %constant.1 = f32[] constant(0)
+  ROOT %reduce.6 = f32[8]{0} reduce(%convert.5, %constant.1), dimensions={1}, to_apply=%add
+}
+
+ENTRY %main (p0: f32[8,8]) -> f32[8,8] {
+  %p0 = f32[8,8]{1,0} parameter(0)
+  %fusion.10 = f32[8,8]{1,0} fusion(%p0, %p0), kind=kOutput, calls=%fused_computation.1
+  %fusion.11 = f32[8]{0} fusion(%fusion.10), kind=kLoop, calls=%fused_computation.2
+  %copy.12 = f32[8,8]{1,0} copy(%fusion.10)
+  ROOT %add.13 = f32[8,8]{1,0} add(%fusion.10, %copy.12)
+}
+"""
+
+
+def _trace(tmp_path):
+    tr = {"traceEvents": [
+        {"ph": "M", "name": "thread_name", "pid": 1, "tid": 7,
+         "args": {"name": "TPU:0 XLA Ops"}},
+        {"ph": "X", "name": "fusion.10", "pid": 1, "tid": 7,
+         "ts": 0.0, "dur": 3000.0},
+        {"ph": "X", "name": "fusion.10", "pid": 1, "tid": 7,
+         "ts": 4000.0, "dur": 3000.0},  # second step: times accumulate
+        {"ph": "X", "name": "fusion.11", "pid": 1, "tid": 7,
+         "ts": 8000.0, "dur": 1000.0},
+        {"ph": "X", "name": "copy.12", "pid": 1, "tid": 7,
+         "ts": 9000.0, "dur": 500.0},
+        {"ph": "X", "name": "ghost.99", "pid": 1, "tid": 7,
+         "ts": 9500.0, "dur": 100.0},  # not in the HLO -> unmatched
+    ]}
+    p = os.path.join(tmp_path, "x.trace.json.gz")
+    with gzip.open(p, "wt") as f:
+        json.dump(tr, f)
+    return p
+
+
+def test_join_names_and_categorizes(tmp_path):
+    import importlib
+
+    phm = importlib.import_module("profile_hlo_map")
+    instrs, comp_ops = phm.parse_hlo(_HLO)
+    assert instrs["fusion.10"]["opcode"] == "fusion"
+    assert instrs["fusion.10"]["calls"] == "%fused_computation.1"
+    assert instrs["fusion.10"]["shape"] == "f32[8,8]"
+    assert comp_ops["%fused_computation.1"]["dot"] == 1
+    assert comp_ops["%fused_computation.2"]["reduce"] == 1
+
+    times = phm.parse_trace_ops(_trace(str(tmp_path)))
+    assert times["fusion.10"] == 6.0  # two occurrences, accumulated (ms)
+
+    out = phm.join(times, instrs, comp_ops, top=10)
+    by_name = {r["name"]: r for r in out["top_ops"]}
+    assert by_name["fusion.10"]["category"] == "matmul/conv"
+    assert by_name["fusion.11"]["category"] == "reduce/stats"
+    assert by_name["copy.12"]["category"] == "copy/layout"
+    assert by_name["ghost.99"]["category"] == "unmatched"
+    # ranked by time: the matmul fusion leads
+    assert out["top_ops"][0]["name"] == "fusion.10"
+    assert out["category_ms"]["matmul/conv"] == 6.0
+    assert out["matched_ops"] == 3 and out["trace_ops"] == 4
+    # >50% matched -> no cross-compile warning
+    assert "warning" not in out
+
+
+def test_unmatched_majority_warns(tmp_path):
+    import importlib
+
+    phm = importlib.import_module("profile_hlo_map")
+    instrs, comp_ops = phm.parse_hlo(_HLO)
+    times = {"ghost.1": 1.0, "ghost.2": 2.0, "ghost.3": 3.0}
+    out = phm.join(times, instrs, comp_ops)
+    assert out["matched_ops"] == 0
+    # main() attaches the warning; emulate its check here
+    assert out["matched_ops"] * 2 < out["trace_ops"]

@@ -188,7 +188,7 @@ def aggregate_sinks(hlo_text, k=5):
     return out
 
 
-def analyze_mode(mode, smoke=False):
+def analyze_mode(mode, smoke=False, save_hlo=None):
     rng = np.random.default_rng(0)
     (step, params, states, batch, units, metric, unit, baseline,
      mfu_fn, _batch_n) = bench._mode_spec(mode, rng, smoke=smoke)
@@ -198,6 +198,15 @@ def analyze_mode(mode, smoke=False):
     t0 = time.time()
     lowered = step.lower(params, states, jnp.int32(1), key, batch)
     compiled = lowered.compile()
+    hlo_text = compiled.as_text()  # many MB; regenerate once, not thrice
+    if save_hlo:
+        # the optimized text carries the backend's OWN fusion names — the
+        # join key tools/profile_hlo_map.py uses to turn a captured
+        # xplane's "fusion.2248 took 2.1ms" into "which op, what shape"
+        os.makedirs(save_hlo, exist_ok=True)
+        with open(os.path.join(save_hlo, "hlo_%s_%s.txt"
+                               % (_BACKEND, mode)), "w") as f:
+            f.write(hlo_text)
     cost = compiled.cost_analysis()
     if isinstance(cost, (list, tuple)):  # older jax returns [dict]
         cost = cost[0]
@@ -215,8 +224,8 @@ def analyze_mode(mode, smoke=False):
         "arithmetic_intensity": round(ai, 2),
         "ceiling_mfu_v5e": round(ceiling_mfu, 4),
         "bound": "compute" if ai >= CRITICAL_INTENSITY else "memory",
-        "top_non_matmul_sinks": top_sinks(compiled.as_text()),
-        "sink_buffers": aggregate_sinks(compiled.as_text()),
+        "top_non_matmul_sinks": top_sinks(hlo_text),
+        "sink_buffers": aggregate_sinks(hlo_text),
         "analysis_seconds": round(time.time() - t0, 1),
     }
     return rec
@@ -225,6 +234,9 @@ def analyze_mode(mode, smoke=False):
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--modes", default=",".join(bench.MODES))
+    ap.add_argument("--save-hlo", default=None, metavar="DIR",
+                    help="save each mode's optimized HLO text to DIR "
+                         "(join key for tools/profile_hlo_map.py)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny shapes (CI); the committed artifact uses "
                     "the real bench shapes")
@@ -280,7 +292,8 @@ def main(argv=None):
             continue
         print("[roofline] analyzing %s..." % mode, flush=True)
         try:
-            out["modes"][mode] = analyze_mode(mode, smoke=args.smoke)
+            out["modes"][mode] = analyze_mode(mode, smoke=args.smoke,
+                                  save_hlo=args.save_hlo)
         except Exception as e:  # record the failure, keep going
             out["modes"][mode] = {"mode": mode, "error": repr(e)}
         m = out["modes"][mode]

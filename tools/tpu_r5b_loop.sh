@@ -40,22 +40,38 @@ sys.exit(0 if (b.get('swept_at') or '') >= '$LOOP_START' else 1)" 2>/dev/null; t
         || { echo "[r5b] sweep failed/wedged (rc=$?); re-probing"; sleep 60; continue; }
     fi
     echo "[r5b] $(date -u +%T) sweep applied; bert headline at default batch 64"
-    BENCH_PROBE_BUDGET_S=600 timeout -k 30 3600 python bench.py bert \
+    BENCH_PROFILE_DIR=/tmp/profile_r5b BENCH_PROBE_BUDGET_S=600 \
+      timeout -k 30 3600 python bench.py bert \
       || { echo "[r5b] headline failed (rc=$?); re-probing"; sleep 60; continue; }
     echo "[r5b] $(date -u +%T) bert512 re-measure (post-sweep gate)"
     BENCH_PROBE_BUDGET_S=300 timeout -k 30 2400 python bench.py bert512 \
       || echo "[r5b] bert512 failed (rc=$?)"
-    echo "[r5b] $(date -u +%T) resnet50 batch sweep"
-    BENCH_PROFILE_DIR=/tmp/profile_r5 BENCH_PROBE_BUDGET_S=300 \
-      timeout -k 30 2400 python bench.py resnet50 --batch=256 \
+    echo "[r5b] $(date -u +%T) resnet50 batch sweep (no profile: --batch=256"
+    echo "      is a different XLA program than the batch-128 HLO roofline saves)"
+    BENCH_PROBE_BUDGET_S=300 timeout -k 30 2400 python bench.py resnet50 --batch=256 \
       || echo "[r5b] resnet50 b256 failed (rc=$?)"
+    echo "[r5b] $(date -u +%T) resnet50 default-batch profile (matches saved HLO)"
+    BENCH_PROFILE_DIR=/tmp/profile_r5b BENCH_PROBE_BUDGET_S=300 \
+      timeout -k 30 2400 python bench.py resnet50 \
+      || echo "[r5b] resnet50 profile run failed (rc=$?)"
     echo "[r5b] $(date -u +%T) ssd512 batch sweep"
     BENCH_PROBE_BUDGET_S=300 timeout -k 30 2400 python bench.py ssd512 --batch=64 \
       || echo "[r5b] ssd512 b64 failed (rc=$?)"
-    echo "[r5b] $(date -u +%T) TPU-compiled roofline (compile-only)"
+    echo "[r5b] $(date -u +%T) TPU-compiled roofline + HLO text (compile-only)"
     timeout -k 30 3600 python tools/roofline.py --backend tpu \
-      --json tools/roofline_r5_tpu.json \
+      --json tools/roofline_r5_tpu.json --save-hlo tools/hlo_tpu \
       || echo "[r5b] tpu roofline failed (rc=$?)"
+    # join the captured profiles with the TPU HLO: the ranked NAMED sink
+    # list for the MFU hunt (same shapes + jax version -> fusion names line
+    # up; the tool warns if the match rate says otherwise)
+    for m in bert resnet50; do
+      if [ -d /tmp/profile_r5b/$m ] && [ -f tools/hlo_tpu/hlo_tpu_$m.txt ]; then
+        timeout -k 30 600 python tools/profile_hlo_map.py \
+          --trace /tmp/profile_r5b/$m --hlo tools/hlo_tpu/hlo_tpu_$m.txt \
+          --json tools/profile_map_r5_$m.json \
+          || echo "[r5b] profile map $m failed (rc=$?)"
+      fi
+    done
     echo "[r5b] $(date -u +%T) sequence complete"
     exit 0
   fi

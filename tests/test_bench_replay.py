@@ -124,6 +124,19 @@ def test_flash_block_artifact_roundtrip(tmp_path):
         fa.MIN_LEN = saved_min
 
 
+def test_shipped_flash_blocks_artifact_loads():
+    """The in-repo artifact (interim since r5) must parse and carry the
+    bench-evidenced gate: min_len 1024 keeps bert512 on the MEASURED-faster
+    dense path until the corrected sweep overwrites the file. A corrupted
+    commit here silently changes production attention routing."""
+    from mxnet_tpu.ops.pallas import flash_attention as fa
+    with open(fa._BLOCKS_ARTIFACT) as f:
+        art = json.load(f)
+    assert "0" in art["blocks"]  # catch-all bucket always present
+    assert fa.MIN_LEN == art.get("min_len")
+    assert fa.BLOCK_DEFAULTS[0] == tuple(art["blocks"]["0"])
+
+
 def test_apply_winners_no_flash_rows_is_noop(tmp_path):
     sys.path.insert(0, os.path.join(REPO, "tools"))
     import importlib

@@ -238,7 +238,11 @@ def build_resnet():
     from mxnet_tpu.gluon.model_zoo.vision import get_resnet
     from mxnet_tpu.parallel import tree_optimizer_step
 
-    net = get_resnet(1, 50, classes=1000)
+    # BENCH_RESNET_S2D=1: MLPerf-style space-to-depth conv0 (identical math,
+    # checkpoint-compatible; see model_zoo _S2DStem). Exploratory — runs
+    # with it set are NOT persisted until it becomes the default.
+    net = get_resnet(1, 50, classes=1000,
+                     stem_s2d=bool(os.environ.get("BENCH_RESNET_S2D")))
     net.initialize()
     # one tiny eager forward materializes deferred param shapes
     from mxnet_tpu import nd as _nd
@@ -659,8 +663,10 @@ def run_mode(mode, results, smoke=False, iters=None, headline=False,
                 mem["peak_bytes_in_use"] / 2**30, 3)
     except Exception:
         pass
+    if mode == "resnet50" and os.environ.get("BENCH_RESNET_S2D"):
+        rec["stem"] = "s2d"  # exploratory config, tagged and not persisted
     if not smoke and batch_override is None and not remat \
-            and rec["platform"] not in ("cpu",):
+            and "stem" not in rec and rec["platform"] not in ("cpu",):
         _save_result(mode, rec)
         results[mode] = rec
     out = dict(rec)

@@ -169,8 +169,31 @@ class BottleneckV2(HybridBlock):
         return x + residual
 
 
+class _S2DStem(HybridBlock):
+    """Drop-in for the ``nn.Conv2D(C0, 7, 2, 3, use_bias=False)`` stem that
+    computes the SAME convolution via ops.space_to_depth_stem_conv (2x2
+    space-to-depth + equivalent 4x4/s1 conv) — the MLPerf-style TPU conv0
+    trick. Parameter name ('weight') and shape (C0, in_c, 7, 7) are
+    identical to the plain conv, so structural save/load keys and the
+    torchvision converter are untouched."""
+
+    def __init__(self, channels, in_channels=3, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(channels, in_channels, 7, 7),
+                allow_deferred_init=True)
+
+    def infer_shape(self, x, *args):
+        self.weight.shape = (self.weight.shape[0], x.shape[1], 7, 7)
+
+    def hybrid_forward(self, F, x, weight):
+        return F.space_to_depth_stem_conv(x, weight)
+
+
 class ResNetV1(HybridBlock):
-    def __init__(self, block, layers, channels, classes=1000, thumbnail=False, **kwargs):
+    def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
+                 stem_s2d=False, **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
         with self.name_scope():
@@ -178,7 +201,9 @@ class ResNetV1(HybridBlock):
             if thumbnail:
                 self.features.add(_conv3x3(channels[0], 1, 0))
             else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False))
+                self.features.add(_S2DStem(channels[0], prefix="conv0_")
+                                  if stem_s2d else
+                                  nn.Conv2D(channels[0], 7, 2, 3, use_bias=False))
                 self.features.add(nn.BatchNorm())
                 self.features.add(nn.Activation("relu"))
                 self.features.add(nn.MaxPool2D(3, 2, 1))
@@ -204,7 +229,8 @@ class ResNetV1(HybridBlock):
 
 
 class ResNetV2(HybridBlock):
-    def __init__(self, block, layers, channels, classes=1000, thumbnail=False, **kwargs):
+    def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
+                 stem_s2d=False, **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
         with self.name_scope():
@@ -213,7 +239,9 @@ class ResNetV2(HybridBlock):
             if thumbnail:
                 self.features.add(_conv3x3(channels[0], 1, 0))
             else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3, use_bias=False))
+                self.features.add(_S2DStem(channels[0], prefix="conv0_")
+                                  if stem_s2d else
+                                  nn.Conv2D(channels[0], 7, 2, 3, use_bias=False))
                 self.features.add(nn.BatchNorm())
                 self.features.add(nn.Activation("relu"))
                 self.features.add(nn.MaxPool2D(3, 2, 1))

@@ -84,3 +84,40 @@ def SpatialTransformer(data, loc, *, target_shape=None, transform_type="affine",
     grid = GridGenerator(loc, transform_type=transform_type,
                          target_shape=target_shape or data.shape[2:])
     return BilinearSampler(data, grid)
+
+
+@register_op("space_to_depth_stem_conv")
+def space_to_depth_stem_conv(x, weight):
+    """conv(kernel 7, stride 2, pad 3, no bias) computed as 2x2
+    space-to-depth + an equivalent 4x4 stride-1 conv — bit-identical math,
+    TPU-shaped: the MXU's input-channel lanes see 12 channels (75%
+    utilization after padding to a multiple of 8) instead of 3 (<=37.5%),
+    the classic MLPerf ResNet conv0 trick. The weight keeps the standard
+    (O, C, 7, 7) layout so checkpoints and torchvision converters are
+    untouched; the reparametrization is a linear gather over the weight,
+    done at trace time, so gradients flow to the standard weight through
+    the same gather's transpose.
+    (ref upstream analogue: none — upstream runs conv0 on cuDNN, which has
+    its own C=3 special path; this is the XLA/TPU-native equivalent.)
+    """
+    B, C, H, W = x.shape
+    O, Cw, KH, KW = weight.shape
+    if (KH, KW) != (7, 7) or H % 2 or W % 2:
+        raise ValueError("space_to_depth_stem_conv is specialized to "
+                         "kernel 7, stride 2, pad 3 on even H/W; got "
+                         "kernel %s on %sx%s" % ((KH, KW), H, W))
+    # z[b, c*4 + py*2 + px, by, bx] = x[b, c, 2*by+py, 2*bx+px]
+    z = x.reshape(B, C, H // 2, 2, W // 2, 2)
+    z = z.transpose(0, 1, 3, 5, 2, 4).reshape(B, C * 4, H // 2, W // 2)
+    # Wp[o, c*4+py*2+px, DB, DX] = W[o, c, 2*DB+py-1, 2*DX+px-1] (0 outside):
+    # output row oy reads block rows oy-2 .. oy+1 (DB in 0..3), and original
+    # row 2*oy-3+ky lands in block oy-2+DB phase py with ky = 2*DB+py-1
+    ky = 2 * jnp.arange(4)[None, :] + jnp.arange(2)[:, None] - 1  # (py, DB)
+    valid = ((ky >= 0) & (ky < 7)).astype(weight.dtype)
+    kyc = jnp.clip(ky, 0, 6)
+    wr = weight[:, :, kyc, :] * valid[None, None, :, :, None]  # (O,C,2,4,7)
+    wrc = wr[:, :, :, :, kyc] * valid[None, None, None, None]  # (O,C,2,4,2,4)
+    wp = wrc.transpose(0, 1, 2, 4, 3, 5).reshape(O, C * 4, 4, 4)
+    return jax.lax.conv_general_dilated(
+        z, wp, window_strides=(1, 1), padding=((2, 1), (2, 1)),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))

@@ -165,3 +165,54 @@ def test_pretrained_raises_clearly():
         get_model("resnet18_v1", pretrained=True)
     net = get_model("resnet18_v1", pretrained=False, classes=4)
     assert net is not None
+
+
+def test_resnet_s2d_stem_matches_plain(tmp_path):
+    """stem_s2d=True computes the IDENTICAL conv0 (space-to-depth
+    reparametrization, ops/spatial.py:space_to_depth_stem_conv) and loads a
+    plain checkpoint unchanged: same structural keys, same weight shape."""
+    from mxnet_tpu.gluon.model_zoo.vision import get_resnet
+
+    plain = get_resnet(1, 18, classes=7)
+    plain.initialize()
+    x = nd.array(np.random.default_rng(0).normal(
+        size=(2, 3, 64, 64)).astype(np.float32))
+    y_plain = plain(x)
+
+    path = str(tmp_path / "p.params")
+    plain.save_parameters(path)
+
+    s2d = get_resnet(1, 18, classes=7, stem_s2d=True)
+    s2d.load_parameters(path)
+    np.testing.assert_allclose(s2d(x).asnumpy(), y_plain.asnumpy(),
+                               rtol=1e-4, atol=1e-5)
+    s2d.hybridize()
+    np.testing.assert_allclose(s2d(x).asnumpy(), y_plain.asnumpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_s2d_stem_op_grad_parity():
+    """Functional parity incl. both grads vs the plain stride-2 conv."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.spatial import space_to_depth_stem_conv
+
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(2, 3, 32, 32)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(8, 3, 7, 7)), jnp.float32)
+    dn = ("NCHW", "OIHW", "NCHW")
+
+    def plain(x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, (2, 2), ((3, 3), (3, 3)), dimension_numbers=dn)
+
+    ct = jnp.arange(16.0)[None, None, :, None]
+    np.testing.assert_allclose(np.asarray(space_to_depth_stem_conv(x, w)),
+                               np.asarray(plain(x, w)), rtol=1e-4, atol=1e-4)
+    for arg in (0, 1):
+        g1 = jax.grad(lambda *a: (space_to_depth_stem_conv(*a) * ct).sum(),
+                      argnums=arg)(x, w)
+        g2 = jax.grad(lambda *a: (plain(*a) * ct).sum(), argnums=arg)(x, w)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=1e-4, atol=1e-3)

@@ -57,11 +57,17 @@ sys.exit(0 if (b.get('swept_at') or '') >= '$LOOP_START' else 1)" 2>/dev/null; t
     echo "[r5b] $(date -u +%T) ssd512 batch sweep"
     BENCH_PROBE_BUDGET_S=300 timeout -k 30 2400 python bench.py ssd512 --batch=64 \
       || echo "[r5b] ssd512 b64 failed (rc=$?)"
-    echo "[r5b] $(date -u +%T) exploration points (bert b96, resnet b192)"
+    echo "[r5b] $(date -u +%T) exploration points (bert b96, resnet b192, resnet s2d)"
     BENCH_PROBE_BUDGET_S=300 timeout -k 30 2400 python bench.py bert --batch=96 \
       || echo "[r5b] bert b96 failed (rc=$?)"
     BENCH_PROBE_BUDGET_S=300 timeout -k 30 2400 python bench.py resnet50 --batch=192 \
       || echo "[r5b] resnet50 b192 failed (rc=$?)"
+    BENCH_RESNET_S2D=1 BENCH_PROBE_BUDGET_S=300 \
+      timeout -k 30 2400 python bench.py resnet50 \
+      || echo "[r5b] resnet50 s2d failed (rc=$?)"
+    BENCH_RESNET_S2D=1 BENCH_PROBE_BUDGET_S=300 \
+      timeout -k 30 2400 python bench.py resnet50 --batch=256 \
+      || echo "[r5b] resnet50 s2d b256 failed (rc=$?)"
     echo "[r5b] $(date -u +%T) TPU-compiled roofline + HLO text (compile-only)"
     timeout -k 30 3600 python tools/roofline.py --backend tpu \
       --json tools/roofline_r5_tpu.json --save-hlo tools/hlo_tpu \

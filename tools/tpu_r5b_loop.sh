@@ -68,6 +68,10 @@ sys.exit(0 if (b.get('swept_at') or '') >= '$LOOP_START' else 1)" 2>/dev/null; t
     BENCH_RESNET_S2D=1 BENCH_PROBE_BUDGET_S=300 \
       timeout -k 30 2400 python bench.py resnet50 --batch=256 \
       || echo "[r5b] resnet50 s2d b256 failed (rc=$?)"
+    for args in "nmt --batch=64" "lstm --batch=128" "ssd512 --batch=48"; do
+      BENCH_PROBE_BUDGET_S=300 timeout -k 30 2400 python bench.py $args \
+        || echo "[r5b] bench $args failed (rc=$?)"
+    done
     echo "[r5b] $(date -u +%T) TPU-compiled roofline + HLO text (compile-only)"
     timeout -k 30 3600 python tools/roofline.py --backend tpu \
       --json tools/roofline_r5_tpu.json --save-hlo tools/hlo_tpu \

@@ -193,6 +193,7 @@ def main():
                 "causal": args.causal, "valid_len": args.valid_len,
                 "iters": args.iters,
                 "platform": jax.devices()[0].platform,
+                "timing": "slope-chained-v2",
                 "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                              time.gmtime())}
         with open(args.json, "w") as f:

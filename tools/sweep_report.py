@@ -26,6 +26,10 @@ def flash_report(path):
     rows = data["rows"]
     print("== flash sweep (%s, measured %s) ==" %
           (data["config"].get("platform"), data["config"].get("measured_at")))
+    if data["config"].get("timing") != "slope-chained-v2":
+        print("   WARNING: artifact predates the relay-safe slope timer "
+              "(r5) — these timings are dispatch-dominated noise; rerun "
+              "tools/flash_sweep.py")
     for seq in sorted({r["seq"] for r in rows}):
         dense = [r for r in rows if r["seq"] == seq and r["kernel"] == "dense"]
         flash = [r for r in rows if r["seq"] == seq and r["kernel"] == "flash"]

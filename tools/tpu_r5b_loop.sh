@@ -20,7 +20,14 @@ exec >>"$LOG" 2>&1
 
 LOOP_START=$(date -u +%FT%TZ)
 echo "[r5b] started $LOOP_START pid $$"
+# stand down before the driver's own end-of-round bench run: concurrent
+# timed work on the one chip would depress BOTH sets of numbers
+DEADLINE=${TPU_LOOP_DEADLINE:-1785612600}  # 2026-08-01T19:30Z
 while true; do
+  if [ "$(date -u +%s)" -gt "$DEADLINE" ]; then
+    echo "[r5b] $(date -u +%T) deadline reached; standing down for the driver"
+    exit 0
+  fi
   echo "[r5b] $(date -u +%T) probing relay..."
   if timeout -k 10 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     while pgrep -f "^[^ ]*python[^ ]* (-m pytest|[^ ]*/pytest)( |$)" >/dev/null 2>&1; do

@@ -23,6 +23,13 @@ echo "[r5b] started $LOOP_START pid $$"
 # stand down before the driver's own end-of-round bench run: concurrent
 # timed work on the one chip would depress BOTH sets of numbers
 DEADLINE=${TPU_LOOP_DEADLINE:-1785612600}  # 2026-08-01T19:30Z
+past_deadline() {
+  if [ "$(date -u +%s)" -gt "$DEADLINE" ]; then
+    echo "[r5b] $(date -u +%T) deadline reached mid-sequence; standing down"
+    return 0
+  fi
+  return 1
+}
 while true; do
   if [ "$(date -u +%s)" -gt "$DEADLINE" ]; then
     echo "[r5b] $(date -u +%T) deadline reached; standing down for the driver"
@@ -50,9 +57,11 @@ sys.exit(0 if (b.get('swept_at') or '') >= '$LOOP_START' else 1)" 2>/dev/null; t
     BENCH_PROFILE_DIR=/tmp/profile_r5b BENCH_PROBE_BUDGET_S=600 \
       timeout -k 30 3600 python bench.py bert \
       || { echo "[r5b] headline failed (rc=$?); re-probing"; sleep 60; continue; }
+    past_deadline && exit 0
     echo "[r5b] $(date -u +%T) bert512 re-measure (post-sweep gate)"
     BENCH_PROBE_BUDGET_S=300 timeout -k 30 2400 python bench.py bert512 \
       || echo "[r5b] bert512 failed (rc=$?)"
+    past_deadline && exit 0
     echo "[r5b] $(date -u +%T) resnet50 batch sweep (no profile: --batch=256"
     echo "      is a different XLA program than the batch-128 HLO roofline saves)"
     BENCH_PROBE_BUDGET_S=300 timeout -k 30 2400 python bench.py resnet50 --batch=256 \
@@ -61,9 +70,11 @@ sys.exit(0 if (b.get('swept_at') or '') >= '$LOOP_START' else 1)" 2>/dev/null; t
     BENCH_PROFILE_DIR=/tmp/profile_r5b BENCH_PROBE_BUDGET_S=300 \
       timeout -k 30 2400 python bench.py resnet50 \
       || echo "[r5b] resnet50 profile run failed (rc=$?)"
+    past_deadline && exit 0
     echo "[r5b] $(date -u +%T) ssd512 batch sweep"
     BENCH_PROBE_BUDGET_S=300 timeout -k 30 2400 python bench.py ssd512 --batch=64 \
       || echo "[r5b] ssd512 b64 failed (rc=$?)"
+    past_deadline && exit 0
     echo "[r5b] $(date -u +%T) exploration points (bert b96, resnet b192, resnet s2d)"
     BENCH_PROBE_BUDGET_S=300 timeout -k 30 2400 python bench.py bert --batch=96 \
       || echo "[r5b] bert b96 failed (rc=$?)"
@@ -79,6 +90,7 @@ sys.exit(0 if (b.get('swept_at') or '') >= '$LOOP_START' else 1)" 2>/dev/null; t
       BENCH_PROBE_BUDGET_S=300 timeout -k 30 2400 python bench.py $args \
         || echo "[r5b] bench $args failed (rc=$?)"
     done
+    past_deadline && exit 0
     echo "[r5b] $(date -u +%T) TPU-compiled roofline + HLO text (compile-only)"
     timeout -k 30 3600 python tools/roofline.py --backend tpu \
       --json tools/roofline_r5_tpu.json --save-hlo tools/hlo_tpu \

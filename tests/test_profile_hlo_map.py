@@ -92,3 +92,26 @@ def test_unmatched_majority_warns(tmp_path):
     assert out["matched_ops"] == 0
     # main() attaches the warning; emulate its check here
     assert out["matched_ops"] * 2 < out["trace_ops"]
+
+
+def test_roofline_backend_spelling(monkeypatch):
+    """--backend must be honored by the import-time env scan in BOTH
+    spellings, and a spelling only argparse sees (main(argv=...) desync)
+    must refuse loudly instead of silently generating a CPU artifact
+    labeled tpu (r5 review finding)."""
+    import importlib
+
+    import pytest
+
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")  # restored on teardown
+    monkeypatch.setattr(sys, "argv", ["roofline.py", "--backend=tpu"])
+    import roofline
+
+    roofline = importlib.reload(roofline)
+    assert roofline._BACKEND == "tpu"
+    with pytest.raises(SystemExit, match="--backend"):
+        roofline.main(["--backend", "cpu", "--modes", "lstm", "--smoke"])
+
+    monkeypatch.setattr(sys, "argv", ["roofline.py", "--backend", "cpu"])
+    roofline = importlib.reload(roofline)
+    assert roofline._BACKEND == "cpu"

@@ -707,6 +707,22 @@ def main():
     if "--cpu" in flags:
         jax.config.update("jax_platforms", "cpu")
     mode = args[0] if args else "bert"
+    if mode == "optstep":
+        # optimizer-step dispatch microbench (fused multi-tensor vs
+        # per-param loop + dispatch counter) — separate from the MODES
+        # table: it measures host dispatch overhead, not model throughput,
+        # and is never persisted/replayed. --smoke/--cpu run the CPU-pinned
+        # --quick variant.
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "opt_step_bench", os.path.join(_REPO, "tools", "opt_step_bench.py"))
+        m = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(m)
+        argv = ["--quick"] if (smoke or "--cpu" in flags) else []
+        if iters := next((f.split("=", 1)[1] for f in flags
+                          if f.startswith("--iters=")), None):
+            argv += ["--iters", iters]
+        raise SystemExit(m.main(argv))
     if mode != "all" and mode not in MODES:
         # validate BEFORE the probe/replay machinery: a typo must abort
         # loudly, never substitute-replay a different mode's record

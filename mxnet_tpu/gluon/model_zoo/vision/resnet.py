@@ -286,6 +286,17 @@ resnet_block_versions = [
 
 
 def get_resnet(version, num_layers, pretrained=False, ctx=None, **kwargs):
+    """(ref: python/mxnet/gluon/model_zoo/vision/resnet.py:get_resnet)
+
+    kwargs reach the ResNetV1/V2 constructor; notable TPU addition:
+
+    - ``stem_s2d=True`` swaps the 7x7/s2 conv0 for the MLPerf-style
+      space-to-depth stem (_S2DStem) — identical math and checkpoint
+      layout, better MXU input-channel utilization. The space-to-depth
+      rewrite needs even input H/W; odd sizes (e.g. 225x225) silently
+      fall back to the plain stride-2 conv for that forward, so every
+      input the plain stem accepts still works.
+    """
     from ..convert import build_with_pretrained
     block_type, layers, channels = resnet_spec[num_layers]
     return build_with_pretrained(

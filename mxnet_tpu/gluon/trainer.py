@@ -3,13 +3,19 @@
 MXNet's Trainer pushes grads into KVStore ('device'/'nccl' → allreduce) and
 applies optimizer updates per parameter. Here:
 
-- single-device: per-param jit-fused updates (each is one XLA kernel);
+- single-device: ALL dense parameters go through one fused multi-tensor
+  optimizer dispatch per step (Optimizer.fused_update — the
+  multi_sgd_update analogue; weights + states donated), with a per-param
+  fallback only for row-sparse/lazy_update leaves;
 - in-mesh data parallel: gradients already arrive psum-reduced when the
   forward/backward ran under ``parallel.build_train_step`` (the compiled path);
-  Trainer.step also supports an explicit ``kvstore`` for API parity.
+  Trainer.step also supports an explicit ``kvstore`` for API parity, and
+  ``set_weight_update_sharding(mesh)`` opts the fused step into ZeRO-1-style
+  cross-replica weight-update sharding (Xu et al., arXiv 2004.13336).
 """
 from __future__ import annotations
 
+import os
 import pickle
 
 from .. import optimizer as opt
@@ -34,6 +40,12 @@ class Trainer:
         self._optimizer.idx2name = {i: p.name for i, p in enumerate(self._params)}
         self._states = {}
         self._scale = self._optimizer.rescale_grad
+        # fused multi-tensor step is the default; MXNET_TPU_FUSED_STEP=0
+        # restores the per-param dispatch loop (debug / bisection hatch)
+        self._fused_opt = os.environ.get("MXNET_TPU_FUSED_STEP", "1") \
+            not in ("0", "false", "no")
+        self._wu_mesh = None
+        self._wu_axis = "dp"
         self._kvstore = None
         if isinstance(kvstore, str) and kvstore not in ("device", "local", None):
             from ..kvstore import create as kv_create
@@ -68,6 +80,16 @@ class Trainer:
     def set_learning_rate(self, lr):
         self._optimizer.set_learning_rate(lr)
 
+    def set_weight_update_sharding(self, mesh, axis="dp"):
+        """Opt-in ZeRO-1-style weight-update sharding (Xu et al., arXiv
+        2004.13336): the fused optimizer step computes each update on a 1/N
+        shard along ``axis`` of ``mesh`` and all-gathers the weights;
+        optimizer state stays sharded across replicas. Meaningful when the
+        params live on the mesh's devices (in-mesh data parallel); pass
+        mesh=None to switch back off."""
+        self._wu_mesh = mesh
+        self._wu_axis = axis
+
     def allreduce_grads(self):
         """Aggregate gradients across devices. In-mesh DP sums inside the
         compiled step via lax.psum (ref kvstore 'device' path:
@@ -89,6 +111,7 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
+        fused_i, fused_w, fused_g, fused_s = [], [], [], []
         for i, p in enumerate(self._params):
             if p._data is None:
                 continue
@@ -98,9 +121,9 @@ class Trainer:
                     continue
                 raise RuntimeError("gradient of %s not attached; call attach_grad/initialize"
                                    % p.name)
-            if getattr(p, "_grad_stype", "default") == "row_sparse" and \
-                    not hasattr(g, "stype") and \
-                    getattr(self._optimizer, "lazy_update", True):
+            sparse_lazy = getattr(p, "_grad_stype", "default") == "row_sparse" \
+                and getattr(self._optimizer, "lazy_update", True)
+            if sparse_lazy and not hasattr(g, "stype"):
                 # Embedding(sparse_grad=True): carry the dense grad as
                 # (rows, values) so the optimizer takes the lazy row path
                 # (ref: gluon/trainer.py sparse pull + SGDUpdateRsp).
@@ -108,7 +131,25 @@ class Trainer:
                 g = dense_to_row_sparse_padded(g)
             if i not in self._states:
                 self._states[i] = self._optimizer.create_state(i, p.data())
-            self._states[i] = self._optimizer.update(i, p.data(), g, self._states[i])
+            if self._fused_opt and not sparse_lazy and not hasattr(g, "stype"):
+                fused_i.append(i)
+                fused_w.append(p.data())
+                fused_g.append(g)
+                fused_s.append(self._states[i])
+            else:
+                # row-sparse / lazy leaves keep the per-param path (the
+                # fused program is dense-only)
+                self._states[i] = self._optimizer.update(i, p.data(), g,
+                                                         self._states[i])
+        if fused_i:
+            # one jitted, donated dispatch for every dense parameter —
+            # states stay keyed by index, so save/load layout is identical
+            # to the per-param path
+            new_states = self._optimizer.fused_update(
+                fused_w, fused_g, fused_s, indices=fused_i,
+                mesh=self._wu_mesh, shard_axis=self._wu_axis)
+            for i, s in zip(fused_i, new_states):
+                self._states[i] = s
 
     def zero_grad(self):
         for p in self._params:

@@ -39,16 +39,28 @@ class KVStore:
 
     def push(self, key, value, priority=0):
         keys, values = _normalize(key, value)
+        batch_k, batch_g = [], []
         for k, v in zip(keys, values):
             agg = _aggregate(v)
             if self._compression is not None:
                 agg = self._compress(k, agg)
             if self._updater is not None:
-                self._updater(k, agg, self._store[k])
+                from .sparse import RowSparseNDArray
+                if isinstance(agg, RowSparseNDArray):
+                    # lazy row path stays per-key (fused program is dense)
+                    self._updater(k, agg, self._store[k])
+                else:
+                    batch_k.append(k)
+                    batch_g.append(agg)
             elif k in self._store:
                 self._store[k]._data = self._store[k]._data + agg._data
             else:
                 self._store[k] = agg.copy()
+        if batch_k:
+            # the whole pushed key batch updates in ONE fused jitted
+            # dispatch (multi_sgd_update analogue) instead of one per key
+            self._updater.batch_call(batch_k, batch_g,
+                                     [self._store[k] for k in batch_k])
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = _normalize(key, out)
@@ -103,6 +115,18 @@ class KVStore:
     def set_optimizer(self, optimizer):
         assert isinstance(optimizer, Optimizer)
         self._updater = get_updater(optimizer)
+
+    def set_weight_update_sharding(self, mesh, axis="dp"):
+        """Opt-in ZeRO-1-style weight-update sharding for the in-mesh
+        'device' mode (Xu et al., arXiv 2004.13336): the fused store-side
+        update runs on 1/N shards along ``axis`` and all-gathers the
+        weights; optimizer state stays sharded across replicas. Call after
+        set_optimizer; pass mesh=None to switch back off."""
+        if self._updater is None:
+            raise RuntimeError("set_optimizer first: weight-update sharding "
+                               "configures the store-side updater")
+        self._updater.wu_mesh = mesh
+        self._updater.wu_axis = axis
 
     def set_gradient_compression(self, compression_params):
         """2-bit gradient compression with error feedback (ref:

@@ -102,10 +102,17 @@ def space_to_depth_stem_conv(x, weight):
     """
     B, C, H, W = x.shape
     O, Cw, KH, KW = weight.shape
-    if (KH, KW) != (7, 7) or H % 2 or W % 2:
+    if (KH, KW) != (7, 7):
         raise ValueError("space_to_depth_stem_conv is specialized to "
-                         "kernel 7, stride 2, pad 3 on even H/W; got "
-                         "kernel %s on %sx%s" % ((KH, KW), H, W))
+                         "kernel 7, stride 2, pad 3; got kernel %s"
+                         % ((KH, KW),))
+    if H % 2 or W % 2:
+        # odd H/W can't 2x2-space-to-depth; fall back to the plain stride-2
+        # conv (same math, without the MXU channel-packing win) so
+        # get_resnet(stem_s2d=True) accepts every size the plain stem does
+        return jax.lax.conv_general_dilated(
+            x, weight, window_strides=(2, 2), padding=((3, 3), (3, 3)),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
     # z[b, c*4 + py*2 + px, by, bx] = x[b, c, 2*by+py, 2*bx+px]
     z = x.reshape(B, C, H // 2, 2, W // 2, 2)
     z = z.transpose(0, 1, 3, 5, 2, 4).reshape(B, C * 4, H // 2, W // 2)

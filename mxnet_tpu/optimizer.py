@@ -20,7 +20,29 @@ from .ndarray import NDArray
 
 __all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "AdaGrad", "AdaDelta",
            "AdaMax", "FTML", "DCASGD", "LARS",
-           "RMSProp", "Ftrl", "LAMB", "Signum", "SGLD", "create", "register"]
+           "RMSProp", "Ftrl", "LAMB", "Signum", "SGLD", "create", "register",
+           "dispatch_counter"]
+
+
+class DispatchCounter:
+    """Counts jitted optimizer-update dispatches: one bump per XLA call into
+    an update program (per-param, row-sparse, or fused multi-tensor). The
+    hook tests and tools/opt_step_bench.py use to assert "one dispatch per
+    Trainer.step" — reset() before the step, read .count after."""
+
+    __slots__ = ("count",)
+
+    def __init__(self):
+        self.count = 0
+
+    def bump(self, n=1):
+        self.count += n
+
+    def reset(self):
+        self.count = 0
+
+
+dispatch_counter = DispatchCounter()
 
 def register(klass):
     """Backed by the generic mx.registry machinery (ref: registry.py) —
@@ -133,6 +155,7 @@ class Optimizer:
         f = getattr(self, "_jit_step", None)
         if f is None:
             f = self._jit_step = jax.jit(self._stepper())
+        dispatch_counter.bump()
         new_w, new_state = f(weight._data, grad._data if isinstance(grad, NDArray) else grad,
                              state, jnp.float32(lr), jnp.float32(wd), jnp.int32(t),
                              jnp.float32(self.rescale_grad))
@@ -180,6 +203,7 @@ class Optimizer:
         f = getattr(self, "_jit_rsp_step", None)
         if f is None:
             f = self._jit_rsp_step = jax.jit(self._rsp_stepper())
+        dispatch_counter.bump()
         new_w, new_state = f(weight._data, grad.indices._data, grad.data._data,
                              state, jnp.float32(lr), jnp.float32(wd), jnp.int32(t),
                              jnp.float32(self.rescale_grad))
@@ -188,6 +212,132 @@ class Optimizer:
 
     def update_multi_precision(self, index, weight, grad, state):
         return self.update(index, weight, grad, state)
+
+    # ------------------------------------------------- fused multi-tensor step
+    def _fused_stepper(self, mesh=None, shard_axis="dp"):
+        """One traced function applying ``_step`` leaf-wise to EVERY
+        parameter — the multi_sgd_update / multi_mp_sgd_update analogue
+        (ref: src/operator/optimizer_op.cc MultiSGDUpdate &co): N per-param
+        XLA dispatches collapse into one program. With ``mesh``, each
+        update additionally runs on a 1/N shard of the replicas along
+        ``shard_axis`` and the updated weights are all-gathered back while
+        optimizer state stays sharded — ZeRO-1-style weight-update sharding
+        (Xu et al., arXiv 2004.13336)."""
+        base = self._stepper()
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            nshard = mesh.shape[shard_axis]
+
+            def _spec(shape):
+                # shard the first axis the replica count divides; tensors
+                # too small to split stay replicated (their update is noise
+                # next to the big ones the paper targets)
+                for d, s in enumerate(shape):
+                    if s >= nshard and s % nshard == 0:
+                        return PartitionSpec(*([None] * d + [shard_axis]))
+                return PartitionSpec()
+
+            def _con(x, spec):
+                return jax.lax.with_sharding_constraint(
+                    x, NamedSharding(mesh, spec))
+
+        def fused(ws, gs, ss, lrs, wds, ts, rescale):
+            new_ws, new_ss = [], []
+            for k, (w, g, s) in enumerate(zip(ws, gs, ss)):
+                if mesh is not None:
+                    spec = _spec(w.shape)
+                    wshape = w.shape
+                    w = _con(w, spec)
+                    g = _con(g, spec)
+                    # weight-shaped state leaves (momenta, masters) shard
+                    # with the weight; odd-shaped leaves stay as they are
+                    s = jax.tree_util.tree_map(
+                        lambda l: _con(l, spec)
+                        if getattr(l, "shape", None) == wshape else l, s)
+                nw, ns = base(w, g, s, lrs[k], wds[k], ts[k], rescale)
+                if mesh is not None:
+                    # all-gather the updated shard back to replicated; the
+                    # state stays sharded across replicas (ZeRO-1's memory
+                    # and weight-update-FLOP saving)
+                    nw = _con(nw, PartitionSpec())
+                new_ws.append(nw)
+                new_ss.append(ns)
+            return new_ws, new_ss
+
+        return fused
+
+    def fused_update(self, params, grads, states, wrappers=None, indices=None,
+                     mesh=None, shard_axis="dp", donate=True):
+        """Apply the update to every parameter in ONE jitted XLA dispatch
+        with weight and state buffers donated. Per-param lr/wd (multipliers
+        included) and update counts enter as traced arrays, so LR schedules
+        and Trainer.step(batch_size) rescale changes never retrace.
+
+        params: list of NDArray weights (updated in place) or raw arrays;
+        ``wrappers`` (optional, same length) receives the new weights when
+        given — NDArray or gluon Parameter entries are written in place.
+        grads / states: lists matching ``params``; returns the new states.
+        indices: per-param keys for lr_mult/wd_mult lookup + update counts
+        (defaults to positions). Caching: one jitted program per
+        (optimizer instance, mesh); jax.jit's signature cache keys the
+        rest by treedef/shapes/dtypes.
+
+        donate=False keeps the input weight buffers alive — required when
+        raw ``._data`` arrays are aliased elsewhere (KVStore.pull hands the
+        store's buffer to ``out``); states are donated either way (the
+        caller always replaces its references with the returned ones)."""
+        n = len(params)
+        if n == 0:
+            return []
+        if indices is None:
+            indices = list(range(n))
+        for i in indices:
+            self._update_count(i)
+        # stacked (N,) arrays, not N scalars: three host->device transfers
+        # per step instead of 3N tiny ones
+        ts = jnp.asarray([self._index_update_count[i] for i in indices],
+                         jnp.int32)
+        lrs = jnp.asarray([self._get_lr(i) for i in indices], jnp.float32)
+        wds = jnp.asarray([self._get_wd(i) for i in indices], jnp.float32)
+        ws = [getattr(w, "_data", w) for w in params]
+        gs = [getattr(g, "_data", g) for g in grads]
+        states = list(states)
+        if mesh is not None:
+            # arrays committed to a single device can't feed a computation
+            # constrained over the mesh — replicate them on first entry
+            # (in-mesh steady state: already on the mesh, no transfer)
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            rep = NamedSharding(mesh, PartitionSpec())
+
+            def _on_mesh(x):
+                sh = getattr(x, "sharding", None)
+                if getattr(sh, "mesh", None) == mesh:
+                    return x
+                return jax.device_put(x, rep)
+
+            ws = [_on_mesh(w) for w in ws]
+            gs = [_on_mesh(g) for g in gs]
+            states = jax.tree_util.tree_map(_on_mesh, states)
+        cache = getattr(self, "_jit_fused", None)
+        if cache is None:
+            cache = self._jit_fused = {}
+        ckey = (None if mesh is None else (mesh, shard_axis), bool(donate))
+        f = cache.get(ckey)
+        if f is None:
+            f = cache[ckey] = jax.jit(
+                self._fused_stepper(mesh, shard_axis),
+                donate_argnums=(0, 2) if donate else (2,))
+        dispatch_counter.bump()
+        new_ws, new_states = f(ws, gs, list(states), lrs, wds, ts,
+                               jnp.float32(self.rescale_grad))
+        for tgt, nw in zip(params if wrappers is None else wrappers, new_ws):
+            if isinstance(tgt, NDArray):
+                tgt._data = nw
+            elif isinstance(getattr(tgt, "_data", None), NDArray):
+                tgt._data._data = nw  # gluon Parameter wrapper
+        return list(new_states)
 
 
 @register
@@ -516,11 +666,29 @@ class Updater:
     def __init__(self, optimizer):
         self.optimizer = optimizer
         self.states = {}
+        # set via KVStore.set_weight_update_sharding (ZeRO-1 opt-in)
+        self.wu_mesh = None
+        self.wu_axis = "dp"
 
     def __call__(self, index, grad, weight):
         if index not in self.states:
             self.states[index] = self.optimizer.create_state(index, weight)
         self.states[index] = self.optimizer.update(index, weight, grad, self.states[index])
+
+    def batch_call(self, indices, grads, weights):
+        """Fused multi-tensor update: the whole key batch in ONE jitted,
+        donated dispatch via Optimizer.fused_update (vs one per key)."""
+        for i, w in zip(indices, weights):
+            if i not in self.states:
+                self.states[i] = self.optimizer.create_state(i, w)
+        # donate=False: KVStore.pull aliases the store's raw buffers into
+        # ``out`` arrays — donating them would invalidate earlier pulls
+        new = self.optimizer.fused_update(
+            list(weights), list(grads), [self.states[i] for i in indices],
+            indices=list(indices), mesh=self.wu_mesh, shard_axis=self.wu_axis,
+            donate=False)
+        for i, s in zip(indices, new):
+            self.states[i] = s
 
 
 def get_updater(optimizer):

@@ -2,7 +2,8 @@
 from .mesh import (make_mesh, named_sharding, replicated, use_mesh,  # noqa: F401
                    current_mesh, shard_array, get_shard_map, P, AXES)
 from .data_parallel import (build_train_step, tree_optimizer_step,  # noqa: F401
-                            replicate_params, shard_batch, block_loss_fn)
+                            replicate_params, shard_batch, block_loss_fn,
+                            weight_update_spec)
 from . import tensor_parallel  # noqa: F401
 from .tensor_parallel import (shard_params, param_specs, constrain,  # noqa: F401
                               psum_region_entry, psum_region_exit)

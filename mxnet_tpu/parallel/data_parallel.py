@@ -59,24 +59,62 @@ class _Box:
         return self._data.shape
 
 
+def weight_update_spec(shape, mesh, axis="dp"):
+    """PartitionSpec sharding the first axis of ``shape`` that the replica
+    count divides (ZeRO-1 weight-update sharding, Xu et al., arXiv
+    2004.13336); replicated when no axis divides."""
+    n = mesh.shape[axis]
+    for d, s in enumerate(shape):
+        if s >= n and s % n == 0:
+            return P(*([None] * d + [axis]))
+    return P()
+
+
 def build_train_step(loss_fn, optimizer, mesh=None, param_spec=None,
-                     batch_spec=None, donate=True, remat=False):
+                     batch_spec=None, donate=True, remat=False,
+                     shard_weight_update=False, shard_axis="dp"):
     """Build ``step(params, states, opt_t, key, batch) -> (params, states, loss)``.
 
     - loss_fn(params, batch, key) -> scalar loss (pure; bf16 inside as desired)
     - mesh: jax Mesh; batch sharded over 'dp' (default), params per param_spec
       (None = replicated; or a pytree/PartitionSpec for fsdp/tp).
     - remat: wrap loss_fn in jax.checkpoint to trade FLOPs for HBM.
+    - shard_weight_update: opt-in ZeRO-1-style cross-replica weight-update
+      sharding (Xu et al., arXiv 2004.13336). The optimizer update is
+      constrained to 1/N shards along ``shard_axis`` — the partitioner turns
+      the gradient all-reduce into reduce-scatter, each replica updates its
+      weight shard, and the updated weights all-gather back; optimizer state
+      stays sharded across replicas between steps (so the first post-build
+      call, which receives replicated states, compiles once more than the
+      steady state). Requires ``mesh``.
     """
     if remat:
         loss_fn = jax.checkpoint(loss_fn)
+    if shard_weight_update and mesh is None:
+        raise ValueError("shard_weight_update=True requires a mesh")
+
+    def _wu_con(x):
+        spec = weight_update_spec(getattr(x, "shape", ()), mesh, shard_axis)
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
 
     def step(params, states, t, key, batch):
         lr = optimizer.learning_rate
         loss, grads = jax.value_and_grad(loss_fn)(params, batch, key)
         _, apply = tree_optimizer_step(optimizer)
-        new_params, new_states = apply(params, grads, states,
-                                       jnp.float32(lr), jnp.float32(optimizer.wd), t)
+        if shard_weight_update:
+            tmap = jax.tree_util.tree_map
+            params_u = tmap(_wu_con, params)
+            grads = tmap(_wu_con, grads)
+            states = tmap(_wu_con, states)
+            new_params, new_states = apply(params_u, grads, states,
+                                           jnp.float32(lr),
+                                           jnp.float32(optimizer.wd), t)
+            new_params = tmap(lambda x: jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P())), new_params)
+        else:
+            new_params, new_states = apply(params, grads, states,
+                                           jnp.float32(lr),
+                                           jnp.float32(optimizer.wd), t)
         return new_params, new_states, loss
 
     if mesh is None:

@@ -33,6 +33,13 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # tier-1 runs with -m 'not slow' (ROADMAP.md); register the marker so
+    # slow-tagged tests deselect cleanly instead of warning
+    config.addinivalue_line("markers",
+                            "slow: multi-second tests excluded from tier-1")
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     np.random.seed(0)

@@ -216,3 +216,29 @@ def test_s2d_stem_op_grad_parity():
         g2 = jax.grad(lambda *a: (plain(*a) * ct).sum(), argnums=arg)(x, w)
         np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
                                    rtol=1e-4, atol=1e-3)
+
+
+def test_s2d_stem_odd_size_falls_back_to_plain_conv():
+    """Odd H/W can't 2x2-space-to-depth; the op must fall back to the plain
+    stride-2 conv so get_resnet(stem_s2d=True) accepts every input size the
+    plain stem does (e.g. 225x225 — ADVICE r5)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.gluon.model_zoo.vision import get_resnet
+    from mxnet_tpu.ops.spatial import space_to_depth_stem_conv
+
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(1, 3, 33, 33)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(8, 3, 7, 7)), jnp.float32)
+    plain = jax.lax.conv_general_dilated(
+        x, w, (2, 2), ((3, 3), (3, 3)),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    np.testing.assert_allclose(np.asarray(space_to_depth_stem_conv(x, w)),
+                               np.asarray(plain), rtol=1e-4, atol=1e-4)
+
+    net = get_resnet(1, 18, classes=4, stem_s2d=True)
+    net.initialize()
+    out = net(nd.array(np.random.default_rng(3).normal(
+        size=(1, 3, 65, 65)).astype(np.float32)))
+    assert out.shape == (1, 4)

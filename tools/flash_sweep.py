@@ -20,6 +20,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+# a slope is only trusted when it exceeds this multiple of the spread
+# across the wall(N) repeats (difference of two best-of-3 minima can be
+# pure relay jitter; ADVICE r5 flash_sweep item)
+NOISE_FLOOR_MULT = 2.0
+
 
 def time_fn(fn, *args, iters=20):
     """Time fn by running `iters` data-chained applications inside ONE jit.
@@ -45,24 +50,31 @@ def time_fn(fn, *args, iters=20):
     def wall(n, repeats=3):
         looped = jax.jit(lambda x0: lax.scan(step, x0, None, length=n)[0])
         np.asarray(looped(args[0]).ravel()[:1])  # compile + warm
-        best = float("inf")
+        times = []
         for _ in range(repeats):
             t0 = time.perf_counter()
             np.asarray(looped(args[0]).ravel()[:1])  # readback = completion
-            best = min(best, time.perf_counter() - t0)
-        return best
+            times.append(time.perf_counter() - t0)
+        return min(times), max(times) - min(times)
 
     # slope timing: wall(2N) - wall(N) cancels the relay's fixed dispatch +
     # readback latency (ms-scale, would swamp a µs-scale seq-128 kernel).
-    # A non-positive slope is relay noise, not a timing — retry once, then
-    # refuse rather than record a bogus ~0 ms row that would win its block
-    # bucket in apply_winners
+    # The slope must not only be positive but exceed a NOISE FLOOR — a
+    # multiple of the spread across the wall() repeats (ADVICE r5): a small
+    # positive slope that is just the difference of two jittery best-of-3
+    # minima would otherwise be recorded and win its block bucket in
+    # apply_winners. Retry once, then refuse rather than record a bogus row.
     for attempt in range(2):
-        slope = wall(2 * iters) - wall(iters)
-        if slope > 0:
+        w1, spread1 = wall(iters)
+        w2, spread2 = wall(2 * iters)
+        slope = w2 - w1
+        floor = NOISE_FLOOR_MULT * max(spread1, spread2)
+        if slope > max(floor, 0.0):
             return slope / iters * 1e3
-    raise RuntimeError("non-positive slope twice (relay noise); "
-                       "config not timed")
+    raise RuntimeError(
+        "slope %.3g s below noise floor %.3g s (= %g x repeat spread) "
+        "twice — relay jitter, not a timing; config not timed"
+        % (slope, floor, NOISE_FLOOR_MULT))
 
 
 def main():

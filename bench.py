@@ -707,15 +707,17 @@ def main():
     if "--cpu" in flags:
         jax.config.update("jax_platforms", "cpu")
     mode = args[0] if args else "bert"
-    if mode == "optstep":
-        # optimizer-step dispatch microbench (fused multi-tensor vs
-        # per-param loop + dispatch counter) — separate from the MODES
-        # table: it measures host dispatch overhead, not model throughput,
-        # and is never persisted/replayed. --smoke/--cpu run the CPU-pinned
+    if mode in ("optstep", "imperative"):
+        # host-dispatch microbenches (fused multi-tensor optimizer step;
+        # lazy bulk imperative chain vs eager) — separate from the MODES
+        # table: they measure host dispatch overhead, not model throughput,
+        # and are never persisted/replayed. --smoke/--cpu run the CPU-pinned
         # --quick variant.
         import importlib.util
+        tool = {"optstep": "opt_step_bench.py",
+                "imperative": "imperative_bench.py"}[mode]
         spec = importlib.util.spec_from_file_location(
-            "opt_step_bench", os.path.join(_REPO, "tools", "opt_step_bench.py"))
+            tool[:-3], os.path.join(_REPO, "tools", tool))
         m = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(m)
         argv = ["--quick"] if (smoke or "--cpu" in flags) else []

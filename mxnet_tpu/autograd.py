@@ -60,6 +60,12 @@ class _RecordScope:
         self._prev = (st.recording, st.training)
         if self._rec is not None:
             if self._rec and not st.recording:
+                # record entry is a sync point for the lazy bulk window:
+                # deferred arrays must materialize BEFORE the tape starts so
+                # every recorded op sees concrete primals (engine.bulk docs)
+                from . import engine
+
+                engine.flush()
                 st.tape = []  # fresh tape per outermost record scope
             st.recording = self._rec
         if self._train is not None:

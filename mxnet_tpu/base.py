@@ -59,6 +59,27 @@ def _freeze(v):
 
 _JIT_CACHE: Dict = {}
 
+# composed-program cache for the lazy bulk window (engine.bulk): one jitted
+# callable per (op-chain topology, static attrs, leaf signatures, output
+# set). Steady-state epochs re-running an identical imperative chain hit the
+# SAME callable object, so jax.jit reuses the compiled executable with zero
+# retrace — the imperative analogue of MXNet's CachedOp handle reuse.
+_BULK_CACHE: Dict = {}
+
+
+def bulk_jitted(key, builder):
+    """Cached jitted composed program for a flushed bulk window. ``key`` is
+    the structural chain key ndarray._flush_window computes; ``builder``
+    returns the pure replay function leaves→outputs, called only on a cache
+    miss (engine.bulk_compile_counter bumps then — the no-recompile hook)."""
+    f = _BULK_CACHE.get(key)
+    if f is None:
+        from .engine import bulk_compile_counter
+
+        bulk_compile_counter.bump()
+        f = _BULK_CACHE[key] = jax.jit(builder())
+    return f
+
 
 def jitted(fn: Callable, static_kwargs: dict, device=None):
     """Return a cached jitted callable of ``fn`` with the given static kwargs
@@ -87,6 +108,10 @@ class OpDef(NamedTuple):
     # tuple-returning ops declare their arity so the symbol builder can
     # mirror it with _item projections (MXNet: nnvm op num_outputs)
     n_outputs: int = 1
+    # precomputed at registration: eligible for the imperative fast/lazy
+    # path (single output, no rng/training-key injection) — one attr read
+    # on the per-op hot loop instead of three
+    fast_ok: bool = True
 
 
 OP_REGISTRY: Dict[str, OpDef] = {}
@@ -97,7 +122,8 @@ def register_op(name=None, array_kwargs=(), needs_rng=False, needs_training=Fals
     def deco(fn):
         opname = name or fn.__name__
         OP_REGISTRY[opname] = OpDef(opname, fn, tuple(array_kwargs), needs_rng, needs_training,
-                                    nondiff, n_outputs)
+                                    nondiff, n_outputs,
+                                    n_outputs == 1 and not needs_rng and not needs_training)
         return fn
 
     return deco

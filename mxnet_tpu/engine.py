@@ -2,11 +2,23 @@
 
 Device-side ordering/async is XLA's job (per-device program order; dispatch is
 asynchronous — MXNet's ThreadedEngine exists to do exactly this for CUDA
-streams). What remains for a host engine is the *host-side* pipeline: decode,
-augment, batching, file IO. That runs on the native C++ dependency engine
-(src/engine_cc/dep_engine.cc) with per-variable RW dependency tracking,
-mirroring ThreadedEngine's Push(fn, const_vars, mutable_vars) API, with a
-Python thread-pool fallback when the .so isn't built.
+streams). Two host-side responsibilities remain:
+
+* the *host-side* pipeline — decode, augment, batching, file IO — on the
+  native C++ dependency engine (src/engine_cc/dep_engine.cc) with
+  per-variable RW dependency tracking, mirroring ThreadedEngine's
+  Push(fn, const_vars, mutable_vars) API, with a Python thread-pool fallback
+  when the .so isn't built;
+* the *bulk window* — the TPU-native equivalent of ThreadedEngine's op
+  bulking (MXNET_ENGINE_BULK_SIZE, ref: src/engine/threaded_engine.cc:
+  BulkAppend). Imperative invocations of fusible ops defer into a lazy
+  expression DAG instead of dispatching one jitted XLA program each; the
+  accumulated chain flushes as ONE composed, cache-keyed program at any
+  sync point (asnumpy/wait_to_read, mutation, autograd.record entry, a
+  non-fusible consumer, or the bulk-size watermark). ndarray.py owns the
+  node type and the flush; this module owns the window, the size knob, and
+  the dispatch counter. ``set_bulk_size(0)`` / ``bulk(0)`` restore pure
+  per-op eager dispatch.
 """
 from __future__ import annotations
 
@@ -16,21 +28,120 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 
 
-_bulk_size = 15  # upstream default (MXNET_ENGINE_BULK_SIZE)
+class DispatchCounter:
+    """Counts real jitted XLA dispatches: one bump per call into a compiled
+    program — imperative op dispatch (ndarray._invoke_impl), a flushed bulk
+    program, or an optimizer-update program (per-param, row-sparse, or fused
+    multi-tensor). The hook tests and tools/*_bench.py use to assert "N ops
+    → 1 dispatch" — reset() before the region, read .count after.
+    (Promoted here from optimizer.py; mxnet_tpu.optimizer.dispatch_counter
+    remains a back-compat alias to this object.)"""
+
+    __slots__ = ("count",)
+
+    def __init__(self):
+        self.count = 0
+
+    def bump(self, n=1):
+        self.count += n
+
+    def reset(self):
+        self.count = 0
+
+
+dispatch_counter = DispatchCounter()
+
+# bumps once per composed bulk-program BUILD (a jit-cache miss in
+# base.bulk_jitted); steady-state epochs re-running an identical chain must
+# not bump it — the "no retrace" assertion tests/test_bulk_engine.py makes
+bulk_compile_counter = DispatchCounter()
+
+
+try:
+    _bulk_size = int(os.environ.get("MXNET_ENGINE_BULK_SIZE", "15"))
+except ValueError:
+    _bulk_size = 15  # upstream default (MXNET_ENGINE_BULK_SIZE)
+
+_bulk_tls = threading.local()
+
+# registered by mxnet_tpu.ndarray at import (avoids an engine→ndarray import
+# cycle): callable flushing the CURRENT THREAD's pending lazy window
+_flush_hook = None
+
+
+class _BulkWindow:
+    """Per-thread deferred-op state. The composed-program cache key is built
+    INCREMENTALLY as nodes are created (ndarray._lazy_invoke classifies every
+    input anyway), so a flush is just hash + cache lookup + one jitted call —
+    the key walk must not be re-done over the whole window on the hot path.
+
+    nodes:     LazyExpr in creation order (creation order IS topo order)
+    leaves:    concrete program inputs (buffers captured at invocation,
+               scalars) — positional args of the composed program
+    leaf_sigs: hashable signature per leaf ((dtype, shape) / scalar type)
+    leaf_ids:  id(buffer) → leaf index (dedup: a fan-out input enters once)
+    key_parts: per-node (opname, static-attrs key, input wiring) tuples
+    """
+
+    __slots__ = ("nodes", "leaves", "leaf_sigs", "leaf_ids", "key_parts")
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        # fresh lists, not in-place clears: a flush in progress may still
+        # hold references to the previous epoch's lists
+        self.nodes = []
+        self.leaves = []
+        self.leaf_sigs = []
+        self.leaf_ids = {}
+        self.key_parts = []
+
+    def __len__(self):
+        return len(self.nodes)
+
+
+def _window():
+    """The current thread's pending lazy-op window. Thread-local like
+    MXNet's per-thread bulk state: loader threads must not interleave
+    their flushes with the training thread's chain."""
+    w = getattr(_bulk_tls, "window", None)
+    if w is None:
+        w = _bulk_tls.window = _BulkWindow()
+    return w
+
+
+def bulk_size():
+    return _bulk_size
+
+
+def flush():
+    """Synchronously execute the current thread's pending lazy window as one
+    composed jitted program (no-op when nothing is pending). Every sync
+    point funnels here."""
+    w = getattr(_bulk_tls, "window", None)
+    if _flush_hook is not None and w is not None and w.nodes:
+        _flush_hook()
 
 
 def set_bulk_size(size):
-    """Returns the PREVIOUS size, like upstream (ref: engine.cc:
-    SetBulkSize). XLA fuses inside jit, so the value is bookkeeping only."""
+    """Set the imperative bulk window size; returns the PREVIOUS size, like
+    upstream (ref: engine.cc:SetBulkSize). size > 0 enables lazy bulk
+    execution of fusible imperative ops (deferred into one composed jitted
+    dispatch per window); size 0 restores pure per-op eager dispatch.
+    Changing the size is a sync point: any pending window flushes first."""
     global _bulk_size
+    flush()
     prev, _bulk_size = _bulk_size, size
     return prev
 
 
 class bulk:
-    """Context manager form (ref: python/mxnet/engine.py:bulk): upstream
-    batches engine pushes inside the scope; XLA's jit fusion already does
-    the equivalent, so this scope only mirrors the API."""
+    """Context manager form (ref: python/mxnet/engine.py:bulk): imperative
+    fusible ops inside the scope defer into a lazy DAG and flush as ONE
+    jitted program at scope exit or any earlier sync point — the
+    ThreadedEngine bulking semantics, realized as XLA program composition.
+    ``bulk(0)`` scopes pure-eager dispatch."""
 
     def __init__(self, size):
         self._size = size
@@ -40,6 +151,7 @@ class bulk:
         return self
 
     def __exit__(self, *a):
+        # scope exit is a sync point (set_bulk_size flushes)
         set_bulk_size(self._prev)
 
 

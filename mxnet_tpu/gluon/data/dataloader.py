@@ -137,12 +137,28 @@ class DataLoader:
         self._batchify_fn = batchify_fn or default_batchify_fn
         self._num_workers = num_workers
         self._mp_pool = None
+        # pin_memory (ref: dataloader.py pin_memory → pinned-memory staging
+        # for fast H2D): here the analogue is eager device placement — the
+        # epoch iterator is wrapped in DevicePrefetcher, so batch N+1's H2D
+        # transfer is issued while the consumer computes on batch N. On a
+        # CPU-only host the device_put is a same-device no-op (harmless).
+        self._pin_memory = pin_memory
         self._prefetch = max(0, prefetch if prefetch is not None else 2 * max(num_workers, 1))
 
     def _make_batch(self, indices):
         return self._batchify_fn([self._dataset[i] for i in indices])
 
     def __iter__(self):
+        if self._pin_memory:
+            from .prefetcher import DevicePrefetcher
+
+            # a generator is its own iterator, and __iter__ builds a fresh
+            # one per epoch, so wrapping it per-call is epoch-safe
+            yield from DevicePrefetcher(self._iter_batches())
+            return
+        yield from self._iter_batches()
+
+    def _iter_batches(self):
         if self._num_workers == 0:
             for indices in self._batch_sampler:
                 yield self._make_batch(indices)

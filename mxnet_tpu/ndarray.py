@@ -14,25 +14,38 @@ src/ndarray/ndarray.cc, python/mxnet/ndarray/ndarray.py). Key mapping:
   underlying immutable buffer — the functional core stays pure for XLA.
 - Under ``autograd.record()`` each invocation stores its ``jax.vjp`` closure on
   the tape (see mxnet_tpu/autograd.py).
+- MXNet's engine op bulking (MXNET_ENGINE_BULK_SIZE, ThreadedEngine
+  BulkAppend) → lazy bulk execution: while ``engine.bulk_size() > 0``
+  (default 15), fusible ops (single-output, no rng/training-key injection,
+  not recording) defer into a ``LazyExpr`` DAG instead of dispatching; the
+  window flushes as ONE composed, cache-keyed jitted program at any sync
+  point — ``asnumpy``/``wait_to_read``/item, any ``_data`` buffer access
+  (mutation, a non-fusible consumer, device queries), ``autograd.record``
+  entry, or the bulk-size watermark. ``shape``/``dtype`` are answered from
+  abstract evaluation without flushing.
 """
 from __future__ import annotations
 
+import functools
 import numbers
+import weakref
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from . import autograd, random
-from .base import OP_REGISTRY, jitted, resolve_dtype
+from . import engine as _engine
+from .base import OP_REGISTRY, _freeze, bulk_jitted, jitted, resolve_dtype
 from .context import Context, current_context
+from .engine import dispatch_counter
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "linspace", "eye", "concat", "stack", "waitall", "invoke"]
 
 
 class NDArray:
-    __slots__ = ("_data", "_grad", "_grad_req", "__weakref__")
+    __slots__ = ("_buf", "_lazy", "_grad", "_grad_req", "__weakref__")
 
     def __init__(self, data, ctx=None):
         if isinstance(data, NDArray):
@@ -43,26 +56,57 @@ class NDArray:
             dev = Context(ctx).jax_device() if not isinstance(ctx, Context) else ctx.jax_device()
             if data.device != dev:
                 data = jax.device_put(data, dev)
-        self._data = data
+        self._lazy = None
+        self._buf = data
         self._grad = None
         self._grad_req = "write"
+
+    # `_data` stays the universal buffer accessor the whole codebase uses,
+    # now lazy-aware: reading it on a deferred array is a sync point (the
+    # pending bulk window flushes as one composed program — see
+    # _flush_window); writing it rebinds to a concrete buffer. This makes
+    # every direct `._data` touch — mutation, out=, copyto, device queries,
+    # a non-fusible op unwrapping its inputs — a correct flush point with no
+    # call-site changes.
+    @property
+    def _data(self):
+        if self._lazy is not None:
+            _flush_window()
+        return self._buf
+
+    @_data.setter
+    def _data(self, value):
+        self._lazy = None
+        self._buf = value
 
     # ------------------------------------------------------------ properties
     @property
     def shape(self):
-        return tuple(self._data.shape)
+        lz = self._lazy
+        if lz is not None:
+            return tuple(lz._aval.shape)
+        return tuple(self._buf.shape)
 
     @property
     def dtype(self):
-        return self._data.dtype
+        lz = self._lazy
+        if lz is not None:
+            return lz._aval.dtype
+        return self._buf.dtype
 
     @property
     def size(self):
-        return int(self._data.size)
+        lz = self._lazy
+        if lz is not None:
+            return int(np.prod(lz._aval.shape, dtype=np.int64))
+        return int(self._buf.size)
 
     @property
     def ndim(self):
-        return self._data.ndim
+        lz = self._lazy
+        if lz is not None:
+            return len(lz._aval.shape)
+        return self._buf.ndim
 
     @property
     def context(self):
@@ -352,6 +396,161 @@ class NDArray:
             "x".join(str(s) for s in self.shape), self.context)
 
 
+# ---------------------------------------------------------------- lazy bulk
+
+
+class LazyExpr:
+    """One deferred fusible op in the engine bulk window: the op's pure
+    functional body plus its wiring. ``specs`` encodes inputs as ints —
+    ``i >= 0`` is the result of window node ``i``, ``~li`` (negative) is
+    window leaf ``li``. Buffers are captured into the window's leaf list at
+    invocation time, so a later in-place rebind of an input NDArray cannot
+    leak forward into an op issued before it — the same ordering MXNet's
+    dependency engine guarantees for reads issued before a write.
+
+    ``_aval`` is inferred at creation through _AVAL_CACHE, so shape/dtype
+    queries on deferred arrays are O(1) and invalid shapes raise at the op
+    call site — the synchronous shape inference MXNet's async engine also
+    guarantees."""
+
+    # Constructed slot-by-slot in invoke (no __init__): the per-op deferral
+    # cost IS the product here, and a call frame is measurable.
+    __slots__ = ("op", "fn", "static", "specs", "ref", "_aval", "_sigid",
+                 "_idx")
+
+    def aval(self):
+        """ShapeDtypeStruct of the result (computed at creation)."""
+        return self._aval
+
+
+_SCALARS = (numbers.Number, np.bool_)
+
+# static-kwarg kinds the lazy path accepts: each freezes (base._freeze) to
+# a hashable cache-key component. bool/int/float/str/tuple literals, axis
+# lists, nested dicts, dtype objects ("float32" arrives as str or np.dtype
+# or a scalar type like np.float32).
+_STATIC_KW_TYPES = (int, float, bool, str, tuple, list, dict, type, np.dtype)
+
+# hot-loop bindings: one global load instead of two attribute chains per op
+_autograd_tls = autograd._tls
+_engine_tls = _engine._bulk_tls
+
+# kept in sync by profiler.start/stop/set_config (profiler._sync_imperative):
+# a single flag read per op instead of two module-attr chains
+_prof_on = False
+
+# Signature interning: a signature — (dtype, shape) for arrays, the
+# python/numpy scalar TYPE for weak-typed scalar leaves — is replaced by a
+# small process-global int everywhere the hot loop touches it (window
+# leaf_sigs, node sigs, aval-cache keys, flush cache keys). Hashing int
+# tuples is several times cheaper than hashing nested dtype tuples, and
+# this runs per op.
+_SIG_IDS = {}
+_SIG_LIST = []
+
+
+def _sig_id(sig):
+    i = _SIG_IDS.get(sig)
+    if i is None:
+        i = _SIG_IDS[sig] = len(_SIG_LIST)
+        _SIG_LIST.append(sig)
+    return i
+
+
+# (op, static-attrs key, input sig-ids) -> (output ShapeDtypeStruct, its
+# sig-id), or None when the combo is not lazily executable (multi-output
+# result — e.g. split/topk whose arity depends on kwargs — or eval_shape
+# raised). One abstract evaluation per distinct combo for the process
+# lifetime; the hot loop pays a dict probe.
+_AVAL_CACHE = {}
+_AVAL_MISS = object()
+
+
+def _infer_aval(opdef, kwargs, in_sig_ids):
+    """Abstract-evaluate one op from input signatures alone (a
+    representative value stands in for scalar leaves: only the type can
+    affect promotion, never the value). Returns the cache entry."""
+    try:
+        sigs = [_SIG_LIST[i] for i in in_sig_ids]
+        ins = [jax.ShapeDtypeStruct(s[1], s[0]) if type(s) is tuple else s(1)
+               for s in sigs]
+        fn = (functools.partial(opdef.fn, **kwargs) if kwargs else opdef.fn)
+        av = jax.eval_shape(fn, *ins)
+    except Exception:
+        return None  # let the eager path raise the real, well-located error
+    if not isinstance(av, jax.ShapeDtypeStruct):
+        return None
+    return (av, _sig_id((av.dtype, tuple(av.shape))))
+
+
+def _flush_window():
+    """Execute the current thread's pending lazy window as ONE composed,
+    jitted, cache-keyed XLA dispatch and bind results to the live output
+    NDArrays. The cache key is (op-chain topology + static attrs, leaf
+    input signatures, live-output set), so a steady-state epoch re-running
+    an identical chain reuses the compiled executable with zero retrace."""
+    w = _engine._window()
+    nodes = w.nodes
+    if not nodes:
+        return
+    leaves = w.leaves
+    outs = []
+    for node in nodes:
+        nd_out = node.ref()
+        if nd_out is not None:
+            outs.append((node._idx, nd_out))
+    key = (tuple(w.key_parts), tuple(w.leaf_sigs),
+           tuple(i for i, _ in outs))
+    w.reset()  # reset first: nothing below may re-enter the same window
+    if not outs:
+        return  # every result died unobserved; pure ops, nothing to run
+
+    if len(nodes) == 1:
+        # degenerate window (op → immediate sync, the common non-chained
+        # pattern): run through the SAME per-op jit cache the eager path
+        # uses — composing would compile a bespoke duplicate of an already
+        # compiled program per call site
+        node = nodes[0]
+        f = jitted(node.fn, node.static)
+        dispatch_counter.count += 1
+        if _prof_on:
+            with _profiler_mod.bulk_scope([node.op]):
+                val = f(*[leaves[~s] for s in node.specs])
+        else:
+            val = f(*[leaves[~s] for s in node.specs])
+        nd_out = outs[0][1]
+        nd_out._buf = val
+        nd_out._lazy = None
+        return
+
+    def builder():
+        steps = [(n.fn, n.static, n.specs) for n in nodes]
+        out_idx = key[2]
+
+        def run(*leaf_vals):
+            env = []
+            for fn, static, specs in steps:
+                vals = [env[s] if s >= 0 else leaf_vals[~s] for s in specs]
+                env.append(fn(*vals, **static) if static else fn(*vals))
+            return tuple(env[i] for i in out_idx)
+
+        return run
+
+    prog = bulk_jitted(key, builder)
+    dispatch_counter.count += 1
+    if _prof_on:
+        with _profiler_mod.bulk_scope([n.op for n in nodes]):
+            results = prog(*leaves)
+    else:
+        results = prog(*leaves)
+    for (_, nd_out), val in zip(outs, results):
+        nd_out._buf = val
+        nd_out._lazy = None
+
+
+_engine._flush_hook = _flush_window
+
+
 # ---------------------------------------------------------------- dispatch
 
 
@@ -366,32 +565,138 @@ def _is_diff(x):
 _FAST_JIT = {}  # opname -> jitted fn (the no-kwargs hot path)
 
 
-_profiler_mod = None  # lazy: profiler imports after ndarray in package init
+_profiler_mod = None  # set by profiler._sync_imperative when it loads
 
 
-def invoke(opname, args, kwargs):
-    """Imperative op invocation: unwrap → (record vjp | cached jit) → wrap.
-    When the profiler runs, each dispatch is recorded as an 'operator' event
-    (ref: MXNet profiler operator events from the engine)."""
-    global _profiler_mod
-    if _profiler_mod is None:
-        # cache the module object: a `from . import` here costs ~1us of
-        # importlib machinery on EVERY op dispatch
-        from . import profiler as _profiler_mod
-    if _profiler_mod._running and _profiler_mod._config["profile_imperative"]:
+def invoke(opname, args, kwargs, _inner=False):
+    """Imperative op invocation: defer into the bulk window, or
+    unwrap → (record vjp | cached jit) → wrap. When the profiler runs, each
+    dispatch is recorded as an 'operator' event (ref: MXNet profiler
+    operator events from the engine); deferred ops record their real cost
+    under the flush's bulk[...] event instead.
+
+    This IS the per-op hot loop (one call per imperative op, the path the
+    Gluon/Module imperative APIs share), so everything — the deferral walk
+    included — runs in this single frame: an extra wrapper frame is
+    ~0.5us/op, and the lazy path's whole budget is a few us. The profiled
+    route re-enters once with ``_inner=True`` to wrap itself in op_scope."""
+    if _prof_on and not _inner:
         with _profiler_mod.op_scope(opname):
-            return _invoke_impl(opname, args, kwargs)
-    return _invoke_impl(opname, args, kwargs)
-
-
-def _invoke_impl(opname, args, kwargs):
+            return invoke(opname, args, kwargs, True)
     opdef = OP_REGISTRY[opname]
-    # fast path: call outside recording — the per-op hot loop (MXNet
-    # equivalent: cached-op handle lookup skipping full FFI parse).
-    # Skipped for rng/training ops (key injection) and multi-output ops.
-    fast = (opdef.n_outputs == 1 and not opdef.needs_rng
-            and not opdef.needs_training and not autograd.is_recording())
+    # fast path: call outside recording (MXNet equivalent: cached-op handle
+    # lookup skipping full FFI parse). Skipped for rng/training ops (key
+    # injection) and multi-output ops (opdef.fast_ok, precomputed at
+    # registration). The recording check is the inlined body of
+    # autograd.is_recording(): this line runs per op.
+    fast = opdef.fast_ok and not getattr(_autograd_tls, "recording", False)
     if fast:
+        if _engine._bulk_size > 0:
+            # ---- lazy bulk deferral (the ThreadedEngine bulking analogue):
+            # record the op into the window instead of dispatching; any
+            # disqualifier (out=/array kwargs, an argument kind the composed
+            # program can't take positionally) falls through to eager.
+            # The walk also builds this node's share of the composed-program
+            # cache key (wiring ints + leaf signatures) — incremental key
+            # construction keeps the flush to hash + lookup + one call.
+            if kwargs:
+                ok = True
+                akw = opdef.array_kwargs
+                for k, v in kwargs.items():
+                    # allowlist of static kwarg kinds that freeze to a
+                    # hashable cache key; arrays (out= aliasing, traced
+                    # kwargs) and exotic objects fall through to eager
+                    if k == "out" or k in akw or not (
+                            v is None or isinstance(v, _STATIC_KW_TYPES)):
+                        ok = False
+                        break
+                static_key = _freeze(kwargs) if ok else None
+            else:
+                ok = True
+                static_key = ()
+            if ok:
+                w = getattr(_engine_tls, "window", None)
+                if w is None:
+                    w = _engine._window()
+                leaves = w.leaves
+                leaf_ids = w.leaf_ids
+                specs = []
+                in_sigs = []
+                for a in args:
+                    t = type(a)
+                    if t is NDArray:
+                        lz = a._lazy
+                        if lz is not None:
+                            specs.append(lz._idx)
+                            in_sigs.append(lz._sigid)
+                            continue
+                        buf = a._buf
+                        li = leaf_ids.get(id(buf))
+                        if li is None:
+                            li = leaf_ids[id(buf)] = len(leaves)
+                            leaves.append(buf)
+                            w.leaf_sigs.append(
+                                _sig_id((buf.dtype, tuple(buf.shape))))
+                        specs.append(~li)
+                        in_sigs.append(w.leaf_sigs[li])
+                    elif t is float or t is int or t is bool \
+                            or isinstance(a, _SCALARS):
+                        # weak-typed traced leaf, interned by (type, value):
+                        # `x * 0.9` twelve times is ONE program argument.
+                        # The VALUE stays out of the cache key (only the
+                        # wiring/dedup pattern enters), so `x * lr` never
+                        # retraces across schedule changes — at worst two
+                        # scalars that happen to collide compile a variant
+                        li = leaf_ids.get((t, a))
+                        if li is None:
+                            li = leaf_ids[(t, a)] = len(leaves)
+                            leaves.append(a)
+                            w.leaf_sigs.append(_sig_id(t))
+                        specs.append(~li)
+                        in_sigs.append(w.leaf_sigs[li])
+                    elif isinstance(a, (jax.Array, np.ndarray)):
+                        li = leaf_ids.get(id(a))
+                        if li is None:
+                            li = leaf_ids[id(a)] = len(leaves)
+                            leaves.append(a)
+                            w.leaf_sigs.append(
+                                _sig_id((a.dtype, tuple(a.shape))))
+                        specs.append(~li)
+                        in_sigs.append(w.leaf_sigs[li])
+                    else:
+                        # bail mid-walk: leaves appended above stay
+                        # interned — unreferenced program args if no later
+                        # node uses them (deterministic, so cache keys stay
+                        # stable); nodes untouched
+                        ok = False
+                        break
+                if ok:
+                    entry = _AVAL_CACHE.get(
+                        akey := (opname, static_key, tuple(in_sigs)),
+                        _AVAL_MISS)
+                    if entry is _AVAL_MISS:
+                        entry = _AVAL_CACHE[akey] = _infer_aval(
+                            opdef, kwargs, in_sigs)
+                if ok and entry is not None:
+                    node = LazyExpr.__new__(LazyExpr)
+                    node.op = opname
+                    node.fn = opdef.fn
+                    node.static = kwargs
+                    node.specs = specs
+                    node._aval, node._sigid = entry
+                    nodes = w.nodes
+                    node._idx = idx = len(nodes)
+                    out = NDArray.__new__(NDArray)
+                    out._buf = None
+                    out._lazy = node
+                    out._grad = None
+                    out._grad_req = "write"
+                    node.ref = weakref.ref(out)
+                    nodes.append(node)
+                    w.key_parts.append((opname, static_key, tuple(specs)))
+                    if idx + 1 >= _engine._bulk_size:
+                        _flush_window()  # watermark: window full, dispatch
+                    return out
         if not kwargs:
             f = _FAST_JIT.get(opname)
             if f is None:
@@ -410,6 +715,7 @@ def _invoke_impl(opname, args, kwargs):
         else:
             f = None
         if f is not None:
+            dispatch_counter.count += 1
             out = f(*[a._data if type(a) is NDArray else a for a in args])
             if isinstance(out, jax.Array):
                 return NDArray(out)
@@ -447,6 +753,7 @@ def _invoke_impl(opname, args, kwargs):
             return fn(*new_args, **kw, **static)
 
         primals = [args[i]._data for i in diff_pos] + [traced_kw[k]._data for k in diff_kw]
+        dispatch_counter.bump()
         out, vjp_fn = jax.vjp(g, *primals)
         outs_flat, treedef = jax.tree_util.tree_flatten(out)
         wrapped = [NDArray(o) for o in outs_flat]
@@ -456,6 +763,7 @@ def _invoke_impl(opname, args, kwargs):
         result = jax.tree_util.tree_unflatten(treedef, wrapped)
     else:
         f = jitted(fn, static)
+        dispatch_counter.bump()
         out = f(*map(_unwrap, args), **{k: _unwrap(v) for k, v in traced_kw.items()})
         result = (NDArray(out) if isinstance(out, jax.Array)
                   else jax.tree_util.tree_map(NDArray, out))
@@ -465,6 +773,11 @@ def _invoke_impl(opname, args, kwargs):
         out_arr._data = src._data
         return out_arr
     return result
+
+
+# pre-promotion internal name (the profiler-off body of invoke); kept for
+# callers/tests that patched or referenced it
+_invoke_impl = invoke
 
 
 def _normalize_key(key):
@@ -593,7 +906,9 @@ def stack(*arrays, axis=0):
 
 def waitall():
     """Block until all launched computations finish (ref:
-    python/mxnet/ndarray/ndarray.py:waitall → engine WaitForAll)."""
+    python/mxnet/ndarray/ndarray.py:waitall → engine WaitForAll). Flushes
+    this thread's pending lazy bulk window first — waitall is a sync point."""
+    _flush_window()
     (jax.device_put(0.0) + 0).block_until_ready()
 
 

@@ -16,33 +16,16 @@ import jax.numpy as jnp
 import numpy as np
 
 from .base import jitted
+# dispatch_counter's home is the engine (it observes EVERY jitted dispatch —
+# imperative ops, bulk flushes, optimizer updates); these names stay
+# importable here for back-compat with pre-promotion callers
+from .engine import DispatchCounter, dispatch_counter
 from .ndarray import NDArray
 
 __all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "AdaGrad", "AdaDelta",
            "AdaMax", "FTML", "DCASGD", "LARS",
            "RMSProp", "Ftrl", "LAMB", "Signum", "SGLD", "create", "register",
            "dispatch_counter"]
-
-
-class DispatchCounter:
-    """Counts jitted optimizer-update dispatches: one bump per XLA call into
-    an update program (per-param, row-sparse, or fused multi-tensor). The
-    hook tests and tools/opt_step_bench.py use to assert "one dispatch per
-    Trainer.step" — reset() before the step, read .count after."""
-
-    __slots__ = ("count",)
-
-    def __init__(self):
-        self.count = 0
-
-    def bump(self, n=1):
-        self.count += n
-
-    def reset(self):
-        self.count = 0
-
-
-dispatch_counter = DispatchCounter()
 
 def register(klass):
     """Backed by the generic mx.registry machinery (ref: registry.py) —

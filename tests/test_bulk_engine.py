@@ -1,0 +1,263 @@
+"""Lazy bulk execution of the imperative path (engine.bulk, ISSUE 2).
+
+Covers the acceptance contract: a K-op fusible chain inside engine.bulk(K)
+executes as exactly ONE XLA dispatch (engine.dispatch_counter), matches
+eager results to <= 1e-6 (bf16 included), flushes correctly at every sync
+point (asnumpy, autograd.record entry, a non-fusible consumer, slice
+assignment, out=, mutation rebinding), reuses the compiled composed program
+with zero recompiles on an identical second chain
+(engine.bulk_compile_counter), and engine.bulk(0) restores pure-eager
+per-op dispatch.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import engine, nd
+
+
+def _chain15(x, a):
+    """15 fusible single-output ops (5 x mul/add/tanh)."""
+    y = x
+    for _ in range(5):
+        y = y * a
+        y = y + 0.5
+        y = y.tanh()
+    return y
+
+
+@pytest.fixture
+def xa():
+    x = nd.array(np.linspace(-2.0, 2.0, 24, dtype=np.float32).reshape(4, 6))
+    a = nd.array(np.full((4, 6), 1.1, np.float32))
+    return x, a
+
+
+def test_15op_chain_is_one_dispatch_with_eager_parity(xa):
+    x, a = xa
+    with engine.bulk(0):
+        ref = _chain15(x, a).asnumpy()
+    engine.dispatch_counter.reset()
+    with engine.bulk(15):
+        y = _chain15(x, a)
+        # the 15th op hits the watermark: the whole chain dispatched as one
+        # composed program before any explicit sync
+        assert engine.dispatch_counter.count == 1
+        out = y.asnumpy()
+    assert engine.dispatch_counter.count == 1  # asnumpy found it concrete
+    np.testing.assert_allclose(out, ref, atol=1e-6, rtol=0)
+
+
+def test_bulk_zero_is_pure_eager(xa):
+    x, a = xa
+    with engine.bulk(0):
+        engine.dispatch_counter.reset()
+        y = _chain15(x, a)
+        assert engine.dispatch_counter.count == 15  # one dispatch per op
+        assert y._lazy is None
+        assert len(engine._window()) == 0
+
+
+def test_watermark_splits_long_chains(xa):
+    x, a = xa
+    with engine.bulk(15):
+        engine.dispatch_counter.reset()
+        y = _chain15(_chain15(x, a), a)  # 30 ops, window 15
+        y.wait_to_read()
+        assert engine.dispatch_counter.count == 2
+
+
+def test_bf16_parity(xa):
+    x, _ = xa
+    xb = x.astype("bfloat16")
+    with engine.bulk(0):
+        ref = ((xb * 2.0 + 0.25).tanh() * xb).asnumpy()
+    with engine.bulk(15):
+        out = ((xb * 2.0 + 0.25).tanh() * xb).asnumpy()
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32), atol=1e-6, rtol=0)
+
+
+def test_shape_dtype_queries_do_not_flush(xa):
+    x, a = xa
+    with engine.bulk(64):
+        engine.dispatch_counter.reset()
+        y = (x * a).sum(axis=0, keepdims=True)
+        # abstract evaluation answers metadata without dispatching
+        assert y.shape == (1, 6)
+        assert y.dtype == np.float32
+        assert y.size == 6
+        assert y.ndim == 2
+        assert y._lazy is not None
+        assert engine.dispatch_counter.count == 0
+        y.wait_to_read()
+        assert engine.dispatch_counter.count == 1
+
+
+def test_flush_on_asnumpy_and_scalar_reads(xa):
+    x, a = xa
+    with engine.bulk(64):
+        y = x * a
+        assert y._lazy is not None
+        y.asnumpy()
+        assert y._lazy is None
+        s = (x * 0.0).sum()
+        assert bool(s == 0.0)  # __bool__ is a sync point
+        assert float((x - x).sum()) == 0.0
+
+
+def test_flush_on_record_entry(xa):
+    x, a = xa
+    with engine.bulk(64):
+        pre = x * 3.0
+        assert pre._lazy is not None
+        with mx.autograd.record():
+            assert pre._lazy is None  # record entry flushed the window
+            x.attach_grad()
+        np.testing.assert_allclose(pre.asnumpy(), x.asnumpy() * 3.0,
+                                   atol=1e-6)
+
+
+def test_record_gradients_through_flushed_inputs(xa):
+    x, _ = xa
+    x.attach_grad()
+    with engine.bulk(64):
+        pre = x * 2.0  # pending when record begins
+        with mx.autograd.record():
+            loss = (pre * x).sum()
+        loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2.0 * x.asnumpy(),
+                               atol=1e-5)
+
+
+def test_flush_on_non_fusible_consumer(xa):
+    x, a = xa
+    with engine.bulk(64):
+        y = x * a
+        assert y._lazy is not None
+        mean, var = nd.moments(y, axes=(0, 1))  # multi-output: not fusible
+        assert y._lazy is None  # consumer unwrapped -> window flushed
+        np.testing.assert_allclose(mean.asnumpy(),
+                                   (x.asnumpy() * a.asnumpy()).mean(),
+                                   atol=1e-6)
+
+
+def test_flush_on_mutation(xa):
+    x, a = xa
+    with engine.bulk(64):
+        y = x * a
+        y[0] = 7.0  # slice-assign is a sync point
+        assert y._lazy is None
+        assert np.all(y.asnumpy()[0] == 7.0)
+
+        z = x * a
+        z += 1.0  # += rebinding is a sync point
+        assert z._lazy is None
+        np.testing.assert_allclose(z.asnumpy(),
+                                   x.asnumpy() * a.asnumpy() + 1.0,
+                                   atol=1e-6)
+
+
+def test_out_kwarg_falls_back_to_eager(xa):
+    x, a = xa
+    dst = nd.zeros((4, 6))
+    with engine.bulk(64):
+        r = nd.add(x, a, out=dst)
+        assert r is dst
+        assert dst._lazy is None
+        np.testing.assert_allclose(dst.asnumpy(),
+                                   x.asnumpy() + a.asnumpy(), atol=1e-6)
+
+
+def test_input_rebinding_after_deferral_keeps_old_value(xa):
+    """An op reads the value its input had WHEN IT WAS ISSUED — the
+    dependency-ordering guarantee MXNet's engine gives reads issued before
+    a write (buffers are captured at invocation)."""
+    x, _ = xa
+    x0 = x.asnumpy()
+    with engine.bulk(64):
+        y = x * 2.0               # captures x's current buffer
+        x._data = nd.zeros((4, 6))._data  # rebind x afterwards
+        np.testing.assert_allclose(y.asnumpy(), x0 * 2.0, atol=1e-6)
+
+
+def test_identical_chain_hits_program_cache(xa):
+    x, a = xa
+
+    def run():
+        return ((x * a + 1.0).tanh() * x).sum().asnumpy()
+
+    with engine.bulk(16):
+        first = run()  # may or may not compile (cache warm from other tests)
+        engine.bulk_compile_counter.reset()
+        engine.dispatch_counter.reset()
+        for _ in range(3):
+            out = run()
+        assert engine.bulk_compile_counter.count == 0  # zero retrace
+        assert engine.dispatch_counter.count == 3      # one dispatch per run
+        np.testing.assert_allclose(out, first, atol=1e-6)
+
+
+def test_scalar_value_change_does_not_recompile(xa):
+    x, _ = xa
+    with engine.bulk(16):
+        ((x * 0.5 + 0.1).tanh()).asnumpy()
+        engine.bulk_compile_counter.reset()
+        out = ((x * 0.25 + 0.3).tanh()).asnumpy()  # same chain, new consts
+        assert engine.bulk_compile_counter.count == 0
+        np.testing.assert_allclose(
+            out, np.tanh(x.asnumpy() * 0.25 + 0.3), atol=1e-6)
+
+
+def test_set_bulk_size_returns_previous_and_flushes(xa):
+    x, a = xa
+    prev = engine.set_bulk_size(33)
+    try:
+        y = x * a
+        assert y._lazy is not None
+        assert engine.set_bulk_size(0) == 33  # size change = sync point
+        assert y._lazy is None
+    finally:
+        engine.set_bulk_size(prev)
+
+
+def test_waitall_flushes():
+    x = nd.array(np.ones((3, 3), np.float32))
+    with engine.bulk(64):
+        y = x * 5.0
+        assert y._lazy is not None
+        nd.waitall()
+        assert y._lazy is None
+        assert np.all(y.asnumpy() == 5.0)
+
+
+def test_transparent_through_mixed_code(xa):
+    """No API change required: a loop mixing fusible chains, reductions,
+    indexing, and host reads produces eager-identical results."""
+    x, a = xa
+
+    def body():
+        y = x
+        acc = 0.0
+        for i in range(4):
+            y = (y * a + 0.1).tanh()
+            row = y[i % 2]
+            acc += float(row.sum())
+        return acc, y.asnumpy()
+
+    with engine.bulk(0):
+        ref_acc, ref_y = body()
+    with engine.bulk(15):
+        acc, yv = body()
+    assert abs(acc - ref_acc) < 1e-4
+    np.testing.assert_allclose(yv, ref_y, atol=1e-6, rtol=0)
+
+
+def test_dispatch_counter_alias_is_engine_counter():
+    from mxnet_tpu import optimizer as opt_mod
+
+    assert opt_mod.dispatch_counter is engine.dispatch_counter
+    engine.dispatch_counter.reset()
+    opt_mod.dispatch_counter.bump(2)
+    assert engine.dispatch_counter.count == 2
+    engine.dispatch_counter.reset()

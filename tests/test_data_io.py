@@ -910,3 +910,65 @@ def test_image_record_uint8_iter(tmp_path):
     with pytest.raises(TypeError, match="normalization"):
         ImageRecordUInt8Iter(path_imgrec=path, data_shape=(3, 8, 8),
                              batch_size=2, mean_r=1.0)
+
+
+def test_dataloader_pin_memory_prefetches_to_device():
+    """pin_memory=True wraps the epoch iterator in DevicePrefetcher: batches
+    arrive as device-placed NDArrays with unchanged values/order (on a CPU
+    host the placement is a same-device no-op)."""
+    from mxnet_tpu import gluon
+
+    xs = mx.nd.array(np.arange(24, dtype=np.float32).reshape(12, 2))
+    ys = mx.nd.array(np.arange(12, dtype=np.float32))
+    ds = gluon.data.ArrayDataset(xs, ys)
+    plain = [b for b in gluon.data.DataLoader(ds, batch_size=4)]
+    pinned_loader = gluon.data.DataLoader(ds, batch_size=4, pin_memory=True)
+    for _ in range(2):  # per-epoch wrapping: iterating twice must work
+        pinned = list(pinned_loader)
+        assert len(pinned) == len(plain) == 3
+        for (px, py), (bx, by) in zip(pinned, plain):
+            np.testing.assert_array_equal(px.asnumpy(), bx.asnumpy())
+            np.testing.assert_array_equal(py.asnumpy(), by.asnumpy())
+
+
+def test_device_prefetcher_device_list_splits_batch():
+    """A device list splits each batch along axis 0 into per-device shards
+    (split_and_load semantics) with transfers issued ahead."""
+    import jax
+
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader, DevicePrefetcher
+
+    devs = jax.devices()[:2]
+    xs = mx.nd.array(np.arange(32, dtype=np.float32).reshape(16, 2))
+    ds = ArrayDataset(xs)
+    loader = DataLoader(ds, batch_size=8)
+    out = list(DevicePrefetcher(loader, ctx=list(devs)))
+    assert len(out) == 2
+    for bi, shards in enumerate(out):
+        assert isinstance(shards, list) and len(shards) == len(devs)
+        whole = np.concatenate([s.asnumpy() for s in shards], axis=0)
+        np.testing.assert_array_equal(
+            whole, xs.asnumpy()[bi * 8:(bi + 1) * 8])
+        for s, d in zip(shards, devs):
+            assert s._data.device == d
+
+
+def test_device_prefetcher_named_sharding():
+    """A NamedSharding target yields ONE global array laid out across the
+    mesh — the input convention of pjit-style data-parallel steps."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader, DevicePrefetcher
+
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs, ("dp",))
+    sharding = NamedSharding(mesh, PartitionSpec("dp"))
+    xs = mx.nd.array(np.arange(64, dtype=np.float32).reshape(16, 4))
+    loader = DataLoader(ArrayDataset(xs), batch_size=8)
+    out = list(DevicePrefetcher(loader, ctx=sharding))
+    assert len(out) == 2
+    for bi, batch in enumerate(out):
+        assert batch._data.sharding.is_equivalent_to(sharding, batch.ndim)
+        np.testing.assert_array_equal(
+            batch.asnumpy(), xs.asnumpy()[bi * 8:(bi + 1) * 8])

@@ -29,6 +29,11 @@ class TraceContext:
         self.training = training
         self.counter = 0
         self.state_updates = {}  # param full-name -> new value (BN running stats)
+        # per-trace scratch for blocks that cache traced values across calls
+        # WITHIN one trace (variational dropout masks, zoneout prev-output).
+        # Storing those on ``self`` instead leaks a dead tracer into the
+        # next trace (graphlint GL003); scratch dies with the trace.
+        self.scratch = {}
 
     def next_key(self):
         self.counter += 1

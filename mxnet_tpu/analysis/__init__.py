@@ -1,0 +1,29 @@
+"""Static + trace-time tracing-hygiene analysis (graphlint).
+
+The paper's premise — MXNet's imperative/hybrid API running TPU-native —
+holds only while the hot path stays inside one jitted XLA program. The last
+two PRs (fused optimizer step, lazy bulk engine) each spent most of their
+effort hand-hunting the same hazard classes: hidden host syncs, per-step
+retraces, tracer leaks, donated-buffer reuse. Relay-style compilers make
+this a *pass*, not a vigil (TVM arXiv:1802.04799; Relay arXiv:1810.00952,
+whose typed IR exists to catch graph invalidity before execution).
+``graphlint`` is that pass for mxnet_tpu's own Python:
+
+* **Stage 1 (static)** — :mod:`.graphlint` walks source ASTs and flags rule
+  classes with stable IDs GL001–GL006 (see ``RULES``). Run it via
+  ``python tools/graphlint.py mxnet_tpu --ci``; the tier-1 suite runs it
+  over the package itself against ``tools/graphlint_allow.json``.
+* **Stage 2 (trace-time)** — :func:`check_hybridizable` /
+  ``Block.hybridize(validate=True)`` trace a block with the engine's
+  dispatch/compile counters armed and *prove* what static analysis can only
+  suspect: actual host readbacks mid-trace (GL101), per-call-varying
+  constants that retrace or go stale (GL102), constant-folded/dead
+  parameters (GL103), data-dependent Python control flow (GL104).
+"""
+from .graphlint import (Finding, RULES, lint_paths, lint_source,
+                        load_allowlist, split_allowed, format_findings)
+from .validate import GraphlintError, check_hybridizable
+
+__all__ = ["Finding", "RULES", "lint_paths", "lint_source", "load_allowlist",
+           "split_allowed", "format_findings", "GraphlintError",
+           "check_hybridizable"]

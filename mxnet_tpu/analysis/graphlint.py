@@ -1,0 +1,670 @@
+"""graphlint stage 1: AST-based tracing-hygiene linter.
+
+Flags the hazard classes that break the "hot path = one XLA program"
+invariant, with stable rule IDs:
+
+GL001  host sync inside a hybridizable/jitted region — ``.asnumpy()``,
+       ``.asscalar()``, ``.wait_to_read()``, ``float()/int()/bool()/.item()``
+       on array values, ``np.asarray``/``np.array`` on traced values. Each
+       is a device→host readback: under trace it either crashes
+       (ConcretizationTypeError) or, worse, silently bakes a constant.
+GL002  retrace hazard — a fresh ``jax.jit`` of a lambda/local function
+       invoked per call (new fn identity every call ⇒ recompile every
+       call), or a set materialized to tuple/list without ``sorted`` (set
+       iteration order feeding a cache key varies across processes).
+GL003  tracer leak — assigning values derived from traced inputs to
+       ``self.*`` or module globals inside a hybridizable region; the
+       stored tracer outlives the trace and poisons the next call.
+GL004  data-dependent Python control flow — ``if``/``while`` on values
+       derived from traced arrays inside a hybridizable region; under
+       trace this forces a host sync (or a TracerBoolConversionError).
+       Shape/dtype/None tests are static and exempt.
+GL005  use-after-donation — reusing a variable after passing it at a
+       donated position of a ``donate_argnums`` callable; the buffer may
+       already be aliased to an output.
+GL006  unbounded module-level cache dict — a module-level ``{}`` that
+       functions insert into with no eviction/cap in sight; long-running
+       serving processes grow it without bound.
+
+A *hybridizable/jitted region* is: any ``hybrid_forward`` body; any
+function decorated with ``jax.jit``/``partial(jax.jit, ...)``; any
+function passed (by name, in the same module) to a tracing entry point
+(``jax.jit``, ``base.jitted``, ``base.bulk_jitted``'s builder result,
+``jax.grad``/``vjp``/``eval_shape``/``make_jaxpr``); and lambdas handed
+to those entry points inline.
+
+Suppression: append ``# graphlint: disable=GLnnn`` to the flagged line
+for one-off exemptions; repo-wide policy exemptions belong in the
+committed allowlist (``tools/graphlint_allow.json``) with a ``why``.
+
+Output is deterministic: findings sort by (path, line, rule) so CI diffs
+and the allowlist stay stable.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, List, NamedTuple, Optional, Set, Tuple
+
+RULES = {
+    "GL001": "host sync inside hybridizable/jitted region",
+    "GL002": "retrace hazard (per-call jit identity / unordered cache key)",
+    "GL003": "tracer leak (traced value stored on self/global in region)",
+    "GL004": "data-dependent Python control flow in hybridizable region",
+    "GL005": "use after donation (donate_argnums argument reused)",
+    "GL006": "unbounded module-level cache dict",
+}
+
+# attribute reads that are static under trace (answered from the aval, never
+# a host readback) — they scrub taint
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "context", "ctx", "stype",
+                 "name", "prefix"}
+
+# calls whose result is host-static even on traced operands
+_SCRUB_CALLS = {"len", "isinstance", "getattr", "hasattr", "type", "id",
+                "range", "enumerate", "zip"}
+
+_SYNC_ATTRS = {"asnumpy", "asscalar", "wait_to_read"}
+_NP_NAMES = {"np", "numpy", "onp"}
+
+# tracing entry points: callable-name -> index of the traced-fn argument
+_TRACE_ENTRY_ARG = {
+    "jit": 0, "pjit": 0, "jitted": 0, "grad": 0, "value_and_grad": 0,
+    "vjp": 0, "jvp": 0, "linearize": 0, "eval_shape": 0, "make_jaxpr": 0,
+    "checkpoint": 0, "remat": 0, "vmap": 0, "pmap": 0, "scan": 0,
+    "bulk_jitted": 1,
+}
+_JIT_NAMES = {"jit", "pjit", "jitted"}
+
+
+class Finding(NamedTuple):
+    path: str
+    line: int
+    rule: str
+    msg: str
+    scope: str  # enclosing def qualname, or the cache name for GL006
+
+    @property
+    def key(self) -> str:
+        """Stable allowlist identity: survives line-number churn."""
+        return "%s::%s::%s" % (self.path, self.rule, self.scope)
+
+    def render(self) -> str:
+        return "%s:%d: %s %s [%s]" % (self.path, self.line, self.rule,
+                                      self.msg, self.scope)
+
+
+def _call_name(func: ast.AST) -> Optional[str]:
+    """Trailing name of a call target: jax.jit -> 'jit', jitted -> 'jitted'."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    # @register_op(...)-decorated functions are the op registry's pure
+    # bodies: every one of them executes under jax.jit (imperative dispatch,
+    # bulk composition, hybridize traces) — all are traced regions
+    if _call_name(dec) in _JIT_NAMES or _call_name(dec) == "register_op":
+        return True
+    if isinstance(dec, ast.Call):
+        # @partial(jax.jit, ...) / @functools.partial(jit, static_argnums=..)
+        if _call_name(dec.func) == "partial" and dec.args \
+                and _call_name(dec.args[0]) in _JIT_NAMES:
+            return True
+        if _call_name(dec.func) in (_JIT_NAMES | {"register_op"}):
+            return True
+    return False
+
+
+def _disabled_rules(src_lines: List[str], line: int) -> Set[str]:
+    """Rules suppressed by a ``# graphlint: disable=GL001,GL002`` comment."""
+    if not (1 <= line <= len(src_lines)):
+        return set()
+    text = src_lines[line - 1]
+    marker = "graphlint: disable="
+    i = text.find(marker)
+    if i < 0:
+        return set()
+    return {r.strip() for r in text[i + len(marker):].split(",")
+            if r.strip().startswith("GL")}
+
+
+class _Taint:
+    """Linear (source-order) intraprocedural taint over names derived from a
+    region's traced inputs. Deliberately coarse — a linter, not an abstract
+    interpreter: one pass, no branch sensitivity."""
+
+    def __init__(self, seeds: Set[str]):
+        self.names = set(seeds)
+
+    def expr(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.names
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self.expr(node.value)
+        if isinstance(node, ast.Call):
+            name = _call_name(node.func)
+            if name in _SCRUB_CALLS:
+                return False
+            if self.expr(node.func):
+                return True
+            return any(self.expr(a) for a in node.args) or \
+                any(self.expr(k.value) for k in node.keywords)
+        if isinstance(node, ast.Compare):
+            # `x is None` / `x is not None` are static guards, not data flow
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False
+            return self.expr(node.left) or any(self.expr(c)
+                                               for c in node.comparators)
+        if isinstance(node, (ast.BinOp,)):
+            return self.expr(node.left) or self.expr(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.expr(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.expr(v) for v in node.values)
+        if isinstance(node, ast.Subscript):
+            return self.expr(node.value)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.expr(e) for e in node.elts)
+        if isinstance(node, ast.IfExp):
+            return self.expr(node.body) or self.expr(node.orelse)
+        if isinstance(node, ast.Starred):
+            return self.expr(node.value)
+        return False
+
+    def assign(self, target: ast.AST):
+        if isinstance(target, ast.Name):
+            self.names.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self.assign(e)
+
+
+class _ModuleLint:
+    def __init__(self, tree: ast.Module, path: str, src: str):
+        self.tree = tree
+        self.path = path
+        self.src_lines = src.splitlines()
+        self.findings: List[Finding] = []
+        self.region_names = self._collect_region_names()
+
+    # ------------------------------------------------------------ plumbing
+    def add(self, node: ast.AST, rule: str, msg: str, scope: str):
+        line = getattr(node, "lineno", 0)
+        if rule in _disabled_rules(self.src_lines, line):
+            return
+        self.findings.append(Finding(self.path, line, rule, msg, scope))
+
+    # ---------------------------------------------------- region discovery
+    def _collect_region_names(self) -> Set[str]:
+        """Names of functions handed (by name) to a tracing entry point
+        anywhere in the module — their bodies are traced regions."""
+        names: Set[str] = set()
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            idx = _TRACE_ENTRY_ARG.get(_call_name(node.func) or "")
+            if idx is None or len(node.args) <= idx:
+                continue
+            target = node.args[idx]
+            if isinstance(target, ast.Name):
+                names.add(target.id)
+        return names
+
+    def _is_region(self, fn: ast.AST) -> bool:
+        if isinstance(fn, ast.Lambda):
+            return False  # lambdas handled at their trace-entry call site
+        if fn.name == "hybrid_forward":
+            return True
+        if any(_is_jit_decorator(d) for d in fn.decorator_list):
+            return True
+        return fn.name in self.region_names
+
+    # ------------------------------------------------------------ top level
+    def run(self) -> List[Finding]:
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if self._is_region(node):
+                    self._check_region(node)
+                self._check_donation(node)
+            if isinstance(node, ast.Call):
+                self._check_percall_jit(node)
+            if isinstance(node, ast.Call) and _call_name(node.func) in (
+                    "tuple", "list") and node.args:
+                self._check_unordered_key(node)
+        self._check_module_caches()
+        self.findings.sort(key=lambda f: (f.path, f.line, f.rule, f.msg))
+        return self.findings
+
+    # ------------------------------------------------- GL001/GL003/GL004
+    def _region_seeds(self, fn) -> Set[str]:
+        """Traced-input names of a region. Positional args are traced;
+        keyword-only args are STATIC by this codebase's convention (OpDef
+        attrs / ``base.jitted`` static kwargs close over them) — except
+        ``hybrid_forward``, whose ``**params`` kwargs are parameter arrays,
+        and ``register_op(array_kwargs=...)`` declarations."""
+        args = fn.args
+        ordered = [a.arg for a in args.posonlyargs + args.args]
+        skip = {"self", "cls"}
+        if fn.name == "hybrid_forward" and len(ordered) >= 2:
+            skip.add(ordered[1])  # F — the functional facade, not an array
+        is_op = any(_call_name(d if not isinstance(d, ast.Call) else d.func)
+                    == "register_op" for d in fn.decorator_list)
+        if is_op and args.defaults:
+            # registered ops: mandatory positional params are the array
+            # inputs; defaulted ones are op attrs, passed as (static)
+            # kwargs by the dispatcher
+            skip.update(ordered[-len(args.defaults):])
+        seeds = {a for a in ordered if a not in skip}
+        if args.vararg:
+            seeds.add(args.vararg.arg)
+        if fn.name == "hybrid_forward":
+            seeds.update(a.arg for a in args.kwonlyargs)
+            if args.kwarg:
+                seeds.add(args.kwarg.arg)  # **params are parameter arrays
+        for dec in fn.decorator_list:
+            if isinstance(dec, ast.Call) and \
+                    _call_name(dec.func) == "register_op":
+                for kw in dec.keywords:
+                    if kw.arg == "array_kwargs":
+                        try:
+                            seeds.update(ast.literal_eval(kw.value))
+                        except ValueError:
+                            pass
+        return seeds
+
+    def _check_region(self, fn):
+        scope = fn.name
+        taint = _Taint(self._region_seeds(fn))
+        globals_declared: Set[str] = set()
+
+        # one linear pass in source order: propagate taint, then check each
+        # statement's hazards against the taint known so far
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                globals_declared.update(node.names)
+
+        # propagate to fixpoint (ast.walk is BFS, not source order; a couple
+        # of sweeps make chained assignments converge regardless)
+        for _ in range(4):
+            before = len(taint.names)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    if taint.expr(node.value):
+                        for t in node.targets:
+                            taint.assign(t)
+                elif isinstance(node, ast.AugAssign):
+                    if taint.expr(node.value) or taint.expr(node.target):
+                        taint.assign(node.target)
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    if taint.expr(node.value):
+                        taint.assign(node.target)
+                elif isinstance(node, ast.For):
+                    if taint.expr(node.iter):
+                        taint.assign(node.target)
+            if len(taint.names) == before:
+                break
+
+        for node in ast.walk(fn):
+            # ---- GL001: host syncs
+            if isinstance(node, ast.Call):
+                name = _call_name(node.func)
+                if isinstance(node.func, ast.Attribute):
+                    if node.func.attr in _SYNC_ATTRS:
+                        self.add(node, "GL001",
+                                 ".%s() is a host readback inside a traced "
+                                 "region" % node.func.attr, scope)
+                    elif node.func.attr == "item" and taint.expr(node.func.value):
+                        self.add(node, "GL001",
+                                 ".item() on a traced value is a host "
+                                 "readback", scope)
+                    elif (node.func.attr in ("asarray", "array")
+                          and isinstance(node.func.value, ast.Name)
+                          and node.func.value.id in _NP_NAMES
+                          and any(taint.expr(a) for a in node.args)):
+                        self.add(node, "GL001",
+                                 "np.%s() on a traced value forces device→"
+                                 "host transfer" % node.func.attr, scope)
+                elif name in ("float", "int", "bool") and node.args \
+                        and any(taint.expr(a) for a in node.args):
+                    self.add(node, "GL001",
+                             "%s() on a traced value is a host readback "
+                             "(concretizes the tracer)" % name, scope)
+            # ---- GL003: tracer leaks
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                value = node.value
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                if taint.expr(value):
+                    for t in targets:
+                        base = t
+                        while isinstance(base, ast.Subscript):
+                            base = base.value
+                        if isinstance(base, ast.Attribute) and \
+                                isinstance(base.value, ast.Name) and \
+                                base.value.id == "self":
+                            self.add(node, "GL003",
+                                     "traced value stored on self.%s escapes "
+                                     "the trace" % base.attr, scope)
+                        elif isinstance(base, ast.Name) and \
+                                base.id in globals_declared:
+                            self.add(node, "GL003",
+                                     "traced value stored in module global "
+                                     "%r escapes the trace" % base.id, scope)
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("append", "extend", "add") and \
+                    any(taint.expr(a) for a in node.args):
+                base = node.func.value
+                if isinstance(base, ast.Attribute) and \
+                        isinstance(base.value, ast.Name) and \
+                        base.value.id == "self":
+                    self.add(node, "GL003",
+                             "traced value appended to self.%s escapes the "
+                             "trace" % base.attr, scope)
+            # ---- GL004: data-dependent control flow
+            if isinstance(node, (ast.If, ast.While)) and taint.expr(node.test):
+                self.add(node, "GL004",
+                         "%s on a traced value forces a host sync per step "
+                         "(use F.where / lax.cond-style ops)"
+                         % ("while" if isinstance(node, ast.While) else "if"),
+                         scope)
+
+    # ------------------------------------------------------------- GL002
+    def _enclosing_scope(self, node) -> str:
+        spans = getattr(self, "_fn_spans", None)
+        if spans is None:
+            spans = self._fn_spans = [
+                (fn.lineno, getattr(fn, "end_lineno", fn.lineno) or fn.lineno,
+                 fn.name)
+                for fn in ast.walk(self.tree)
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        best = "<module>"
+        for start, end, name in spans:
+            if start <= node.lineno <= max(start, end):
+                best = name  # innermost wins: defs walk outer→inner
+        return best
+
+    def _check_percall_jit(self, node: ast.Call):
+        """``jax.jit(lambda ...)(x)`` / ``jax.jit(local_fn)(x)`` invoked
+        immediately inside a function: the wrapped callable has a fresh
+        identity per call, so every invocation retraces AND recompiles
+        (jax's jit cache keys on fn identity). ``base.jitted`` is exempt —
+        caching per (fn, static, device) is exactly its job."""
+        inner = node.func
+        if not isinstance(inner, ast.Call):
+            return
+        if _call_name(inner.func) not in ("jit", "pjit") or not inner.args:
+            return
+        target = inner.args[0]
+        scope = self._enclosing_scope(node)
+        if scope == "<module>":
+            return  # module-level one-shot jit compiles once per process
+        if isinstance(target, ast.Lambda):
+            self.add(node, "GL002",
+                     "jit(lambda)(…) builds a fresh jitted callable per "
+                     "call — every invocation retraces; hoist and cache it",
+                     scope)
+        elif isinstance(target, ast.Name) and \
+                target.id in self._local_bindings(scope):
+            self.add(node, "GL002",
+                     "jit(%s)(…) where %r is a per-call local binding — "
+                     "fresh fn identity every call means a retrace + "
+                     "recompile per call; cache the jitted callable"
+                     % (target.id, target.id), scope)
+
+    def _local_bindings(self, scope: str) -> Set[str]:
+        """Names bound inside function ``scope`` (assignments + nested
+        defs) — jit-wrapping these per call defeats jax's fn-identity
+        cache."""
+        cached = getattr(self, "_local_bind_cache", None)
+        if cached is None:
+            cached = self._local_bind_cache = {}
+        if scope in cached:
+            return cached[scope]
+        names: Set[str] = set()
+        for fn in ast.walk(self.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                    fn.name == scope:
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Name) and \
+                            isinstance(node.ctx, ast.Store):
+                        names.add(node.id)
+                    elif isinstance(node, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)) \
+                            and node is not fn:
+                        names.add(node.name)
+        cached[scope] = names
+        return names
+
+    def _check_unordered_key(self, node: ast.Call):
+        """tuple(<set>) / list(<set>): set iteration order is not a stable
+        cache-key component (varies across processes/hash seeds)."""
+        arg = node.args[0]
+        is_set = isinstance(arg, ast.Set) or (
+            isinstance(arg, ast.Call) and _call_name(arg.func) == "set")
+        if is_set:
+            self.add(node, "GL002",
+                     "%s() over a set has nondeterministic order — sort "
+                     "before using it in a cache key or static arg"
+                     % _call_name(node.func),
+                     self._enclosing_scope(node))
+
+    # ------------------------------------------------------------- GL005
+    def _donating_names(self, fn) -> Dict[str, Tuple[int, ...]]:
+        """name -> donated positional indices, for names bound (module- or
+        function-level) to jit(..., donate_argnums=...) results. The
+        module-level scan runs once and is cached (linting is O(files), not
+        O(files × functions))."""
+        module_names = getattr(self, "_module_donating", None)
+        if module_names is None:
+            module_names = self._module_donating = \
+                self._scan_donating(self.tree)
+        out = dict(module_names)
+        out.update(self._scan_donating(fn))
+        return out
+
+    @staticmethod
+    def _scan_donating(scope) -> Dict[str, Tuple[int, ...]]:
+        out: Dict[str, Tuple[int, ...]] = {}
+        for node in ast.walk(scope):
+            if not isinstance(node, ast.Assign) or \
+                    not isinstance(node.value, ast.Call):
+                continue
+            call = node.value
+            if _call_name(call.func) not in _JIT_NAMES:
+                continue
+            donated: Optional[Tuple[int, ...]] = None
+            for kw in call.keywords:
+                if kw.arg == "donate_argnums":
+                    try:
+                        v = ast.literal_eval(kw.value)
+                    except ValueError:
+                        continue
+                    if isinstance(v, int):
+                        donated = (v,)
+                    elif isinstance(v, (tuple, list)):
+                        donated = tuple(int(i) for i in v)
+            if not donated:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = donated
+        return out
+
+    def _check_donation(self, fn):
+        donating = self._donating_names(fn)
+        if not donating:
+            return
+        # (donated name, call line) events, then loads/stores by line
+        events: List[Tuple[str, int]] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                positions = donating.get(node.func.id)
+                if not positions:
+                    continue
+                for p in positions:
+                    if p < len(node.args) and isinstance(node.args[p], ast.Name):
+                        events.append((node.args[p].id, node.lineno))
+        if not events:
+            return
+        loads: Dict[str, List[int]] = {}
+        stores: Dict[str, List[int]] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name):
+                d = loads if isinstance(node.ctx, ast.Load) else stores
+                d.setdefault(node.id, []).append(node.lineno)
+        for name, dline in events:
+            rebinds = [l for l in stores.get(name, []) if l >= dline]
+            horizon = min(rebinds) if rebinds else float("inf")
+            for l in sorted(loads.get(name, [])):
+                if dline < l < horizon:
+                    if "GL005" not in _disabled_rules(self.src_lines, l):
+                        self.findings.append(Finding(
+                            self.path, l, "GL005",
+                            "%r is read after being passed at a donated "
+                            "position (line %d) — its buffer may alias an "
+                            "output" % (name, dline), fn.name))
+                    break  # one finding per donation event
+
+    # ------------------------------------------------------------- GL006
+    def _check_module_caches(self):
+        bounded_markers = ("pop", "popitem", "clear", "move_to_end")
+        candidates: Dict[str, ast.AST] = {}
+        for node in self.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t, v = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                t, v = node.target, node.value  # NAME: Dict = {}
+            else:
+                continue
+            if not isinstance(t, ast.Name):
+                continue
+            empty_dict = (isinstance(v, ast.Dict) and not v.keys) or (
+                isinstance(v, ast.Call) and _call_name(v.func) == "dict"
+                and not v.args and not v.keywords)
+            if empty_dict:
+                candidates[t.id] = node
+        if not candidates:
+            return
+        grows: Set[str] = set()
+        bounded: Set[str] = set()
+        for node in ast.walk(self.tree):
+            # NAME[key] = ... / NAME.setdefault(...)
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Subscript) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id in candidates:
+                        grows.add(t.value.id)
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id in candidates:
+                if node.func.attr == "setdefault":
+                    grows.add(node.func.value.id)
+                if node.func.attr in bounded_markers:
+                    bounded.add(node.func.value.id)
+            # del NAME[...] or a len(NAME) comparison count as bounding
+            if isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript) and \
+                            isinstance(t.value, ast.Name):
+                        bounded.add(t.value.id)
+            if isinstance(node, ast.Compare):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call) and \
+                            _call_name(sub.func) == "len" and sub.args and \
+                            isinstance(sub.args[0], ast.Name):
+                        bounded.add(sub.args[0].id)
+        for name in sorted(grows - bounded):
+            node = candidates[name]
+            self.add(node, "GL006",
+                     "module-level cache %r grows without an eviction path "
+                     "(cap it or use base.BoundedCache)" % name, name)
+
+
+# ------------------------------------------------------------------ driver
+
+
+def lint_source(src: str, path: str) -> List[Finding]:
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 0, "GL000",
+                        "syntax error: %s" % e.msg, "<module>")]
+    return _ModuleLint(tree, path, src).run()
+
+
+def lint_paths(paths, exclude=()) -> List[Finding]:
+    """Lint .py files under ``paths`` (files or directories). Paths in
+    findings are normalized to forward-slash relatives of the CWD when
+    possible, so output and allowlist keys are machine-independent."""
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__",) + tuple(exclude))
+                files.extend(os.path.join(root, n) for n in sorted(names)
+                             if n.endswith(".py"))
+        elif p.endswith(".py"):
+            files.append(p)
+    findings: List[Finding] = []
+    cwd = os.getcwd()
+    for f in files:
+        rel = os.path.relpath(f, cwd)
+        rel = f if rel.startswith("..") else rel
+        rel = rel.replace(os.sep, "/")
+        with open(f, "r", encoding="utf-8") as fh:
+            findings.extend(lint_source(fh.read(), rel))
+    findings.sort(key=lambda x: (x.path, x.line, x.rule, x.msg))
+    return findings
+
+
+def load_allowlist(path: str) -> Dict[str, str]:
+    """Committed allowlist: [{"id": "path::rule::scope", "why": "..."}].
+    Every entry must carry a non-empty ``why`` — the justification lives
+    inline with the exemption."""
+    with open(path, "r", encoding="utf-8") as fh:
+        entries = json.load(fh)
+    out = {}
+    for e in entries:
+        if not e.get("why", "").strip():
+            raise ValueError("allowlist entry %r has no 'why' justification"
+                             % e.get("id"))
+        out[e["id"]] = e["why"]
+    return out
+
+
+def split_allowed(findings, allow: Dict[str, str]):
+    """(kept, suppressed, stale_allow_ids)."""
+    kept, suppressed = [], []
+    seen = set()
+    for f in findings:
+        if f.key in allow:
+            suppressed.append(f)
+            seen.add(f.key)
+        else:
+            kept.append(f)
+    stale = sorted(set(allow) - seen)
+    return kept, suppressed, stale
+
+
+def format_findings(findings) -> str:
+    return "\n".join(f.render() for f in findings)
+
+
+def summarize(findings) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for f in findings:
+        out[f.rule] = out.get(f.rule, 0) + 1
+    return dict(sorted(out.items()))

@@ -1,0 +1,305 @@
+"""graphlint stage 2: trace-time validation of hybridizable blocks.
+
+Static analysis (stage 1) can only *suspect* a hazard; this module traces
+the block for real — with the engine's dispatch/compile counters armed and
+NDArray's host-sync methods instrumented — and *proves* it, the way Relay's
+typed IR proves graph validity before execution (arXiv:1810.00952):
+
+GL101  host readback mid-trace: ``float()``/``np.asarray``/``.asnumpy()``
+       on a traced value (ConcretizationTypeError and friends), an
+       imperative NDArray dispatch escaping the trace
+       (``engine.dispatch_counter`` bumps while tracing), or lazy bulk
+       nodes issued into the window from inside the trace.
+GL102  retrace hazard: two traces at the same signature produce different
+       jaxprs (per-call-varying Python state baked as constants — under
+       ``jax.jit`` this is silent staleness, under shape polymorphism a
+       recompile per step), or the compile probe observes a second
+       same-signature call re-tracing.
+GL103  constant-folded / dead parameter: a parameter array that never
+       influences the traced outputs (e.g. read via ``.asnumpy()`` at
+       module build time so the trace sees a baked constant).
+GL104  data-dependent Python control flow (TracerBoolConversionError).
+
+Entry points: :func:`check_hybridizable` (returns findings) and
+``HybridBlock.hybridize(validate=True)`` (raises :class:`GraphlintError`
+on the first forward if validation finds anything).
+"""
+from __future__ import annotations
+
+import traceback
+from typing import List
+
+import jax
+
+from .graphlint import Finding
+
+_TRACE_RULES = {
+    "GL101": "host readback inside the traced region",
+    "GL102": "retrace hazard (per-call-varying trace)",
+    "GL103": "constant-folded or dead parameter",
+    "GL104": "data-dependent Python control flow under trace",
+}
+
+
+class GraphlintError(RuntimeError):
+    """hybridize(validate=True) found trace-hygiene violations."""
+
+    def __init__(self, findings: List[Finding]):
+        self.findings = findings
+        msgs = "\n".join("  " + f.render() for f in findings)
+        super().__init__(
+            "graphlint: block failed trace-time validation "
+            "(%d finding%s):\n%s\nFix the block, or hybridize without "
+            "validate=True to skip the check."
+            % (len(findings), "s" if len(findings) != 1 else "", msgs))
+
+
+def _user_frame(tb_or_exc, block) -> tuple:
+    """(path, line) of the deepest frame that belongs to the block's own
+    code (not jax internals, not this package's machinery)."""
+    frames = traceback.extract_tb(tb_or_exc.__traceback__) \
+        if isinstance(tb_or_exc, BaseException) else tb_or_exc
+    best = ("<unknown>", 0)
+    for fr in frames:
+        fn = fr.filename
+        if "site-packages" in fn or "/jax/" in fn:
+            continue
+        if fn.endswith(("analysis/validate.py", "mxnet_tpu/_trace.py")):
+            continue
+        best = (fn, fr.lineno or 0)
+    return best
+
+
+def _deactivated(block):
+    """Recursively collect (block, prev_active) so the probe can force the
+    pure-imperative path even on an already-hybridized net."""
+    saved = []
+    stack = [block]
+    while stack:
+        b = stack.pop()
+        if hasattr(b, "_active"):
+            saved.append((b, b._active))
+            b._active = False
+        stack.extend(getattr(b, "_children", {}).values())
+    return saved
+
+
+def _sync_probe(block, inputs):
+    """Run the block imperatively with NDArray's host-sync methods
+    instrumented; every sync issued from inside the block's forward is a
+    latent GL101 (it will crash or constant-fold once hybridized)."""
+    from ..ndarray import NDArray
+
+    records = []
+    hooked = ["asnumpy", "asscalar", "item", "__float__", "__int__",
+              "__bool__", "wait_to_read"]
+    saved = {name: getattr(NDArray, name) for name in hooked}
+
+    def wrap(name, orig):
+        def probe(self, *a, **k):
+            stack = traceback.extract_stack()[:-1]
+            for fr in reversed(stack):
+                if not fr.filename.endswith(
+                        ("mxnet_tpu/ndarray.py", "analysis/validate.py")):
+                    records.append((name, fr.filename, fr.lineno or 0))
+                    break
+            return orig(self, *a, **k)
+        return probe
+
+    actives = _deactivated(block)
+    for name in hooked:
+        setattr(NDArray, name, wrap(name, saved[name]))
+    try:
+        out = block(*inputs)
+    finally:
+        for name in hooked:
+            setattr(NDArray, name, saved[name])
+        for b, prev in actives:
+            b._active = prev
+    findings = []
+    seen = set()
+    for name, path, line in records:
+        if (path, line) in seen:
+            continue
+        seen.add((path, line))
+        findings.append(Finding(path, line, "GL101",
+                                "%s triggered a device→host sync inside the "
+                                "block's forward" % name.strip("_"),
+                                type(block).__name__))
+    return out, findings
+
+
+def check_hybridizable(block, *inputs, training=False, compile_probe=False):
+    """Trace ``block`` on ``inputs`` and return a list of trace-time
+    findings (empty = clean). ``inputs`` are NDArrays (or raw arrays) of
+    the real shapes you intend to run.
+
+    Probes, in order:
+
+    1. **Imperative sync probe** — runs the block once un-hybridized with
+       NDArray's host-sync methods instrumented (also materializes any
+       deferred-init parameters, exactly like the normal warmup).
+    2. **Trace probe** — ``jax.make_jaxpr`` over the same pure function
+       ``hybridize`` compiles, with ``engine.dispatch_counter`` and the
+       bulk window watched: tracer-concretization errors, imperative
+       dispatches, and lazy nodes issued mid-trace are all GL101/GL104.
+       The trace runs **twice**; differing jaxprs at an identical
+       signature are GL102 (per-call-varying Python constants). Parameter
+       inputs that appear in no equation are GL103.
+    3. **Compile probe** (``compile_probe=True``) — jits the pure function
+       with a trace counter and calls it twice with the same concrete
+       signature; a second trace is a proven same-signature recompile
+       (GL102). Off by default: it pays an XLA compile.
+    """
+    from .. import _trace, engine
+    from ..ndarray import NDArray
+
+    if not hasattr(block, "_call_traced"):
+        raise TypeError(
+            "check_hybridizable needs a HybridBlock (got %s) — plain Blocks "
+            "have no traced path to validate" % type(block).__name__)
+
+    import numpy as np
+
+    from .. import autograd, random as _random
+
+    findings: List[Finding] = []
+    scope = type(block).__name__
+
+    # ---- probe 1: imperative, instrumented (also warms deferred params).
+    # Runs TWICE at the same inputs and RNG seed: any output difference is
+    # per-call-varying Python state being folded into the math — the state
+    # a jit compile would freeze at trace-1 values (silent staleness) or
+    # retrace on. This catches what jaxpr comparison cannot: jit-wrapped
+    # jnp ops cache their inner jaxprs by aval, so a varying Python scalar
+    # yields byte-identical outer jaxprs with a stale constant inside.
+    import contextlib
+
+    mode = autograd.train_mode() if training else contextlib.nullcontext()
+    state = _random.get_state()
+    try:
+        with mode:
+            _random.seed(1234)
+            out1, sync_findings = _sync_probe(block, inputs)
+            _random.seed(1234)
+            out2, _ = _sync_probe(block, inputs)
+    finally:
+        _random.set_state(state)
+    findings.extend(sync_findings)
+
+    def _leaves(o):
+        flat, _ = jax.tree_util.tree_flatten(
+            o, is_leaf=lambda x: isinstance(x, NDArray))
+        return [np.asarray(l.asnumpy() if isinstance(l, NDArray) else l)
+                for l in flat]
+
+    l1, l2 = _leaves(out1), _leaves(out2)
+    same = len(l1) == len(l2) and all(
+        a.shape == b.shape and np.array_equal(a, b, equal_nan=True)
+        for a, b in zip(l1, l2))
+    if not same:
+        findings.append(Finding(
+            "<trace>", 0, "GL102",
+            "two runs at the same inputs and RNG seed produced different "
+            "outputs — per-call-varying Python state feeds the math; under "
+            "jit it would be frozen at first-trace values (silently stale) "
+            "or force a retrace per call", scope))
+
+    params = block.collect_params()
+    plist = [p for p in params.values() if p._data is not None]
+    pnames = [p.name for p in plist]
+
+    def pure(pa, key, *xs):
+        with _trace.trace_scope(key, training) as tctx:
+            tctx.param_store = {id(p): a for p, a in zip(plist, pa)}
+            out = block._call_traced(*xs)
+            upd = [tctx.state_updates.get(id(p)) for p in plist]
+        return out, upd
+
+    pa = [p.data()._data for p in plist]
+    xs = [a._data if isinstance(a, NDArray) else a for a in inputs]
+    key = jax.random.PRNGKey(0)
+
+    # ---- probe 2: make_jaxpr with the engine counters armed
+    engine.flush()  # drain unrelated pending lazy work first
+    d0 = engine.dispatch_counter.count
+    w0 = len(engine._window())
+    try:
+        jaxpr1 = jax.make_jaxpr(pure)(pa, key, *xs)
+        jaxpr2 = jax.make_jaxpr(pure)(pa, key, *xs)
+    except Exception as e:  # Tracer*Error / ConcretizationTypeError
+        name = type(e).__name__
+        if "Tracer" not in name and "Concretization" not in name:
+            raise
+        rule = "GL104" if "Bool" in name else "GL101"
+        path, line = _user_frame(e, block)
+        findings.append(Finding(
+            path, line, rule,
+            "%s while tracing: %s" % (name, str(e).splitlines()[0]), scope))
+        engine.flush()
+        return _dedup(findings)
+    if len(engine._window()) > w0:
+        engine.flush()
+        findings.append(Finding("<trace>", 0, "GL101",
+                                "imperative lazy ops were issued into the "
+                                "bulk window from inside the trace", scope))
+    if engine.dispatch_counter.count != d0:
+        findings.append(Finding(
+            "<trace>", 0, "GL101",
+            "%d imperative dispatch(es) escaped the trace — NDArray ops ran "
+            "on the host mid-trace" % (engine.dispatch_counter.count - d0),
+            scope))
+    consts_differ = (len(jaxpr1.consts) != len(jaxpr2.consts)
+                     or any(not np.array_equal(np.asarray(a), np.asarray(b))
+                            for a, b in zip(jaxpr1.consts, jaxpr2.consts)))
+    if str(jaxpr1) != str(jaxpr2) or consts_differ:
+        findings.append(Finding(
+            "<trace>", 0, "GL102",
+            "two traces at the same signature differ — per-call-varying "
+            "Python state is being baked into the program (stale constants "
+            "under jit, a retrace per call otherwise)", scope))
+
+    # GL103: param invars that no equation consumes (by Var identity).
+    # ``pure`` flattens to [*params, key, *inputs]; match by position.
+    used = set()
+    for eqn in jaxpr1.jaxpr.eqns:
+        used.update(id(v) for v in eqn.invars)
+    used.update(id(v) for v in jaxpr1.jaxpr.outvars)
+    for i, name in enumerate(pnames):
+        if i < len(jaxpr1.jaxpr.invars) and \
+                id(jaxpr1.jaxpr.invars[i]) not in used:
+            findings.append(Finding(
+                "<trace>", 0, "GL103",
+                "parameter %r never influences the traced outputs "
+                "(constant-folded at build time, or dead)" % name, scope))
+
+    # ---- probe 3 (opt-in): jit + same-signature second call
+    if compile_probe:
+        traces = [0]
+
+        def counting(pa_, key_, *xs_):
+            traces[0] += 1
+            return pure(pa_, key_, *xs_)
+
+        jf = jax.jit(counting)
+        jf(pa, key, *xs)
+        first = traces[0]
+        jf(pa, key, *xs)
+        if traces[0] > first:
+            findings.append(Finding(
+                "<trace>", 0, "GL102",
+                "a second call at the same signature re-traced (recompile "
+                "per step)", scope))
+    return _dedup(findings)
+
+
+def _dedup(findings: List[Finding]) -> List[Finding]:
+    """One finding per (path, line, rule): the sync probe and the trace
+    probe can surface the same offending call site."""
+    seen, out = set(), []
+    for f in findings:
+        k = (f.path, f.line, f.rule)
+        if k not in seen:
+            seen.add(k)
+            out.append(f)
+    return out

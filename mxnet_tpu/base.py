@@ -8,6 +8,7 @@ traced/hybridized path) are generated from it.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Callable, Dict, NamedTuple
 
 import jax
@@ -57,14 +58,46 @@ def _freeze(v):
     return v
 
 
-_JIT_CACHE: Dict = {}
+def env_cap(name, default):
+    """Integer cache cap from the environment (graphlint GL006 knobs)."""
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class BoundedCache(dict):
+    """Capped dict for module-level program/metadata caches (graphlint
+    GL006: an unbounded module cache grows forever in long-running serving
+    processes). Eviction is insertion-order (oldest first) and happens only
+    on insert — hits stay plain-dict speed with zero LRU bookkeeping on the
+    per-op hot path. Entries must be pure caches: evicting one may cost a
+    recompute/recompile, never correctness."""
+
+    __slots__ = ("cap",)
+
+    def __init__(self, cap):
+        super().__init__()
+        self.cap = max(int(cap), 1)
+
+    def __setitem__(self, key, value):
+        if len(self) >= self.cap and key not in self:
+            del self[next(iter(self))]
+        super().__setitem__(key, value)
+
+
+# per-(op, static attrs, device) jitted callables. Keys include static-attr
+# VALUES (reshape targets, axis lists), whose diversity is unbounded under
+# adversarial serving traffic — hence the cap (MXNET_JIT_CACHE_CAP).
+_JIT_CACHE: Dict = BoundedCache(env_cap("MXNET_JIT_CACHE_CAP", 4096))
 
 # composed-program cache for the lazy bulk window (engine.bulk): one jitted
 # callable per (op-chain topology, static attrs, leaf signatures, output
 # set). Steady-state epochs re-running an identical imperative chain hit the
 # SAME callable object, so jax.jit reuses the compiled executable with zero
 # retrace — the imperative analogue of MXNet's CachedOp handle reuse.
-_BULK_CACHE: Dict = {}
+# Capped (MXNET_BULK_CACHE_CAP): chain-topology diversity is unbounded.
+_BULK_CACHE: Dict = BoundedCache(env_cap("MXNET_BULK_CACHE_CAP", 1024))
 
 
 def bulk_jitted(key, builder):

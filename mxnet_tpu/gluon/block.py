@@ -285,11 +285,19 @@ class HybridBlock(Block):
         super().__init__(prefix=prefix, params=params)
         self._active = False
         self._cached_execs = {}  # training(bool) -> (jitted, plist)
+        self._validate_trace = False
 
-    def hybridize(self, active=True, **kwargs):
+    def hybridize(self, active=True, validate=False, **kwargs):
+        """``validate=True`` arms graphlint's trace-time checker: the first
+        forward traces the block with instrumented NDArrays and the engine
+        counters and raises :class:`mxnet_tpu.analysis.GraphlintError` on
+        host readbacks mid-trace, per-call-varying (retracing) constants,
+        or constant-folded parameters — instead of MXNet's silent hybridize
+        warnings (see MIGRATING.md)."""
         self._active = active
+        self._validate_trace = bool(validate) and bool(active)
         self._cached_execs = {}
-        super().hybridize(active, **kwargs)
+        super().hybridize(active, validate=validate, **kwargs)
 
     def cast(self, dtype):
         self._cached_execs = {}
@@ -343,6 +351,15 @@ class HybridBlock(Block):
 
         self._ensure_params(*args)
         if self._active:
+            if self._validate_trace:
+                # disarm BEFORE probing: validation re-enters this forward
+                self._validate_trace = False
+                from .. import analysis
+
+                findings = analysis.check_hybridizable(
+                    self, *args, training=autograd.is_training())
+                if findings:
+                    raise analysis.GraphlintError(findings)
             try:
                 return self._call_compiled(*args)
             except _NotReady:

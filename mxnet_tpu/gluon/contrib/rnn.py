@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ... import _trace
 from ..rnn.rnn_cell import RecurrentCell
 
 __all__ = ["Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
@@ -183,9 +184,18 @@ class VariationalDropoutCell(RecurrentCell):
         self.base_cell.reset()
         self._mask_i = self._mask_s = self._mask_o = None
 
-    def _mask(self, F, cached, ref, rate):
+    def _mask(self, F, slot, ref, rate):
+        # The per-sequence mask cache: imperatively it lives on ``self``
+        # (cleared by reset(), the upstream contract); under a hybridize
+        # trace it lives in the TraceContext scratch instead — one traced
+        # unroll IS one sequence, and caching the mask on ``self`` there
+        # would leak a dead tracer into the next trace (graphlint GL003).
+        tctx = _trace.current_trace()
+        store = tctx.scratch if tctx is not None else self.__dict__
+        key = (id(self), slot) if tctx is not None else slot
+        cached = store.get(key)
         if cached is None:
-            cached = F.Dropout(F.ones_like(ref), p=rate)
+            cached = store[key] = F.Dropout(F.ones_like(ref), p=rate)
         return cached
 
     def hybrid_forward(self, F, inputs, states):
@@ -197,15 +207,13 @@ class VariationalDropoutCell(RecurrentCell):
         if not autograd.is_training():
             return self.base_cell(inputs, states)
         if self._di > 0:
-            self._mask_i = self._mask(F, self._mask_i, inputs, self._di)
-            inputs = inputs * self._mask_i
+            inputs = inputs * self._mask(F, "_mask_i", inputs, self._di)
         if self._ds > 0:
-            self._mask_s = self._mask(F, self._mask_s, states[0], self._ds)
-            states = [states[0] * self._mask_s] + list(states[1:])
+            states = ([states[0] * self._mask(F, "_mask_s", states[0],
+                                              self._ds)] + list(states[1:]))
         out, nstates = self.base_cell(inputs, states)
         if self._do > 0:
-            self._mask_o = self._mask(F, self._mask_o, out, self._do)
-            out = out * self._mask_o
+            out = out * self._mask(F, "_mask_o", out, self._do)
         return out, nstates
 
     def __repr__(self):

@@ -269,6 +269,8 @@ class ZoneoutCell(ModifierCell):
         self._prev_output = None
 
     def hybrid_forward(self, F, inputs, states):
+        from ... import _trace
+
         out, new_states = self.base_cell(inputs, states)
 
         def mask(p, like):
@@ -279,10 +281,18 @@ class ZoneoutCell(ModifierCell):
             new_states = [F.where(mask(self._zs, s_new), s_new, s_old)
                           for s_old, s_new in zip(states, new_states)]
         if self._zo > 0:
-            prev = (self._prev_output if self._prev_output is not None
-                    else F.zeros_like(out))
-            out = F.where(mask(self._zo, out), out, prev)
-            self._prev_output = out  # only read on the _zo path; storing
+            # prev-output carry: on ``self`` imperatively (reset() clears
+            # it), in the TraceContext scratch under a hybridize trace —
+            # writing the traced ``out`` to ``self`` would leak a dead
+            # tracer into the next trace (graphlint GL003)
+            tctx = _trace.current_trace()
+            store = tctx.scratch if tctx is not None else self.__dict__
+            key = (id(self), "_prev_output") if tctx is not None \
+                else "_prev_output"
+            prev = store.get(key)
+            out = F.where(mask(self._zo, out),
+                          out, prev if prev is not None else F.zeros_like(out))
+            store[key] = out  # only read on the _zo path; storing
             # unconditionally would pin a dead array/tracer per step
         return out, new_states
 

@@ -36,7 +36,8 @@ import numpy as np
 
 from . import autograd, random
 from . import engine as _engine
-from .base import OP_REGISTRY, _freeze, bulk_jitted, jitted, resolve_dtype
+from .base import (OP_REGISTRY, BoundedCache as _BoundedCache, _freeze,
+                   bulk_jitted, env_cap as _env_cap, jitted, resolve_dtype)
 from .context import Context, current_context
 from .engine import dispatch_counter
 
@@ -445,13 +446,24 @@ _prof_on = False
 # leaf_sigs, node sigs, aval-cache keys, flush cache keys). Hashing int
 # tuples is several times cheaper than hashing nested dtype tuples, and
 # this runs per op.
+#
+# The table is CAPPED (MXNET_SIG_INTERN_CAP; graphlint GL006): ids index
+# into _SIG_LIST, so entries can never be evicted without invalidating
+# every cache key built from them. Instead, once the cap is hit, _sig_id
+# returns None for NEW signatures and the lazy path falls back to eager
+# dispatch for ops touching them — steady-state workloads (a bounded
+# signature set) never notice; adversarial shape churn degrades gracefully
+# instead of growing host memory without bound.
 _SIG_IDS = {}
 _SIG_LIST = []
+_SIG_INTERN_CAP = _env_cap("MXNET_SIG_INTERN_CAP", 65536)
 
 
 def _sig_id(sig):
     i = _SIG_IDS.get(sig)
     if i is None:
+        if len(_SIG_IDS) >= _SIG_INTERN_CAP:
+            return None  # table full — caller bails to eager dispatch
         i = _SIG_IDS[sig] = len(_SIG_LIST)
         _SIG_LIST.append(sig)
     return i
@@ -460,9 +472,11 @@ def _sig_id(sig):
 # (op, static-attrs key, input sig-ids) -> (output ShapeDtypeStruct, its
 # sig-id), or None when the combo is not lazily executable (multi-output
 # result — e.g. split/topk whose arity depends on kwargs — or eval_shape
-# raised). One abstract evaluation per distinct combo for the process
-# lifetime; the hot loop pays a dict probe.
-_AVAL_CACHE = {}
+# raised). One abstract evaluation per distinct combo while cached; the
+# hot loop pays a dict probe. Capped (MXNET_AVAL_CACHE_CAP, insertion-order
+# eviction — graphlint GL006): static-attr diversity is unbounded, a miss
+# only re-runs eval_shape.
+_AVAL_CACHE = _BoundedCache(_env_cap("MXNET_AVAL_CACHE_CAP", 65536))
 _AVAL_MISS = object()
 
 
@@ -480,7 +494,10 @@ def _infer_aval(opdef, kwargs, in_sig_ids):
         return None  # let the eager path raise the real, well-located error
     if not isinstance(av, jax.ShapeDtypeStruct):
         return None
-    return (av, _sig_id((av.dtype, tuple(av.shape))))
+    sid = _sig_id((av.dtype, tuple(av.shape)))
+    if sid is None:  # intern table at cap: mark combo non-lazy
+        return None
+    return (av, sid)
 
 
 def _flush_window():
@@ -633,10 +650,13 @@ def invoke(opname, args, kwargs, _inner=False):
                         buf = a._buf
                         li = leaf_ids.get(id(buf))
                         if li is None:
+                            sid = _sig_id((buf.dtype, tuple(buf.shape)))
+                            if sid is None:  # intern table at cap: eager
+                                ok = False
+                                break
                             li = leaf_ids[id(buf)] = len(leaves)
                             leaves.append(buf)
-                            w.leaf_sigs.append(
-                                _sig_id((buf.dtype, tuple(buf.shape))))
+                            w.leaf_sigs.append(sid)
                         specs.append(~li)
                         in_sigs.append(w.leaf_sigs[li])
                     elif t is float or t is int or t is bool \
@@ -649,18 +669,25 @@ def invoke(opname, args, kwargs, _inner=False):
                         # scalars that happen to collide compile a variant
                         li = leaf_ids.get((t, a))
                         if li is None:
+                            sid = _sig_id(t)
+                            if sid is None:
+                                ok = False
+                                break
                             li = leaf_ids[(t, a)] = len(leaves)
                             leaves.append(a)
-                            w.leaf_sigs.append(_sig_id(t))
+                            w.leaf_sigs.append(sid)
                         specs.append(~li)
                         in_sigs.append(w.leaf_sigs[li])
                     elif isinstance(a, (jax.Array, np.ndarray)):
                         li = leaf_ids.get(id(a))
                         if li is None:
+                            sid = _sig_id((a.dtype, tuple(a.shape)))
+                            if sid is None:
+                                ok = False
+                                break
                             li = leaf_ids[id(a)] = len(leaves)
                             leaves.append(a)
-                            w.leaf_sigs.append(
-                                _sig_id((a.dtype, tuple(a.shape))))
+                            w.leaf_sigs.append(sid)
                         specs.append(~li)
                         in_sigs.append(w.leaf_sigs[li])
                     else:

@@ -198,10 +198,18 @@ class Symbol:
         return fn, names
 
     def eval(self, ctx=None, **kwargs):
-        fn, names = self._build_fn()
+        # per-symbol jit cache (graphlint GL002): _build_fn returns a FRESH
+        # closure, so jitting it per call would retrace + recompile every
+        # eval; the graph is fixed at construction, so one jitted callable
+        # serves the symbol's lifetime (jax keys further by input signature)
+        cached = getattr(self, "_eval_exec", None)
+        if cached is None:
+            fn, names = self._build_fn()
+            cached = self._eval_exec = (jax.jit(fn), names)
+        jfn, names = cached
         vals = [kwargs[n]._data if isinstance(kwargs[n], NDArray) else jnp.asarray(kwargs[n])
                 for n in names]
-        out = jax.jit(fn)(*vals)
+        out = jfn(*vals)
         out = out if isinstance(out, (list, tuple)) else [out]
         return [NDArray(o) for o in out]
 

@@ -1,0 +1,14 @@
+"""Fixture: GL001 negatives — syncs outside regions, statics inside."""
+import numpy as np
+
+
+def report(arr):
+    # not a traced region: a host readback here is normal imperative code
+    return float(np.asarray(arr).sum())
+
+
+class GoodBlock:
+    def hybrid_forward(self, F, x):
+        scale = float(self._alpha)   # python attr on self, never traced
+        n = int(x.shape[0])          # shape is static under trace
+        return F.relu(x) * scale * n

@@ -1,0 +1,10 @@
+"""Fixture: GL001 true positives — host syncs inside a traced region."""
+import numpy as np
+
+
+class BadBlock:
+    def hybrid_forward(self, F, x):
+        host = x.asnumpy()                              # expect: GL001
+        s = float(F.sum(x))                             # expect: GL001
+        arr = np.asarray(x)                             # expect: GL001
+        return F.relu(x) * s + arr.mean() + host.sum()

@@ -1,0 +1,14 @@
+"""Fixture: GL002 negatives — cached jit, deterministic key ordering."""
+import jax
+
+
+def _body(a):
+    return a + 1
+
+
+_JITTED = jax.jit(_body)  # module-level: one compile per process
+
+
+def run_cached(x):
+    key = tuple(sorted({"b", "a"}))  # sorted() makes the order stable
+    return _JITTED(x), key

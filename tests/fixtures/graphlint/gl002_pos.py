@@ -1,0 +1,15 @@
+"""Fixture: GL002 true positives — per-call jit identity, unordered keys."""
+import jax
+
+
+def run_per_call(x):
+    y = jax.jit(lambda a: a + 1)(x)                     # expect: GL002
+    key = tuple({"b", "a"})                             # expect: GL002
+    return y, key
+
+
+def run_local_fn(x):
+    def body(a):
+        return a * 2
+
+    return jax.jit(body)(x)                             # expect: GL002

@@ -1,0 +1,13 @@
+"""Fixture: GL003 negatives — self writes outside regions / untraced."""
+
+
+class CleanBlock:
+    def __init__(self):
+        self.units = 16  # config on self outside any traced region
+
+    def hybrid_forward(self, F, x):
+        y = F.relu(x)    # locals are fine: they die with the trace
+        return y
+
+    def configure(self, batch):
+        self.batch = batch  # not a traced region

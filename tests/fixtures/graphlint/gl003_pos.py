@@ -1,0 +1,8 @@
+"""Fixture: GL003 true positives — traced values escaping onto self."""
+
+
+class LeakyBlock:
+    def hybrid_forward(self, F, x):
+        self.last_activation = F.relu(x)                # expect: GL003
+        self.history.append(x * 2)                      # expect: GL003
+        return self.last_activation

@@ -1,0 +1,10 @@
+"""Fixture: GL004 negatives — None guards and shape tests are static."""
+
+
+class ShapedBlock:
+    def hybrid_forward(self, F, x, mask=None):
+        if mask is not None:   # None-guard: resolved at trace time
+            x = x * mask
+        if x.shape[0] > 1:     # shape is static under trace
+            x = F.flatten(x)
+        return x

@@ -1,0 +1,10 @@
+"""Fixture: GL004 true positives — data-dependent Python control flow."""
+
+
+class BranchyBlock:
+    def hybrid_forward(self, F, x):
+        if F.sum(x) > 0:                                # expect: GL004
+            return x
+        while x.min() < 0:                              # expect: GL004
+            x = x + 1
+        return -x

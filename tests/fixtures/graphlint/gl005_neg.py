@@ -1,0 +1,8 @@
+"""Fixture: GL005 negative — the donated name is rebound by the call."""
+import jax
+
+
+def train_step(params, grads, fn):
+    step = jax.jit(fn, donate_argnums=(0,))
+    params = step(params, grads)  # rebinding the donated name is the idiom
+    return params.sum()

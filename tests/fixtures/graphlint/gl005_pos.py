@@ -1,0 +1,9 @@
+"""Fixture: GL005 true positive — donated buffer read after the call."""
+import jax
+
+
+def train_step(params, grads, fn):
+    step = jax.jit(fn, donate_argnums=(0,))
+    new_params = step(params, grads)
+    norm = params.sum()                                 # expect: GL005
+    return new_params, norm

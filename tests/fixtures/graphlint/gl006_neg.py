@@ -1,0 +1,11 @@
+"""Fixture: GL006 negative — the cache evicts when it reaches its cap."""
+
+_CAP = 128
+_RESULTS = {}
+
+
+def remember(key, value):
+    if len(_RESULTS) >= _CAP:
+        _RESULTS.pop(next(iter(_RESULTS)))
+    _RESULTS[key] = value
+    return _RESULTS.get(key)

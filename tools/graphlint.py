@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""graphlint CLI — tracing-hygiene static analysis for the TPU hot path.
+
+Usage:
+    python tools/graphlint.py [paths ...] [--ci] [--allowlist FILE] [--json]
+
+Default path: ``mxnet_tpu``. Output is deterministic (sorted by
+path:line:rule), so diffs against the committed allowlist are stable.
+
+``--ci`` loads the allowlist (default ``tools/graphlint_allow.json``),
+prints only NON-allowlisted findings, and exits 1 if any exist (0 when
+clean). Stale allowlist entries (matching no current finding) are reported
+as warnings so the list can only shrink, never rot. The tier-1 suite runs
+this mode over ``mxnet_tpu/`` itself (tests/test_graphlint.py).
+
+Rule reference: ``python tools/graphlint.py --rules`` or
+``mxnet_tpu/analysis/graphlint.py`` docstring.
+"""
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# stage 1 is pure stdlib: pull the module in directly so the CLI works (and
+# stays fast) even where jax is absent/broken
+import importlib.util as _ilu  # noqa: E402
+
+_spec = _ilu.spec_from_file_location(
+    "graphlint_core", os.path.join(_REPO, "mxnet_tpu", "analysis",
+                                   "graphlint.py"))
+gl = _ilu.module_from_spec(_spec)
+_spec.loader.exec_module(gl)
+
+DEFAULT_ALLOWLIST = os.path.join(_REPO, "tools", "graphlint_allow.json")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: mxnet_tpu)")
+    ap.add_argument("--ci", action="store_true",
+                    help="apply the allowlist; exit 1 on any other finding")
+    ap.add_argument("--allowlist", default=DEFAULT_ALLOWLIST,
+                    help="allowlist JSON (default tools/graphlint_allow.json)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as JSON")
+    ap.add_argument("--rules", action="store_true",
+                    help="print the rule table and exit")
+    args = ap.parse_args(argv)
+
+    if args.rules:
+        for rid, desc in sorted(gl.RULES.items()):
+            print("%s  %s" % (rid, desc))
+        return 0
+
+    paths = args.paths or [os.path.join(_REPO, "mxnet_tpu")]
+    prev = os.getcwd()
+    os.chdir(_REPO)  # finding paths (and allowlist keys) are repo-relative
+    try:
+        findings = gl.lint_paths([os.path.relpath(p, _REPO)
+                                  if os.path.isabs(p) else p for p in paths])
+    finally:
+        os.chdir(prev)
+
+    suppressed, stale = [], []
+    if args.ci:
+        allow = (gl.load_allowlist(args.allowlist)
+                 if os.path.exists(args.allowlist) else {})
+        findings, suppressed, stale = gl.split_allowed(findings, allow)
+
+    if args.as_json:
+        print(json.dumps([f._asdict() for f in findings], indent=2,
+                         sort_keys=True))
+    elif findings:
+        print(gl.format_findings(findings))
+
+    summary = gl.summarize(findings)
+    total = sum(summary.values())
+    print("graphlint: %d finding%s%s%s" % (
+        total, "" if total == 1 else "s",
+        " (%s)" % ", ".join("%s=%d" % kv for kv in summary.items())
+        if summary else "",
+        ", %d allowlisted" % len(suppressed) if args.ci else ""))
+    for sid in stale:
+        print("graphlint: WARNING stale allowlist entry (no longer fires): %s"
+              % sid)
+    return 1 if (args.ci and findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

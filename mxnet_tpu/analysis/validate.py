@@ -140,9 +140,10 @@ def check_hybridizable(block, *inputs, training=False, compile_probe=False):
        NDArray's host-sync methods instrumented (also materializes any
        deferred-init parameters, exactly like the normal warmup).
     2. **Trace probe** — ``jax.make_jaxpr`` over the same pure function
-       ``hybridize`` compiles, with ``engine.dispatch_counter`` and the
-       bulk window watched: tracer-concretization errors, imperative
-       dispatches, and lazy nodes issued mid-trace are all GL101/GL104.
+       ``hybridize`` compiles, with ``engine.dispatch_counter``, the bulk
+       window, and the autograd tape/``tape_compile_counter`` watched:
+       tracer-concretization errors, imperative dispatches, lazy nodes, and
+       tape nodes issued mid-trace are all GL101/GL104.
        The trace runs **twice**; differing jaxprs at an identical
        signature are GL102 (per-call-varying Python constants). Parameter
        inputs that appear in no equation are GL103.
@@ -224,6 +225,8 @@ def check_hybridizable(block, *inputs, training=False, compile_probe=False):
     engine.flush()  # drain unrelated pending lazy work first
     d0 = engine.dispatch_counter.count
     w0 = len(engine._window())
+    t0 = len(autograd._tape())
+    c0 = engine.tape_compile_counter.count
     try:
         jaxpr1 = jax.make_jaxpr(pure)(pa, key, *xs)
         jaxpr2 = jax.make_jaxpr(pure)(pa, key, *xs)
@@ -243,6 +246,14 @@ def check_hybridizable(block, *inputs, training=False, compile_probe=False):
         findings.append(Finding("<trace>", 0, "GL101",
                                 "imperative lazy ops were issued into the "
                                 "bulk window from inside the trace", scope))
+    if len(autograd._tape()) > t0 or engine.tape_compile_counter.count != c0:
+        # trim the leaked nodes: they pin tracers from the dead trace
+        del autograd._st().tape[t0:]
+        findings.append(Finding(
+            "<trace>", 0, "GL101",
+            "autograd tape activity escaped into the trace — recorded ops "
+            "or a compiled tape backward ran inside the compiled region",
+            scope))
     if engine.dispatch_counter.count != d0:
         findings.append(Finding(
             "<trace>", 0, "GL101",

@@ -114,6 +114,33 @@ def bulk_jitted(key, builder):
     return f
 
 
+# compiled tape-replay program cache (autograd.backward): one jitted
+# forward+backward program per (tape topology, static attrs, leaf
+# signatures, head set, grad_req/donation layout) — the whole-program
+# analogue of MXNet's nnvm backward graph executed via Imperative::Backward.
+# Capped like the others (MXNET_TAPE_CACHE_CAP).
+_TAPE_CACHE: Dict = BoundedCache(env_cap("MXNET_TAPE_CACHE_CAP", 512))
+
+
+def tape_jitted(key, builder):
+    """Cached jitted compiled-tape backward program. ``builder`` (called
+    only on a miss) returns ``(prog, donate_argnums)``; a steady-state
+    record→backward loop must hit the cache every iteration —
+    engine.tape_compile_counter (misses) / engine.tape_cache_hit_counter
+    (hits) are the proof hooks tests and tools/diagnose.py read."""
+    from .engine import tape_cache_hit_counter, tape_compile_counter
+
+    f = _TAPE_CACHE.get(key)
+    if f is None:
+        tape_compile_counter.bump()
+        prog, donate = builder()
+        f = _TAPE_CACHE[key] = (jax.jit(prog, donate_argnums=donate)
+                                if donate else jax.jit(prog))
+    else:
+        tape_cache_hit_counter.bump()
+    return f
+
+
 def jitted(fn: Callable, static_kwargs: dict, device=None):
     """Return a cached jitted callable of ``fn`` with the given static kwargs
     closed over. Equivalent role to MXNet's cached op handles for imperative

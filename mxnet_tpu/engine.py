@@ -56,6 +56,14 @@ dispatch_counter = DispatchCounter()
 # not bump it — the "no retrace" assertion tests/test_bulk_engine.py makes
 bulk_compile_counter = DispatchCounter()
 
+# compiled tape replay (autograd.backward): tape_compile_counter bumps once
+# per backward-program BUILD (a base.tape_jitted miss) — steady-state
+# record→backward loops must not bump it (the zero-retrace assertion in
+# tests/test_tape_replay.py); tape_cache_hit_counter counts the hits
+# (surfaced by tools/diagnose.py)
+tape_compile_counter = DispatchCounter()
+tape_cache_hit_counter = DispatchCounter()
+
 
 try:
     _bulk_size = int(os.environ.get("MXNET_ENGINE_BULK_SIZE", "15"))

@@ -136,6 +136,11 @@ class Parameter:
     def zero_grad(self):
         if self._data is not None and self._data.grad is not None:
             self._data.grad._data = jnp.zeros_like(self._data.grad._data)
+            # fresh private buffer: re-enable compiled-backward donation if
+            # a kvstore pull had marked the grad as aliasing store memory
+            from .. import autograd
+
+            autograd.mark_grad_private(self._data.grad)
 
     def list_ctx(self):
         return [self.data().context] if self._data is not None else []

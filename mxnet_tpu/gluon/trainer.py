@@ -93,13 +93,31 @@ class Trainer:
     def allreduce_grads(self):
         """Aggregate gradients across devices. In-mesh DP sums inside the
         compiled step via lax.psum (ref kvstore 'device' path:
-        src/kvstore/kvstore_local.h); with an explicit dist kvstore, push/pull."""
+        src/kvstore/kvstore_local.h); with an explicit dist kvstore, ONE
+        batched list-key push + pull covers every parameter (the
+        KVStore.push/pull list API, ref: python/mxnet/kvstore.py) instead
+        of a per-param Python loop.
+
+        Donation handshake: the pull aliases store buffers into the grad
+        arrays, so they are marked shared (autograd.mark_grad_shared) —
+        the compiled tape backward must not donate a buffer the store
+        still owns; the next backward rebinds them to program-owned
+        storage and re-marks them private."""
         if self._kvstore is not None:
+            from .. import autograd as _autograd
+
+            keys, grads = [], []
             for i, p in enumerate(self._params):
                 if p._data is None or p.grad() is None:
                     continue
-                self._kvstore.push(i, p.grad())
-                self._kvstore.pull(i, out=p.grad())
+                keys.append(i)
+                grads.append(p.grad())
+            if not keys:
+                return
+            self._kvstore.push(keys, grads)
+            self._kvstore.pull(keys, out=grads)
+            for g in grads:
+                _autograd.mark_grad_shared(g)
 
     def step(self, batch_size, ignore_stale_grad=False):
         self.allreduce_grads()

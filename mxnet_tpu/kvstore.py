@@ -38,7 +38,18 @@ class KVStore:
             self._store[k] = v.copy() if isinstance(v, NDArray) else NDArray(jnp.asarray(v))
 
     def push(self, key, value, priority=0):
+        """Push value(s) for key(s); a whole pushed list-key batch updates
+        in ONE fused dispatch when an updater is set.
+
+        ``priority`` (ref: include/mxnet/kvstore.h) is a scheduling *hint*:
+        upstream's async engine runs higher-priority pushes sooner. Dispatch
+        here is synchronous XLA program order, so a single int cannot
+        reorder anything — it is validated rather than silently dropped.
+        Extension: a per-key list/tuple of ints orders the batch
+        (descending priority, stable), the one observable scheduling effect
+        left in a synchronous engine."""
         keys, values = _normalize(key, value)
+        keys, values = _apply_priority(keys, values, priority)
         batch_k, batch_g = [], []
         for k, v in zip(keys, values):
             agg = _aggregate(v)
@@ -63,7 +74,12 @@ class KVStore:
                                      [self._store[k] for k in batch_k])
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        """Pull current value(s) for key(s) into ``out``. ``priority`` is
+        the same scheduling hint as in :meth:`push` — validated, never
+        silently dropped. Pulls are pure reads, so the hint cannot change
+        anything observable here; results always come back in key order."""
         keys, outs = _normalize(key, out)
+        _check_priority(priority, len(keys))
         results = []
         for k, o in zip(keys, outs):
             v = self._store[k]
@@ -76,6 +92,9 @@ class KVStore:
         return results if len(results) > 1 else results[0]
 
     def pushpull(self, key, value, out=None, priority=0):
+        """Fused push+pull (ref: python/mxnet/kvstore.py:pushpull).
+        ``priority`` follows the push/pull semantics above: a scheduling
+        hint, validated and applied to the push ordering."""
         self.push(key, value, priority)
         return self.pull(key, out or value, priority)
 
@@ -199,6 +218,7 @@ class DistKVStore(KVStore):
 
     def push(self, key, value, priority=0):
         keys, values = _normalize(key, value)
+        keys, values = _apply_priority(keys, values, priority)
         for k, v in zip(keys, values):
             agg = _aggregate(v)
             if self._compression is not None:
@@ -256,6 +276,31 @@ def _allreduce_across_hosts(x):
     garr = jax.make_array_from_process_local_data(
         NamedSharding(mesh, PartitionSpec("p")), rep)
     return jnp.asarray(local_np(reducer(garr)))
+
+
+def _check_priority(priority, n_keys):
+    """Validate the ``priority`` scheduling hint (int, or one int per key).
+    A bad value raises instead of being silently swallowed — the hint is
+    part of the API contract even where a synchronous engine cannot act
+    on it (ref: include/mxnet/kvstore.h Push/Pull priority)."""
+    if isinstance(priority, (list, tuple)):
+        if len(priority) != n_keys:
+            raise ValueError("priority list has %d entries for %d keys"
+                             % (len(priority), n_keys))
+        for p in priority:
+            int(p)
+    else:
+        int(priority)
+
+
+def _apply_priority(keys, values, priority):
+    """Order a list-key batch by descending priority (stable). With the
+    default scalar hint the order is untouched."""
+    _check_priority(priority, len(keys))
+    if isinstance(priority, (list, tuple)) and len(keys) > 1:
+        order = sorted(range(len(keys)), key=lambda i: -int(priority[i]))
+        return [keys[i] for i in order], [values[i] for i in order]
+    return keys, values
 
 
 def _normalize(key, value):

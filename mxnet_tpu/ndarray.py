@@ -514,7 +514,10 @@ def _flush_window():
     outs = []
     for node in nodes:
         nd_out = node.ref()
-        if nd_out is not None:
+        # `_lazy is node` guard: an output whose value was bound by another
+        # path (compiled tape backward returns head values; a _data write)
+        # must not be clobbered with a rebind here
+        if nd_out is not None and nd_out._lazy is node:
             outs.append((node._idx, nd_out))
     key = (tuple(w.key_parts), tuple(w.leaf_sigs),
            tuple(i for i, _ in outs))
@@ -575,8 +578,46 @@ def _unwrap(x):
     return x._data if isinstance(x, NDArray) else x
 
 
+# dtype -> bool (issubdtype is too slow for per-op use); the dtype universe
+# is ~a dozen entries, the cap is belt-and-braces (graphlint GL006)
+_INEXACT_CACHE = _BoundedCache(64)
+
+
+def _dtype_inexact(dt):
+    r = _INEXACT_CACHE.get(dt)
+    if r is None:
+        r = _INEXACT_CACHE[dt] = bool(jnp.issubdtype(dt, jnp.inexact))
+    return r
+
+
 def _is_diff(x):
-    return isinstance(x, NDArray) and jnp.issubdtype(x.dtype, jnp.inexact)
+    return isinstance(x, NDArray) and _dtype_inexact(x.dtype)
+
+
+def _structural_args(args, traced_kw):
+    """(call_args, call_kw, ok) wiring entries for a slow-path recorded op
+    so the compiled tape replay can re-execute it (rng keys and other traced
+    kwargs become ("b", array) leaves). Any argument kind the replay cannot
+    wire positionally keeps the node opaque (ok=False)."""
+    ca = []
+    for a in args:
+        if isinstance(a, NDArray):
+            ca.append(("t", a, a._buf if a._lazy is None else None))
+        elif isinstance(a, (jax.Array, np.ndarray)):
+            ca.append(("b", a))
+        elif type(a) in (int, float, bool) or isinstance(a, _SCALARS):
+            ca.append(("s", a))
+        else:
+            return None, None, False
+    ckw = []
+    for k, v in traced_kw.items():
+        if isinstance(v, NDArray):
+            ckw.append((k, ("t", v, v._buf if v._lazy is None else None)))
+        elif isinstance(v, (jax.Array, np.ndarray)):
+            ckw.append((k, ("b", v)))
+        else:
+            return None, None, False
+    return tuple(ca), tuple(ckw), True
 
 
 _FAST_JIT = {}  # opname -> jitted fn (the no-kwargs hot path)
@@ -601,12 +642,16 @@ def invoke(opname, args, kwargs, _inner=False):
         with _profiler_mod.op_scope(opname):
             return invoke(opname, args, kwargs, True)
     opdef = OP_REGISTRY[opname]
-    # fast path: call outside recording (MXNet equivalent: cached-op handle
-    # lookup skipping full FFI parse). Skipped for rng/training ops (key
-    # injection) and multi-output ops (opdef.fast_ok, precomputed at
+    # fast path: cached-op-handle analogue. Skipped for rng/training ops
+    # (key injection) and multi-output ops (opdef.fast_ok, precomputed at
     # registration). The recording check is the inlined body of
-    # autograd.is_recording(): this line runs per op.
-    fast = opdef.fast_ok and not getattr(_autograd_tls, "recording", False)
+    # autograd.is_recording(): this line runs per op. Recorded ops take the
+    # fast path too when compiled tape replay is on — they DEFER into the
+    # bulk window and append a structural tape node instead of paying an
+    # eager jax.vjp dispatch (autograd module docstring).
+    rec = getattr(_autograd_tls, "recording", False)
+    fast = opdef.fast_ok and (not rec or (autograd._TAPE_COMPILE
+                                          and _engine._bulk_size > 0))
     if fast:
         if _engine._bulk_size > 0:
             # ---- lazy bulk deferral (the ThreadedEngine bulking analogue):
@@ -721,10 +766,40 @@ def invoke(opname, args, kwargs, _inner=False):
                     node.ref = weakref.ref(out)
                     nodes.append(node)
                     w.key_parts.append((opname, static_key, tuple(specs)))
-                    if idx + 1 >= _engine._bulk_size:
-                        _flush_window()  # watermark: window full, dispatch
+                    if rec and not opdef.nondiff:
+                        # structural tape node: full arg wiring, buffers
+                        # captured for concrete inputs (lazy ones resolve
+                        # through their tape producer at lowering time)
+                        call_args, diff_pos, t_inputs = [], [], []
+                        for ai, a in enumerate(args):
+                            if type(a) is NDArray:
+                                call_args.append(
+                                    ("t", a,
+                                     a._buf if a._lazy is None else None))
+                                if _dtype_inexact(a.dtype):
+                                    diff_pos.append(ai)
+                                    t_inputs.append(a)
+                            elif isinstance(a, (jax.Array, np.ndarray)):
+                                call_args.append(("b", a))
+                            else:
+                                call_args.append(("s", a))
+                        if t_inputs:
+                            autograd.append_node(autograd.TapeNode.structural(
+                                opname, opdef.fn, kwargs, static_key,
+                                tuple(call_args), (), tuple(diff_pos), (),
+                                t_inputs, [out]))
+                    if not rec and idx + 1 >= _engine._bulk_size:
+                        # watermark: window full, dispatch. Suspended while
+                        # recording — the tape anchors every output anyway,
+                        # and the whole region wants to reach backward()
+                        # undispatched (a flush mid-record stays CORRECT,
+                        # structural nodes replay from leaves regardless;
+                        # it would just cost extra dispatches)
+                        _flush_window()
                     return out
-        if not kwargs:
+        if rec:
+            f = None  # recording + deferral bailed: the vjp path below
+        elif not kwargs:
             f = _FAST_JIT.get(opname)
             if f is None:
                 # seed from base.jitted so the slow path's out= branch
@@ -785,8 +860,23 @@ def invoke(opname, args, kwargs, _inner=False):
         outs_flat, treedef = jax.tree_util.tree_flatten(out)
         wrapped = [NDArray(o) for o in outs_flat]
         inputs = [args[i] for i in diff_pos] + [traced_kw[k] for k in diff_kw]
-        autograd.append_node(autograd.TapeNode(inputs, wrapped, vjp_fn,
-                                               primal_fn=g))
+        node = autograd.TapeNode(inputs, wrapped, vjp_fn, primal_fn=g)
+        # structural replay info wherever the arg kinds are wireable: lets
+        # the compiled tape backward cover rng/training/multi-output ops
+        # too (the recorded key array replays as a leaf, so the program is
+        # deterministic). The eager vjp above still ran — only backward's
+        # per-node dispatches are saved for these.
+        call_args, call_kw, s_ok = _structural_args(args, traced_kw)
+        if s_ok:
+            node.op = opname
+            node.fn = fn
+            node.static = static
+            node.static_key = _freeze(static)
+            node.call_args = call_args
+            node.call_kw = call_kw
+            node.diff_pos = tuple(diff_pos)
+            node.diff_kw = tuple(diff_kw)
+        autograd.append_node(node)
         result = jax.tree_util.tree_unflatten(treedef, wrapped)
     else:
         f = jitted(fn, static)

@@ -273,6 +273,14 @@ class Optimizer:
         n = len(params)
         if n == 0:
             return []
+        if donate:
+            # deferred imperative work (bulk window / recorded tape region)
+            # may still hold the CURRENT weight buffers as captured leaves;
+            # donating them would leave the eventual flush reading deleted
+            # arrays — drain the window first (no-op when nothing pends)
+            from . import engine
+
+            engine.flush()
         if indices is None:
             indices = list(range(n))
         for i in indices:

@@ -190,13 +190,9 @@ def op_scope(name):
     _record(name, (t0 - _epoch) * 1e6, (t1 - t0) * 1e3, cat="operator")
 
 
-@contextlib.contextmanager
-def bulk_scope(op_names):
-    """Instruments one flushed bulk-window dispatch (called from
-    ndarray._flush_window): the composed program carries the cost of every
-    deferred op it fuses, so the event is named after its constituents —
-    ``bulk[mul x5,add x5,tanh x5]`` — keeping per-op attribution readable
-    in the trace. The ``args.ops`` field holds the exact op sequence."""
+def _fused_label(op_names):
+    """``mul x5,add x5,tanh x5``-style constituent label for a fused
+    program event (shared by bulk_scope and backward_scope)."""
     counts = {}
     for n in op_names:
         counts[n] = counts.get(n, 0) + 1
@@ -204,12 +200,37 @@ def bulk_scope(op_names):
                      for n, c in counts.items())
     if len(label) > 120:
         label = label[:117] + "..."
+    return label
+
+
+@contextlib.contextmanager
+def _fused_scope(kind, op_names):
+    name = "%s[%s]" % (kind, _fused_label(op_names))
     t0 = time.perf_counter()
-    with jax.profiler.TraceAnnotation("bulk[%s]" % label):
+    with jax.profiler.TraceAnnotation(name):
         yield
     t1 = time.perf_counter()
-    _record("bulk[%s]" % label, (t0 - _epoch) * 1e6, (t1 - t0) * 1e3,
+    _record(name, (t0 - _epoch) * 1e6, (t1 - t0) * 1e3,
             cat="operator", args={"ops": list(op_names)})
+
+
+def bulk_scope(op_names):
+    """Instruments one flushed bulk-window dispatch (called from
+    ndarray._flush_window): the composed program carries the cost of every
+    deferred op it fuses, so the event is named after its constituents —
+    ``bulk[mul x5,add x5,tanh x5]`` — keeping per-op attribution readable
+    in the trace. The ``args.ops`` field holds the exact op sequence."""
+    return _fused_scope("bulk", op_names)
+
+
+def backward_scope(op_names):
+    """Instruments one compiled tape-replay dispatch (called from
+    autograd._compiled_backward): the single program carries primal replay
+    plus the vjp of every recorded op it fuses, named
+    ``backward[mul x17,add x16,...]`` — the backward mirror of the
+    ``bulk[...]`` events. The ``args.ops`` field holds the replayed op
+    sequence in tape order."""
+    return _fused_scope("backward", op_names)
 
 
 class Domain:

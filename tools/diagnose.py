@@ -51,6 +51,19 @@ def main():
     import mxnet_tpu
     print("%-16s: %s" % ("mxnet_tpu", mxnet_tpu.__version__))
 
+    print("----------Autograd Tape Replay----------")
+    # compiled tape replay state (autograd module docstring): the knob, the
+    # program cache, and the hit/miss counters backing the zero-retrace
+    # contract — attach when reporting backward()-speed regressions
+    from mxnet_tpu import autograd as _ag, base as _base, engine as _eng
+    print("tape compile : %s (MXNET_TAPE_COMPILE)"
+          % ("on" if _ag.tape_compile_enabled() else "off — eager walk"))
+    print("program cache: %d entries / cap %d (MXNET_TAPE_CACHE_CAP)"
+          % (len(_base._TAPE_CACHE), _base._TAPE_CACHE.cap))
+    print("cache hits   : %d   compiles (misses): %d"
+          % (_eng.tape_cache_hit_counter.count,
+             _eng.tape_compile_counter.count))
+
     print("----------Graphlint Summary----------")
     # tracing-hygiene static pass over the package (tools/graphlint.py);
     # anything non-allowlisted here also fails the tier-1 suite

@@ -707,17 +707,19 @@ def main():
     if "--cpu" in flags:
         jax.config.update("jax_platforms", "cpu")
     mode = args[0] if args else "bert"
-    if mode in ("optstep", "imperative", "autograd"):
+    if mode in ("optstep", "imperative", "autograd", "serve"):
         # host-dispatch microbenches (fused multi-tensor optimizer step;
         # lazy bulk imperative chain vs eager; compiled tape replay vs the
-        # eager backward walk) — separate from the MODES table: they measure
-        # host dispatch overhead, not model throughput, and are never
+        # eager backward walk; dynamic-batched serving vs per-request
+        # dispatch) — separate from the MODES table: they measure host
+        # dispatch overhead, not model throughput, and are never
         # persisted/replayed. --smoke/--cpu run the CPU-pinned --quick
         # variant.
         import importlib.util
         tool = {"optstep": "opt_step_bench.py",
                 "imperative": "imperative_bench.py",
-                "autograd": "autograd_bench.py"}[mode]
+                "autograd": "autograd_bench.py",
+                "serve": "serve_bench.py"}[mode]
         spec = importlib.util.spec_from_file_location(
             tool[:-3], os.path.join(_REPO, "tools", tool))
         m = importlib.util.module_from_spec(spec)

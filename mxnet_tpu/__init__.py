@@ -67,5 +67,6 @@ from . import registry  # generic register/alias/create machinery
 from . import libinfo  # native lib paths + parity version line
 from . import kvstore_server  # justified N/A: no PS role on this backend
 from . import analysis  # graphlint: tracing-hygiene static + trace checks
+from . import serve  # dynamic-batching inference on bucketed executors
 
 __all__ = ["nd", "gluon", "autograd", "cpu", "gpu", "tpu", "Context", "NDArray"]

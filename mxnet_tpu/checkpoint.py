@@ -42,14 +42,41 @@ def load_checkpoint(prefix, epoch, block=None, trainer=None):
 
 
 def save_arrays(path, arrays):
-    """dict[str, NDArray|jax.Array] → npz (host-gathered)."""
-    np.savez(path, **{k: np.asarray(v._data if isinstance(v, NDArray) else v)
-                      for k, v in arrays.items()})
+    """dict[str, NDArray|jax.Array] → npz (host-gathered, dtype-exact:
+    bf16 arrays round-trip as bf16, see util.save_npz_exact)."""
+    from .util import save_npz_exact
+    save_npz_exact(path, {k: np.asarray(v._data if isinstance(v, NDArray)
+                                        else v)
+                          for k, v in arrays.items()})
 
 
 def load_arrays(path):
-    loaded = np.load(path)
-    return {k: NDArray(jax.numpy.asarray(loaded[k])) for k in loaded.files}
+    from .util import load_npz_exact
+    return {k: NDArray(jax.numpy.asarray(v))
+            for k, v in load_npz_exact(path).items()}
+
+
+def save_for_serving(prefix, block, epoch=0, input_names=("data",),
+                     input_shapes=None):
+    """Export a hybridized block in the serving layout — ``prefix-symbol.json``
+    + ``prefix-NNNN.params`` (HybridBlock.export), dtype-exact so a reload
+    restores into an executor pool with the SAME compiled leaf signatures.
+    Returns (symbol_file, params_file)."""
+    return block.export(prefix, epoch=epoch, input_names=input_names,
+                        input_shapes=input_shapes)
+
+
+def load_for_serving(prefix, epoch=0, input_names=("data",), ctx=None):
+    """Warm-start load for mxnet_tpu.serve: rebuild the exported block as a
+    SymbolBlock whose parameters carry the FILE's exact dtypes/shapes, so
+    an executor pool built over it compiles the same bucket programs as the
+    exporting process — reload must not retrace (the regression
+    tests/test_serve.py pins covered a bf16 export reloading as fp32 and
+    recompiling every bucket)."""
+    from .gluon.block import SymbolBlock
+
+    return SymbolBlock.imports("%s-symbol.json" % prefix, list(input_names),
+                               "%s-%04d.params" % (prefix, epoch), ctx=ctx)
 
 
 def save_sharded(directory, pytree, step=0):

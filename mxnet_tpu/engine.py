@@ -64,6 +64,14 @@ bulk_compile_counter = DispatchCounter()
 tape_compile_counter = DispatchCounter()
 tape_cache_hit_counter = DispatchCounter()
 
+# serving executor pool (mxnet_tpu.serve): bumps once per bucket-program
+# BUILD (an XLA trace of a pool's inference function — the bump sits inside
+# the traced body, so it fires exactly when jax re-traces). Warmup compiles
+# all configured buckets up front; a steady-state request stream must not
+# bump it — the zero-retrace assertion tests/test_serve.py makes, same
+# discipline as bulk_compile_counter/tape_compile_counter.
+serve_compile_counter = DispatchCounter()
+
 
 try:
     _bulk_size = int(os.environ.get("MXNET_ENGINE_BULK_SIZE", "15"))

@@ -206,8 +206,9 @@ class Block:
 
     def load_parameters(self, filename, ctx=None, allow_missing=False,
                         ignore_extra=False, cast_dtype=False, dtype_source="current"):
+        from ..util import load_npz_exact
         params = self._collect_params_with_prefix()
-        loaded = dict(np.load(filename, allow_pickle=False))
+        loaded = load_npz_exact(filename)
         if loaded and params and not (set(loaded) & set(params)):
             # legacy file saved with global names (pre-structural format or
             # ParameterDict.save): fall back to prefix-stripped matching
@@ -395,9 +396,47 @@ class HybridBlock(Block):
         params_file = "%s-%04d.params" % (path, epoch)
         payload = {p.name: np.asarray(p.data()._data)
                    for p in self.collect_params().values()}
-        with open(params_file, "wb") as fh:  # exact filename, no .npz suffix
-            np.savez(fh, **payload)
+        # dtype-exact npz (bf16-safe): SymbolBlock.imports / serve warm-start
+        # must see the same leaf dtypes the exporting pool compiled with
+        from ..util import save_npz_exact
+        save_npz_exact(params_file, payload)
         return sym_file, params_file
+
+    # ------------------------------------------------------------ serving
+    def serving_fn(self):
+        """Export→serve handoff: the EVAL-mode pure function of this block
+        for mxnet_tpu.serve's executor pool —
+        ``fn(param_arrays, *inputs) -> outputs``. Training is False and the
+        PRNG key is a trace-time constant (dropout is off in eval, so no
+        per-call noise is lost); BatchNorm running-stat updates are NOT
+        applied — serving must never mutate the model. Params must be
+        initialized with known shapes (run one forward first for deferred
+        shapes)."""
+        plist = list(self.collect_params().values())
+        for p in plist:
+            if p._data is None:
+                if p._deferred_init is not None and p._shape_known():
+                    p._finish_deferred_init()
+                else:
+                    raise RuntimeError(
+                        "serving_fn: parameter %r has no materialized "
+                        "shape — run one forward (or initialize with "
+                        "explicit shapes) before serving" % p.name)
+        key = jax.random.PRNGKey(0)
+
+        def pure(pa, *xs):
+            with _trace.trace_scope(key, False) as tctx:
+                tctx.param_store = {id(p): a for p, a in zip(plist, pa)}
+                return self._call_traced(*xs)
+
+        return pure, [p.data()._data for p in plist]
+
+    def serve(self, input_specs, **kwargs):
+        """Convenience constructor for a dynamic-batching server over this
+        block (see mxnet_tpu.serve.ModelServer for the knobs)."""
+        from .. import serve as _serve
+
+        return _serve.ModelServer(self, input_specs, **kwargs)
 
     # ------------------------------------------------------------ traced
     def _call_traced(self, *args, **kwargs):
@@ -436,6 +475,11 @@ class HybridBlock(Block):
         pa = [p._data._data for p in plist]
         xs = [a._data if isinstance(a, NDArray) else a for a in args]
         key = _random.next_key()
+        # one call into a compiled program = one dispatch (the counter's
+        # contract, engine.DispatchCounter) — lets serving/bench compare
+        # per-request block calls against pooled batch dispatches
+        from ..engine import dispatch_counter
+        dispatch_counter.bump()
 
         if autograd.is_recording():
             def f(pa_, *xs_):
@@ -516,19 +560,22 @@ class SymbolBlock(HybridBlock):
         inputs = [var(n) for n in input_names]
         blk = cls(out, inputs)
         if param_file is not None:
-            import numpy as np
-
             import jax.numpy as jnp
 
-            loaded = np.load(param_file, allow_pickle=False)
+            from ..util import load_npz_exact
+            loaded = load_npz_exact(param_file)
             from .parameter import Parameter
 
             for name in out.list_arguments():
                 if name in input_names:
                     continue
-                if name in loaded.files:
-                    p = Parameter(name, shape=loaded[name].shape)
-                    p.set_data(jnp.asarray(loaded[name]))
+                if name in loaded:
+                    arr = loaded[name]
+                    # the FILE's dtype is the parameter's dtype (a bf16
+                    # export must reload as bf16 — the default fp32 would
+                    # silently upcast and retrace the serving pool)
+                    p = Parameter(name, shape=arr.shape, dtype=arr.dtype)
+                    p.set_data(jnp.asarray(arr))
                     blk._params._params[name] = p
         return blk
 
@@ -559,13 +606,55 @@ class SymbolBlock(HybridBlock):
             outs = _substitute(self._outputs, mapping)
             return outs[0] if len(outs) == 1 else outs
 
-        feed = {s.name: (a._data if isinstance(a, NDArray) else a)
-                for s, a in zip(self._inputs, args)}
-        for name, p in self.collect_params().items():
-            feed[name] = p.data()._data
-        outs = _eval_symbols(self._outputs, feed)
+        pool = self._infer_pool()
+        vals = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
+                for a in args]
+        if pool is not None:
+            # deterministic eval graph: the shared executor-pool helper
+            # (serve.executor_pool) — one cached compiled program per input
+            # signature replaces the old per-call evaluation walk (one
+            # dispatch per graph node, every call). Exact-signature mode:
+            # a bare graph cannot declare which inputs carry a batch axis,
+            # so zero-row padding is never assumed here (ModelServer, with
+            # explicit input_specs, is the padding/bucketing layer).
+            outs = pool.run_device(vals)
+        else:
+            # stochastic eval graph (mode='always' dropout): per-call
+            # evaluation draws fresh noise, which a cached program can't
+            feed = {s.name: v for s, v in zip(self._inputs, vals)}
+            for name, p in self.collect_params().items():
+                feed[name] = p.data()._data
+            outs = _eval_symbols(self._outputs, feed)
         outs = [NDArray(o) for o in outs]
         return outs[0] if len(outs) == 1 else outs
+
+    def _infer_pool(self):
+        """Cached executor pool over the stored graph (None when the eval
+        graph is stochastic). Invalidation rides the existing _cached_execs
+        lifecycle (cast/hybridize clear it); parameter set_data needs none —
+        the pool reads current values per call."""
+        cached = self._cached_execs.get("_pool")
+        if cached is not None:
+            return cached[0]
+        from ..serve.executor_pool import BucketedExecutor, symbol_infer_fn
+
+        input_names = [s.name for s in self._inputs]
+        fn, pnames = symbol_infer_fn(self._outputs, input_names)
+        params = self.collect_params() if fn is not None else None
+        if fn is None or any(n not in params for n in pnames):
+            # stochastic, or unbound free vars: the per-call evaluation
+            # path owns those (and raises its usual error for the latter)
+            pool = None
+        else:
+            plist = [params[n] for n in pnames]
+
+            def params_fn():
+                return [p.data()._data for p in plist]
+
+            pool = BucketedExecutor(fn, params_fn, pad=False,
+                                    name="symbolblock")
+        self._cached_execs["_pool"] = (pool,)
+        return pool
 
     def hybrid_forward(self, F, *args, **kwargs):
         raise RuntimeError("SymbolBlock executes its graph directly")

@@ -265,8 +265,9 @@ class ParameterDict:
 
     def load(self, filename, ctx=None, allow_missing=False,
              ignore_extra=False, restore_prefix=""):
-        loaded = np.load(filename, allow_pickle=False)
-        loaded = {restore_prefix + k: v for k, v in loaded.items()}
+        from ..util import load_npz_exact
+        loaded = {restore_prefix + k: v
+                  for k, v in load_npz_exact(filename).items()}
         for name, p in self.items():
             if name in loaded:
                 p.set_data(NDArray(jnp.asarray(loaded[name])))

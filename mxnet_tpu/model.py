@@ -39,11 +39,12 @@ def load_checkpoint(prefix, epoch):
     path = "%s-%04d.params" % (prefix, epoch)
     if not os.path.exists(path) and os.path.exists(path + ".npz"):
         path += ".npz"  # files written before the exact-name fix
-    data = np.load(path)
+    from .util import load_npz_exact
+    data = load_npz_exact(path)
     arg_params, aux_params = {}, {}
-    for k in data.files:
+    for k, v in data.items():
         kind, name = k.split(":", 1)
-        (arg_params if kind == "arg" else aux_params)[name] = NDArray(data[k])
+        (arg_params if kind == "arg" else aux_params)[name] = NDArray(v)
     return symbol, arg_params, aux_params
 
 

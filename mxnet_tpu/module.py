@@ -34,6 +34,7 @@ class Module:
         self._opt_states = {}
         self._n_main_outputs = 1
         self._aux_update_names = []
+        self._pred_pool = None
         self.binded = False
         self.params_initialized = False
 
@@ -50,6 +51,7 @@ class Module:
         self._for_training = for_training
         self._inputs_need_grad = inputs_need_grad
         self._exec = None
+        self._pred_pool = None  # rebind invalidates the inference pool
         self.binded = True
 
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
@@ -74,6 +76,7 @@ class Module:
             initializer(init_mod.InitDesc(n), arr)
             self._arg_params[n] = arr
             arr.attach_grad()
+        self._pred_pool = None  # pool captures param objects; re-resolve
         self.params_initialized = True
 
     def _infer_param_shapes(self):
@@ -307,19 +310,89 @@ class Module:
         labels = [NDArray(l._data[:l.shape[0] - pad]) for l in labels]
         return outs, labels
 
+    def _predict_pool(self):
+        """Shared bucketed inference executor (serve.executor_pool) for
+        predict/score-style eval: ONE compiled program at the bound-batch
+        bucket serves every batch — including the iterator's final padded
+        partial batch, which the bound executor used to retrace at its
+        smaller shape. Returns (pool, input_names), or (None, None) when
+        the graph isn't poolable (stochastic eval graph, missing params) —
+        the per-batch forward path serves those."""
+        if self._pred_pool is not None:
+            return self._pred_pool
+        from .serve.executor_pool import BucketedExecutor, symbol_infer_fn
+
+        self._pred_pool = (None, None)
+        shapes = getattr(self, "_data_shapes", None)
+        if shapes and self.params_initialized:
+            arg_names = set(self._symbol.list_arguments())
+            input_names = [n for n in self._data_names + self._label_names
+                           if n in arg_names and n in shapes]
+            fn, pnames = symbol_infer_fn([self._symbol], input_names)
+            if fn is not None and all(n in self._arg_params for n in pnames):
+                plist = [self._arg_params[n] for n in pnames]
+
+                def params_fn():
+                    return [p._data for p in plist]
+
+                bucket = shapes[self._data_names[0]][0]
+                self._pred_pool = (
+                    BucketedExecutor(fn, params_fn, buckets=(bucket,),
+                                     name="module.predict"), input_names)
+        return self._pred_pool
+
+    def _pool_batch_inputs(self, batch, input_names, rows):
+        """Assemble predict-pool inputs from a DataBatch; absent labels
+        (predict on unlabeled iterators) feed zeros at the bound shape —
+        eval outputs can't depend on them row-wise."""
+        feed = dict(zip(self._data_names, batch.data))
+        if batch.label:
+            feed.update(zip(self._label_names, batch.label))
+        ins = []
+        for n in input_names:
+            a = feed.get(n)
+            if a is None:
+                ins.append(np.zeros((rows,) + tuple(self._data_shapes[n][1:]),
+                                    np.float32))
+            else:
+                ins.append(a.asnumpy() if isinstance(a, NDArray)
+                           else np.asarray(a))
+        return ins
+
     def predict(self, eval_data, num_batch=None, merge_batches=True,
                 reset=True, always_output_list=False):
         """(ref: base_module.py:predict) — run inference over an iterator,
-        concatenating per-batch outputs along axis 0."""
+        concatenating per-batch outputs along axis 0. Deterministic graphs
+        route through the shared bucketed executor pool (one compiled
+        program for all batches, partial final batch padded); others fall
+        back to the per-batch bound-executor forward."""
         if reset and hasattr(eval_data, "reset"):
             eval_data.reset()
+        pool, input_names = self._predict_pool()
         per_batch = []  # list over batches of the (pad-stripped) output list
         for i, batch in enumerate(eval_data):
             if num_batch is not None and i >= num_batch:
                 break
+            pad = getattr(batch, "pad", 0) or 0
+            if pool is not None:
+                from .serve.executor_pool import PoolError
+
+                rows = batch.data[0].shape[0]
+                try:
+                    ins = self._pool_batch_inputs(batch, input_names, rows)
+                    outs = pool.run(ins, n_real=rows - pad)
+                except PoolError:  # e.g. a batch wider than the bound bucket
+                    outs = None
+                if outs is not None and pool.row_aligned:
+                    per_batch.append([NDArray(o) for o in outs])
+                    continue
+                # outputs don't carry the batch on axis 0 (or the batch
+                # doesn't fit the bucket): padding is not sliceable —
+                # disable the pool and recompute via forward
+                pool = None
+                self._pred_pool = (None, None)
             self.forward(batch, is_train=False)
             outs = self.get_outputs()
-            pad = getattr(batch, "pad", 0) or 0
             if pad:
                 outs = [NDArray(o._data[:o.shape[0] - pad]) for o in outs]
             per_batch.append(outs)
@@ -481,6 +554,12 @@ class BucketingModule(Module):
         key = self._default_key if key is None else key
         m = self.switch_bucket(key)
         return m.forward(data_batch, is_train)
+
+    def _predict_pool(self):
+        # bucketing modules pick their graph per batch (bucket_key), so a
+        # single pooled program can't serve predict — per-bucket executors
+        # already are the bucketed cache here
+        return None, None
 
     def backward(self, out_grads=None):
         self._curr_module.backward(out_grads)

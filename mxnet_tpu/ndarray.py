@@ -1051,18 +1051,20 @@ def save(fname, data):
     else:
         raise ValueError("data must be NDArray, list of NDArray, or "
                          "dict of str to NDArray, got %s" % type(data))
-    with open(fname, "wb") as fh:  # keep the exact name (np.savez appends .npz)
-        np.savez(fh, **payload)
+    # dtype-exact npz (bfloat16-safe; keeps the exact filename)
+    from .util import save_npz_exact
+    save_npz_exact(fname, payload)
 
 
 def load(fname):
     """Load NDArrays saved by ``save`` — returns a list or a dict matching
     the saved container (ref: python/mxnet/ndarray/utils.py:load)."""
-    with np.load(fname) as f:
-        keys = [k for k in f.files if k != "__kind__"]
-        kind = int(f["__kind__"]) if "__kind__" in f.files else (
-            0 if keys and all(k.startswith("l:") for k in keys) else 1)
-        if kind == 0:
-            return [NDArray(jnp.asarray(f[k])) for k in sorted(keys)]
-        return {k[2:] if k.startswith("d:") else k: NDArray(jnp.asarray(f[k]))
-                for k in keys}
+    from .util import load_npz_exact
+    f = load_npz_exact(fname)
+    keys = [k for k in f if k != "__kind__"]
+    kind = int(f["__kind__"]) if "__kind__" in f else (
+        0 if keys and all(k.startswith("l:") for k in keys) else 1)
+    if kind == 0:
+        return [NDArray(jnp.asarray(f[k])) for k in sorted(keys)]
+    return {k[2:] if k.startswith("d:") else k: NDArray(jnp.asarray(f[k]))
+            for k in keys}

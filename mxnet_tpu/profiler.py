@@ -223,6 +223,22 @@ def bulk_scope(op_names):
     return _fused_scope("bulk", op_names)
 
 
+@contextlib.contextmanager
+def serve_scope(bucket, n_real):
+    """Instruments one served-batch dispatch (called from
+    serve.executor_pool when the profiler runs): the event is named
+    ``serve[b32 fill=0.75]`` — compiled bucket size plus how much of it the
+    coalesced requests actually filled — so batching efficiency reads
+    directly off the trace next to the XLA kernels it feeds."""
+    name = "serve[b%d fill=%.2f]" % (bucket, n_real / max(bucket, 1))
+    t0 = time.perf_counter()
+    with jax.profiler.TraceAnnotation(name):
+        yield
+    t1 = time.perf_counter()
+    _record(name, (t0 - _epoch) * 1e6, (t1 - t0) * 1e3, cat="serve",
+            args={"bucket": bucket, "rows": n_real})
+
+
 def backward_scope(op_names):
     """Instruments one compiled tape-replay dispatch (called from
     autograd._compiled_backward): the single program carries primal replay

@@ -1,0 +1,64 @@
+"""mxnet_tpu.serve — dynamic-batching inference serving on bucketed
+compiled executors.
+
+The serving layer the reference shipped as mxnet-model-server on top of
+``Module.predict``/CachedOp, rebuilt TPU-native: a model compiles once per
+batch-size bucket (executor_pool), single requests coalesce into the
+largest fitting bucket under a deadline (batcher), batches round-robin
+over device replicas, and the whole thing is observable (metrics →
+``serve.stats()`` + profiler events). See README "Serving" and
+MIGRATING.md for the mxnet-model-server mapping.
+
+    import mxnet_tpu as mx
+    net = ...hybridized block...
+    with mx.serve.ModelServer(net, [((3, 224, 224), "float32")]) as srv:
+        out = srv.predict(img)
+
+    blk = mx.serve.load("export/model", epoch=0)     # warm-start a export
+    mx.serve.stats()                                 # all live servers
+"""
+from __future__ import annotations
+
+import weakref
+
+from .batcher import (DynamicBatcher, ServeError, ServerBusy,  # noqa: F401
+                      ServeTimeout)
+from .executor_pool import (BucketedExecutor, PoolError,  # noqa: F401
+                            symbol_infer_fn)
+from .metrics import ServeMetrics  # noqa: F401
+from .server import DEFAULT_BUCKETS, ModelServer  # noqa: F401
+
+__all__ = ["ModelServer", "BucketedExecutor", "DynamicBatcher",
+           "ServeMetrics", "ServeError", "ServerBusy", "ServeTimeout",
+           "PoolError", "DEFAULT_BUCKETS", "load", "stats"]
+
+# live-server registry for the aggregate stats() snapshot; weak so a
+# dropped server never lingers (and the registry never grows unbounded)
+_SERVERS = weakref.WeakSet()
+
+
+def _register(server):
+    _SERVERS.add(server)
+
+
+def load(prefix, epoch=0, input_names=("data",), ctx=None):
+    """Warm-start a served model from an export/checkpoint layout
+    (``prefix-symbol.json`` + ``prefix-NNNN.params``): returns a
+    SymbolBlock with the file's exact dtypes, ready for ModelServer —
+    reload compiles the same bucket programs as the exporting process
+    (checkpoint.load_for_serving)."""
+    from ..checkpoint import load_for_serving
+
+    return load_for_serving(prefix, epoch=epoch, input_names=input_names,
+                            ctx=ctx)
+
+
+def stats():
+    """Snapshot of every live server, keyed by server name, plus the
+    process-wide compile counter — what tools/diagnose.py prints."""
+    from .. import engine
+
+    return {
+        "serve_compile_counter": engine.serve_compile_counter.count,
+        "servers": {s.name: s.stats() for s in list(_SERVERS)},
+    }

@@ -1,0 +1,220 @@
+"""Dynamic request batcher (ref: mxnet-model-server's BatchAggregator —
+mms/service_manager + the TF-Serving shared-batch-scheduler shape).
+
+Single requests land in a bounded thread-safe queue; a worker coalesces
+them into the largest bucket that fits under a ``max_wait_ms`` deadline —
+the first request in a window starts the clock, late arrivals ride along
+until the batch fills or the deadline passes. Admission control sheds load
+at enqueue time (typed ``ServerBusy``, never silent drops); each request
+carries its own timeout and is failed with ``ServeTimeout`` if a result
+hasn't arrived in time. Dispatch (pad → compiled bucket program → split
+results back per request) is delegated to the callable the server wires in,
+run on a small per-replica dispatcher pool so replicas overlap.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+
+
+class ServeError(RuntimeError):
+    """Base class for typed serving failures."""
+
+
+class ServerBusy(ServeError):
+    """Admission control: the request queue is full (load shedding)."""
+
+
+class ServeTimeout(ServeError):
+    """The per-request deadline passed before a result arrived."""
+
+
+class _Request:
+    __slots__ = ("inputs", "n", "t_submit", "deadline", "_event", "_result",
+                 "_error", "_done")
+
+    def __init__(self, inputs, n, timeout_ms):
+        self.inputs = inputs
+        self.n = n  # rows this request contributes to a batch
+        self.t_submit = time.perf_counter()
+        self.deadline = (self.t_submit + timeout_ms / 1e3
+                         if timeout_ms else None)
+        self._event = threading.Event()
+        self._result = None
+        self._error = None
+        self._done = False
+
+    # finish() is idempotent under race (batcher result vs. timeout sweep):
+    # first writer wins, the event releases every waiter exactly once
+    def finish(self, result=None, error=None):
+        if self._done:
+            return False
+        self._done = True
+        self._result = result
+        self._error = error
+        self._event.set()
+        return True
+
+    def expired(self, now=None):
+        return self.deadline is not None \
+            and (now or time.perf_counter()) > self.deadline
+
+    def result(self, timeout_s=None):
+        if not self._event.wait(timeout_s):
+            raise ServeTimeout("no result within %.1fs" % (timeout_s or 0))
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def done(self):
+        return self._done
+
+
+class DynamicBatcher:
+    """Coalesces requests and hands batches to ``dispatch_fn``.
+
+    dispatch_fn(requests, total_rows) is called on a dispatcher thread with
+    a list of requests whose rows sum to ≤ max_batch; it must finish() every
+    request (result or error).
+    """
+
+    def __init__(self, dispatch_fn, max_batch, max_wait_ms=2.0,
+                 max_queue=256, num_dispatchers=1, metrics=None):
+        self._dispatch_fn = dispatch_fn
+        self._max_batch = int(max_batch)
+        self._max_wait_s = float(max_wait_ms) / 1e3
+        self._max_queue = int(max_queue)
+        self._metrics = metrics
+        self._queue = deque()
+        self._queued_rows = 0
+        self._cond = threading.Condition()
+        self._stop = False
+        self._worker = None
+        # in-flight bound: without it the worker would drain the admission
+        # queue into the executor's unbounded backlog and load shedding
+        # would never fire — requests must WAIT IN the bounded queue while
+        # every dispatcher is busy
+        self._inflight = threading.Semaphore(max(1, int(num_dispatchers)))
+        self._pool = ThreadPoolExecutor(max(1, int(num_dispatchers)),
+                                        thread_name_prefix="serve-dispatch")
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self):
+        if self._worker is None or not self._worker.is_alive():
+            self._stop = False
+            self._worker = threading.Thread(target=self._loop, daemon=True,
+                                            name="serve-batcher")
+            self._worker.start()
+        return self
+
+    def stop(self, drain=True):
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._worker is not None:
+            self._worker.join(timeout=5.0)
+        if not drain:
+            with self._cond:
+                pending, self._queue = list(self._queue), deque()
+                self._queued_rows = 0
+            for r in pending:
+                r.finish(error=ServeError("server stopped"))
+        self._pool.shutdown(wait=True)
+
+    # ------------------------------------------------------------ admission
+    def submit(self, inputs, n_rows, timeout_ms=None):
+        """Enqueue one request (``n_rows`` ≥ 1 coalescible rows). Returns a
+        future-like handle; raises ServerBusy when the queue is full —
+        shedding at the door keeps tail latency bounded instead of letting
+        the queue grow into a multi-deadline backlog."""
+        req = _Request(inputs, int(n_rows), timeout_ms)
+        with self._cond:
+            if self._stop:
+                raise ServeError("server stopped")
+            if self._queued_rows + req.n > self._max_queue:
+                if self._metrics:
+                    self._metrics.record_shed()
+                raise ServerBusy(
+                    "queue full (%d rows queued, max %d)"
+                    % (self._queued_rows, self._max_queue))
+            self._queue.append(req)
+            self._queued_rows += req.n
+            if self._metrics:
+                self._metrics.record_admit()
+                self._metrics.record_queue_depth(self._queued_rows)
+            self._cond.notify()
+        return req
+
+    def queue_depth(self):
+        with self._cond:
+            return self._queued_rows
+
+    # ------------------------------------------------------------ worker
+    def _take_batch(self):
+        """Block until a deadline-ripe batch is ready; None on stop. Runs
+        under the condition lock except while waiting."""
+        with self._cond:
+            while True:
+                if self._stop and not self._queue:
+                    return None
+                # drop requests that expired while queued — dispatching them
+                # would waste a bucket slot on a caller that already left
+                now = time.perf_counter()
+                while self._queue and self._queue[0].expired(now):
+                    req = self._queue.popleft()
+                    self._queued_rows -= req.n
+                    if req.finish(error=ServeTimeout(
+                            "timed out after %.1fms in queue"
+                            % ((now - req.t_submit) * 1e3))) and self._metrics:
+                        self._metrics.record_timeout()
+                if not self._queue:
+                    if self._stop:
+                        return None
+                    self._cond.wait(0.05)
+                    continue
+                head = self._queue[0]
+                batch_deadline = head.t_submit + self._max_wait_s
+                if self._queued_rows >= self._max_batch \
+                        or now >= batch_deadline or self._stop:
+                    batch, rows = [], 0
+                    while self._queue and rows + self._queue[0].n \
+                            <= self._max_batch:
+                        req = self._queue.popleft()
+                        self._queued_rows -= req.n
+                        batch.append(req)
+                        rows += req.n
+                    if self._metrics:
+                        self._metrics.record_queue_depth(self._queued_rows)
+                    if batch:
+                        return batch, rows
+                    # head alone exceeds max_batch: caller bug — fail it
+                    req = self._queue.popleft()
+                    self._queued_rows -= req.n
+                    req.finish(error=ServeError(
+                        "request of %d rows exceeds max batch %d"
+                        % (req.n, self._max_batch)))
+                    continue
+                self._cond.wait(min(0.05, batch_deadline - now))
+
+    def _run_dispatch(self, batch, rows):
+        try:
+            self._dispatch_fn(batch, rows)
+        finally:
+            self._inflight.release()
+
+    def _loop(self):
+        while True:
+            # claim a dispatcher slot BEFORE popping a batch, so requests
+            # keep aging (and shedding) in the bounded queue when saturated
+            while not self._inflight.acquire(timeout=0.05):
+                with self._cond:
+                    if self._stop and not self._queue:
+                        return
+            got = self._take_batch()
+            if got is None:
+                self._inflight.release()
+                return
+            batch, rows = got
+            self._pool.submit(self._run_dispatch, batch, rows)

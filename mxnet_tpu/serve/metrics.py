@@ -1,0 +1,127 @@
+"""Serving observability (ref: mxnet-model-server metrics — QPS, latency
+percentiles, queue telemetry — mms/metrics/*; here collected in-process).
+
+One ``ServeMetrics`` instance per server/pool. Two export paths:
+
+* ``snapshot()`` — the ``serve.stats()`` dict tools/diagnose.py prints:
+  request/batch counters, shed/timeout/error counts, p50/p95/p99 request
+  latency, mean batch-fill ratio, current queue depth;
+* profiler counter events — when the profiler is running, queue depth and
+  shed/timeout totals are emitted as Chrome-trace 'C' tracks (and each
+  dispatched batch gets a ``serve[...]`` duration event from the pool via
+  profiler.serve_scope), so serving pressure lines up with the XLA trace.
+
+Latency percentiles come from a bounded ring of the most recent ``window``
+request latencies — O(1) per request, no unbounded growth in long-running
+servers (the same concern graphlint GL006 polices for caches).
+"""
+from __future__ import annotations
+
+import threading
+
+from .. import profiler
+
+
+class ServeMetrics:
+    def __init__(self, name="serve", window=2048):
+        self.name = name
+        self._lock = threading.Lock()
+        self._window = int(window)
+        self._lat = [0.0] * self._window  # ring buffer, ms
+        self._lat_n = 0                   # total latencies ever recorded
+        self.requests = 0                 # admitted requests
+        self.completed = 0
+        self.shed = 0                     # rejected at admission (ServerBusy)
+        self.timeouts = 0                 # expired before a result arrived
+        self.errors = 0                   # model/fault failures propagated
+        self.batches = 0                  # dispatched batches
+        self.batched_rows = 0             # real rows across batches
+        self.bucket_rows = 0              # padded bucket rows across batches
+        self._queue_depth = 0
+        # profiler 'C' counters are created lazily so importing serve never
+        # touches profiler state; events are only emitted while it runs
+        self._prof = None
+
+    # ------------------------------------------------------------ recording
+    def _counters(self):
+        if self._prof is None:
+            dom = profiler.Domain("serve")
+            self._prof = {
+                "queue": dom.new_counter("%s.queue_depth" % self.name),
+                "shed": dom.new_counter("%s.shed" % self.name),
+                "timeout": dom.new_counter("%s.timeouts" % self.name),
+            }
+        return self._prof
+
+    def record_admit(self, n=1):
+        with self._lock:
+            self.requests += n
+
+    def record_queue_depth(self, depth):
+        with self._lock:
+            self._queue_depth = depth
+        if profiler.is_running():
+            self._counters()["queue"].set_value(depth)
+
+    def record_shed(self, n=1):
+        with self._lock:
+            self.shed += n
+            total = self.shed
+        if profiler.is_running():
+            self._counters()["shed"].set_value(total)
+
+    def record_timeout(self, n=1):
+        with self._lock:
+            self.timeouts += n
+            total = self.timeouts
+        if profiler.is_running():
+            self._counters()["timeout"].set_value(total)
+
+    def record_error(self, n=1):
+        with self._lock:
+            self.errors += n
+
+    def record_batch(self, n_real, bucket):
+        with self._lock:
+            self.batches += 1
+            self.batched_rows += int(n_real)
+            self.bucket_rows += int(bucket)
+
+    def record_latency(self, ms):
+        with self._lock:
+            self._lat[self._lat_n % self._window] = float(ms)
+            self._lat_n += 1
+            self.completed += 1
+
+    # ------------------------------------------------------------ snapshot
+    def _percentiles(self):
+        n = min(self._lat_n, self._window)
+        if n == 0:
+            return {"p50_ms": None, "p95_ms": None, "p99_ms": None}
+        vals = sorted(self._lat[:n])
+        # nearest-rank on the retained window
+        pick = lambda q: vals[min(n - 1, int(q * (n - 1) + 0.5))]  # noqa: E731
+        return {"p50_ms": round(pick(0.50), 3),
+                "p95_ms": round(pick(0.95), 3),
+                "p99_ms": round(pick(0.99), 3)}
+
+    def snapshot(self):
+        with self._lock:
+            snap = {
+                "name": self.name,
+                "requests": self.requests,
+                "completed": self.completed,
+                "shed": self.shed,
+                "timeouts": self.timeouts,
+                "errors": self.errors,
+                "batches": self.batches,
+                "queue_depth": self._queue_depth,
+                "batch_fill_ratio": (round(self.batched_rows
+                                           / self.bucket_rows, 4)
+                                     if self.bucket_rows else None),
+                "mean_batch_size": (round(self.batched_rows / self.batches, 2)
+                                    if self.batches else None),
+                "latency_window": min(self._lat_n, self._window),
+            }
+            snap.update(self._percentiles())
+        return snap

@@ -1,0 +1,246 @@
+"""ModelServer — dynamic-batching inference serving over an executor pool.
+
+The mxnet-model-server analogue for this stack: take a hybridized
+``gluon.Block`` (or a ``SymbolBlock`` loaded from an export/checkpoint),
+pre-compile it at a set of batch-size buckets, and serve single requests
+through a dynamic batcher that coalesces them into the largest fitting
+bucket under a deadline. Steady state is one cached XLA dispatch per batch
+(``engine.serve_compile_counter`` flat after warmup), with typed
+load-shedding/timeout degradation and p50/p95/p99 observability.
+
+    net = resnet18_v1(); net.initialize(); net.hybridize()
+    srv = mxnet_tpu.serve.ModelServer(net, [((3, 224, 224), "float32")],
+                                      buckets=(1, 4, 16), max_wait_ms=2.0)
+    with srv:
+        probs = srv.predict(img)          # sync, single sample
+        handle = srv.submit(img)          # async, .result(timeout_s)
+        srv.stats()                       # latency/queue/shed snapshot
+
+Fault injection for degradation drills reuses the resilience hook shape
+(``parallel/resilience.py`` ``fail_at``/``SimulatedFailure``): assign
+``srv.inject_fault = lambda batch_idx: ...`` to raise on chosen batches —
+affected requests get the error, the server keeps serving.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from ..ndarray import NDArray
+from .batcher import DynamicBatcher, ServeError, ServeTimeout
+from .executor_pool import BucketedExecutor, symbol_infer_fn
+
+DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32)
+
+
+def _block_pool(model, devices, buckets, donate):
+    """Adapt a gluon block to (fn, params_fn): SymbolBlocks route through
+    their stored graph, hybrid blocks through serving_fn's pure trace."""
+    from ..gluon.block import SymbolBlock
+
+    if isinstance(model, SymbolBlock):
+        params = model.collect_params()
+        input_names = [s.name for s in model._inputs]
+        fn, pnames = symbol_infer_fn(model._outputs, input_names)
+        if fn is None:
+            raise ServeError(
+                "model's eval graph draws randomness per call (mode='always' "
+                "dropout?) — not servable from fixed compiled buckets")
+        plist = [params[n] for n in pnames]
+    else:
+        fn, _ = model.serving_fn()
+        plist = list(model.collect_params().values())
+
+    def params_fn():
+        return [p.data()._data for p in plist]
+
+    return BucketedExecutor(fn, params_fn, buckets=buckets, devices=devices,
+                            donate=donate, name=type(model).__name__)
+
+
+class ModelServer:
+    """Dynamic-batching server over a bucketed executor pool.
+
+    Parameters
+    ----------
+    model : HybridBlock | SymbolBlock
+        Initialized (and ideally hybridized) block; SymbolBlocks come from
+        ``serve.load`` / ``checkpoint.load_for_serving``.
+    input_specs : list of ((sample_shape), dtype)
+        Per model input, the PER-SAMPLE shape (no batch dim) and dtype —
+        fixes the compiled signatures; requests are cast to these.
+    buckets : tuple of int
+        Padded batch sizes compiled at startup (warm compile). The largest
+        is also the coalescing limit.
+    max_wait_ms : float
+        Batching deadline: how long the first request in a window waits for
+        company before dispatching a partial bucket.
+    max_queue : int
+        Admission bound in ROWS; beyond it submit() sheds with ServerBusy.
+    timeout_ms : float
+        Default per-request deadline (predict/submit can override).
+    devices : list | Mesh | None
+        Replica devices; batches round-robin over them (whole-batch
+        replication — the inference-side complement of ``split_and_load``'s
+        per-device sharding). A ``parallel.mesh`` Mesh serves on all its
+        devices. None = one replica on the current placement.
+    """
+
+    def __init__(self, model, input_specs, buckets=DEFAULT_BUCKETS,
+                 max_wait_ms=2.0, max_queue=256, timeout_ms=1000.0,
+                 devices=None, donate=None, name=None, warmup=True):
+        from .metrics import ServeMetrics
+
+        if devices is not None and hasattr(devices, "devices"):
+            # a parallel.mesh Mesh: replicate over every device in it
+            import numpy as _np
+
+            devices = list(_np.asarray(devices.devices).flat)
+        self.name = name or ("serve:%s" % type(model).__name__.lower())
+        self.model = model
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        self._specs = [(tuple(shape), np.dtype(dt))
+                       for shape, dt in input_specs]
+        self.timeout_ms = float(timeout_ms)
+        self.metrics = ServeMetrics(self.name)
+        self._pool = _block_pool(model, devices, self.buckets, donate)
+        self._batcher = DynamicBatcher(
+            self._dispatch, max_batch=self.buckets[-1],
+            max_wait_ms=max_wait_ms, max_queue=max_queue,
+            num_dispatchers=self._pool.num_replicas, metrics=self.metrics)
+        self._batch_idx = 0
+        self._batch_lock = threading.Lock()
+        self.inject_fault = None  # drill hook: callable(batch_idx) may raise
+        self._started = False
+        if warmup:
+            self.warmup()
+        from . import _register
+        _register(self)
+
+    # ------------------------------------------------------------ lifecycle
+    def warmup(self):
+        """Compile every (bucket, replica) program before taking traffic;
+        also proves row-aligned outputs (padding is only sound when each
+        output carries the batch on axis 0)."""
+        self._pool.warmup(self._specs, self.buckets)
+        if not self._pool.row_aligned:
+            raise ServeError(
+                "model outputs do not all carry the batch on axis 0 — "
+                "padded serving cannot slice per-request rows")
+        return self
+
+    def start(self):
+        self._batcher.start()
+        self._started = True
+        return self
+
+    def stop(self):
+        self._started = False
+        self._batcher.stop()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *a):
+        self.stop()
+
+    # ------------------------------------------------------------ requests
+    def _coerce(self, xs):
+        """Normalize one request's inputs to numpy with a leading batch dim;
+        returns (arrays, n_rows, was_sample). A bare sample gets batch
+        dim 1 (and ``was_sample`` lets predict drop it from the outputs)."""
+        if len(xs) != len(self._specs):
+            raise ServeError("model takes %d inputs, got %d"
+                             % (len(self._specs), len(xs)))
+        out, n, was_sample = [], None, False
+        for x, (shape, dt) in zip(xs, self._specs):
+            if isinstance(x, NDArray):
+                x = x.asnumpy()
+            x = np.asarray(x, dtype=dt)
+            if x.shape == shape:
+                x = x[None]
+                was_sample = True
+            elif x.shape[1:] != shape:
+                raise ServeError("input shape %s matches neither sample %s "
+                                 "nor batch (n,)+%s"
+                                 % (x.shape, shape, shape))
+            if n is None:
+                n = x.shape[0]
+            elif x.shape[0] != n:
+                raise ServeError("inputs disagree on batch size")
+            out.append(x)
+        return out, n, was_sample
+
+    def _submit_arrays(self, arrays, n, timeout_ms):
+        if not self._started:
+            self.start()
+        if n > self.buckets[-1]:
+            raise ServeError("request of %d rows exceeds the largest bucket "
+                             "%d — split it or widen buckets"
+                             % (n, self.buckets[-1]))
+        return self._batcher.submit(arrays, n, timeout_ms=timeout_ms)
+
+    def submit(self, *xs, timeout_ms=None):
+        """Async enqueue; returns a handle with ``.result(timeout_s)``.
+        Raises ServerBusy immediately when admission control sheds."""
+        arrays, n, _ = self._coerce(xs)
+        tmo = self.timeout_ms if timeout_ms is None else float(timeout_ms)
+        return self._submit_arrays(arrays, n, tmo)
+
+    def predict(self, *xs, timeout_ms=None):
+        """Synchronous single-request inference through the batcher. Returns
+        one numpy array per model output (batch dim dropped for bare-sample
+        requests)."""
+        tmo = self.timeout_ms if timeout_ms is None else float(timeout_ms)
+        arrays, n, was_sample = self._coerce(xs)
+        req = self._submit_arrays(arrays, n, tmo)
+        try:
+            outs = req.result(timeout_s=tmo / 1e3 + 5.0)
+        except ServeTimeout:
+            if req.finish(error=ServeTimeout("result wait expired")):
+                self.metrics.record_timeout()
+            raise
+        squeeze = was_sample and n == 1
+        outs = [o[0] if squeeze and o.ndim >= 1 and o.shape[0] == 1 else o
+                for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+    # ------------------------------------------------------------ dispatch
+    def _dispatch(self, requests, total_rows):
+        """Batcher callback: coalesce → one bucket dispatch → scatter
+        results. Runs on a dispatcher thread; must finish() every request."""
+        with self._batch_lock:
+            idx = self._batch_idx
+            self._batch_idx += 1
+        try:
+            if self.inject_fault is not None:
+                self.inject_fault(idx)
+            ins = [np.concatenate([r.inputs[i] for r in requests], axis=0)
+                   for i in range(len(self._specs))]
+            bucket = self._pool.pick_bucket(total_rows)
+            outs = self._pool.run(ins, n_real=total_rows)
+            self.metrics.record_batch(total_rows, bucket)
+            now = time.perf_counter()
+            off = 0
+            for r in requests:
+                per = [o[off:off + r.n] if o.ndim >= 1
+                       and o.shape[0] == total_rows else o for o in outs]
+                off += r.n
+                if r.finish(result=per):
+                    self.metrics.record_latency((now - r.t_submit) * 1e3)
+        except Exception as e:  # fault path: typed propagation, keep serving
+            self.metrics.record_error()
+            for r in requests:
+                r.finish(error=e)
+
+    # ------------------------------------------------------------ stats
+    def stats(self):
+        """One snapshot dict: batcher/latency metrics + pool shape — the
+        payload tools/diagnose.py's Serving section prints."""
+        snap = self.metrics.snapshot()
+        snap.update(buckets=list(self.buckets),
+                    replicas=self._pool.num_replicas,
+                    running=self._started)
+        return snap

@@ -15,19 +15,70 @@ __all__ = ["makedirs", "set_np", "reset_np", "is_np_array", "is_np_shape",
            "getenv", "setenv"]
 
 
+_NPZ_DTYPE_PREFIX = "__dtype__:"
+
+
+def _npy_native(dtype):
+    """True when the .npy header can represent ``dtype`` losslessly.
+    ml_dtypes types (bfloat16, fp8) serialize as opaque void ('|V2') and
+    reload unusable — the dtype/layout mismatch that used to break
+    checkpoint→serve warm-starts for bf16-cast models."""
+    import numpy as _np
+    try:
+        descr = _np.lib.format.dtype_to_descr(_np.dtype(dtype))
+        return _np.lib.format.descr_to_dtype(descr) == _np.dtype(dtype)
+    except Exception:
+        return False
+
+
 def save_npz_exact(filename, arrays):
     """np.savez under the EXACT filename (no automatic .npz suffix),
     atomically: write to a temp file in the same directory, then rename —
-    a crash mid-save must not leave a truncated checkpoint behind."""
+    a crash mid-save must not leave a truncated checkpoint behind.
+
+    Dtypes .npy cannot represent (bfloat16 et al.) are stored as their raw
+    bits viewed as a same-width uint plus a ``__dtype__:<name>`` sidecar
+    entry; :func:`load_npz_exact` restores the exact dtype. Plain-float
+    files are byte-identical to before (no sidecars), so old readers keep
+    working."""
     import numpy as _np
+    enc = {}
+    for k, v in arrays.items():
+        v = _np.asarray(v)
+        if not _npy_native(v.dtype):
+            enc[_NPZ_DTYPE_PREFIX + k] = _np.asarray(v.dtype.name)
+            v = v.view(_np.dtype("u%d" % v.dtype.itemsize))
+        enc[k] = v
     tmp = "%s.tmp%d" % (filename, os.getpid())
     try:
         with open(tmp, "wb") as f:
-            _np.savez(f, **arrays)
+            _np.savez(f, **enc)
         os.replace(tmp, filename)
     finally:
         if os.path.exists(tmp):
             os.remove(tmp)
+
+
+def load_npz_exact(filename):
+    """dict[name → np.ndarray] with EXACT dtypes restored (the read side of
+    :func:`save_npz_exact`). Also repairs legacy files that stored bfloat16
+    without a sidecar (np.load yields void '|V2' there — 2-byte payloads
+    from this codebase can only be bfloat16: float16 is npy-native)."""
+    import numpy as _np
+    from .base import resolve_dtype
+    raw = dict(_np.load(filename, allow_pickle=False))
+    dtypes = {}
+    for k in [k for k in raw if k.startswith(_NPZ_DTYPE_PREFIX)]:
+        dtypes[k[len(_NPZ_DTYPE_PREFIX):]] = str(raw.pop(k))
+    out = {}
+    for k, v in raw.items():
+        name = dtypes.get(k)
+        if name is not None:
+            v = v.view(_np.dtype(resolve_dtype(name)))
+        elif v.dtype.kind == "V" and v.dtype.itemsize == 2:
+            v = v.view(_np.dtype(resolve_dtype("bfloat16")))
+        out[k] = v
+    return out
 
 
 def makedirs(d):

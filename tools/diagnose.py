@@ -64,6 +64,29 @@ def main():
           % (_eng.tape_cache_hit_counter.count,
              _eng.tape_compile_counter.count))
 
+    print("----------Serving----------")
+    # mxnet_tpu.serve state: the executor-pool compile counter (a nonzero
+    # steady-state delta here means bucket programs are retracing — attach
+    # when reporting serving-latency regressions) plus every live server's
+    # stats() snapshot (latency percentiles, queue/shed/timeout counters)
+    try:
+        from mxnet_tpu import serve as _serve
+        snap = _serve.stats()
+        print("pool compiles: %d bucket program(s) built this process"
+              % snap["serve_compile_counter"])
+        if snap["servers"]:
+            for sname, s in sorted(snap["servers"].items()):
+                print("%-13s: req=%d done=%d shed=%d timeout=%d err=%d "
+                      "batches=%d fill=%s p50=%s p99=%s"
+                      % (sname, s["requests"], s["completed"], s["shed"],
+                         s["timeouts"], s["errors"], s["batches"],
+                         s["batch_fill_ratio"], s["p50_ms"], s["p99_ms"]))
+        else:
+            print("live servers : none (snapshots appear while a "
+                  "serve.ModelServer is alive)")
+    except Exception as e:
+        print("serve unavailable:", e)
+
     print("----------Graphlint Summary----------")
     # tracing-hygiene static pass over the package (tools/graphlint.py);
     # anything non-allowlisted here also fails the tier-1 suite

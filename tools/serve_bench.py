@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Serving-dispatch microbench: dynamic-batched bucketed executors vs the
+naive per-request path.
+
+Measures end-to-end requests/sec and jitted-dispatch counts for a stream of
+single-sample inference requests, two ways:
+
+* naive — each request is its own compiled call (``block(x[None])``), one
+  cached jitted dispatch PER REQUEST: what ported ``Module.predict``-style
+  code does when every request arrives alone;
+* served — the same requests through ``mxnet_tpu.serve.ModelServer``:
+  requests coalesce in the dynamic batcher into bucket-padded batches, ONE
+  cached dispatch per BATCH (PERF.md "inference dispatch" lever; the
+  request-side cousin of μ-cuDNN micro-batch decomposition onto fixed
+  compiled shapes, arXiv 1804.04806).
+
+Both sides are host-readback-closed per request (np.asarray results — the
+PERF.md completion methodology; the server's dispatch path gathers to host
+anyway because a response leaves the process). Parity is asserted ≤1e-6.
+
+Run: python tools/serve_bench.py [--quick] [--requests 256] [--json PATH]
+
+--quick pins the CPU backend and keeps the model tiny so device compute is
+negligible and the number under test is dispatch+batching overhead (the CI
+mode; wired as `python bench.py serve --smoke` and committed to
+tools/serve_bench_quick.json).
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_model(features=64, hidden=128, classes=10):
+    import numpy as np
+
+    from mxnet_tpu import gluon, nd
+
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(hidden, activation="relu"))
+        net.add(gluon.nn.Dense(classes))
+    net.initialize()
+    net(nd.array(np.zeros((1, features), np.float32)))  # materialize shapes
+    net.hybridize()
+    return net
+
+
+def run_naive(net, samples, iters):
+    """One compiled call per request — block batch-1 inference, jit cached
+    (this is the FAVORABLE naive baseline: no per-request recompiles)."""
+    import numpy as np
+
+    from mxnet_tpu import engine, nd
+
+    xs = [nd.array(s[None]) for s in samples]
+    outs = [np.asarray(net(x)._data) for x in xs]  # warmup + reference
+    best = float("inf")
+    for _ in range(3):
+        engine.dispatch_counter.reset()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            for x in xs:
+                out = np.asarray(net(x)._data)
+            _ = out
+        best = min(best, time.perf_counter() - t0)
+        disp = engine.dispatch_counter.count / iters
+    return len(samples) * iters / best, disp, outs
+
+
+def run_served(net, samples, iters, buckets, max_wait_ms):
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import engine
+
+    feat = samples[0].shape[0]
+    srv = mx.serve.ModelServer(net, [((feat,), "float32")], buckets=buckets,
+                               max_wait_ms=max_wait_ms, max_queue=4096,
+                               timeout_ms=30000.0)
+    with srv:
+        # warmup through the batcher once
+        handles = [srv.submit(s) for s in samples]
+        outs = [h.result(30)[0][0] for h in handles]
+        best = float("inf")
+        for _ in range(3):
+            engine.dispatch_counter.reset()
+            engine.serve_compile_counter.reset()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                handles = [srv.submit(s) for s in samples]
+                for h in handles:
+                    h.result(30)
+            best = min(best, time.perf_counter() - t0)
+            disp = engine.dispatch_counter.count / iters
+            recompiles = engine.serve_compile_counter.count
+        stats = srv.stats()
+    return (len(samples) * iters / best, disp, outs, recompiles, stats)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="CPU backend + tiny model: isolate dispatch and "
+                         "batching overhead (the CI mode)")
+    ap.add_argument("--requests", type=int, default=128,
+                    help="requests per timed iteration")
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--max-wait-ms", type=float, default=2.0)
+    ap.add_argument("--json", default=None, metavar="PATH")
+    args = ap.parse_args(argv)
+
+    if args.quick:
+        os.environ["PALLAS_AXON_POOL_IPS"] = ""
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    if args.quick:
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    feat = 64
+    buckets = (1, 8, 32)
+    samples = [rng.normal(size=(feat,)).astype(np.float32)
+               for _ in range(args.requests)]
+
+    net = build_model(features=feat)
+    naive_rps, naive_disp, naive_outs = run_naive(net, samples, args.iters)
+    (served_rps, served_disp, served_outs, recompiles,
+     stats) = run_served(net, samples, args.iters, buckets, args.max_wait_ms)
+
+    for a, b in zip(naive_outs, served_outs):
+        assert np.allclose(a[0], b, atol=1e-6), "served/naive parity violated"
+    assert recompiles == 0, \
+        "steady-state serving retraced %d times" % recompiles
+
+    rec = {
+        "case": "mlp%d" % feat,
+        "requests_per_iter": args.requests,
+        "iters": args.iters,
+        "buckets": list(buckets),
+        "max_wait_ms": args.max_wait_ms,
+        "served_requests_per_sec": round(served_rps, 1),
+        "naive_requests_per_sec": round(naive_rps, 1),
+        "speedup": round(served_rps / naive_rps, 2),
+        "served_dispatches_per_iter": served_disp,
+        "naive_dispatches_per_iter": naive_disp,
+        "dispatch_reduction": round(naive_disp / max(served_disp, 1e-9), 1),
+        "steady_state_recompiles": recompiles,
+        "batch_fill_ratio": stats["batch_fill_ratio"],
+        "p50_ms": stats["p50_ms"],
+        "p99_ms": stats["p99_ms"],
+        "parity_atol": 1e-6,
+    }
+    print(json.dumps(rec), flush=True)
+
+    if args.json:
+        meta = {"quick": args.quick,
+                "platform": jax.devices()[0].platform,
+                "timing": "end-to-end request round-trip, host-readback "
+                          "closed (PERF.md)",
+                "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                             time.gmtime())}
+        with open(args.json, "w") as f:
+            json.dump({"config": meta, "rows": [rec]}, f, indent=1)
+            f.write("\n")
+        print("wrote %s" % args.json)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

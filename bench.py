@@ -707,11 +707,12 @@ def main():
     if "--cpu" in flags:
         jax.config.update("jax_platforms", "cpu")
     mode = args[0] if args else "bert"
-    if mode in ("optstep", "imperative", "autograd", "serve"):
+    if mode in ("optstep", "imperative", "autograd", "serve", "decode"):
         # host-dispatch microbenches (fused multi-tensor optimizer step;
         # lazy bulk imperative chain vs eager; compiled tape replay vs the
         # eager backward walk; dynamic-batched serving vs per-request
-        # dispatch) — separate from the MODES table: they measure host
+        # dispatch; continuous-batching generative decode vs per-request
+        # generate) — separate from the MODES table: they measure host
         # dispatch overhead, not model throughput, and are never
         # persisted/replayed. --smoke/--cpu run the CPU-pinned --quick
         # variant.
@@ -719,12 +720,15 @@ def main():
         tool = {"optstep": "opt_step_bench.py",
                 "imperative": "imperative_bench.py",
                 "autograd": "autograd_bench.py",
-                "serve": "serve_bench.py"}[mode]
+                "serve": "serve_bench.py",
+                "decode": "serve_bench.py"}[mode]
         spec = importlib.util.spec_from_file_location(
             tool[:-3], os.path.join(_REPO, "tools", tool))
         m = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(m)
         argv = ["--quick"] if (smoke or "--cpu" in flags) else []
+        if mode == "decode":
+            argv += ["--mode", "decode"]
         if iters := next((f.split("=", 1)[1] for f in flags
                           if f.startswith("--iters=")), None):
             argv += ["--iters", iters]

@@ -25,6 +25,14 @@ GL005  use-after-donation — reusing a variable after passing it at a
 GL006  unbounded module-level cache dict — a module-level ``{}`` that
        functions insert into with no eviction/cap in sight; long-running
        serving processes grow it without bound.
+GL007  growing carried state — inside a ``for``/``while`` loop, a value
+       rebound to a concat of itself (``x = F.concat(x, …)`` /
+       ``jnp.concatenate([x, …])``): its aval changes every iteration, so
+       every compiled consumer retraces PER STEP (the KV-cache decode bug
+       class: a cache with a growing time axis recompiles each token).
+       Use a fixed-capacity buffer written via ``cache_write`` /
+       ``lax.dynamic_update_slice`` with a valid-length mask instead.
+       Host-side numpy accumulation (``np.*``) is exempt.
 
 A *hybridizable/jitted region* is: any ``hybrid_forward`` body; any
 function decorated with ``jax.jit``/``partial(jax.jit, ...)``; any
@@ -54,7 +62,12 @@ RULES = {
     "GL004": "data-dependent Python control flow in hybridizable region",
     "GL005": "use after donation (donate_argnums argument reused)",
     "GL006": "unbounded module-level cache dict",
+    "GL007": "growing carried state (aval changes per loop iteration)",
 }
+
+# concat-family callables whose self-referential use in a loop grows the
+# carried aval (GL007); numpy names are exempt (host accumulation)
+_CONCAT_NAMES = {"concat", "concatenate", "append", "hstack", "vstack"}
 
 # attribute reads that are static under trace (answered from the aval, never
 # a host readback) — they scrub taint
@@ -238,6 +251,8 @@ class _ModuleLint:
             if isinstance(node, ast.Call) and _call_name(node.func) in (
                     "tuple", "list") and node.args:
                 self._check_unordered_key(node)
+            if isinstance(node, (ast.For, ast.While)):
+                self._check_growing_carried(node)
         self._check_module_caches()
         self.findings.sort(key=lambda f: (f.path, f.line, f.rule, f.msg))
         return self.findings
@@ -455,6 +470,63 @@ class _ModuleLint:
                      "before using it in a cache key or static arg"
                      % _call_name(node.func),
                      self._enclosing_scope(node))
+
+    # ------------------------------------------------------------- GL007
+    @staticmethod
+    def _src_key(node: ast.AST) -> str:
+        """Structural identity of an expression (x, self.k, cache['k'])
+        for matching a rebind target against concat operands."""
+        try:
+            return ast.unparse(node)
+        except Exception:  # pragma: no cover - pre-3.9 fallback
+            return ast.dump(node, annotate_fields=False)
+
+    def _check_growing_carried(self, loop):
+        """GL007: a loop-carried value rebound to a concat of itself —
+        ``x = F.concat(x, new)`` inside for/while. The carried aval grows
+        every iteration, so any jitted/compiled consumer (including each
+        imperative op's cached program) retraces PER STEP — the
+        growing-KV-cache decode hazard. numpy calls are exempt: host-side
+        result accumulation doesn't feed a trace cache by itself."""
+        # names bound by the for-target are re-derived per ELEMENT, not
+        # carried across iterations — rebinding them doesn't grow an aval
+        loop_vars: Set[str] = set()
+        if isinstance(loop, ast.For):
+            t = _Taint(set())
+            t.assign(loop.target)
+            loop_vars = t.names
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Assign) or \
+                    not isinstance(node.value, ast.Call):
+                continue
+            call = node.value
+            if _call_name(call.func) not in _CONCAT_NAMES:
+                continue
+            f = call.func
+            if isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and f.value.id in _NP_NAMES:
+                continue
+            operands = []
+            for a in call.args:
+                if isinstance(a, (ast.List, ast.Tuple)):
+                    operands.extend(a.elts)
+                elif isinstance(a, ast.Starred):
+                    operands.append(a.value)
+                else:
+                    operands.append(a)
+            keys = {self._src_key(a) for a in operands}
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id in loop_vars:
+                    continue
+                if self._src_key(t) in keys:
+                    self.add(node, "GL007",
+                             "%r is rebound to a concat of itself inside a "
+                             "loop — its aval grows every iteration, so "
+                             "compiled consumers retrace per step (use a "
+                             "fixed-capacity buffer + cache_write and a "
+                             "valid-length mask)" % self._src_key(t),
+                             self._enclosing_scope(node))
+                    break
 
     # ------------------------------------------------------------- GL005
     def _donating_names(self, fn) -> Dict[str, Tuple[int, ...]]:

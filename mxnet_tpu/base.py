@@ -40,6 +40,17 @@ def is_tpu_backend():
         return False
 
 
+def next_pow2(n):
+    """Smallest power of two ≥ n — the shared bucket-rounding rule (serve
+    batch buckets, decode cache capacities, prompt-length buckets): any
+    request stream compiles at most log2(max) programs per knob instead of
+    one per distinct size."""
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
 def resolve_dtype(dtype):
     if dtype is None:
         return None

@@ -72,6 +72,15 @@ tape_cache_hit_counter = DispatchCounter()
 # discipline as bulk_compile_counter/tape_compile_counter.
 serve_compile_counter = DispatchCounter()
 
+# generative decode (mxnet_tpu.serve.GenerativeServer): bumps once per
+# prefill/decode/inject program BUILD — the bump sits INSIDE the traced body,
+# so it fires exactly when jax re-traces. After warmup (one decode program
+# per (slots, capacity-bucket), one prefill program per prompt-length
+# bucket), a steady decode stream — including requests joining and leaving
+# between steps — must not bump it: the zero-retrace assertion
+# tests/test_generate.py makes, same discipline as serve_compile_counter.
+decode_compile_counter = DispatchCounter()
+
 
 try:
     _bulk_size = int(os.environ.get("MXNET_ENGINE_BULK_SIZE", "15"))

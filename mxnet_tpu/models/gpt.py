@@ -5,16 +5,24 @@ TPU-first details: pre-LN blocks with the causal ``F.scaled_dot_attention``
 seam — at seq >= 256 on TPU this is the causal pallas flash kernel with its
 block-skipping for the masked upper triangle (O(T) memory, ~half the score
 FLOPs); weight-tied LM head (one MXU matmul against the embedding table);
-KV-cached incremental decode for generation; all widths multiples of 128
-at base size for MXU tiling; param names follow
-parallel.tensor_parallel.TRANSFORMER_RULES so the model shards over a
-(dp, tp, sp) mesh without edits.
+KV-cached incremental decode for generation over FIXED-CAPACITY caches:
+``init_cache`` allocates (B, H, capacity, D) buffers once and every step
+writes in place via ``F.cache_write`` with attention masked to the live
+prefix, so no shape ever changes across decode steps (the old growing
+(B, H, t, D) time axis retraced any compiled consumer every token —
+graphlint GL007). ``prefill`` fills the cache from the whole prompt in ONE
+forward pass; ``decode_step_fixed`` is the per-slot-position step the
+``serve.GenerativeServer`` continuous-batching scheduler traces into one
+fused program. All widths multiples of 128 at base size for MXU tiling;
+param names follow parallel.tensor_parallel.TRANSFORMER_RULES so the model
+shards over a (dp, tp, sp) mesh without edits.
 """
 from __future__ import annotations
 
 import numpy as np
 
 from .. import initializer as init_mod
+from ..base import next_pow2
 from ..gluon import nn
 from ..gluon.block import HybridBlock, param_value
 
@@ -34,45 +42,69 @@ class _CausalSelfAttention(HybridBlock):
                                      prefix="attn_out_")
             self.dropout = nn.Dropout(dropout) if dropout else None
 
-    def _split(self, F, x):
+    def _qkv_heads(self, F, x):
         B, T, C = x.shape
-        h = F.reshape(x, shape=(B, T, 3, self._heads, C // 3 // self._heads))
-        return F.transpose(h, axes=(2, 0, 3, 1, 4))  # (3, B, H, T, D)
+        H = self._heads
+        h = F.reshape(self.qkv(x), shape=(B, T, 3, H, C // H))
+        h = F.transpose(h, axes=(2, 0, 3, 1, 4))  # (3, B, H, T, D)
+        q = F.squeeze(F.slice_axis(h, axis=0, begin=0, end=1), axis=0)
+        k = F.squeeze(F.slice_axis(h, axis=0, begin=1, end=2), axis=0)
+        v = F.squeeze(F.slice_axis(h, axis=0, begin=2, end=3), axis=0)
+        return q, k, v
 
-    def hybrid_forward(self, F, x):
-        qkv = self._split(F, self.qkv(x))
-        q = F.squeeze(F.slice_axis(qkv, axis=0, begin=0, end=1), axis=0)
-        k = F.squeeze(F.slice_axis(qkv, axis=0, begin=1, end=2), axis=0)
-        v = F.squeeze(F.slice_axis(qkv, axis=0, begin=2, end=3), axis=0)
-        out = F.scaled_dot_attention(q, k, v, causal=True)
+    def _merge_heads(self, F, out):
         B, H, T, D = out.shape
-        out = F.reshape(F.transpose(out, axes=(0, 2, 1, 3)),
-                        shape=(B, T, H * D))
-        out = self.attn_out(out)
+        return F.reshape(F.transpose(out, axes=(0, 2, 1, 3)),
+                         shape=(B, T, H * D))
+
+    def forward_kv(self, F, x):
+        """Causal self-attention that also returns the projected per-head
+        K/V (B, H, T, D) — prefill writes them into the decode cache in one
+        shot instead of re-projecting token by token."""
+        q, k, v = self._qkv_heads(F, x)
+        out = F.scaled_dot_attention(q, k, v, causal=True)
+        out = self.attn_out(self._merge_heads(F, out))
         if self.dropout is not None:
             out = self.dropout(out)
-        return out
+        return out, k, v
+
+    def hybrid_forward(self, F, x):
+        return self.forward_kv(F, x)[0]
+
+    def step_cached(self, F, x, k_cache, v_cache, start):
+        """Decode against the fixed-capacity cache: ``x`` (B, T, C) holds
+        the next T tokens (T=1 in steady-state decode), whose K/V are
+        written IN PLACE at time offset ``start`` via ``F.cache_write``
+        (lax.dynamic_update_slice underneath); attention masks to the live
+        prefix ``pos <= start + row``. ``start`` is a python int (uniform
+        imperative decode) or a (B,) per-slot position vector (continuous
+        batching). Cache shapes never change across steps — the whole point.
+        Returns (out (B, T, C), k_cache', v_cache')."""
+        B, T, C = x.shape
+        q, k_new, v_new = self._qkv_heads(F, x)
+        k_cache = F.cache_write(k_cache, k_new, start)
+        v_cache = F.cache_write(v_cache, v_new, start)
+        cap = k_cache.shape[2]
+        pos = F.reshape(F.arange(0, cap, dtype="int32"),
+                        shape=(1, 1, 1, cap))
+        rows = F.reshape(F.arange(0, T, dtype="int32"), shape=(1, 1, T, 1))
+        if isinstance(start, int):
+            limit = rows + start
+        else:  # (B,) per-slot positions
+            limit = rows + F.reshape(start, shape=(-1, 1, 1, 1))
+        mask = F.lesser_equal(pos, limit)
+        out = F.scaled_dot_attention(q, k_cache, v_cache, mask)
+        return self.attn_out(self._merge_heads(F, out)), k_cache, v_cache
 
     def step(self, x, cache):
-        """One-token decode against the (k, v, length) cache (eager path:
-        generation loops in python, each step one small jitted program)."""
+        """One-token decode against the fixed-capacity ``(k, v, n)`` cache
+        (eager path: generation loops in python, each step a fixed-shape
+        program — position ``n`` advances, shapes don't)."""
         from .. import nd
 
-        B, _, C = x.shape
-        H = self._heads
-        D = C // H
-        qkv = nd.reshape(self.qkv(x), shape=(B, 1, 3, H, D))
-        qkv = nd.transpose(qkv, axes=(2, 0, 3, 1, 4))   # (3, B, H, 1, D)
-        q = nd.squeeze(nd.slice_axis(qkv, axis=0, begin=0, end=1), axis=0)
-        k_new = nd.squeeze(nd.slice_axis(qkv, axis=0, begin=1, end=2), axis=0)
-        v_new = nd.squeeze(nd.slice_axis(qkv, axis=0, begin=2, end=3), axis=0)
         ks, vs, n = cache
-        ks = nd.concat(ks, k_new, dim=2)
-        vs = nd.concat(vs, v_new, dim=2)
-        out = nd.scaled_dot_attention(q, ks, vs)  # all cached keys visible
-        out = nd.reshape(nd.transpose(out, axes=(0, 2, 1, 3)),
-                         shape=(B, 1, C))
-        return self.attn_out(out), (ks, vs, n + 1)
+        out, ks, vs = self.step_cached(nd, x, ks, vs, n)
+        return out, (ks, vs, n + 1)
 
 
 class _GPTBlock(HybridBlock):
@@ -92,18 +124,30 @@ class _GPTBlock(HybridBlock):
                                   prefix="ffn_2_")
             self.dropout = nn.Dropout(dropout) if dropout else None
 
-    def hybrid_forward(self, F, x):
-        x = x + self.attn(self.ln1(x))
+    def _ffn(self, x):
         h = self.ffn_2(self.act(self.ffn_1(self.ln2(x))))
         if self.dropout is not None:
             h = self.dropout(h)
         return x + h
 
+    def forward_kv(self, F, x):
+        a, k, v = self.attn.forward_kv(F, self.ln1(x))
+        return self._ffn(x + a), k, v
+
+    def hybrid_forward(self, F, x):
+        return self.forward_kv(F, x)[0]
+
+    def step_cached(self, F, x, k_cache, v_cache, start):
+        a, k_cache, v_cache = self.attn.step_cached(F, self.ln1(x), k_cache,
+                                                    v_cache, start)
+        return self._ffn(x + a), k_cache, v_cache
+
     def step(self, x, cache):
-        a, cache = self.attn.step(self.ln1(x), cache)
-        x = x + a
-        h = self.ffn_2(self.act(self.ffn_1(self.ln2(x))))
-        return x + h, cache
+        ks, vs, n = cache
+        from .. import nd
+
+        out, ks, vs = self.step_cached(nd, x, ks, vs, n)
+        return out, (ks, vs, n + 1)
 
 
 class GPTModel(HybridBlock):
@@ -148,26 +192,80 @@ class GPTModel(HybridBlock):
             x = self.drop(x)
         return x
 
-    def hybrid_forward(self, F, tokens):
-        x = self._embed(F, tokens)
-        x = self.blocks(x)
+    def _lm_logits(self, F, x):
         x = self.ln_f(x)
-        w = param_value(self.word_embed.weight)           # (V, C) tied head
+        w = param_value(self.word_embed.weight)          # (V, C) tied head
         B, T, C = x.shape
         logits = F.dot(F.reshape(x, shape=(B * T, C)), F.transpose(w))
         return F.reshape(logits, shape=(B, T, -1))
 
-    def init_cache(self, batch_size, dtype="float32"):
+    def hybrid_forward(self, F, tokens):
+        x = self._embed(F, tokens)
+        x = self.blocks(x)
+        return self._lm_logits(F, x)
+
+    # --------------------------------------------------- fixed-cap caches
+    def decode_state_spec(self):
+        """Cache-shape contract for external decode schedulers
+        (serve.GenerativeServer): per layer, K/V buffers are
+        (slots, heads, capacity, head_dim) of ``dtype``."""
+        H = self.blocks[0].attn._heads
+        return {"layers": len(self.blocks), "heads": H,
+                "head_dim": self._units // H, "max_length": self._max_len,
+                "dtype": np.dtype(self.word_embed.weight.data().dtype)}
+
+    def init_cache(self, batch_size, capacity=None, dtype=None):
+        """Fixed-capacity decode cache: per layer ``(k, v, n)`` with k/v
+        (B, H, capacity, D) zero buffers written in place by ``step`` and
+        ``n`` the live length attention masks to. Shapes never change
+        across decode steps, so every compiled consumer traces ONCE (the
+        old growing (B, H, t, D) time axis was a per-token retrace —
+        graphlint GL007). ``capacity`` defaults to ``max_length``; dtype
+        defaults to the parameter dtype (bf16-cast models cache in bf16)."""
         from .. import nd
 
+        cap = int(capacity if capacity is not None else self._max_len)
+        self._check_len(cap)
+        if dtype is None:
+            dtype = self.word_embed.weight.data().dtype
         H = self.blocks[0].attn._heads
         D = self._units // H
-        return [(nd.zeros((batch_size, H, 0, D), dtype=dtype),
-                 nd.zeros((batch_size, H, 0, D), dtype=dtype), 0)
+        return [(nd.zeros((batch_size, H, cap, D), dtype=dtype),
+                 nd.zeros((batch_size, H, cap, D), dtype=dtype), 0)
                 for _ in range(len(self.blocks))]
 
+    def forward_collect_kv(self, F, tokens):
+        """Forward pass that also returns every layer's projected K/V —
+        the prefill primitive: one whole-prompt dispatch yields both the
+        next-token logits and the complete cache contents."""
+        x = self._embed(F, tokens)
+        kvs = []
+        for blk in self.blocks:
+            x, k, v = blk.forward_kv(F, x)
+            kvs.append((k, v))
+        return self._lm_logits(F, x), kvs
+
+    def prefill(self, tokens, caches):
+        """Whole-prompt cache fill: ONE forward pass computes every
+        position's K/V and writes them into the fixed-capacity caches at
+        offset 0 (vs. the old token-by-token loop — T dispatch rounds and
+        a growing cache shape). Returns (last-position logits (B, V),
+        updated caches)."""
+        from .. import nd
+
+        B, T = tokens.shape
+        self._check_len(T)
+        logits, kvs = self.forward_collect_kv(nd, tokens)
+        new = [(nd.cache_write(kc, k, 0), nd.cache_write(vc, v, 0), T)
+               for (k, v), (kc, vc, _n) in zip(kvs, caches)]
+        last = nd.reshape(nd.slice_axis(logits, axis=1, begin=T - 1, end=T),
+                          shape=(B, -1))
+        return last, new
+
     def step(self, tokens, caches, position):
-        """One decode step: tokens (B, 1) → logits (B, V), updated caches."""
+        """One decode step: tokens (B, 1) → logits (B, V), updated caches.
+        ``position`` indexes into the fixed capacity axis; shapes are
+        step-invariant."""
         from .. import nd
 
         self._check_len(position + 1)
@@ -175,36 +273,61 @@ class GPTModel(HybridBlock):
         pw = param_value(self.pos_embed.weight)
         x = x + nd.slice_axis(pw, axis=0, begin=position, end=position + 1)
         new_caches = []
-        for blk, c in zip(self.blocks, caches):
-            x, c = blk.step(x, c)
-            new_caches.append(c)
+        for blk, (ks, vs, _n) in zip(self.blocks, caches):
+            x, ks, vs = blk.step_cached(nd, x, ks, vs, position)
+            new_caches.append((ks, vs, position + 1))
         x = self.ln_f(x)
         w = param_value(self.word_embed.weight)
         logits = nd.dot(nd.reshape(x, shape=(x.shape[0], self._units)),
                         nd.transpose(w))
         return logits, new_caches
 
+    def decode_step_fixed(self, F, tokens, k_caches, v_caches, valid_len):
+        """Continuous-batching decode step over PER-SLOT positions: tokens
+        (B,) int — each slot's current input token; ``k_caches``/
+        ``v_caches`` per-layer (B, H, capacity, D); ``valid_len`` (B,) —
+        tokens already cached per slot (= this token's position). Each
+        slot's K/V is written at its own position and attends to its own
+        live prefix; returns (logits (B, V), new k_caches, new v_caches).
+        Pure and F-generic: serve.GenerativeServer traces it (with
+        sampling fused behind it) into ONE cached XLA program per step."""
+        x = self.word_embed(F.reshape(tokens, shape=(-1, 1)))  # (B, 1, C)
+        pw = param_value(self.pos_embed.weight)
+        x = x + F.expand_dims(F.take(pw, valid_len), axis=1)
+        nk, nv = [], []
+        for blk, kc, vc in zip(self.blocks, k_caches, v_caches):
+            x, kc, vc = blk.step_cached(F, x, kc, vc, valid_len)
+            nk.append(kc)
+            nv.append(vc)
+        x = self.ln_f(x)
+        w = param_value(self.word_embed.weight)
+        logits = F.dot(F.reshape(x, shape=(x.shape[0], self._units)),
+                       F.transpose(w))
+        return logits, nk, nv
+
     def generate(self, prompt, max_new_tokens=16, use_cache=True):
         """Greedy decode. prompt (B, T0) int → (B, T0 + max_new) int.
+        The cached path prefills the whole prompt in ONE forward pass and
+        keeps argmax on-device between steps (no host sync in the loop);
         ``use_cache=False`` re-forwards the whole sequence each step
         (the O(T²) parity oracle the cached path is tested against)."""
         from .. import nd
 
         toks = prompt
         if use_cache:
-            caches = self.init_cache(prompt.shape[0])
-            # prefill: feed the prompt token by token (simple + exact)
-            logits = None
-            for t in range(prompt.shape[1]):
-                logits, caches = self.step(
-                    nd.slice_axis(toks, axis=1, begin=t, end=t + 1),
-                    caches, t)
-            for _ in range(max_new_tokens):
+            B, T0 = prompt.shape
+            self._check_len(T0 + max_new_tokens)
+            cap = min(self._max_len, next_pow2(T0 + max_new_tokens))
+            caches = self.init_cache(B, capacity=cap)
+            logits, caches = self.prefill(prompt, caches)
+            new = []
+            for i in range(max_new_tokens):
                 nxt = nd.reshape(nd.argmax(logits, axis=-1),
                                  shape=(-1, 1)).astype(prompt.dtype)
-                toks = nd.concat(toks, nxt, dim=1)
-                logits, caches = self.step(nxt, caches, toks.shape[1] - 1)
-            return toks
+                new.append(nxt)
+                if i + 1 < max_new_tokens:
+                    logits, caches = self.step(nxt, caches, T0 + i)
+            return nd.concat(toks, *new, dim=1)
         for _ in range(max_new_tokens):
             logits = self(toks)
             nxt = nd.reshape(
@@ -212,7 +335,8 @@ class GPTModel(HybridBlock):
                                         begin=toks.shape[1] - 1,
                                         end=toks.shape[1]), axis=-1),
                 shape=(-1, 1)).astype(prompt.dtype)
-            toks = nd.concat(toks, nxt, dim=1)
+            # intentional O(T²) growth: this is the oracle, not the product
+            toks = nd.concat(toks, nxt, dim=1)  # graphlint: disable=GL007
         return toks
 
 

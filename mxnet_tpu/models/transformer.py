@@ -66,22 +66,31 @@ class MultiHeadAttention(HybridBlock):
         return {"k": k, "v": v}
 
     def step(self, q_in, cache):
-        """q_in: (B, 1, C); cache holds accumulated K/V (B, H, t, D).
-        Appends this step's K/V (self-attention) unless the cache is static
-        (cross-attention over encoder output)."""
+        """q_in: (B, 1, C). Self-attention caches are FIXED-CAPACITY
+        (B, H, capacity, D) buffers written in place at position
+        ``cache["n"]`` via ``nd.cache_write`` with attention masked to the
+        live prefix — no shape changes across steps (the old growing
+        concat-on-axis-2 cache retraced every compiled consumer per token;
+        graphlint GL007). Cross-attention caches are static projections of
+        the encoder output (``cache["static"]``)."""
         from .. import nd
 
         B, _, C = q_in.shape
         q = self._split(nd, self.query(q_in))
         if cache.get("static"):
-            k, v = cache["k"], cache["v"]
+            out = nd.scaled_dot_attention(q, cache["k"], cache["v"])
         else:
+            n = cache["n"]
             k_new = self._split(nd, self.key(q_in))
             v_new = self._split(nd, self.value(q_in))
-            k = nd.concat(cache["k"], k_new, dim=2) if cache.get("k") is not None else k_new
-            v = nd.concat(cache["v"], v_new, dim=2) if cache.get("v") is not None else v_new
-            cache["k"], cache["v"] = k, v
-        out = nd.scaled_dot_attention(q, k, v)
+            k = cache["k"] = nd.cache_write(cache["k"], k_new, n)
+            v = cache["v"] = nd.cache_write(cache["v"], v_new, n)
+            cache["n"] = n + 1
+            cap = k.shape[2]
+            mask = nd.reshape(
+                nd.lesser_equal(nd.arange(0, cap, dtype="int32"), n),
+                shape=(1, 1, 1, cap))
+            out = nd.scaled_dot_attention(q, k, v, mask)
         out = nd.reshape(nd.transpose(out, axes=(0, 2, 1, 3)), shape=(B, 1, C))
         return self.attn_out(out)
 
@@ -201,12 +210,27 @@ class TransformerModel(HybridBlock):
         return self.decode(F, tgt, enc_out, pos_enc, cross_mask)
 
     # ------------------------------------------------------- inference
-    def init_cache(self, enc_out):
+    def init_cache(self, enc_out, capacity=None):
+        """Fixed-capacity decode caches: self-attention K/V are
+        (B, H, capacity, D) zero buffers (written in place, masked to the
+        live prefix — shapes never change across steps), cross-attention
+        K/V are static encoder projections. ``capacity`` defaults to
+        ``max_len``; pass the decode budget to keep buffers tight."""
+        from .. import nd
+
+        cap = int(capacity if capacity is not None else self._max_len)
+        B = enc_out.shape[0]
+        H = self.dec_cells[0].self_attn._heads
+        D = self._units // H
+        dt = enc_out.dtype
         caches = []
         for cell in self.dec_cells:
             cross = cell.cross_attn.project_kv(enc_out)
             cross["static"] = True
-            caches.append({"self": {"k": None, "v": None}, "cross": cross})
+            caches.append({"self": {"k": nd.zeros((B, H, cap, D), dtype=dt),
+                                    "v": nd.zeros((B, H, cap, D), dtype=dt),
+                                    "n": 0},
+                           "cross": cross})
         return caches
 
     def decode_step(self, tok, caches, position):
@@ -232,20 +256,26 @@ class TransformerModel(HybridBlock):
             tgt = nd.full((B, 1), bos, dtype="int32")
             if use_cache:
                 enc_out = self._encode_imperative(src)
-                caches = self.init_cache(enc_out)
+                caches = self.init_cache(enc_out, capacity=max_len)
+                # fixed-shape steps; tokens accumulate host-side and concat
+                # ONCE at the end (a growing device concat per step is the
+                # GL007 retrace hazard the fixed cache exists to avoid)
+                pieces = [tgt]
                 cur = tgt
                 for t in range(max_len - 1):
                     logits = self.decode_step(cur, caches, t)
                     nxt = logits.asnumpy()[:, -1].argmax(-1).astype("int32")
                     cur = nd.array(nxt[:, None], dtype="int32")
-                    tgt = nd.concat(tgt, cur, dim=1)
+                    pieces.append(cur)
                     if (nxt == eos).all():
                         break
-                return tgt
+                return nd.concat(*pieces, dim=1)
             for _ in range(max_len - 1):
                 logits = self(src, tgt)
                 nxt = logits.asnumpy()[:, -1].argmax(-1).astype("int32")
-                tgt = nd.concat(tgt, nd.array(nxt[:, None], dtype="int32"), dim=1)
+                cur = nd.array(nxt[:, None], dtype="int32")
+                # intentional O(T²) re-forward growth: the parity oracle
+                tgt = nd.concat(tgt, cur, dim=1)  # graphlint: disable=GL007
                 if (nxt == eos).all():
                     break
             return tgt

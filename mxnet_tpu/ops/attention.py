@@ -236,3 +236,28 @@ def masked_softmax(x, mask=None, *, axis=-1):
     if mask is not None:
         x = jnp.where(mask.astype(bool), x, -1e30)
     return jax.nn.softmax(x, axis=axis)
+
+
+@register_op("cache_write", nondiff=True)
+def cache_write(cache, update, index):
+    """Write ``update`` (B, H, T, D) into the fixed-capacity KV cache
+    ``cache`` (B, H, C, D) at time offset ``index`` along axis 2 — the
+    decode-cache primitive: the cache shape NEVER changes across steps, so
+    a jitted decode step compiles once instead of retracing per token (the
+    growing-``concat`` cache layout graphlint GL007 flags).
+
+    ``index`` is a scalar (whole-batch write at one offset: prefill, the
+    uniform imperative decode loop) or a per-row ``(B,)`` vector (continuous
+    batching: each slot is at its own position). Lowers to
+    ``lax.dynamic_update_slice`` — with the cache buffer donated, XLA
+    updates it in place. Writes past the capacity are the caller's bug;
+    like dynamic_update_slice, the start index clamps to ``C - T``."""
+    index = jnp.asarray(index, jnp.int32)
+    update = update.astype(cache.dtype)
+    zero = jnp.int32(0)
+    if index.ndim == 0:
+        return jax.lax.dynamic_update_slice(cache, update,
+                                            (zero, zero, index, zero))
+    return jax.vmap(
+        lambda c, u, i: jax.lax.dynamic_update_slice(c, u, (zero, i, zero))
+    )(cache, update, index)

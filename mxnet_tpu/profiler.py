@@ -239,6 +239,24 @@ def serve_scope(bucket, n_real):
             args={"bucket": bucket, "rows": n_real})
 
 
+@contextlib.contextmanager
+def decode_scope(kind, slots, n_active):
+    """Instruments one generative-decode dispatch (called from
+    serve.decoder when the profiler runs): ``decode[step fill=0.75 b8]``
+    for a fused token step of the whole in-flight batch, or
+    ``decode[prefill16 fill=...]`` for a whole-prompt cache fill at a
+    prompt-length bucket — batch-fill efficiency of the continuous-batching
+    scheduler reads directly off the trace next to the XLA kernels."""
+    name = "decode[%s fill=%.2f b%d]" % (kind, n_active / max(slots, 1),
+                                         slots)
+    t0 = time.perf_counter()
+    with jax.profiler.TraceAnnotation(name):
+        yield
+    t1 = time.perf_counter()
+    _record(name, (t0 - _epoch) * 1e6, (t1 - t0) * 1e3, cat="serve",
+            args={"slots": slots, "active": n_active})
+
+
 def backward_scope(op_names):
     """Instruments one compiled tape-replay dispatch (called from
     autograd._compiled_backward): the single program carries primal replay

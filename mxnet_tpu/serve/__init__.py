@@ -9,10 +9,20 @@ over device replicas, and the whole thing is observable (metrics →
 ``serve.stats()`` + profiler events). See README "Serving" and
 MIGRATING.md for the mxnet-model-server mapping.
 
+Generative decode is its own scheduler (decoder.py): ``GenerativeServer``
+runs token-level continuous batching over a paged fixed-capacity KV cache
+(kv_cache.py) — prefill/decode split, ONE fused dispatch per token step
+for all in-flight requests with sampling in-program, join/leave by slot
+assignment, prefix caching, and streaming per-request iterators.
+
     import mxnet_tpu as mx
     net = ...hybridized block...
     with mx.serve.ModelServer(net, [((3, 224, 224), "float32")]) as srv:
         out = srv.predict(img)
+
+    with mx.serve.GenerativeServer(gpt, slots=8) as gsrv:   # decode
+        for tok in gsrv.submit([1, 2, 3], max_new_tokens=32):
+            ...
 
     blk = mx.serve.load("export/model", epoch=0)     # warm-start a export
     mx.serve.stats()                                 # all live servers
@@ -23,14 +33,18 @@ import weakref
 
 from .batcher import (DynamicBatcher, ServeError, ServerBusy,  # noqa: F401
                       ServeTimeout)
+from .decoder import GenerationStream, GenerativeServer  # noqa: F401
 from .executor_pool import (BucketedExecutor, PoolError,  # noqa: F401
                             symbol_infer_fn)
-from .metrics import ServeMetrics  # noqa: F401
+from .kv_cache import CacheError, PagedKVCache, PrefixCache  # noqa: F401
+from .metrics import GenerativeMetrics, ServeMetrics  # noqa: F401
 from .server import DEFAULT_BUCKETS, ModelServer  # noqa: F401
 
-__all__ = ["ModelServer", "BucketedExecutor", "DynamicBatcher",
-           "ServeMetrics", "ServeError", "ServerBusy", "ServeTimeout",
-           "PoolError", "DEFAULT_BUCKETS", "load", "stats"]
+__all__ = ["ModelServer", "GenerativeServer", "GenerationStream",
+           "BucketedExecutor", "DynamicBatcher", "PagedKVCache",
+           "PrefixCache", "CacheError", "ServeMetrics", "GenerativeMetrics",
+           "ServeError", "ServerBusy", "ServeTimeout", "PoolError",
+           "DEFAULT_BUCKETS", "load", "stats"]
 
 # live-server registry for the aggregate stats() snapshot; weak so a
 # dropped server never lingers (and the registry never grows unbounded)
@@ -60,5 +74,6 @@ def stats():
 
     return {
         "serve_compile_counter": engine.serve_compile_counter.count,
+        "decode_compile_counter": engine.decode_compile_counter.count,
         "servers": {s.name: s.stats() for s in list(_SERVERS)},
     }

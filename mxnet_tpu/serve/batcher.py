@@ -32,15 +32,16 @@ class ServeTimeout(ServeError):
 
 
 class _Request:
-    __slots__ = ("inputs", "n", "t_submit", "deadline", "_event", "_result",
-                 "_error", "_done")
+    __slots__ = ("inputs", "n", "t_submit", "deadline", "priority", "_event",
+                 "_result", "_error", "_done")
 
-    def __init__(self, inputs, n, timeout_ms):
+    def __init__(self, inputs, n, timeout_ms, priority=0):
         self.inputs = inputs
         self.n = n  # rows this request contributes to a batch
         self.t_submit = time.perf_counter()
         self.deadline = (self.t_submit + timeout_ms / 1e3
                          if timeout_ms else None)
+        self.priority = int(priority)  # higher = more urgent
         self._event = threading.Event()
         self._result = None
         self._error = None
@@ -124,27 +125,55 @@ class DynamicBatcher:
         self._pool.shutdown(wait=True)
 
     # ------------------------------------------------------------ admission
-    def submit(self, inputs, n_rows, timeout_ms=None):
+    def submit(self, inputs, n_rows, timeout_ms=None, priority=0):
         """Enqueue one request (``n_rows`` ≥ 1 coalescible rows). Returns a
         future-like handle; raises ServerBusy when the queue is full —
         shedding at the door keeps tail latency bounded instead of letting
-        the queue grow into a multi-deadline backlog."""
-        req = _Request(inputs, int(n_rows), timeout_ms)
+        the queue grow into a multi-deadline backlog.
+
+        ``priority`` (higher = more urgent) orders the queue: dispatch
+        drains the highest class first, FIFO within a class. When the
+        queue is full and a strictly LOWER-priority request is waiting,
+        admission is SLO-aware preemptive shedding: the victim is the
+        lowest-priority queued request with the least deadline slack (the
+        one most likely to miss its SLO anyway) — it gets ServerBusy and
+        the new request takes its place."""
+        req = _Request(inputs, int(n_rows), timeout_ms, priority)
+        evicted = []
         with self._cond:
             if self._stop:
                 raise ServeError("server stopped")
-            if self._queued_rows + req.n > self._max_queue:
-                if self._metrics:
-                    self._metrics.record_shed()
-                raise ServerBusy(
-                    "queue full (%d rows queued, max %d)"
-                    % (self._queued_rows, self._max_queue))
-            self._queue.append(req)
+            while self._queued_rows + req.n > self._max_queue:
+                victim = min(
+                    self._queue,
+                    key=lambda r: (r.priority,
+                                   r.deadline if r.deadline is not None
+                                   else float("inf")),
+                    default=None)
+                if victim is None or victim.priority >= req.priority:
+                    if self._metrics:
+                        self._metrics.record_shed()
+                    raise ServerBusy(
+                        "queue full (%d rows queued, max %d)"
+                        % (self._queued_rows, self._max_queue))
+                self._queue.remove(victim)
+                self._queued_rows -= victim.n
+                evicted.append(victim)
+            # sorted insert: before the first strictly-lower class (stable
+            # FIFO within a class; O(queue) on a bounded queue)
+            idx = next((i for i, r in enumerate(self._queue)
+                        if r.priority < req.priority), len(self._queue))
+            self._queue.insert(idx, req)
             self._queued_rows += req.n
             if self._metrics:
                 self._metrics.record_admit()
                 self._metrics.record_queue_depth(self._queued_rows)
             self._cond.notify()
+        for v in evicted:
+            if v.finish(error=ServerBusy(
+                    "shed from the queue by a priority-%d arrival"
+                    % req.priority)) and self._metrics:
+                self._metrics.record_shed()
         return req
 
     def queue_depth(self):
@@ -160,10 +189,12 @@ class DynamicBatcher:
                 if self._stop and not self._queue:
                     return None
                 # drop requests that expired while queued — dispatching them
-                # would waste a bucket slot on a caller that already left
+                # would waste a bucket slot on a caller that already left.
+                # Whole-queue sweep: with priority classes an expired
+                # request can sit behind a higher class, not just at head.
                 now = time.perf_counter()
-                while self._queue and self._queue[0].expired(now):
-                    req = self._queue.popleft()
+                for req in [r for r in self._queue if r.expired(now)]:
+                    self._queue.remove(req)
                     self._queued_rows -= req.n
                     if req.finish(error=ServeTimeout(
                             "timed out after %.1fms in queue"

@@ -1,0 +1,658 @@
+"""GenerativeServer — token-level continuous batching over a paged KV cache.
+
+The autoregressive complement of ``ModelServer``: instead of coalescing
+whole fixed-shape forward passes, the scheduler coalesces TOKEN STEPS.
+Requests join and leave between steps by slot assignment into a padded
+batch (μ-cuDNN-style request decomposition, arXiv 1804.04806, applied to
+the decode loop); every step runs ONE fused compiled program for the whole
+in-flight batch — embed → N transformer blocks (each writing its slot's
+K/V in place at its own position) → logits → SAMPLING (greedy + temperature
+/top-k over per-slot threefry keys) — so there is no per-step host argmax
+and exactly one dispatch per token step with zero steady-state retrace
+(``engine.decode_compile_counter`` bumps inside the traced bodies, the same
+proof-hook discipline as ``serve_compile_counter``).
+
+Prefill is split from decode (the compute-bound vs. latency-bound halves):
+a joining request's whole prompt runs through one forward pass at a pow2
+prompt-length bucket, writing its cache page in a single dispatch and
+sampling the first token inside the program. Identical prompts hit the
+``PrefixCache`` instead: the stored pages are injected by a tiny compiled
+program, skipping the forward entirely.
+
+Admission reuses ``DynamicBatcher``'s bounded queue — priority classes and
+SLO-aware preemptive shedding (batcher.submit) apply to generation
+requests unchanged; per-request deadlines keep ticking while a request
+waits for a slot and mid-stream. Tokens stream back through per-request
+iterators (``GenerationStream``).
+
+    m = gpt_nano(); m.initialize()
+    srv = mxnet_tpu.serve.GenerativeServer(m, slots=8, eos_id=None)
+    with srv:
+        s = srv.submit([1, 2, 3], max_new_tokens=16, temperature=0.8)
+        for tok in s:          # streams as decode steps complete
+            print(tok)
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import _trace, engine, profiler
+from ..base import is_tpu_backend, next_pow2
+from .batcher import DynamicBatcher, ServeError, ServeTimeout
+from .kv_cache import CacheError, PagedKVCache, PrefixCache
+from .metrics import GenerativeMetrics
+
+_DONE = object()
+
+
+def sample_tokens(logits, keys, positions, temps, top_k):
+    """Fused in-program sampling: greedy argmax per slot, or temperature/
+    top-k categorical when ``temps[slot] > 0``. Each slot's threefry key is
+    folded with the generated token's sequence position, so a request's
+    token stream is deterministic in (seed, position) and independent of
+    every other in-flight request. Runs INSIDE the compiled step — the
+    sampled ids are the only thing the host reads back."""
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if top_k and top_k > 0:
+        kth = jax.lax.top_k(logits, int(top_k))[0][:, -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    subkeys = jax.vmap(jax.random.fold_in)(keys, positions)
+    sampled = jax.vmap(jax.random.categorical)(subkeys, scaled)
+    return jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
+
+
+class GenerationStream:
+    """Per-request streaming handle: iterate generated token ids as decode
+    steps complete, or block for the full sequence with ``result()``.
+    Queue-phase failures (shed by priority admission, queue timeout) and
+    mid-stream failures (deadline, server stop) surface as the typed
+    serve exceptions on the consumer side."""
+
+    def __init__(self, prompt, max_new_tokens, temperature, seed, priority):
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if self.prompt.size == 0:
+            raise ServeError("empty prompt")
+        self.max_new_tokens = max(1, int(max_new_tokens))
+        self.temperature = float(temperature)
+        self.seed = int(seed)
+        self.priority = int(priority)
+        self.tokens = []          # generated ids, in order
+        self._q = queue.Queue()
+        self._done = threading.Event()
+        self._error = None
+        self._admission = None    # batcher request handle (queue-phase SLO)
+
+    # ------------------------------------------------------- producer side
+    def _push(self, tok):
+        self.tokens.append(int(tok))
+        self._q.put(int(tok))
+
+    def _finish(self, error=None):
+        if self._done.is_set():
+            return False
+        self._error = error
+        self._done.set()
+        self._q.put(_DONE)
+        return True
+
+    # ------------------------------------------------------- consumer side
+    def _check_admission(self):
+        # the batcher fails queue-phase requests (timeout sweep, preemptive
+        # shed) on ITS handle; mirror that failure onto the stream
+        a = self._admission
+        if a is not None and a.done() and a._error is not None:
+            self._finish(a._error)
+
+    def __iter__(self):
+        while True:
+            self._check_admission()
+            try:
+                item = self._q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            if item is _DONE:
+                break
+            yield item
+        if self._error is not None:
+            raise self._error
+
+    def done(self):
+        return self._done.is_set()
+
+    def result(self, timeout_s=None):
+        """Block until generation completes; returns the list of generated
+        token ids (prompt excluded). Raises the typed failure if the
+        request was shed, timed out, or errored."""
+        deadline = (time.perf_counter() + timeout_s) if timeout_s else None
+        while not self._done.wait(0.05):
+            self._check_admission()
+            if deadline is not None and time.perf_counter() > deadline:
+                raise ServeTimeout("no completion within %.1fs" % timeout_s)
+        if self._error is not None:
+            raise self._error
+        return list(self.tokens)
+
+
+class GenerativeServer:
+    """Continuous-batching generative decode scheduler.
+
+    Parameters
+    ----------
+    model : block implementing the fixed-capacity decode protocol
+        ``decode_state_spec()``, ``forward_collect_kv(F, tokens)`` and
+        ``decode_step_fixed(F, tokens, k_caches, v_caches, valid_len)``
+        (``models.gpt.GPTModel`` is the reference implementation).
+        Must be initialized; its parameter dtype decides the cache dtype.
+    slots : int
+        In-flight request pages — the padded decode batch. One decode
+        dispatch serves all of them; free slots are masked, so join/leave
+        never recompiles.
+    top_k : int
+        STATIC top-k filter compiled into the sampling head (0 = off).
+        Temperature is per-request (0 = greedy) and traced, so mixing
+        greedy and sampled requests in one batch costs nothing.
+    eos_id : int or None
+        Token id that completes a request early.
+    max_wait_ms / max_queue / timeout_ms
+        Admission-queue knobs, as in ModelServer. ``max_queue`` is in
+        REQUESTS; priority classes and SLO-aware preemptive shedding are
+        the DynamicBatcher's (see batcher.submit).
+    prefix_cache : bool
+        Cache finished prefills keyed by the prompt's token hash; a repeat
+        prompt injects the stored pages instead of re-running the forward.
+    donate : bool or None
+        Donate cache/state buffers to the step programs (default: on for
+        TPU backends — the executor-pool donation discipline).
+    """
+
+    def __init__(self, model, slots=8, top_k=0, eos_id=None,
+                 max_wait_ms=1.0, max_queue=64, timeout_ms=30000.0,
+                 prefix_cache=True, donate=None, name=None):
+        spec = model.decode_state_spec()
+        self.model = model
+        self.name = name or ("generate:%s" % type(model).__name__.lower())
+        self.slots = int(slots)
+        self.top_k = int(top_k)
+        self.eos_id = eos_id
+        self.timeout_ms = float(timeout_ms)
+        self._plist = list(model.collect_params().values())
+        self.cache = PagedKVCache(
+            spec["layers"], spec["heads"], spec["head_dim"], self.slots,
+            spec["max_length"], dtype=spec["dtype"])
+        self.prefix = PrefixCache() if prefix_cache else None
+        self.metrics = GenerativeMetrics(self.name)
+        self._donate = is_tpu_backend() if donate is None else bool(donate)
+        # compiled-program caches: the pow2 bucketing bounds each at
+        # log2(max) entries — the executor-pool discipline
+        self._decode_fns = {}    # capacity -> jitted step
+        self._prefill_fns = {}   # (tp, capacity) -> jitted prompt fill
+        self._inject_fns = {}    # (tp, capacity) -> jitted prefix replay
+        self._extract_fns = {}   # (tp, capacity) -> jitted page read-out
+        # device-side carried state beyond the cache: current input token
+        # per slot, and the per-slot sampling controls
+        self._tok = jnp.zeros((self.slots,), jnp.int32)
+        self._keys = np.zeros((self.slots, 2), np.uint32)
+        self._temps = np.zeros((self.slots,), np.float32)
+        self._dev_keys = None
+        self._dev_temps = None
+        self._dev_active = None
+        self._ctl_dirty = True
+        # host bookkeeping per slot
+        self._slot_req = [None] * self.slots   # admission handle (deadline)
+        self._remaining = [0] * self.slots     # tokens left to generate
+        self._join_q = deque()
+        self._join_cond = threading.Condition()
+        self._batcher = DynamicBatcher(
+            self._admit_batch, max_batch=self.slots, max_wait_ms=max_wait_ms,
+            max_queue=max_queue, num_dispatchers=1, metrics=self.metrics)
+        self._loop_thread = None
+        self._stop_flag = False
+        from . import _register
+        _register(self)
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self):
+        """Start the background scheduler loop (admit → one fused decode
+        step → stream tokens, forever). Tests drive the same tick
+        synchronously via :meth:`step`."""
+        self._batcher.start()
+        if self._loop_thread is None or not self._loop_thread.is_alive():
+            self._stop_flag = False
+            self._loop_thread = threading.Thread(
+                target=self._loop, daemon=True, name="serve-decode")
+            self._loop_thread.start()
+        return self
+
+    def stop(self):
+        self._stop_flag = True
+        with self._join_cond:
+            self._join_cond.notify_all()
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=5.0)
+        self._batcher.stop(drain=False)
+        for slot in self.cache.active_slots:
+            self._retire(slot, error=ServeError("server stopped"))
+        with self._join_cond:
+            pending, self._join_q = list(self._join_q), deque()
+        for req in pending:
+            err = ServeError("server stopped")
+            if req.finish(error=err):
+                req.inputs._finish(err)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *a):
+        self.stop()
+
+    # ------------------------------------------------------------ admission
+    def submit(self, prompt, max_new_tokens=16, temperature=0.0, seed=0,
+               priority=0, timeout_ms=None):
+        """Enqueue one generation request; returns a ``GenerationStream``.
+        Sheds with ``ServerBusy`` when the admission queue is full (unless
+        ``priority`` preempts a lower class — see DynamicBatcher.submit);
+        the deadline covers queue wait, prefill AND generation."""
+        stream = GenerationStream(prompt, max_new_tokens, temperature, seed,
+                                  priority)
+        tmo = self.timeout_ms if timeout_ms is None else float(timeout_ms)
+        # fail impossible requests at the door, not after a queue wait
+        self.cache.capacity_bucket(stream.prompt.size + stream.max_new_tokens)
+        if not self._batcher._worker or not self._batcher._worker.is_alive():
+            self._batcher.start()
+        req = self._batcher.submit(stream, 1, timeout_ms=tmo,
+                                   priority=priority)
+        stream._admission = req
+        return stream
+
+    def generate(self, prompt, **kwargs):
+        """Synchronous convenience: submit + wait; returns generated ids."""
+        tmo = kwargs.get("timeout_ms", self.timeout_ms)
+        return self.submit(prompt, **kwargs).result(timeout_s=tmo / 1e3 + 5.0)
+
+    def _admit_batch(self, requests, rows):
+        """Batcher dispatch callback: hand admitted requests to the decode
+        loop. BLOCKS while the handover buffer is full so saturation backs
+        up into the bounded admission queue (where shedding and timeouts
+        live) instead of an unbounded join list."""
+        for req in requests:
+            with self._join_cond:
+                while (not self._stop_flag
+                       and len(self._join_q) >= self.slots):
+                    self._join_cond.wait(0.05)
+                    if req.expired():
+                        break
+                if self._stop_flag:
+                    err = ServeError("server stopped")
+                    if req.finish(error=err):
+                        req.inputs._finish(err)
+                    continue
+                self._join_q.append(req)
+
+    # ------------------------------------------------------------ scheduler
+    def step(self):
+        """One scheduler tick: admit pending joins (prefill/inject, one
+        dispatch each), then run ONE fused decode step for the whole
+        in-flight batch and deliver each live slot's token. Returns the
+        number of slots decoded (0 = idle). The background loop calls this
+        continuously; tests call it directly for counter-exact assertions."""
+        self._admit_pending()
+        return self._decode_once()
+
+    def _loop(self):
+        while not self._stop_flag:
+            if self.step() == 0:
+                time.sleep(0.001)
+
+    # ------------------------------------------------------------- joining
+    def _admit_pending(self):
+        while self.cache._free:
+            with self._join_cond:
+                req = self._join_q.popleft() if self._join_q else None
+                self._join_cond.notify_all()
+            if req is None:
+                return
+            stream = req.inputs
+            now = time.perf_counter()
+            if req.done():      # queue sweep got it first
+                continue
+            if req.expired(now):
+                err = ServeTimeout("timed out after %.1fms waiting for a "
+                                   "slot" % ((now - req.t_submit) * 1e3))
+                if req.finish(error=err):
+                    stream._finish(err)
+                    self.metrics.record_timeout()
+                continue
+            try:
+                self._join(req, stream)
+            except Exception as e:   # cache exhaustion, model error
+                self.metrics.record_error()
+                if req.finish(error=e):
+                    stream._finish(e)
+
+    def _join(self, req, stream):
+        t0_len = int(stream.prompt.size)
+        need = t0_len + stream.max_new_tokens
+        self.cache.ensure_capacity(need)
+        slot = self.cache.acquire(stream)
+        tp = min(next_pow2(t0_len), self.cache.capacity)
+        padded = np.zeros((1, tp), np.int32)
+        padded[0, :t0_len] = stream.prompt
+        key = np.asarray(jax.random.PRNGKey(stream.seed), np.uint32)
+        hit = self.prefix.get(stream.prompt) if self.prefix is not None \
+            else None
+        engine.dispatch_counter.bump()
+        scope = (profiler.decode_scope("prefill%d" % tp, self.slots,
+                                       self.cache.num_active)
+                 if profiler.is_running() else None)
+        try:
+            if scope is not None:
+                scope.__enter__()
+            if hit is not None:
+                k_stack, v_stack, plen, last = hit
+                fn = self._inject_fn(tp, self.cache.capacity)
+                kcs, vcs, valid, toks = fn(
+                    self.cache.k, self.cache.v, self.cache.valid, self._tok,
+                    jnp.asarray(k_stack), jnp.asarray(v_stack),
+                    jnp.int32(plen), jnp.int32(slot), jnp.asarray(last),
+                    jnp.asarray(key), jnp.float32(stream.temperature))
+            else:
+                fn = self._prefill_fn(tp, self.cache.capacity)
+                params = [p.data()._data for p in self._plist]
+                kcs, vcs, valid, toks, last = fn(
+                    params, self.cache.k, self.cache.v, self.cache.valid,
+                    self._tok, jnp.asarray(padded), jnp.int32(t0_len),
+                    jnp.int32(slot), jnp.asarray(key),
+                    jnp.float32(stream.temperature))
+        finally:
+            if scope is not None:
+                scope.__exit__(None, None, None)
+        self.cache.update(kcs, vcs, valid)
+        self._tok = toks
+        if hit is None:
+            self.metrics.record_prefill()
+            if self.prefix is not None:
+                # one page read-out per UNIQUE prompt; repeats skip the
+                # whole forward from then on
+                engine.dispatch_counter.bump()
+                ks, vs = self._extract_fn(tp, self.cache.capacity)(
+                    self.cache.k, self.cache.v, jnp.int32(slot))
+                self.prefix.put(stream.prompt, ks, vs, t0_len,
+                                np.asarray(last))
+        first = int(np.asarray(self._tok)[slot])
+        now = time.perf_counter()
+        if not req.finish(result=stream):
+            # timed out in the same instant admission landed: roll back
+            self.cache.release(slot)
+            return
+        self._slot_req[slot] = req
+        self._remaining[slot] = stream.max_new_tokens
+        self._keys[slot] = key
+        self._temps[slot] = stream.temperature
+        self._ctl_dirty = True
+        self.metrics.record_first_token((now - req.t_submit) * 1e3)
+        self._deliver(slot, first)
+
+    # ------------------------------------------------------------- decoding
+    def _decode_once(self):
+        active = self.cache.active_mask()
+        n_active = int(active.sum())
+        if n_active == 0:
+            return 0
+        if self._ctl_dirty:
+            self._dev_keys = jnp.asarray(self._keys)
+            self._dev_temps = jnp.asarray(self._temps)
+            self._dev_active = jnp.asarray(active)
+            self._ctl_dirty = False
+        fn = self._decode_fn(self.cache.capacity)
+        params = [p.data()._data for p in self._plist]
+        engine.dispatch_counter.bump()
+        t0 = time.perf_counter()
+        if profiler.is_running():
+            with profiler.decode_scope("step", self.slots, n_active):
+                kcs, vcs, valid, nxt = fn(
+                    params, self.cache.k, self.cache.v, self.cache.valid,
+                    self._tok, self._dev_active, self._dev_keys,
+                    self._dev_temps)
+        else:
+            kcs, vcs, valid, nxt = fn(
+                params, self.cache.k, self.cache.v, self.cache.valid,
+                self._tok, self._dev_active, self._dev_keys,
+                self._dev_temps)
+        nxt_host = np.asarray(nxt)   # ONE host gather per step — the tokens
+        self.cache.update(kcs, vcs, valid)
+        self._tok = nxt
+        dt = time.perf_counter() - t0
+        self.metrics.record_step(dt, n_active, n_active, self.slots)
+        now = time.perf_counter()
+        for slot in self.cache.active_slots:
+            self._deliver(slot, int(nxt_host[slot]), now)
+        return n_active
+
+    def _deliver(self, slot, tok, now=None):
+        """Hand one token to a slot's stream and retire the request when it
+        completes (EOS / budget) or blows its deadline."""
+        stream = self.cache.owner(slot)
+        req = self._slot_req[slot]
+        stream._push(tok)
+        self._remaining[slot] -= 1
+        if (self.eos_id is not None and tok == self.eos_id) \
+                or self._remaining[slot] <= 0:
+            self._retire(slot)
+            return
+        if req is not None and req.expired(now):
+            self._retire(slot, error=ServeTimeout(
+                "deadline passed mid-generation (after %d tokens)"
+                % len(stream.tokens)))
+            self.metrics.record_timeout()
+
+    def _retire(self, slot, error=None):
+        stream = self.cache.owner(slot)
+        req = self._slot_req[slot]
+        if stream is not None:
+            stream._finish(error)
+            if error is None and req is not None:
+                self.metrics.record_latency(
+                    (time.perf_counter() - req.t_submit) * 1e3)
+        self._slot_req[slot] = None
+        self._temps[slot] = 0.0
+        self._ctl_dirty = True
+        self.cache.release(slot)
+        with self._join_cond:
+            self._join_cond.notify_all()
+
+    # ------------------------------------------------- compiled programs
+    def _trace_ctx(self, params):
+        ctx = _trace.trace_scope(jax.random.PRNGKey(0), False)
+        return ctx
+
+    def _jit(self, fn, donate):
+        if self._donate and donate:
+            return jax.jit(fn, donate_argnums=donate)
+        return jax.jit(fn)
+
+    def _decode_fn(self, capacity):
+        fn = self._decode_fns.get(capacity)
+        if fn is not None:
+            return fn
+        model, plist, top_k = self.model, self._plist, self.top_k
+
+        def pure(params, kcs, vcs, valid, toks, active, keys, temps):
+            # trace-time bump: fires exactly when XLA retraces — the
+            # zero-steady-state-retrace proof tests assert
+            engine.decode_compile_counter.bump()
+            with _trace.trace_scope(jax.random.PRNGKey(0), False) as t:
+                t.param_store = {id(p): a for p, a in zip(plist, params)}
+                logits, kcs, vcs = model.decode_step_fixed(
+                    _trace.F, toks, kcs, vcs, valid)
+            # the generated token's position is valid+1 (prefill used
+            # `prompt_len` for the first token) — every token of a stream
+            # folds a distinct position into its slot key
+            nxt = sample_tokens(logits, keys, valid + 1, temps, top_k)
+            act = active > 0
+            nxt = jnp.where(act, nxt, 0)
+            valid = valid + act.astype(jnp.int32)
+            return kcs, vcs, valid, nxt
+
+        fn = self._jit(pure, donate=(1, 2, 3, 4))
+        self._decode_fns[capacity] = fn
+        return fn
+
+    def _prefill_fn(self, tp, capacity):
+        fn = self._prefill_fns.get((tp, capacity))
+        if fn is not None:
+            return fn
+        model, plist, top_k = self.model, self._plist, self.top_k
+        zero = jnp.int32(0)
+
+        def pure(params, kcs, vcs, valid, toks, tokens, plen, slot, key,
+                 temp):
+            engine.decode_compile_counter.bump()
+            with _trace.trace_scope(jax.random.PRNGKey(0), False) as t:
+                t.param_store = {id(p): a for p, a in zip(plist, params)}
+                logits, kvs = model.forward_collect_kv(_trace.F, tokens)
+            kcs = [jax.lax.dynamic_update_slice(
+                kc, k.astype(kc.dtype), (slot, zero, zero, zero))
+                for kc, (k, _v) in zip(kcs, kvs)]
+            vcs = [jax.lax.dynamic_update_slice(
+                vc, v.astype(vc.dtype), (slot, zero, zero, zero))
+                for vc, (_k, v) in zip(vcs, kvs)]
+            valid = jax.lax.dynamic_update_slice(
+                valid, jnp.reshape(plen, (1,)), (slot,))
+            last = jnp.reshape(jax.lax.dynamic_slice(
+                logits, (zero, plen - 1, zero),
+                (1, 1, logits.shape[2])), (1, -1))
+            t0 = sample_tokens(last, key[None], plen[None], temp[None],
+                               top_k)
+            toks = jax.lax.dynamic_update_slice(toks, t0, (slot,))
+            return kcs, vcs, valid, toks, jnp.reshape(last, (-1,))
+
+        fn = self._jit(pure, donate=(1, 2, 3, 4))
+        self._prefill_fns[(tp, capacity)] = fn
+        return fn
+
+    def _inject_fn(self, tp, capacity):
+        fn = self._inject_fns.get((tp, capacity))
+        if fn is not None:
+            return fn
+        top_k = self.top_k
+        zero = jnp.int32(0)
+
+        def pure(kcs, vcs, valid, toks, k_stack, v_stack, plen, slot, last,
+                 key, temp):
+            engine.decode_compile_counter.bump()
+            kcs = [jax.lax.dynamic_update_slice(
+                kc, k_stack[i][None].astype(kc.dtype),
+                (slot, zero, zero, zero)) for i, kc in enumerate(kcs)]
+            vcs = [jax.lax.dynamic_update_slice(
+                vc, v_stack[i][None].astype(vc.dtype),
+                (slot, zero, zero, zero)) for i, vc in enumerate(vcs)]
+            valid = jax.lax.dynamic_update_slice(
+                valid, jnp.reshape(plen, (1,)), (slot,))
+            t0 = sample_tokens(last[None], key[None], plen[None], temp[None],
+                               top_k)
+            toks = jax.lax.dynamic_update_slice(toks, t0, (slot,))
+            return kcs, vcs, valid, toks
+
+        fn = self._jit(pure, donate=(0, 1, 2, 3))
+        self._inject_fns[(tp, capacity)] = fn
+        return fn
+
+    def _extract_fn(self, tp, capacity):
+        fn = self._extract_fns.get((tp, capacity))
+        if fn is not None:
+            return fn
+        H, D = self.cache.heads, self.cache.head_dim
+        zero = jnp.int32(0)
+
+        def pure(kcs, vcs, slot):
+            engine.decode_compile_counter.bump()
+            ks = jnp.stack([jax.lax.dynamic_slice(
+                kc, (slot, zero, zero, zero), (1, H, tp, D))[0]
+                for kc in kcs])
+            vs = jnp.stack([jax.lax.dynamic_slice(
+                vc, (slot, zero, zero, zero), (1, H, tp, D))[0]
+                for vc in vcs])
+            return ks, vs
+
+        fn = jax.jit(pure)   # reads live caches: never donate
+        self._extract_fns[(tp, capacity)] = fn
+        return fn
+
+    # ------------------------------------------------------------ warmup
+    def warmup(self, prompt_buckets=(), max_tokens=None):
+        """Compile ahead of traffic: the decode step at the current (or
+        requested) capacity, plus prefill programs for the given pow2
+        prompt-length buckets — after this a steady token stream never
+        bumps ``engine.decode_compile_counter``."""
+        need = max(int(max_tokens or 0),
+                   max([int(b) for b in prompt_buckets], default=1) + 1)
+        self.cache.ensure_capacity(need)
+        for b in prompt_buckets:
+            stream = GenerationStream([1] * int(b), 1, 0.0, 0, 0)
+            slot = self.cache.acquire(stream)
+            if slot is None:
+                break
+            tp = min(next_pow2(int(b)), self.cache.capacity)
+            fn = self._prefill_fn(tp, self.cache.capacity)
+            params = [p.data()._data for p in self._plist]
+            key = np.asarray(jax.random.PRNGKey(0), np.uint32)
+            padded = np.zeros((1, tp), np.int32)
+            kcs, vcs, valid, toks, _last = fn(
+                params, self.cache.k, self.cache.v, self.cache.valid,
+                self._tok, jnp.asarray(padded), jnp.int32(int(b)),
+                jnp.int32(slot), jnp.asarray(key), jnp.float32(0.0))
+            self.cache.update(kcs, vcs, valid)
+            self._tok = toks
+            if self.prefix is not None:
+                # prefix-store (extract) and replay (inject) programs are
+                # part of the join path: compile them now too
+                ks, vs = self._extract_fn(tp, self.cache.capacity)(
+                    self.cache.k, self.cache.v, jnp.int32(slot))
+                kcs, vcs, valid, toks = self._inject_fn(
+                    tp, self.cache.capacity)(
+                    self.cache.k, self.cache.v, self.cache.valid, self._tok,
+                    ks, vs, jnp.int32(int(b)), jnp.int32(slot),
+                    jnp.asarray(_last), jnp.asarray(key), jnp.float32(0.0))
+                self.cache.update(kcs, vcs, valid)
+                self._tok = toks
+            self.cache.release(slot)
+        # one masked all-free decode dispatch compiles the step program
+        dummy = GenerationStream([1], 1, 0.0, 0, 0)
+        slot = self.cache.acquire(dummy)
+        if slot is not None:
+            self._remaining[slot] = 1
+            self._decode_once()
+            if self.cache.owner(slot) is dummy:
+                self._retire(slot)
+        return self
+
+    # ------------------------------------------------------------- stats
+    def stats(self):
+        """Snapshot for ``serve.stats()`` / tools/diagnose.py: generative
+        counters on top of the base queue/latency metrics."""
+        snap = self.metrics.snapshot()
+        snap.update(
+            slots=self.slots,
+            capacity=self.cache.capacity,
+            in_flight=self.cache.num_active,
+            cache_migrations=self.cache.migrations,
+            prefix_hits=self.prefix.hits if self.prefix is not None else None,
+            prefix_misses=(self.prefix.misses if self.prefix is not None
+                           else None),
+            prefix_entries=(len(self.prefix) if self.prefix is not None
+                            else None),
+            decode_compile_counter=engine.decode_compile_counter.count,
+            running=(self._loop_thread is not None
+                     and self._loop_thread.is_alive()),
+        )
+        return snap

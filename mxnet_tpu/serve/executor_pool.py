@@ -32,18 +32,11 @@ import jax
 import jax.numpy as jnp
 
 from .. import engine
-from ..base import is_tpu_backend
+from ..base import is_tpu_backend, next_pow2  # noqa: F401  (re-export)
 
 
 class PoolError(RuntimeError):
     """Misuse of the executor pool (shape/bucket mismatch)."""
-
-
-def next_pow2(n):
-    p = 1
-    while p < n:
-        p <<= 1
-    return p
 
 
 class BucketedExecutor:

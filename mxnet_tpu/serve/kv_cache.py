@@ -1,0 +1,190 @@
+"""Paged KV-cache state for continuous-batching generative decode.
+
+The decode-side analogue of ``executor_pool``'s pad-to-bucket discipline
+(TVM-style fixed compiled shapes, arXiv 1802.04799) applied to the KV cache:
+instead of a per-request cache tensor whose time axis grows every token —
+a new aval per step, so every compiled consumer retraces (graphlint GL007)
+— all in-flight requests share per-layer ``(slots, heads, capacity,
+head_dim)`` buffers. Each request owns one SLOT page; its tokens are
+written in place at its own ``valid_len`` position via
+``lax.dynamic_update_slice`` (the ``cache_write`` op) and attention masks
+to the live prefix, so **no shape ever changes across decode steps**.
+
+Capacity is bucketed in powers of two: when an admitted request needs more
+room than the current bucket, the buffers are zero-padded up to the next
+bucket (one rare migration dispatch) and the decode program for that
+capacity compiles once — the same log2-many-programs bound the executor
+pool gives batch sizes. Buffers are donated to the decode program on TPU
+backends (they are pure carried state; XLA updates them in place), the
+same donation discipline as ``executor_pool``.
+
+``PrefixCache`` is the prompt-caching layer: completed prefills are keyed
+by the token-prefix hash; a hit replays the stored K/V pages into the new
+request's slot (one tiny inject dispatch) instead of re-running the
+whole-prompt forward.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..base import BoundedCache, env_cap, next_pow2
+
+
+class CacheError(RuntimeError):
+    """Misuse of the paged cache (capacity/slot exhaustion)."""
+
+
+class PagedKVCache:
+    """Slot-paged fixed-capacity KV cache shared by all in-flight requests.
+
+    Holds the device-side carried state of the decode loop — per-layer K/V
+    buffers plus the per-slot ``valid_len`` vector — and the host-side slot
+    bookkeeping (which request owns which page). The compiled prefill/
+    decode programs take these arrays as (donated) inputs and return the
+    updated ones; the server writes them back via :meth:`update`.
+
+    Parameters
+    ----------
+    layers, heads, head_dim : int
+        Per-layer buffer geometry (``model.decode_state_spec()``).
+    slots : int
+        Number of request pages — the padded decode batch size.
+    max_capacity : int
+        Hard ceiling on the time axis (the model's ``max_length``).
+    dtype : np.dtype
+        K/V element dtype (the model's parameter dtype; bf16 models
+        cache in bf16).
+    """
+
+    def __init__(self, layers, heads, head_dim, slots, max_capacity,
+                 dtype=np.float32):
+        self.layers = int(layers)
+        self.heads = int(heads)
+        self.head_dim = int(head_dim)
+        self.slots = int(slots)
+        self.max_capacity = int(max_capacity)
+        self.dtype = np.dtype(dtype)
+        self.capacity = 0
+        self.k = None     # list[L] of (slots, H, capacity, D) jax arrays
+        self.v = None
+        self.valid = jnp.zeros((self.slots,), jnp.int32)
+        self._free = list(range(self.slots))
+        self._owner = [None] * self.slots
+        self.migrations = 0  # capacity-bucket growths (rare by design)
+
+    # ---------------------------------------------------------- capacity
+    def capacity_bucket(self, need):
+        """Pow2 capacity bucket for ``need`` tokens, clamped to the model's
+        max length (positions beyond it have no embedding)."""
+        if need > self.max_capacity:
+            raise CacheError(
+                "request needs %d cache positions but the model's "
+                "max_length is %d" % (need, self.max_capacity))
+        return min(self.max_capacity, next_pow2(need))
+
+    def ensure_capacity(self, need):
+        """Grow the buffers to the bucket that fits ``need`` (zero-padding
+        the time axis — one migration dispatch per layer, then the decode
+        program for the new capacity compiles once). Returns True when a
+        migration happened — live programs for the old capacity stay
+        cached, so shrinking traffic never re-migrates."""
+        cap = self.capacity_bucket(need)
+        if cap <= self.capacity and self.k is not None:
+            return False
+        shape = (self.slots, self.heads, cap, self.head_dim)
+        if self.k is None:
+            self.k = [jnp.zeros(shape, self.dtype) for _ in range(self.layers)]
+            self.v = [jnp.zeros(shape, self.dtype) for _ in range(self.layers)]
+        else:
+            pad = ((0, 0), (0, 0), (0, cap - self.capacity), (0, 0))
+            self.k = [jnp.pad(k, pad) for k in self.k]
+            self.v = [jnp.pad(v, pad) for v in self.v]
+            self.migrations += 1
+        self.capacity = cap
+        return True
+
+    # ------------------------------------------------------------- slots
+    def acquire(self, owner):
+        """Claim a free page for ``owner``; None when fully booked (the
+        scheduler leaves the request in the admission queue)."""
+        if not self._free:
+            return None
+        slot = self._free.pop(0)
+        self._owner[slot] = owner
+        return slot
+
+    def release(self, slot):
+        """Free a page between decode steps — pure host bookkeeping: the
+        next prefill overwrites the page from offset 0 and ``valid_len``
+        masks everything stale, so no device-side scrub is needed (and no
+        recompile: the batch layout is padded, not reshaped)."""
+        self._owner[slot] = None
+        self._free.append(slot)
+
+    def owner(self, slot):
+        return self._owner[slot]
+
+    @property
+    def active_slots(self):
+        return [i for i, o in enumerate(self._owner) if o is not None]
+
+    @property
+    def num_active(self):
+        return self.slots - len(self._free)
+
+    def active_mask(self):
+        """(slots,) int32 mask of live pages — a traced input of the decode
+        program (free slots sample nothing and their valid_len holds), so
+        join/leave between steps never changes a shape."""
+        return np.asarray([0 if o is None else 1 for o in self._owner],
+                          np.int32)
+
+    def update(self, k, v, valid):
+        """Install the arrays a compiled step returned (the old buffers
+        were donated on TPU — they must not be touched again)."""
+        self.k, self.v, self.valid = list(k), list(v), valid
+
+
+class PrefixCache:
+    """Prompt/prefix cache: token-prefix hash → finished prefill state.
+
+    Entries hold host-side copies ``(k_stack, v_stack, prompt_len,
+    last_logits)`` with ``k_stack``/``v_stack`` of shape (layers, heads,
+    padded_prompt_len, head_dim) — exact dtypes (bf16 stays bf16). A hit
+    skips the whole-prompt forward: the stored pages are injected into the
+    request's slot by a tiny compiled program and the first token is
+    sampled from the stored logits with the request's own key/temperature
+    (two requests sharing a prompt can still sample differently).
+
+    Bounded (``MXNET_PREFIX_CACHE_CAP``, default 32 prompts): entries are
+    full KV pages, the one cache in this subsystem where eviction is about
+    host RAM, not compiled-program count.
+    """
+
+    def __init__(self, cap=None):
+        self._store = BoundedCache(env_cap("MXNET_PREFIX_CACHE_CAP", 32)
+                                   if cap is None else cap)
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def key(tokens):
+        return tuple(int(t) for t in np.asarray(tokens).ravel())
+
+    def get(self, tokens):
+        ent = self._store.get(self.key(tokens))
+        if ent is None:
+            self.misses += 1
+        else:
+            self.hits += 1
+        return ent
+
+    def put(self, tokens, k_stack, v_stack, prompt_len, last_logits):
+        self._store[self.key(tokens)] = (
+            np.asarray(k_stack), np.asarray(v_stack), int(prompt_len),
+            np.asarray(last_logits))
+
+    def __len__(self):
+        return len(self._store)

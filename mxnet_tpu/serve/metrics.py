@@ -125,3 +125,79 @@ class ServeMetrics:
             }
             snap.update(self._percentiles())
         return snap
+
+
+def _ring_percentiles(ring, n, prefix):
+    """Nearest-rank p50/p95/p99 over the retained window of a latency ring
+    (same estimator as ServeMetrics._percentiles)."""
+    out = {"%s_p50_ms" % prefix: None, "%s_p95_ms" % prefix: None,
+           "%s_p99_ms" % prefix: None}
+    if n == 0:
+        return out
+    vals = sorted(ring[:n])
+    pick = lambda q: vals[min(n - 1, int(q * (n - 1) + 0.5))]  # noqa: E731
+    out["%s_p50_ms" % prefix] = round(pick(0.50), 3)
+    out["%s_p95_ms" % prefix] = round(pick(0.95), 3)
+    out["%s_p99_ms" % prefix] = round(pick(0.99), 3)
+    return out
+
+
+class GenerativeMetrics(ServeMetrics):
+    """ServeMetrics plus the token-level counters autoregressive serving
+    is judged by: tokens/s (over decode-active wall time, not idle time),
+    time-to-first-token (admission → first sampled token, the user-visible
+    prefill latency), inter-token latency (one decode step of the shared
+    batch), and in-flight batch fill (live slots / padded slots — how much
+    of every decode dispatch is real work)."""
+
+    def __init__(self, name="serve", window=2048):
+        super().__init__(name, window)
+        self._ttft = [0.0] * self._window   # admission → first token, ms
+        self._ttft_n = 0
+        self._itl = [0.0] * self._window    # per decode step, ms
+        self._itl_n = 0
+        self.tokens = 0                     # generated tokens, all requests
+        self.steps = 0                      # decode dispatches
+        self.prefills = 0                   # whole-prompt forward dispatches
+        self._decode_s = 0.0                # decode-active wall time
+        self._active_slot_steps = 0         # live slots summed over steps
+        self._slot_steps = 0                # padded slots summed over steps
+
+    def record_first_token(self, ms):
+        with self._lock:
+            self._ttft[self._ttft_n % self._window] = float(ms)
+            self._ttft_n += 1
+            self.tokens += 1   # the first token is sampled by prefill
+
+    def record_prefill(self, n=1):
+        with self._lock:
+            self.prefills += n
+
+    def record_step(self, step_s, n_tokens, n_active, slots):
+        with self._lock:
+            self._itl[self._itl_n % self._window] = float(step_s) * 1e3
+            self._itl_n += 1
+            self.steps += 1
+            self.tokens += int(n_tokens)
+            self._decode_s += float(step_s)
+            self._active_slot_steps += int(n_active)
+            self._slot_steps += int(slots)
+
+    def snapshot(self):
+        snap = super().snapshot()
+        with self._lock:
+            snap.update({
+                "tokens": self.tokens,
+                "decode_steps": self.steps,
+                "prefills": self.prefills,
+                "tokens_per_s": (round(self.tokens / self._decode_s, 1)
+                                 if self._decode_s > 0 else None),
+                "inflight_fill": (round(self._active_slot_steps
+                                        / self._slot_steps, 4)
+                                  if self._slot_steps else None),
+            })
+            snap.update(_ring_percentiles(
+                self._ttft, min(self._ttft_n, self._window), "ttft"))
+            snap.update(_ring_percentiles(
+                self._itl, min(self._itl_n, self._window), "itl"))
+        return snap

@@ -1,0 +1,27 @@
+"""Fixture: GL007 negatives — fixed-capacity cache writes and host-side
+numpy accumulation (neither changes a compiled program's input avals)."""
+import numpy as np
+
+
+def decode_fixed_cache(nd, step_fn, x, ks, steps):
+    # fixed-capacity buffer: same shape every step, written in place
+    for t in range(steps):
+        k_new = step_fn(x, ks)
+        ks = nd.cache_write(ks, k_new, t)
+    return ks
+
+
+def accumulate_on_host(model, toks, n):
+    out = np.zeros((0,), np.int32)
+    pieces = []
+    for _ in range(n):
+        nxt = model(toks)
+        out = np.concatenate([out, nxt])  # host result gather, not a trace input
+        pieces.append(nxt)
+    return out, pieces
+
+
+def concat_of_others(nd, a, b, n):
+    for _ in range(n):
+        c = nd.concat(a, b, dim=1)  # not self-referential: aval is static
+    return c

@@ -1,0 +1,22 @@
+"""Fixture: GL007 true positives — loop-carried state whose aval grows.
+
+The KV-cache decode bug class: a cache tensor whose time axis grows by one
+every iteration has a NEW shape each step, so every compiled consumer
+(jitted step fn, per-op cached programs) retraces per token.
+"""
+import jax.numpy as jnp
+
+
+def decode_growing_cache(step_fn, x, ks, steps):
+    for _ in range(steps):
+        k_new = step_fn(x, ks)
+        ks = jnp.concatenate([ks, k_new], axis=2)       # expect: GL007
+    return ks
+
+
+def greedy_decode_growing_tokens(nd, model, toks, n):
+    while n > 0:
+        nxt = model(toks)
+        toks = nd.concat(toks, nxt, dim=1)              # expect: GL007
+        n -= 1
+    return toks

@@ -74,6 +74,9 @@ def main():
         snap = _serve.stats()
         print("pool compiles: %d bucket program(s) built this process"
               % snap["serve_compile_counter"])
+        print("decode builds: %d generative program(s) (prefill/decode/"
+              "inject buckets — a steady-state delta here means the token "
+              "loop is retracing)" % snap["decode_compile_counter"])
         if snap["servers"]:
             for sname, s in sorted(snap["servers"].items()):
                 print("%-13s: req=%d done=%d shed=%d timeout=%d err=%d "
@@ -81,6 +84,15 @@ def main():
                       % (sname, s["requests"], s["completed"], s["shed"],
                          s["timeouts"], s["errors"], s["batches"],
                          s["batch_fill_ratio"], s["p50_ms"], s["p99_ms"]))
+                if "tokens" in s:  # generative server: token-level counters
+                    print("%-13s  tokens=%s tok/s=%s ttft_p50=%s itl_p50=%s "
+                          "itl_p99=%s fill=%s inflight=%s/%s cap=%s "
+                          "prefix=%s/%s"
+                          % ("", s["tokens"], s["tokens_per_s"],
+                             s["ttft_p50_ms"], s["itl_p50_ms"],
+                             s["itl_p99_ms"], s["inflight_fill"],
+                             s["in_flight"], s["slots"], s["capacity"],
+                             s["prefix_hits"], s["prefix_misses"]))
         else:
             print("live servers : none (snapshots appear while a "
                   "serve.ModelServer is alive)")

@@ -18,12 +18,22 @@ Both sides are host-readback-closed per request (np.asarray results — the
 PERF.md completion methodology; the server's dispatch path gathers to host
 anyway because a response leaves the process). Parity is asserted ≤1e-6.
 
-Run: python tools/serve_bench.py [--quick] [--requests 256] [--json PATH]
+``--mode decode`` benches the GENERATIVE path instead: mixed-length
+concurrent token streams through ``serve.GenerativeServer`` (continuous
+batching: paged KV cache, one fused dispatch per token step, sampling
+in-program) vs. naive per-request ``GPTModel.generate`` — the numbers are
+tokens/sec and dispatches per decode step (PERF.md "per-token decode
+dispatch" lever). Parity is exact token ids against the same greedy
+decode.
+
+Run: python tools/serve_bench.py [--quick] [--mode serve|decode]
+     [--requests 256] [--json PATH]
 
 --quick pins the CPU backend and keeps the model tiny so device compute is
 negligible and the number under test is dispatch+batching overhead (the CI
-mode; wired as `python bench.py serve --smoke` and committed to
-tools/serve_bench_quick.json).
+mode; wired as `python bench.py serve --smoke` / `python bench.py decode
+--smoke` and committed to tools/serve_bench_quick.json /
+tools/serve_decode_bench_quick.json).
 """
 import argparse
 import json
@@ -101,14 +111,115 @@ def run_served(net, samples, iters, buckets, max_wait_ms):
     return (len(samples) * iters / best, disp, outs, recompiles, stats)
 
 
+def run_decode(requests, iters, max_new, slots, seed=0):
+    """Generative decode bench: naive per-request ``generate()`` (the
+    imperative KV-cached loop — one step ROUND of per-op dispatches per
+    token per request) vs. continuous batching (ONE fused dispatch per
+    token step for ALL in-flight requests). Greedy both sides; parity is
+    exact token ids. Returns the artifact row."""
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import engine, nd
+    from mxnet_tpu.models.gpt import gpt_nano
+
+    rng = np.random.default_rng(seed)
+    m = gpt_nano()
+    m.initialize()
+    m.hybridize()
+    prompts = [rng.integers(0, 256, size=(int(l),)).astype(np.int32)
+               for l in rng.integers(3, 12, size=requests)]
+
+    # ---- naive: one KV-cached generate() per request, sequential
+    refs = [m.generate(nd.array(p[None], dtype="int32"),
+                       max_new_tokens=max_new).asnumpy()[0, len(p):].tolist()
+            for p in prompts]  # warmup + reference
+    tokens_total = requests * max_new
+    naive_best = float("inf")
+    for _ in range(iters):
+        engine.dispatch_counter.reset()
+        t0 = time.perf_counter()
+        for p in prompts:
+            m.generate(nd.array(p[None], dtype="int32"),
+                       max_new_tokens=max_new)
+        nd.waitall()
+        naive_best = min(naive_best, time.perf_counter() - t0)
+        naive_disp = engine.dispatch_counter.count
+    naive_tps = tokens_total / naive_best
+    # dispatches per generated token step, per request stream
+    naive_dps = naive_disp / max(requests * max_new, 1)
+
+    # ---- served: all requests in flight, manual stepping for exact
+    # dispatch accounting (the background loop runs the same tick)
+    srv = mx.serve.GenerativeServer(m, slots=slots, max_wait_ms=1.0,
+                                    max_queue=max(64, requests),
+                                    timeout_ms=120000.0)
+    srv.warmup(prompt_buckets=(4, 8, 16), max_tokens=32)
+    served_best, served_dps, recompiles = float("inf"), 0.0, 0
+    for _ in range(iters):
+        streams = [srv.submit(p, max_new_tokens=max_new) for p in prompts]
+        time.sleep(0.05)  # admission handover
+        engine.decode_compile_counter.reset()
+        pure_disp = pure_steps = 0
+        t0 = time.perf_counter()
+        while not all(s.done() for s in streams):
+            # a tick that admits joins also pays prefill/inject dispatches;
+            # dispatches/step is measured over PURE decode ticks only
+            joins0 = srv.metrics.prefills + (srv.prefix.hits
+                                             if srv.prefix else 0)
+            engine.dispatch_counter.reset()
+            n = srv.step()
+            joins1 = srv.metrics.prefills + (srv.prefix.hits
+                                             if srv.prefix else 0)
+            if n and joins1 == joins0:
+                pure_disp += engine.dispatch_counter.count
+                pure_steps += 1
+            elif n == 0:
+                time.sleep(0.001)
+        served_best = min(served_best, time.perf_counter() - t0)
+        served_dps = pure_disp / max(pure_steps, 1)
+        recompiles = engine.decode_compile_counter.count
+        for s, ref in zip(streams, refs):
+            got = s.result(10)
+            assert got == ref, "decode parity violated"
+    served_tps = tokens_total / served_best
+    stats = srv.stats()
+    srv.stop()
+    return {
+        "case": "gpt_nano decode",
+        "requests": requests,
+        "max_new_tokens": max_new,
+        "slots": slots,
+        "iters": iters,
+        "served_tokens_per_sec": round(served_tps, 1),
+        "naive_tokens_per_sec": round(naive_tps, 1),
+        "speedup": round(served_tps / naive_tps, 2),
+        "dispatches_per_step": round(served_dps, 2),
+        "naive_dispatches_per_token": round(naive_dps, 1),
+        "steady_state_recompiles": recompiles,
+        "inflight_fill": stats["inflight_fill"],
+        "ttft_p50_ms": stats["ttft_p50_ms"],
+        "itl_p50_ms": stats["itl_p50_ms"],
+        "prefix_hits": stats["prefix_hits"],
+        "parity": "exact token ids vs per-request generate()",
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="CPU backend + tiny model: isolate dispatch and "
                          "batching overhead (the CI mode)")
+    ap.add_argument("--mode", choices=("serve", "decode"), default="serve",
+                    help="serve: fixed-shape inference batching; decode: "
+                         "continuous-batching generative token streams")
     ap.add_argument("--requests", type=int, default=128,
                     help="requests per timed iteration")
     ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--max-new", type=int, default=16,
+                    help="decode mode: tokens generated per request")
+    ap.add_argument("--slots", type=int, default=8,
+                    help="decode mode: in-flight request pages")
     ap.add_argument("--max-wait-ms", type=float, default=2.0)
     ap.add_argument("--json", default=None, metavar="PATH")
     args = ap.parse_args(argv)
@@ -121,6 +232,23 @@ def main(argv=None):
     if args.quick:
         jax.config.update("jax_platforms", "cpu")
     import numpy as np
+
+    if args.mode == "decode":
+        rec = run_decode(args.requests if args.requests != 128 else 16,
+                         args.iters, args.max_new, args.slots)
+        print(json.dumps(rec), flush=True)
+        if args.json:
+            meta = {"quick": args.quick, "mode": "decode",
+                    "platform": jax.devices()[0].platform,
+                    "timing": "end-to-end mixed-length concurrent streams, "
+                              "host-readback closed per token (PERF.md)",
+                    "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                 time.gmtime())}
+            with open(args.json, "w") as f:
+                json.dump({"config": meta, "rows": [rec]}, f, indent=1)
+                f.write("\n")
+            print("wrote %s" % args.json)
+        return 0
 
     rng = np.random.default_rng(0)
     feat = 64

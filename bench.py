@@ -707,7 +707,8 @@ def main():
     if "--cpu" in flags:
         jax.config.update("jax_platforms", "cpu")
     mode = args[0] if args else "bert"
-    if mode in ("optstep", "imperative", "autograd", "serve", "decode"):
+    if mode in ("optstep", "imperative", "autograd", "serve", "decode",
+                "coldstart"):
         # host-dispatch microbenches (fused multi-tensor optimizer step;
         # lazy bulk imperative chain vs eager; compiled tape replay vs the
         # eager backward walk; dynamic-batched serving vs per-request
@@ -721,14 +722,17 @@ def main():
                 "imperative": "imperative_bench.py",
                 "autograd": "autograd_bench.py",
                 "serve": "serve_bench.py",
-                "decode": "serve_bench.py"}[mode]
+                "decode": "serve_bench.py",
+                "coldstart": "serve_bench.py"}[mode]
         spec = importlib.util.spec_from_file_location(
             tool[:-3], os.path.join(_REPO, "tools", tool))
         m = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(m)
         argv = ["--quick"] if (smoke or "--cpu" in flags) else []
-        if mode == "decode":
-            argv += ["--mode", "decode"]
+        if mode in ("decode", "coldstart"):
+            # coldstart = replica spin-up cold vs snapshot-warm (cache
+            # Tier B), subprocess-isolated; see tools/serve_bench.py
+            argv += ["--mode", mode]
         if iters := next((f.split("=", 1)[1] for f in flags
                           if f.startswith("--iters=")), None):
             argv += ["--iters", iters]

@@ -33,6 +33,16 @@ GL007  growing carried state — inside a ``for``/``while`` loop, a value
        Use a fixed-capacity buffer written via ``cache_write`` /
        ``lax.dynamic_update_slice`` with a valid-length mask instead.
        Host-side numpy accumulation (``np.*``) is exempt.
+GL008  direct ``jax.jit`` that bypasses the persistent compilation layer —
+       inside ``mxnet_tpu/`` every program build must route through the
+       ``base._jit_backed`` funnel (``jitted``/``bulk_jitted``/
+       ``tape_jitted``) or ``cache.AotFn``, so a warm process can
+       deserialize the executable from ``MXNET_COMP_CACHE_DIR`` instead
+       of recompiling it (and serve snapshots can export it). A raw
+       ``jax.jit`` is invisible to that store: a cold replica pays its
+       full compile every time. ``mxnet_tpu/base.py`` and
+       ``mxnet_tpu/cache/`` (the funnel itself) are structurally exempt;
+       deliberate exceptions carry an allowlist entry with a why.
 
 A *hybridizable/jitted region* is: any ``hybrid_forward`` body; any
 function decorated with ``jax.jit``/``partial(jax.jit, ...)``; any
@@ -63,7 +73,11 @@ RULES = {
     "GL005": "use after donation (donate_argnums argument reused)",
     "GL006": "unbounded module-level cache dict",
     "GL007": "growing carried state (aval changes per loop iteration)",
+    "GL008": "direct jax.jit bypasses the persistent compilation layer",
 }
+
+# paths structurally exempt from GL008: the persistent funnel itself
+_GL008_EXEMPT = ("mxnet_tpu/base.py", "mxnet_tpu/cache/")
 
 # concat-family callables whose self-referential use in a loop grows the
 # carried aval (GL007); numpy names are exempt (host accumulation)
@@ -88,7 +102,7 @@ _TRACE_ENTRY_ARG = {
     "checkpoint": 0, "remat": 0, "vmap": 0, "pmap": 0, "scan": 0,
     "bulk_jitted": 1,
 }
-_JIT_NAMES = {"jit", "pjit", "jitted"}
+_JIT_NAMES = {"jit", "pjit", "jitted", "_jit_backed"}
 
 
 class Finding(NamedTuple):
@@ -248,6 +262,7 @@ class _ModuleLint:
                 self._check_donation(node)
             if isinstance(node, ast.Call):
                 self._check_percall_jit(node)
+                self._check_unfunneled_jit(node)
             if isinstance(node, ast.Call) and _call_name(node.func) in (
                     "tuple", "list") and node.args:
                 self._check_unordered_key(node)
@@ -471,6 +486,28 @@ class _ModuleLint:
                      % _call_name(node.func),
                      self._enclosing_scope(node))
 
+    # ------------------------------------------------------------- GL008
+    def _check_unfunneled_jit(self, node: ast.Call):
+        """GL008: a direct ``jax.jit(...)`` call site. Programs built here
+        never reach the persistent compilation store (base._jit_backed /
+        cache.AotFn), so warm replicas recompile them. Path-scoped: the
+        funnel's own modules are exempt."""
+        path = self.path.replace(os.sep, "/")
+        if any(x in path for x in _GL008_EXEMPT):
+            return
+        f = node.func
+        is_jit = (isinstance(f, ast.Attribute)
+                  and f.attr in ("jit", "pjit")
+                  and isinstance(f.value, ast.Name) and f.value.id == "jax") \
+            or (isinstance(f, ast.Name) and f.id in ("jit", "pjit"))
+        if is_jit:
+            self.add(node, "GL008",
+                     "direct jax.jit bypasses the persistent compilation "
+                     "layer — route through base._jit_backed / "
+                     "base.jitted / cache.AotFn so warm processes can "
+                     "deserialize the executable instead of recompiling",
+                     self._enclosing_scope(node))
+
     # ------------------------------------------------------------- GL007
     @staticmethod
     def _src_key(node: ast.AST) -> str:
@@ -554,7 +591,8 @@ class _ModuleLint:
                 continue
             donated: Optional[Tuple[int, ...]] = None
             for kw in call.keywords:
-                if kw.arg == "donate_argnums":
+                # 'donate' is base._jit_backed's spelling of donate_argnums
+                if kw.arg in ("donate_argnums", "donate"):
                     try:
                         v = ast.literal_eval(kw.value)
                     except ValueError:

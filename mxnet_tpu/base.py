@@ -111,6 +111,27 @@ _JIT_CACHE: Dict = BoundedCache(env_cap("MXNET_JIT_CACHE_CAP", 4096))
 _BULK_CACHE: Dict = BoundedCache(env_cap("MXNET_BULK_CACHE_CAP", 1024))
 
 
+def _jit_backed(fn, device=None, donate=None, tier="jit", hint=""):
+    """The ONE funnel from this stack's program builders to jax.jit: a
+    plain ``jax.jit`` when the persistent compilation store is off (the
+    default — zero added overhead), a ``cache.AotFn`` when
+    ``MXNET_COMP_CACHE_DIR`` is configured, so the compiled executable is
+    persisted across processes (mxnet_tpu.cache Tier A). graphlint GL008
+    flags direct ``jax.jit`` call sites that bypass this funnel."""
+    from .cache import persistent_backed
+
+    backed = persistent_backed(fn, device=device, donate_argnums=donate,
+                               tier=tier, hint=hint)
+    if backed is not None:
+        return backed
+    kw = {}
+    if donate:
+        kw["donate_argnums"] = tuple(donate)
+    if device is not None:
+        kw["device"] = device
+    return jax.jit(fn, **kw)
+
+
 def bulk_jitted(key, builder):
     """Cached jitted composed program for a flushed bulk window. ``key`` is
     the structural chain key ndarray._flush_window computes; ``builder``
@@ -121,7 +142,8 @@ def bulk_jitted(key, builder):
         from .engine import bulk_compile_counter
 
         bulk_compile_counter.bump()
-        f = _BULK_CACHE[key] = jax.jit(builder())
+        f = _BULK_CACHE[key] = _jit_backed(builder(), tier="bulk",
+                                           hint="bulk")
     return f
 
 
@@ -145,8 +167,8 @@ def tape_jitted(key, builder):
     if f is None:
         tape_compile_counter.bump()
         prog, donate = builder()
-        f = _TAPE_CACHE[key] = (jax.jit(prog, donate_argnums=donate)
-                                if donate else jax.jit(prog))
+        f = _TAPE_CACHE[key] = _jit_backed(prog, donate=donate or None,
+                                           tier="tape", hint="tape")
     else:
         tape_cache_hit_counter.bump()
     return f
@@ -160,7 +182,8 @@ def jitted(fn: Callable, static_kwargs: dict, device=None):
     cached = _JIT_CACHE.get(key)
     if cached is None:
         f = functools.partial(fn, **static_kwargs) if static_kwargs else fn
-        cached = jax.jit(f, device=device) if device is not None else jax.jit(f)
+        cached = _jit_backed(f, device=device, tier="jit",
+                             hint=getattr(fn, "__name__", "op"))
         _JIT_CACHE[key] = cached
     return cached
 

@@ -79,6 +79,27 @@ def load_for_serving(prefix, epoch=0, input_names=("data",), ctx=None):
                                "%s-%04d.params" % (prefix, epoch), ctx=ctx)
 
 
+def save_serving_snapshot(server, prefix, input_names=None, epoch=0):
+    """AOT serving artifact for a live warmed server: this checkpoint
+    layout PLUS the serialized executables of every warmed program
+    (mxnet_tpu.cache Tier B — TVM export_library, arXiv 1802.04799).
+    ``load_serving_snapshot`` reaches first-request with zero compiles."""
+    from .cache.snapshot import save_snapshot
+
+    return save_snapshot(server, prefix, input_names=input_names,
+                         epoch=epoch)
+
+
+def load_serving_snapshot(prefix, model=None, **server_kwargs):
+    """Rebuild a ready server from ``save_serving_snapshot`` output —
+    programs are deserialized, never compiled (the horizontal-autoscale
+    warm start; ``serve_compile_counter``/``decode_compile_counter`` stay
+    flat from process start)."""
+    from .cache.snapshot import load_snapshot
+
+    return load_snapshot(prefix, model=model, **server_kwargs)
+
+
 def save_sharded(directory, pytree, step=0):
     """Sharded checkpoint via orbax when available (multi-host safe);
     single-host falls back to pickle-of-numpy."""

@@ -81,6 +81,18 @@ serve_compile_counter = DispatchCounter()
 # tests/test_generate.py makes, same discipline as serve_compile_counter.
 decode_compile_counter = DispatchCounter()
 
+# persistent cross-process compilation store (mxnet_tpu.cache): lookup
+# outcomes for every jit funnel when MXNET_COMP_CACHE_DIR is configured.
+# hit = a valid disk entry replaced an XLA compile; miss = nothing usable
+# on disk (the program compiled and, best-effort, persisted); deserialize
+# = successful executable loads (disk hits AND serve-snapshot preloads).
+# Same proof-hook discipline as the *_compile_counters: tests assert a
+# second process re-running an identical workload is all hits, zero
+# compiles.
+comp_cache_hit_counter = DispatchCounter()
+comp_cache_miss_counter = DispatchCounter()
+comp_cache_deserialize_counter = DispatchCounter()
+
 
 try:
     _bulk_size = int(os.environ.get("MXNET_ENGINE_BULK_SIZE", "15"))

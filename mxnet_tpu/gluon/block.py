@@ -457,7 +457,13 @@ class HybridBlock(Block):
                 upd = [tctx.state_updates.get(id(p)) for p in plist]
             return out, upd
 
-        fn = jax.jit(pure)
+        from ..base import _jit_backed
+
+        # through the persistent-compilation funnel (cache Tier A): a warm
+        # process deserializes this block's compiled forward instead of
+        # re-compiling it; under autograd's vjp trace the wrapper falls
+        # back to its inner jit, which inlines
+        fn = _jit_backed(pure, tier="hybrid", hint=type(self).__name__)
         self._cached_execs[training] = (fn, plist)
         return fn, plist
 

@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .base import jitted
+from .base import _jit_backed, jitted
 # dispatch_counter's home is the engine (it observes EVERY jitted dispatch —
 # imperative ops, bulk flushes, optimizer updates); these names stay
 # importable here for back-compat with pre-promotion callers
@@ -137,7 +137,8 @@ class Optimizer:
         wd = self._get_wd(index)
         f = getattr(self, "_jit_step", None)
         if f is None:
-            f = self._jit_step = jax.jit(self._stepper())
+            f = self._jit_step = _jit_backed(self._stepper(), tier="jit",
+                                             hint="opt_step")
         dispatch_counter.bump()
         new_w, new_state = f(weight._data, grad._data if isinstance(grad, NDArray) else grad,
                              state, jnp.float32(lr), jnp.float32(wd), jnp.int32(t),
@@ -185,7 +186,9 @@ class Optimizer:
         wd = self._get_wd(index)
         f = getattr(self, "_jit_rsp_step", None)
         if f is None:
-            f = self._jit_rsp_step = jax.jit(self._rsp_stepper())
+            f = self._jit_rsp_step = _jit_backed(self._rsp_stepper(),
+                                                 tier="jit",
+                                                 hint="opt_rsp_step")
         dispatch_counter.bump()
         new_w, new_state = f(weight._data, grad.indices._data, grad.data._data,
                              state, jnp.float32(lr), jnp.float32(wd), jnp.int32(t),
@@ -317,9 +320,10 @@ class Optimizer:
         ckey = (None if mesh is None else (mesh, shard_axis), bool(donate))
         f = cache.get(ckey)
         if f is None:
-            f = cache[ckey] = jax.jit(
+            f = cache[ckey] = _jit_backed(
                 self._fused_stepper(mesh, shard_axis),
-                donate_argnums=(0, 2) if donate else (2,))
+                donate=(0, 2) if donate else (2,), tier="jit",
+                hint="fused_step")
         dispatch_counter.bump()
         new_ws, new_states = f(ws, gs, list(states), lrs, wds, ts,
                                jnp.float32(self.rescale_grad))

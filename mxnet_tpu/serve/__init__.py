@@ -44,7 +44,7 @@ __all__ = ["ModelServer", "GenerativeServer", "GenerationStream",
            "BucketedExecutor", "DynamicBatcher", "PagedKVCache",
            "PrefixCache", "CacheError", "ServeMetrics", "GenerativeMetrics",
            "ServeError", "ServerBusy", "ServeTimeout", "PoolError",
-           "DEFAULT_BUCKETS", "load", "stats"]
+           "DEFAULT_BUCKETS", "load", "snapshot", "stats"]
 
 # live-server registry for the aggregate stats() snapshot; weak so a
 # dropped server never lingers (and the registry never grows unbounded)
@@ -55,16 +55,43 @@ def _register(server):
     _SERVERS.add(server)
 
 
-def load(prefix, epoch=0, input_names=("data",), ctx=None):
-    """Warm-start a served model from an export/checkpoint layout
-    (``prefix-symbol.json`` + ``prefix-NNNN.params``): returns a
+def load(prefix, epoch=0, input_names=("data",), ctx=None, snapshot=False,
+         model=None, **server_kwargs):
+    """Warm-start a served model.
+
+    Default (``snapshot=False``): load an export/checkpoint layout
+    (``prefix-symbol.json`` + ``prefix-NNNN.params``) and return a
     SymbolBlock with the file's exact dtypes, ready for ModelServer —
     reload compiles the same bucket programs as the exporting process
-    (checkpoint.load_for_serving)."""
+    (checkpoint.load_for_serving).
+
+    ``snapshot=True``: load an AOT serving snapshot written by
+    ``serve.snapshot`` and return a READY SERVER whose warmed programs
+    are **deserialized, not compiled** —
+    ``engine.serve_compile_counter``/``decode_compile_counter`` stay 0
+    from process start to the first served request. Generative snapshots
+    need ``model=`` (the decode protocol is code; params/config/
+    executables come from the artifact). Extra kwargs reach the server
+    constructor (queue/deadline knobs)."""
+    if snapshot:
+        from ..cache.snapshot import load_snapshot
+
+        return load_snapshot(prefix, model=model, **server_kwargs)
     from ..checkpoint import load_for_serving
 
     return load_for_serving(prefix, epoch=epoch, input_names=input_names,
                             ctx=ctx)
+
+
+def snapshot(server, prefix, input_names=None, epoch=0):
+    """Write the AOT serving artifact for a live (warmed) server — the
+    executable-shipping complement of ``checkpoint.save_for_serving``
+    (TVM export_library, arXiv 1802.04799). See
+    ``serve.load(prefix, snapshot=True)`` and mxnet_tpu.cache.snapshot."""
+    from ..cache.snapshot import save_snapshot
+
+    return save_snapshot(server, prefix, input_names=input_names,
+                         epoch=epoch)
 
 
 def stats():

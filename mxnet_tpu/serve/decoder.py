@@ -475,10 +475,19 @@ class GenerativeServer:
         ctx = _trace.trace_scope(jax.random.PRNGKey(0), False)
         return ctx
 
-    def _jit(self, fn, donate):
-        if self._donate and donate:
-            return jax.jit(fn, donate_argnums=donate)
-        return jax.jit(fn)
+    def _jit(self, fn, donate, hint=""):
+        """Decode-loop programs compile through ``cache.AotFn`` in
+        single-signature mode: shapes are fixed by the (slots, capacity /
+        prompt-bucket) key, so the hot per-token path is one attribute
+        read — and every program has an exportable executable handle for
+        Tier B snapshots plus the Tier A disk store underneath."""
+        from ..cache import AotFn
+
+        return AotFn(fn,
+                     donate_argnums=donate if (self._donate and donate)
+                     else (),
+                     tier="decode", hint=hint or "decode",
+                     single_signature=True)
 
     def _decode_fn(self, capacity):
         fn = self._decode_fns.get(capacity)
@@ -503,7 +512,7 @@ class GenerativeServer:
             valid = valid + act.astype(jnp.int32)
             return kcs, vcs, valid, nxt
 
-        fn = self._jit(pure, donate=(1, 2, 3, 4))
+        fn = self._jit(pure, donate=(1, 2, 3, 4), hint="step@c%d" % capacity)
         self._decode_fns[capacity] = fn
         return fn
 
@@ -536,7 +545,8 @@ class GenerativeServer:
             toks = jax.lax.dynamic_update_slice(toks, t0, (slot,))
             return kcs, vcs, valid, toks, jnp.reshape(last, (-1,))
 
-        fn = self._jit(pure, donate=(1, 2, 3, 4))
+        fn = self._jit(pure, donate=(1, 2, 3, 4),
+                       hint="prefill@t%dc%d" % (tp, capacity))
         self._prefill_fns[(tp, capacity)] = fn
         return fn
 
@@ -563,7 +573,8 @@ class GenerativeServer:
             toks = jax.lax.dynamic_update_slice(toks, t0, (slot,))
             return kcs, vcs, valid, toks
 
-        fn = self._jit(pure, donate=(0, 1, 2, 3))
+        fn = self._jit(pure, donate=(0, 1, 2, 3),
+                       hint="inject@t%dc%d" % (tp, capacity))
         self._inject_fns[(tp, capacity)] = fn
         return fn
 
@@ -584,7 +595,9 @@ class GenerativeServer:
                 for vc in vcs])
             return ks, vs
 
-        fn = jax.jit(pure)   # reads live caches: never donate
+        # reads live caches: never donate
+        fn = self._jit(pure, donate=(),
+                       hint="extract@t%dc%d" % (tp, capacity))
         self._extract_fns[(tp, capacity)] = fn
         return fn
 
@@ -635,6 +648,54 @@ class GenerativeServer:
             if self.cache.owner(slot) is dummy:
                 self._retire(slot)
         return self
+
+    # ------------------------------------------------ snapshot interface
+    def export_executables(self):
+        """Every compiled generative program, tagged for the snapshot
+        manifest: [{key, kind, tp, capacity, compiled}] covering decode
+        steps AND the join path (prefill/inject/extract buckets) — a warm
+        replica must reach its first token with zero compiles."""
+        out = []
+        for cap, fn in sorted(self._decode_fns.items()):
+            c = fn.compiled_for()
+            if c is not None:
+                out.append({"key": "decode@c%d" % cap, "kind": "decode",
+                            "tp": 0, "capacity": int(cap), "compiled": c})
+        for kind, fns in (("prefill", self._prefill_fns),
+                          ("inject", self._inject_fns),
+                          ("extract", self._extract_fns)):
+            for (tp, cap), fn in sorted(fns.items()):
+                c = fn.compiled_for()
+                if c is not None:
+                    out.append({"key": "%s@t%dc%d" % (kind, tp, cap),
+                                "kind": kind, "tp": int(tp),
+                                "capacity": int(cap), "compiled": c})
+        return out
+
+    def preload_executable(self, kind, tp, capacity, compiled):
+        """Adopt one deserialized program (snapshot warm start): builds
+        the wrapper for its key — cheap, no trace — and installs the
+        executable. A mismatched executable recompiles with one warning at
+        first use (AotFn's recovery path)."""
+        if kind == "decode":
+            fn = self._decode_fn(capacity)
+        elif kind == "prefill":
+            fn = self._prefill_fn(tp, capacity)
+        elif kind == "inject":
+            fn = self._inject_fn(tp, capacity)
+        elif kind == "extract":
+            fn = self._extract_fn(tp, capacity)
+        else:
+            raise ServeError("unknown snapshot program kind %r" % kind)
+        fn.adopt(compiled)
+
+    def snapshot(self, prefix):
+        """Write the AOT serving artifact for this server (checkpoint +
+        decode config + every warmed program's serialized executable) —
+        see serve.snapshot / cache.snapshot."""
+        from ..cache.snapshot import save_snapshot
+
+        return save_snapshot(self, prefix)
 
     # ------------------------------------------------------------- stats
     def stats(self):

@@ -83,7 +83,10 @@ class BucketedExecutor:
 
         def traced(params, *xs):
             # executes at TRACE time only: one bump per program build is the
-            # zero-retrace proof tests/test_serve.py asserts
+            # zero-retrace proof tests/test_serve.py asserts. A snapshot
+            # warm start (serve.load(snapshot=True)) never traces at all —
+            # deserialized executables are adopted directly — so this
+            # counter reads 0 from process start to first request there.
             engine.serve_compile_counter.bump()
             out = fn(params, *xs)
             return list(out) if isinstance(out, (list, tuple)) else [out]
@@ -91,8 +94,13 @@ class BucketedExecutor:
         if donate is None:
             donate = is_tpu_backend()
         self._donate = bool(donate)
-        self._jit = jax.jit(traced)  # inputs unknown yet; donate set lazily
-        self._jit_donating = None
+        # per-signature AOT dispatch (cache.AotFn): explicit lower/compile
+        # per bucket so every bucket program has an exportable executable
+        # handle (Tier B snapshots) and a persistent disk tier under it
+        # (Tier A) — jax.jit's internal cache can do neither. One wrapper
+        # per (replica, donating): a Compiled is specialized to its
+        # arguments' device placement, so replicas cannot share one.
+        self._aots = {}
         self._fn = traced
 
     # ------------------------------------------------------------ buckets
@@ -162,15 +170,27 @@ class BucketedExecutor:
         params = self._replica_params(replica)
         xs = [jnp.asarray(x) if dev is None else jax.device_put(x, dev)
               for x in inputs]
-        if self._donate and donate_ok:
-            if self._jit_donating is None:
-                self._jit_donating = jax.jit(
-                    self._fn, donate_argnums=tuple(range(1, 1 + len(xs))))
-            fn = self._jit_donating
-        else:
-            fn = self._jit
         engine.dispatch_counter.bump()
-        return fn(params, *xs)
+        return self._exec_for(replica, len(xs), donate_ok)(params, *xs)
+
+    def _exec_for(self, replica, n_inputs, donate_ok):
+        """The AOT wrapper a dispatch routes through: one per (replica,
+        donating) — the donating variant on TPU (padded inputs are
+        per-request scratch), the plain one elsewhere / for caller-owned
+        buffers."""
+        donating = bool(self._donate and donate_ok)
+        aot = self._aots.get((replica, donating))
+        if aot is None:
+            from ..cache import AotFn
+
+            aot = self._aots[(replica, donating)] = AotFn(
+                self._fn,
+                donate_argnums=(tuple(range(1, 1 + n_inputs))
+                                if donating else ()),
+                tier="serve",
+                hint="%s:r%d%s" % (self.name, replica,
+                                   ":donated" if donating else ""))
+        return aot
 
     def run(self, inputs, n_real=None, replica=None):
         """Execute a coalesced batch: pad to bucket, one cached dispatch,
@@ -255,6 +275,64 @@ class BucketedExecutor:
             for r in range(len(self._devices)):
                 self.run(zeros, n_real=b, replica=r)
         return self
+
+    # ------------------------------------------------ snapshot interface
+    def _bucket_sig(self, aot, bucket, input_specs):
+        """Call signature of a bucket dispatch, computed from shape specs
+        (no arrays, no trace): (params, *padded_inputs)."""
+        params = [jax.ShapeDtypeStruct(tuple(p.shape), p.dtype)
+                  for p in self._params_fn()]
+        xs = [jax.ShapeDtypeStruct((int(bucket),) + tuple(shape),
+                                   np.dtype(dt))
+              for shape, dt in input_specs]
+        return aot.sig_of(params, *xs)
+
+    def export_executables(self, input_specs, buckets):
+        """Every warmed bucket's compiled executable, tagged for the
+        snapshot manifest: [{key, bucket, donating, compiled}]. Replica 0
+        only — a snapshot-warmed replica is a fresh single-device process
+        (the horizontal-autoscale unit); extra replicas compile lazily."""
+        out = []
+        for donating in (False, True):
+            aot = self._aots.get((0, donating))
+            if aot is None:
+                continue
+            for b in buckets:
+                c = aot.compiled_for(self._bucket_sig(aot, b, input_specs))
+                if c is not None:
+                    out.append({"key": "b%d_d%d" % (b, int(donating)),
+                                "bucket": int(b),
+                                "donating": bool(donating),
+                                "compiled": c})
+        return out
+
+    def preload_executables(self, entries, input_specs):
+        """Adopt deserialized bucket executables (snapshot warm start): no
+        trace, no compile. Entries that don't match the live signature are
+        caught at first dispatch (AotFn recompiles with one warning)."""
+        for e in entries:
+            aot = self._exec_for(0, len(input_specs),
+                                 donate_ok=e["donating"])
+            aot.adopt(e["compiled"],
+                      self._bucket_sig(aot, e["bucket"], input_specs))
+
+    def export_state(self):
+        """Host-side pool state a snapshot must carry so a warm start
+        needs no proving dispatch (warmup also exists to learn these)."""
+        return {"in_dtypes": [str(np.dtype(dt)) for dt in self._in_dtypes]
+                if self._in_dtypes else None,
+                "row_outputs": self._row_outputs,
+                "donate": self._donate}
+
+    def restore_state(self, state):
+        if state.get("in_dtypes"):
+            self._in_dtypes = [np.dtype(d) for d in state["in_dtypes"]]
+        if state.get("row_outputs") is not None:
+            self._row_outputs = [bool(r) for r in state["row_outputs"]]
+        if state.get("donate") is not None:
+            # the exporter's donation decision rode into the executables;
+            # dispatch must route the same way or warm start would retrace
+            self._donate = bool(state["donate"])
 
 
 def symbol_infer_fn(outputs, input_names, param_names=None):

@@ -131,6 +131,17 @@ class ModelServer:
                 "padded serving cannot slice per-request rows")
         return self
 
+    def snapshot(self, prefix, input_names=None, epoch=0):
+        """Write the AOT serving artifact: checkpoint + bucket config +
+        every warmed bucket's serialized executable.
+        ``serve.load(prefix, snapshot=True)`` rebuilds this server with
+        ZERO compiles to first request (cache Tier B; see
+        mxnet_tpu.cache.snapshot)."""
+        from ..cache.snapshot import save_snapshot
+
+        return save_snapshot(self, prefix, input_names=input_names,
+                             epoch=epoch)
+
     def start(self):
         self._batcher.start()
         self._started = True

@@ -15,7 +15,7 @@ import jax.numpy as jnp
 from jax import lax
 import numpy as np
 
-from .base import OP_REGISTRY, resolve_dtype
+from .base import OP_REGISTRY, _jit_backed, resolve_dtype
 from .context import current_context
 from .ndarray import NDArray
 
@@ -205,7 +205,9 @@ class Symbol:
         cached = getattr(self, "_eval_exec", None)
         if cached is None:
             fn, names = self._build_fn()
-            cached = self._eval_exec = (jax.jit(fn), names)
+            cached = self._eval_exec = (_jit_backed(fn, tier="jit",
+                                                    hint="symbol.eval"),
+                                        names)
         jfn, names = cached
         vals = [kwargs[n]._data if isinstance(kwargs[n], NDArray) else jnp.asarray(kwargs[n])
                 for n in names]
@@ -1065,7 +1067,7 @@ class Executor:
             keyed = _graph_has_rng(s)
             fn, names = s._build_fn(thread_key=keyed)
             assert names == self._names
-            ent = (jax.jit(fn), keyed)
+            ent = (_jit_backed(fn, tier="jit", hint="executor"), keyed)
             self._modes[bool(is_train)] = ent
         return ent
 

@@ -1,0 +1,18 @@
+"""GL008 negative: program builds routed through the persistent
+compilation funnel (base._jit_backed / jitted / cache.AotFn) — the
+compiled executables land in MXNET_COMP_CACHE_DIR and warm processes
+deserialize instead of recompiling."""
+from mxnet_tpu.base import _jit_backed, jitted
+from mxnet_tpu.cache import AotFn
+
+
+def build_step(fn):
+    return _jit_backed(fn, tier="jit", hint="step")
+
+
+def build_op(fn, static):
+    return jitted(fn, static)
+
+
+def build_pool_program(fn):
+    return AotFn(fn, tier="serve", hint="pool")

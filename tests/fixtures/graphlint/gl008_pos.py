@@ -1,0 +1,16 @@
+"""GL008 positive: direct jax.jit call sites that bypass the persistent
+compilation layer — a warm process can never deserialize these programs
+from MXNET_COMP_CACHE_DIR; every fresh replica pays the full compile."""
+import jax
+
+
+def build_step(fn):
+    # a module building its own jitted program instead of routing through
+    # base._jit_backed / cache.AotFn
+    step = jax.jit(fn)  # expect: GL008
+    return step
+
+
+def build_donating(fn):
+    step = jax.jit(fn, donate_argnums=(0,))  # expect: GL008
+    return step

@@ -64,6 +64,36 @@ def main():
           % (_eng.tape_cache_hit_counter.count,
              _eng.tape_compile_counter.count))
 
+    print("----------Compilation Cache----------")
+    # persistent cross-process compilation layer (mxnet_tpu.cache): per-tier
+    # disk entries/bytes plus this process's hit/miss/deserialize counters
+    # and the store's GC/robustness tallies — attach when reporting replica
+    # cold-start or warm-start-still-compiles regressions
+    try:
+        from mxnet_tpu import cache as _cc
+        snap = _cc.stats()
+        if not snap["enabled"]:
+            print("store        : disabled (set MXNET_COMP_CACHE_DIR to "
+                  "persist compiled executables across processes)")
+        else:
+            print("store        : %s (cap %d MiB)"
+                  % (snap["dir"], snap["cap_bytes"] // (1 << 20)))
+            print("entries      : %d (%d KiB): %s"
+                  % (snap["entries"], snap["bytes"] // 1024,
+                     ", ".join("%s=%d" % (t, d["entries"])
+                               for t, d in sorted(snap["tiers"].items())
+                               if d["entries"])
+                     or "empty"))
+            print("gc/robustness: writes=%d evictions=%d stale=%d "
+                  "corrupt=%d wrong_key=%d"
+                  % (snap["writes"], snap["evictions"], snap["stale"],
+                     snap["corrupt"], snap["wrong_key"]))
+        print("this process : hits=%d misses=%d deserializes=%d "
+              "(deserializes include serve-snapshot preloads)"
+              % (snap["hits"], snap["misses"], snap["deserializes"]))
+    except Exception as e:
+        print("cache unavailable:", e)
+
     print("----------Serving----------")
     # mxnet_tpu.serve state: the executor-pool compile counter (a nonzero
     # steady-state delta here means bucket programs are retracing — attach

@@ -18,6 +18,16 @@ Both sides are host-readback-closed per request (np.asarray results — the
 PERF.md completion methodology; the server's dispatch path gathers to host
 anyway because a response leaves the process). Parity is asserted ≤1e-6.
 
+``--mode coldstart`` benches REPLICA SPIN-UP instead: process-spawn →
+first served request, cold (fresh process compiles every bucket) vs
+snapshot-warm (fresh process ``serve.load(prefix, snapshot=True)``
+deserializes every bucket executable — zero compiles, asserted via
+``engine.serve_compile_counter``). Each side runs in its own subprocess
+so the in-process jit caches cannot leak between them; parity of the
+served outputs is asserted ≤1e-6. This is the cache Tier B acceptance
+number (PERF.md "replica cold-start" lever; artifact
+tools/serve_coldstart_bench_quick.json).
+
 ``--mode decode`` benches the GENERATIVE path instead: mixed-length
 concurrent token streams through ``serve.GenerativeServer`` (continuous
 batching: paged KV cache, one fused dispatch per token step, sampling
@@ -205,14 +215,155 @@ def run_decode(requests, iters, max_new, slots, seed=0):
     }
 
 
+def _coldstart_model(quick):
+    """Deterministic-shape serving model for the spin-up bench. --quick: a
+    4-layer MLP (CPU CI); full: resnet18 (real bucket compiles)."""
+    import numpy as np
+
+    from mxnet_tpu import gluon, nd
+
+    if quick:
+        feat = 128
+        net = gluon.nn.HybridSequential()
+        with net.name_scope():
+            for _ in range(3):
+                net.add(gluon.nn.Dense(256, activation="relu"))
+            net.add(gluon.nn.Dense(10))
+        net.initialize()
+        net(nd.array(np.zeros((1, feat), np.float32)))
+        net.hybridize()
+        return net, ((feat,), "float32")
+    from mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+
+    net = resnet18_v1()
+    net.initialize()
+    net(nd.array(np.zeros((1, 3, 224, 224), np.float32)))
+    net.hybridize()
+    return net, ((3, 224, 224), "float32")
+
+
+def coldstart_child(which, prefix, quick, buckets, t_entry):
+    """One replica spin-up, timed inside the child process. ``cold``
+    builds + warm-compiles + serves + WRITES the snapshot (untimed);
+    ``warm`` loads the snapshot and serves. Prints one JSON line."""
+    import numpy as np
+
+    t_import0 = time.perf_counter()
+    import jax  # noqa: F401  (the dominant import)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import engine
+
+    import_s = time.perf_counter() - t_import0
+    rng = np.random.default_rng(0)
+    t0 = time.perf_counter()
+    if which == "cold":
+        net, spec = _coldstart_model(quick)
+        srv = mx.serve.ModelServer(net, [spec], buckets=buckets,
+                                   max_wait_ms=0.5, timeout_ms=30000.0)
+    else:
+        srv = mx.serve.load(prefix, snapshot=True, max_wait_ms=0.5,
+                            timeout_ms=30000.0)
+        spec = srv._specs[0]
+    x = rng.normal(size=spec[0]).astype(np.dtype(spec[1]))
+    with srv:
+        out = srv.predict(x)
+    first_request_s = time.perf_counter() - t0
+    spawn_env = os.environ.get("MXNET_SPAWN_T0")
+    spawn_to_first_s = (time.time() - float(spawn_env)) if spawn_env else None
+    rec = {
+        "which": which,
+        "first_request_s": round(first_request_s, 4),
+        "spawn_to_first_s": (round(spawn_to_first_s, 4)
+                             if spawn_to_first_s is not None else None),
+        "spawn_to_main_s": round(t_entry, 4),
+        "import_s": round(import_s, 4),
+        "serve_compiles": engine.serve_compile_counter.count,
+        "deserializes": engine.comp_cache_deserialize_counter.count,
+        "out": np.asarray(out).ravel()[:8].astype(float).tolist(),
+        "out_sum": float(np.asarray(out).sum()),
+    }
+    if which == "cold":
+        srv.snapshot(prefix)  # untimed: the artifact is built once, offline
+    print(json.dumps(rec), flush=True)
+    return 0
+
+
+def run_coldstart(quick, prefix=None):
+    """Spawn the cold and warm children, check parity + the zero-compile
+    contract, and return the artifact row."""
+    import subprocess
+    import tempfile
+
+    import numpy as np
+
+    buckets = (1, 2, 4, 8, 16, 32)
+    tmp = None
+    if prefix is None:
+        tmp = tempfile.mkdtemp(prefix="mxc_coldstart_")
+        prefix = os.path.join(tmp, "snap")
+    here = os.path.abspath(__file__)
+    out = {}
+    for which in ("cold", "warm"):
+        env = dict(os.environ, MXNET_SPAWN_T0=repr(time.time()))
+        argv = [sys.executable, here, "--mode", "coldstart",
+                "--coldstart-child", which, "--prefix", prefix]
+        if quick:
+            argv.append("--quick")
+        r = subprocess.run(argv, capture_output=True, text=True, env=env,
+                           timeout=1800)
+        if r.returncode != 0:
+            raise RuntimeError("%s child failed:\n%s\n%s"
+                               % (which, r.stdout, r.stderr))
+        out[which] = json.loads(r.stdout.strip().splitlines()[-1])
+    cold, warm = out["cold"], out["warm"]
+    assert warm["serve_compiles"] == 0, \
+        "snapshot-warm replica traced %d bucket programs (must be 0: the " \
+        "Tier B zero-compile contract)" % warm["serve_compiles"]
+    assert np.allclose(cold["out"], warm["out"], atol=1e-6) and \
+        abs(cold["out_sum"] - warm["out_sum"]) < 1e-4, \
+        "cold/warm output parity violated"
+    rec = {
+        "case": ("mlp128 coldstart" if quick else "resnet18 coldstart"),
+        "buckets": list(buckets),
+        "cold_first_request_s": cold["first_request_s"],
+        "warm_first_request_s": warm["first_request_s"],
+        # the headline: replica-ready time once the interpreter is up —
+        # build+compile+serve vs snapshot-load+serve. Interpreter + jax
+        # import are identical on both sides and reported separately.
+        "speedup": round(cold["first_request_s"]
+                         / warm["first_request_s"], 2),
+        "cold_spawn_to_first_s": cold["spawn_to_first_s"],
+        "warm_spawn_to_first_s": warm["spawn_to_first_s"],
+        "spawn_speedup": (round(cold["spawn_to_first_s"]
+                                / warm["spawn_to_first_s"], 2)
+                          if cold.get("spawn_to_first_s")
+                          and warm.get("spawn_to_first_s") else None),
+        "import_s": warm["import_s"],
+        "warm_serve_compiles": warm["serve_compiles"],
+        "cold_serve_compiles": cold["serve_compiles"],
+        "warm_deserializes": warm["deserializes"],
+        "parity_atol": 1e-6,
+    }
+    return rec
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="CPU backend + tiny model: isolate dispatch and "
                          "batching overhead (the CI mode)")
-    ap.add_argument("--mode", choices=("serve", "decode"), default="serve",
+    ap.add_argument("--mode", choices=("serve", "decode", "coldstart"),
+                    default="serve",
                     help="serve: fixed-shape inference batching; decode: "
-                         "continuous-batching generative token streams")
+                         "continuous-batching generative token streams; "
+                         "coldstart: replica spin-up cold vs snapshot-warm "
+                         "(subprocess-isolated)")
+    ap.add_argument("--coldstart-child", choices=("cold", "warm"),
+                    default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--prefix", default=None,
+                    help="coldstart: snapshot artifact prefix (default: "
+                         "a temp dir)")
     ap.add_argument("--requests", type=int, default=128,
                     help="requests per timed iteration")
     ap.add_argument("--iters", type=int, default=5)
@@ -227,6 +378,35 @@ def main(argv=None):
     if args.quick:
         os.environ["PALLAS_AXON_POOL_IPS"] = ""
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    if args.mode == "coldstart":
+        if args.coldstart_child:
+            # child: time everything INSIDE the spawned process (jax not
+            # yet imported here — that's part of what's being measured);
+            # t_entry = spawn→main latency (interpreter + this module)
+            t0 = os.environ.get("MXNET_SPAWN_T0")
+            t_entry = (time.time() - float(t0)) if t0 else 0.0
+            return coldstart_child(args.coldstart_child, args.prefix,
+                                   args.quick, (1, 2, 4, 8, 16, 32),
+                                   t_entry)
+        rec = run_coldstart(args.quick, prefix=args.prefix)
+        print(json.dumps(rec), flush=True)
+        if args.json:
+            meta = {"quick": args.quick, "mode": "coldstart",
+                    "timing": "per-side subprocess: first_request_s = "
+                              "model build/snapshot load + warmup/preload "
+                              "+ first served response (imports excluded, "
+                              "identical both sides and reported); "
+                              "spawn_to_first_s includes interpreter+jax "
+                              "import",
+                    "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                 time.gmtime())}
+            with open(args.json, "w") as f:
+                json.dump({"config": meta, "rows": [rec]}, f, indent=1)
+                f.write("\n")
+            print("wrote %s" % args.json)
+        return 0
+
     import jax
 
     if args.quick:

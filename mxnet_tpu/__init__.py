@@ -68,5 +68,6 @@ from . import libinfo  # native lib paths + parity version line
 from . import kvstore_server  # justified N/A: no PS role on this backend
 from . import analysis  # graphlint: tracing-hygiene static + trace checks
 from . import serve  # dynamic-batching inference on bucketed executors
+from . import observability  # unified runtime telemetry (registry/tracing)
 
 __all__ = ["nd", "gluon", "autograd", "cpu", "gpu", "tpu", "Context", "NDArray"]

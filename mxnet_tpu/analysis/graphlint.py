@@ -43,6 +43,17 @@ GL008  direct ``jax.jit`` that bypasses the persistent compilation layer —
        full compile every time. ``mxnet_tpu/base.py`` and
        ``mxnet_tpu/cache/`` (the funnel itself) are structurally exempt;
        deliberate exceptions carry an allowlist entry with a why.
+GL009  ad-hoc metric state outside ``mxnet_tpu/observability/`` — a
+       ``DispatchCounter(...)`` instantiation anywhere, or a module-level
+       binding of a metric object (``Counter``/``Gauge``/``Histogram``/
+       ``ServeMetrics``/``GenerativeMetrics``), outside the observability
+       package. Telemetry that isn't registered is telemetry the
+       ``/metrics`` endpoint, ``observability.snapshot()`` and the
+       retrace watchdog can't see — create metrics through
+       ``observability.registry`` (``counter``/``gauge``/``histogram``)
+       or register a collector. The engine proof-hook counters (the
+       dispatch/compile counters the registry itself absorbs) carry
+       allowlist entries with whys.
 
 A *hybridizable/jitted region* is: any ``hybrid_forward`` body; any
 function decorated with ``jax.jit``/``partial(jax.jit, ...)``; any
@@ -74,10 +85,20 @@ RULES = {
     "GL006": "unbounded module-level cache dict",
     "GL007": "growing carried state (aval changes per loop iteration)",
     "GL008": "direct jax.jit bypasses the persistent compilation layer",
+    "GL009": "ad-hoc metric state outside mxnet_tpu/observability",
 }
 
 # paths structurally exempt from GL008: the persistent funnel itself
 _GL008_EXEMPT = ("mxnet_tpu/base.py", "mxnet_tpu/cache/")
+
+# paths structurally exempt from GL009: the metrics registry itself
+_GL009_EXEMPT = ("mxnet_tpu/observability/",)
+
+# metric classes whose MODULE-LEVEL instantiation outside observability is
+# ad-hoc metric state (function/method-level instances are request- or
+# server-scoped and register through their owners)
+_GL009_METRIC_CLASSES = {"Counter", "Gauge", "Histogram", "ServeMetrics",
+                         "GenerativeMetrics"}
 
 # concat-family callables whose self-referential use in a loop grows the
 # carried aval (GL007); numpy names are exempt (host accumulation)
@@ -263,6 +284,7 @@ class _ModuleLint:
             if isinstance(node, ast.Call):
                 self._check_percall_jit(node)
                 self._check_unfunneled_jit(node)
+                self._check_adhoc_metric(node)
             if isinstance(node, ast.Call) and _call_name(node.func) in (
                     "tuple", "list") and node.args:
                 self._check_unordered_key(node)
@@ -507,6 +529,46 @@ class _ModuleLint:
                      "base.jitted / cache.AotFn so warm processes can "
                      "deserialize the executable instead of recompiling",
                      self._enclosing_scope(node))
+
+    # ------------------------------------------------------------- GL009
+    def _module_metric_names(self) -> Dict[int, str]:
+        """lineno → assigned name for MODULE-LEVEL ``NAME = Cls(...)``
+        bindings (allowlist scope stability: the counter's own name, like
+        GL006's cache names, survives refactors better than a lineno)."""
+        cached = getattr(self, "_gl009_names", None)
+        if cached is None:
+            cached = self._gl009_names = {}
+            for node in self.tree.body:
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and isinstance(node.value, ast.Call):
+                    cached[node.value.lineno] = node.targets[0].id
+        return cached
+
+    def _check_adhoc_metric(self, node: ast.Call):
+        """GL009: metric state created outside the observability package —
+        a ``DispatchCounter(...)`` anywhere, or a module-level metric-class
+        binding. Unregistered telemetry is invisible to ``snapshot()``,
+        ``/metrics`` and the retrace watchdog."""
+        path = self.path.replace(os.sep, "/")
+        if any(x in path for x in _GL009_EXEMPT):
+            return
+        name = _call_name(node.func)
+        mod_names = self._module_metric_names()
+        if name == "DispatchCounter":
+            scope = mod_names.get(node.lineno,
+                                  self._enclosing_scope(node))
+            self.add(node, "GL009",
+                     "DispatchCounter() outside mxnet_tpu/observability — "
+                     "proof-hook counters live in engine (allowlisted); "
+                     "new telemetry goes through observability.registry",
+                     scope)
+        elif name in _GL009_METRIC_CLASSES and node.lineno in mod_names:
+            self.add(node, "GL009",
+                     "module-level %s(...) is ad-hoc metric state — create "
+                     "it via observability.registry so snapshot()/"
+                     "/metrics/the watchdog can see it" % name,
+                     mod_names[node.lineno])
 
     # ------------------------------------------------------------- GL007
     @staticmethod

@@ -111,6 +111,16 @@ _JIT_CACHE: Dict = BoundedCache(env_cap("MXNET_JIT_CACHE_CAP", 4096))
 _BULK_CACHE: Dict = BoundedCache(env_cap("MXNET_BULK_CACHE_CAP", 1024))
 
 
+def _key_note(kind, key, limit=200):
+    """Compact, truncated rendering of a program-cache key for watchdog
+    attribution (observability): enough to identify the offending chain /
+    tape topology in a structured warning, never the full key blob."""
+    s = repr(key)
+    if len(s) > limit:
+        s = s[:limit - 3] + "..."
+    return "%s:%s" % (kind, s)
+
+
 def _jit_backed(fn, device=None, donate=None, tier="jit", hint=""):
     """The ONE funnel from this stack's program builders to jax.jit: a
     plain ``jax.jit`` when the persistent compilation store is off (the
@@ -141,7 +151,9 @@ def bulk_jitted(key, builder):
     if f is None:
         from .engine import bulk_compile_counter
 
-        bulk_compile_counter.bump()
+        # note= carries the chain key to the retrace watchdog: a post-warmup
+        # miss here warns with the offending topology (observability)
+        bulk_compile_counter.bump(note=_key_note("bulk", key))
         f = _BULK_CACHE[key] = _jit_backed(builder(), tier="bulk",
                                            hint="bulk")
     return f
@@ -165,7 +177,7 @@ def tape_jitted(key, builder):
 
     f = _TAPE_CACHE.get(key)
     if f is None:
-        tape_compile_counter.bump()
+        tape_compile_counter.bump(note=_key_note("tape", key))
         prog, donate = builder()
         f = _TAPE_CACHE[key] = _jit_backed(prog, donate=donate or None,
                                            tier="tape", hint="tape")

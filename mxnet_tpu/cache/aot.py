@@ -120,17 +120,29 @@ class AotFn:
 
     # ------------------------------------------------------------ acquire
     def _acquire(self, args, kwargs, sig):
-        """lower → (disk tier) → compile → (disk tier save) → cache."""
-        from . import active_store
+        """lower → (disk tier) → compile → (disk tier save) → cache.
 
-        lowered = self._jit.lower(*args, **kwargs)
-        store = active_store()
-        compiled = store.lookup(self.tier, lowered) if store is not None \
-            else None
-        if compiled is None:
-            compiled = lowered.compile()
-            if store is not None:
-                store.save(self.tier, lowered, compiled)
+        The whole acquire runs under an observability ``compile_context``
+        (the serve/decode compile counters bump INSIDE the traced bodies,
+        so this is where the retrace watchdog learns which program is
+        being built) and its wall time feeds the compile-time gauges."""
+        import time
+
+        from . import active_store
+        from ..observability import note_compile, watchdog
+
+        t0 = time.perf_counter()
+        with watchdog.compile_context("%s:%s" % (self.tier,
+                                                 self.hint or "fn")):
+            lowered = self._jit.lower(*args, **kwargs)
+            store = active_store()
+            compiled = store.lookup(self.tier, lowered) if store is not None \
+                else None
+            if compiled is None:
+                compiled = lowered.compile()
+                if store is not None:
+                    store.save(self.tier, lowered, compiled)
+        note_compile(time.perf_counter() - t0)
         if self._single:
             self._only = compiled
         else:

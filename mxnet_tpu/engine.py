@@ -35,34 +35,47 @@ class DispatchCounter:
     multi-tensor). The hook tests and tools/*_bench.py use to assert "N ops
     → 1 dispatch" — reset() before the region, read .count after.
     (Promoted here from optimizer.py; mxnet_tpu.optimizer.dispatch_counter
-    remains a back-compat alias to this object.)"""
+    remains a back-compat alias to this object.)
 
-    __slots__ = ("count",)
+    These instances ARE the proof-hook primitives the observability
+    registry absorbs (mxnet_tpu/observability reads them by name) — new
+    metric state belongs in that registry, not in fresh DispatchCounters
+    (graphlint GL009; this module's instances carry allowlist entries).
+    ``_watch`` is the retrace-watchdog hook: when armed it receives every
+    bump with the cache-key ``note`` the miss site passed — one is-None
+    test on the hot path when disarmed."""
 
-    def __init__(self):
+    __slots__ = ("count", "name", "_watch")
+
+    def __init__(self, name=""):
         self.count = 0
+        self.name = name
+        self._watch = None
 
-    def bump(self, n=1):
+    def bump(self, n=1, note=None):
         self.count += n
+        w = self._watch
+        if w is not None:
+            w(self, n, note)
 
     def reset(self):
         self.count = 0
 
 
-dispatch_counter = DispatchCounter()
+dispatch_counter = DispatchCounter("dispatch")
 
 # bumps once per composed bulk-program BUILD (a jit-cache miss in
 # base.bulk_jitted); steady-state epochs re-running an identical chain must
 # not bump it — the "no retrace" assertion tests/test_bulk_engine.py makes
-bulk_compile_counter = DispatchCounter()
+bulk_compile_counter = DispatchCounter("bulk_compile")
 
 # compiled tape replay (autograd.backward): tape_compile_counter bumps once
 # per backward-program BUILD (a base.tape_jitted miss) — steady-state
 # record→backward loops must not bump it (the zero-retrace assertion in
 # tests/test_tape_replay.py); tape_cache_hit_counter counts the hits
 # (surfaced by tools/diagnose.py)
-tape_compile_counter = DispatchCounter()
-tape_cache_hit_counter = DispatchCounter()
+tape_compile_counter = DispatchCounter("tape_compile")
+tape_cache_hit_counter = DispatchCounter("tape_cache_hit")
 
 # serving executor pool (mxnet_tpu.serve): bumps once per bucket-program
 # BUILD (an XLA trace of a pool's inference function — the bump sits inside
@@ -70,7 +83,7 @@ tape_cache_hit_counter = DispatchCounter()
 # all configured buckets up front; a steady-state request stream must not
 # bump it — the zero-retrace assertion tests/test_serve.py makes, same
 # discipline as bulk_compile_counter/tape_compile_counter.
-serve_compile_counter = DispatchCounter()
+serve_compile_counter = DispatchCounter("serve_compile")
 
 # generative decode (mxnet_tpu.serve.GenerativeServer): bumps once per
 # prefill/decode/inject program BUILD — the bump sits INSIDE the traced body,
@@ -79,7 +92,7 @@ serve_compile_counter = DispatchCounter()
 # bucket), a steady decode stream — including requests joining and leaving
 # between steps — must not bump it: the zero-retrace assertion
 # tests/test_generate.py makes, same discipline as serve_compile_counter.
-decode_compile_counter = DispatchCounter()
+decode_compile_counter = DispatchCounter("decode_compile")
 
 # persistent cross-process compilation store (mxnet_tpu.cache): lookup
 # outcomes for every jit funnel when MXNET_COMP_CACHE_DIR is configured.
@@ -89,9 +102,9 @@ decode_compile_counter = DispatchCounter()
 # Same proof-hook discipline as the *_compile_counters: tests assert a
 # second process re-running an identical workload is all hits, zero
 # compiles.
-comp_cache_hit_counter = DispatchCounter()
-comp_cache_miss_counter = DispatchCounter()
-comp_cache_deserialize_counter = DispatchCounter()
+comp_cache_hit_counter = DispatchCounter("comp_cache_hit")
+comp_cache_miss_counter = DispatchCounter("comp_cache_miss")
+comp_cache_deserialize_counter = DispatchCounter("comp_cache_deserialize")
 
 
 try:

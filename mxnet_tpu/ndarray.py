@@ -440,6 +440,13 @@ _engine_tls = _engine._bulk_tls
 # a single flag read per op instead of two module-attr chains
 _prof_on = False
 
+# per-op dispatch telemetry (observability.enable_op_telemetry): same
+# precomputed-boolean trick as _prof_on — the off-state hot-loop cost is
+# ONE flag read per op. _obs_counts is the registry-owned dict (bounded by
+# len(OP_REGISTRY)); this module only holds the pointer.
+_obs_on = False
+_obs_counts = None
+
 # Signature interning: a signature — (dtype, shape) for arrays, the
 # python/numpy scalar TYPE for weak-typed scalar leaves — is replaced by a
 # small process-global int everywhere the hot loop touches it (window
@@ -638,6 +645,9 @@ def invoke(opname, args, kwargs, _inner=False):
     included — runs in this single frame: an extra wrapper frame is
     ~0.5us/op, and the lazy path's whole budget is a few us. The profiled
     route re-enters once with ``_inner=True`` to wrap itself in op_scope."""
+    if _obs_on and not _inner:
+        # GIL-atomic dict increment; the dict is owned by observability
+        _obs_counts[opname] = _obs_counts.get(opname, 0) + 1
     if _prof_on and not _inner:
         with _profiler_mod.op_scope(opname):
             return invoke(opname, args, kwargs, True)

@@ -1,0 +1,190 @@
+"""mxnet_tpu.observability — unified runtime telemetry.
+
+One registry absorbs every signal the repo already proves its dispatch
+story with — the engine ``DispatchCounter``s (dispatch + the
+bulk/tape/serve/decode compile counters and comp-cache hit/miss/
+deserialize), the serve/generative latency rings, the bounded program
+caches, the profiler record buffer — and exports them two ways from one
+``snapshot()``:
+
+* ``observability.snapshot()`` — stable JSON; ``tools/diagnose.py``
+  renders its human report from this dict and ``--json`` emits it
+  verbatim;
+* ``observability.prometheus()`` — Prometheus text exposition, served by
+  the opt-in ``/metrics`` endpoint (``ModelServer``/``GenerativeServer``
+  ``metrics_port=``, http.py).
+
+Per-request tracing (tracing.py) threads a trace-id from ``submit()``
+through queue → coalesce → pad → dispatch → (decode) token steps; the
+retrace watchdog (watchdog.py) turns the zero-steady-state-retrace test
+contract into a runtime alarm. The old names all still work —
+``engine.dispatch_counter``, ``serve.stats()``, ``ServeMetrics`` — the
+registry reads them, it does not replace them.
+"""
+from __future__ import annotations
+
+from . import watchdog  # noqa: F401
+from .http import MetricsHTTPServer  # noqa: F401
+from .registry import (Counter, Gauge, Histogram,  # noqa: F401
+                       MetricsRegistry, render_prometheus)
+from .tracing import (RequestTrace, new_trace, set_tracing,  # noqa: F401
+                      tracing_enabled)
+
+__all__ = ["registry", "snapshot", "prometheus", "MetricsRegistry",
+           "Counter", "Gauge", "Histogram", "RequestTrace", "new_trace",
+           "set_tracing", "tracing_enabled", "arm_watchdog",
+           "disarm_watchdog", "MetricsHTTPServer", "enable_op_telemetry",
+           "op_telemetry_enabled", "note_compile", "render_prometheus",
+           "device_section"]
+
+# the process-wide default registry (module-level by design: it is the
+# blessed home for metric state — graphlint GL009 polices ad-hoc metric
+# state anywhere else)
+registry = MetricsRegistry()
+
+arm_watchdog = watchdog.arm
+disarm_watchdog = watchdog.disarm
+
+# compile accounting (fed by cache.AotFn around lower/compile): cumulative
+# XLA compile wall-time + count — the "is this replica compiling under
+# traffic" gauge the watchdog's per-event warnings aggregate into
+_compiles_total = registry.counter(
+    "compiles_total", "explicit lower/compile builds (cache.AotFn)")
+_compile_seconds = registry.counter(
+    "compile_seconds_total", "wall-clock seconds spent in lower/compile")
+
+
+def note_compile(seconds):
+    _compiles_total.inc()
+    _compile_seconds.inc(float(seconds))
+
+
+# ---------------------------------------------------------- op telemetry
+# per-op-name dispatch counts from the imperative hot loop. Off by default:
+# ndarray.invoke reads ONE precomputed module boolean (the _prof_on trick);
+# when on, the cost is one dict increment per op into this registry-owned
+# dict (bounded by len(OP_REGISTRY)).
+_op_counts = {}
+
+
+def enable_op_telemetry(on=True):
+    """Count imperative dispatches per op name (``snapshot()['ops']``).
+    Returns the previous state."""
+    from .. import ndarray as _nd
+
+    prev = _nd._obs_on
+    _nd._obs_counts = _op_counts
+    _nd._obs_on = bool(on)
+    return prev
+
+
+def op_telemetry_enabled():
+    from .. import ndarray as _nd
+
+    return _nd._obs_on
+
+
+# ------------------------------------------------------------- collectors
+def _collect_engine():
+    from .. import engine
+
+    return {
+        "dispatch": engine.dispatch_counter.count,
+        "bulk_compile": engine.bulk_compile_counter.count,
+        "tape_compile": engine.tape_compile_counter.count,
+        "tape_cache_hit": engine.tape_cache_hit_counter.count,
+        "serve_compile": engine.serve_compile_counter.count,
+        "decode_compile": engine.decode_compile_counter.count,
+        "comp_cache_hit": engine.comp_cache_hit_counter.count,
+        "comp_cache_miss": engine.comp_cache_miss_counter.count,
+        "comp_cache_deserialize": engine.comp_cache_deserialize_counter.count,
+    }
+
+
+def _collect_caches():
+    from .. import base, ndarray
+    from ..autograd import tape_compile_enabled
+
+    return {
+        "jit": {"entries": len(base._JIT_CACHE), "cap": base._JIT_CACHE.cap},
+        "bulk": {"entries": len(base._BULK_CACHE),
+                 "cap": base._BULK_CACHE.cap},
+        "tape": {"entries": len(base._TAPE_CACHE),
+                 "cap": base._TAPE_CACHE.cap,
+                 "compile_enabled": tape_compile_enabled()},
+        "aval": {"entries": len(ndarray._AVAL_CACHE),
+                 "cap": ndarray._AVAL_CACHE.cap},
+        "sig_intern": {"entries": len(ndarray._SIG_IDS),
+                       "cap": ndarray._SIG_INTERN_CAP},
+    }
+
+
+def _collect_comp_cache():
+    from .. import cache
+
+    return cache.stats()
+
+
+def _collect_serve():
+    from .. import serve
+
+    return serve.stats()
+
+
+def _collect_profiler():
+    from .. import profiler
+
+    return {
+        "running": profiler.is_running(),
+        "records": profiler.num_records(),
+        "records_cap": profiler.record_cap(),
+        "records_dropped": profiler.records_dropped(),
+    }
+
+
+def _collect_ops():
+    # copy under the GIL: the hot loop mutates this dict lock-free
+    return {"enabled": op_telemetry_enabled(), "dispatches": dict(_op_counts)}
+
+
+registry.register_collector("engine", _collect_engine)
+registry.register_collector("caches", _collect_caches)
+registry.register_collector("comp_cache", _collect_comp_cache)
+registry.register_collector("serve", _collect_serve)
+registry.register_collector("profiler", _collect_profiler)
+registry.register_collector("ops", _collect_ops)
+registry.register_collector("watchdog", watchdog.snapshot)
+registry.register_collector(
+    "tracing", lambda: {"enabled": tracing_enabled()})
+
+
+def device_section():
+    """HBM live-buffer gauges from the XLA client's own accounting
+    (authoritative on TPU — jax owns the HBM pool). Separate from the
+    collector set because a device probe can block when the accelerator
+    relay is down (``diagnose.py --no-device``)."""
+    from .. import profiler
+
+    try:
+        stats = profiler.device_memory_summary()
+    except Exception as e:
+        return {"error": "%s: %s" % (type(e).__name__, e)}
+    return {"hbm_bytes_in_use": stats.get("bytes_in_use"),
+            "hbm_peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+            "hbm_bytes_limit": stats.get("bytes_limit")}
+
+
+def snapshot(device=False):
+    """The stable JSON telemetry snapshot: registry metrics + every
+    collector section. ``device=True`` adds the HBM gauges (it probes the
+    backend, which can block on a downed relay — opt in)."""
+    snap = registry.snapshot()
+    if device:
+        snap["device"] = device_section()
+    return snap
+
+
+def prometheus(device=False):
+    """Prometheus text exposition of :func:`snapshot` — the ``/metrics``
+    payload."""
+    return render_prometheus(snapshot(device=device))

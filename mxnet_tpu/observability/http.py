@@ -1,0 +1,69 @@
+"""Opt-in ``/metrics`` HTTP endpoint — stdlib only, no server framework.
+
+The scrape surface mxnet-model-server exposed on its management port,
+rebuilt on ``http.server``: GET ``/metrics`` returns the Prometheus text
+exposition of ``observability.snapshot()``, GET ``/snapshot`` (or
+``/stats``) the stable JSON form. Bound to loopback by default; a serving
+replica opts in with ``ModelServer(..., metrics_port=9090)`` /
+``GenerativeServer(..., metrics_port=9090)`` (0 = ephemeral port, read
+back from ``.port`` — how tests avoid collisions).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class MetricsHTTPServer:
+    """Background thread serving the observability snapshot. ``close()``
+    (or the owning server's ``stop()``) shuts it down; scrapes never touch
+    the dispatch path — they read counters and bounded rings."""
+
+    def __init__(self, port=0, host="127.0.0.1"):
+        from . import prometheus, snapshot
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib API name)
+                # device=True: a live server's backend is already
+                # initialized, so the HBM gauges are a cached read — the
+                # downed-relay hang risk diagnose --no-device guards
+                # against doesn't apply here
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = prometheus(device=True).encode("utf-8")
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif path in ("/snapshot", "/stats"):
+                    body = json.dumps(snapshot(device=True), indent=1,
+                                      sort_keys=True,
+                                      default=str).encode("utf-8")
+                    ctype = "application/json"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # scrapes are not stdout events
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="mxtpu-metrics")
+        self._thread.start()
+
+    def url(self, path="/metrics"):
+        return "http://%s:%d%s" % (self.host, self.port, path)
+
+    def close(self):
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:
+            pass

@@ -1,0 +1,122 @@
+"""Retrace/recompile watchdog — anomaly detection on the compile counters.
+
+The repo's zero-steady-state-retrace contract is proven by tests; in a
+long-running replica the same contract is *enforced at runtime* by this
+watchdog: after warmup (``arm()``), any increment of a ``*_compile_counter``
+— ``bulk``/``tape``/``serve``/``decode`` — logs ONE structured warning per
+event with the offending cache key, through the stdlib ``logging`` module
+(logger ``mxnet_tpu.observability.watchdog``), and records it in a bounded
+``events`` ring the registry snapshot exposes.
+
+Key attribution: the cache-miss sites that own a key pass it directly
+(``base.bulk_jitted``/``tape_jitted`` → ``bump(note=...)``); the serve and
+decode counters bump INSIDE traced bodies, so ``cache.AotFn`` brackets its
+lower/compile with :func:`compile_context` and the hook reads the
+thread-local description (``serve:mlp:r0 sig=...`` / ``decode:step@c64``).
+
+Arming is explicit (``observability.arm_watchdog()`` or
+``MXNET_RETRACE_WATCHDOG=1``): warmup-time compiles are expected, and
+deliberate later builds (a new bucket, a capacity growth) are policy the
+operator opts into watching.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+
+logger = logging.getLogger("mxnet_tpu.observability.watchdog")
+
+_EVENT_CAP = 256
+events = []                 # bounded ring of structured event dicts
+_armed = False
+_lock = threading.Lock()
+_tls = threading.local()    # .ctx — current compile-site description
+
+
+class compile_context:
+    """Thread-local description of the program being lowered/compiled —
+    set by ``cache.AotFn`` so a post-warmup retrace warning can name the
+    offending program even when the counter bump sits inside the traced
+    body."""
+
+    __slots__ = ("_desc", "_prev")
+
+    def __init__(self, desc):
+        self._desc = desc
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "ctx", None)
+        _tls.ctx = self._desc
+        return self
+
+    def __exit__(self, *a):
+        _tls.ctx = self._prev
+
+
+def current_context():
+    return getattr(_tls, "ctx", None)
+
+
+def _on_compile(counter, n, note):
+    """DispatchCounter watch hook: one structured warning per post-warmup
+    compile event, with the best key attribution available."""
+    key = note if note is not None else current_context()
+    evt = {
+        "event": "retrace_after_warmup",
+        "counter": counter.name or "compile",
+        "key": str(key) if key is not None else "<unattributed jit site>",
+        "count": counter.count,
+        "ts": time.time(),
+    }
+    with _lock:
+        if len(events) >= _EVENT_CAP:
+            del events[0]
+        events.append(evt)
+    logger.warning("retrace after warmup: %s",
+                   json.dumps(evt, sort_keys=True))
+
+
+def _compile_counters():
+    from .. import engine
+
+    return (engine.bulk_compile_counter, engine.tape_compile_counter,
+            engine.serve_compile_counter, engine.decode_compile_counter)
+
+
+def arm():
+    """Start watching: from now until :func:`disarm`, every compile-counter
+    bump is an anomaly event. Idempotent."""
+    global _armed
+    for c in _compile_counters():
+        c._watch = _on_compile
+    _armed = True
+
+
+def disarm():
+    global _armed
+    for c in _compile_counters():
+        c._watch = None
+    _armed = False
+
+
+def armed():
+    return _armed
+
+
+def reset_events():
+    with _lock:
+        del events[:]
+
+
+def snapshot():
+    with _lock:
+        last = events[-1] if events else None
+    return {"armed": _armed, "events": len(events), "last_event": last}
+
+
+if os.environ.get("MXNET_RETRACE_WATCHDOG", "0").lower() in (
+        "1", "true", "yes", "on"):
+    arm()

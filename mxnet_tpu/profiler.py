@@ -30,6 +30,32 @@ _records = []          # {"name", "ts_us", "dur_ms", "cat"}
 _lock = threading.Lock()
 _epoch = time.perf_counter()
 
+# the record buffer is BOUNDED (the GL006 unbounded-growth concern applied
+# to the profiler itself: a long always-on run would otherwise grow host
+# memory without limit). Past the cap, new records are counted as dropped
+# and discarded — the retained prefix keeps a coherent trace; the dropped
+# tally is surfaced in dump() metadata and observability.snapshot().
+try:
+    _RECORD_CAP = int(os.environ.get("MXNET_PROFILER_RECORD_CAP", "1000000"))
+except ValueError:
+    _RECORD_CAP = 1000000
+_dropped = 0
+
+
+def record_cap():
+    return _RECORD_CAP
+
+
+def num_records():
+    return len(_records)
+
+
+def records_dropped():
+    """Records discarded because the bounded buffer was full — nonzero
+    means the Chrome trace is truncated (raise MXNET_PROFILER_RECORD_CAP
+    or dump/reset more often)."""
+    return _dropped
+
 
 def _sync_imperative():
     """Push the imperative-profiling flag (and this module object) into
@@ -97,10 +123,14 @@ def resume(profile_process="worker"):
 
 
 def _record(name, ts_us, dur_ms=None, cat="host", ph="X", **extra):
+    global _dropped
     rec = {"name": name, "ts_us": ts_us, "cat": cat, "ph": ph, **extra}
     if dur_ms is not None:
         rec["dur_ms"] = dur_ms
     with _lock:
+        if len(_records) >= _RECORD_CAP:
+            _dropped += 1
+            return
         _records.append(rec)
 
 
@@ -139,8 +169,10 @@ def dumps(reset=False):
         with _lock:
             out = json.dumps(_records, indent=2)
     if reset:
+        global _dropped
         with _lock:
             _records.clear()
+            _dropped = 0
     return out
 
 
@@ -163,7 +195,8 @@ def dump(finished=True, profile_process="worker"):
                 ev["s"] = r.get("s", "g")
             events.append(ev)
     with open(_config["filename"], "w") as f:
-        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms",
+                   "otherData": {"droppedRecords": _dropped}}, f)
     return _config["filename"]
 
 

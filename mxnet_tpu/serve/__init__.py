@@ -96,7 +96,10 @@ def snapshot(server, prefix, input_names=None, epoch=0):
 
 def stats():
     """Snapshot of every live server, keyed by server name, plus the
-    process-wide compile counter — what tools/diagnose.py prints."""
+    process-wide compile counter — what tools/diagnose.py prints and the
+    observability registry's ``serve`` collector absorbs (so every field
+    here is also a Prometheus sample on the ``/metrics`` endpoint, labeled
+    ``server="<name>"``)."""
     from .. import engine
 
     return {

@@ -32,20 +32,35 @@ class ServeTimeout(ServeError):
 
 
 class _Request:
-    __slots__ = ("inputs", "n", "t_submit", "deadline", "priority", "_event",
-                 "_result", "_error", "_done")
+    __slots__ = ("inputs", "n", "t_submit", "t_dequeue", "deadline",
+                 "priority", "trace", "_event", "_result", "_error", "_done")
 
     def __init__(self, inputs, n, timeout_ms, priority=0):
         self.inputs = inputs
         self.n = n  # rows this request contributes to a batch
         self.t_submit = time.perf_counter()
+        self.t_dequeue = None   # stamped when a batch claims this request
         self.deadline = (self.t_submit + timeout_ms / 1e3
                          if timeout_ms else None)
         self.priority = int(priority)  # higher = more urgent
+        # observability.RequestTrace riding with the request (None when
+        # tracing is off); the server attaches it at submit and closes
+        # queue/pad/dispatch spans as the request moves through
+        self.trace = None
         self._event = threading.Event()
         self._result = None
         self._error = None
         self._done = False
+
+    @property
+    def trace_id(self):
+        return self.trace.trace_id if self.trace is not None else None
+
+    def timing(self):
+        """Per-request breakdown (queue_ms/pad_ms/dispatch_ms/tokens) —
+        the response-object surface of the trace; None when tracing is
+        disabled."""
+        return self.trace.timing() if self.trace is not None else None
 
     # finish() is idempotent under race (batcher result vs. timeout sweep):
     # first writer wins, the event releases every waiter exactly once
@@ -125,7 +140,8 @@ class DynamicBatcher:
         self._pool.shutdown(wait=True)
 
     # ------------------------------------------------------------ admission
-    def submit(self, inputs, n_rows, timeout_ms=None, priority=0):
+    def submit(self, inputs, n_rows, timeout_ms=None, priority=0,
+               trace=None):
         """Enqueue one request (``n_rows`` ≥ 1 coalescible rows). Returns a
         future-like handle; raises ServerBusy when the queue is full —
         shedding at the door keeps tail latency bounded instead of letting
@@ -137,8 +153,13 @@ class DynamicBatcher:
         admission is SLO-aware preemptive shedding: the victim is the
         lowest-priority queued request with the least deadline slack (the
         one most likely to miss its SLO anyway) — it gets ServerBusy and
-        the new request takes its place."""
+        the new request takes its place.
+
+        ``trace``: an observability.RequestTrace to ride with the request —
+        attached BEFORE enqueue so the queue span can never be missed by an
+        immediate dispatch."""
         req = _Request(inputs, int(n_rows), timeout_ms, priority)
+        req.trace = trace
         evicted = []
         with self._cond:
             if self._stop:
@@ -219,6 +240,10 @@ class DynamicBatcher:
                     if self._metrics:
                         self._metrics.record_queue_depth(self._queued_rows)
                     if batch:
+                        # queue-span close: one clock read per batch
+                        t_deq = time.perf_counter()
+                        for req in batch:
+                            req.t_dequeue = t_deq
                         return batch, rows
                     # head alone exceeds max_batch: caller bug — fail it
                     req = self._queue.popleft()

@@ -91,6 +91,10 @@ class GenerationStream:
         self._done = threading.Event()
         self._error = None
         self._admission = None    # batcher request handle (queue-phase SLO)
+        # observability.RequestTrace (set at submit; None = tracing off):
+        # queue/pad/dispatch spans + per-token step attribution; read the
+        # breakdown from stream.timing() when the stream completes
+        self.trace = None
 
     # ------------------------------------------------------- producer side
     def _push(self, tok):
@@ -128,6 +132,15 @@ class GenerationStream:
 
     def done(self):
         return self._done.is_set()
+
+    @property
+    def trace_id(self):
+        return self.trace.trace_id if self.trace is not None else None
+
+    def timing(self):
+        """Per-request breakdown (queue_ms/pad_ms/dispatch_ms/tokens);
+        None when tracing is disabled."""
+        return self.trace.timing() if self.trace is not None else None
 
     def result(self, timeout_s=None):
         """Block until generation completes; returns the list of generated
@@ -177,7 +190,8 @@ class GenerativeServer:
 
     def __init__(self, model, slots=8, top_k=0, eos_id=None,
                  max_wait_ms=1.0, max_queue=64, timeout_ms=30000.0,
-                 prefix_cache=True, donate=None, name=None):
+                 prefix_cache=True, donate=None, name=None,
+                 metrics_port=None):
         spec = model.decode_state_spec()
         self.model = model
         self.name = name or ("generate:%s" % type(model).__name__.lower())
@@ -217,6 +231,9 @@ class GenerativeServer:
             max_queue=max_queue, num_dispatchers=1, metrics=self.metrics)
         self._loop_thread = None
         self._stop_flag = False
+        # opt-in /metrics scrape endpoint (observability.http); None = off
+        self._metrics_port = metrics_port
+        self.metrics_http = None
         from . import _register
         _register(self)
 
@@ -226,6 +243,10 @@ class GenerativeServer:
         step → stream tokens, forever). Tests drive the same tick
         synchronously via :meth:`step`."""
         self._batcher.start()
+        if self._metrics_port is not None and self.metrics_http is None:
+            from ..observability import MetricsHTTPServer
+
+            self.metrics_http = MetricsHTTPServer(self._metrics_port)
         if self._loop_thread is None or not self._loop_thread.is_alive():
             self._stop_flag = False
             self._loop_thread = threading.Thread(
@@ -248,6 +269,9 @@ class GenerativeServer:
             err = ServeError("server stopped")
             if req.finish(error=err):
                 req.inputs._finish(err)
+        if self.metrics_http is not None:
+            self.metrics_http.close()
+            self.metrics_http = None
 
     def __enter__(self):
         return self.start()
@@ -269,8 +293,11 @@ class GenerativeServer:
         self.cache.capacity_bucket(stream.prompt.size + stream.max_new_tokens)
         if not self._batcher._worker or not self._batcher._worker.is_alive():
             self._batcher.start()
+        from ..observability import new_trace
+
+        stream.trace = new_trace(self.name)
         req = self._batcher.submit(stream, 1, timeout_ms=tmo,
-                                   priority=priority)
+                                   priority=priority, trace=stream.trace)
         stream._admission = req
         return stream
 
@@ -340,6 +367,12 @@ class GenerativeServer:
                     stream._finish(e)
 
     def _join(self, req, stream):
+        tr = stream.trace
+        t_join = time.perf_counter()
+        if tr is not None:
+            # queue phase for a generation request spans admission →
+            # slot assignment (batcher queue + join handover)
+            tr.add_span("queue", req.t_submit, t_join)
         t0_len = int(stream.prompt.size)
         need = t0_len + stream.max_new_tokens
         self.cache.ensure_capacity(need)
@@ -350,6 +383,11 @@ class GenerativeServer:
         key = np.asarray(jax.random.PRNGKey(stream.seed), np.uint32)
         hit = self.prefix.get(stream.prompt) if self.prefix is not None \
             else None
+        t_disp0 = time.perf_counter()
+        if tr is not None:
+            # host-side prompt pad-to-bucket (the decode analogue of the
+            # pool's pad span)
+            tr.add_span("pad", t_join, t_disp0, bucket=tp)
         engine.dispatch_counter.bump()
         scope = (profiler.decode_scope("prefill%d" % tp, self.slots,
                                        self.cache.num_active)
@@ -390,6 +428,12 @@ class GenerativeServer:
                                 np.asarray(last))
         first = int(np.asarray(self._tok)[slot])
         now = time.perf_counter()
+        if tr is not None:
+            # prefill (or prefix-inject) dispatch, closed by the first-token
+            # host readback; the first token is sampled inside this program
+            tr.add_span("dispatch", t_disp0, now,
+                        kind="inject" if hit is not None else "prefill")
+            tr.tokens += 1
         if not req.finish(result=stream):
             # timed out in the same instant admission landed: roll back
             self.cache.release(slot)
@@ -435,14 +479,18 @@ class GenerativeServer:
         self.metrics.record_step(dt, n_active, n_active, self.slots)
         now = time.perf_counter()
         for slot in self.cache.active_slots:
-            self._deliver(slot, int(nxt_host[slot]), now)
+            self._deliver(slot, int(nxt_host[slot]), now, step_s=dt)
         return n_active
 
-    def _deliver(self, slot, tok, now=None):
+    def _deliver(self, slot, tok, now=None, step_s=None):
         """Hand one token to a slot's stream and retire the request when it
         completes (EOS / budget) or blows its deadline."""
         stream = self.cache.owner(slot)
         req = self._slot_req[slot]
+        if step_s is not None and stream.trace is not None:
+            # O(1) per token: attribute the shared step dispatch to this
+            # request (a float add, never a span)
+            stream.trace.note_decode_step(step_s, now)
         stream._push(tok)
         self._remaining[slot] -= 1
         if (self.eos_id is not None and tok == self.eos_id) \
@@ -459,6 +507,9 @@ class GenerativeServer:
         stream = self.cache.owner(slot)
         req = self._slot_req[slot]
         if stream is not None:
+            if stream.trace is not None:
+                # one aggregate decode span per request, emitted at retire
+                stream.trace.close_decode()
             stream._finish(error)
             if error is None and req is not None:
                 self.metrics.record_latency(

@@ -192,18 +192,26 @@ class BucketedExecutor:
                                    ":donated" if donating else ""))
         return aot
 
-    def run(self, inputs, n_real=None, replica=None):
+    def run(self, inputs, n_real=None, replica=None, traces=None):
         """Execute a coalesced batch: pad to bucket, one cached dispatch,
         host-gather, slice off the pad rows. ``inputs`` share leading batch
         dim; returns a list of numpy outputs with ``n_real`` rows each
-        (row-aligned outputs only — others returned whole)."""
+        (row-aligned outputs only — others returned whole).
+
+        ``traces``: the coalesced requests' RequestTraces — each gets the
+        shared ``pad`` (host pad-to-bucket) and ``dispatch`` (compiled
+        call + host gather) spans closed, three clock reads per BATCH."""
+        import time as _time
+
         n = int(np.asarray(inputs[0]).shape[0])
         n_real = n if n_real is None else int(n_real)
         bucket = self.pick_bucket(n)
         if replica is None:
             replica = self.next_replica()
         from .. import profiler
+        t_pad0 = _time.perf_counter() if traces else None
         prepped = self._prepare(inputs, bucket)
+        t_disp0 = _time.perf_counter() if traces else None
         if profiler.is_running():
             with profiler.serve_scope(bucket, n_real):
                 outs = self._dispatch(prepped, replica)
@@ -212,6 +220,12 @@ class BucketedExecutor:
         # host gather = the only completion signal the relay honors; also
         # what the caller (a serving response) needs anyway
         outs = [np.asarray(o) for o in outs]
+        if traces:
+            t_done = _time.perf_counter()
+            for tr in traces:
+                tr.add_span("pad", t_pad0, t_disp0, bucket=bucket)
+                tr.add_span("dispatch", t_disp0, t_done, bucket=bucket,
+                            rows=n_real, replica=replica)
         if self._row_outputs is None:
             self._row_outputs = [o.ndim >= 1 and o.shape[0] == bucket
                                  for o in outs]

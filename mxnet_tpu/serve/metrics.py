@@ -14,6 +14,14 @@ One ``ServeMetrics`` instance per server/pool. Two export paths:
 Latency percentiles come from a bounded ring of the most recent ``window``
 request latencies — O(1) per request, no unbounded growth in long-running
 servers (the same concern graphlint GL006 polices for caches).
+
+These objects are ABSORBED by ``mxnet_tpu.observability``: the registry's
+``serve`` collector reads every live server's ``stats()`` (this module's
+snapshots) at snapshot time, so they appear in
+``observability.snapshot()``/``prometheus()`` and the opt-in ``/metrics``
+endpoint without any push-site wiring here — this module stays the
+recording surface, the registry is the export surface (GL009 polices new
+metric state landing anywhere else).
 """
 from __future__ import annotations
 

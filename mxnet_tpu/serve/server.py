@@ -90,7 +90,8 @@ class ModelServer:
 
     def __init__(self, model, input_specs, buckets=DEFAULT_BUCKETS,
                  max_wait_ms=2.0, max_queue=256, timeout_ms=1000.0,
-                 devices=None, donate=None, name=None, warmup=True):
+                 devices=None, donate=None, name=None, warmup=True,
+                 metrics_port=None):
         from .metrics import ServeMetrics
 
         if devices is not None and hasattr(devices, "devices"):
@@ -114,6 +115,10 @@ class ModelServer:
         self._batch_lock = threading.Lock()
         self.inject_fault = None  # drill hook: callable(batch_idx) may raise
         self._started = False
+        # opt-in /metrics scrape endpoint (observability.http); None = off.
+        # 0 picks an ephemeral port, read back from metrics_http.port.
+        self._metrics_port = metrics_port
+        self.metrics_http = None
         if warmup:
             self.warmup()
         from . import _register
@@ -144,12 +149,19 @@ class ModelServer:
 
     def start(self):
         self._batcher.start()
+        if self._metrics_port is not None and self.metrics_http is None:
+            from ..observability import MetricsHTTPServer
+
+            self.metrics_http = MetricsHTTPServer(self._metrics_port)
         self._started = True
         return self
 
     def stop(self):
         self._started = False
         self._batcher.stop()
+        if self.metrics_http is not None:
+            self.metrics_http.close()
+            self.metrics_http = None
 
     def __enter__(self):
         return self.start()
@@ -191,7 +203,13 @@ class ModelServer:
             raise ServeError("request of %d rows exceeds the largest bucket "
                              "%d — split it or widen buckets"
                              % (n, self.buckets[-1]))
-        return self._batcher.submit(arrays, n, timeout_ms=timeout_ms)
+        # per-request trace context: rides the handle through queue →
+        # coalesce → pad → dispatch; handle.timing()/handle.trace expose
+        # the breakdown (observability.tracing; None when tracing is off)
+        from ..observability import new_trace
+
+        return self._batcher.submit(arrays, n, timeout_ms=timeout_ms,
+                                    trace=new_trace(self.name))
 
     def submit(self, *xs, timeout_ms=None):
         """Async enqueue; returns a handle with ``.result(timeout_s)``.
@@ -228,10 +246,18 @@ class ModelServer:
         try:
             if self.inject_fault is not None:
                 self.inject_fault(idx)
+            # close each rider's queue span (submit → batch claim) before
+            # the shared pad/dispatch spans the pool adds
+            traces = []
+            for r in requests:
+                if r.trace is not None:
+                    r.trace.add_span("queue", r.t_submit,
+                                     r.t_dequeue or time.perf_counter())
+                    traces.append(r.trace)
             ins = [np.concatenate([r.inputs[i] for r in requests], axis=0)
                    for i in range(len(self._specs))]
             bucket = self._pool.pick_bucket(total_rows)
-            outs = self._pool.run(ins, n_real=total_rows)
+            outs = self._pool.run(ins, n_real=total_rows, traces=traces)
             self.metrics.record_batch(total_rows, bucket)
             now = time.perf_counter()
             off = 0

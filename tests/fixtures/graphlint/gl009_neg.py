@@ -1,0 +1,22 @@
+"""GL009 negative: telemetry created the blessed ways — through the
+process-wide observability registry (get-or-create, so every module shares
+one object per name), via a registered collector over existing state, or
+as server-scoped metrics objects owned by a server instance (ServeMetrics
+inside __init__ is request-plumbing, not module-level metric state)."""
+from mxnet_tpu import observability
+from mxnet_tpu.serve.metrics import ServeMetrics
+
+requests_served = observability.registry.counter(
+    "requests_served", "completed requests")
+latency_hist = observability.registry.histogram("latency_ms", window=1024)
+queue_gauge = observability.registry.gauge("queue_depth")
+
+observability.registry.register_collector(
+    "my_subsystem", lambda: {"widgets": 3})
+
+
+class MyServer:
+    def __init__(self, name):
+        # instance-scoped metrics object: owned, registered via the serve
+        # weak registry, exported by serve.stats() — not module state
+        self.metrics = ServeMetrics(name)

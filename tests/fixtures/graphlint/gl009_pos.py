@@ -1,0 +1,19 @@
+"""GL009 positive: ad-hoc metric state outside mxnet_tpu/observability —
+a DispatchCounter instantiated in a random module, and module-level metric
+objects bound outside the registry. None of these are visible to
+observability.snapshot(), the /metrics endpoint, or the retrace watchdog."""
+from mxnet_tpu.engine import DispatchCounter
+from mxnet_tpu.observability import Counter, Histogram
+
+my_counter = DispatchCounter("mine")  # expect: GL009
+
+requests_served = Counter("requests_served")  # expect: GL009
+
+latency_hist = Histogram("latency_ms")  # expect: GL009
+
+
+def make_probe():
+    # function-scoped DispatchCounters are still ad-hoc proof hooks the
+    # registry can't absorb — flagged wherever they are created
+    probe = DispatchCounter("probe")  # expect: GL009
+    return probe

@@ -96,7 +96,10 @@ def test_repo_self_lint_is_ci_clean():
 def test_allowlist_is_small_and_justified():
     with open(ALLOWLIST) as fh:
         entries = json.load(fh)
-    assert len(entries) <= 15, "allowlist grew to %d entries" % len(entries)
+    # 9 of these are the engine proof-hook counters GL009 deliberately
+    # keeps visible (each carries a why explaining the in-trace / hot-path
+    # constraint that keeps it out of the registry)
+    assert len(entries) <= 24, "allowlist grew to %d entries" % len(entries)
     for e in entries:
         assert e.get("why", "").strip(), "entry %r lacks a why" % e.get("id")
 

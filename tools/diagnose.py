@@ -2,15 +2,27 @@
 """Environment diagnosis (ref: incubator-mxnet tools/diagnose.py).
 
 Prints platform, Python, key package versions, mxnet_tpu feature flags, and
-device visibility — the report users attach to bug reports.
+device visibility — the report users attach to bug reports. Every runtime
+telemetry section (tape replay, compilation cache, serving, observability)
+is a thin renderer over ``mxnet_tpu.observability.snapshot()`` — the same
+dict the ``/metrics`` endpoint and ``serve.stats()`` feed from.
 
-Run: python tools/diagnose.py [--no-device]  (device probe can hang when the
-TPU relay is down; --no-device skips it)
+Run: python tools/diagnose.py [--no-device] [--json]
+
+``--no-device`` skips the jax device probe (it can hang when the TPU relay
+is down). ``--json`` emits ``observability.snapshot()`` verbatim as JSON —
+the machine-readable mode (round-trips through ``json.loads``; schema key
+``schema`` versions it).
 """
 import argparse
+import json
 import os
 import platform
 import sys
+
+
+def _fmt(v):
+    return "-" if v is None else v
 
 
 def main():
@@ -18,7 +30,19 @@ def main():
     ap.add_argument("--no-device", action="store_true",
                     help="skip the jax device probe (it can block when the "
                          "accelerator relay is unreachable)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit mxnet_tpu.observability.snapshot() verbatim "
+                         "as JSON and exit")
     args = ap.parse_args()
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    if args.as_json:
+        from mxnet_tpu import observability
+        print(json.dumps(observability.snapshot(device=not args.no_device),
+                         indent=1, sort_keys=True, default=str))
+        return
 
     print("----------Platform Info----------")
     print("Platform     :", platform.platform())
@@ -38,8 +62,6 @@ def main():
             print("%s=\"%s\"" % (k, os.environ[k]))
 
     print("----------Package Info----------")
-    sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
     import importlib
 
     for name in ("jax", "jaxlib", "numpy", "flax", "optax", "orbax.checkpoint"):
@@ -49,66 +71,71 @@ def main():
         except Exception as e:
             print("%-16s: unavailable (%s)" % (name, e))
     import mxnet_tpu
+    from mxnet_tpu import observability
     print("%-16s: %s" % ("mxnet_tpu", mxnet_tpu.__version__))
 
+    # one telemetry snapshot renders every runtime section below — the
+    # sections are views, the snapshot is the data
+    snap = observability.snapshot()
+
     print("----------Autograd Tape Replay----------")
-    # compiled tape replay state (autograd module docstring): the knob, the
-    # program cache, and the hit/miss counters backing the zero-retrace
-    # contract — attach when reporting backward()-speed regressions
-    from mxnet_tpu import autograd as _ag, base as _base, engine as _eng
+    # compiled tape replay state: the knob, the program cache, and the
+    # hit/miss counters backing the zero-retrace contract — attach when
+    # reporting backward()-speed regressions
+    tape = snap["caches"]["tape"]
+    eng = snap["engine"]
     print("tape compile : %s (MXNET_TAPE_COMPILE)"
-          % ("on" if _ag.tape_compile_enabled() else "off — eager walk"))
+          % ("on" if tape.get("compile_enabled") else "off — eager walk"))
     print("program cache: %d entries / cap %d (MXNET_TAPE_CACHE_CAP)"
-          % (len(_base._TAPE_CACHE), _base._TAPE_CACHE.cap))
+          % (tape["entries"], tape["cap"]))
     print("cache hits   : %d   compiles (misses): %d"
-          % (_eng.tape_cache_hit_counter.count,
-             _eng.tape_compile_counter.count))
+          % (eng["tape_cache_hit"], eng["tape_compile"]))
 
     print("----------Compilation Cache----------")
     # persistent cross-process compilation layer (mxnet_tpu.cache): per-tier
     # disk entries/bytes plus this process's hit/miss/deserialize counters
     # and the store's GC/robustness tallies — attach when reporting replica
     # cold-start or warm-start-still-compiles regressions
-    try:
-        from mxnet_tpu import cache as _cc
-        snap = _cc.stats()
-        if not snap["enabled"]:
+    cc = snap["comp_cache"]
+    if "error" in cc:
+        print("cache unavailable:", cc["error"])
+    else:
+        if not cc["enabled"]:
             print("store        : disabled (set MXNET_COMP_CACHE_DIR to "
                   "persist compiled executables across processes)")
         else:
             print("store        : %s (cap %d MiB)"
-                  % (snap["dir"], snap["cap_bytes"] // (1 << 20)))
+                  % (cc["dir"], cc["cap_bytes"] // (1 << 20)))
             print("entries      : %d (%d KiB): %s"
-                  % (snap["entries"], snap["bytes"] // 1024,
+                  % (cc["entries"], cc["bytes"] // 1024,
                      ", ".join("%s=%d" % (t, d["entries"])
-                               for t, d in sorted(snap["tiers"].items())
+                               for t, d in sorted(cc["tiers"].items())
                                if d["entries"])
                      or "empty"))
             print("gc/robustness: writes=%d evictions=%d stale=%d "
                   "corrupt=%d wrong_key=%d"
-                  % (snap["writes"], snap["evictions"], snap["stale"],
-                     snap["corrupt"], snap["wrong_key"]))
+                  % (cc["writes"], cc["evictions"], cc["stale"],
+                     cc["corrupt"], cc["wrong_key"]))
         print("this process : hits=%d misses=%d deserializes=%d "
               "(deserializes include serve-snapshot preloads)"
-              % (snap["hits"], snap["misses"], snap["deserializes"]))
-    except Exception as e:
-        print("cache unavailable:", e)
+              % (cc["hits"], cc["misses"], cc["deserializes"]))
 
     print("----------Serving----------")
     # mxnet_tpu.serve state: the executor-pool compile counter (a nonzero
     # steady-state delta here means bucket programs are retracing — attach
     # when reporting serving-latency regressions) plus every live server's
     # stats() snapshot (latency percentiles, queue/shed/timeout counters)
-    try:
-        from mxnet_tpu import serve as _serve
-        snap = _serve.stats()
+    sv = snap["serve"]
+    if "error" in sv:
+        print("serve unavailable:", sv["error"])
+    else:
         print("pool compiles: %d bucket program(s) built this process"
-              % snap["serve_compile_counter"])
+              % sv["serve_compile_counter"])
         print("decode builds: %d generative program(s) (prefill/decode/"
               "inject buckets — a steady-state delta here means the token "
-              "loop is retracing)" % snap["decode_compile_counter"])
-        if snap["servers"]:
-            for sname, s in sorted(snap["servers"].items()):
+              "loop is retracing)" % sv["decode_compile_counter"])
+        if sv["servers"]:
+            for sname, s in sorted(sv["servers"].items()):
                 print("%-13s: req=%d done=%d shed=%d timeout=%d err=%d "
                       "batches=%d fill=%s p50=%s p99=%s"
                       % (sname, s["requests"], s["completed"], s["shed"],
@@ -126,8 +153,33 @@ def main():
         else:
             print("live servers : none (snapshots appear while a "
                   "serve.ModelServer is alive)")
-    except Exception as e:
-        print("serve unavailable:", e)
+
+    print("----------Observability----------")
+    # the unified-telemetry layer itself: registry size, compile-time
+    # accounting, the retrace watchdog, request tracing, and the bounded
+    # profiler record buffer — attach when a replica's /metrics disagrees
+    # with its behavior
+    m = snap["metrics"]
+    wd = snap["watchdog"]
+    prof = snap["profiler"]
+    print("registry     : %d counter(s), %d gauge(s), %d histogram(s)"
+          % (len(m["counters"]), len(m["gauges"]), len(m["histograms"])))
+    print("compiles     : %s build(s), %.2fs wall (cache.AotFn lower/"
+          "compile)" % (_fmt(m["counters"].get("compiles_total")),
+                        m["counters"].get("compile_seconds_total", 0.0)))
+    print("watchdog     : %s, %d retrace event(s)%s"
+          % ("ARMED" if wd["armed"] else "disarmed", wd["events"],
+             " — last: %s" % wd["last_event"]["key"]
+             if wd["last_event"] else ""))
+    print("tracing      : %s (MXNET_REQUEST_TRACING)"
+          % ("on" if snap["tracing"]["enabled"] else "off"))
+    print("op telemetry : %s (%d op name(s) counted)"
+          % ("on" if snap["ops"]["enabled"] else "off",
+             len(snap["ops"]["dispatches"])))
+    print("profiler     : %s, %d/%d record(s), %d dropped "
+          "(MXNET_PROFILER_RECORD_CAP)"
+          % ("running" if prof["running"] else "stopped", prof["records"],
+             prof["records_cap"], prof["records_dropped"]))
 
     print("----------Graphlint Summary----------")
     # tracing-hygiene static pass over the package (tools/graphlint.py);

@@ -708,7 +708,7 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     mode = args[0] if args else "bert"
     if mode in ("optstep", "imperative", "autograd", "serve", "decode",
-                "coldstart"):
+                "coldstart", "ir"):
         # host-dispatch microbenches (fused multi-tensor optimizer step;
         # lazy bulk imperative chain vs eager; compiled tape replay vs the
         # eager backward walk; dynamic-batched serving vs per-request
@@ -723,7 +723,10 @@ def main():
                 "autograd": "autograd_bench.py",
                 "serve": "serve_bench.py",
                 "decode": "serve_bench.py",
-                "coldstart": "serve_bench.py"}[mode]
+                "coldstart": "serve_bench.py",
+                # unified graph IR: CSE/DCE node shrink + host-loop time
+                # on a repeated-subexpression chain (mxnet_tpu.ir)
+                "ir": "ir_bench.py"}[mode]
         spec = importlib.util.spec_from_file_location(
             tool[:-3], os.path.join(_REPO, "tools", tool))
         m = importlib.util.module_from_spec(spec)

@@ -12,6 +12,7 @@ from . import base
 from .base import MXNetError
 from .context import Context, cpu, cpu_pinned, gpu, tpu, num_gpus, num_tpus, current_context
 from . import ops
+from . import ir
 from . import ndarray
 from . import nd
 from .ndarray import NDArray, waitall

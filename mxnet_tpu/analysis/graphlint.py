@@ -43,6 +43,17 @@ GL008  direct ``jax.jit`` that bypasses the persistent compilation layer —
        full compile every time. ``mxnet_tpu/base.py`` and
        ``mxnet_tpu/cache/`` (the funnel itself) are structurally exempt;
        deliberate exceptions carry an allowlist entry with a why.
+GL010  ad-hoc structural graph machinery outside ``mxnet_tpu/ir/`` — a
+       class carrying graph-node state (an ``op``/``_op`` field next to
+       ``specs``/``inputs`` wiring), or a hand-rolled program-cache key
+       (a tuple assembling two or more ``tuple(...)``/``_freeze(...)``
+       components into a ``*key*`` name). The repo converged on ONE
+       typed graph IR (``mxnet_tpu.ir``) with one content-addressed
+       canonical key; a fourth parallel node type or key scheme
+       re-opens the three-captures problem this refactor closed. The
+       legacy capture shims (``LazyExpr``, ``TapeNode``, ``Symbol`` and
+       their front-memo keys — thin converters INTO the IR) carry
+       allowlist entries with whys.
 GL009  ad-hoc metric state outside ``mxnet_tpu/observability/`` — a
        ``DispatchCounter(...)`` instantiation anywhere, or a module-level
        binding of a metric object (``Counter``/``Gauge``/``Histogram``/
@@ -86,7 +97,21 @@ RULES = {
     "GL007": "growing carried state (aval changes per loop iteration)",
     "GL008": "direct jax.jit bypasses the persistent compilation layer",
     "GL009": "ad-hoc metric state outside mxnet_tpu/observability",
+    "GL010": "ad-hoc graph-node class / hand-rolled cache key outside "
+             "mxnet_tpu/ir",
 }
+
+# paths structurally exempt from GL010: the typed IR itself
+_GL010_EXEMPT = ("mxnet_tpu/ir/",)
+
+# field-name evidence for a structural graph-node class: an op name next
+# to input wiring
+_GL010_OP_FIELDS = {"op", "_op"}
+_GL010_WIRING_FIELDS = {"specs", "inputs", "_inputs", "wiring"}
+
+# call names whose tuple-assembly into a `*key*` binding marks a
+# hand-rolled program-cache key
+_GL010_KEY_CALLS = {"tuple", "frozenset", "_freeze"}
 
 # paths structurally exempt from GL008: the persistent funnel itself
 _GL008_EXEMPT = ("mxnet_tpu/base.py", "mxnet_tpu/cache/")
@@ -281,6 +306,10 @@ class _ModuleLint:
                 if self._is_region(node):
                     self._check_region(node)
                 self._check_donation(node)
+            if isinstance(node, ast.ClassDef):
+                self._check_node_class(node)
+            if isinstance(node, ast.Assign):
+                self._check_handrolled_key(node)
             if isinstance(node, ast.Call):
                 self._check_percall_jit(node)
                 self._check_unfunneled_jit(node)
@@ -569,6 +598,80 @@ class _ModuleLint:
                      "it via observability.registry so snapshot()/"
                      "/metrics/the watchdog can see it" % name,
                      mod_names[node.lineno])
+
+    # ------------------------------------------------------------- GL010
+    def _check_node_class(self, node: ast.ClassDef):
+        """GL010 (classes): a structural graph-node class — an op field
+        next to input wiring — defined outside ``mxnet_tpu/ir``. A fourth
+        parallel node type re-opens the three-captures problem the
+        unified IR closed; new graph machinery composes ``ir.Node`` /
+        ``ir.GraphBuilder`` instead. Field evidence: ``__slots__``
+        entries, class-level bindings, NamedTuple-style annotations, and
+        ``self.X`` assignments in ``__init__``."""
+        path = self.path.replace(os.sep, "/")
+        if any(x in path for x in _GL010_EXEMPT):
+            return
+        fields: Set[str] = set()
+        for stmt in node.body:
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if not isinstance(t, ast.Name):
+                        continue
+                    if t.id == "__slots__":
+                        try:
+                            v = ast.literal_eval(stmt.value)
+                        except (ValueError, SyntaxError):
+                            v = ()
+                        if isinstance(v, (tuple, list)):
+                            fields.update(str(x) for x in v)
+                    else:
+                        fields.add(t.id)
+            elif isinstance(stmt, ast.AnnAssign) and \
+                    isinstance(stmt.target, ast.Name):
+                fields.add(stmt.target.id)  # NamedTuple-style field
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and stmt.name == "__init__":
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Assign):
+                        for t in sub.targets:
+                            if isinstance(t, ast.Attribute) and \
+                                    isinstance(t.value, ast.Name) and \
+                                    t.value.id == "self":
+                                fields.add(t.attr)
+        if fields & _GL010_OP_FIELDS and fields & _GL010_WIRING_FIELDS:
+            self.add(node, "GL010",
+                     "class %r carries graph-node state (an op field next "
+                     "to input wiring) outside mxnet_tpu/ir — structural "
+                     "graphs belong in the unified typed IR (ir.Node / "
+                     "ir.GraphBuilder); legacy capture shims carry "
+                     "allowlist entries" % node.name,
+                     node.name)
+
+    def _check_handrolled_key(self, node: ast.Assign):
+        """GL010 (keys): ``key = (tuple(...), tuple(...), ...)`` — a
+        hand-rolled program-cache key assembled outside ``mxnet_tpu/ir``.
+        Cache keys collapsed into the IR's content-addressed canonical
+        key; front memos OVER that key are fine but carry allowlist
+        entries naming themselves as such."""
+        path = self.path.replace(os.sep, "/")
+        if any(x in path for x in _GL010_EXEMPT):
+            return
+        if len(node.targets) != 1 or \
+                not isinstance(node.targets[0], ast.Name):
+            return
+        name = node.targets[0].id
+        if "key" not in name.lower() or not isinstance(node.value, ast.Tuple):
+            return
+        n_calls = sum(1 for e in node.value.elts
+                      if isinstance(e, ast.Call)
+                      and _call_name(e.func) in _GL010_KEY_CALLS)
+        if n_calls >= 2:
+            self.add(node, "GL010",
+                     "%r hand-rolls a program-cache key (%d tuple/_freeze "
+                     "components) — program keys collapse into the IR "
+                     "canonical key (ir.canonical_key); front memos over "
+                     "it carry allowlist entries" % (name, n_calls),
+                     self._enclosing_scope(node))
 
     # ------------------------------------------------------------- GL007
     @staticmethod

@@ -16,11 +16,14 @@ form (the TVM/Relay compilation analogue, arXiv 1802.04799 / 1810.00952):
   primal replay, ``jax.vjp``, head seeding, zero-filled probes, cotangent
   accumulation, ``grad_req`` application into ``.grad`` buffers (prior
   'add' buffers donated where the handshake says it is safe) — into ONE
-  jitted program, cached in ``base.tape_jitted`` by (tape topology, static
-  attrs, interned leaf signatures, head set, grad_req/donation layout). A
-  steady-state ``record → loss → backward`` loop is O(1) dispatches with
-  zero retrace (``engine.dispatch_counter`` / ``engine.tape_compile_counter``
-  prove it);
+  jitted program: the region converts to the unified typed graph IR
+  (``mxnet_tpu.ir``; probe sites pinned), runs the shared rewrite-pass
+  pipeline, and resolves through the canonical content-addressed cache,
+  front-memoized here by (tape topology, static attrs, interned leaf
+  signatures, head set, grad_req/donation layout). A steady-state
+  ``record → loss → backward`` loop is O(1) dispatches with zero retrace
+  (``engine.dispatch_counter`` / ``engine.tape_compile_counter`` prove
+  it);
 * the per-node eager walk remains the fallback for tapes holding
   non-replayable nodes (imperative ``CustomOp.backward``,
   ``autograd.Function``, ``primal_fn=None``) and for
@@ -390,15 +393,22 @@ def _compiled_backward(heads, head_grads, tape):
     to the eager walk (non-structural node on the path, non-float head,
     signature-intern table at cap).
 
-    The program is cached by a purely structural key — per-node (op, static
-    attrs, wiring ints), interned leaf signatures, head wiring, grad-target
-    layout (position, grad_req, donation) — so a steady-state training loop
-    re-running the same topology hits the same compiled executable with
-    zero retrace even though every NDArray object is fresh each iteration
-    (the CachedOp-handle-reuse analogue of MXNet's backward graph)."""
+    The program is front-memoized by a purely structural key — per-node
+    (op, static attrs, wiring ints), interned leaf signatures, head wiring,
+    grad-target layout (position, grad_req, donation) — so a steady-state
+    training loop re-running the same topology hits the same compiled
+    executable with zero retrace even though every NDArray object is fresh
+    each iteration (the CachedOp-handle-reuse analogue of MXNet's backward
+    graph). A front miss converts the recorded region into the typed
+    ``mxnet_tpu.ir`` graph (probe-injection sites pinned against rewrites),
+    runs the shared pass pipeline, and lowers through ir.lower's canonical
+    cache — the same form the bulk window and Symbol executors lower
+    through."""
     from . import engine
-    from .base import tape_jitted
-    from .ndarray import _sig_id
+    from .base import _TAPE_CACHE
+    from .ir import graph as _irg
+    from .ir import lower as _irl
+    from .ir.graph import _sig_id
 
     # ---- prune: reverse sweep collecting the VALUE-dependency closure of
     # the heads (replay needs non-diff tensor args too, unlike the walk)
@@ -431,35 +441,31 @@ def _compiled_backward(heads, head_grads, tape):
             for i in node.inputs:
                 reach.add(id(i))
 
-    # ---- wiring: assign env slots, intern leaves, build the cache key
-    leaves, leaf_sigs = [], []
-    leaf_ids = {}   # identity key -> leaf index
+    # ---- wiring: build the typed IR region through the shared
+    # GraphBuilder, assign env slots, intern leaves, build the front key
+    b = _irg.GraphBuilder()
+    leaves = []     # concrete leaf values, builder leaf order
     slot_of = {}    # id(output NDArray) -> env slot
-    key_parts, steps = [], []
+    key_parts = []
 
     def intern(entry):
         """Spec int (~leaf_index) for a leaf argument entry, or None when
         the signature intern table hit its cap (caller bails to eager)."""
         kind = entry[0]
-        if kind == "t":
-            ident = id(entry[1])
-        elif kind == "b":
-            ident = id(entry[1])
-        else:  # weak-typed scalar, interned by (type, value) like the window
+        if kind == "s":  # weak-typed scalar, interned by (type, value)
             ident = (type(entry[1]), entry[1])
-        li = leaf_ids.get(ident)
-        if li is None:
+            val = entry[1]
+            sig = type(val)
+        else:
+            ident = id(entry[1])
             val = _arg_value(entry)
-            sid = _sig_id(type(val) if kind == "s"
-                          else (val.dtype, tuple(val.shape)))
-            if sid is None:
-                return None
-            li = leaf_ids[ident] = len(leaves)
+            sig = (val.dtype, tuple(val.shape))
+        n_before = len(b.leaf_sigs)
+        spec = b.leaf(ident, sig=sig)
+        if spec is not None and len(b.leaf_sigs) > n_before:
             leaves.append(val)
-            leaf_sigs.append(sid)
-        return ~li
+        return spec
 
-    nslots = 0
     for node in pruned:
         specs = []
         for e in node.call_args:
@@ -479,13 +485,13 @@ def _compiled_backward(heads, head_grads, tape):
                     return False
             kw_specs.append(s)
         n_out = len(node.outputs)
-        for o in node.outputs:
-            slot_of[id(o)] = nslots
-            nslots += 1
-        steps.append((node.fn, node.static, tuple(specs), tuple(kw_names),
-                      tuple(kw_specs), n_out))
+        first = b.add(node.op, node.fn, node.static, node.static_key,
+                      specs, tuple(kw_names), tuple(kw_specs), n_out)
+        for j, o in enumerate(node.outputs):
+            slot_of[id(o)] = first + j
         key_parts.append((node.op, node.static_key, tuple(specs),
                           tuple(kw_names), tuple(kw_specs)))
+    leaf_sigs = b.leaf_sigs
 
     # ---- grad targets, discovered in deterministic tape order
     targets, tspecs, t_avals = [], [], []
@@ -565,69 +571,122 @@ def _compiled_backward(heads, head_grads, tape):
             prior_idx.append(None)
             donate_flags.append(False)
 
-    nl, nhg = len(leaves), len(hg_vals)
-    donate_argnums = tuple(nl + nhg + prior_idx[k]
-                           for k in range(len(targets)) if donate_flags[k])
+    nhg = len(hg_vals)
     key = (tuple(key_parts), tuple(leaf_sigs), tuple(head_specs),
            tuple(hg_key),
            tuple((ts[0], ts[1], rq, dn)
                  for ts, rq, dn in zip(tspecs, reqs, donate_flags)))
 
-    def builder():
-        probe = {ts[1]: k for k, ts in enumerate(tspecs) if ts[0] == "p"}
-        n_t, n_h = len(tspecs), len(head_specs)
+    ent = _TAPE_CACHE.get(key)
+    if ent is None:
+        # front-memo miss: lower the recorded region through the shared
+        # typed IR. Probe slots (intermediate grad targets — cotangent
+        # injection sites) are pinned so CSE/folding/cast-sinking cannot
+        # merge or bypass them, and listed as graph outputs so DCE keeps
+        # them; heads come first in the output tuple.
+        probe_slots = tuple(ts[1] for ts in tspecs if ts[0] == "p")
+        graph = b.build(tuple(head_specs) + probe_slots)
+        if probe_slots:
+            owner = graph.slot_owner()
+            pin = {owner[s][0] for s in probe_slots}
+            graph = _irg.Graph(
+                tuple(n.replace(pinned=True) if i in pin else n
+                      for i, n in enumerate(graph.nodes)),
+                graph.leaf_sigs, graph.outputs, graph.meta)
+        canon, ir_ent = _irl.prepare(graph)
+        leaf_canon = {orig: j for j, orig in enumerate(canon.leaf_perm)}
+        leaf_final = {c: j for j, c in enumerate(ir_ent.leaf_sel)}
 
-        def replay(lv, tv):
-            env = []
-            for fn, static, specs, kwn, kws, n_out in steps:
-                vals = [env[s] if s >= 0 else lv[~s] for s in specs]
-                if kwn or static:
-                    kw = {n: (env[s] if s >= 0 else lv[~s])
-                          for n, s in zip(kwn, kws)}
-                    r = fn(*vals, **kw, **static)
-                else:
-                    r = fn(*vals)
-                flat = jax.tree_util.tree_leaves(r) if n_out != 1 else [r]
-                for v in flat:
-                    pk = probe.get(len(env))
-                    env.append(v if pk is None else v + tv[pk])
-            return tuple(env[s] if s >= 0 else lv[~s] for s in head_specs)
+        def respec(s):
+            """Builder spec -> final-graph spec (through canonicalization
+            and the pass pipeline); None = unmappable (bail to eager)."""
+            if s >= 0:
+                c = canon.slot_map.get(s)
+                return None if c is None else ir_ent.slot_fwd.get(c)
+            j = leaf_canon.get(~s)
+            f = None if j is None else leaf_final.get(j)
+            return None if f is None else ~f
 
-        def prog(*flat):
-            lvs = flat[:nl]
-            hgs = flat[nl:nl + nhg]
-            priors = flat[nl + nhg:]
-            if not n_t:
-                return replay(list(lvs), ())
+        f_heads = []
+        for s in head_specs:
+            f = respec(s)
+            if f is None:
+                return False
+            f_heads.append(f)
+        f_tspecs = []
+        for ts in tspecs:
+            if ts[0] == "p":
+                f = respec(ts[1])
+                if f is None or f < 0:
+                    return False  # pinned slots survive by construction
+                f_tspecs.append(("p", f))
+            else:
+                f = respec(~ts[1])  # stored as positive leaf index
+                if f is None or f >= 0:
+                    return False
+                f_tspecs.append(("l", ~f))
+        arg_sel = tuple(canon.leaf_perm[c] for c in ir_ent.leaf_sel)
+        nl = len(arg_sel)
+        donate_argnums = tuple(nl + nhg + prior_idx[k]
+                               for k in range(len(targets))
+                               if donate_flags[k])
+        variant_key = (tuple(f_heads), tuple(hg_key),
+                       tuple((ts[0], ts[1], rq, dn) for ts, rq, dn in
+                             zip(f_tspecs, reqs, donate_flags)))
 
-            def f(tv):
-                lv = list(lvs)
-                for k, ts in enumerate(tspecs):
-                    if ts[0] == "l":
-                        lv[ts[1]] = tv[k]
-                return replay(lv, tv)
+        def builder():
+            probe = {ts[1]: k for k, ts in enumerate(f_tspecs)
+                     if ts[0] == "p"}
+            n_t, n_h = len(f_tspecs), len(f_heads)
+            runner = _irg.build_runner(ir_ent.graph, probes=probe)
 
-            init = tuple(
-                jnp.zeros(*t_avals[k]) if ts[0] == "p" else lvs[ts[1]]
-                for k, ts in enumerate(tspecs))
-            outs, vjp = jax.vjp(f, init)
-            seed = tuple(
-                hgs[hg_idx[j]] if hg_idx[j] is not None
-                else jnp.ones(*head_avals[j]) for j in range(n_h))
-            (cots,) = vjp(seed)
-            res = []
-            for k in range(n_t):
-                g = cots[k]
-                if reqs[k] == "add":
-                    g = priors[prior_idx[k]] + g
-                res.append(g)
-            return tuple(res) + tuple(outs)
+            def replay(lv, tv):
+                # graph outputs are heads followed by probe slots; the
+                # vjp seeds cover heads only
+                return runner(lv, tv)[:n_h]
 
-        return prog, donate_argnums
+            def prog(*flat):
+                lvs = flat[:nl]
+                hgs = flat[nl:nl + nhg]
+                priors = flat[nl + nhg:]
+                if not n_t:
+                    return replay(list(lvs), ())
 
-    prog = tape_jitted(key, builder)
+                def f(tv):
+                    lv = list(lvs)
+                    for k, ts in enumerate(f_tspecs):
+                        if ts[0] == "l":
+                            lv[ts[1]] = tv[k]
+                    return replay(lv, tv)
+
+                init = tuple(
+                    jnp.zeros(*t_avals[k]) if ts[0] == "p" else lvs[ts[1]]
+                    for k, ts in enumerate(f_tspecs))
+                outs, vjp = jax.vjp(f, init)
+                seed = tuple(
+                    hgs[hg_idx[j]] if hg_idx[j] is not None
+                    else jnp.ones(*head_avals[j]) for j in range(n_h))
+                (cots,) = vjp(seed)
+                res = []
+                for k in range(n_t):
+                    g = cots[k]
+                    if reqs[k] == "add":
+                        g = priors[prior_idx[k]] + g
+                    res.append(g)
+                return tuple(res) + tuple(outs)
+
+            return prog
+
+        prog = _irl.tape_program(ir_ent, variant_key, builder,
+                                 donate=donate_argnums)
+        ent = _TAPE_CACHE[key] = (prog, arg_sel)
+    else:
+        from .engine import tape_cache_hit_counter
+
+        tape_cache_hit_counter.bump()
+    prog, arg_sel = ent
     engine.dispatch_counter.bump()
-    args = leaves + hg_vals + prior_vals
+    args = [leaves[i] for i in arg_sel] + hg_vals + prior_vals
     from . import ndarray as _nd
 
     if _nd._prof_on:

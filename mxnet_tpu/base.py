@@ -83,17 +83,21 @@ class BoundedCache(dict):
     processes). Eviction is insertion-order (oldest first) and happens only
     on insert — hits stay plain-dict speed with zero LRU bookkeeping on the
     per-op hot path. Entries must be pure caches: evicting one may cost a
-    recompute/recompile, never correctness."""
+    recompute/recompile, never correctness. ``evictions`` counts the
+    drops — observability.snapshot() surfaces it per cache, so cap churn
+    in a long-running replica is visible instead of silent."""
 
-    __slots__ = ("cap",)
+    __slots__ = ("cap", "evictions")
 
     def __init__(self, cap):
         super().__init__()
         self.cap = max(int(cap), 1)
+        self.evictions = 0
 
     def __setitem__(self, key, value):
         if len(self) >= self.cap and key not in self:
             del self[next(iter(self))]
+            self.evictions += 1
         super().__setitem__(key, value)
 
 
@@ -102,13 +106,23 @@ class BoundedCache(dict):
 # adversarial serving traffic — hence the cap (MXNET_JIT_CACHE_CAP).
 _JIT_CACHE: Dict = BoundedCache(env_cap("MXNET_JIT_CACHE_CAP", 4096))
 
-# composed-program cache for the lazy bulk window (engine.bulk): one jitted
-# callable per (op-chain topology, static attrs, leaf signatures, output
-# set). Steady-state epochs re-running an identical imperative chain hit the
-# SAME callable object, so jax.jit reuses the compiled executable with zero
-# retrace — the imperative analogue of MXNet's CachedOp handle reuse.
+# bulk-window FRONT memo (engine.bulk): window-structural-key →
+# (program, arg selection) resolved through the canonical IR cache below.
+# Steady-state epochs re-running an identical imperative chain hit this
+# memo at hash-and-lookup cost — the imperative analogue of MXNet's
+# CachedOp handle reuse; a miss builds the typed IR graph and resolves
+# through _IR_CACHE (which is where identical math from the tape or a
+# Symbol lands on the SAME compiled program).
 # Capped (MXNET_BULK_CACHE_CAP): chain-topology diversity is unbounded.
 _BULK_CACHE: Dict = BoundedCache(env_cap("MXNET_BULK_CACHE_CAP", 1024))
+
+# the ONE canonical program cache (mxnet_tpu.ir.lower): content-addressed
+# canonical-graph key → IREntry (optimized graph + every program lowered
+# from it). The bulk/tape/symbol key schemes all collapse into this cache;
+# the per-capture dicts above/below are thin front memos over it.
+# MXNET_IR_CACHE_CAP bounds it; evictions are surfaced in
+# observability.snapshot()["ir"].
+_IR_CACHE: Dict = BoundedCache(env_cap("MXNET_IR_CACHE_CAP", 2048))
 
 
 def _key_note(kind, key, limit=200):
@@ -143,10 +157,14 @@ def _jit_backed(fn, device=None, donate=None, tier="jit", hint=""):
 
 
 def bulk_jitted(key, builder):
-    """Cached jitted composed program for a flushed bulk window. ``key`` is
-    the structural chain key ndarray._flush_window computes; ``builder``
-    returns the pure replay function leaves→outputs, called only on a cache
-    miss (engine.bulk_compile_counter bumps then — the no-recompile hook)."""
+    """LEGACY SHIM (pre-IR): cached jitted composed program for a flushed
+    bulk window. The live flush path now builds a typed ``mxnet_tpu.ir``
+    graph and lowers through ``ir.lower_forward`` (see
+    ndarray._flush_window); this entry point remains for external callers
+    that hand-compose a window program. ``key`` is the structural chain
+    key; ``builder`` returns the pure replay function leaves→outputs,
+    called only on a cache miss (engine.bulk_compile_counter bumps then —
+    the no-recompile hook)."""
     f = _BULK_CACHE.get(key)
     if f is None:
         from .engine import bulk_compile_counter
@@ -159,20 +177,25 @@ def bulk_jitted(key, builder):
     return f
 
 
-# compiled tape-replay program cache (autograd.backward): one jitted
-# forward+backward program per (tape topology, static attrs, leaf
-# signatures, head set, grad_req/donation layout) — the whole-program
-# analogue of MXNet's nnvm backward graph executed via Imperative::Backward.
+# compiled tape-replay FRONT memo (autograd.backward): structural key
+# (tape topology, static attrs, leaf signatures, head set,
+# grad_req/donation layout) → (program, arg selection) resolved through
+# the canonical IR cache — the whole-program analogue of MXNet's nnvm
+# backward graph executed via Imperative::Backward, now sharing the
+# forward region's canonical form with the other captures.
 # Capped like the others (MXNET_TAPE_CACHE_CAP).
 _TAPE_CACHE: Dict = BoundedCache(env_cap("MXNET_TAPE_CACHE_CAP", 512))
 
 
 def tape_jitted(key, builder):
-    """Cached jitted compiled-tape backward program. ``builder`` (called
-    only on a miss) returns ``(prog, donate_argnums)``; a steady-state
-    record→backward loop must hit the cache every iteration —
-    engine.tape_compile_counter (misses) / engine.tape_cache_hit_counter
-    (hits) are the proof hooks tests and tools/diagnose.py read."""
+    """LEGACY SHIM (pre-IR): cached jitted compiled-tape backward program.
+    The live backward path now lowers the recorded region through
+    ``mxnet_tpu.ir`` (autograd._compiled_backward); kept for external
+    callers. ``builder`` (called only on a miss) returns
+    ``(prog, donate_argnums)``; a steady-state record→backward loop must
+    hit the cache every iteration — engine.tape_compile_counter (misses) /
+    engine.tape_cache_hit_counter (hits) are the proof hooks tests and
+    tools/diagnose.py read."""
     from .engine import tape_cache_hit_counter, tape_compile_counter
 
     f = _TAPE_CACHE.get(key)

@@ -51,7 +51,11 @@ ENTRY_MAGIC = "mxcexec1"
 ENTRY_SUFFIX = ".mxc"
 
 # tiers = subdirectories; one per jit funnel so diagnose.py can report
-# per-tier entry counts and a GC sweep never mixes populations
+# per-tier entry counts and a GC sweep never mixes populations. The
+# unified graph IR (mxnet_tpu.ir.lower) lowers every capture through
+# base._jit_backed with the CAPTURE's tier name ("bulk"/"tape"/"symbol"),
+# so cross-capture dedup upstream only ever SHRINKS a tier's population —
+# one canonical program persists once, under the tier that built it first
 TIERS = ("jit", "bulk", "tape", "hybrid", "serve", "decode")
 
 

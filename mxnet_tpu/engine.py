@@ -77,6 +77,15 @@ bulk_compile_counter = DispatchCounter("bulk_compile")
 tape_compile_counter = DispatchCounter("tape_compile")
 tape_cache_hit_counter = DispatchCounter("tape_cache_hit")
 
+# symbolic executors (Symbol.eval / symbol.Executor lowered through
+# mxnet_tpu.ir): bumps once per symbol-capture program BUILD — an ir-cache
+# miss that actually compiles. A Symbol whose canonical graph was already
+# compiled by ANOTHER capture (bulk window, tape) does NOT bump: the
+# cross-capture dedup is precisely what this counter plus its two siblings
+# prove ("3 captures, 1 total compile" in tests/test_ir.py). Same
+# zero-steady-state-retrace discipline as bulk_compile_counter.
+symbol_compile_counter = DispatchCounter("symbol_compile")
+
 # serving executor pool (mxnet_tpu.serve): bumps once per bucket-program
 # BUILD (an XLA trace of a pool's inference function — the bump sits inside
 # the traced body, so it fires exactly when jax re-traces). Warmup compiles

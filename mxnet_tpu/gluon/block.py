@@ -619,10 +619,14 @@ class SymbolBlock(HybridBlock):
             # deterministic eval graph: the shared executor-pool helper
             # (serve.executor_pool) — one cached compiled program per input
             # signature replaces the old per-call evaluation walk (one
-            # dispatch per graph node, every call). Exact-signature mode:
-            # a bare graph cannot declare which inputs carry a batch axis,
-            # so zero-row padding is never assumed here (ModelServer, with
-            # explicit input_specs, is the padding/bucketing layer).
+            # dispatch per graph node, every call). The pool's inference
+            # function is the unified-IR runner when the graph is
+            # representable (symbol_infer_fn → ir.from_symbol + the
+            # CSE/fold/cast-sink/DCE pass pipeline — whole-graph cleanup
+            # XLA can't do across dispatch boundaries). Exact-signature
+            # mode: a bare graph cannot declare which inputs carry a batch
+            # axis, so zero-row padding is never assumed here (ModelServer,
+            # with explicit input_specs, is the padding/bucketing layer).
             outs = pool.run_device(vals)
         else:
             # stochastic eval graph (mode='always' dropout): per-call

@@ -1,0 +1,32 @@
+"""mxnet_tpu.ir — one typed graph IR and rewrite-pass pipeline under all
+three captures (ROADMAP #4, the refactor that unlocks).
+
+The bulk window's ``LazyExpr`` DAG, the autograd tape's structural
+region, and the ``Symbol`` graph all convert into the same canonical
+typed :class:`~mxnet_tpu.ir.graph.Graph` (nodes carry
+``(op, static attrs, input wiring)``; values carry interned
+``(shape, dtype)`` avals), run the same rewrite-pass pipeline (CSE,
+constant folding, bf16 cast-sinking, dead-subgraph elimination, and the
+donation-policy annotator — :mod:`mxnet_tpu.ir.passes`), and lower
+through the same content-addressed cache to ONE compiled artifact per
+canonical graph via ``base._jit_backed``
+(:mod:`mxnet_tpu.ir.lower`) — so the persistent compilation store and
+AOT snapshots apply unchanged, and identical math from any capture
+shares one compiled program.
+
+Relay's typed-IR + pass-pipeline design (arXiv 1810.00952) and TVM's
+one-artifact-per-graph lowering (arXiv 1802.04799), applied to this
+stack's three frontends.
+"""
+from . import graph, lower, passes  # noqa: F401
+from .graph import (Graph, GraphBuilder, Node, UnsupportedGraph,  # noqa: F401
+                    build_runner, canonical_key, canonicalize, from_symbol,
+                    from_window, symbol_skeleton)
+from .lower import lower_forward, prepare, stats, tape_program  # noqa: F401
+from .passes import DEFAULT_PASSES, PassManager, pass_stats  # noqa: F401
+
+__all__ = ["Graph", "GraphBuilder", "Node", "UnsupportedGraph",
+           "build_runner", "canonical_key", "canonicalize", "from_symbol",
+           "from_window", "symbol_skeleton", "lower_forward", "prepare",
+           "tape_program", "stats", "PassManager", "DEFAULT_PASSES",
+           "pass_stats", "graph", "passes", "lower"]

@@ -1,0 +1,524 @@
+"""mxnet_tpu.ir.graph — ONE typed graph IR under all three captures.
+
+The repo grew three parallel structural-graph representations — the bulk
+window's ``LazyExpr`` DAG (engine/ndarray), the autograd tape's
+``TapeNode`` region (autograd), and the ``Symbol`` DAG (symbol) — each
+with its own cache-key scheme and its own lowering. This module is the
+single canonical form they all convert into (Relay's "one typed IR, many
+frontends" move, arXiv 1810.00952, applied to this stack's captures):
+
+* a :class:`Graph` is immutable and *typed*: nodes carry
+  ``(op, static attrs, input wiring)``, values carry interned
+  ``(shape, dtype, sharding)`` avals via the signature interner below;
+* wiring is the spec-int convention every capture already speaks —
+  ``s >= 0`` is value slot ``s`` (node *i* with ``n_out`` outputs owns
+  ``n_out`` consecutive slots), ``~li`` is graph leaf ``li`` (a program
+  input);
+* :func:`canonicalize` renumbers any capture's graph into a
+  deterministic DFS-from-outputs form, and :func:`canonical_key` hashes
+  that form content-addressed — identical math captured imperatively,
+  on the tape, or symbolically produces the SAME key, so all three hit
+  the same compiled program in ``ir.lower``'s cache.
+
+The signature interner (``_sig_id``) and abstract-evaluation cache
+(``_AVAL_CACHE`` / ``_infer_aval``) moved here from ``ndarray`` — they
+were the per-capture key-assembly machinery and are now the one shared
+implementation (``ndarray`` keeps aliases for its hot loop and for
+back-compat). This module imports only ``base``/jax/numpy: every capture
+layer can import it without cycles.
+"""
+from __future__ import annotations
+
+import functools
+import hashlib
+
+import jax
+import numpy as np
+
+from ..base import (OP_REGISTRY, BoundedCache as _BoundedCache, _freeze,
+                    env_cap as _env_cap)
+
+__all__ = ["Node", "Graph", "GraphBuilder", "Canonical", "canonicalize",
+           "canonical_key", "from_window", "from_symbol", "build_runner",
+           "interner_stats"]
+
+
+# ------------------------------------------------------------- interner
+#
+# Signature interning: a signature — (dtype, shape) for arrays, the
+# python/numpy scalar TYPE for weak-typed scalar leaves — is replaced by
+# a small process-global int everywhere the hot loops touch it (bulk
+# window leaf_sigs, tape leaf wiring, aval-cache keys, IR graph leaves).
+# Hashing int tuples is several times cheaper than hashing nested dtype
+# tuples, and this runs per imperative op.
+#
+# The table is CAPPED (MXNET_SIG_INTERN_CAP; graphlint GL006): ids index
+# into _SIG_LIST, so entries can never be evicted without invalidating
+# every cache key built from them. Once the cap is hit, _sig_id returns
+# None for NEW signatures and the capture layers fall back to eager
+# dispatch for values carrying them — steady-state workloads (a bounded
+# signature set) never notice; adversarial shape churn degrades
+# gracefully instead of growing host memory without bound.
+_SIG_IDS = {}
+_SIG_LIST = []
+_SIG_INTERN_CAP = _env_cap("MXNET_SIG_INTERN_CAP", 65536)
+
+
+def _sig_id(sig):
+    i = _SIG_IDS.get(sig)
+    if i is None:
+        if len(_SIG_IDS) >= _SIG_INTERN_CAP:
+            return None  # table full — caller bails to eager dispatch
+        i = _SIG_IDS[sig] = len(_SIG_LIST)
+        _SIG_LIST.append(sig)
+    return i
+
+
+def sig_value(i):
+    """The interned signature behind id ``i``."""
+    return _SIG_LIST[i]
+
+
+def interner_stats():
+    return {"entries": len(_SIG_IDS), "cap": _SIG_INTERN_CAP}
+
+
+# (op, static-attrs key, input sig-ids) -> (output ShapeDtypeStruct, its
+# sig-id), or None when the combo is not abstractly evaluable to ONE
+# array (multi-output result — e.g. split/topk whose arity depends on
+# kwargs — or eval_shape raised). One abstract evaluation per distinct
+# combo while cached; the hot loops pay a dict probe. Capped
+# (MXNET_AVAL_CACHE_CAP, insertion-order eviction — graphlint GL006):
+# static-attr diversity is unbounded, a miss only re-runs eval_shape.
+_AVAL_CACHE = _BoundedCache(_env_cap("MXNET_AVAL_CACHE_CAP", 65536))
+_AVAL_MISS = object()
+
+
+def _infer_aval(opdef, kwargs, in_sig_ids):
+    """Abstract-evaluate one op from input signatures alone (a
+    representative value stands in for scalar leaves: only the type can
+    affect promotion, never the value). Returns the cache entry."""
+    try:
+        sigs = [_SIG_LIST[i] for i in in_sig_ids]
+        ins = [jax.ShapeDtypeStruct(s[1], s[0]) if type(s) is tuple else s(1)
+               for s in sigs]
+        fn = (functools.partial(opdef.fn, **kwargs) if kwargs else opdef.fn)
+        av = jax.eval_shape(fn, *ins)
+    except Exception:
+        return None  # let the eager path raise the real, well-located error
+    if not isinstance(av, jax.ShapeDtypeStruct):
+        return None
+    sid = _sig_id((av.dtype, tuple(av.shape)))
+    if sid is None:  # intern table at cap: mark combo non-lazy
+        return None
+    return (av, sid)
+
+
+def infer_aval_cached(opname, static_key, kwargs, in_sigs, opdef=None):
+    """Cached (aval, sig-id) for one op application, or None when not
+    single-output evaluable — the one inference path shared by the bulk
+    window (via ndarray's aliases) and the symbol builder."""
+    key = (opname, static_key, tuple(in_sigs))
+    entry = _AVAL_CACHE.get(key, _AVAL_MISS)
+    if entry is _AVAL_MISS:
+        entry = _AVAL_CACHE[key] = _infer_aval(
+            opdef if opdef is not None else OP_REGISTRY[opname], kwargs,
+            in_sigs)
+    return entry
+
+
+# ------------------------------------------------------------- the graph
+
+
+class Node:
+    """One typed IR node: a pure registry-op application.
+
+    ``specs`` wire positional inputs (spec ints); ``kw_names``/
+    ``kw_specs`` wire traced keyword inputs (the tape's rng-key arrays);
+    ``static`` holds the non-traced attrs splatted into ``fn`` and
+    ``static_key`` their frozen, hashable form. ``n_out`` slots are
+    produced (flattened tree leaves for multi-output ops). ``aval``/
+    ``sig`` describe the output when known (single-output nodes); passes
+    that need types skip nodes without them. ``pinned`` marks nodes
+    whose value slot is externally observed mid-program (tape probe
+    injection sites) — rewrite passes must neither merge nor bypass
+    them."""
+
+    __slots__ = ("op", "fn", "static", "static_key", "specs", "kw_names",
+                 "kw_specs", "n_out", "aval", "sig", "pinned")
+
+    def __init__(self, op, fn, static, static_key, specs, kw_names=(),
+                 kw_specs=(), n_out=1, aval=None, sig=None, pinned=False):
+        self.op = op
+        self.fn = fn
+        self.static = static
+        self.static_key = static_key
+        self.specs = tuple(specs)
+        self.kw_names = tuple(kw_names)
+        self.kw_specs = tuple(kw_specs)
+        self.n_out = n_out
+        self.aval = aval
+        self.sig = sig
+        self.pinned = pinned
+
+    def replace(self, **kw):
+        d = {s: getattr(self, s) for s in self.__slots__}
+        d.update(kw)
+        return Node(**d)
+
+    def ident(self):
+        """Structural identity for keys/CSE: everything that determines
+        the node's value given its inputs (fn is derived from op)."""
+        return (self.op, self.static_key, self.specs, self.kw_names,
+                self.kw_specs, self.n_out, self.pinned)
+
+
+class Graph:
+    """Immutable typed graph: ``nodes`` in a valid topological order,
+    ``leaf_sigs`` (interned signature id per program input), ``outputs``
+    (spec ints), and ``meta`` (pass annotations, e.g. the donation
+    policy). Value slots number the flattened node outputs in node
+    order."""
+
+    __slots__ = ("nodes", "leaf_sigs", "outputs", "meta")
+
+    def __init__(self, nodes, leaf_sigs, outputs, meta=None):
+        self.nodes = tuple(nodes)
+        self.leaf_sigs = tuple(leaf_sigs)
+        self.outputs = tuple(outputs)
+        self.meta = dict(meta or {})
+
+    @property
+    def n_nodes(self):
+        return len(self.nodes)
+
+    @property
+    def n_edges(self):
+        return sum(len(n.specs) + len(n.kw_specs) for n in self.nodes)
+
+    def slot_bases(self):
+        """First value slot of each node."""
+        bases, s = [], 0
+        for n in self.nodes:
+            bases.append(s)
+            s += n.n_out
+        return bases
+
+    def slot_owner(self):
+        """slot index -> (node index, output position)."""
+        own = {}
+        s = 0
+        for i, n in enumerate(self.nodes):
+            for j in range(n.n_out):
+                own[s] = (i, j)
+                s += 1
+        return own
+
+
+class GraphBuilder:
+    """Incremental Graph construction shared by the three capture
+    converters. ``leaf`` interns a program input (deduped by caller
+    identity), ``add`` appends a node and returns its FIRST value slot;
+    ``build`` freezes the result."""
+
+    def __init__(self):
+        self.nodes = []
+        self.leaf_sigs = []
+        self._leaf_ids = {}
+        self._nslots = 0
+
+    def leaf(self, ident, sig=None, sig_id=None, untyped=False):
+        """Spec int (~li) for a leaf, deduped by ``ident``; returns None
+        when the signature interner is at cap (caller bails).
+        ``untyped=True`` admits a leaf with no signature (sig entry
+        None) — the structural-only form serve's per-bucket compilation
+        uses; type-dependent passes skip what they can't see."""
+        li = self._leaf_ids.get(ident)
+        if li is None:
+            if untyped:
+                sid = None
+            else:
+                sid = sig_id if sig_id is not None else _sig_id(sig)
+                if sid is None:
+                    return None
+            li = self._leaf_ids[ident] = len(self.leaf_sigs)
+            self.leaf_sigs.append(sid)
+        return ~li
+
+    def add(self, op, fn, static, static_key, specs, kw_names=(),
+            kw_specs=(), n_out=1, aval=None, sig=None, pinned=False):
+        first = self._nslots
+        self.nodes.append(Node(op, fn, static, static_key, specs, kw_names,
+                               kw_specs, n_out, aval, sig, pinned))
+        self._nslots += n_out
+        return first
+
+    @property
+    def n_slots(self):
+        return self._nslots
+
+    def build(self, outputs, meta=None):
+        return Graph(self.nodes, self.leaf_sigs, outputs, meta)
+
+
+# ------------------------------------------------------- capture: window
+
+
+def from_window(nodes, key_parts, leaf_sigs, out_slots):
+    """Convert a flushed bulk window (``engine._BulkWindow`` contents at
+    flush time) into a Graph. The window's creation order is already a
+    topological order and its specs already speak the spec-int
+    convention, so this is a typed re-wrap, not a walk: ``key_parts[i]``
+    carries the frozen static attrs the incremental key build already
+    computed."""
+    return Graph(
+        (Node(n.op, n.fn, n.static, kp[1], n.specs, aval=n._aval,
+              sig=n._sigid) for n, kp in zip(nodes, key_parts)),
+        leaf_sigs, out_slots)
+
+
+# ------------------------------------------------------- capture: symbol
+
+# symbol ops evaluated by dedicated _eval branches (control flow,
+# grouping, host closures) — never representable as a single typed node
+_SYM_UNSUPPORTED = frozenset(
+    ("_group", "_item", "_cond", "_foreach", "_while", "_callable"))
+
+
+class UnsupportedGraph(Exception):
+    """Raised by the symbol converter for graphs the IR cannot represent
+    (control flow, rng draws, multi-output ops) — callers fall back to
+    the legacy per-capture lowering."""
+
+
+def symbol_skeleton(roots):
+    """Structural skeleton of a deterministic Symbol DAG: a list of
+    ``(op, attrs, static_key, specs)`` steps over named leaves, plus the
+    leaf (variable) names in first-use order and the output specs.
+    Signature-independent — combine with runtime value signatures via
+    :func:`from_symbol`. Raises :class:`UnsupportedGraph` for graphs the
+    IR cannot represent."""
+    steps = []
+    leaf_names = []
+    leaf_pos = {}
+    memo = {}
+
+    def visit(s):
+        got = memo.get(id(s))
+        if got is not None:
+            return got
+        if s._op is None:  # variable: a named leaf (shared by name)
+            li = leaf_pos.get(s.name)
+            if li is None:
+                li = leaf_pos[s.name] = len(leaf_names)
+                leaf_names.append(s.name)
+            spec = ~li
+            memo[id(s)] = spec
+            return spec
+        if s._op in _SYM_UNSUPPORTED:
+            raise UnsupportedGraph(s._op)
+        opdef = OP_REGISTRY.get(s._op)
+        if opdef is None or opdef.needs_rng or opdef.n_outputs != 1:
+            raise UnsupportedGraph(s._op)
+        attrs = s._attrs
+        if "key" in attrs or "out" in attrs:
+            raise UnsupportedGraph("%s: traced attr" % s._op)
+        try:
+            static_key = _freeze(attrs)
+            hash(static_key)
+        except TypeError:
+            raise UnsupportedGraph("%s: unhashable attrs" % s._op)
+        specs = tuple(visit(i) for i in s._inputs)
+        idx = len(steps)
+        steps.append((s._op, attrs, static_key, specs))
+        memo[id(s)] = idx
+        return idx
+
+    out_specs = tuple(visit(r) for r in roots)
+    return steps, leaf_names, out_specs
+
+
+def from_symbol(skeleton, leaf_sig_ids=None):
+    """Build a typed Graph from a symbol skeleton and the interned
+    signatures of the values bound to its leaves (eval-time); per-node
+    avals are inferred through the shared aval cache. Raises
+    :class:`UnsupportedGraph` when any node is not single-output
+    evaluable at these signatures (the legacy eval path then raises the
+    real, well-located error).
+
+    ``leaf_sig_ids=None`` builds the STRUCTURAL-ONLY form (untyped
+    leaves, no aval inference) — serve's per-bucket compilation path,
+    where signatures arrive per bucket at jit time; type-dependent
+    rewrites simply skip."""
+    steps, leaf_names, out_specs = skeleton
+    b = GraphBuilder()
+    if leaf_sig_ids is None:
+        for name in leaf_names:
+            b.leaf(name, untyped=True)
+        for op, attrs, static_key, specs in steps:
+            b.add(op, OP_REGISTRY[op].fn, attrs, static_key, specs)
+        return b.build(out_specs)
+    for name, sid in zip(leaf_names, leaf_sig_ids):
+        if b.leaf(name, sig_id=sid) is None:
+            raise UnsupportedGraph("signature interner at cap")
+    slot_sigs = []
+    for op, attrs, static_key, specs in steps:
+        opdef = OP_REGISTRY[op]
+        in_sigs = tuple(leaf_sig_ids[~s] if s < 0 else slot_sigs[s]
+                        for s in specs)
+        entry = infer_aval_cached(op, static_key, attrs, in_sigs, opdef)
+        if entry is None:
+            raise UnsupportedGraph("%s: not single-output evaluable" % op)
+        av, sid = entry
+        b.add(op, opdef.fn, attrs, static_key, specs, aval=av, sig=sid)
+        slot_sigs.append(sid)
+    return b.build(out_specs)
+
+
+# --------------------------------------------------------- canonical form
+
+
+class Canonical:
+    """Result of :func:`canonicalize`: the canonical graph plus the maps
+    back to the capture's numbering. ``leaf_perm[j]`` is the ORIGINAL
+    leaf index behind canonical leaf ``j``; ``slot_map`` maps original
+    value slots to canonical slots (absent = unreachable, dropped)."""
+
+    __slots__ = ("graph", "leaf_perm", "slot_map", "dropped_nodes")
+
+    def __init__(self, graph, leaf_perm, slot_map, dropped_nodes):
+        self.graph = graph
+        self.leaf_perm = leaf_perm
+        self.slot_map = slot_map
+        self.dropped_nodes = dropped_nodes
+
+
+def canonicalize(graph):
+    """Renumber a capture-ordered graph into the canonical form: nodes in
+    deterministic DFS-from-outputs post-order (inputs visited
+    left-to-right), leaves renumbered by first use in that order,
+    unreachable nodes and leaves dropped. Identical math captured by any
+    frontend converges here — the content the key hashes."""
+    owner = graph.slot_owner()
+    nodes = graph.nodes
+    order = []          # original node indices, canonical order
+    state = {}          # original node idx -> 1 (on stack) / 2 (done)
+
+    # iterative DFS: symbol/tape graphs can be deep (resnet-scale chains
+    # overflow the recursion limit)
+    for root in graph.outputs:
+        if root < 0:
+            continue
+        stack = [owner[root][0]]
+        while stack:
+            ni = stack[-1]
+            st = state.get(ni)
+            if st == 2:
+                stack.pop()
+                continue
+            if st == 1:
+                state[ni] = 2
+                order.append(ni)
+                stack.pop()
+                continue
+            state[ni] = 1
+            n = nodes[ni]
+            # push children in REVERSE so the leftmost input completes
+            # first (deterministic post-order)
+            for s in reversed(n.specs + n.kw_specs):
+                if s >= 0 and state.get(owner[s][0]) is None:
+                    stack.append(owner[s][0])
+
+    new_idx = {ni: k for k, ni in enumerate(order)}
+    new_bases, s = [], 0
+    for ni in order:
+        new_bases.append(s)
+        s += nodes[ni].n_out
+
+    leaf_perm = []      # canonical leaf -> original leaf
+    leaf_new = {}       # original leaf -> canonical leaf
+    slot_map = {}       # original slot -> canonical slot
+
+    def remap(spec):
+        if spec >= 0:
+            ni, j = owner[spec]
+            return new_bases[new_idx[ni]] + j
+        li = ~spec
+        nl = leaf_new.get(li)
+        if nl is None:
+            nl = leaf_new[li] = len(leaf_perm)
+            leaf_perm.append(li)
+        return ~nl
+
+    new_nodes = []
+    for ni in order:
+        n = nodes[ni]
+        new_nodes.append(n.replace(
+            specs=tuple(remap(s) for s in n.specs),
+            kw_specs=tuple(remap(s) for s in n.kw_specs)))
+    new_outputs = tuple(remap(s) for s in graph.outputs)
+    for old, (ni, j) in owner.items():
+        if ni in new_idx:
+            slot_map[old] = new_bases[new_idx[ni]] + j
+    lsigs = tuple(graph.leaf_sigs[li] for li in leaf_perm)
+    cg = Graph(new_nodes, lsigs, new_outputs, graph.meta)
+    return Canonical(cg, tuple(leaf_perm), slot_map,
+                     len(nodes) - len(order))
+
+
+def _render_sig(sig):
+    """Process-stable rendering of an interned signature for the
+    content-addressed key (intern IDS are process-local; the key must be
+    byte-identical across processes)."""
+    if sig is None:
+        return ("u",)  # untyped leaf (structural-only graphs)
+    if type(sig) is tuple:  # array: (dtype, shape)
+        return ("a", str(np.dtype(sig[0])), tuple(sig[1]))
+    return ("s", getattr(sig, "__name__", str(sig)))  # weak scalar type
+
+
+def canonical_key(cgraph):
+    """Content-addressed key of a CANONICAL graph: sha256 over a stable
+    rendering of (node idents, leaf signatures, outputs). Same canonical
+    graph → byte-identical key, in any process — the one cache key the
+    bulk/tape/symbol schemes collapse into."""
+    payload = ("irv1",
+               tuple(n.ident() for n in cgraph.nodes),
+               tuple(_render_sig(None if i is None else _SIG_LIST[i])
+                     for i in cgraph.leaf_sigs),
+               cgraph.outputs)
+    return hashlib.sha256(repr(payload).encode()).hexdigest()
+
+
+# ------------------------------------------------------------- execution
+
+
+def build_runner(graph, probes=None):
+    """Pure replay function of a Graph: ``run(leaf_vals, probe_vals)``
+    evaluates nodes in order and returns the output tuple. ``probes``
+    (value slot -> probe index) adds ``probe_vals[k]`` to a slot's value
+    at its production site — the tape's intermediate-gradient injection
+    points. The returned function is jax-traceable; lowering jits it
+    through ``base._jit_backed``."""
+    steps = [(n.fn, n.static, n.specs, n.kw_names, n.kw_specs, n.n_out)
+             for n in graph.nodes]
+    outputs = graph.outputs
+    probe = dict(probes or {})
+
+    def run(lv, tv=()):
+        env = []
+        for fn, static, specs, kwn, kws, n_out in steps:
+            vals = [env[s] if s >= 0 else lv[~s] for s in specs]
+            if kwn or static:
+                kw = {k: (env[s] if s >= 0 else lv[~s])
+                      for k, s in zip(kwn, kws)}
+                r = fn(*vals, **kw, **static)
+            else:
+                r = fn(*vals)
+            flat = jax.tree_util.tree_leaves(r) if n_out != 1 else [r]
+            for v in flat:
+                pk = probe.get(len(env))
+                env.append(v if pk is None else v + tv[pk])
+        return tuple(env[s] if s >= 0 else lv[~s] for s in outputs)
+
+    return run

@@ -1,0 +1,171 @@
+"""mxnet_tpu.ir.lower — one compiled artifact per canonical graph.
+
+The collapse point of the three cache-key schemes: the bulk window, the
+autograd tape, and the Symbol executors all land here with a typed
+:class:`~mxnet_tpu.ir.graph.Graph`; lowering canonicalizes it, looks up
+the content-addressed key in ONE shared cache (``base._IR_CACHE``,
+``MXNET_IR_CACHE_CAP``), runs the rewrite-pass pipeline on a miss, and
+jits the optimized replay through ``base._jit_backed`` — so the
+persistent cross-process compilation store and the AOT snapshot layer
+(mxnet_tpu.cache, PR 7) apply to every capture unchanged, and identical
+math from ANY capture shares one compiled program (TVM's
+one-artifact-per-graph lowering, arXiv 1802.04799).
+
+Counter semantics are preserved per capture: a real program build bumps
+the owning capture's compile counter (``engine.bulk_compile_counter`` /
+``tape_compile_counter`` / ``symbol_compile_counter``) with the canonical
+key as the watchdog note — a cache HIT from a different capture bumps
+nothing, which is exactly the cross-capture dedup the counters now also
+prove (tests assert "3 captures, 1 compile").
+"""
+from __future__ import annotations
+
+import threading
+
+from .. import base
+from ..base import _jit_backed, _key_note
+from . import graph as _g
+from . import passes as _p
+
+__all__ = ["lower_forward", "prepare", "tape_program", "stats",
+           "reset_stats"]
+
+_lock = threading.Lock()   # entry construction only — never the hit path
+
+# build accounting for tools/diagnose.py, tools/ir_bench.py and the
+# observability "ir" collector (fixed keys — GL006)
+_BUILD_STATS = {"graph_builds": 0, "program_builds": 0,
+                "last_build": None}
+
+
+class IREntry:
+    """One canonical graph's cache entry: the pass-optimized graph, the
+    capture maps, and every program lowered from it (``fwd`` for the
+    forward captures; tape layouts key their own variants)."""
+
+    __slots__ = ("key", "graph", "leaf_sel", "slot_fwd", "programs",
+                 "nodes_canonical", "nodes_final", "edges_canonical",
+                 "edges_final")
+
+    def __init__(self, key, cgraph):
+        final, leaf_sel, slot_fwd = _p.optimize(cgraph)
+        self.key = key
+        self.graph = final
+        self.leaf_sel = leaf_sel      # final program arg j -> canonical leaf
+        self.slot_fwd = slot_fwd      # canonical slot -> final spec (or None)
+        self.programs = {}
+        self.nodes_canonical = cgraph.n_nodes
+        self.nodes_final = final.n_nodes
+        self.edges_canonical = cgraph.n_edges
+        self.edges_final = final.n_edges
+
+
+def _counter(kind):
+    from .. import engine
+
+    return {"bulk": engine.bulk_compile_counter,
+            "tape": engine.tape_compile_counter,
+            "symbol": engine.symbol_compile_counter}[kind]
+
+
+def prepare(raw_graph):
+    """(canonical, entry): canonicalize a capture's graph and get (or
+    build) its shared cache entry. The entry build — passes included —
+    runs once per canonical key; steady state is hash + dict hit."""
+    canon = _g.canonicalize(raw_graph)
+    key = _g.canonical_key(canon.graph)
+    ent = base._IR_CACHE.get(key)
+    if ent is None:
+        with _lock:
+            ent = base._IR_CACHE.get(key)
+            if ent is None:
+                ent = base._IR_CACHE[key] = IREntry(key, canon.graph)
+                _BUILD_STATS["graph_builds"] += 1
+                _BUILD_STATS["last_build"] = {
+                    "key": key[:16],
+                    "nodes_captured": raw_graph.n_nodes,
+                    "nodes_canonical": ent.nodes_canonical,
+                    "nodes_final": ent.nodes_final,
+                    "edges_canonical": ent.edges_canonical,
+                    "edges_final": ent.edges_final,
+                }
+    return canon, ent
+
+
+def lower_forward(raw_graph, kind, hint=None):
+    """Lower a forward capture to ``(prog, arg_sel)``: ``prog`` is the
+    jitted optimized program (shared across captures via the canonical
+    key), ``arg_sel[j]`` the CAPTURE leaf index to pass as program arg
+    ``j``. Only an actual program build bumps ``kind``'s compile
+    counter."""
+    canon, ent = prepare(raw_graph)
+    prog = ent.programs.get("fwd")
+    if prog is None:
+        with _lock:
+            prog = ent.programs.get("fwd")
+            if prog is None:
+                # note carries the CAPTURE kind + canonical key: watchdog
+                # warnings name both the frontend and the offending graph
+                _counter(kind).bump(note=_key_note(kind, ent.key))
+                prog = _jit_backed(_fwd_fn(ent.graph), tier=kind,
+                                   hint=hint or ("ir-" + kind))
+                ent.programs["fwd"] = prog
+                _BUILD_STATS["program_builds"] += 1
+    sel = tuple(canon.leaf_perm[c] for c in ent.leaf_sel)
+    return prog, sel
+
+
+def _fwd_fn(final_graph):
+    run = _g.build_runner(final_graph)
+
+    def fwd(*leaf_vals):
+        return run(leaf_vals)
+
+    return fwd
+
+
+def tape_program(ent, variant_key, builder, donate=()):
+    """Cached jitted tape program over an entry's optimized graph.
+    ``variant_key`` carries the head/grad/donation layout (canonical
+    space — deterministic); ``builder()`` returns the pure program fn.
+    A miss bumps ``engine.tape_compile_counter`` with the composite key
+    as the watchdog note."""
+    key = ("tape", ent.key, variant_key)
+    prog = ent.programs.get(key)
+    if prog is None:
+        with _lock:
+            prog = ent.programs.get(key)
+            if prog is None:
+                _counter("tape").bump(note=_key_note("tape", key))
+                prog = _jit_backed(builder(), donate=tuple(donate) or None,
+                                   tier="tape", hint="tape")
+                ent.programs[key] = prog
+                _BUILD_STATS["program_builds"] += 1
+    return prog
+
+
+def program_count():
+    """Live compiled programs across all canonical entries — the number
+    the cross-capture dedup test pins to 1."""
+    return sum(len(e.programs) for e in base._IR_CACHE.values()
+               if isinstance(e, IREntry))
+
+
+def stats():
+    """The observability/diagnose "Graph IR" section payload."""
+    return {
+        "cache": {"entries": len(base._IR_CACHE),
+                  "cap": base._IR_CACHE.cap,
+                  "evictions": base._IR_CACHE.evictions,
+                  "programs": program_count()},
+        "interner": _g.interner_stats(),
+        "builds": dict(_BUILD_STATS),
+        "passes": _p.pass_stats(),
+    }
+
+
+def reset_stats():
+    """Test/bench hook: zero the build tallies (cache stays warm)."""
+    _BUILD_STATS["graph_builds"] = 0
+    _BUILD_STATS["program_builds"] = 0
+    _BUILD_STATS["last_build"] = None

@@ -1,0 +1,486 @@
+"""mxnet_tpu.ir.passes — rewrite-pass pipeline over the typed graph IR.
+
+Each pass is a pure ``Graph -> Graph`` function (via :class:`PassManager`
+for users; the lowering layer runs the same passes through a map-tracking
+:class:`_Work` so capture-side leaf/slot numbering survives the
+rewrites). These are the whole-graph optimizations XLA cannot do across
+this stack's dispatch boundaries — they run ONCE per canonical graph,
+before jit, and every capture that lowers the same math shares the
+result (Relay's "pass, not vigil" discipline, arXiv 1810.00952; the
+lowered artifact is one compiled program per canonical graph, the TVM
+move of arXiv 1802.04799).
+
+Passes:
+
+* ``cse``       — merge structurally identical subexpressions (same op,
+                  static attrs, input wiring). The bulk window captures
+                  a fresh node per imperative call even when the math
+                  repeats; CSE collapses the repeats to one slot.
+* ``fold``      — pre-evaluate constant islands (``_const``/``_filled``/
+                  ``_arange`` roots and the pure math over them) into
+                  baked array constants at build time.
+* ``cast_sink`` — parity-exact cast cleanup: identity casts
+                  (target == input dtype) vanish; lossless-widening
+                  round trips (``bf16 → f32 → bf16``) collapse to the
+                  source value. The mixed-precision checkpoint/AMP
+                  boundary pattern.
+* ``dce``       — drop nodes and leaves no output depends on (the dead
+                  branches earlier rewrites strand, plus capture-side
+                  dead results the window recorded but nobody read).
+* ``donation``  — annotate the donation policy: leaves consumed exactly
+                  once whose aval matches an output are safe donation
+                  candidates (``meta['donatable_leaves']``); lowering
+                  applies them only when the caller opts in (capture
+                  paths never donate implicitly — the caller's NDArrays
+                  own those buffers).
+
+Per-pass node/edge deltas are kept in :data:`PASS_STATS` (fixed keys, no
+unbounded growth — GL006) and mirrored into the observability registry
+as ``ir_pass_*`` counters on each run.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import OP_REGISTRY, _freeze, env_cap as _env_cap, resolve_dtype
+from .graph import Graph
+
+__all__ = ["PassManager", "DEFAULT_PASSES", "PASS_STATS", "pass_stats"]
+
+# ops whose value is fully determined by static attrs (no inputs): the
+# roots constant folding grows islands from
+_CONST_ROOT_OPS = ("_const", "_filled", "_arange")
+
+# folding a huge _filled would bake megabytes into the program text; XLA
+# folds those fine on its own. Islands above this element count stay.
+_FOLD_MAX_ELEMS = _env_cap("MXNET_IR_FOLD_MAX_ELEMS", 65536)
+
+_PASS_NAMES = ("cse", "fold", "cast_sink", "dce", "donation")
+
+# fixed-key stats table (one entry per pass — bounded by construction);
+# tools/diagnose.py and ir.lower.stats() read it, the observability "ir"
+# collector exports it
+PASS_STATS = {name: {"runs": 0, "nodes_removed": 0, "edges_removed": 0,
+                     "rewrites": 0} for name in _PASS_NAMES}
+
+
+def pass_stats():
+    return {k: dict(v) for k, v in PASS_STATS.items()}
+
+
+def _note(name, graph_before, graph_after, rewrites):
+    st = PASS_STATS[name]
+    st["runs"] += 1
+    dn = graph_before.n_nodes - graph_after.n_nodes
+    de = graph_before.n_edges - graph_after.n_edges
+    st["nodes_removed"] += max(dn, 0)
+    st["edges_removed"] += max(de, 0)
+    st["rewrites"] += rewrites
+    try:  # mirror into the metrics registry (lazy: avoids an import cycle)
+        from ..observability import registry
+
+        if dn > 0:
+            registry.counter("ir_pass_%s_nodes_removed" % name).inc(dn)
+        if de > 0:
+            registry.counter("ir_pass_%s_edges_removed" % name).inc(de)
+        if rewrites:
+            registry.counter("ir_pass_%s_rewrites" % name).inc(rewrites)
+    except Exception:
+        pass  # registry unavailable (partial import): stats table still has it
+
+
+class _Work:
+    """Mutable pass workspace with capture-map tracking. ``slot_rep``
+    accumulates slot→spec replacements (CSE merges, cast bypasses);
+    ``leaf_back[j]`` is the input-graph leaf behind current leaf ``j``.
+    ``resolve`` follows replacement chains so later passes and the final
+    maps all see through earlier rewrites."""
+
+    def __init__(self, graph):
+        self.nodes = list(graph.nodes)
+        self.leaf_sigs = list(graph.leaf_sigs)
+        self.outputs = list(graph.outputs)
+        self.meta = dict(graph.meta)
+        self.leaf_back = list(range(len(graph.leaf_sigs)))
+        self.slot_rep = {}
+        self._in_slots = sum(n.n_out for n in graph.nodes)
+
+    def resolve(self, spec):
+        if getattr(self, "_rep_final", False):
+            # post-renumber: values live in the FINAL slot space, whose
+            # numbers may coincide with stale keys — single-step only
+            return self.slot_rep.get(spec, spec) if spec >= 0 else spec
+        while spec >= 0 and spec in self.slot_rep:
+            spec = self.slot_rep[spec]
+        return spec
+
+    def graph(self):
+        return Graph(self.nodes, self.leaf_sigs, self.outputs, self.meta)
+
+    def finish(self):
+        """(final Graph, leaf_sel, slot_fwd): ``leaf_sel[j]`` is the
+        input-graph leaf behind final program arg ``j``; ``slot_fwd``
+        maps every input-graph slot to its final spec (through merges
+        and renumbering; None = dead)."""
+        g = self.graph()
+        renumber = getattr(self, "_renumber", None)
+        slot_fwd = {}
+        for s in range(self._in_slots):
+            if s in self.slot_rep:
+                slot_fwd[s] = self.resolve(s)  # already final-space
+            elif renumber is not None:
+                slot_fwd[s] = renumber.get(s)  # None when DCE'd
+            else:
+                slot_fwd[s] = s
+        return g, tuple(self.leaf_back), slot_fwd
+
+
+# ---------------------------------------------------------------- passes
+
+
+def _apply_reps(work):
+    """Rewrite all wiring through the accumulated slot replacements."""
+    if not work.slot_rep:
+        return
+    res = work.resolve
+    work.nodes = [n.replace(specs=tuple(res(s) for s in n.specs),
+                            kw_specs=tuple(res(s) for s in n.kw_specs))
+                  for n in work.nodes]
+    work.outputs = [res(s) for s in work.outputs]
+
+
+def _cse(work):
+    """Merge structurally identical nodes. Pinned nodes (tape probe
+    injection sites) are opaque: never merged away, never a merge
+    target — a probe perturbs its slot's value, so aliasing it with
+    other uses would change gradients."""
+    seen = {}
+    rewrites = 0
+    bases, s = [], 0
+    for n in work.nodes:
+        bases.append(s)
+        s += n.n_out
+    for i, n in enumerate(work.nodes):
+        if n.pinned:
+            continue
+        key = (n.op, n.static_key,
+               tuple(work.resolve(x) for x in n.specs),
+               tuple(work.resolve(x) for x in n.kw_specs),
+               n.kw_names, n.n_out)
+        try:
+            first = seen.setdefault(key, i)
+        except TypeError:  # unhashable static_key: skip defensively
+            continue
+        if first != i and not work.nodes[first].pinned:
+            for j in range(n.n_out):
+                work.slot_rep[bases[i] + j] = bases[first] + j
+            rewrites += 1
+    _apply_reps(work)
+    return rewrites
+
+
+def _fold(work):
+    """Replace constant islands with baked array constants. A node is
+    constant when it is a const root (no inputs, static-only) or every
+    input resolves to a constant slot; boundary nodes (constant nodes
+    with a non-constant consumer, or outputs) become ``_ir_const``
+    nodes holding the pre-evaluated value; interior nodes die (DCE
+    sweeps them)."""
+    bases, s = [], 0
+    for n in work.nodes:
+        bases.append(s)
+        s += n.n_out
+    const = {}   # node idx -> evaluated value (single-output only)
+    rewrites = 0
+    for i, n in enumerate(work.nodes):
+        if n.pinned or n.n_out != 1 or n.kw_names:
+            continue
+        is_root = n.op in _CONST_ROOT_OPS and not n.specs
+        deps_const = n.specs and all(
+            s >= 0 and s in const for s in
+            (work.resolve(x) for x in n.specs))
+        if not (is_root or deps_const):
+            continue
+        try:
+            vals = [const[work.resolve(x)] for x in n.specs]
+            v = n.fn(*vals, **n.static) if n.static else n.fn(*vals)
+            v = np.asarray(v)
+        except Exception:
+            continue  # not host-evaluable: leave it to runtime
+        if v.size > _FOLD_MAX_ELEMS:
+            continue
+        const[bases[i]] = v
+    if not const:
+        return 0
+    # rebuild: constant slots that still have non-constant consumers (or
+    # are outputs) become baked-constant nodes
+    slot_is_const = set(const)
+    used_by_nonconst = set()
+    for i, n in enumerate(work.nodes):
+        if bases[i] in const:
+            continue
+        for x in n.specs + n.kw_specs:
+            r = work.resolve(x)
+            if r in slot_is_const:
+                used_by_nonconst.add(r)
+    for s_ in work.outputs:
+        r = work.resolve(s_)
+        if r in slot_is_const:
+            used_by_nonconst.add(r)
+    for i, n in enumerate(work.nodes):
+        sl = bases[i]
+        if sl in const and sl in used_by_nonconst \
+                and n.op != "_ir_const":
+            v = const[sl]
+            work.nodes[i] = Node_const(v, n)
+            rewrites += 1
+    return rewrites
+
+
+def Node_const(value, like):
+    """A baked constant node: value pre-evaluated at pass time, embedded
+    as a program constant (XLA hoists it)."""
+    from .graph import Node
+
+    arr = np.asarray(value)
+
+    def _ir_const(*, value=None):
+        import jax.numpy as jnp
+
+        return jnp.asarray(arr)
+
+    return Node("_ir_const", _ir_const, {"value": arr},
+                _freeze({"value": arr}), (), aval=like.aval, sig=like.sig)
+
+
+def _lossless_widen(src, mid):
+    """True when casting ``src`` → ``mid`` loses nothing (so a later cast
+    from ``mid`` equals a cast from ``src``). Conservative float/int
+    ladder; unknown combos are not lossless."""
+    try:
+        src, mid = np.dtype(src), np.dtype(mid)
+    except TypeError:
+        return False
+    if src == mid:
+        return True
+    flt = {"bfloat16": 8, "float16": 11, "float32": 24, "float64": 53}
+    if src.name in flt and mid.name in flt:
+        # mantissa AND exponent must both widen; bf16's exponent range
+        # equals f32's, f16's does not cover bf16
+        exp = {"bfloat16": 8, "float16": 5, "float32": 8, "float64": 11}
+        return flt[mid.name] >= flt[src.name] and \
+            exp[mid.name] >= exp[src.name]
+    if src.kind in "iu" and mid.kind in "iu":
+        return (src.kind == mid.kind and mid.itemsize >= src.itemsize) or \
+            (src.kind == "u" and mid.kind == "i"
+             and mid.itemsize > src.itemsize)
+    return False
+
+
+def _cast_sink(work):
+    """Parity-exact cast cleanup (the bf16 mixed-precision pattern):
+
+    * ``cast(x, dtype(x))``                  → ``x``
+    * ``cast(cast(x, mid), t)`` with a lossless ``x → mid`` widen
+                                             → ``cast(x, t)``
+
+    Rewrites never bypass a pinned producer (its slot's value is
+    observed by tape probe injection)."""
+    owner = {}
+    bases, s = [], 0
+    for i, n in enumerate(work.nodes):
+        bases.append(s)
+        for j in range(n.n_out):
+            owner[s + j] = i
+        s += n.n_out
+
+    def producer(spec):
+        return work.nodes[owner[spec]] if spec >= 0 else None
+
+    def spec_dtype(spec):
+        if spec >= 0:
+            n = producer(spec)
+            return None if n is None or n.aval is None else n.aval.dtype
+        from .graph import _SIG_LIST
+
+        sid = work.leaf_sigs[~spec]
+        if sid is None:  # untyped leaf (structural-only graph)
+            return None
+        sig = _SIG_LIST[sid]
+        return sig[0] if type(sig) is tuple else None
+
+    rewrites = 0
+    for i, n in enumerate(work.nodes):
+        if n.op != "cast" or n.pinned:
+            continue
+        src = work.resolve(n.specs[0])
+        target = resolve_dtype(n.static.get("dtype"))
+        # collapse a lossless-widening inner cast first
+        inner = producer(src)
+        if inner is not None and inner.op == "cast" and not inner.pinned:
+            inner_src = work.resolve(inner.specs[0])
+            sdt = spec_dtype(inner_src)
+            if sdt is not None and target is not None and \
+                    _lossless_widen(sdt, inner.aval.dtype
+                                    if inner.aval is not None
+                                    else resolve_dtype(
+                                        inner.static.get("dtype"))):
+                work.nodes[i] = n.replace(specs=(inner_src,))
+                src = inner_src
+                rewrites += 1
+        # identity cast: target == input dtype
+        sdt = spec_dtype(src)
+        if sdt is not None and target is not None \
+                and np.dtype(sdt) == np.dtype(target):
+            prod = producer(src)
+            if prod is None or not prod.pinned:
+                work.slot_rep[bases[i]] = src
+                rewrites += 1
+    _apply_reps(work)
+    return rewrites
+
+
+def _dce(work):
+    """Drop nodes and leaves no output (transitively) uses, renumbering
+    slots and leaves. Outputs — including the tape's pinned probe
+    slots, which lowering always lists as outputs — are the roots."""
+    owner = {}
+    bases, s = [], 0
+    for i, n in enumerate(work.nodes):
+        bases.append(s)
+        for j in range(n.n_out):
+            owner[s + j] = i
+        s += n.n_out
+    live_nodes = set()
+    live_leaves = set()
+    stack = [sp for sp in work.outputs]
+    while stack:
+        sp = stack.pop()
+        if sp < 0:
+            live_leaves.add(~sp)
+            continue
+        ni = owner[sp]
+        if ni in live_nodes:
+            continue
+        live_nodes.add(ni)
+        n = work.nodes[ni]
+        stack.extend(n.specs + n.kw_specs)
+    if len(live_nodes) == len(work.nodes) and \
+            len(live_leaves) == len(work.leaf_sigs):
+        return 0
+    # renumber kept nodes (original relative order) and kept leaves
+    kept = [i for i in range(len(work.nodes)) if i in live_nodes]
+    new_bases, s = {}, 0
+    for i in kept:
+        new_bases[i] = s
+        s += work.nodes[i].n_out
+    leaf_map = {}
+    new_leaf_sigs, new_leaf_back = [], []
+    for li in range(len(work.leaf_sigs)):
+        if li in live_leaves:
+            leaf_map[li] = len(new_leaf_sigs)
+            new_leaf_sigs.append(work.leaf_sigs[li])
+            new_leaf_back.append(work.leaf_back[li])
+
+    def remap(spec):
+        if spec < 0:
+            return ~leaf_map[~spec]
+        return new_bases[owner[spec]] + (spec - bases[owner[spec]])
+
+    renumber = {}
+    for i in kept:
+        for j in range(work.nodes[i].n_out):
+            renumber[bases[i] + j] = new_bases[i] + j
+    rewrites = len(work.nodes) - len(kept)
+    work.nodes = [work.nodes[i].replace(
+        specs=tuple(remap(s) for s in work.nodes[i].specs),
+        kw_specs=tuple(remap(s) for s in work.nodes[i].kw_specs))
+        for i in kept]
+    work.outputs = [remap(s) for s in work.outputs]
+    work.leaf_sigs = new_leaf_sigs
+    work.leaf_back = new_leaf_back
+    # flatten every replacement chain in the OLD slot space, then remap
+    # into the final space; from here on resolve() is single-step
+    # (_rep_final) — final slot numbers may coincide with stale old keys
+    flat = {k: work.resolve(k) for k in list(work.slot_rep)}
+    work.slot_rep = {
+        k: (renumber.get(v, v) if v >= 0
+            else (~leaf_map[~v] if ~v in leaf_map else v))
+        for k, v in flat.items()}
+    work._rep_final = True
+    prev = getattr(work, "_renumber", None)
+    work._renumber = renumber if prev is None else {
+        k: renumber.get(v, v) for k, v in prev.items()}
+    return rewrites
+
+
+def _donation(work):
+    """Annotate the automatic donation policy: a leaf is a donation
+    candidate when it is an array leaf consumed by exactly ONE wiring
+    edge and some output aval matches its signature (XLA can then alias
+    the input buffer into that output). Annotation only — lowering
+    donates solely when the caller opts in."""
+    from .graph import _SIG_LIST
+
+    uses = {}
+    for n in work.nodes:
+        for s in n.specs + n.kw_specs:
+            if s < 0:
+                uses[~s] = uses.get(~s, 0) + 1
+    for s in work.outputs:
+        if s < 0:
+            uses[~s] = uses.get(~s, 0) + 2  # passthrough output: never donate
+    out_sigs = set()
+    owner = {}
+    base = 0
+    for n in work.nodes:
+        for j in range(n.n_out):
+            owner[base + j] = n
+        base += n.n_out
+    for s in work.outputs:
+        if s >= 0 and owner[s].sig is not None:
+            out_sigs.add(owner[s].sig)
+    cands = tuple(sorted(
+        li for li, cnt in uses.items()
+        if cnt == 1 and work.leaf_sigs[li] is not None
+        and type(_SIG_LIST[work.leaf_sigs[li]]) is tuple
+        and work.leaf_sigs[li] in out_sigs))
+    work.meta["donatable_leaves"] = cands
+    return len(cands)
+
+
+_PASS_FNS = {"cse": _cse, "fold": _fold, "cast_sink": _cast_sink,
+             "dce": _dce, "donation": _donation}
+
+DEFAULT_PASSES = ("cse", "fold", "cast_sink", "dce", "donation")
+
+
+class PassManager:
+    """Ordered pipeline of rewrite passes. :meth:`run` is the pure
+    ``Graph -> Graph`` form (pass-unit tests, user experimentation);
+    :meth:`run_work` is the map-tracking form lowering uses. The
+    pipeline is deterministic: same input graph → same output graph,
+    byte-identical canonical keys (tests assert it)."""
+
+    def __init__(self, passes=DEFAULT_PASSES):
+        unknown = [p for p in passes if p not in _PASS_FNS]
+        if unknown:
+            raise ValueError("unknown IR passes %s (have %s)"
+                             % (unknown, sorted(_PASS_FNS)))
+        self.passes = tuple(passes)
+
+    def run_work(self, work):
+        for name in self.passes:
+            before = work.graph()
+            rewrites = _PASS_FNS[name](work)
+            _note(name, before, work.graph(), rewrites)
+        return work
+
+    def run(self, graph):
+        return self.run_work(_Work(graph)).graph()
+
+
+def optimize(graph, pm=None):
+    """(final Graph, leaf_sel, slot_fwd) — the lowering entry point."""
+    w = (pm or PassManager()).run_work(_Work(graph))
+    return w.finish()

@@ -26,7 +26,6 @@ src/ndarray/ndarray.cc, python/mxnet/ndarray/ndarray.py). Key mapping:
 """
 from __future__ import annotations
 
-import functools
 import numbers
 import weakref
 
@@ -36,10 +35,12 @@ import numpy as np
 
 from . import autograd, random
 from . import engine as _engine
-from .base import (OP_REGISTRY, BoundedCache as _BoundedCache, _freeze,
-                   bulk_jitted, env_cap as _env_cap, jitted, resolve_dtype)
+from .base import (OP_REGISTRY, _BULK_CACHE, BoundedCache as _BoundedCache,
+                   _freeze, env_cap as _env_cap, jitted, resolve_dtype)
 from .context import Context, current_context
 from .engine import dispatch_counter
+from .ir import graph as _irgraph
+from .ir import lower as _irlower
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "linspace", "eye", "concat", "stack", "waitall", "invoke"]
@@ -447,72 +448,37 @@ _prof_on = False
 _obs_on = False
 _obs_counts = None
 
-# Signature interning: a signature — (dtype, shape) for arrays, the
-# python/numpy scalar TYPE for weak-typed scalar leaves — is replaced by a
-# small process-global int everywhere the hot loop touches it (window
-# leaf_sigs, node sigs, aval-cache keys, flush cache keys). Hashing int
-# tuples is several times cheaper than hashing nested dtype tuples, and
-# this runs per op.
-#
-# The table is CAPPED (MXNET_SIG_INTERN_CAP; graphlint GL006): ids index
-# into _SIG_LIST, so entries can never be evicted without invalidating
-# every cache key built from them. Instead, once the cap is hit, _sig_id
+# Signature interning and abstract evaluation moved to mxnet_tpu.ir.graph
+# (the ONE shared interner every capture's key assembly uses — bulk
+# window, tape wiring, symbol lowering). Hot-loop aliases: the objects
+# below ARE ir.graph's (same dict/list/function identity), so the per-op
+# fast path pays one module-global load exactly as before. The table is
+# CAPPED (MXNET_SIG_INTERN_CAP; graphlint GL006): once full, _sig_id
 # returns None for NEW signatures and the lazy path falls back to eager
-# dispatch for ops touching them — steady-state workloads (a bounded
-# signature set) never notice; adversarial shape churn degrades gracefully
-# instead of growing host memory without bound.
-_SIG_IDS = {}
-_SIG_LIST = []
-_SIG_INTERN_CAP = _env_cap("MXNET_SIG_INTERN_CAP", 65536)
-
-
-def _sig_id(sig):
-    i = _SIG_IDS.get(sig)
-    if i is None:
-        if len(_SIG_IDS) >= _SIG_INTERN_CAP:
-            return None  # table full — caller bails to eager dispatch
-        i = _SIG_IDS[sig] = len(_SIG_LIST)
-        _SIG_LIST.append(sig)
-    return i
-
+# dispatch — see ir/graph.py for the full policy.
+_SIG_IDS = _irgraph._SIG_IDS
+_SIG_LIST = _irgraph._SIG_LIST
+_SIG_INTERN_CAP = _irgraph._SIG_INTERN_CAP
+_sig_id = _irgraph._sig_id
 
 # (op, static-attrs key, input sig-ids) -> (output ShapeDtypeStruct, its
-# sig-id), or None when the combo is not lazily executable (multi-output
-# result — e.g. split/topk whose arity depends on kwargs — or eval_shape
-# raised). One abstract evaluation per distinct combo while cached; the
-# hot loop pays a dict probe. Capped (MXNET_AVAL_CACHE_CAP, insertion-order
-# eviction — graphlint GL006): static-attr diversity is unbounded, a miss
-# only re-runs eval_shape.
-_AVAL_CACHE = _BoundedCache(_env_cap("MXNET_AVAL_CACHE_CAP", 65536))
-_AVAL_MISS = object()
-
-
-def _infer_aval(opdef, kwargs, in_sig_ids):
-    """Abstract-evaluate one op from input signatures alone (a
-    representative value stands in for scalar leaves: only the type can
-    affect promotion, never the value). Returns the cache entry."""
-    try:
-        sigs = [_SIG_LIST[i] for i in in_sig_ids]
-        ins = [jax.ShapeDtypeStruct(s[1], s[0]) if type(s) is tuple else s(1)
-               for s in sigs]
-        fn = (functools.partial(opdef.fn, **kwargs) if kwargs else opdef.fn)
-        av = jax.eval_shape(fn, *ins)
-    except Exception:
-        return None  # let the eager path raise the real, well-located error
-    if not isinstance(av, jax.ShapeDtypeStruct):
-        return None
-    sid = _sig_id((av.dtype, tuple(av.shape)))
-    if sid is None:  # intern table at cap: mark combo non-lazy
-        return None
-    return (av, sid)
+# sig-id) — the shared abstract-evaluation cache (MXNET_AVAL_CACHE_CAP),
+# also aliased from ir.graph.
+_AVAL_CACHE = _irgraph._AVAL_CACHE
+_AVAL_MISS = _irgraph._AVAL_MISS
+_infer_aval = _irgraph._infer_aval
 
 
 def _flush_window():
     """Execute the current thread's pending lazy window as ONE composed,
     jitted, cache-keyed XLA dispatch and bind results to the live output
-    NDArrays. The cache key is (op-chain topology + static attrs, leaf
-    input signatures, live-output set), so a steady-state epoch re-running
-    an identical chain reuses the compiled executable with zero retrace."""
+    NDArrays. The window-structural key (op-chain topology + static attrs,
+    leaf input signatures, live-output set) fronts a memo whose miss path
+    builds the typed ``mxnet_tpu.ir`` graph and lowers it through the
+    canonical IR cache — so a steady-state epoch re-running an identical
+    chain reuses the compiled executable at hash-and-lookup cost with zero
+    retrace, and identical math captured by the tape or a Symbol shares
+    the SAME compiled program (ir.lower's content-addressed key)."""
     w = _engine._window()
     nodes = w.nodes
     if not nodes:
@@ -550,26 +516,23 @@ def _flush_window():
         nd_out._lazy = None
         return
 
-    def builder():
-        steps = [(n.fn, n.static, n.specs) for n in nodes]
-        out_idx = key[2]
-
-        def run(*leaf_vals):
-            env = []
-            for fn, static, specs in steps:
-                vals = [env[s] if s >= 0 else leaf_vals[~s] for s in specs]
-                env.append(fn(*vals, **static) if static else fn(*vals))
-            return tuple(env[i] for i in out_idx)
-
-        return run
-
-    prog = bulk_jitted(key, builder)
+    ent = _BULK_CACHE.get(key)
+    if ent is None:
+        # front-memo miss: convert the window to the typed IR graph and
+        # lower through the canonical cache (ir.lower bumps
+        # engine.bulk_compile_counter only when a program actually
+        # compiles — a canonical hit from another capture bumps nothing)
+        g = _irgraph.from_window(nodes, key[0], key[1], key[2])
+        ent = _BULK_CACHE[key] = _irlower.lower_forward(g, "bulk",
+                                                        hint="bulk")
+    prog, sel = ent
     dispatch_counter.count += 1
+    args = [leaves[i] for i in sel]
     if _prof_on:
         with _profiler_mod.bulk_scope([n.op for n in nodes]):
-            results = prog(*leaves)
+            results = prog(*args)
     else:
-        results = prog(*leaves)
+        results = prog(*args)
     for (_, nd_out), val in zip(outs, results):
         nd_out._buf = val
         nd_out._lazy = None

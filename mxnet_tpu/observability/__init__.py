@@ -93,6 +93,7 @@ def _collect_engine():
         "bulk_compile": engine.bulk_compile_counter.count,
         "tape_compile": engine.tape_compile_counter.count,
         "tape_cache_hit": engine.tape_cache_hit_counter.count,
+        "symbol_compile": engine.symbol_compile_counter.count,
         "serve_compile": engine.serve_compile_counter.count,
         "decode_compile": engine.decode_compile_counter.count,
         "comp_cache_hit": engine.comp_cache_hit_counter.count,
@@ -102,20 +103,27 @@ def _collect_engine():
 
 
 def _collect_caches():
-    from .. import base, ndarray
+    from .. import base
     from ..autograd import tape_compile_enabled
+    from ..ir import graph as irgraph
 
     return {
-        "jit": {"entries": len(base._JIT_CACHE), "cap": base._JIT_CACHE.cap},
+        "jit": {"entries": len(base._JIT_CACHE), "cap": base._JIT_CACHE.cap,
+                "evictions": base._JIT_CACHE.evictions},
         "bulk": {"entries": len(base._BULK_CACHE),
-                 "cap": base._BULK_CACHE.cap},
+                 "cap": base._BULK_CACHE.cap,
+                 "evictions": base._BULK_CACHE.evictions},
         "tape": {"entries": len(base._TAPE_CACHE),
                  "cap": base._TAPE_CACHE.cap,
+                 "evictions": base._TAPE_CACHE.evictions,
                  "compile_enabled": tape_compile_enabled()},
-        "aval": {"entries": len(ndarray._AVAL_CACHE),
-                 "cap": ndarray._AVAL_CACHE.cap},
-        "sig_intern": {"entries": len(ndarray._SIG_IDS),
-                       "cap": ndarray._SIG_INTERN_CAP},
+        "ir": {"entries": len(base._IR_CACHE), "cap": base._IR_CACHE.cap,
+               "evictions": base._IR_CACHE.evictions},
+        "aval": {"entries": len(irgraph._AVAL_CACHE),
+                 "cap": irgraph._AVAL_CACHE.cap,
+                 "evictions": irgraph._AVAL_CACHE.evictions},
+        "sig_intern": {"entries": len(irgraph._SIG_IDS),
+                       "cap": irgraph._SIG_INTERN_CAP},
     }
 
 
@@ -147,12 +155,23 @@ def _collect_ops():
     return {"enabled": op_telemetry_enabled(), "dispatches": dict(_op_counts)}
 
 
+def _collect_ir():
+    # unified graph IR (mxnet_tpu.ir): canonical-cache occupancy +
+    # evictions, the shared signature interner, build tallies, and the
+    # per-pass node/edge delta counters — tools/diagnose.py's "Graph IR"
+    # section renders this dict
+    from ..ir import lower as irlower
+
+    return irlower.stats()
+
+
 registry.register_collector("engine", _collect_engine)
 registry.register_collector("caches", _collect_caches)
 registry.register_collector("comp_cache", _collect_comp_cache)
 registry.register_collector("serve", _collect_serve)
 registry.register_collector("profiler", _collect_profiler)
 registry.register_collector("ops", _collect_ops)
+registry.register_collector("ir", _collect_ir)
 registry.register_collector("watchdog", watchdog.snapshot)
 registry.register_collector(
     "tracing", lambda: {"enabled": tracing_enabled()})

@@ -83,7 +83,8 @@ def _compile_counters():
     from .. import engine
 
     return (engine.bulk_compile_counter, engine.tape_compile_counter,
-            engine.serve_compile_counter, engine.decode_compile_counter)
+            engine.symbol_compile_counter, engine.serve_compile_counter,
+            engine.decode_compile_counter)
 
 
 def arm():

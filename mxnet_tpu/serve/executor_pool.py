@@ -357,13 +357,22 @@ def symbol_infer_fn(outputs, input_names, param_names=None):
     (mode='always' dropout etc.) — those need fresh noise per call and must
     stay on the per-call evaluation path.
     """
-    from ..symbol import Group, _graph_has_rng, _with_training
+    from ..symbol import (Group, _graph_has_rng, _ir_infer_runner,
+                          _with_training)
 
     combined = outputs[0] if len(outputs) == 1 else Group(list(outputs))
     ev = _with_training(combined, False)
     if _graph_has_rng(ev):
         return None, None
-    inner, names = ev._build_fn()
+    # prefer the unified-IR runner: the pass-optimized graph (CSE/fold/
+    # cast-sink/DCE, mxnet_tpu.ir) compiles per bucket instead of the raw
+    # per-call evaluation walk; graphs the IR can't represent (control
+    # flow, multi-output ops) keep the legacy _build_fn closure
+    ir_runner = _ir_infer_runner(ev)
+    if ir_runner is not None:
+        inner, names = ir_runner
+    else:
+        inner, names = ev._build_fn()
     input_names = list(input_names)
     if param_names is None:
         param_names = [n for n in names if n not in input_names]

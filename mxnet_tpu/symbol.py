@@ -198,6 +198,16 @@ class Symbol:
         return fn, names
 
     def eval(self, ctx=None, **kwargs):
+        # deterministic registry-op graphs lower through the unified
+        # typed IR (mxnet_tpu.ir): canonical content-addressed key, so
+        # two Symbols with identical math — or the same math captured by
+        # the bulk window or the autograd tape — share ONE compiled
+        # program; the rewrite-pass pipeline (CSE/fold/cast-sink/DCE)
+        # runs once per canonical graph before jit
+        out = _ir_symbol_eval(self, kwargs)
+        if out is not None:
+            return out
+        # fallback (stochastic / control-flow / multi-output graphs):
         # per-symbol jit cache (graphlint GL002): _build_fn returns a FRESH
         # closure, so jitting it per call would retrace + recompile every
         # eval; the graph is fixed at construction, so one jitted callable
@@ -573,6 +583,159 @@ def _eval_symbols(outputs, feed):
     return outs
 
 
+
+
+# ----------------------------------------------------- unified IR lowering
+#
+# Deterministic Symbol graphs convert into mxnet_tpu.ir's typed canonical
+# form and lower through its shared content-addressed cache — the third
+# capture collapsing into the one-key scheme (the other two are the bulk
+# window and the autograd tape). Graphs the IR cannot represent (rng
+# draws, control flow, multi-output ops, host closures) keep their legacy
+# evaluation paths; conversion failure is memoized per symbol so the probe
+# costs once.
+
+
+def _ir_skeleton_of(root):
+    """Memoized IR skeleton of this symbol's graph (False = the graph is
+    not IR-representable)."""
+    sk = root.__dict__.get("_ir_skel")
+    if sk is None:
+        from . import ir as _ir
+
+        roots = root._inputs if root._op == "_group" else [root]
+        try:
+            sk = _ir.symbol_skeleton(roots)
+        except _ir.UnsupportedGraph:
+            sk = False
+        root._ir_skel = sk
+    return sk
+
+
+def _ir_symbol_eval(sym, kwargs):
+    """Symbol.eval through the unified IR, or None to use the legacy
+    path. One compiled program per canonical (graph, signatures) —
+    shared across symbols and captures; engine.symbol_compile_counter
+    bumps only on a real build."""
+    sk = _ir_skeleton_of(sym)
+    if sk is False:
+        return None
+    from . import ir as _ir
+    from .base import BoundedCache
+    from .ir.graph import _sig_id
+
+    _steps, leaf_names, _out_specs = sk
+    vals = []
+    for n in leaf_names:
+        if n not in kwargs:
+            raise KeyError("unbound variable %s" % n)
+        v = kwargs[n]
+        vals.append(v._data if isinstance(v, NDArray) else jnp.asarray(v))
+    sigids = []
+    for v in vals:
+        sid = _sig_id((v.dtype, tuple(v.shape)))
+        if sid is None:
+            return None  # interner at cap: legacy path still works
+        sigids.append(sid)
+    memo = sym.__dict__.get("_ir_execs")
+    if memo is None:
+        memo = sym._ir_execs = BoundedCache(32)
+    mk = tuple(sigids)
+    ent = memo.get(mk)
+    if ent is None:
+        try:
+            g = _ir.from_symbol(sk, sigids)
+        except _ir.UnsupportedGraph:
+            memo[mk] = False  # these signatures can't lower; legacy path
+            return None
+        ent = memo[mk] = _ir.lower_forward(g, "symbol", hint="symbol.eval")
+    if ent is False:
+        return None
+    prog, sel = ent
+    out = prog(*[vals[i] for i in sel])
+    return [NDArray(o) for o in out]
+
+
+def _ir_executor_callable(s, names):
+    """Per-signature dispatching callable over the IR-lowered graph for
+    symbol.Executor, or None when the graph is unsupported. Falls back
+    to a directly-jitted ``_build_fn`` INSIDE the callable for
+    signatures the IR rejects, so shape errors surface from the same
+    place they always did."""
+    sk = _ir_skeleton_of(s)
+    if sk is False:
+        return None
+    _steps, leaf_names, out_specs = sk
+    name_idx = {n: i for i, n in enumerate(names)}
+    pos = []
+    for n in leaf_names:
+        i = name_idx.get(n)
+        if i is None:
+            return None
+        pos.append(i)
+    from . import ir as _ir
+    from .base import BoundedCache
+    from .ir.graph import _sig_id
+
+    memo = BoundedCache(32)
+    is_group = s._op == "_group"
+    fallback = []
+
+    def _legacy(*vals):
+        if not fallback:
+            fn, fnames = s._build_fn()
+            fallback.append(_jit_backed(fn, tier="jit", hint="executor"))
+        return fallback[0](*vals)
+
+    def call(*vals):
+        lv = [vals[i] for i in pos]
+        sigids = []
+        for v in lv:
+            sid = _sig_id((v.dtype, tuple(v.shape)))
+            if sid is None:
+                return _legacy(*vals)
+            sigids.append(sid)
+        mk = tuple(sigids)
+        ent = memo.get(mk)
+        if ent is None:
+            try:
+                g = _ir.from_symbol(sk, sigids)
+            except _ir.UnsupportedGraph:
+                memo[mk] = False
+                return _legacy(*vals)
+            ent = memo[mk] = _ir.lower_forward(g, "symbol",
+                                               hint="executor")
+        if ent is False:
+            return _legacy(*vals)
+        prog, sel = ent
+        out = prog(*[lv[i] for i in sel])
+        return list(out) if is_group else out[0]
+
+    return call
+
+
+def _ir_infer_runner(root):
+    """(runner, leaf names) executing the pass-optimized STRUCTURAL IR
+    graph of a deterministic symbol DAG, or None when unsupported —
+    serve's ``symbol_infer_fn`` jits the runner through its own AotFn
+    path, so symbolic serving graphs get whole-graph CSE/fold/DCE before
+    each bucket compiles."""
+    sk = _ir_skeleton_of(root)
+    if sk is False:
+        return None
+    from . import ir as _ir
+
+    _steps, leaf_names, out_specs = sk
+    g = _ir.from_symbol(sk, None)
+    final, leaf_sel, _slot_fwd = _ir.passes.optimize(g)
+    run = _ir.build_runner(final)
+    is_group = root._op == "_group"
+
+    def inner(*vals):
+        out = run([vals[i] for i in leaf_sel])
+        return list(out) if is_group else out[0]
+
+    return inner, list(leaf_names)
 
 
 def _substitute(outputs, mapping):
@@ -1065,6 +1228,13 @@ class Executor:
             # program (that would replay identical noise every forward):
             # stochastic graphs thread the key as a jit ARGUMENT.
             keyed = _graph_has_rng(s)
+            if not keyed:
+                # deterministic mode variant: lower through the unified
+                # typed IR — canonical key, shared pass-optimized program
+                irfn = _ir_executor_callable(s, self._names)
+                if irfn is not None:
+                    ent = self._modes[bool(is_train)] = (irfn, False)
+                    return ent
             fn, names = s._build_fn(thread_key=keyed)
             assert names == self._names
             ent = (_jit_backed(fn, tier="jit", hint="executor"), keyed)

@@ -1,0 +1,34 @@
+"""GL010 negative: graph work expressed THROUGH the unified IR, plus
+innocent classes and keys that must not fire — a dataclass without op
+wiring, a single-component key, and a non-key tuple assembly."""
+
+
+class RequestState:
+    """Carries op-unrelated state: no wiring fields."""
+
+    __slots__ = ("op_count", "deadline", "payload")
+
+
+class Span:
+    def __init__(self, name, children):
+        self.name = name
+        self.children = list(children)
+
+
+def lower_through_ir(window_nodes, key_parts, leaf_sigs, outs):
+    # the blessed route: convert the capture into the typed IR and let
+    # its content-addressed canonical key identify the program
+    from mxnet_tpu import ir
+
+    g = ir.from_window(window_nodes, key_parts, leaf_sigs, outs)
+    return ir.lower_forward(g, "bulk")
+
+
+def single_component_key(static_kwargs):
+    key = (static_kwargs, None)  # one plain tuple: not a key assembly
+    return key
+
+
+def not_a_key(parts, sigs):
+    bundle = (tuple(parts), tuple(sigs))  # not bound to a *key* name
+    return bundle

@@ -1,0 +1,27 @@
+"""GL010 positive: ad-hoc structural graph machinery outside
+mxnet_tpu/ir — a parallel graph-node class (op field + input wiring) and
+a hand-rolled multi-component program-cache key. Both re-open the
+three-captures problem the unified typed IR closed."""
+
+
+def _freeze(v):
+    return v
+
+
+class MyGraphNode:  # expect: GL010
+    """A fourth parallel node type: op + specs wiring in __slots__."""
+
+    __slots__ = ("op", "fn", "specs", "static")
+
+
+class RecordedStep:  # expect: GL010
+    """Same hazard via __init__ attribute assignment."""
+
+    def __init__(self, op, inputs):
+        self.op = op
+        self.inputs = list(inputs)
+
+
+def build_program(window, sigs, outs):
+    key = (tuple(window), tuple(sigs), tuple(outs))  # expect: GL010
+    return key

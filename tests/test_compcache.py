@@ -66,6 +66,7 @@ def _clear_inproc_jit_caches():
     base._JIT_CACHE.clear()
     base._BULK_CACHE.clear()
     base._TAPE_CACHE.clear()
+    base._IR_CACHE.clear()  # canonical IR programs (mxnet_tpu.ir.lower)
     ndm._FAST_JIT.clear()
 
 

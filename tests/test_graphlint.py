@@ -96,10 +96,11 @@ def test_repo_self_lint_is_ci_clean():
 def test_allowlist_is_small_and_justified():
     with open(ALLOWLIST) as fh:
         entries = json.load(fh)
-    # 9 of these are the engine proof-hook counters GL009 deliberately
-    # keeps visible (each carries a why explaining the in-trace / hot-path
-    # constraint that keeps it out of the registry)
-    assert len(entries) <= 24, "allowlist grew to %d entries" % len(entries)
+    # 10 of these are the engine proof-hook counters GL009 deliberately
+    # keeps visible, and 5 are the GL010 legacy capture shims (LazyExpr/
+    # TapeNode/Symbol + the two front-memo keys over the IR canonical
+    # key) — each carries a why naming the constraint
+    assert len(entries) <= 30, "allowlist grew to %d entries" % len(entries)
     for e in entries:
         assert e.get("why", "").strip(), "entry %r lacks a why" % e.get("id")
 
@@ -264,11 +265,15 @@ def test_aval_and_program_caches_are_bounded():
 
 def test_sig_intern_cap_falls_back_to_eager(monkeypatch):
     """At the intern cap, NEW signatures bail to eager dispatch — results
-    stay correct and the table stops growing (graphlint GL006)."""
+    stay correct and the table stops growing (graphlint GL006). The
+    interner lives in ir.graph now (the single shared table every
+    capture's key assembly uses); ndarray aliases it for its hot loop."""
     from mxnet_tpu import ndarray as ndmod
+    from mxnet_tpu.ir import graph as irgraph
 
+    assert ndmod._SIG_IDS is irgraph._SIG_IDS  # one shared interner
     a = nd.array(np.random.randn(17, 23).astype(np.float32))
-    monkeypatch.setattr(ndmod, "_SIG_INTERN_CAP", len(ndmod._SIG_IDS))
+    monkeypatch.setattr(irgraph, "_SIG_INTERN_CAP", len(irgraph._SIG_IDS))
     before = len(ndmod._SIG_IDS)
     out = (a * 2.0 + 1.0).asnumpy()
     np.testing.assert_allclose(out, np.asarray(a.asnumpy()) * 2.0 + 1.0,

@@ -120,6 +120,34 @@ def main():
               "(deserializes include serve-snapshot preloads)"
               % (cc["hits"], cc["misses"], cc["deserializes"]))
 
+    print("----------Graph IR----------")
+    # the unified typed graph IR (mxnet_tpu.ir): all three captures — bulk
+    # window, autograd tape, Symbol executors — lower through ONE canonical
+    # program cache after the rewrite-pass pipeline. Attach when reporting
+    # "same math compiles twice" or pass-pipeline regressions.
+    ir = snap["ir"]
+    eng_ir = snap["engine"]
+    print("canonical    : %d entrie(s) / cap %d, %d compiled program(s), "
+          "%d eviction(s) (MXNET_IR_CACHE_CAP)"
+          % (ir["cache"]["entries"], ir["cache"]["cap"],
+             ir["cache"]["programs"], ir["cache"]["evictions"]))
+    print("compiles     : bulk=%d tape=%d symbol=%d (per-capture program "
+          "builds; identical math across captures compiles once)"
+          % (eng_ir["bulk_compile"], eng_ir["tape_compile"],
+             eng_ir["symbol_compile"]))
+    print("interner     : %d signature(s) / cap %d (shared by every "
+          "capture's key assembly)"
+          % (ir["interner"]["entries"], ir["interner"]["cap"]))
+    passes = ir["passes"]
+    print("passes       : " + "  ".join(
+        "%s[-%dn/-%de]" % (name, st["nodes_removed"], st["edges_removed"])
+        for name, st in sorted(passes.items())))
+    if ir["builds"]["last_build"]:
+        lb = ir["builds"]["last_build"]
+        print("last build   : %s… %d captured → %d canonical → %d final "
+              "node(s)" % (lb["key"], lb["nodes_captured"],
+                           lb["nodes_canonical"], lb["nodes_final"]))
+
     print("----------Serving----------")
     # mxnet_tpu.serve state: the executor-pool compile counter (a nonzero
     # steady-state delta here means bucket programs are retracing — attach

@@ -708,7 +708,7 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     mode = args[0] if args else "bert"
     if mode in ("optstep", "imperative", "autograd", "serve", "decode",
-                "coldstart", "ir"):
+                "coldstart", "ir", "dist"):
         # host-dispatch microbenches (fused multi-tensor optimizer step;
         # lazy bulk imperative chain vs eager; compiled tape replay vs the
         # eager backward walk; dynamic-batched serving vs per-request
@@ -726,7 +726,10 @@ def main():
                 "coldstart": "serve_bench.py",
                 # unified graph IR: CSE/DCE node shrink + host-loop time
                 # on a repeated-subexpression chain (mxnet_tpu.ir)
-                "ir": "ir_bench.py"}[mode]
+                "ir": "ir_bench.py",
+                # overlapped bucketed hierarchical gradient exchange vs
+                # the serialized flat baseline (mxnet_tpu.dist)
+                "dist": "dist_bench.py"}[mode]
         spec = importlib.util.spec_from_file_location(
             tool[:-3], os.path.join(_REPO, "tools", tool))
         m = importlib.util.module_from_spec(spec)
@@ -738,7 +741,8 @@ def main():
             argv += ["--mode", mode]
         if iters := next((f.split("=", 1)[1] for f in flags
                           if f.startswith("--iters=")), None):
-            argv += ["--iters", iters]
+            # dist_bench counts training steps, not timing iterations
+            argv += ["--steps" if mode == "dist" else "--iters", iters]
         raise SystemExit(m.main(argv))
     if mode != "all" and mode not in MODES:
         # validate BEFORE the probe/replay machinery: a typo must abort

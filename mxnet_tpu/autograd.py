@@ -293,6 +293,11 @@ def _const_fill(one, shape, dtype):
 # NDArray.__eq__, which is elementwise).
 _SHARED_GRADS = {}
 
+# mxnet_tpu.dist overlap hook: set by dist.attach() to a callable taking the
+# backward's target list; invoked right after grad writeback so bucketed
+# reductions dispatch behind the (still-executing) backward program.
+_GRAD_EXCHANGER = None
+
 
 def mark_grad_shared(arr):
     """Record that ``arr``'s buffer aliases external storage (kvstore pull,
@@ -706,6 +711,10 @@ def _compiled_backward(heads, head_grads, tape):
         if h._lazy is not None:
             h._buf = out[ng + j]
             h._lazy = None
+    if _GRAD_EXCHANGER is not None:
+        # mxnet_tpu.dist: launch bucketed gradient reductions NOW, while the
+        # backward program may still be executing — the overlap window
+        _GRAD_EXCHANGER(targets)
     return True
 
 

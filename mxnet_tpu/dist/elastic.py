@@ -1,0 +1,145 @@
+"""Elastic multi-host training: real recovery drills over a shrinking mesh.
+
+TPU slices are gang-scheduled — a chip loss kills the slice — so elastic
+training is not "keep running minus one worker" (the ps-lite model) but
+"the survivor set re-forms a smaller mesh and rejoins from the sharded
+checkpoint". :class:`ElasticTrainer` drives exactly that loop, and its
+drill mode proves it: a :class:`~mxnet_tpu.parallel.resilience.
+SimulatedFailure` kills a replica mid-epoch, the survivors re-mesh,
+training resumes from the last ``ResumableLoop`` checkpoint, and the
+post-recovery loss trajectory must match an uninterrupted run (the batch
+schedule is a pure function of the global step, so the math is identical;
+only the reduction layout changed).
+
+Every recovery is recorded in the observability registry
+(``dist_elastic_recoveries``) and the bounded event list the ``dist``
+collector snapshots — the same proof-hook discipline as the compile
+counters.
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+
+from ..parallel.mesh import make_mesh
+from ..parallel.resilience import ResumableLoop, SimulatedFailure
+from .. import checkpoint as ckpt
+
+
+# bounded ring of recovery events, snapshotted by the "dist" collector
+_EVENT_CAP = 64
+events = []
+
+
+def _record_event(evt):
+    if len(events) >= _EVENT_CAP:
+        del events[0]
+    events.append(evt)
+    from ..observability import registry
+
+    registry.counter("dist_elastic_recoveries",
+                     "mesh re-formations after a replica loss").inc()
+
+
+class ElasticRun:
+    """Result of one elastic run: final state, per-step losses, and the
+    recovery history."""
+
+    __slots__ = ("state", "losses", "recoveries", "mesh", "start_step")
+
+    def __init__(self, state, losses, recoveries, mesh, start_step):
+        self.state = state
+        self.losses = losses
+        self.recoveries = recoveries
+        self.mesh = mesh
+        self.start_step = start_step
+
+
+class ElasticTrainer:
+    """Checkpointed training driver that survives replica loss by
+    re-forming the mesh from the survivor set.
+
+    build_step(mesh) -> (step_fn, place_state):
+        ``step_fn(state, batch) -> (state, loss)`` — the compiled train
+        step for THAT mesh; ``place_state(state, mesh) -> state`` re-lays
+        a (restored or initial) state onto the mesh's devices. Rebuilding
+        per mesh is the point: after a loss the survivor mesh is smaller
+        and every sharding in the program changes.
+    make_batch(step):
+        deterministic in the GLOBAL step and independent of the mesh —
+        the replay contract that makes interrupted+resumed == uninterrupted
+        (same as ``run_resilient``).
+    """
+
+    def __init__(self, build_step, init_state, make_batch, directory,
+                 save_every=5, heartbeat=None, axis="dp"):
+        self.build_step = build_step
+        self.init_state = init_state
+        self.make_batch = make_batch
+        self.directory = directory
+        self.save_every = int(save_every)
+        self.heartbeat = heartbeat
+        self.axis = axis
+        self.recoveries = []
+
+    def _mesh(self, devices):
+        return make_mesh({self.axis: len(devices)}, devices=devices)
+
+    def _restore_or_init(self, loop, mesh, place):
+        last = loop.latest()
+        if last is not None:
+            state = loop.restore(like=self.init_state)
+            return place(state, mesh), last
+        return place(self.init_state, mesh), 0
+
+    def run(self, num_steps, devices=None, fail_at=None, survivors=None):
+        """Train ``num_steps`` steps. ``fail_at`` arms the drill: a
+        SimulatedFailure fires before that step, the device set shrinks to
+        ``survivors`` (default: the first half), and training rejoins from
+        the latest sharded checkpoint on the re-formed mesh."""
+        devices = list(devices if devices is not None else jax.devices())
+        loop = ResumableLoop(self.directory, self.save_every)
+        mesh = self._mesh(devices)
+        step_fn, place = self.build_step(mesh)
+        state, start = self._restore_or_init(loop, mesh, place)
+        first_start = start
+        losses = {}
+        hb = self.heartbeat.start() if self.heartbeat is not None else None
+        armed = fail_at
+        try:
+            step = start
+            while step < num_steps:
+                try:
+                    if armed is not None and step == armed:
+                        armed = None   # one failure per drill
+                        raise SimulatedFailure(step)
+                    state, loss = step_fn(state, self.make_batch(step))
+                    losses[step] = float(loss)
+                    step += 1
+                    if step % self.save_every == 0 or step == num_steps:
+                        ckpt.save_sharded(self.directory, state, step)
+                        loop.note_save()
+                except SimulatedFailure as e:
+                    # the drill: replica lost mid-epoch. Survivors re-form
+                    # the mesh, restore the sharded checkpoint, rebuild the
+                    # compiled step for the new topology, rewind to the
+                    # checkpointed step and keep going.
+                    devices = list(survivors) if survivors is not None \
+                        else devices[:max(1, len(devices) // 2)]
+                    mesh = self._mesh(devices)
+                    step_fn, place = self.build_step(mesh)
+                    state, resumed = self._restore_or_init(loop, mesh, place)
+                    step = resumed
+                    evt = {"event": "elastic_recovery",
+                           "failed_step": e.step,
+                           "survivors": len(devices),
+                           "resumed_from": resumed,
+                           "ts": time.time()}
+                    self.recoveries.append(evt)
+                    _record_event(evt)
+        finally:
+            if hb is not None:
+                hb.stop()
+        return ElasticRun(state, losses, list(self.recoveries), mesh,
+                          first_start)

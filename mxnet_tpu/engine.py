@@ -115,6 +115,18 @@ comp_cache_hit_counter = DispatchCounter("comp_cache_hit")
 comp_cache_miss_counter = DispatchCounter("comp_cache_miss")
 comp_cache_deserialize_counter = DispatchCounter("comp_cache_deserialize")
 
+# distributed gradient exchange (mxnet_tpu.dist): dist_bucket_counter bumps
+# once per bucket-reduction DISPATCH (the overlapped launches the bucketer
+# issues while the compiled backward is still executing — the comm/compute
+# overlap proof hook tools/dist_bench.py pins); dist_compile_counter bumps
+# once per bucket-program BUILD, INSIDE the traced body, so it fires exactly
+# when jax re-traces. Deterministic bucket layouts mean a steady-state train
+# loop must never bump the compile counter — the zero-retrace assertion
+# tests/test_dist.py makes with the watchdog armed, same discipline as
+# serve_compile_counter/decode_compile_counter.
+dist_bucket_counter = DispatchCounter("dist_bucket")
+dist_compile_counter = DispatchCounter("dist_compile")
+
 
 try:
     _bulk_size = int(os.environ.get("MXNET_ENGINE_BULK_SIZE", "15"))

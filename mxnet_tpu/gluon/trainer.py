@@ -46,6 +46,7 @@ class Trainer:
             not in ("0", "false", "no")
         self._wu_mesh = None
         self._wu_axis = "dp"
+        self._dist = None  # DistHandle installed by mxnet_tpu.dist.attach
         self._kvstore = None
         if isinstance(kvstore, str) and kvstore not in ("device", "local", None):
             from ..kvstore import create as kv_create
@@ -102,7 +103,14 @@ class Trainer:
         arrays, so they are marked shared (autograd.mark_grad_shared) —
         the compiled tape backward must not donate a buffer the store
         still owns; the next backward rebinds them to program-owned
-        storage and re-marks them private."""
+        storage and re-marks them private.
+
+        With ``mxnet_tpu.dist.attach`` installed this is a thin shim:
+        bucketed reductions already dispatched under the backward (the
+        overlap window); only the straggler sweep remains."""
+        if self._dist is not None:
+            self._dist.finish()
+            return
         if self._kvstore is not None:
             from .. import autograd as _autograd
 
@@ -165,9 +173,15 @@ class Trainer:
             # to the per-param path
             new_states = self._optimizer.fused_update(
                 fused_w, fused_g, fused_s, indices=fused_i,
-                mesh=self._wu_mesh, shard_axis=self._wu_axis)
+                mesh=self._wu_mesh, shard_axis=self._wu_axis,
+                keep_sharded=(self._dist is not None
+                              and self._dist.zero >= 3))
             for i, s in zip(fused_i, new_states):
                 self._states[i] = s
+        if self._dist is not None:
+            # mesh-updated weights come home for the next eager forward
+            # (ZeRO-3 keeps them sharded; gather_params re-homes on demand)
+            self._dist._rehome()
 
     def zero_grad(self):
         for p in self._params:

@@ -11,6 +11,10 @@ TPU-native mapping:
   surface for code ported from the reference.
 - 'dist_sync': when jax.distributed is initialized (multi-host), push/pull
   wraps a psum over all hosts' devices; otherwise degenerates to local.
+- 'dist_async': deliberately absent (see create()); its latency-hiding role
+  belongs to mxnet_tpu.dist — overlapped synchronous bucketed collectives
+  (GradientBucketer + HierarchicalAllreduce), which also reuse this module's
+  dist_sync path as the cross-host DCN leg (dcn='kvstore').
 """
 from __future__ import annotations
 
@@ -327,14 +331,18 @@ def create(name="local"):
         # (src/kvstore/kvstore_dist.h) applies server-side updates with no
         # worker barrier — stale-gradient semantics that fight the SPMD
         # execution model XLA compiles to on TPU pods (every collective is a
-        # program-ordered barrier by construction). The TPU-native equivalent
-        # of "hide communication latency" is overlapped synchronous
-        # collectives (see parallel/), not asynchrony. SURVEY.md row 23
-        # records this as a justified N/A.
+        # program-ordered barrier by construction). What dist_async buys —
+        # hiding communication latency behind compute — mxnet_tpu.dist
+        # delivers synchronously: GradientBucketer dispatches size-capped
+        # bucket reductions while the compiled backward is still executing,
+        # and HierarchicalAllreduce keeps the slow DCN hop to 1/ici_size of
+        # the payload. SURVEY.md row 23 records this as a justified N/A.
         raise ValueError(
             "kvstore %r: asynchronous push semantics are not supported on "
             "the TPU backend; use 'dist_sync' / 'dist_device_sync' "
-            "(synchronous allreduce over ICI/DCN)" % name)
+            "(synchronous allreduce over ICI/DCN), or mxnet_tpu.dist.attach "
+            "for overlapped bucketed gradient exchange (the latency-hiding "
+            "dist_async was for)" % name)
     if name.startswith("dist"):
         return DistKVStore(name)
     raise ValueError("unknown kvstore type %r" % name)

@@ -99,6 +99,8 @@ def _collect_engine():
         "comp_cache_hit": engine.comp_cache_hit_counter.count,
         "comp_cache_miss": engine.comp_cache_miss_counter.count,
         "comp_cache_deserialize": engine.comp_cache_deserialize_counter.count,
+        "dist_bucket": engine.dist_bucket_counter.count,
+        "dist_compile": engine.dist_compile_counter.count,
     }
 
 
@@ -165,7 +167,40 @@ def _collect_ir():
     return irlower.stats()
 
 
+def _collect_dist():
+    # distributed gradient exchange (mxnet_tpu.dist) + resilience events.
+    # The registry counters are get-or-create so the section is complete
+    # (zeros) even before the first stall/save/restore; the subsystem
+    # stats only appear once mxnet_tpu.dist has actually been imported —
+    # a collector must never force-load the package it observes.
+    import sys
+
+    from .. import engine
+
+    out = {
+        "bucket_dispatches": engine.dist_bucket_counter.count,
+        "bucket_compiles": engine.dist_compile_counter.count,
+        "heartbeat_stalls": registry.counter(
+            "dist_heartbeat_stalls",
+            "device round-trips exceeding the heartbeat timeout").value,
+        "checkpoint_saves": registry.counter(
+            "dist_checkpoint_saves", "sharded checkpoint writes").value,
+        "checkpoint_restores": registry.counter(
+            "dist_checkpoint_restores", "sharded checkpoint restores").value,
+        "elastic_recoveries": registry.counter(
+            "dist_elastic_recoveries",
+            "mesh re-formations after a replica loss").value,
+    }
+    d = sys.modules.get("mxnet_tpu.dist")
+    if d is not None:
+        out.update(d.stats())
+    else:
+        out["subsystem"] = "not loaded"
+    return out
+
+
 registry.register_collector("engine", _collect_engine)
+registry.register_collector("dist", _collect_dist)
 registry.register_collector("caches", _collect_caches)
 registry.register_collector("comp_cache", _collect_comp_cache)
 registry.register_collector("serve", _collect_serve)

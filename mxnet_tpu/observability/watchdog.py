@@ -84,7 +84,7 @@ def _compile_counters():
 
     return (engine.bulk_compile_counter, engine.tape_compile_counter,
             engine.symbol_compile_counter, engine.serve_compile_counter,
-            engine.decode_compile_counter)
+            engine.decode_compile_counter, engine.dist_compile_counter)
 
 
 def arm():

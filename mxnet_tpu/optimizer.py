@@ -200,7 +200,7 @@ class Optimizer:
         return self.update(index, weight, grad, state)
 
     # ------------------------------------------------- fused multi-tensor step
-    def _fused_stepper(self, mesh=None, shard_axis="dp"):
+    def _fused_stepper(self, mesh=None, shard_axis="dp", keep_sharded=False):
         """One traced function applying ``_step`` leaf-wise to EVERY
         parameter — the multi_sgd_update / multi_mp_sgd_update analogue
         (ref: src/operator/optimizer_op.cc MultiSGDUpdate &co): N per-param
@@ -208,17 +208,25 @@ class Optimizer:
         update additionally runs on a 1/N shard of the replicas along
         ``shard_axis`` and the updated weights are all-gathered back while
         optimizer state stays sharded — ZeRO-1-style weight-update sharding
-        (Xu et al., arXiv 2004.13336)."""
+        (Xu et al., arXiv 2004.13336). ``keep_sharded`` skips that final
+        all-gather: weights LEAVE the step sharded like the state (ZeRO-3
+        parameter residency — mxnet_tpu.dist gathers them back per-bucket
+        on demand before the next forward)."""
         base = self._stepper()
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
 
-            nshard = mesh.shape[shard_axis]
+            nshard = mesh.shape[shard_axis] if shard_axis is not None else 1
 
             def _spec(shape):
                 # shard the first axis the replica count divides; tensors
                 # too small to split stay replicated (their update is noise
-                # next to the big ones the paper targets)
+                # next to the big ones the paper targets). shard_axis=None
+                # runs the update ON the mesh but fully replicated — the
+                # residency mxnet_tpu.dist needs when its exchanged grads
+                # live mesh-committed without ZeRO sharding.
+                if shard_axis is None:
+                    return PartitionSpec()
                 for d, s in enumerate(shape):
                     if s >= nshard and s % nshard == 0:
                         return PartitionSpec(*([None] * d + [shard_axis]))
@@ -245,8 +253,10 @@ class Optimizer:
                 if mesh is not None:
                     # all-gather the updated shard back to replicated; the
                     # state stays sharded across replicas (ZeRO-1's memory
-                    # and weight-update-FLOP saving)
-                    nw = _con(nw, PartitionSpec())
+                    # and weight-update-FLOP saving). ZeRO-3 keeps the
+                    # weights sharded too — the all-gather moves to the
+                    # consumer side (dist.Zero3ParamManager, per-bucket).
+                    nw = _con(nw, spec if keep_sharded else PartitionSpec())
                 new_ws.append(nw)
                 new_ss.append(ns)
             return new_ws, new_ss
@@ -254,7 +264,8 @@ class Optimizer:
         return fused
 
     def fused_update(self, params, grads, states, wrappers=None, indices=None,
-                     mesh=None, shard_axis="dp", donate=True):
+                     mesh=None, shard_axis="dp", donate=True,
+                     keep_sharded=False):
         """Apply the update to every parameter in ONE jitted XLA dispatch
         with weight and state buffers donated. Per-param lr/wd (multipliers
         included) and update counts enter as traced arrays, so LR schedules
@@ -317,11 +328,13 @@ class Optimizer:
         cache = getattr(self, "_jit_fused", None)
         if cache is None:
             cache = self._jit_fused = {}
-        ckey = (None if mesh is None else (mesh, shard_axis), bool(donate))
+        ckey = (None if mesh is None else (mesh, shard_axis), bool(donate),
+                bool(keep_sharded))
         f = cache.get(ckey)
         if f is None:
             f = cache[ckey] = _jit_backed(
-                self._fused_stepper(mesh, shard_axis),
+                self._fused_stepper(mesh, shard_axis,
+                                    keep_sharded=keep_sharded),
                 donate=(0, 2) if donate else (2,), tier="jit",
                 hint="fused_step")
         dispatch_counter.bump()

@@ -18,6 +18,18 @@ import jax.numpy as jnp
 from .. import checkpoint as ckpt
 
 
+def _note(name, help=""):
+    """Count a resilience event in the observability registry (the blessed
+    home for metric state — GL009); the ``dist`` collector snapshots these
+    alongside the exchange counters. Lazy import: resilience must stay
+    usable before observability is."""
+    try:
+        from ..observability import registry
+    except Exception:
+        return
+    registry.counter(name, help).inc()
+
+
 class Heartbeat:
     """Watchdog: ticks a trivial device computation; if a tick exceeds
     `timeout_s`, `on_stall` is called (default: print diagnostics)."""
@@ -44,6 +56,8 @@ class Heartbeat:
             if stop_evt.is_set():
                 return  # stopped mid-tick: don't report, just exit
             if elapsed > self.timeout_s:
+                _note("dist_heartbeat_stalls",
+                      "device round-trips exceeding the heartbeat timeout")
                 self.on_stall(elapsed)
             else:
                 self.last_ok = time.time()
@@ -80,8 +94,25 @@ class ResumableLoop:
     def maybe_save(self, step, pytree):
         if step % self.every == 0 and step > 0:
             ckpt.save_sharded(self.directory, pytree, step)
+            self.note_save()
             return True
         return False
+
+    def note_save(self):
+        """Count one checkpoint save (called by maybe_save and by external
+        savers that write through ``checkpoint`` directly, e.g. the
+        elastic driver's end-of-run save)."""
+        _note("dist_checkpoint_saves", "sharded checkpoint writes")
+
+    def restore(self, like, step=None):
+        """Restore the ``step`` (default: latest) checkpoint; counts into
+        ``dist_checkpoint_restores`` — the rejoin half of the drill."""
+        step = self.latest() if step is None else step
+        if step is None:
+            raise FileNotFoundError("no checkpoint in %s" % self.directory)
+        state = ckpt.restore_sharded(self.directory, step, like=like)
+        _note("dist_checkpoint_restores", "sharded checkpoint restores")
+        return state
 
 
 class SimulatedFailure(RuntimeError):
@@ -115,6 +146,7 @@ def run_resilient(step_fn, init_state, make_batch, num_steps, directory,
     last = ckpt.latest_step(directory)
     if last is not None:
         init_state = ckpt.restore_sharded(directory, last, like=init_state)
+        _note("dist_checkpoint_restores", "sharded checkpoint restores")
         start = last
     state = init_state
     hb = heartbeat.start() if heartbeat is not None else None

@@ -71,17 +71,22 @@ def _ring_attn_local(q, k, v, axis_name, n, causal, scale):
     return out.astype(q.dtype)
 
 
-def ring_attention(q, k, v, mesh, axis_name="sp", causal=False, scale=None):
+def ring_attention(q, k, v, mesh, axis_name="sp", causal=False, scale=None,
+                   batch_axis=None):
     """q,k,v: (B, H, T, D) with T sharded over `axis_name` on `mesh`.
 
     Differentiable: gradients flow through the scan + ppermute ring (the
     transpose rotates cotangents the opposite way around the ring), so this
     is the training path for sp-sharded long context, not just inference.
+
+    ``batch_axis`` additionally shards B over that mesh axis (dp×sp
+    composition: each dp replica runs its own independent ring over its
+    batch shard — same convention as ep.moe_ffn's batch_axis).
     """
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     sm = get_shard_map()
-    spec = P(None, None, axis_name, None)
+    spec = P(batch_axis, None, axis_name, None)
     n = int(mesh.shape[axis_name])
     f = sm(functools.partial(_ring_attn_local, axis_name=axis_name, n=n,
                              causal=causal, scale=scale),

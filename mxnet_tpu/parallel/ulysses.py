@@ -66,10 +66,14 @@ def _ulysses_local(q, k, v, axis_name, n, causal, scale):
 
 
 def ulysses_attention(q, k, v, mesh, axis_name="sp", causal=False,
-                      scale=None):
+                      scale=None, batch_axis=None):
     """q,k,v: (B, H, T, D), T sharded over `axis_name`; requires
     H % mesh.shape[axis_name] == 0. Differentiable: all_to_all transposes to
-    the inverse all_to_all, so the backward pass is two more a2a hops."""
+    the inverse all_to_all, so the backward pass is two more a2a hops.
+
+    ``batch_axis`` additionally shards B over that mesh axis (dp×sp
+    composition: every dp replica runs its own pair of all-to-alls over
+    its batch shard — same convention as ep.moe_ffn's batch_axis)."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     n = int(mesh.shape[axis_name])
@@ -81,7 +85,7 @@ def ulysses_attention(q, k, v, mesh, axis_name="sp", causal=False,
                 "does not divide the head count"
                 % (name, t.shape[1], name, axis_name, n))
     sm = get_shard_map()
-    spec = P(None, None, axis_name, None)
+    spec = P(batch_axis, None, axis_name, None)
     f = sm(functools.partial(_ulysses_local, axis_name=axis_name, n=n,
                              causal=causal, scale=scale),
            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)

@@ -210,6 +210,29 @@ def test_decode_dispatch_counters_match_artifact():
         % (recompiles, row["steady_state_recompiles"])
 
 
+# ---------------------------------------------------------------- dist
+def test_dist_exchange_counters_match_artifact():
+    """The overlapped-exchange gate: bucket dispatches per step and
+    steady-state bucket-program builds are deterministic per (model,
+    bucket cap) — a bucketer change that splits buckets differently or
+    retraces in steady state fails here even with parity intact."""
+    art = _artifact("dist_bench_quick.json")
+    row = _row(art, "mlp_6x256_w8")
+    bench = _tool("dist_bench")
+    for mode, col in (("overlapped", "overlapped_buckets_per_step"),
+                      ("serialized", "serialized_buckets_per_step")):
+        _losses, _ms, counters = bench.run_mode(mode, steps=4,
+                                                bucket_mb=row["bucket_mb"])
+        assert counters["buckets_per_step"] == row[col], \
+            "%s: %.1f bucket dispatches/step (baseline %.1f)" \
+            % (mode, counters["buckets_per_step"], row[col])
+        assert counters["steady_state_bucket_builds"] == \
+            row["steady_state_bucket_builds"], \
+            "%s: %d steady-state bucket builds (baseline %d)" \
+            % (mode, counters["steady_state_bucket_builds"],
+               row["steady_state_bucket_builds"])
+
+
 # ------------------------------------------------- artifact sanity gate
 @pytest.mark.parametrize("name,counter_cols", [
     ("opt_step_bench_quick.json", ["fused_dispatches_per_step"]),
@@ -224,6 +247,11 @@ def test_decode_dispatch_counters_match_artifact():
                              "steady_state_recompiles", "nodes_captured",
                              "nodes_canonical", "nodes_final",
                              "cse_rewrites", "dce_nodes_removed"]),
+    ("dist_bench_quick.json", ["overlapped_buckets_per_step",
+                               "serialized_buckets_per_step",
+                               "overlapped_dispatches_per_step",
+                               "steady_state_bucket_builds",
+                               "loss_trajectory_max_diff"]),
 ])
 def test_committed_artifacts_carry_counter_columns(name, counter_cols):
     """The gate only works while the artifacts keep their counter columns —

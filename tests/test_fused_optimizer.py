@@ -351,7 +351,7 @@ def test_lr_schedule_and_batch_size_do_not_retrace():
         ps.append(p)
     trainer = gluon.Trainer(ps, "sgd", {"learning_rate": 0.1})
     trainer.step(2)
-    f = trainer._optimizer._jit_fused[(None, True)]
+    f = trainer._optimizer._jit_fused[(None, True, False)]
     sizes = f._cache_size()
     trainer.set_learning_rate(0.01)
     trainer.step(4)  # different lr AND different batch_size rescale

@@ -96,11 +96,11 @@ def test_repo_self_lint_is_ci_clean():
 def test_allowlist_is_small_and_justified():
     with open(ALLOWLIST) as fh:
         entries = json.load(fh)
-    # 10 of these are the engine proof-hook counters GL009 deliberately
+    # 12 of these are the engine proof-hook counters GL009 deliberately
     # keeps visible, and 5 are the GL010 legacy capture shims (LazyExpr/
     # TapeNode/Symbol + the two front-memo keys over the IR canonical
     # key) — each carries a why naming the constraint
-    assert len(entries) <= 30, "allowlist grew to %d entries" % len(entries)
+    assert len(entries) <= 32, "allowlist grew to %d entries" % len(entries)
     for e in entries:
         assert e.get("why", "").strip(), "entry %r lacks a why" % e.get("id")
 

@@ -182,6 +182,31 @@ def main():
             print("live servers : none (snapshots appear while a "
                   "serve.ModelServer is alive)")
 
+    print("----------Distributed----------")
+    # mxnet_tpu.dist: the overlapped gradient exchange (bucket dispatches
+    # vs bucket-program builds — a steady-state build delta means the
+    # exchange is retracing) plus the resilience event counters the
+    # heartbeat/checkpoint/elastic machinery feeds into the registry
+    dd = snap["dist"]
+    print("exchange     : %d bucket dispatch(es), %d bucket program "
+          "build(s)" % (dd["bucket_dispatches"], dd["bucket_compiles"]))
+    if "attached_trainers" in dd:
+        print("trainers     : %d attached, %d layout(s), %d program(s), "
+              "%d exchange(s), bucket cap %.1f MB (MXNET_DIST_BUCKET_MB)"
+              % (dd["attached_trainers"], dd["bucket_layouts"],
+                 dd["bucket_programs"], dd["exchanges"],
+                 dd["bucket_mb_default"]))
+    else:
+        print("trainers     : subsystem not loaded (import mxnet_tpu.dist)")
+    print("resilience   : stalls=%d saves=%d restores=%d recoveries=%d"
+          % (dd["heartbeat_stalls"], dd["checkpoint_saves"],
+             dd["checkpoint_restores"], dd["elastic_recoveries"]))
+    if dd.get("last_recovery"):
+        lr = dd["last_recovery"]
+        print("last recovery: failed_step=%s survivors=%s resumed_from=%s"
+              % (lr.get("failed_step"), lr.get("survivors"),
+                 lr.get("resumed_from")))
+
     print("----------Observability----------")
     # the unified-telemetry layer itself: registry size, compile-time
     # accounting, the retrace watchdog, request tracing, and the bounded

@@ -45,6 +45,7 @@ from . import rnn
 from . import operator
 from . import sparse
 from . import quantization
+from . import quant  # canonical quantized-inference entry point
 from . import linalg
 from . import test_utils
 from . import callback

@@ -29,6 +29,13 @@ _DTYPE_ALIASES = {
     "bool": np.bool_,
 }
 
+# fp8 storage dtypes (quantization.py fp8 modes) resolve by name where the
+# jax build ships them — load_npz_exact's __dtype__ sidecars round-trip fp8
+# checkpoints through resolve_dtype
+for _fp8 in ("float8_e4m3fn", "float8_e5m2"):
+    if hasattr(jax.numpy, _fp8):
+        _DTYPE_ALIASES[_fp8] = getattr(jax.numpy, _fp8)
+
 
 def is_tpu_backend():
     """True when the default backend is a TPU — including relayed platforms

@@ -128,8 +128,11 @@ def _save_model_snapshot(server, prefix, input_names, epoch):
         if fe is not None:
             fe.update(bucket=e["bucket"], donating=e["donating"])
             execs[e["key"]] = fe
+    # informational for model snapshots: the exported graph already bakes
+    # the quantized ops in, so load never needs to re-apply it
     return {"kind": "model", "input_names": input_names,
             "input_specs": specs, "buckets": list(server.buckets),
+            "quantize": getattr(server, "quantize", None),
             "pool_state": server._pool.export_state(),
             "executables": execs}
 
@@ -151,6 +154,7 @@ def _save_generative_snapshot(server, prefix, epoch):
             "top_k": server.top_k, "eos_id": server.eos_id,
             "capacity": int(server.cache.capacity),
             "prefix_cache": server.prefix is not None,
+            "quantize": server._quantize,
             "prompt_buckets": sorted({tp for tp, _ in server._prefill_fns}),
             "executables": execs}
 
@@ -226,12 +230,32 @@ def _load_generative_snapshot(prefix, manifest, model, use_execs,
             "serve.load(prefix, snapshot=True, model=my_model) — the "
             "decode protocol is code; only params/config/executables are "
             "in the artifact")
+    quantize = manifest.get("quantize") or server_kwargs.pop("quantize",
+                                                             None)
+    if quantize:
+        # the checkpoint holds the QUANTIZED parameter tree (qweight/
+        # w_scale under structural names) — swap the layers first so
+        # load_parameters finds matching slots, then load bit-exact (the
+        # server ctor's re-quantize is an idempotent no-op on swapped
+        # layers)
+        from ..quantization import quantize_model
+
+        params = model.collect_params()
+        if any(p._data is None and p._deferred_init is None
+               for p in params.values()):
+            # bare skeleton (the usual serve.load(model=gpt_nano()) call):
+            # QuantizedDense derives qweight from a materialized fp32
+            # weight at swap time, so give the skeleton throwaway values —
+            # load_parameters overwrites every slot bit-exactly below
+            model.initialize()
+        quantize_model(model, mode=quantize)
     model.load_parameters("%s-%04d.params" % (prefix,
                                               manifest.get("epoch", 0)))
     srv = GenerativeServer(model, slots=manifest["slots"],
                            top_k=manifest["top_k"],
                            eos_id=manifest["eos_id"],
                            prefix_cache=manifest.get("prefix_cache", True),
+                           quantize=quantize,
                            **server_kwargs)
     # allocate the cache at the snapshot's capacity bucket up front — a
     # fresh zero alloc, NOT a migration dispatch — so the preloaded

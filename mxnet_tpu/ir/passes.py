@@ -33,6 +33,16 @@ Passes:
                   applies them only when the caller opts in (capture
                   paths never donate implicitly — the caller's NDArrays
                   own those buffers).
+* ``quant``     — OPT-IN (not in ``DEFAULT_PASSES``): rewrite eligible
+                  fp32 matmul nodes (``dot`` without transposes,
+                  ``FullyConnected``) into fused dynamically-quantized
+                  bodies — per-channel int8 weight quantize → int8×int8
+                  MXU matmul (``preferred_element_type=int32``) → fp32
+                  rescale. Single-node in-place rewrite: slot numbering
+                  and wiring are untouched, so capture maps survive.
+                  The graph-level complement of ``quant.quantize_model``
+                  for callers that opt whole captured programs in:
+                  ``PassManager(DEFAULT_PASSES + ("quant",))``.
 
 Per-pass node/edge deltas are kept in :data:`PASS_STATS` (fixed keys, no
 unbounded growth — GL006) and mirrored into the observability registry
@@ -55,7 +65,7 @@ _CONST_ROOT_OPS = ("_const", "_filled", "_arange")
 # folds those fine on its own. Islands above this element count stay.
 _FOLD_MAX_ELEMS = _env_cap("MXNET_IR_FOLD_MAX_ELEMS", 65536)
 
-_PASS_NAMES = ("cse", "fold", "cast_sink", "dce", "donation")
+_PASS_NAMES = ("cse", "fold", "cast_sink", "dce", "donation", "quant")
 
 # fixed-key stats table (one entry per pass — bounded by construction);
 # tools/diagnose.py and ir.lower.stats() read it, the observability "ir"
@@ -449,8 +459,68 @@ def _donation(work):
     return len(cands)
 
 
+def _quant_node_fn(op, orig):
+    """Fused dynamically-quantized body replacing one matmul node.
+    Branches only on trace-time static properties (ndim/dtype/static
+    attrs) and falls back to the original body for ineligible inputs, so
+    the rewrite is always safe to apply."""
+
+    def fn(a, b, *rest, **static):
+        import jax
+        import jax.numpy as jnp
+
+        from ..quantization import _quantize_act, quantize_weight, \
+            quantized_fully_connected
+
+        f32 = np.dtype(np.float32)
+        if op == "FullyConnected":
+            x, w = a, b
+            nh = static.get("num_hidden")
+            if w.ndim != 2 or np.dtype(w.dtype) != f32 \
+                    or np.dtype(x.dtype) != f32 \
+                    or (nh is not None and w.shape[0] != nh):
+                return orig(a, b, *rest, **static)
+            if static.get("flatten", True) and x.ndim > 2:
+                x = jnp.reshape(x, (x.shape[0], -1))
+            bias = None
+            if rest and rest[0] is not None \
+                    and not static.get("no_bias", False):
+                bias = rest[0]
+            qw, ws = quantize_weight(w, axis=0)
+            return quantized_fully_connected(x, qw, ws, bias)
+        # dot: a @ b with b (in, out) — per-column weight channels
+        if static.get("transpose_a") or static.get("transpose_b") \
+                or a.ndim != 2 or b.ndim != 2 \
+                or np.dtype(a.dtype) != f32 or np.dtype(b.dtype) != f32:
+            return orig(a, b, *rest, **static)
+        qb, b_scale = quantize_weight(b, axis=1)
+        qa, a_scale = _quantize_act(a, None, qb.dtype, 127.0, True)
+        acc = jax.lax.dot_general(qa, qb, (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.int32)
+        return acc.astype(jnp.float32) * (a_scale * b_scale.reshape(-1))
+
+    return fn
+
+
+def _quant(work):
+    """Opt-in quantized-matmul rewrite (see module docstring). In-place
+    single-node rewrites only: n_out, specs and slot numbering are
+    preserved, so no _apply_reps / renumbering is needed."""
+    rewrites = 0
+    for i, n in enumerate(work.nodes):
+        if n.pinned or n.kw_names or n.op not in ("dot", "FullyConnected"):
+            continue
+        if n.op == "dot" and (n.static.get("transpose_a")
+                              or n.static.get("transpose_b")):
+            continue
+        work.nodes[i] = n.replace(op="_quant_" + n.op,
+                                  fn=_quant_node_fn(n.op, n.fn))
+        rewrites += 1
+    return rewrites
+
+
 _PASS_FNS = {"cse": _cse, "fold": _fold, "cast_sink": _cast_sink,
-             "dce": _dce, "donation": _donation}
+             "dce": _dce, "donation": _donation, "quant": _quant}
 
 DEFAULT_PASSES = ("cse", "fold", "cast_sink", "dce", "donation")
 

@@ -96,6 +96,32 @@ class _CausalSelfAttention(HybridBlock):
         out = F.scaled_dot_attention(q, k_cache, v_cache, mask)
         return self.attn_out(self._merge_heads(F, out)), k_cache, v_cache
 
+    def step_cached_quant(self, F, x, k_cache, k_scale, v_cache, v_scale,
+                          start):
+        """:meth:`step_cached` against int8 KV pages: new K/V quantize on
+        write (``F.quant_cache_write`` keeps a running per-page-per-head
+        scale) and the full pages dequantize on read — XLA fuses the
+        int8→fp32 convert into the attention matmuls, so the cache lives in
+        HBM at half the bf16 bytes while shapes stay step-invariant.
+        Returns (out, k_cache', k_scale', v_cache', v_scale')."""
+        B, T, C = x.shape
+        q, k_new, v_new = self._qkv_heads(F, x)
+        k_cache, k_scale = F.quant_cache_write(k_cache, k_scale, k_new, start)
+        v_cache, v_scale = F.quant_cache_write(v_cache, v_scale, v_new, start)
+        cap = k_cache.shape[2]
+        pos = F.reshape(F.arange(0, cap, dtype="int32"),
+                        shape=(1, 1, 1, cap))
+        rows = F.reshape(F.arange(0, T, dtype="int32"), shape=(1, 1, T, 1))
+        if isinstance(start, int):
+            limit = rows + start
+        else:  # (B,) per-slot positions
+            limit = rows + F.reshape(start, shape=(-1, 1, 1, 1))
+        mask = F.lesser_equal(pos, limit)
+        out = F.scaled_dot_attention(q, F.dequant_cache(k_cache, k_scale),
+                                     F.dequant_cache(v_cache, v_scale), mask)
+        return (self.attn_out(self._merge_heads(F, out)),
+                k_cache, k_scale, v_cache, v_scale)
+
     def step(self, x, cache):
         """One-token decode against the fixed-capacity ``(k, v, n)`` cache
         (eager path: generation loops in python, each step a fixed-shape
@@ -141,6 +167,12 @@ class _GPTBlock(HybridBlock):
         a, k_cache, v_cache = self.attn.step_cached(F, self.ln1(x), k_cache,
                                                     v_cache, start)
         return self._ffn(x + a), k_cache, v_cache
+
+    def step_cached_quant(self, F, x, k_cache, k_scale, v_cache, v_scale,
+                          start):
+        a, k_cache, k_scale, v_cache, v_scale = self.attn.step_cached_quant(
+            F, self.ln1(x), k_cache, k_scale, v_cache, v_scale, start)
+        return self._ffn(x + a), k_cache, k_scale, v_cache, v_scale
 
     def step(self, x, cache):
         ks, vs, n = cache
@@ -304,6 +336,31 @@ class GPTModel(HybridBlock):
         logits = F.dot(F.reshape(x, shape=(x.shape[0], self._units)),
                        F.transpose(w))
         return logits, nk, nv
+
+    def decode_step_fixed_quant(self, F, tokens, k_caches, k_scales,
+                                v_caches, v_scales, valid_len):
+        """:meth:`decode_step_fixed` over int8 KV pages with per-page-per-
+        head scales (``k_scales``/``v_scales`` per-layer (B, H, 1, 1) fp32).
+        Same per-slot-position semantics, same step-invariant shapes — one
+        compiled program per capacity; returns (logits, new k_caches,
+        new k_scales, new v_caches, new v_scales)."""
+        x = self.word_embed(F.reshape(tokens, shape=(-1, 1)))  # (B, 1, C)
+        pw = param_value(self.pos_embed.weight)
+        x = x + F.expand_dims(F.take(pw, valid_len), axis=1)
+        nk, nks, nv, nvs = [], [], [], []
+        for blk, kc, ks, vc, vs in zip(self.blocks, k_caches, k_scales,
+                                       v_caches, v_scales):
+            x, kc, ks, vc, vs = blk.step_cached_quant(F, x, kc, ks, vc, vs,
+                                                      valid_len)
+            nk.append(kc)
+            nks.append(ks)
+            nv.append(vc)
+            nvs.append(vs)
+        x = self.ln_f(x)
+        w = param_value(self.word_embed.weight)
+        logits = F.dot(F.reshape(x, shape=(x.shape[0], self._units)),
+                       F.transpose(w))
+        return logits, nk, nks, nv, nvs
 
     def generate(self, prompt, max_new_tokens=16, use_cache=True):
         """Greedy decode. prompt (B, T0) int → (B, T0 + max_new) int.

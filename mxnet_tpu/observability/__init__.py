@@ -199,8 +199,23 @@ def _collect_dist():
     return out
 
 
+def _collect_quant():
+    # quantized inference (mxnet_tpu.quant): swap/calibration tallies from
+    # the quantization module's fixed-key stats table. Like dist, the
+    # subsystem detail only appears once the module has actually been
+    # imported — a collector must never force-load the package it
+    # observes.
+    import sys
+
+    q = sys.modules.get("mxnet_tpu.quantization")
+    if q is None:
+        return {"subsystem": "not loaded"}
+    return q.stats()
+
+
 registry.register_collector("engine", _collect_engine)
 registry.register_collector("dist", _collect_dist)
+registry.register_collector("quant", _collect_quant)
 registry.register_collector("caches", _collect_caches)
 registry.register_collector("comp_cache", _collect_comp_cache)
 registry.register_collector("serve", _collect_serve)

@@ -261,3 +261,43 @@ def cache_write(cache, update, index):
     return jax.vmap(
         lambda c, u, i: jax.lax.dynamic_update_slice(c, u, (zero, i, zero))
     )(cache, update, index)
+
+
+@register_op("quant_cache_write", nondiff=True, n_outputs=2)
+def quant_cache_write(cache, scale, update, index):
+    """:func:`cache_write` for the int8 paged KV cache: quantize ``update``
+    (B, H, T, D) fp on write into ``cache`` (B, H, C, D) int8 with a
+    per-page-per-head scale ``scale`` (B, H, 1, 1) fp32, returning
+    ``(new_cache, new_scale)``.
+
+    The scale is a RUNNING per-(page, head) max — monotone non-decreasing,
+    so already-written positions only ever rescale DOWN (ratio ≤ 1) and the
+    branchless requantize below is an exact no-op when the scale did not
+    move (int8→fp32 × 1.0 → round reproduces the integer). Both buffers are
+    donated by the decode step, so the whole thing is an in-place page
+    update; shapes never change across steps — one compiled program."""
+    index = jnp.asarray(index, jnp.int32)
+    zero = jnp.int32(0)
+    update = update.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(update), axis=(2, 3), keepdims=True)
+    new_scale = jnp.maximum(scale, jnp.maximum(amax / 127.0, 1e-8))
+    ratio = scale / new_scale            # ≤ 1; 0 for never-written pages
+    requant = jnp.clip(jnp.round(cache.astype(jnp.float32) * ratio),
+                       -127, 127).astype(jnp.int8)
+    qupd = jnp.clip(jnp.round(update / new_scale), -127, 127).astype(jnp.int8)
+    if index.ndim == 0:
+        out = jax.lax.dynamic_update_slice(requant, qupd,
+                                           (zero, zero, index, zero))
+    else:
+        out = jax.vmap(
+            lambda c, u, i: jax.lax.dynamic_update_slice(c, u, (zero, i, zero))
+        )(requant, qupd, index)
+    return out, new_scale
+
+
+@register_op("dequant_cache", nondiff=True)
+def dequant_cache(cache, scale):
+    """int8 KV pages → fp32 for attention: ``cache`` (B, H, C, D) int8 ×
+    ``scale`` (B, H, 1, 1) fp32. XLA fuses the convert+scale into the
+    attention matmul's operand read — no materialized fp32 cache copy."""
+    return cache.astype(jnp.float32) * scale

@@ -1,0 +1,37 @@
+"""``mxnet_tpu.quant`` — the serving-grade quantization subsystem.
+
+The public face of quantized inference end to end:
+
+* **Weight quantization** — :func:`quantize_model` swaps every eligible
+  ``Dense``/``Conv2D`` for its quantized twin (symmetric per-channel
+  ``int8``; ``e4m3``/``e5m2`` fp8 where the backend ships the dtypes —
+  probe with :func:`fp8_supported`). Accumulation runs on the MXU int8/fp8
+  path via ``preferred_element_type``; the fp32 rescale is fused by XLA.
+* **Calibration** — :func:`calibrate_model` freezes static activation
+  scales from representative data (``naive`` amax or KL-``entropy``
+  thresholds), removing the per-batch amax reduction from the hot path.
+* **Quantized serving** — ``serve.ModelServer(..., quantize="int8")`` and
+  ``serve.GenerativeServer(..., quantize="int8")`` compile the quantized
+  programs into the warmed buckets; the generative path also stores the
+  paged KV cache as int8 pages with per-page-per-head scales (~0.5× bf16
+  bytes) while keeping decode at ONE dispatch per token step.
+* **Persistence** — quantized weights are registered parameters, so
+  checkpoints (``save_parameters``/``save_npz_exact``) and serving
+  snapshots (``serve.snapshot``/``serve.load``) round-trip bit-exact.
+
+Implementation lives in :mod:`mxnet_tpu.quantization` (kept for
+backward-compatible imports); this package is the canonical entry point::
+
+    from mxnet_tpu import quant
+    quant.quantize_model(net, mode="int8", calib_mode="entropy",
+                         calib_data=warmup_batch)
+"""
+from ..quantization import (QuantizedConv2D, QuantizedDense, calibrate_model,
+                            dequantize, fp8_supported, quant_dtype, quantize,
+                            quantize_model, quantize_weight, quantized_conv,
+                            quantized_fully_connected, stats)
+
+__all__ = ["quantize", "dequantize", "quantize_weight",
+           "quantized_fully_connected", "quantized_conv", "QuantizedDense",
+           "QuantizedConv2D", "quantize_model", "calibrate_model",
+           "fp8_supported", "quant_dtype", "stats"]

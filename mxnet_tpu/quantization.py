@@ -1,25 +1,117 @@
-"""int8 quantization (ref: src/operator/quantization/*.cc, python/mxnet/
+"""int8/fp8 quantization (ref: src/operator/quantization/*.cc, python/mxnet/
 contrib/quantization.py).
 
 MXNet's int8 path targets MKLDNN/TensorRT kernels with calibrated ranges.
-TPU-native: symmetric per-channel int8 weights + dynamic per-tensor int8
-activations, accumulating in int32 on the MXU (``preferred_element_type``),
-rescaled in fp32 — the standard XLA int8 inference recipe. ``quantize_model``
-swaps eligible Dense layers in-place for inference.
+TPU-native: symmetric per-channel quantized weights + dynamic per-tensor
+quantized activations, accumulating on the MXU via ``preferred_element_type``
+(int32 for int8, fp32 for fp8), rescaled in fp32 — the standard XLA low-bit
+inference recipe. ``quantize_model`` swaps eligible Dense/Conv2D layers
+in-place for inference; the swapped twins register their quantized weights as
+grad-less Parameters so checkpoints and serve snapshots round-trip bit-exactly.
+
+Modes: ``int8`` (always available), ``e4m3``/``e5m2`` (fp8, capability-probed
+per jax build like the flash-attention gate — see :func:`fp8_supported`).
+The serving-facing façade lives in :mod:`mxnet_tpu.quant`.
 """
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .base import register_op
 from .gluon import nn
 from .gluon.block import HybridBlock
 from .ndarray import NDArray
 
-__all__ = ["quantize", "dequantize", "quantized_fully_connected",
-           "quantized_conv", "QuantizedDense", "QuantizedConv2D",
-           "quantize_model", "calibrate_model"]
+__all__ = ["quantize", "dequantize", "quantize_weight",
+           "quantized_fully_connected", "quantized_conv", "QuantizedDense",
+           "QuantizedConv2D", "quantize_model", "calibrate_model",
+           "fp8_supported", "quant_dtype", "stats"]
+
+# symmetric-quantization ranges per mode; fp8 qmax values are the finite
+# maxima of the respective formats (e4m3: 448, e5m2: 57344)
+_QMAX = {"int8": 127.0, "e4m3": 448.0, "e5m2": 57344.0}
+_FP8_NAMES = {"e4m3": "float8_e4m3fn", "e5m2": "float8_e5m2"}
+
+# capability-probe cache (int8 seed keeps the dict non-empty by construction;
+# fp8 entries fill in lazily per probed mode)
+_FP8_SUPPORT = {"int8": True}
+
+# subsystem telemetry read by observability's "quant" collector (fixed keys;
+# quantize_model/calibrate_model update them in place)
+_QUANT_STATS = {
+    "quantized_layers": 0,
+    "weight_bytes_quantized": 0,
+    "weight_bytes_fp32": 0,
+    "calibrated_layers": 0,
+    "calib_mode": "none",
+    "mode": "none",
+}
+
+
+def stats():
+    """Quantization telemetry snapshot (observability ``quant`` section)."""
+    return dict(_QUANT_STATS)
+
+
+def fp8_supported(mode="e4m3"):
+    """True when this jax build can run an fp8 ``dot_general`` for ``mode``
+    (``e4m3``/``e5m2``). Probed once with a tiny eager matmul and cached —
+    the same lazy capability-gate pattern as the flash-attention probe."""
+    got = _FP8_SUPPORT.get(mode)
+    if got is not None:
+        return got
+    ok = False
+    name = _FP8_NAMES.get(mode)
+    if name is not None and hasattr(jnp, name):
+        try:
+            dt = getattr(jnp, name)
+            a = jnp.ones((2, 2), dt)
+            out = jax.lax.dot_general(a, a, (((1,), (1,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+            ok = bool(np.asarray(out).shape == (2, 2))
+        except Exception:
+            ok = False
+    _FP8_SUPPORT[mode] = ok
+    return ok
+
+
+def quant_dtype(mode):
+    """The storage dtype for a quantization mode."""
+    if mode == "int8":
+        return jnp.int8
+    name = _FP8_NAMES.get(mode)
+    if name is None:
+        raise ValueError("quantization mode must be one of %s, got %r"
+                         % (sorted(_QMAX), mode))
+    dt = getattr(jnp, name, None)
+    if dt is None:
+        raise RuntimeError("this jax build has no %s dtype — use mode='int8'"
+                           % name)
+    return dt
+
+
+def _check_mode(mode):
+    if mode not in _QMAX:
+        raise ValueError("quantization mode must be one of %s, got %r"
+                         % (sorted(_QMAX), mode))
+    if mode != "int8" and not fp8_supported(mode):
+        raise RuntimeError(
+            "fp8 mode %r unsupported by this jax build/backend (capability "
+            "probe failed) — use mode='int8'" % mode)
+
+
+def _dtype_qparams(dt):
+    """(qmax, integral) for a quantized storage dtype — dt is a static
+    attribute of the weight array, so branching on it is trace-safe."""
+    dt = np.dtype(dt)
+    if dt == np.dtype(np.int8):
+        return 127.0, True
+    for mode, name in _FP8_NAMES.items():
+        if hasattr(jnp, name) and dt == np.dtype(getattr(jnp, name)):
+            return _QMAX[mode], False
+    raise TypeError("unsupported quantized weight dtype %r" % (dt,))
 
 
 @register_op("contrib_quantize", nondiff=True, n_outputs=2)
@@ -41,23 +133,45 @@ def dequantize(q, scale):
     return q.astype(jnp.float32) * scale
 
 
-def _quantize_act(x, x_scale):
-    """Dynamic (x_scale=None) or static (calibrated scale) int8 activations."""
+def quantize_weight(w, axis=0, mode="int8"):
+    """Eager symmetric per-slice weight quantization: (q, scale) with scale
+    keeping dims along ``axis``. int8 rounds; fp8 casts (the format's own
+    mantissa rounding applies)."""
+    red = tuple(d for d in range(w.ndim) if d != axis)
+    amax = jnp.max(jnp.abs(w), axis=red, keepdims=True)
+    qmax = _QMAX[mode]
+    scale = jnp.maximum(amax, 1e-8) / qmax
+    if mode == "int8":
+        q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    else:
+        q = jnp.clip(w / scale, -qmax, qmax).astype(quant_dtype(mode))
+    return q, scale
+
+
+def _quantize_act(x, x_scale, dt, qmax, integral):
+    """Dynamic (x_scale=None) or static (calibrated scale) quantized
+    activations in the weight's storage dtype."""
     if x_scale is None:
-        return quantize(x)
-    qx = jnp.clip(jnp.round(x / x_scale), -127, 127).astype(jnp.int8)
+        amax = jnp.max(jnp.abs(x))
+        x_scale = jnp.maximum(amax, 1e-8) / qmax
+    if integral:
+        qx = jnp.clip(jnp.round(x / x_scale), -qmax, qmax).astype(dt)
+    else:
+        qx = jnp.clip(x / x_scale, -qmax, qmax).astype(dt)
     return qx, x_scale
 
 
 @register_op("quantized_fully_connected", nondiff=True)
 def quantized_fully_connected(x, qweight, w_scale, bias=None, *, x_scale=None):
-    """x fp → int8 (dynamic per-tensor, or static when a calibrated x_scale is
-    given); int8×int8 matmul accumulated in int32 on the MXU.
-    qweight: (out, in) int8; w_scale: (out, 1) fp32."""
-    qx, x_scale = _quantize_act(x, x_scale)
+    """x fp → quantized (dynamic per-tensor, or static when a calibrated
+    x_scale is given); low-bit matmul accumulated on the MXU — int32 for
+    int8 weights, fp32 for fp8. qweight: (out, in) int8/fp8;
+    w_scale: (out, 1) fp32."""
+    qmax, integral = _dtype_qparams(qweight.dtype)
+    qx, x_scale = _quantize_act(x, x_scale, qweight.dtype, qmax, integral)
     acc = jax.lax.dot_general(
         qx, qweight, (((qx.ndim - 1,), (1,)), ((), ())),
-        preferred_element_type=jnp.int32)
+        preferred_element_type=jnp.int32 if integral else jnp.float32)
     y = acc.astype(jnp.float32) * (x_scale * w_scale.reshape(-1))
     if bias is not None:
         y = y + bias
@@ -67,16 +181,17 @@ def quantized_fully_connected(x, qweight, w_scale, bias=None, *, x_scale=None):
 @register_op("quantized_conv", nondiff=True)
 def quantized_conv(x, qweight, w_scale, bias=None, *, stride=1, pad=0, dilate=1,
                    num_group=1, x_scale=None):
-    """int8 convolution (ref: src/operator/quantization/quantized_conv.cc —
-    the cuDNN int8x4 path). Per-tensor int8 activations (dynamic or
-    calibrated-static) × per-output-channel int8 weights, int32 accumulation
-    on the MXU, fp32 rescale. qweight: (O, I, *K) int8; w_scale: (O, 1, 1, ...)
-    fp32."""
+    """Quantized convolution (ref: src/operator/quantization/
+    quantized_conv.cc — the cuDNN int8x4 path). Per-tensor quantized
+    activations (dynamic or calibrated-static) × per-output-channel quantized
+    weights, MXU accumulation (int32 for int8, fp32 for fp8), fp32 rescale.
+    qweight: (O, I, *K); w_scale: (O, 1, 1, ...) fp32."""
     from .ops.functional import _pair
 
     nd = x.ndim - 2
     stride, pad, dilate = _pair(stride, nd), _pair(pad, nd), _pair(dilate, nd)
-    qx, x_scale = _quantize_act(x, x_scale)
+    qmax, integral = _dtype_qparams(qweight.dtype)
+    qx, x_scale = _quantize_act(x, x_scale, qweight.dtype, qmax, integral)
     spatial = "DHW"[-nd:]
     lhs = "NC" + spatial
     dn = jax.lax.conv_dimension_numbers(x.shape, qweight.shape,
@@ -84,7 +199,7 @@ def quantized_conv(x, qweight, w_scale, bias=None, *, stride=1, pad=0, dilate=1,
     acc = jax.lax.conv_general_dilated(
         qx, qweight, window_strides=stride, padding=[(p, p) for p in pad],
         rhs_dilation=dilate, dimension_numbers=dn, feature_group_count=num_group,
-        preferred_element_type=jnp.int32)
+        preferred_element_type=jnp.int32 if integral else jnp.float32)
     oscale = (x_scale * w_scale.reshape(-1)).reshape((1, -1) + (1,) * nd)
     y = acc.astype(jnp.float32) * oscale
     if bias is not None:
@@ -98,8 +213,6 @@ class _LayerCollector:
     _LayerHistogramCollector)."""
 
     def __init__(self, mode="naive", num_bins=8001):
-        import numpy as np
-
         self.mode = mode
         self.num_bins = num_bins
         self.amax = 0.0
@@ -107,8 +220,6 @@ class _LayerCollector:
         self.phase = 1
 
     def collect(self, x):
-        import numpy as np
-
         if isinstance(x, NDArray):
             a = x.asnumpy()
         else:
@@ -129,8 +240,6 @@ class _LayerCollector:
 def _smooth_distribution(d, eps=1e-4):
     """Move eps mass onto zero entries so KL stays finite (ref:
     contrib/quantization.py _smooth_distribution)."""
-    import numpy as np
-
     is_zero = d == 0
     n_zero = int(is_zero.sum())
     n_nonzero = d.size - n_zero
@@ -148,8 +257,6 @@ def _optimal_threshold(hist, amax, num_quantized_bins=255):
     with the clipped-away outlier mass folded into its edge bin; q is the
     255-level quantization of the UNFOLDED clipped histogram — so clipping
     cost appears as p/q divergence at the edge rather than being free."""
-    import numpy as np
-
     num_bins = hist.size
     if amax <= 0 or hist.sum() == 0:
         return amax
@@ -180,28 +287,42 @@ def _optimal_threshold(hist, amax, num_quantized_bins=255):
 
 
 class QuantizedDense(HybridBlock):
-    """Inference-only Dense with pre-quantized int8 weights."""
+    """Inference-only Dense with pre-quantized int8/fp8 weights.
 
-    def __init__(self, dense: nn.Dense, **kwargs):
+    ``qweight``/``w_scale``/``bias`` are registered as grad-less Parameters
+    (not raw jnp attributes), so ``save_parameters``/``export``/
+    ``serve.snapshot`` round-trip the quantized net bit-exactly and the
+    serving param store picks them up like any other weight."""
+
+    def __init__(self, dense: nn.Dense, mode="int8", **kwargs):
         super().__init__(prefix=dense.prefix, **kwargs)
+        _check_mode(mode)
         w = dense.weight.data()._data.astype(jnp.float32)
-        qw, ws = quantize(w, axis=0)
-        self._qw = jnp.asarray(qw)
-        self._ws = jnp.asarray(ws)
-        self._bias = (dense.bias.data()._data.astype(jnp.float32)
-                      if hasattr(dense, "bias") and dense.bias is not None else None)
+        qw, ws = quantize_weight(w, axis=0, mode=mode)
+        self._mode = mode
+        self.qweight = self.params.get("qweight", shape=tuple(qw.shape),
+                                       dtype=quant_dtype(mode),
+                                       differentiable=False)
+        self.qweight.set_data(NDArray(qw))
+        self.w_scale = self.params.get("w_scale", shape=tuple(ws.shape),
+                                       dtype="float32", differentiable=False)
+        self.w_scale.set_data(NDArray(jnp.asarray(ws, jnp.float32)))
+        if hasattr(dense, "bias") and dense.bias is not None:
+            b = dense.bias.data()._data.astype(jnp.float32)
+            self.bias = self.params.get("bias", shape=tuple(b.shape),
+                                        dtype="float32", differentiable=False)
+            self.bias.set_data(NDArray(b))
         self._flatten = dense._flatten
         self._act = dense.act
         self._x_scale = None      # static activation scale after calibration
         self._collector = None
 
-    def hybrid_forward(self, F, x):
+    def hybrid_forward(self, F, x, qweight, w_scale, bias=None):
         if self._flatten:
             x = F.flatten(x)  # Dense(flatten=True) semantics, e.g. pooled NCHW
         if self._collector is not None:
             self._collector.collect(x)
-        # raw jnp weights pass through both facades unchanged
-        y = F.quantized_fully_connected(x, self._qw, self._ws, self._bias,
+        y = F.quantized_fully_connected(x, qweight, w_scale, bias,
                                         x_scale=self._x_scale)
         if self._act is not None:
             y = self._act(y)
@@ -209,17 +330,28 @@ class QuantizedDense(HybridBlock):
 
 
 class QuantizedConv2D(HybridBlock):
-    """Inference-only Conv2D with pre-quantized per-output-channel int8
-    weights (ref: quantized_conv.cc). Grouped convs keep the same layout."""
+    """Inference-only Conv2D with pre-quantized per-output-channel weights
+    (ref: quantized_conv.cc). Grouped convs keep the same layout. Weights
+    live in grad-less Parameters — see :class:`QuantizedDense`."""
 
-    def __init__(self, conv, **kwargs):
+    def __init__(self, conv, mode="int8", **kwargs):
         super().__init__(prefix=conv.prefix, **kwargs)
+        _check_mode(mode)
         w = conv.weight.data()._data.astype(jnp.float32)
-        qw, ws = quantize(w, axis=0)
-        self._qw = jnp.asarray(qw)
-        self._ws = jnp.asarray(ws)
-        self._bias = (conv.bias.data()._data.astype(jnp.float32)
-                      if getattr(conv, "bias", None) is not None else None)
+        qw, ws = quantize_weight(w, axis=0, mode=mode)
+        self._mode = mode
+        self.qweight = self.params.get("qweight", shape=tuple(qw.shape),
+                                       dtype=quant_dtype(mode),
+                                       differentiable=False)
+        self.qweight.set_data(NDArray(qw))
+        self.w_scale = self.params.get("w_scale", shape=tuple(ws.shape),
+                                       dtype="float32", differentiable=False)
+        self.w_scale.set_data(NDArray(jnp.asarray(ws, jnp.float32)))
+        if getattr(conv, "bias", None) is not None:
+            b = conv.bias.data()._data.astype(jnp.float32)
+            self.bias = self.params.get("bias", shape=tuple(b.shape),
+                                        dtype="float32", differentiable=False)
+            self.bias.set_data(NDArray(b))
         k = conv._kwargs
         self._conv_kw = dict(stride=k["stride"], pad=k["pad"], dilate=k["dilate"],
                              num_group=k["num_group"])
@@ -227,10 +359,10 @@ class QuantizedConv2D(HybridBlock):
         self._x_scale = None
         self._collector = None
 
-    def hybrid_forward(self, F, x):
+    def hybrid_forward(self, F, x, qweight, w_scale, bias=None):
         if self._collector is not None:
             self._collector.collect(x)
-        y = F.quantized_conv(x, self._qw, self._ws, self._bias,
+        y = F.quantized_conv(x, qweight, w_scale, bias,
                              x_scale=self._x_scale, **self._conv_kw)
         if self._act is not None:
             y = self._act(y)
@@ -246,14 +378,33 @@ def _quantized_layers(block, out):
     return out
 
 
+def _hybrid_blocks(block, out):
+    if isinstance(block, HybridBlock):
+        out.append(block)
+    for child in block._children.values():
+        _hybrid_blocks(child, out)
+    return out
+
+
+def _invalidate_execs(block):
+    """Drop every compiled executable in the subtree. Swapping a child on an
+    already-hybridized block (quantize_model) or freezing a new static
+    activation scale (calibrate_model) changes the traced program; a stale
+    ``_cached_execs`` entry would silently keep running the old fp32 code."""
+    for b in _hybrid_blocks(block, []):
+        b._cached_execs = {}
+
+
 def calibrate_model(block, calib_data, mode="naive", num_bins=8001):
     """Freeze static activation scales from calibration batches (ref:
     contrib/quantization.py calib_mode='naive'|'entropy').
 
     ``calib_data``: iterable of input batches (materialized to a list so
     entropy's second histogram pass sees the same batches); each element is
-    the net's positional input (or a tuple of them). Runs imperatively —
-    calibrate BEFORE hybridize()."""
+    the net's positional input (or a tuple of them). Calibration forwards run
+    imperatively — hybridized blocks are temporarily de-activated so the
+    collectors see concrete arrays, and every compiled executable is dropped
+    afterwards (the frozen scale is a trace-time constant)."""
     if mode not in ("naive", "entropy"):
         raise ValueError("calib mode must be 'naive' or 'entropy', got %r" % (mode,))
     calib_data = list(calib_data)
@@ -267,43 +418,82 @@ def calibrate_model(block, calib_data, mode="naive", num_bins=8001):
         l._collector = _LayerCollector(mode, num_bins)
         l._x_scale = None         # dynamic during calibration forwards
 
+    hbs = _hybrid_blocks(block, [])
+    prev_active = [(b, b._active) for b in hbs]
+    for b in hbs:
+        b._active = False
+
     def _run():
         for batch in calib_data:
             block(*batch) if isinstance(batch, tuple) else block(batch)
 
-    _run()                        # pass 1: amax
-    if mode == "entropy":
-        for l in layers:
-            l._collector.phase = 2
-        _run()                    # pass 2: histograms over [0, amax]
+    try:
+        _run()                    # pass 1: amax
+        if mode == "entropy":
+            for l in layers:
+                l._collector.phase = 2
+            _run()                # pass 2: histograms over [0, amax]
+    finally:
+        for b, a in prev_active:
+            b._active = a
     for l in layers:
         t = l._collector.threshold()
-        l._x_scale = max(t, 1e-8) / 127.0
+        l._x_scale = max(t, 1e-8) / _QMAX[l._mode]
         l._collector = None
+    _invalidate_execs(block)
+    _QUANT_STATS["calibrated_layers"] = len(layers)
+    _QUANT_STATS["calib_mode"] = mode
     return block
 
 
-def quantize_model(block, exclude=(), calib_mode="none", calib_data=None,
-                   num_bins=8001):
-    """Replace Dense/Conv2D children with their int8 twins (in place),
-    skipping names matching any substring in `exclude`; optionally calibrate
-    static activation ranges (ref: contrib/quantization.py:quantize_model —
-    calib_mode none/naive/entropy)."""
+def _swap_children(block, exclude, mode):
     from .gluon.nn.conv_layers import Conv2D
 
+    swapped = []
     for name, child in list(block._children.items()):
+        if isinstance(child, (QuantizedDense, QuantizedConv2D)):
+            continue              # idempotent: snapshot load re-applies
         q = None
         if not any(e in child.prefix for e in exclude):
             if isinstance(child, nn.Dense):
-                q = QuantizedDense(child)
+                q = QuantizedDense(child, mode=mode)
             elif isinstance(child, Conv2D):
-                q = QuantizedConv2D(child)
+                # fp8 conv is untested territory on most backends — convs
+                # always take the int8 path; fp8 targets the matmuls
+                q = QuantizedConv2D(child, mode="int8")
         if q is not None:
             block._children[name] = q
             if hasattr(block, name):
                 object.__setattr__(block, name, q)
+            swapped.append(q)
         else:
-            quantize_model(child, exclude, calib_mode="none")
+            swapped.extend(_swap_children(child, exclude, mode))
+    return swapped
+
+
+def quantize_model(block, exclude=(), mode="int8", calib_mode="none",
+                   calib_data=None, num_bins=8001):
+    """Replace Dense/Conv2D children with their quantized twins (in place),
+    skipping names matching any substring in `exclude`; optionally calibrate
+    static activation ranges (ref: contrib/quantization.py:quantize_model —
+    calib_mode none/naive/entropy). ``mode``: int8 (default) or fp8
+    e4m3/e5m2 where :func:`fp8_supported` says the build can. Safe to call
+    on an already-quantized model (no-op on quantized children — the
+    snapshot loader relies on this). Compiled executables in the subtree are
+    invalidated so the next forward runs the quantized program."""
+    _check_mode(mode)
+    swapped = _swap_children(block, exclude, mode)
+    _invalidate_execs(block)
+    if swapped:
+        qb = fb = 0
+        for q in _quantized_layers(block, []):
+            qw = q.qweight.data()
+            qb += qw._data.nbytes + q.w_scale.data()._data.nbytes
+            fb += qw.size * 4
+        _QUANT_STATS["quantized_layers"] = len(_quantized_layers(block, []))
+        _QUANT_STATS["weight_bytes_quantized"] = int(qb)
+        _QUANT_STATS["weight_bytes_fp32"] = int(fb)
+        _QUANT_STATS["mode"] = mode
     if calib_mode != "none":
         if calib_data is None:
             raise ValueError("calib_mode=%r requires calib_data" % (calib_mode,))

@@ -186,12 +186,33 @@ class GenerativeServer:
     donate : bool or None
         Donate cache/state buffers to the step programs (default: on for
         TPU backends — the executor-pool donation discipline).
+    quantize : None or 'int8' / 'e4m3' / 'e5m2'
+        Quantized serving: weight-quantize the model in place
+        (``quantization.quantize_model`` — per-channel quantized matmuls
+        with MXU accumulation) AND store KV pages as int8 with per-page-
+        per-head scales. Decode stays ONE dispatch per token step with
+        zero steady-state retrace; the cache costs ~0.5× the bf16 bytes.
+        The model must implement ``decode_step_fixed_quant`` (GPTModel
+        does). fp8 modes require :func:`quantization.fp8_supported`.
     """
 
     def __init__(self, model, slots=8, top_k=0, eos_id=None,
                  max_wait_ms=1.0, max_queue=64, timeout_ms=30000.0,
                  prefix_cache=True, donate=None, name=None,
-                 metrics_port=None):
+                 metrics_port=None, quantize=None):
+        self._quantize = quantize or None
+        if self._quantize is not None:
+            if not hasattr(model, "decode_step_fixed_quant"):
+                raise ServeError(
+                    "quantize=%r: model %s has no decode_step_fixed_quant — "
+                    "the int8 paged-KV decode protocol (see models.gpt."
+                    "GPTModel)" % (quantize, type(model).__name__))
+            from ..quantization import quantize_model
+
+            # weight quantization BEFORE the param-list capture below so
+            # the serving param store carries qweight/w_scale pages;
+            # idempotent on an already-quantized model (snapshot load)
+            quantize_model(model, mode=self._quantize)
         spec = model.decode_state_spec()
         self.model = model
         self.name = name or ("generate:%s" % type(model).__name__.lower())
@@ -202,7 +223,8 @@ class GenerativeServer:
         self._plist = list(model.collect_params().values())
         self.cache = PagedKVCache(
             spec["layers"], spec["heads"], spec["head_dim"], self.slots,
-            spec["max_length"], dtype=spec["dtype"])
+            spec["max_length"], dtype=spec["dtype"],
+            quantize=self._quantize is not None)
         self.prefix = PrefixCache() if prefix_cache else None
         self.metrics = GenerativeMetrics(self.name)
         self._donate = is_tpu_backend() if donate is None else bool(donate)
@@ -395,26 +417,47 @@ class GenerativeServer:
         try:
             if scope is not None:
                 scope.__enter__()
+            kss = vss = None
             if hit is not None:
                 k_stack, v_stack, plen, last = hit
                 fn = self._inject_fn(tp, self.cache.capacity)
-                kcs, vcs, valid, toks = fn(
-                    self.cache.k, self.cache.v, self.cache.valid, self._tok,
-                    jnp.asarray(k_stack), jnp.asarray(v_stack),
-                    jnp.int32(plen), jnp.int32(slot), jnp.asarray(last),
-                    jnp.asarray(key), jnp.float32(stream.temperature))
+                if self._quantize:
+                    # prefix entries stay in the fp format: inject
+                    # re-quantizes into the slot's page (exact round-trip
+                    # with extract's dequantize — same scale re-derives)
+                    kcs, kss, vcs, vss, valid, toks = fn(
+                        self.cache.k, self.cache.k_scale, self.cache.v,
+                        self.cache.v_scale, self.cache.valid, self._tok,
+                        jnp.asarray(k_stack), jnp.asarray(v_stack),
+                        jnp.int32(plen), jnp.int32(slot), jnp.asarray(last),
+                        jnp.asarray(key), jnp.float32(stream.temperature))
+                else:
+                    kcs, vcs, valid, toks = fn(
+                        self.cache.k, self.cache.v, self.cache.valid,
+                        self._tok, jnp.asarray(k_stack),
+                        jnp.asarray(v_stack), jnp.int32(plen),
+                        jnp.int32(slot), jnp.asarray(last),
+                        jnp.asarray(key), jnp.float32(stream.temperature))
             else:
                 fn = self._prefill_fn(tp, self.cache.capacity)
                 params = [p.data()._data for p in self._plist]
-                kcs, vcs, valid, toks, last = fn(
-                    params, self.cache.k, self.cache.v, self.cache.valid,
-                    self._tok, jnp.asarray(padded), jnp.int32(t0_len),
-                    jnp.int32(slot), jnp.asarray(key),
-                    jnp.float32(stream.temperature))
+                if self._quantize:
+                    kcs, kss, vcs, vss, valid, toks, last = fn(
+                        params, self.cache.k, self.cache.k_scale,
+                        self.cache.v, self.cache.v_scale, self.cache.valid,
+                        self._tok, jnp.asarray(padded), jnp.int32(t0_len),
+                        jnp.int32(slot), jnp.asarray(key),
+                        jnp.float32(stream.temperature))
+                else:
+                    kcs, vcs, valid, toks, last = fn(
+                        params, self.cache.k, self.cache.v, self.cache.valid,
+                        self._tok, jnp.asarray(padded), jnp.int32(t0_len),
+                        jnp.int32(slot), jnp.asarray(key),
+                        jnp.float32(stream.temperature))
         finally:
             if scope is not None:
                 scope.__exit__(None, None, None)
-        self.cache.update(kcs, vcs, valid)
+        self.cache.update(kcs, vcs, valid, kss, vss)
         self._tok = toks
         if hit is None:
             self.metrics.record_prefill()
@@ -422,8 +465,13 @@ class GenerativeServer:
                 # one page read-out per UNIQUE prompt; repeats skip the
                 # whole forward from then on
                 engine.dispatch_counter.bump()
-                ks, vs = self._extract_fn(tp, self.cache.capacity)(
-                    self.cache.k, self.cache.v, jnp.int32(slot))
+                if self._quantize:
+                    ks, vs = self._extract_fn(tp, self.cache.capacity)(
+                        self.cache.k, self.cache.k_scale, self.cache.v,
+                        self.cache.v_scale, jnp.int32(slot))
+                else:
+                    ks, vs = self._extract_fn(tp, self.cache.capacity)(
+                        self.cache.k, self.cache.v, jnp.int32(slot))
                 self.prefix.put(stream.prompt, ks, vs, t0_len,
                                 np.asarray(last))
         first = int(np.asarray(self._tok)[slot])
@@ -459,21 +507,28 @@ class GenerativeServer:
             self._ctl_dirty = False
         fn = self._decode_fn(self.cache.capacity)
         params = [p.data()._data for p in self._plist]
+        if self._quantize:
+            args = (params, self.cache.k, self.cache.k_scale, self.cache.v,
+                    self.cache.v_scale, self.cache.valid, self._tok,
+                    self._dev_active, self._dev_keys, self._dev_temps)
+        else:
+            args = (params, self.cache.k, self.cache.v, self.cache.valid,
+                    self._tok, self._dev_active, self._dev_keys,
+                    self._dev_temps)
         engine.dispatch_counter.bump()
         t0 = time.perf_counter()
         if profiler.is_running():
             with profiler.decode_scope("step", self.slots, n_active):
-                kcs, vcs, valid, nxt = fn(
-                    params, self.cache.k, self.cache.v, self.cache.valid,
-                    self._tok, self._dev_active, self._dev_keys,
-                    self._dev_temps)
+                out = fn(*args)
         else:
-            kcs, vcs, valid, nxt = fn(
-                params, self.cache.k, self.cache.v, self.cache.valid,
-                self._tok, self._dev_active, self._dev_keys,
-                self._dev_temps)
+            out = fn(*args)
+        kss = vss = None
+        if self._quantize:
+            kcs, kss, vcs, vss, valid, nxt = out
+        else:
+            kcs, vcs, valid, nxt = out
         nxt_host = np.asarray(nxt)   # ONE host gather per step — the tokens
-        self.cache.update(kcs, vcs, valid)
+        self.cache.update(kcs, vcs, valid, kss, vss)
         self._tok = nxt
         dt = time.perf_counter() - t0
         self.metrics.record_step(dt, n_active, n_active, self.slots)
@@ -546,6 +601,30 @@ class GenerativeServer:
             return fn
         model, plist, top_k = self.model, self._plist, self.top_k
 
+        if self._quantize:
+            def pure(params, kcs, kss, vcs, vss, valid, toks, active, keys,
+                     temps):
+                # trace-time bump: fires exactly when XLA retraces — the
+                # zero-steady-state-retrace proof tests assert (the
+                # quantized step keeps the identical contract)
+                engine.decode_compile_counter.bump()
+                with _trace.trace_scope(jax.random.PRNGKey(0), False) as t:
+                    t.param_store = {id(p): a
+                                     for p, a in zip(plist, params)}
+                    logits, kcs, kss, vcs, vss = \
+                        model.decode_step_fixed_quant(
+                            _trace.F, toks, kcs, kss, vcs, vss, valid)
+                nxt = sample_tokens(logits, keys, valid + 1, temps, top_k)
+                act = active > 0
+                nxt = jnp.where(act, nxt, 0)
+                valid = valid + act.astype(jnp.int32)
+                return kcs, kss, vcs, vss, valid, nxt
+
+            fn = self._jit(pure, donate=(1, 2, 3, 4, 5, 6),
+                           hint="step@c%d" % capacity)
+            self._decode_fns[capacity] = fn
+            return fn
+
         def pure(params, kcs, vcs, valid, toks, active, keys, temps):
             # trace-time bump: fires exactly when XLA retraces — the
             # zero-steady-state-retrace proof tests assert
@@ -567,12 +646,70 @@ class GenerativeServer:
         self._decode_fns[capacity] = fn
         return fn
 
+    @staticmethod
+    def _quantize_pages(pages, plen, tp):
+        """Quantize per-layer fp K or V (1, H, tp, D) into int8 pages with
+        a fresh per-head scale, masking positions ≥ plen out of the amax
+        (pad garbage must not inflate the scale). Fresh overwrite, not a
+        running max: slot reuse relies on prefill/inject resetting the
+        page scale. Returns [(q (1,H,tp,D) int8, scale (1,H,1,1) f32)]."""
+        maskf = (jnp.arange(tp) < plen).astype(jnp.float32).reshape(
+            (1, 1, tp, 1))
+        out = []
+        for a in pages:
+            a = a.astype(jnp.float32) * maskf
+            amax = jnp.max(jnp.abs(a), axis=(2, 3), keepdims=True)
+            scale = jnp.maximum(amax / 127.0, 1e-8)
+            q = jnp.clip(jnp.round(a / scale), -127, 127).astype(jnp.int8)
+            out.append((q, scale))
+        return out
+
     def _prefill_fn(self, tp, capacity):
         fn = self._prefill_fns.get((tp, capacity))
         if fn is not None:
             return fn
         model, plist, top_k = self.model, self._plist, self.top_k
         zero = jnp.int32(0)
+
+        if self._quantize:
+            quantize_pages = self._quantize_pages
+
+            def pure(params, kcs, kss, vcs, vss, valid, toks, tokens, plen,
+                     slot, key, temp):
+                engine.decode_compile_counter.bump()
+                with _trace.trace_scope(jax.random.PRNGKey(0), False) as t:
+                    t.param_store = {id(p): a
+                                     for p, a in zip(plist, params)}
+                    logits, kvs = model.forward_collect_kv(_trace.F, tokens)
+                qk = quantize_pages([k for k, _v in kvs], plen, tp)
+                qv = quantize_pages([v for _k, v in kvs], plen, tp)
+                kcs = [jax.lax.dynamic_update_slice(
+                    kc, q, (slot, zero, zero, zero))
+                    for kc, (q, _s) in zip(kcs, qk)]
+                kss = [jax.lax.dynamic_update_slice(
+                    ks, s, (slot, zero, zero, zero))
+                    for ks, (_q, s) in zip(kss, qk)]
+                vcs = [jax.lax.dynamic_update_slice(
+                    vc, q, (slot, zero, zero, zero))
+                    for vc, (q, _s) in zip(vcs, qv)]
+                vss = [jax.lax.dynamic_update_slice(
+                    vs, s, (slot, zero, zero, zero))
+                    for vs, (_q, s) in zip(vss, qv)]
+                valid = jax.lax.dynamic_update_slice(
+                    valid, jnp.reshape(plen, (1,)), (slot,))
+                last = jnp.reshape(jax.lax.dynamic_slice(
+                    logits, (zero, plen - 1, zero),
+                    (1, 1, logits.shape[2])), (1, -1))
+                t0 = sample_tokens(last, key[None], plen[None], temp[None],
+                                   top_k)
+                toks = jax.lax.dynamic_update_slice(toks, t0, (slot,))
+                return (kcs, kss, vcs, vss, valid, toks,
+                        jnp.reshape(last, (-1,)))
+
+            fn = self._jit(pure, donate=(1, 2, 3, 4, 5, 6),
+                           hint="prefill@t%dc%d" % (tp, capacity))
+            self._prefill_fns[(tp, capacity)] = fn
+            return fn
 
         def pure(params, kcs, vcs, valid, toks, tokens, plen, slot, key,
                  temp):
@@ -608,6 +745,41 @@ class GenerativeServer:
         top_k = self.top_k
         zero = jnp.int32(0)
 
+        if self._quantize:
+            quantize_pages = self._quantize_pages
+
+            def pure(kcs, kss, vcs, vss, valid, toks, k_stack, v_stack,
+                     plen, slot, last, key, temp):
+                engine.decode_compile_counter.bump()
+                L = len(kcs)
+                qk = quantize_pages([k_stack[i][None] for i in range(L)],
+                                    plen, tp)
+                qv = quantize_pages([v_stack[i][None] for i in range(L)],
+                                    plen, tp)
+                kcs = [jax.lax.dynamic_update_slice(
+                    kc, q, (slot, zero, zero, zero))
+                    for kc, (q, _s) in zip(kcs, qk)]
+                kss = [jax.lax.dynamic_update_slice(
+                    ks, s, (slot, zero, zero, zero))
+                    for ks, (_q, s) in zip(kss, qk)]
+                vcs = [jax.lax.dynamic_update_slice(
+                    vc, q, (slot, zero, zero, zero))
+                    for vc, (q, _s) in zip(vcs, qv)]
+                vss = [jax.lax.dynamic_update_slice(
+                    vs, s, (slot, zero, zero, zero))
+                    for vs, (_q, s) in zip(vss, qv)]
+                valid = jax.lax.dynamic_update_slice(
+                    valid, jnp.reshape(plen, (1,)), (slot,))
+                t0 = sample_tokens(last[None], key[None], plen[None],
+                                   temp[None], top_k)
+                toks = jax.lax.dynamic_update_slice(toks, t0, (slot,))
+                return kcs, kss, vcs, vss, valid, toks
+
+            fn = self._jit(pure, donate=(0, 1, 2, 3, 4, 5),
+                           hint="inject@t%dc%d" % (tp, capacity))
+            self._inject_fns[(tp, capacity)] = fn
+            return fn
+
         def pure(kcs, vcs, valid, toks, k_stack, v_stack, plen, slot, last,
                  key, temp):
             engine.decode_compile_counter.bump()
@@ -635,6 +807,32 @@ class GenerativeServer:
             return fn
         H, D = self.cache.heads, self.cache.head_dim
         zero = jnp.int32(0)
+
+        if self._quantize:
+            def pure(kcs, kss, vcs, vss, slot):
+                # prefix entries store fp pages: dequantize on read-out so
+                # the PrefixCache format is quantization-agnostic (inject
+                # re-quantizes exactly — the max element re-derives the
+                # same scale)
+                engine.decode_compile_counter.bump()
+
+                def slice_deq(cs, ss):
+                    out = []
+                    for c, s in zip(cs, ss):
+                        page = jax.lax.dynamic_slice(
+                            c, (slot, zero, zero, zero), (1, H, tp, D))
+                        sc = jax.lax.dynamic_slice(
+                            s, (slot, zero, zero, zero), (1, H, 1, 1))
+                        out.append((page.astype(jnp.float32) * sc)[0])
+                    return jnp.stack(out)
+
+                return slice_deq(kcs, kss), slice_deq(vcs, vss)
+
+            # reads live caches: never donate
+            fn = self._jit(pure, donate=(),
+                           hint="extract@t%dc%d" % (tp, capacity))
+            self._extract_fns[(tp, capacity)] = fn
+            return fn
 
         def pure(kcs, vcs, slot):
             engine.decode_compile_counter.bump()
@@ -671,23 +869,45 @@ class GenerativeServer:
             params = [p.data()._data for p in self._plist]
             key = np.asarray(jax.random.PRNGKey(0), np.uint32)
             padded = np.zeros((1, tp), np.int32)
-            kcs, vcs, valid, toks, _last = fn(
-                params, self.cache.k, self.cache.v, self.cache.valid,
-                self._tok, jnp.asarray(padded), jnp.int32(int(b)),
-                jnp.int32(slot), jnp.asarray(key), jnp.float32(0.0))
-            self.cache.update(kcs, vcs, valid)
+            if self._quantize:
+                kcs, kss, vcs, vss, valid, toks, _last = fn(
+                    params, self.cache.k, self.cache.k_scale, self.cache.v,
+                    self.cache.v_scale, self.cache.valid, self._tok,
+                    jnp.asarray(padded), jnp.int32(int(b)), jnp.int32(slot),
+                    jnp.asarray(key), jnp.float32(0.0))
+                self.cache.update(kcs, vcs, valid, kss, vss)
+            else:
+                kcs, vcs, valid, toks, _last = fn(
+                    params, self.cache.k, self.cache.v, self.cache.valid,
+                    self._tok, jnp.asarray(padded), jnp.int32(int(b)),
+                    jnp.int32(slot), jnp.asarray(key), jnp.float32(0.0))
+                self.cache.update(kcs, vcs, valid)
             self._tok = toks
             if self.prefix is not None:
                 # prefix-store (extract) and replay (inject) programs are
                 # part of the join path: compile them now too
-                ks, vs = self._extract_fn(tp, self.cache.capacity)(
-                    self.cache.k, self.cache.v, jnp.int32(slot))
-                kcs, vcs, valid, toks = self._inject_fn(
-                    tp, self.cache.capacity)(
-                    self.cache.k, self.cache.v, self.cache.valid, self._tok,
-                    ks, vs, jnp.int32(int(b)), jnp.int32(slot),
-                    jnp.asarray(_last), jnp.asarray(key), jnp.float32(0.0))
-                self.cache.update(kcs, vcs, valid)
+                if self._quantize:
+                    ks, vs = self._extract_fn(tp, self.cache.capacity)(
+                        self.cache.k, self.cache.k_scale, self.cache.v,
+                        self.cache.v_scale, jnp.int32(slot))
+                    kcs, kss, vcs, vss, valid, toks = self._inject_fn(
+                        tp, self.cache.capacity)(
+                        self.cache.k, self.cache.k_scale, self.cache.v,
+                        self.cache.v_scale, self.cache.valid, self._tok,
+                        ks, vs, jnp.int32(int(b)), jnp.int32(slot),
+                        jnp.asarray(_last), jnp.asarray(key),
+                        jnp.float32(0.0))
+                    self.cache.update(kcs, vcs, valid, kss, vss)
+                else:
+                    ks, vs = self._extract_fn(tp, self.cache.capacity)(
+                        self.cache.k, self.cache.v, jnp.int32(slot))
+                    kcs, vcs, valid, toks = self._inject_fn(
+                        tp, self.cache.capacity)(
+                        self.cache.k, self.cache.v, self.cache.valid,
+                        self._tok, ks, vs, jnp.int32(int(b)),
+                        jnp.int32(slot), jnp.asarray(_last),
+                        jnp.asarray(key), jnp.float32(0.0))
+                    self.cache.update(kcs, vcs, valid)
                 self._tok = toks
             self.cache.release(slot)
         # one masked all-free decode dispatch compiles the step program
@@ -764,6 +984,9 @@ class GenerativeServer:
             prefix_entries=(len(self.prefix) if self.prefix is not None
                             else None),
             decode_compile_counter=engine.decode_compile_counter.count,
+            quantize=self._quantize,
+            kv_cache_bytes=self.cache.nbytes(),
+            kv_cache_bytes_unquantized=self.cache.nbytes_unquantized(),
             running=(self._loop_thread is not None
                      and self._loop_thread.is_alive()),
         )

@@ -56,19 +56,33 @@ class PagedKVCache:
     dtype : np.dtype
         K/V element dtype (the model's parameter dtype; bf16 models
         cache in bf16).
+    quantize : bool
+        Store pages as int8 with per-page-per-head fp32 scales
+        (``k_scale``/``v_scale``, (slots, H, 1, 1) per layer): ~0.5× the
+        bf16 page bytes. Pages quantize on write (``quant_cache_write``'s
+        running-max scale) and dequantize on read inside the decode
+        program; capacity buckets, donation and the one-dispatch step are
+        unchanged. Scale buffers are capacity-independent, so migrations
+        only pad the int8 pages.
     """
 
     def __init__(self, layers, heads, head_dim, slots, max_capacity,
-                 dtype=np.float32):
+                 dtype=np.float32, quantize=False):
         self.layers = int(layers)
         self.heads = int(heads)
         self.head_dim = int(head_dim)
         self.slots = int(slots)
         self.max_capacity = int(max_capacity)
-        self.dtype = np.dtype(dtype)
+        self.quantize = bool(quantize)
+        self.dtype = np.dtype(np.int8) if self.quantize else np.dtype(dtype)
+        # what a non-quantized cache of the model's dtype would cost per
+        # element — the denominator of the bytes-saved accounting
+        self._ref_itemsize = np.dtype(dtype).itemsize
         self.capacity = 0
         self.k = None     # list[L] of (slots, H, capacity, D) jax arrays
         self.v = None
+        self.k_scale = None  # list[L] of (slots, H, 1, 1) fp32 (quantized)
+        self.v_scale = None
         self.valid = jnp.zeros((self.slots,), jnp.int32)
         self._free = list(range(self.slots))
         self._owner = [None] * self.slots
@@ -97,10 +111,17 @@ class PagedKVCache:
         if self.k is None:
             self.k = [jnp.zeros(shape, self.dtype) for _ in range(self.layers)]
             self.v = [jnp.zeros(shape, self.dtype) for _ in range(self.layers)]
+            if self.quantize:
+                sshape = (self.slots, self.heads, 1, 1)
+                self.k_scale = [jnp.zeros(sshape, jnp.float32)
+                                for _ in range(self.layers)]
+                self.v_scale = [jnp.zeros(sshape, jnp.float32)
+                                for _ in range(self.layers)]
         else:
             pad = ((0, 0), (0, 0), (0, cap - self.capacity), (0, 0))
             self.k = [jnp.pad(k, pad) for k in self.k]
             self.v = [jnp.pad(v, pad) for v in self.v]
+            # scale buffers are (slots, H, 1, 1) — capacity-independent
             self.migrations += 1
         self.capacity = cap
         return True
@@ -141,10 +162,37 @@ class PagedKVCache:
         return np.asarray([0 if o is None else 1 for o in self._owner],
                           np.int32)
 
-    def update(self, k, v, valid):
+    def update(self, k, v, valid, k_scale=None, v_scale=None):
         """Install the arrays a compiled step returned (the old buffers
         were donated on TPU — they must not be touched again)."""
         self.k, self.v, self.valid = list(k), list(v), valid
+        if k_scale is not None:
+            self.k_scale = list(k_scale)
+        if v_scale is not None:
+            self.v_scale = list(v_scale)
+
+    # ------------------------------------------------------------ accounting
+    def nbytes(self):
+        """Live page-buffer bytes (K + V + scales) — the measured side of
+        the quantized-cache acceptance ratio."""
+        if self.k is None:
+            return 0
+        total = sum(int(a.nbytes) for a in self.k)
+        total += sum(int(a.nbytes) for a in self.v)
+        if self.quantize:
+            total += sum(int(a.nbytes) for a in self.k_scale)
+            total += sum(int(a.nbytes) for a in self.v_scale)
+        return total
+
+    def nbytes_unquantized(self, itemsize=None):
+        """What the SAME geometry would cost unquantized — the denominator
+        of the ≤ 0.55× bytes acceptance check. ``itemsize`` defaults to the
+        model dtype's (pass 2 to compare against a bf16 cache)."""
+        if self.k is None:
+            return 0
+        elems = 2 * self.layers * self.slots * self.heads \
+            * self.capacity * self.head_dim
+        return elems * (self._ref_itemsize if itemsize is None else itemsize)
 
 
 class PrefixCache:

@@ -86,12 +86,25 @@ class ModelServer:
         replication — the inference-side complement of ``split_and_load``'s
         per-device sharding). A ``parallel.mesh`` Mesh serves on all its
         devices. None = one replica on the current placement.
+    quantize : str | None
+        Serve with quantized weights: ``"int8"`` (or ``"e4m3"``/``"e5m2"``
+        where the backend ships fp8) swaps every eligible Dense/Conv2D for
+        its quantized twin (``quant.quantize_model``) before the pool
+        compiles, so the warmed bucket programs ARE the quantized programs
+        — snapshot/load round-trips them like any other. SymbolBlocks are
+        served as exported (quantize before export instead).
+    calib_mode, calib_data :
+        Activation-scale calibration for the quantized layers (``"naive"``
+        or ``"entropy"``), run against ``calib_data`` — typically a warmup
+        batch shaped like real traffic — before the pool compiles. Ignored
+        unless ``quantize`` is set.
     """
 
     def __init__(self, model, input_specs, buckets=DEFAULT_BUCKETS,
                  max_wait_ms=2.0, max_queue=256, timeout_ms=1000.0,
                  devices=None, donate=None, name=None, warmup=True,
-                 metrics_port=None):
+                 metrics_port=None, quantize=None, calib_mode="none",
+                 calib_data=None):
         from .metrics import ServeMetrics
 
         if devices is not None and hasattr(devices, "devices"):
@@ -100,6 +113,19 @@ class ModelServer:
 
             devices = list(_np.asarray(devices.devices).flat)
         self.name = name or ("serve:%s" % type(model).__name__.lower())
+        self.quantize = quantize or None
+        if self.quantize is not None:
+            from ..gluon.block import SymbolBlock
+
+            if isinstance(model, SymbolBlock):
+                raise ServeError(
+                    "quantize= needs a live HybridBlock (a SymbolBlock's "
+                    "graph is frozen) — quantize before export, or load "
+                    "the original block")
+            from ..quantization import quantize_model
+
+            quantize_model(model, mode=self.quantize,
+                           calib_mode=calib_mode, calib_data=calib_data)
         self.model = model
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         self._specs = [(tuple(shape), np.dtype(dt))
@@ -279,5 +305,6 @@ class ModelServer:
         snap = self.metrics.snapshot()
         snap.update(buckets=list(self.buckets),
                     replicas=self._pool.num_replicas,
+                    quantize=self.quantize,
                     running=self._started)
         return snap

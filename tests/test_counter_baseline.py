@@ -210,6 +210,66 @@ def test_decode_dispatch_counters_match_artifact():
         % (recompiles, row["steady_state_recompiles"])
 
 
+# --------------------------------------------------------------- quant
+def test_quant_decode_counters_match_artifact():
+    """Quantized-decode gate: the int8 serving path must keep the same
+    one-fused-dispatch/zero-retrace counters as the committed artifact,
+    and the int8 paged-KV byte ratio is deterministic per cache geometry
+    — a cache or decoder change that splits the quantized step or grows
+    the pages fails here even with parity intact."""
+    from mxnet_tpu.models.gpt import gpt_nano
+
+    art = _artifact("quant_bench_quick.json")
+    row = _row(art, "gpt_nano quantized decode (int8)")
+    rng = np.random.default_rng(0)
+    m = gpt_nano()
+    m.initialize()
+    m.hybridize()
+    prompts = [rng.integers(0, 256, size=(int(l),)).astype(np.int32)
+               for l in rng.integers(3, 12, size=8)]
+    srv = mx.serve.GenerativeServer(m, slots=row["slots"], max_wait_ms=1.0,
+                                    max_queue=64, timeout_ms=120000.0,
+                                    quantize=row["quantize"])
+    srv.warmup(prompt_buckets=(4, 8, 16), max_tokens=32)
+    try:
+        streams = [srv.submit(p, max_new_tokens=8) for p in prompts]
+        srv._batcher.start()
+        time.sleep(0.05)
+        engine.decode_compile_counter.reset()
+        pure_disp = pure_steps = 0
+        t0 = time.time()
+        while not all(s.done() for s in streams) and time.time() - t0 < 120:
+            joins0 = srv.metrics.prefills + (srv.prefix.hits
+                                             if srv.prefix else 0)
+            engine.dispatch_counter.reset()
+            n = srv.step()
+            joins1 = srv.metrics.prefills + (srv.prefix.hits
+                                             if srv.prefix else 0)
+            if n and joins1 == joins0:
+                pure_disp += engine.dispatch_counter.count
+                pure_steps += 1
+            elif n == 0:
+                time.sleep(0.001)
+        assert pure_steps > 0
+        for s in streams:
+            assert len(s.result(10)) == 8
+        dps = pure_disp / pure_steps
+        recompiles = engine.decode_compile_counter.count
+        ratio = round(srv.cache.nbytes()
+                      / srv.cache.nbytes_unquantized(itemsize=2), 4)
+    finally:
+        srv.stop()
+    assert dps == row["dispatches_per_step"], \
+        "quantized decode now takes %.2f dispatches per token step " \
+        "(baseline %.2f)" % (dps, row["dispatches_per_step"])
+    assert recompiles == row["steady_state_recompiles"], \
+        "%d steady-state quantized-decode recompiles (baseline %d)" \
+        % (recompiles, row["steady_state_recompiles"])
+    assert ratio == row["kv_bytes_vs_bf16"], \
+        "int8 KV pages now %.4fx bf16 bytes (baseline %.4fx)" \
+        % (ratio, row["kv_bytes_vs_bf16"])
+
+
 # ---------------------------------------------------------------- dist
 def test_dist_exchange_counters_match_artifact():
     """The overlapped-exchange gate: bucket dispatches per step and
@@ -252,6 +312,12 @@ def test_dist_exchange_counters_match_artifact():
                                "overlapped_dispatches_per_step",
                                "steady_state_bucket_builds",
                                "loss_trajectory_max_diff"]),
+    # row-specific quant columns (dispatches_per_step, top1_agreement on
+    # the nano row; speedup_vs_bf16 >= 1 on the wide row) are pinned in
+    # tests/test_quant.py::test_quant_bench_artifact_pins
+    ("quant_bench_quick.json", ["steady_state_recompiles",
+                                "kv_bytes_vs_bf16",
+                                "kv_cache_bytes"]),
 ])
 def test_committed_artifacts_carry_counter_columns(name, counter_cols):
     """The gate only works while the artifacts keep their counter columns —

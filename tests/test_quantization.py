@@ -108,3 +108,91 @@ def test_entropy_threshold_clips_outliers():
     hist[8000] = 1.0      # single outlier at the max
     t = _optimal_threshold(hist, amax=100.0)
     assert t < 100.0
+
+
+def test_entropy_threshold_never_exceeds_amax():
+    """Entropy folds clipped mass into the edge bin rather than widening
+    the range: on ANY activation distribution the chosen threshold stays
+    <= the observed amax (the naive scale), positive, and finite."""
+    from mxnet_tpu.quantization import _quantized_layers
+
+    rng = np.random.RandomState(7)
+    batches = [nd.array((rng.randn(8, 16) * (1 + 3 * rng.rand()))
+                        .astype(np.float32)) for _ in range(3)]
+    amax = max(float(np.abs(b.asnumpy()).max()) for b in batches)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, in_units=16))
+    net.initialize()
+    quantize_model(net, calib_mode="entropy", calib_data=batches)
+    (layer,) = _quantized_layers(net, [])
+    # _x_scale = threshold / 127: recover the threshold it froze
+    assert 0 < layer._x_scale * 127.0 <= amax + 1e-6
+
+
+def test_calibration_two_pass_determinism():
+    """Identical calibration batches must freeze identical static scales
+    (the entropy collector histograms in pass 2 over the pass-1 amax —
+    any order- or state-dependence would break replayability)."""
+    from mxnet_tpu.quantization import _quantized_layers
+
+    rng = np.random.RandomState(11)
+    batches = [nd.array(rng.randn(8, 16).astype(np.float32))
+               for _ in range(3)]
+    for mode in ("naive", "entropy"):
+        scales = []
+        for _ in range(2):
+            net = gluon.nn.HybridSequential()
+            net.add(gluon.nn.Dense(32, activation="relu", in_units=16),
+                    gluon.nn.Dense(8, in_units=32))
+            net.initialize(init="ones")   # identical nets both rounds
+            quantize_model(net, calib_mode=mode, calib_data=batches)
+            scales.append([l._x_scale
+                           for l in _quantized_layers(net, [])])
+        assert scales[0] == scales[1], mode
+
+
+def test_static_vs_dynamic_scale_parity():
+    """Static (calibrated) and dynamic (per-batch amax) activation scales
+    must agree closely on data drawn from the calibration distribution —
+    naive calibration over batches that INCLUDE the eval batch freezes a
+    scale >= the eval batch's amax, so outputs differ only by rounding."""
+    rng = np.random.RandomState(13)
+    batches = [nd.array(rng.randn(8, 16).astype(np.float32))
+               for _ in range(4)]
+
+    def build():
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(32, activation="relu", in_units=16),
+                gluon.nn.Dense(8, in_units=32))
+        net.initialize()
+        return net
+
+    dyn, stat = build(), build()
+    for ps, pd in zip(dyn.collect_params().values(),
+                      stat.collect_params().values()):
+        pd.set_data(ps.data())
+    quantize_model(dyn)                   # dynamic scales
+    quantize_model(stat, calib_mode="naive", calib_data=batches)
+    for b in batches:
+        d = dyn(b).asnumpy()
+        s = stat(b).asnumpy()
+        denom = np.abs(d).max() + 1e-6
+        assert np.abs(s - d).max() / denom < 0.05
+
+
+def test_quantize_model_grouped_conv_block():
+    """quantize_model through a grouped Conv2D block (num_group>1): the
+    swapped QuantizedConv2D must keep the grouped layout and parity."""
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 3, padding=1, groups=2, in_channels=4,
+                            activation="relu"),
+            gluon.nn.Conv2D(4, 1, in_channels=8))
+    net.initialize()
+    x = nd.array(np.random.RandomState(5).randn(2, 4, 8, 8)
+                 .astype(np.float32))
+    ref = net(x).asnumpy()
+    quantize_model(net)
+    out = net(x).asnumpy()
+    assert out.shape == ref.shape
+    denom = np.abs(ref).max() + 1e-6
+    assert np.abs(out - ref).max() / denom < 0.1

@@ -207,6 +207,23 @@ def main():
               % (lr.get("failed_step"), lr.get("survivors"),
                  lr.get("resumed_from")))
 
+    print("----------Quantization----------")
+    # mxnet_tpu.quant: the serving-grade quantized-inference subsystem —
+    # swap/calibration tallies plus the weight-bytes ratio. Attach when
+    # reporting quantized-serving accuracy or throughput regressions.
+    qt = snap["quant"]
+    if qt.get("subsystem") == "not loaded":
+        print("layers       : subsystem not loaded (import mxnet_tpu.quant)")
+    else:
+        ratio = (float(qt["weight_bytes_quantized"])
+                 / qt["weight_bytes_fp32"]) if qt["weight_bytes_fp32"] else 0.0
+        print("layers       : %d quantized (mode=%s), %d calibrated "
+              "(calib=%s)" % (qt["quantized_layers"], qt["mode"],
+                              qt["calibrated_layers"], qt["calib_mode"]))
+        print("weight bytes : %d quantized vs %d fp32 (%.2fx)"
+              % (qt["weight_bytes_quantized"], qt["weight_bytes_fp32"],
+                 ratio))
+
     print("----------Observability----------")
     # the unified-telemetry layer itself: registry size, compile-time
     # accounting, the retrace watchdog, request tracing, and the bounded

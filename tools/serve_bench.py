@@ -121,12 +121,15 @@ def run_served(net, samples, iters, buckets, max_wait_ms):
     return (len(samples) * iters / best, disp, outs, recompiles, stats)
 
 
-def run_decode(requests, iters, max_new, slots, seed=0):
+def run_decode(requests, iters, max_new, slots, seed=0, quantize=None):
     """Generative decode bench: naive per-request ``generate()`` (the
     imperative KV-cached loop — one step ROUND of per-op dispatches per
     token per request) vs. continuous batching (ONE fused dispatch per
     token step for ALL in-flight requests). Greedy both sides; parity is
-    exact token ids. Returns the artifact row."""
+    exact token ids — except under ``--quantize``, where the served side
+    runs int8 weights + int8 KV pages and parity becomes top-1 agreement
+    against the fp32 naive decode (tools/quant_bench.py is the dedicated
+    quantized-decode artifact). Returns the artifact row."""
     import numpy as np
 
     import mxnet_tpu as mx
@@ -163,7 +166,7 @@ def run_decode(requests, iters, max_new, slots, seed=0):
     # dispatch accounting (the background loop runs the same tick)
     srv = mx.serve.GenerativeServer(m, slots=slots, max_wait_ms=1.0,
                                     max_queue=max(64, requests),
-                                    timeout_ms=120000.0)
+                                    timeout_ms=120000.0, quantize=quantize)
     srv.warmup(prompt_buckets=(4, 8, 16), max_tokens=32)
     served_best, served_dps, recompiles = float("inf"), 0.0, 0
     for _ in range(iters):
@@ -189,14 +192,21 @@ def run_decode(requests, iters, max_new, slots, seed=0):
         served_best = min(served_best, time.perf_counter() - t0)
         served_dps = pure_disp / max(pure_steps, 1)
         recompiles = engine.decode_compile_counter.count
+        agree = same = 0
         for s, ref in zip(streams, refs):
             got = s.result(10)
-            assert got == ref, "decode parity violated"
+            if quantize is None:
+                assert got == ref, "decode parity violated"
+            else:
+                same += sum(1 for a, b in zip(got, ref) if a == b)
+                agree += len(ref)
     served_tps = tokens_total / served_best
     stats = srv.stats()
     srv.stop()
     return {
-        "case": "gpt_nano decode",
+        "case": ("gpt_nano decode" if quantize is None
+                 else "gpt_nano decode (%s)" % quantize),
+        "quantize": quantize,
         "requests": requests,
         "max_new_tokens": max_new,
         "slots": slots,
@@ -211,7 +221,11 @@ def run_decode(requests, iters, max_new, slots, seed=0):
         "ttft_p50_ms": stats["ttft_p50_ms"],
         "itl_p50_ms": stats["itl_p50_ms"],
         "prefix_hits": stats["prefix_hits"],
-        "parity": "exact token ids vs per-request generate()",
+        "kv_cache_bytes": stats["kv_cache_bytes"],
+        "parity": ("exact token ids vs per-request generate()"
+                   if quantize is None else
+                   "top-1 agreement %.4f vs fp32 generate()"
+                   % (same / max(agree, 1))),
     }
 
 
@@ -371,6 +385,10 @@ def main(argv=None):
                     help="decode mode: tokens generated per request")
     ap.add_argument("--slots", type=int, default=8,
                     help="decode mode: in-flight request pages")
+    ap.add_argument("--quantize", choices=("int8", "e4m3", "e5m2"),
+                    default=None,
+                    help="decode mode: serve with quantized weights + int8 "
+                         "KV pages (parity becomes top-1 agreement)")
     ap.add_argument("--max-wait-ms", type=float, default=2.0)
     ap.add_argument("--json", default=None, metavar="PATH")
     args = ap.parse_args(argv)
@@ -415,7 +433,8 @@ def main(argv=None):
 
     if args.mode == "decode":
         rec = run_decode(args.requests if args.requests != 128 else 16,
-                         args.iters, args.max_new, args.slots)
+                         args.iters, args.max_new, args.slots,
+                         quantize=args.quantize)
         print(json.dumps(rec), flush=True)
         if args.json:
             meta = {"quick": args.quick, "mode": "decode",

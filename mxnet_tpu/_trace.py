@@ -71,7 +71,11 @@ class _TracedF:
                 kwargs["training"] = ctx.training if ctx is not None else False
             if opdef.needs_rng and "key" not in kwargs and kwargs.get("training", False):
                 kwargs["key"] = ctx.next_key() if ctx is not None else jax.random.PRNGKey(0)
-            return opdef.fn(*args, **kwargs)
+            # registry-op provenance in the HLO metadata (op_name=...):
+            # the hybrid/serve/decode captures keep their op names end to
+            # end, like the IR runner's per-node scope (ir/graph.py)
+            with jax.named_scope(name):
+                return opdef.fn(*args, **kwargs)
 
         f.__name__ = name
         object.__setattr__(self, name, f)

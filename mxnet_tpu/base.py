@@ -148,8 +148,15 @@ def _jit_backed(fn, device=None, donate=None, tier="jit", hint=""):
     default — zero added overhead), a ``cache.AotFn`` when
     ``MXNET_COMP_CACHE_DIR`` is configured, so the compiled executable is
     persisted across processes (mxnet_tpu.cache Tier A). graphlint GL008
-    flags direct ``jax.jit`` call sites that bypass this funnel."""
+    flags direct ``jax.jit`` call sites that bypass this funnel.
+
+    Because every capture path funnels through here, cost attribution
+    (observability.costs) sees every program: the AotFn path records
+    eagerly inside ``_acquire``; the plain-jit path is wrapped by
+    ``costs.tracked`` (a per-call cache-size poll + lazy analysis).
+    ``MXNET_COST_ATTRIBUTION=0`` restores the bare ``jax.jit`` return."""
     from .cache import persistent_backed
+    from .observability import costs
 
     backed = persistent_backed(fn, device=device, donate_argnums=donate,
                                tier=tier, hint=hint)
@@ -160,7 +167,7 @@ def _jit_backed(fn, device=None, donate=None, tier="jit", hint=""):
         kw["donate_argnums"] = tuple(donate)
     if device is not None:
         kw["device"] = device
-    return jax.jit(fn, **kw)
+    return costs.tracked(jax.jit(fn, **kw), tier, hint)
 
 
 def bulk_jitted(key, builder):

@@ -129,7 +129,7 @@ class AotFn:
         import time
 
         from . import active_store
-        from ..observability import note_compile, watchdog
+        from ..observability import costs, note_compile, watchdog
 
         t0 = time.perf_counter()
         with watchdog.compile_context("%s:%s" % (self.tier,
@@ -143,6 +143,10 @@ class AotFn:
                 if store is not None:
                     store.save(self.tier, lowered, compiled)
         note_compile(time.perf_counter() - t0)
+        # eager cost attribution: the Compiled is in hand, profiling is
+        # two XLA property reads (adopt() snapshot warm-starts have no
+        # lowered handle and are skipped by design)
+        costs.record_compiled(self.tier, self.hint, lowered, compiled)
         if self._single:
             self._only = compiled
         else:

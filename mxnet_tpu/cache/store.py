@@ -55,8 +55,10 @@ ENTRY_SUFFIX = ".mxc"
 # unified graph IR (mxnet_tpu.ir.lower) lowers every capture through
 # base._jit_backed with the CAPTURE's tier name ("bulk"/"tape"/"symbol"),
 # so cross-capture dedup upstream only ever SHRINKS a tier's population —
-# one canonical program persists once, under the tier that built it first
-TIERS = ("jit", "bulk", "tape", "hybrid", "serve", "decode")
+# one canonical program persists once, under the tier that built it first.
+# "symbol" must be listed: its entries are written like any other tier's,
+# and a tier missing here is invisible to scan()/gc() (unbounded growth).
+TIERS = ("jit", "bulk", "tape", "hybrid", "symbol", "serve", "decode")
 
 
 def _warn(msg):

@@ -442,7 +442,12 @@ class HybridBlock(Block):
     def _call_traced(self, *args, **kwargs):
         tctx = _trace.current_trace()
         pkwargs = {n: tctx.param_store[id(p)] for n, p in self._reg_params.items()}
-        return self.hybrid_forward(_trace.F, *args, **pkwargs, **kwargs)
+        # block-name scope nests with the per-op scopes from _trace.F, so
+        # optimized-HLO metadata reads "dense0/FullyConnected/..." — the
+        # provenance tools/profile_hlo_map.py names sinks from
+        with jax.named_scope(str(getattr(self, "name", None)
+                                 or type(self).__name__)):
+            return self.hybrid_forward(_trace.F, *args, **pkwargs, **kwargs)
 
     # ------------------------------------------------------------ compiled
     def _get_exec(self, training, plist):

@@ -500,21 +500,28 @@ def build_runner(graph, probes=None):
     at its production site — the tape's intermediate-gradient injection
     points. The returned function is jax-traceable; lowering jits it
     through ``base._jit_backed``."""
-    steps = [(n.fn, n.static, n.specs, n.kw_names, n.kw_specs, n.n_out)
-             for n in graph.nodes]
+    steps = [(n.fn, n.static, n.specs, n.kw_names, n.kw_specs, n.n_out,
+              n.op) for n in graph.nodes]
     outputs = graph.outputs
     probe = dict(probes or {})
 
     def run(lv, tv=()):
         env = []
-        for fn, static, specs, kwn, kws, n_out in steps:
+        for fn, static, specs, kwn, kws, n_out, op in steps:
             vals = [env[s] if s >= 0 else lv[~s] for s in specs]
-            if kwn or static:
-                kw = {k: (env[s] if s >= 0 else lv[~s])
-                      for k, s in zip(kwn, kws)}
-                r = fn(*vals, **kw, **static)
-            else:
-                r = fn(*vals)
+            # named_scope stamps the IR node's op name into the HLO
+            # metadata (op_name=...), so optimized-HLO sinks carry their
+            # graph provenance end to end (tools/profile_hlo_map.py,
+            # observability.costs). Trace-time only — zero runtime cost,
+            # and invisible to the default lowered text the comp-cache
+            # digests, so content keys are unchanged.
+            with jax.named_scope(op):
+                if kwn or static:
+                    kw = {k: (env[s] if s >= 0 else lv[~s])
+                          for k, s in zip(kwn, kws)}
+                    r = fn(*vals, **kw, **static)
+                else:
+                    r = fn(*vals)
             flat = jax.tree_util.tree_leaves(r) if n_out != 1 else [r]
             for v in flat:
                 pk = probe.get(len(env))

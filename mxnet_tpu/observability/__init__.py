@@ -23,6 +23,7 @@ registry reads them, it does not replace them.
 """
 from __future__ import annotations
 
+from . import costs  # noqa: F401
 from . import watchdog  # noqa: F401
 from .http import MetricsHTTPServer  # noqa: F401
 from .registry import (Counter, Gauge, Histogram,  # noqa: F401
@@ -35,7 +36,7 @@ __all__ = ["registry", "snapshot", "prometheus", "MetricsRegistry",
            "set_tracing", "tracing_enabled", "arm_watchdog",
            "disarm_watchdog", "MetricsHTTPServer", "enable_op_telemetry",
            "op_telemetry_enabled", "note_compile", "render_prometheus",
-           "device_section"]
+           "device_section", "costs"]
 
 # the process-wide default registry (module-level by design: it is the
 # blessed home for metric state — graphlint GL009 polices ad-hoc metric
@@ -213,7 +214,16 @@ def _collect_quant():
     return q.stats()
 
 
+def _collect_costs():
+    # per-program cost attribution (costs.py): drains any parked lowered
+    # handles (the one place the lazy path pays its explicit compiles),
+    # then reports bounded profiles + per-tier totals + the live-server
+    # HBM ledger
+    return costs.snapshot_section()
+
+
 registry.register_collector("engine", _collect_engine)
+registry.register_collector("costs", _collect_costs)
 registry.register_collector("dist", _collect_dist)
 registry.register_collector("quant", _collect_quant)
 registry.register_collector("caches", _collect_caches)

@@ -101,19 +101,32 @@ class Histogram:
             self._n += 1
             self._sum += float(value)
 
-    def percentiles(self):
-        with self._lock:
-            n = min(self._n, self._window)
-            vals = sorted(self._ring[:n])
+    @staticmethod
+    def _pctiles(vals):
+        # explicit empty guard: percentiles of nothing are None (rendered
+        # as absent samples), never a silent 0.0 that reads as "fast"
+        n = len(vals)
         if n == 0:
             return {"p50": None, "p95": None, "p99": None}
         pick = lambda q: vals[min(n - 1, int(q * (n - 1) + 0.5))]  # noqa: E731
         return {"p50": round(pick(0.50), 4), "p95": round(pick(0.95), 4),
                 "p99": round(pick(0.99), 4)}
 
+    def percentiles(self):
+        with self._lock:
+            vals = sorted(self._ring[:min(self._n, self._window)])
+        return self._pctiles(vals)
+
     def snapshot(self):
-        out = {"count": self._n, "sum": round(self._sum, 4)}
-        out.update(self.percentiles())
+        # count, sum, and the ring are read as ONE locked view — a
+        # concurrent observe() can otherwise tear count from sum (count
+        # incremented, sum not yet) and the snapshot lies about the mean
+        with self._lock:
+            count = self._n
+            total = self._sum
+            vals = sorted(self._ring[:min(self._n, self._window)])
+        out = {"count": count, "sum": round(total, 4)}
+        out.update(self._pctiles(vals))
         return out
 
 
@@ -207,6 +220,13 @@ def _walk(prefix, value, labels, lines):
                 for sname, sval in sorted(v.items()):
                     _walk(prefix + "_server", sval,
                           labels + (("server", sname),), lines)
+            elif k == "profiles" and isinstance(v, dict):
+                # cost-attribution profiles: the "tier:key" map becomes a
+                # program="..." label (same reasoning as servers — the
+                # per-program aggregation is the point of the label)
+                for pname, pval in sorted(v.items()):
+                    _walk(prefix + "_program", pval,
+                          labels + (("program", pname),), lines)
             else:
                 _walk(prefix + "_" + _sanitize(k) if prefix
                       else _sanitize(k), v, labels, lines)
@@ -228,8 +248,12 @@ def render_prometheus(snap, prefix="mxtpu"):
         full = "%s_%s" % (prefix, name)
         if full not in seen_type:
             seen_type.add(full)
-            kind = "counter" if name.startswith(counter_prefixes) \
-                else "gauge"
+            # histogram _sum/_count are monotonic series (Prometheus
+            # summary convention) — typing them gauge breaks rate()
+            kind = "counter" if (
+                name.startswith(counter_prefixes)
+                or (name.startswith("metrics_histograms_")
+                    and name.endswith(("_sum", "_count")))) else "gauge"
             out.append("# TYPE %s %s" % (full, kind))
         label_s = ""
         if labels:

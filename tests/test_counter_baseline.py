@@ -318,6 +318,10 @@ def test_dist_exchange_counters_match_artifact():
     ("quant_bench_quick.json", ["steady_state_recompiles",
                                 "kv_bytes_vs_bf16",
                                 "kv_cache_bytes"]),
+    # flops/bytes/peak-HBM gate columns: replayed exactly by
+    # tests/test_costs.py::test_cost_gate_replay_matches_committed_artifact
+    ("cost_report_quick.json", ["tier", "programs", "flops",
+                                "bytes_accessed", "peak_hbm_bytes"]),
 ])
 def test_committed_artifacts_carry_counter_columns(name, counter_cols):
     """The gate only works while the artifacts keep their counter columns —

@@ -251,6 +251,32 @@ def main():
           % ("running" if prof["running"] else "stopped", prof["records"],
              prof["records_cap"], prof["records_dropped"]))
 
+    print("----------Cost Attribution----------")
+    # per-program flops/bytes/peak-HBM ledger (observability.costs):
+    # every _jit_backed program profiles itself; ranked detail + the CI
+    # gate artifact live in tools/cost_report.py
+    cs = snap["costs"]
+    print("collection   : %s (MXNET_COST_ATTRIBUTION), %d profile(s), "
+          "%d pending, %d dropped, %d error(s)"
+          % ("on" if cs["enabled"] else "off", len(cs["profiles"]),
+             cs["pending"], cs["dropped"], cs["errors"]))
+    for tier, tot in sorted(cs["totals"].items()):
+        print("  tier %-7s: %d program(s), %.3g flops, %.3g bytes, "
+              "peak %s B" % (tier, tot["programs"], tot["flops"],
+                             tot["bytes_accessed"],
+                             _fmt(tot["peak_hbm_bytes"])))
+    top = sorted(cs["profiles"].values(),
+                 key=lambda p: (-p["flops"], p["key"]))[:3]
+    for p in top:
+        print("  top %s:%s %-18s %.3g flops, peak %s B"
+              % (p["tier"], p["key"], p["hint"][:18], p["flops"],
+                 _fmt(p["peak_hbm_bytes"])))
+    for sname, row in sorted(cs["ledger"].get("servers", {}).items()):
+        print("  hbm %-14s: params %s B, kv %s B, total %s B"
+              % (sname, _fmt(row.get("params_bytes")),
+                 _fmt(row.get("kv_cache_bytes", 0)),
+                 _fmt(row.get("total_bytes"))))
+
     print("----------Graphlint Summary----------")
     # tracing-hygiene static pass over the package (tools/graphlint.py);
     # anything non-allowlisted here also fails the tier-1 suite

@@ -17,6 +17,11 @@ Scenarios (the same builders the committed baselines use):
   trace spans, per-token step attribution (one float add per live slot
   per step), and the armed watchdog's is-None check per counter bump.
 
+Cost attribution (observability.costs, default-on) runs in BOTH arms:
+its steady-state price — one ``_cache_size()`` poll per tracked-jit call,
+profiling itself only on compiles — is part of the baseline posture the
+<3% budget is measured on top of.
+
 Run: python tools/observability_bench.py [--quick] [--json PATH]
 --quick pins the CPU backend (the CI mode; artifact committed to
 tools/observability_overhead_quick.json).

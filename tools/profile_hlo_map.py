@@ -31,6 +31,10 @@ _INSTR_RE = re.compile(r"^\s*(?:ROOT\s+)?(%[\w.\-]+)\s+=\s+")
 _SHAPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
 _CALLS_RE = re.compile(r"calls=(%[\w.\-]+)")
 _KIND_RE = re.compile(r"kind=(\w+)")
+# jax.named_scope provenance: optimized HLO carries
+# `metadata={op_name="jit(f)/jit(main)/<scopes>/<primitive>" ...}` —
+# the scopes are OUR op/block names (ir/graph.py build_runner, _trace.F)
+_META_RE = re.compile(r'metadata=\{[^}]*op_name="([^"]*)"')
 
 
 def _line_opcode(line):
@@ -84,8 +88,50 @@ def parse_hlo(text):
         cm = _CALLS_RE.search(line)
         if cm:
             rec["calls"] = cm.group(1)
+        mm = _META_RE.search(line)
+        if mm and mm.group(1):
+            rec["op_name"] = _clean_op_name(mm.group(1))
         instrs[name.lstrip("%")] = rec
     return instrs, comp_ops
+
+
+def _clean_op_name(op_name):
+    """Drop the jit(...) wrapper components: the residual path is the
+    named_scope provenance (block/op names) ending in the primitive."""
+    parts = [p for p in op_name.split("/")
+             if p and not (p.startswith("jit(") and p.endswith(")"))]
+    return "/".join(parts)
+
+
+def provenance_scope(op_name):
+    """The human scope of a cleaned op_name: everything but the trailing
+    jax primitive ('dense0/FullyConnected/dot_general' -> scope
+    'dense0/FullyConnected')."""
+    parts = op_name.split("/")
+    return "/".join(parts[:-1]) if len(parts) > 1 else parts[0]
+
+
+# primitive-name rules for op_name-based categorization (first match
+# wins); these are jax primitive names, not HLO opcodes
+_PRIM_RULES = (
+    (("dot", "conv"), "matmul/conv"),
+    (("scatter",), "scatter"),
+    (("reduce", "argmax", "argmin", "cumsum", "sort", "top_k"),
+     "reduce/stats"),
+    (("psum", "all_gather", "all_to_all", "ppermute", "reduce_scatter",
+      "collective"), "collective"),
+    (("random", "rng", "threefry"), "rng"),
+    (("transpose", "copy", "broadcast", "reshape", "concatenate", "pad",
+      "slice", "gather", "rev", "squeeze", "bitcast", "convert"),
+     "copy/layout"),
+)
+
+
+def _categorize_primitive(prim):
+    for keys, cat in _PRIM_RULES:
+        if any(k in prim for k in keys):
+            return cat
+    return None
 
 
 def parse_trace_ops(trace_path):
@@ -114,8 +160,27 @@ def parse_trace_ops(trace_path):
 
 
 # category rules, first match wins; fusions are classified by their called
-# computation's instruction mix (a "fusion" wrapping a dot IS the matmul)
+# computation's instruction mix (a "fusion" wrapping a dot IS the matmul).
+# When the instruction carries named_scope provenance (metadata op_name=),
+# the jax primitive name is preferred — it survives fusion better than the
+# HLO opcode — EXCEPT when the opcode/inner-mix evidence names a stronger
+# category (the fusion root's metadata can be a weak broadcast while the
+# fusion body holds the dot). Old saved HLO without metadata takes the
+# opcode-only path unchanged.
 def categorize(rec, inner):
+    base = _categorize_opcode(rec, inner)
+    opn = rec.get("op_name", "")
+    if not opn:
+        return base
+    named = _categorize_primitive(opn.rsplit("/", 1)[-1])
+    if named in (None, "copy/layout") and base in (
+            "matmul/conv", "scatter", "reduce/stats", "collective",
+            "custom-call (pallas kernel)"):
+        return base
+    return named or "elementwise/other"
+
+
+def _categorize_opcode(rec, inner):
     op = rec.get("opcode", "")
     if op in ("custom-call",):
         return "custom-call (pallas kernel)"
@@ -141,17 +206,22 @@ def join(times, instrs, comp_ops, top=20):
     total = sum(times.values()) or 1.0
     rows = []
     cat_ms = collections.Counter()
+    scope_ms = collections.Counter()   # named_scope provenance rollup
     for name, ms in times.items():
         base = re.sub(r"^%", "", name)
         rec = instrs.get(base, {})
         inner = comp_ops.get(rec.get("calls", ""), {})
         cat = categorize(rec, inner) if rec else "unmatched"
         cat_ms[cat] += ms
+        opn = rec.get("op_name", "")
+        if opn:
+            scope_ms[provenance_scope(opn)] += ms
         rows.append({"name": base, "total_ms": round(ms, 3),
                      "pct": round(100 * ms / total, 2),
                      "opcode": rec.get("opcode", "?"),
                      "kind": rec.get("kind", ""),
                      "shape": rec.get("shape", ""),
+                     "op_name": opn,
                      "category": cat,
                      "inner_ops": dict(collections.Counter(inner)
                                        .most_common(6))})
@@ -159,10 +229,13 @@ def join(times, instrs, comp_ops, top=20):
     matched = sum(1 for r in rows if r["category"] != "unmatched")
     return {"total_ms": round(total, 3),
             "matched_ops": matched, "trace_ops": len(rows),
+            "named_ops": sum(1 for r in rows if r["op_name"]),
             "category_ms": {k: round(v, 3)
                             for k, v in cat_ms.most_common()},
             "category_pct": {k: round(100 * v / total, 2)
                              for k, v in cat_ms.most_common()},
+            "scope_ms": {k: round(v, 3)
+                         for k, v in scope_ms.most_common(top)},
             "top_ops": rows[:top]}
 
 
@@ -190,10 +263,15 @@ def main(argv=None):
                           "trace and HLO are probably from different "
                           "compiles; regenerate both in the same session")
         print("WARNING: %s" % out["warning"], file=sys.stderr)
-    print("total device time %.2f ms over %d ops (%d matched)"
-          % (out["total_ms"], out["trace_ops"], out["matched_ops"]))
+    print("total device time %.2f ms over %d ops (%d matched, %d named)"
+          % (out["total_ms"], out["trace_ops"], out["matched_ops"],
+             out["named_ops"]))
     for k, v in out["category_pct"].items():
         print("  %5.1f%%  %s" % (v, k))
+    if out["scope_ms"]:
+        print("named sinks (metadata op_name provenance):")
+        for k, v in out["scope_ms"].items():
+            print("  %8.3fms  %s" % (v, k))
     for r in out["top_ops"][:args.top]:
         print("%8.3fms %5.1f%%  %-28s %-12s %s %s"
               % (r["total_ms"], r["pct"], r["name"], r["category"],

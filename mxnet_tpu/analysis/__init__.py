@@ -19,11 +19,18 @@ whose typed IR exists to catch graph invalidity before execution).
   suspect: actual host readbacks mid-trace (GL101), per-call-varying
   constants that retrace or go stale (GL102), constant-folded/dead
   parameters (GL103), data-dependent Python control flow (GL104).
+
+Concurrency (racecheck, :mod:`.concurrency`) follows the same two-stage
+shape for the threading layers: static rules GL011–GL015 ride the same
+graphlint pass, and an opt-in runtime stage (``MXNET_LOCK_CHECK=1``)
+records lock-acquisition order and write overlap on shared structures —
+see README "Concurrency analysis" and ``tools/race_stress.py``.
 """
+from . import concurrency  # noqa: F401  (stdlib-only; also loads GL011–15)
 from .graphlint import (Finding, RULES, lint_paths, lint_source,
                         load_allowlist, split_allowed, format_findings)
 from .validate import GraphlintError, check_hybridizable
 
 __all__ = ["Finding", "RULES", "lint_paths", "lint_source", "load_allowlist",
            "split_allowed", "format_findings", "GraphlintError",
-           "check_hybridizable"]
+           "check_hybridizable", "concurrency"]

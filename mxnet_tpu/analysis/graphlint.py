@@ -87,6 +87,19 @@ import json
 import os
 from typing import Dict, List, NamedTuple, Optional, Set, Tuple
 
+try:
+    from . import concurrency as _conc
+except ImportError:
+    # tools/graphlint.py loads this module standalone (no package context);
+    # load the concurrency rules the same way.
+    import importlib.util as _ilu
+    _conc_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "concurrency.py")
+    _conc_spec = _ilu.spec_from_file_location("graphlint_concurrency",
+                                              _conc_path)
+    _conc = _ilu.module_from_spec(_conc_spec)
+    _conc_spec.loader.exec_module(_conc)
+
 RULES = {
     "GL001": "host sync inside hybridizable/jitted region",
     "GL002": "retrace hazard (per-call jit identity / unordered cache key)",
@@ -100,6 +113,7 @@ RULES = {
     "GL010": "ad-hoc graph-node class / hand-rolled cache key outside "
              "mxnet_tpu/ir",
 }
+RULES.update(_conc.RULES)  # GL011–GL015: concurrency rules (racecheck)
 
 # paths structurally exempt from GL010: the typed IR itself
 _GL010_EXEMPT = ("mxnet_tpu/ir/",)
@@ -870,13 +884,25 @@ class _ModuleLint:
 # ------------------------------------------------------------------ driver
 
 
-def lint_source(src: str, path: str) -> List[Finding]:
+def lint_source(src: str, path: str,
+                _conc_shared=None) -> List[Finding]:
     try:
         tree = ast.parse(src)
     except SyntaxError as e:
         return [Finding(path, e.lineno or 0, "GL000",
                         "syntax error: %s" % e.msg, "<module>")]
-    return _ModuleLint(tree, path, src).run()
+    findings = _ModuleLint(tree, path, src).run()
+    # concurrency rules (GL011–GL015). With a shared lint the GL015
+    # cross-module lock graph accumulates and finish() runs in the
+    # caller; standalone, the cycle check covers just this module.
+    conc = _conc_shared if _conc_shared is not None \
+        else _conc.ConcurrencyLint()
+    lines = src.splitlines()
+    findings.extend(Finding(*t) for t in conc.lint_module(tree, path, lines))
+    if _conc_shared is None:
+        findings.extend(Finding(*t) for t in conc.finish())
+    findings.sort(key=lambda x: (x.path, x.line, x.rule, x.msg))
+    return findings
 
 
 def lint_paths(paths, exclude=()) -> List[Finding]:
@@ -895,12 +921,14 @@ def lint_paths(paths, exclude=()) -> List[Finding]:
             files.append(p)
     findings: List[Finding] = []
     cwd = os.getcwd()
+    conc = _conc.ConcurrencyLint()
     for f in files:
         rel = os.path.relpath(f, cwd)
         rel = f if rel.startswith("..") else rel
         rel = rel.replace(os.sep, "/")
         with open(f, "r", encoding="utf-8") as fh:
-            findings.extend(lint_source(fh.read(), rel))
+            findings.extend(lint_source(fh.read(), rel, _conc_shared=conc))
+    findings.extend(Finding(*t) for t in conc.finish())
     findings.sort(key=lambda x: (x.path, x.line, x.rule, x.msg))
     return findings
 

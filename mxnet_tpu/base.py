@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
 from typing import Callable, Dict, NamedTuple
 
 import jax
@@ -92,20 +93,33 @@ class BoundedCache(dict):
     per-op hot path. Entries must be pure caches: evicting one may cost a
     recompute/recompile, never correctness. ``evictions`` counts the
     drops — observability.snapshot() surfaces it per cache, so cap churn
-    in a long-running replica is visible instead of silent."""
+    in a long-running replica is visible instead of silent.
 
-    __slots__ = ("cap", "evictions")
+    Inserts are serialized by an internal lock: serve dispatcher threads
+    populate these tables concurrently, and the unguarded
+    len-check/evict/store sequence could evict twice or over-fill
+    (racecheck GL011). Hits never take the lock — reads stay plain-dict
+    speed. ``_insert_locked`` is a seam for analysis.concurrency's
+    runtime race probe (placed *inside* the lock so correctly serialized
+    writers never report)."""
+
+    __slots__ = ("cap", "evictions", "_lk")
 
     def __init__(self, cap):
         super().__init__()
         self.cap = max(int(cap), 1)
         self.evictions = 0
+        self._lk = threading.Lock()
 
     def __setitem__(self, key, value):
+        with self._lk:
+            self._insert_locked(key, value)
+
+    def _insert_locked(self, key, value):
         if len(self) >= self.cap and key not in self:
             del self[next(iter(self))]
             self.evictions += 1
-        super().__setitem__(key, value)
+        dict.__setitem__(self, key, value)
 
 
 # per-(op, static attrs, device) jitted callables. Keys include static-attr

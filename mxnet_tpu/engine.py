@@ -308,13 +308,15 @@ class NativeEngine:
         lib = _native()
         self._lib = lib
         self._keep = []
+        # guards _keep/_futures/_var_locks: push() is called from any
+        # thread (racecheck GL011 — concurrent appends can drop entries)
+        self._guard = threading.Lock()
         if lib:
             self._h = lib.mxtpu_engine_create(num_threads)
         else:
             self._h = None
             self._pool = ThreadPoolExecutor(num_threads)
             self._var_locks = {}
-            self._guard = threading.Lock()
             self._futures = []
 
     def new_variable(self):
@@ -328,7 +330,8 @@ class NativeEngine:
     def push(self, fn, const_vars=(), mutable_vars=()):
         if self._h:
             cb = _CALLBACK(lambda _: fn())
-            self._keep.append(cb)
+            with self._guard:
+                self._keep.append(cb)
             cv = (ctypes.c_long * len(const_vars))(*const_vars)
             mv = (ctypes.c_long * len(mutable_vars))(*mutable_vars)
             self._lib.mxtpu_engine_push(self._h, ctypes.cast(cb, ctypes.c_void_p),
@@ -345,15 +348,18 @@ class NativeEngine:
                     for lk in reversed(locks):
                         lk.release()
 
-            self._futures.append(self._pool.submit(task))
+            with self._guard:
+                self._futures.append(self._pool.submit(task))
 
     def wait_all(self):
         if self._h:
             self._lib.mxtpu_engine_wait_all(self._h)
         else:
-            for f in self._futures:
+            # swap under the guard, block outside it (racecheck GL013)
+            with self._guard:
+                futures, self._futures = self._futures, []
+            for f in futures:
                 f.result()
-            self._futures = []
 
     def __del__(self):
         try:

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import functools
 import hashlib
+import threading
 
 import jax
 import numpy as np
@@ -63,14 +64,30 @@ _SIG_IDS = {}
 _SIG_LIST = []
 _SIG_INTERN_CAP = _env_cap("MXNET_SIG_INTERN_CAP", 65536)
 
+# Inserts are serialized (racecheck): two dispatcher threads interning
+# the same fresh signature could both claim len(_SIG_LIST) as its id and
+# leave _SIG_IDS pointing past the list. Hits stay lock-free — the dict
+# probe is the per-op hot path; the lock is only taken on a miss.
+_SIG_LOCK = threading.Lock()
+
 
 def _sig_id(sig):
+    i = _SIG_IDS.get(sig)
+    if i is not None:
+        return i
+    with _SIG_LOCK:
+        return _sig_id_locked(sig)
+
+
+def _sig_id_locked(sig):
+    # seam for analysis.concurrency's runtime race probe (inside the lock)
     i = _SIG_IDS.get(sig)
     if i is None:
         if len(_SIG_IDS) >= _SIG_INTERN_CAP:
             return None  # table full — caller bails to eager dispatch
-        i = _SIG_IDS[sig] = len(_SIG_LIST)
+        i = len(_SIG_LIST)
         _SIG_LIST.append(sig)
+        _SIG_IDS[sig] = i  # publish only after the list holds the entry
     return i
 
 

@@ -101,15 +101,19 @@ def lower_forward(raw_graph, kind, hint=None):
     canon, ent = prepare(raw_graph)
     prog = ent.programs.get("fwd")
     if prog is None:
+        # build OUTSIDE the lock (racecheck GL013: a compile can take
+        # seconds and would stall every other entry's first build); the
+        # double-checked publish below keeps one winner, whose build is
+        # the only one counted
+        fresh = _jit_backed(_fwd_fn(ent.graph), tier=kind,
+                            hint=hint or ("ir-" + kind))
         with _lock:
             prog = ent.programs.get("fwd")
             if prog is None:
                 # note carries the CAPTURE kind + canonical key: watchdog
                 # warnings name both the frontend and the offending graph
                 _counter(kind).bump(note=_key_note(kind, ent.key))
-                prog = _jit_backed(_fwd_fn(ent.graph), tier=kind,
-                                   hint=hint or ("ir-" + kind))
-                ent.programs["fwd"] = prog
+                prog = ent.programs["fwd"] = fresh
                 _BUILD_STATS["program_builds"] += 1
     sel = tuple(canon.leaf_perm[c] for c in ent.leaf_sel)
     return prog, sel
@@ -133,13 +137,14 @@ def tape_program(ent, variant_key, builder, donate=()):
     key = ("tape", ent.key, variant_key)
     prog = ent.programs.get(key)
     if prog is None:
+        # build outside the lock, publish under it (racecheck GL013)
+        fresh = _jit_backed(builder(), donate=tuple(donate) or None,
+                            tier="tape", hint="tape")
         with _lock:
             prog = ent.programs.get(key)
             if prog is None:
                 _counter("tape").bump(note=_key_note("tape", key))
-                prog = _jit_backed(builder(), donate=tuple(donate) or None,
-                                   tier="tape", hint="tape")
-                ent.programs[key] = prog
+                prog = ent.programs[key] = fresh
                 _BUILD_STATS["program_builds"] += 1
     return prog
 

@@ -222,7 +222,17 @@ def _collect_costs():
     return costs.snapshot_section()
 
 
+def _collect_concurrency():
+    # racecheck runtime stage (analysis.concurrency): lock-order graph
+    # size, deadlock cycles, race reports. Brief form — stacks stay in
+    # concurrency.runtime_stats(verbose=True) / tools/diagnose.py
+    from ..analysis import concurrency
+
+    return concurrency.runtime_stats()
+
+
 registry.register_collector("engine", _collect_engine)
+registry.register_collector("concurrency", _collect_concurrency)
 registry.register_collector("costs", _collect_costs)
 registry.register_collector("dist", _collect_dist)
 registry.register_collector("quant", _collect_quant)

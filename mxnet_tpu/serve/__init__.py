@@ -53,6 +53,17 @@ _SERVERS = weakref.WeakSet()
 
 def _register(server):
     _SERVERS.add(server)
+    # racecheck: when the runtime lock-order/race stage is armed
+    # (MXNET_LOCK_CHECK=1), new servers are instrumented at construction
+    # so their condition variables, queues and slot tables are watched
+    # from the first request
+    try:
+        from ..analysis import concurrency as _conc
+
+        if _conc.lock_check_enabled():
+            _conc.instrument_server(server)
+    except Exception:
+        pass
 
 
 def load(prefix, epoch=0, input_names=("data",), ctx=None, snapshot=False,

@@ -112,32 +112,65 @@ class DynamicBatcher:
         # queue into the executor's unbounded backlog and load shedding
         # would never fire — requests must WAIT IN the bounded queue while
         # every dispatcher is busy
-        self._inflight = threading.Semaphore(max(1, int(num_dispatchers)))
-        self._pool = ThreadPoolExecutor(max(1, int(num_dispatchers)),
+        self._num_dispatchers = max(1, int(num_dispatchers))
+        self._inflight = threading.Semaphore(self._num_dispatchers)
+        self._pool = ThreadPoolExecutor(self._num_dispatchers,
                                         thread_name_prefix="serve-dispatch")
 
     # ------------------------------------------------------------ lifecycle
     def start(self):
         if self._worker is None or not self._worker.is_alive():
             self._stop = False
+            if self._pool is None:
+                # a previous stop() tore the executor down — restartable
+                # start/stop cycles must not submit to a dead pool (and
+                # must not leak the old pool's threads)
+                self._inflight = threading.Semaphore(self._num_dispatchers)
+                self._pool = ThreadPoolExecutor(
+                    self._num_dispatchers,
+                    thread_name_prefix="serve-dispatch")
             self._worker = threading.Thread(target=self._loop, daemon=True,
                                             name="serve-batcher")
             self._worker.start()
         return self
 
-    def stop(self, drain=True):
+    def stop(self, drain=True, timeout_s=5.0):
+        """Stop the worker and tear down the dispatcher pool.
+
+        drain=True lets the worker dispatch what is already queued before
+        exiting; drain=False rejects the queue immediately. Either way the
+        worker join is bounded by ``timeout_s`` and anything still queued
+        after it is rejected with ServeError — stop() never strands a
+        caller blocked on ``result()``. Idempotent; start() after stop()
+        builds a fresh pool, so repeated cycles leak no threads."""
         with self._cond:
             self._stop = True
-            self._cond.notify_all()
-        if self._worker is not None:
-            self._worker.join(timeout=5.0)
-        if not drain:
-            with self._cond:
-                pending, self._queue = list(self._queue), deque()
+            if not drain:
+                pending = list(self._queue)
+                self._queue.clear()
                 self._queued_rows = 0
-            for r in pending:
-                r.finish(error=ServeError("server stopped"))
-        self._pool.shutdown(wait=True)
+            else:
+                pending = []
+            self._cond.notify_all()
+        err = ServeError("server stopped")
+        for r in pending:
+            r.finish(error=err)
+        worker, self._worker = self._worker, None
+        if worker is not None:
+            worker.join(timeout=timeout_s)
+        # drain-then-reject: whatever the worker did not dispatch within
+        # the bound (or was enqueued in the closing window) is rejected
+        with self._cond:
+            leftover = list(self._queue)
+            self._queue.clear()
+            self._queued_rows = 0
+        for r in leftover:
+            r.finish(error=err)
+        pool, self._pool = self._pool, None
+        if pool is not None:
+            # dispatchers hold requests whose callers may be blocked on
+            # result(): wait for in-flight work, never for new work
+            pool.shutdown(wait=True)
 
     # ------------------------------------------------------------ admission
     def submit(self, inputs, n_rows, timeout_ms=None, priority=0,
@@ -273,4 +306,13 @@ class DynamicBatcher:
                 self._inflight.release()
                 return
             batch, rows = got
-            self._pool.submit(self._run_dispatch, batch, rows)
+            pool = self._pool
+            if pool is None:
+                # stop() tore the pool down after a bounded join timed
+                # out — reject rather than dispatch into nothing
+                err = ServeError("server stopped")
+                for req in batch:
+                    req.finish(error=err)
+                self._inflight.release()
+                return
+            pool.submit(self._run_dispatch, batch, rows)

@@ -276,17 +276,26 @@ class GenerativeServer:
             self._loop_thread.start()
         return self
 
-    def stop(self):
+    def stop(self, timeout_s=5.0):
+        """Stop the scheduler loop, reject everything in flight, and tear
+        the dispatcher pool down. The loop join is bounded by
+        ``timeout_s``; active slots are retired and the join queue is
+        drained only AFTER the join, so slot tables keep their
+        single-writer discipline (racecheck GL011 allowlist). Idempotent,
+        and start() after stop() rebuilds every thread — repeated cycles
+        leak no threads (pinned by tests/test_concurrency.py)."""
         self._stop_flag = True
         with self._join_cond:
             self._join_cond.notify_all()
-        if self._loop_thread is not None:
-            self._loop_thread.join(timeout=5.0)
-        self._batcher.stop(drain=False)
+        loop, self._loop_thread = self._loop_thread, None
+        if loop is not None:
+            loop.join(timeout=timeout_s)
+        self._batcher.stop(drain=False, timeout_s=timeout_s)
         for slot in self.cache.active_slots:
             self._retire(slot, error=ServeError("server stopped"))
         with self._join_cond:
-            pending, self._join_q = list(self._join_q), deque()
+            pending = list(self._join_q)
+            self._join_q.clear()
         for req in pending:
             err = ServeError("server stopped")
             if req.finish(error=err):

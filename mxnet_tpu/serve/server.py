@@ -182,9 +182,15 @@ class ModelServer:
         self._started = True
         return self
 
-    def stop(self):
+    def stop(self, drain=True, timeout_s=5.0):
+        """Stop serving. drain=True dispatches what is already queued
+        before shutdown; drain=False rejects it immediately. Dispatcher
+        and worker joins are bounded by ``timeout_s``, any request still
+        queued afterwards is rejected (never stranded), and start() after
+        stop() rebuilds the dispatcher pool — repeated cycles leak no
+        threads (pinned by tests/test_concurrency.py)."""
         self._started = False
-        self._batcher.stop()
+        self._batcher.stop(drain=drain, timeout_s=timeout_s)
         if self.metrics_http is not None:
             self.metrics_http.close()
             self.metrics_http = None

@@ -1,0 +1,18 @@
+"""Fixture: GL011 true negative — same shape, but every mutation is
+guarded by a lock."""
+import threading
+from collections import deque
+
+_EVENTS = deque()
+_LOCK = threading.Lock()
+
+
+def note(x):
+    with _LOCK:
+        _EVENTS.append(x)
+        while len(_EVENTS) > 64:
+            _EVENTS.popleft()
+
+
+def start():
+    threading.Thread(target=note, args=(1,), daemon=True).start()

@@ -1,0 +1,16 @@
+"""Fixture: GL011 true positive — unguarded shared-container mutation in
+a module that spawns threads."""
+import threading
+from collections import deque
+
+_EVENTS = deque()
+
+
+def note(x):
+    _EVENTS.append(x)                                   # expect: GL011
+    while len(_EVENTS) > 64:
+        _EVENTS.popleft()
+
+
+def start():
+    threading.Thread(target=note, args=(1,), daemon=True).start()

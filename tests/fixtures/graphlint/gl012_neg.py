@@ -1,0 +1,18 @@
+"""Fixture: GL012 true negative — acquire is paired with a release in a
+finally (or uses a with-block)."""
+import threading
+
+_LOCK = threading.Lock()
+
+
+def careful(work):
+    _LOCK.acquire()
+    try:
+        work()
+    finally:
+        _LOCK.release()
+
+
+def idiomatic(work):
+    with _LOCK:
+        work()

@@ -1,0 +1,11 @@
+"""Fixture: GL012 true positive — bare acquire(); an exception between
+acquire and release leaks the lock forever."""
+import threading
+
+_LOCK = threading.Lock()
+
+
+def risky(work):
+    _LOCK.acquire()                                     # expect: GL012
+    work()
+    _LOCK.release()

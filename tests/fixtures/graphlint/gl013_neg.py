@@ -1,0 +1,14 @@
+"""Fixture: GL013 true negative — blocking work happens outside the
+lock; only the state handoff is inside it."""
+import threading
+import time
+
+_LOCK = threading.Lock()
+_STATE = {}
+
+
+def slow_update(value):
+    value.block_until_ready()
+    time.sleep(0.1)
+    with _LOCK:
+        _STATE["latest"] = value

@@ -1,0 +1,12 @@
+"""Fixture: GL013 true positive — blocking work inside the critical
+section stalls every other thread contending for the lock."""
+import threading
+import time
+
+_LOCK = threading.Lock()
+
+
+def slow_update(value):
+    with _LOCK:
+        time.sleep(0.1)                                 # expect: GL013
+        value.block_until_ready()                       # expect: GL013

@@ -1,0 +1,13 @@
+"""Fixture: GL014 true negative — the wait re-checks its predicate in a
+while loop."""
+import threading
+
+_COND = threading.Condition()
+_READY = []
+
+
+def take():
+    with _COND:
+        while not _READY:
+            _COND.wait(1.0)
+        return _READY.pop()

@@ -1,0 +1,13 @@
+"""Fixture: GL014 true positive — Condition.wait gated by an `if`: a
+spurious wakeup or missed notify proceeds with the predicate false."""
+import threading
+
+_COND = threading.Condition()
+_READY = []
+
+
+def take():
+    with _COND:
+        if not _READY:
+            _COND.wait(1.0)                             # expect: GL014
+        return _READY.pop()

@@ -1,0 +1,18 @@
+"""Fixture: GL015 true negative — both paths agree on one global lock
+order (A before B), so no acquisition cycle exists."""
+import threading
+
+_A = threading.Lock()
+_B = threading.Lock()
+
+
+def forward():
+    with _A:
+        with _B:
+            pass
+
+
+def also_forward():
+    with _A:
+        with _B:
+            pass

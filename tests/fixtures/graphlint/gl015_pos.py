@@ -1,0 +1,18 @@
+"""Fixture: GL015 true positive — two locks taken in opposite orders by
+two code paths: classic AB/BA deadlock."""
+import threading
+
+_A = threading.Lock()
+_B = threading.Lock()
+
+
+def forward():
+    with _A:
+        with _B:
+            pass
+
+
+def backward():
+    with _B:
+        with _A:                                        # expect: GL015
+            pass

@@ -1,0 +1,314 @@
+"""racecheck (ISSUE 15): the runtime lock-order/race stage, the
+concurrency hardening it forced, and server shutdown discipline.
+
+The static stage (GL011–GL015) is fixture-proven in test_graphlint.py via
+the shared RULES parametrization; this file covers everything dynamic:
+
+* seeded deadlock + seeded data race, each detected deterministically in
+  a FRESH subprocess (the acceptance criterion's detection proof);
+* BoundedCache and the signature interner under concurrent writers — the
+  regressions the new locks exist to prevent;
+* ModelServer/GenerativeServer repeated start/stop cycles leak no
+  threads and stay restartable (bounded joins, drain-then-reject);
+* an armed in-process steady-state serve burst stays CLEAN — zero
+  cycles, zero races (the tools/race_stress.py invariant, in miniature).
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.analysis import concurrency as conc
+from mxnet_tpu.analysis import graphlint as gl
+from mxnet_tpu.base import BoundedCache
+from mxnet_tpu.gluon import nn
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CONC_PATH = os.path.join(REPO, "mxnet_tpu", "analysis", "concurrency.py")
+
+# subprocess preamble: load the concurrency module standalone (it is
+# stdlib-only by contract) so the seeded tests cost milliseconds, not a
+# full jax import
+_LOAD = """\
+import importlib.util, json, sys, threading, time
+spec = importlib.util.spec_from_file_location("conc", %r)
+conc = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(conc)
+conc.enable_lock_check(True)
+""" % CONC_PATH
+
+
+def _run_seeded(body):
+    proc = subprocess.run([sys.executable, "-c", _LOAD + body],
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    return json.loads(proc.stdout.splitlines()[-1])
+
+
+# --------------------------------------------------- seeded detection
+
+
+def test_rules_registered():
+    for rule in ("GL011", "GL012", "GL013", "GL014", "GL015"):
+        assert rule in gl.RULES and rule in conc.RULES
+
+
+def test_seeded_deadlock_detected_in_fresh_subprocess():
+    """Two locks taken A->B by one thread and B->A by another: the
+    lock-order graph must report the cycle (with both stacks) even though
+    the interleaving never actually deadlocks — that is the point."""
+    stats = _run_seeded("""
+A = conc.InstrumentedLock("fixture.A")
+B = conc.InstrumentedLock("fixture.B")
+def one():
+    with A:
+        with B:
+            pass
+def two():
+    with B:
+        with A:
+            pass
+for fn in (one, two):   # sequential: deterministic, deadlock-free
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join()
+print(json.dumps(conc.runtime_stats(verbose=True)))
+""")
+    assert stats["cycles"], "seeded lock-order cycle not detected"
+    cyc = stats["cycles"][0]
+    assert set(cyc["cycle"]) >= {"fixture.A", "fixture.B"}
+    # both edges carry the acquiring thread's stack for the report
+    assert len(cyc["edges"]) == 2
+    for info in cyc["edges"].values():
+        assert info["stack"], "cycle edge lost its stack"
+
+
+def test_seeded_race_detected_in_fresh_subprocess():
+    """Two threads inside overlapping shared_write sections on one
+    registered structure: the sampling probe must report exactly that
+    structure with both thread ids."""
+    stats = _run_seeded("""
+conc.register_shared("fixture.table", sample=1)
+bar = threading.Barrier(2)
+def writer():
+    bar.wait()
+    with conc.shared_write("fixture.table"):
+        time.sleep(0.2)
+ts = [threading.Thread(target=writer, name="w%d" % i) for i in range(2)]
+for t in ts:
+    t.start()
+for t in ts:
+    t.join()
+print(json.dumps(conc.runtime_stats(verbose=True)))
+""")
+    assert stats["races"], "seeded overlapping write not detected"
+    assert stats["races"][0]["shared"] == "fixture.table"
+    assert len(stats["races"][0]["threads"]) == 2
+    assert stats["race_hits"].get("fixture.table", 0) >= 1
+
+
+def test_serialized_writers_do_not_report():
+    """The negative control: the same two writers under one real lock are
+    correctly serialized — zero reports."""
+    stats = _run_seeded("""
+conc.register_shared("fixture.table", sample=1)
+lk = threading.Lock()
+def writer():
+    for _ in range(200):
+        with lk:
+            with conc.shared_write("fixture.table"):
+                pass
+ts = [threading.Thread(target=writer) for _ in range(2)]
+for t in ts:
+    t.start()
+for t in ts:
+    t.join()
+print(json.dumps(conc.runtime_stats()))
+""")
+    assert stats["races"] == []
+    assert stats["race_hits"] == {}
+
+
+# ------------------------------------------- concurrent-writer hardening
+
+
+def test_bounded_cache_concurrent_writers():
+    """N threads inserting past the cap: the insert lock keeps len<=cap
+    and the evict-oldest step never throws (pre-fix: KeyError/over-cap
+    growth under the evict/insert interleave)."""
+    c = BoundedCache(16)
+    errs = []
+
+    def writer(tag):
+        try:
+            for i in range(400):
+                c[(tag, i)] = i
+        except Exception as e:  # noqa: BLE001 — the regression under test
+            errs.append(repr(e))
+
+    ts = [threading.Thread(target=writer, args=(t,)) for t in range(6)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert errs == []
+    assert len(c) <= 16
+    assert c.evictions >= 6 * 400 - 16
+
+
+def test_sig_intern_concurrent_writers():
+    """Threads interning overlapping FRESH signatures: every sig gets one
+    stable id and _SIG_LIST[id] round-trips (pre-fix: torn list/dict
+    publish could hand out an id whose list slot holds another sig)."""
+    from mxnet_tpu.ir import graph as irgraph
+
+    sigs = [("test_conc_sig", i) for i in range(64)]
+    results = [dict() for _ in range(6)]
+
+    def intern(out):
+        for s in sigs:
+            out[s] = irgraph._sig_id(s)
+
+    ts = [threading.Thread(target=intern, args=(r,)) for r in results]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    for s in sigs:
+        ids = {r[s] for r in results} - {None}
+        assert len(ids) <= 1, "sig %r interned to multiple ids: %s" % (s, ids)
+        for i in ids:
+            assert irgraph._SIG_LIST[i] == s
+            assert irgraph._SIG_IDS[s] == i
+
+
+# --------------------------------------------------- shutdown discipline
+
+
+def _serve_threads():
+    return [t.name for t in threading.enumerate()
+            if t.name.startswith(("serve-batcher", "serve-dispatch"))]
+
+
+def _mlp_server(**kw):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(4))
+    net.initialize()
+    net(nd.array(np.zeros((1, 6), np.float32)))  # materialize shapes
+    kw.setdefault("buckets", (1, 2))
+    kw.setdefault("max_wait_ms", 1.0)
+    kw.setdefault("timeout_ms", 30000.0)
+    return mx.serve.ModelServer(net, [((6,), "float32")], **kw)
+
+
+def test_model_server_start_stop_cycles_leak_no_threads():
+    """stop() joins bounded and tears the dispatcher pool down; start()
+    after stop() rebuilds it. Three full cycles with traffic leave no
+    serve-* thread behind and the count never ratchets up."""
+    before = len(_serve_threads())
+    srv = _mlp_server()
+    x = np.zeros((6,), np.float32)
+    for _ in range(3):
+        srv.start()
+        out = srv.predict(x)
+        assert np.asarray(out).shape == (4,)
+        srv.stop()
+        assert len(_serve_threads()) == before, \
+            "serve threads leaked: %s" % _serve_threads()
+    srv.stop()  # idempotent
+
+
+def test_model_server_stop_rejects_then_restarts():
+    """drain=False stop() fails work still queued with ServeError instead
+    of dispatching or stranding it, and the server serves again after a
+    restart (predict on a stopped server auto-starts by contract)."""
+    # huge coalesce window + a wide bucket: 1-row requests sit in the
+    # queue waiting for batchmates, deterministically still queued at stop
+    srv = _mlp_server(buckets=(8,), max_wait_ms=5000.0)
+    srv.start()
+    x = np.zeros((1, 6), np.float32)
+    reqs = [srv._submit_arrays([x], 1, 30000.0) for _ in range(3)]
+    srv.stop(drain=False)
+    for r in reqs:
+        with pytest.raises(mx.serve.ServeError):
+            r.result(timeout_s=5.0)
+    out = srv.predict(np.ones((6,), np.float32))  # auto-restart
+    assert np.asarray(out).shape == (4,)
+    srv.stop()
+
+
+def test_generative_server_start_stop_cycles_leak_no_threads():
+    """The decode loop thread + batcher worker are joined (bounded) every
+    stop(); repeated idle cycles neither leak nor wedge."""
+    from mxnet_tpu.models.gpt import gpt_nano
+
+    def loops():
+        return [t.name for t in threading.enumerate()
+                if t.name.startswith("serve-")]
+
+    before = len(loops())
+    m = gpt_nano()
+    m.initialize()
+    gen = mx.serve.GenerativeServer(m, slots=2, timeout_ms=60000.0)
+    for _ in range(3):
+        gen.start()
+        assert any(t.name == "serve-decode"
+                   for t in threading.enumerate())
+        gen.stop()
+        assert len(loops()) == before, "threads leaked: %s" % loops()
+    gen.stop()  # idempotent
+
+
+# ---------------------------------------------- armed steady-state burst
+
+
+def test_armed_serve_burst_stays_clean():
+    """The race_stress invariant in miniature: with the runtime stage
+    armed and the server instrumented, concurrent predict bursts plus
+    snapshot scrapes produce ZERO cycles and ZERO races."""
+    from mxnet_tpu import observability
+
+    prev = conc.enable_lock_check(True)
+    conc.reset_runtime()
+    try:
+        conc.instrument_locks()
+        srv = _mlp_server(max_queue=256)  # _register arms it while enabled
+        srv.start()
+        errs = []
+
+        def client(seed):
+            rng = np.random.default_rng(seed)
+            try:
+                for _ in range(12):
+                    srv.predict(rng.normal(size=(6,)).astype(np.float32))
+            except Exception as e:  # noqa: BLE001
+                errs.append(repr(e))
+
+        def scraper():
+            try:
+                for _ in range(20):
+                    snap = observability.snapshot()
+                    assert snap["concurrency"]["enabled"]
+            except Exception as e:  # noqa: BLE001
+                errs.append(repr(e))
+
+        ts = [threading.Thread(target=client, args=(s,)) for s in range(4)]
+        ts.append(threading.Thread(target=scraper))
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        srv.stop()
+        stats = conc.runtime_stats()
+        assert errs == []
+        assert stats["cycles"] == [], stats["cycles"]
+        assert stats["races"] == [], stats["races"]
+    finally:
+        conc.enable_lock_check(prev)
+        conc.reset_runtime()

@@ -306,6 +306,27 @@ def main():
     except Exception as e:
         print("graphlint unavailable:", e)
 
+    print("----------Concurrency----------")
+    # racecheck runtime stage (analysis.concurrency): armed via
+    # MXNET_LOCK_CHECK=1 + instrument_locks(); the lock-order graph and
+    # race probes fill only while armed — tools/race_stress.py drives a
+    # worst-case mixed workload through them
+    cc = snap["concurrency"]
+    print("lock check   : %s (MXNET_LOCK_CHECK)"
+          % ("ARMED" if cc["enabled"] else "off"))
+    print("lock graph   : %d lock(s), %d order edge(s), %d dropped"
+          % (cc["graph_nodes"], cc["graph_edges"], cc["edges_dropped"]))
+    print("watched      : %d shared structure(s)%s"
+          % (len(cc["watched"]),
+             " — " + ", ".join(cc["watched"]) if cc["watched"] else ""))
+    print("cycles       : %d potential deadlock(s)" % len(cc["cycles"]))
+    for cyc in cc["cycles"]:
+        print("  DEADLOCK   : %s" % " -> ".join(cyc["cycle"]))
+    print("races        : %d overlapping-writer report(s)" % len(cc["races"]))
+    for r in cc["races"]:
+        print("  RACE       : %s (threads %s)"
+              % (r["shared"], r["threads"]))
+
     if not args.no_device:
         # Features() also probes the backend (jax.default_backend inside
         # runtime._detect) — it must sit behind the same flag

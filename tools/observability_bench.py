@@ -5,9 +5,12 @@ must regress < 3%.
 
 "Always-on" is the full production posture, strictly more than the
 default: per-request tracing (default-on), the retrace watchdog ARMED,
-and per-op dispatch telemetry ENABLED (default-off; one registry dict
-increment per imperative op). "Off" disables all three — the engine
-counters and serve metric rings run in both modes, as they always have.
+per-op dispatch telemetry ENABLED (default-off; one registry dict
+increment per imperative op), and the racecheck runtime stage ARMED over
+instrumented locks (analysis.concurrency; default-off). "Off" disables
+all four — lock wrappers stay in place but reduce to one boolean check;
+the engine counters and serve metric rings run in both modes, as they
+always have.
 
 Scenarios (the same builders the committed baselines use):
 
@@ -39,14 +42,21 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 def _set_telemetry(on):
     from mxnet_tpu import observability as obs
+    from mxnet_tpu.analysis import concurrency
     from mxnet_tpu.observability import watchdog
 
     obs.set_tracing(on)
     obs.enable_op_telemetry(on)
     if on:
         watchdog.arm()
+        # racecheck runtime stage: wrappers go in once (idempotent), the
+        # toggle below is what the kill switch removes — disarmed wrappers
+        # reduce to one boolean check per acquire
+        concurrency.enable_lock_check(True)
+        concurrency.instrument_locks()
     else:
         watchdog.disarm()
+        concurrency.enable_lock_check(False)
     watchdog.reset_events()
 
 
@@ -91,6 +101,12 @@ def run_decode(iters, quick):
     srv = mx.serve.GenerativeServer(m, slots=requests, max_wait_ms=1.0,
                                     max_queue=64, timeout_ms=120000.0)
     srv.warmup(prompt_buckets=(4, 8, 16), max_tokens=max_new + 16)
+    # racecheck wrappers go in BEFORE the worker threads exist (swapping a
+    # condition out from under a waiting worker is exactly the hazard the
+    # detector polices); both arms run instrumented — the on-arm pays the
+    # armed recording, the price enable_lock_check(False) removes
+    from mxnet_tpu.analysis import concurrency
+    concurrency.instrument_server(srv)
     srv._batcher.start()
     tps = {}
     try:
@@ -146,7 +162,8 @@ def main(argv=None):
         "config": {
             "quick": bool(args.quick),
             "platform": __import__("jax").default_backend(),
-            "telemetry_on": "tracing + armed watchdog + op telemetry",
+            "telemetry_on": "tracing + armed watchdog + op telemetry "
+                            "+ armed lock check (racecheck)",
             "budget_pct": 3.0,
             "timing": "host-loop / end-to-end decode, readback-closed "
                       "(PERF.md), best-of-repeats both modes",

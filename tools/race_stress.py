@@ -1,0 +1,256 @@
+#!/usr/bin/env python
+"""racecheck runtime stress harness (ISSUE 15 acceptance).
+
+Arms the opt-in runtime stage of ``mxnet_tpu.analysis.concurrency`` —
+instrumented locks feeding the global lock-order graph plus the sampling
+write-overlap probes on registered shared structures — and then drives
+every concurrent surface of the serving stack in ONE process:
+
+* **serve waves** — client threads hammering ``ModelServer.predict``
+  (mixed bare samples and small batches) through the dynamic batcher;
+* **generative decode** — gpt_nano streams submitted against a
+  ``start()``-ed ``GenerativeServer`` whose background scheduler loop
+  owns the KV slot tables;
+* **snapshot scrapes** — ``observability.snapshot()`` in a loop (the
+  collector reads race the metric writers by design);
+* **/metrics scrapes** — real HTTP GETs via urllib against the opt-in
+  metrics endpoint;
+* **cache-eviction churn** — varying-shape imperative chains inserting
+  through the shared jit program caches, plus two writers hammering one
+  registered ``BoundedCache`` past its cap.
+
+Exit 0 only when the armed detector reports ZERO deadlock cycles and
+ZERO races (and no worker raised). This is the harness the ISSUE's
+acceptance criterion names: ``graphlint --ci`` (static, GL011–GL015)
+plus this armed runtime stage must BOTH be clean on the real codebase.
+
+Run: python tools/race_stress.py [--quick] [--seconds N] [--json PATH]
+--quick pins the CPU backend and shrinks the stress window (the CI mode).
+"""
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _predict_wave(srv, rng, stop, errors, tag):
+    import numpy as np
+
+    i = 0
+    while not stop.is_set():
+        try:
+            if i % 3 == 0:
+                srv.predict(rng.normal(size=(2, 16)).astype(np.float32))
+            else:
+                srv.predict(rng.normal(size=(16,)).astype(np.float32))
+            i += 1
+        except Exception as e:  # noqa: BLE001 — report, keep stressing
+            errors.append("%s: %s: %s" % (tag, type(e).__name__, e))
+            return
+
+
+def _decode_wave(gen, rng, stop, errors):
+    import numpy as np
+
+    while not stop.is_set():
+        try:
+            prompts = [rng.integers(1, 200, size=(int(l),)).astype(np.int32)
+                       for l in rng.integers(3, 8, size=3)]
+            streams = [gen.submit(p, max_new_tokens=6) for p in prompts]
+            for s in streams:
+                s.result(60)
+        except Exception as e:  # noqa: BLE001
+            errors.append("decode: %s: %s" % (type(e).__name__, e))
+            return
+
+
+def _snapshot_wave(stop, errors):
+    from mxnet_tpu import observability
+
+    while not stop.is_set():
+        try:
+            snap = observability.snapshot()
+            assert "concurrency" in snap
+            time.sleep(0.005)
+        except Exception as e:  # noqa: BLE001
+            errors.append("snapshot: %s: %s" % (type(e).__name__, e))
+            return
+
+
+def _scrape_wave(port, stop, errors):
+    url = "http://127.0.0.1:%d/metrics" % port
+    while not stop.is_set():
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                body = resp.read()
+            assert b"mxtpu" in body or b"compiles_total" in body, body[:200]
+            time.sleep(0.01)
+        except Exception as e:  # noqa: BLE001
+            errors.append("scrape: %s: %s" % (type(e).__name__, e))
+            return
+
+
+def _churn_wave(rng, stop, errors):
+    """Compile-cache churn: a rotating set of shapes keeps inserting into
+    the shared program caches while the serve legs read them."""
+    import numpy as np
+
+    from mxnet_tpu import nd
+
+    shapes = [(3, 5), (5, 3), (7,), (2, 2, 2), (11,), (4, 6), (6, 4), (13,)]
+    k = 0
+    while not stop.is_set():
+        try:
+            shp = shapes[k % len(shapes)]
+            a = nd.array(rng.normal(size=shp).astype(np.float32))
+            out = (a * 2.0 + 1.0).asnumpy()
+            assert out.shape == shp
+            k += 1
+        except Exception as e:  # noqa: BLE001
+            errors.append("churn: %s: %s" % (type(e).__name__, e))
+            return
+
+
+def _cache_wave(cache, stop, errors, tag):
+    """Two writers push one registered BoundedCache past its cap — the
+    insert probe sits inside the cache's own lock, so this must be clean."""
+    i = 0
+    while not stop.is_set():
+        try:
+            cache[(tag, i % 100)] = i
+            i += 1
+        except Exception as e:  # noqa: BLE001
+            errors.append("cache-%s: %s: %s" % (tag, type(e).__name__, e))
+            return
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="CPU backend + short stress window (the CI mode)")
+    ap.add_argument("--seconds", type=float, default=None,
+                    help="stress window length (default 4 quick / 10 full)")
+    ap.add_argument("--json", dest="json_path", default=None)
+    args = ap.parse_args(argv)
+
+    if args.quick:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    window = args.seconds or (4.0 if args.quick else 10.0)
+
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.analysis import concurrency as conc
+    from mxnet_tpu.base import BoundedCache
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.models.gpt import gpt_nano
+
+    # arm BEFORE building servers: _register() instruments live servers
+    # only while the lock check is enabled
+    conc.enable_lock_check(True)
+    n = conc.instrument_locks()
+    print("race_stress: armed, %d targets instrumented" % n)
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(24, activation="relu"), nn.Dense(10))
+    net.initialize()
+    net(mx.nd.array(np.zeros((1, 16), np.float32)))  # materialize shapes
+    srv = mx.serve.ModelServer(net, [((16,), "float32")], buckets=(1, 2, 4, 8),
+                               max_wait_ms=1.0, max_queue=512,
+                               timeout_ms=60000.0, metrics_port=0)
+    srv.start()
+    port = srv.metrics_http.port
+
+    m = gpt_nano()
+    m.initialize()
+    gen = mx.serve.GenerativeServer(m, slots=4, max_wait_ms=1.0, max_queue=64,
+                                    timeout_ms=120000.0)
+    gen.warmup(prompt_buckets=(4, 8), max_tokens=12)
+    gen.start()
+
+    churn_cache = BoundedCache(32)
+    conc.register_shared("stress.bounded_cache", churn_cache)
+
+    errors = []
+    stop = threading.Event()
+    waves = []
+    for i in range(4):
+        rng = np.random.default_rng(100 + i)
+        waves.append(threading.Thread(
+            target=_predict_wave, args=(srv, rng, stop, errors, "serve%d" % i),
+            name="stress-serve-%d" % i))
+    waves.append(threading.Thread(
+        target=_decode_wave,
+        args=(gen, np.random.default_rng(7), stop, errors),
+        name="stress-decode"))
+    waves.append(threading.Thread(target=_snapshot_wave, args=(stop, errors),
+                                  name="stress-snapshot"))
+    waves.append(threading.Thread(target=_scrape_wave,
+                                  args=(port, stop, errors),
+                                  name="stress-scrape"))
+    waves.append(threading.Thread(
+        target=_churn_wave, args=(np.random.default_rng(9), stop, errors),
+        name="stress-churn"))
+    for tag in ("w1", "w2"):
+        waves.append(threading.Thread(
+            target=_cache_wave, args=(churn_cache, stop, errors, tag),
+            name="stress-cache-%s" % tag))
+
+    t0 = time.perf_counter()
+    for t in waves:
+        t.start()
+    try:
+        time.sleep(window)
+    finally:
+        stop.set()
+        for t in waves:
+            t.join(timeout=60)
+    wall = time.perf_counter() - t0
+
+    # one mid-flight restart cycle: stop() must drain-or-reject, bound its
+    # joins, and start() must come back — under the armed detector
+    srv.stop(drain=False)
+    srv.start()
+    srv.predict(np.zeros((16,), np.float32))
+    srv.stop()
+    gen.stop()
+
+    stats = conc.runtime_stats(verbose=True)
+    alive = [t.name for t in waves if t.is_alive()]
+
+    print("race_stress: %.1fs window, %d worker errors" % (wall, len(errors)))
+    for e in errors[:10]:
+        print("  error: %s" % e)
+    print("  lock graph : %d node(s), %d order edge(s), %d dropped"
+          % (stats["graph_nodes"], stats["graph_edges"],
+             stats["edges_dropped"]))
+    print("  watched    : %s" % ", ".join(stats["watched"]))
+    for c in stats["cycles"]:
+        print("  DEADLOCK   : %s" % " -> ".join(c["cycle"]))
+        for edge, info in sorted(c.get("edges", {}).items()):
+            print("    edge %s (thread %s)" % (edge, info.get("thread")))
+    for r in stats["races"]:
+        print("  RACE       : %s (threads %s)"
+              % (r["shared"], ", ".join(r["threads"])))
+    if alive:
+        print("  STUCK      : workers still alive after join: %s" % alive)
+
+    if args.json_path:
+        with open(args.json_path, "w") as fh:
+            json.dump({"window_s": wall, "errors": errors, "stats": stats},
+                      fh, indent=1)
+            fh.write("\n")
+
+    ok = (not errors and not alive and not stats["cycles"]
+          and not stats["races"])
+    print("race_stress: %s" % ("CLEAN" if ok else "FAILED"))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -708,7 +708,7 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     mode = args[0] if args else "bert"
     if mode in ("optstep", "imperative", "autograd", "serve", "decode",
-                "coldstart", "ir", "dist", "quant"):
+                "coldstart", "specdecode", "ir", "dist", "quant"):
         # host-dispatch microbenches (fused multi-tensor optimizer step;
         # lazy bulk imperative chain vs eager; compiled tape replay vs the
         # eager backward walk; dynamic-batched serving vs per-request
@@ -724,6 +724,9 @@ def main():
                 "serve": "serve_bench.py",
                 "decode": "serve_bench.py",
                 "coldstart": "serve_bench.py",
+                # speculative draft/verify decode + chunked prefill vs
+                # the plain continuous-batching path
+                "specdecode": "serve_bench.py",
                 # unified graph IR: CSE/DCE node shrink + host-loop time
                 # on a repeated-subexpression chain (mxnet_tpu.ir)
                 "ir": "ir_bench.py",
@@ -739,7 +742,7 @@ def main():
         m = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(m)
         argv = ["--quick"] if (smoke or "--cpu" in flags) else []
-        if mode in ("decode", "coldstart"):
+        if mode in ("decode", "coldstart", "specdecode"):
             # coldstart = replica spin-up cold vs snapshot-warm (cache
             # Tier B), subprocess-isolated; see tools/serve_bench.py
             argv += ["--mode", mode]

@@ -155,6 +155,15 @@ def _save_generative_snapshot(server, prefix, epoch):
             "capacity": int(server.cache.capacity),
             "prefix_cache": server.prefix is not None,
             "quantize": server._quantize,
+            # speculative/chunked-prefill config: spec_k and prefill_chunk
+            # are part of the compiled-program keys (window width / chunk
+            # length) so load must rebuild the server with the same values;
+            # the draft itself is CODE (like the model) and is passed to
+            # load via draft= — "draft" here is informational
+            "spec_k": server.spec_k,
+            "prefill_chunk": server._prefill_chunk,
+            "draft": (type(server._draft).__name__
+                      if server._draft is not None else None),
             "prompt_buckets": sorted({tp for tp, _ in server._prefill_fns}),
             "executables": execs}
 
@@ -251,12 +260,22 @@ def _load_generative_snapshot(prefix, manifest, model, use_execs,
         quantize_model(model, mode=quantize)
     model.load_parameters("%s-%04d.params" % (prefix,
                                               manifest.get("epoch", 0)))
+    # window width / chunk length are baked into the exported programs —
+    # rebuild with the artifact's values unless the caller overrides (the
+    # override then recompiles, with AotFn's one-warning recovery)
+    server_kwargs.setdefault("spec_k", manifest.get("spec_k", 4))
+    server_kwargs.setdefault("prefill_chunk",
+                             manifest.get("prefill_chunk"))
     srv = GenerativeServer(model, slots=manifest["slots"],
                            top_k=manifest["top_k"],
                            eos_id=manifest["eos_id"],
                            prefix_cache=manifest.get("prefix_cache", True),
                            quantize=quantize,
                            **server_kwargs)
+    if manifest.get("draft") and srv._draft is None:
+        _warn("snapshot %r was built with a %s draft but load got no "
+              "draft= — speculative programs in the artifact are skipped "
+              "and the server decodes plain" % (prefix, manifest["draft"]))
     # allocate the cache at the snapshot's capacity bucket up front — a
     # fresh zero alloc, NOT a migration dispatch — so the preloaded
     # programs (all specialized to this capacity) match from token one
@@ -265,6 +284,11 @@ def _load_generative_snapshot(prefix, manifest, model, use_execs,
     if not use_execs:
         return srv
     for key, fe in sorted(manifest.get("executables", {}).items()):
+        if fe["kind"] in ("verify", "draftstep", "draftfill") \
+                and srv._draft is None:
+            continue   # warned above: no draft, plain decode only
+        if fe["kind"] == "chunk" and srv._prefill_chunk is None:
+            continue   # chunking disabled by a caller override
         compiled = _read_exec(prefix, fe, key)
         if compiled is not None:
             srv.preload_executable(fe["kind"], fe["tp"], fe["capacity"],

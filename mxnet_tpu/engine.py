@@ -103,6 +103,16 @@ serve_compile_counter = DispatchCounter("serve_compile")
 # tests/test_generate.py makes, same discipline as serve_compile_counter.
 decode_compile_counter = DispatchCounter("decode_compile")
 
+# speculative decode (mxnet_tpu.serve.speculative): bumps once per VERIFY
+# DISPATCH — the wide k-token target scoring the GenerativeServer issues
+# per speculation round. Unlike decode_compile_counter this is a call-site
+# counter (dispatches, not traces): the 2-dispatches-per-k-tokens proof
+# divides emitted tokens by (draft dispatches + verify dispatches), while
+# decode_compile_counter staying flat remains the zero-retrace proof for
+# the same programs. tests/test_speculative.py and tools/serve_bench.py
+# --mode specdecode assert both.
+verify_dispatch_counter = DispatchCounter("verify_dispatch")
+
 # persistent cross-process compilation store (mxnet_tpu.cache): lookup
 # outcomes for every jit funnel when MXNET_COMP_CACHE_DIR is configured.
 # hit = a valid disk entry replaced an XLA compile; miss = nothing usable

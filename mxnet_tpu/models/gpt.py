@@ -337,6 +337,66 @@ class GPTModel(HybridBlock):
                        F.transpose(w))
         return logits, nk, nv
 
+    def decode_step_speculative(self, F, tokens, k_caches, v_caches,
+                                valid_len):
+        """Speculative verify step: tokens (B, K) int — each slot's current
+        input token followed by K-1 drafted tokens, occupying positions
+        ``valid_len .. valid_len+K-1`` of that slot's cache. One wide
+        dispatch scores all K positions: row j's K/V is written at
+        ``valid_len+j`` (the per-row ``F.cache_write`` window) and attends
+        to the live prefix plus the draft prefix ``pos <= valid_len+j`` —
+        exactly the mask :meth:`_CausalSelfAttention.step_cached` already
+        builds for a (B,) ``start`` with T=K. Returns (logits (B, K, V),
+        new k_caches, new v_caches); logits[:, j] scores the token at
+        position valid_len+j+1, i.e. drafted token j+1. K=1 is
+        bit-identical to :meth:`decode_step_fixed`. Cache rollback after
+        rejection is the caller's job and is free: advancing ``valid_len``
+        by only the accepted length masks the dead suffix, and the next
+        window overwrites it in place."""
+        B, K = tokens.shape
+        x = self.word_embed(tokens)                        # (B, K, C)
+        pw = param_value(self.pos_embed.weight)
+        pos = (F.reshape(valid_len, shape=(-1, 1))
+               + F.reshape(F.arange(0, K, dtype="int32"), shape=(1, -1)))
+        x = x + F.take(pw, pos)                            # (B, K, C)
+        nk, nv = [], []
+        for blk, kc, vc in zip(self.blocks, k_caches, v_caches):
+            x, kc, vc = blk.step_cached(F, x, kc, vc, valid_len)
+            nk.append(kc)
+            nv.append(vc)
+        x = self.ln_f(x)
+        w = param_value(self.word_embed.weight)
+        logits = F.dot(F.reshape(x, shape=(B * K, self._units)),
+                       F.transpose(w))
+        return F.reshape(logits, shape=(B, K, -1)), nk, nv
+
+    def decode_step_speculative_quant(self, F, tokens, k_caches, k_scales,
+                                      v_caches, v_scales, valid_len):
+        """:meth:`decode_step_speculative` over int8 KV pages (same scale
+        plumbing as :meth:`decode_step_fixed_quant`). Returns (logits
+        (B, K, V), new k_caches, new k_scales, new v_caches,
+        new v_scales)."""
+        B, K = tokens.shape
+        x = self.word_embed(tokens)                        # (B, K, C)
+        pw = param_value(self.pos_embed.weight)
+        pos = (F.reshape(valid_len, shape=(-1, 1))
+               + F.reshape(F.arange(0, K, dtype="int32"), shape=(1, -1)))
+        x = x + F.take(pw, pos)                            # (B, K, C)
+        nk, nks, nv, nvs = [], [], [], []
+        for blk, kc, ks, vc, vs in zip(self.blocks, k_caches, k_scales,
+                                       v_caches, v_scales):
+            x, kc, ks, vc, vs = blk.step_cached_quant(F, x, kc, ks, vc, vs,
+                                                      valid_len)
+            nk.append(kc)
+            nks.append(ks)
+            nv.append(vc)
+            nvs.append(vs)
+        x = self.ln_f(x)
+        w = param_value(self.word_embed.weight)
+        logits = F.dot(F.reshape(x, shape=(B * K, self._units)),
+                       F.transpose(w))
+        return F.reshape(logits, shape=(B, K, -1)), nk, nks, nv, nvs
+
     def decode_step_fixed_quant(self, F, tokens, k_caches, k_scales,
                                 v_caches, v_scales, valid_len):
         """:meth:`decode_step_fixed` over int8 KV pages with per-page-per-

@@ -227,6 +227,16 @@ def _walk(prefix, value, labels, lines):
                 for pname, pval in sorted(v.items()):
                     _walk(prefix + "_program", pval,
                           labels + (("program", pname),), lines)
+            elif k.endswith("_by_bucket") and isinstance(v, dict):
+                # per-bucket splits (e.g. TTFT by pow2 prompt length):
+                # the bucket key becomes a bucket="..." label so one
+                # metric name carries the whole distribution family
+                stem = k[:-len("_by_bucket")]
+                for bname, bval in sorted(
+                        v.items(), key=lambda it: str(it[0])):
+                    _walk(prefix + "_" + _sanitize(stem) if prefix
+                          else _sanitize(stem), bval,
+                          labels + (("bucket", bname),), lines)
             else:
                 _walk(prefix + "_" + _sanitize(k) if prefix
                       else _sanitize(k), v, labels, lines)

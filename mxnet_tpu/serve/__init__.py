@@ -39,10 +39,12 @@ from .executor_pool import (BucketedExecutor, PoolError,  # noqa: F401
 from .kv_cache import CacheError, PagedKVCache, PrefixCache  # noqa: F401
 from .metrics import GenerativeMetrics, ServeMetrics  # noqa: F401
 from .server import DEFAULT_BUCKETS, ModelServer  # noqa: F401
+from .speculative import ModelDraft, NGramDraft  # noqa: F401
 
 __all__ = ["ModelServer", "GenerativeServer", "GenerationStream",
            "BucketedExecutor", "DynamicBatcher", "PagedKVCache",
            "PrefixCache", "CacheError", "ServeMetrics", "GenerativeMetrics",
+           "NGramDraft", "ModelDraft",
            "ServeError", "ServerBusy", "ServeTimeout", "PoolError",
            "DEFAULT_BUCKETS", "load", "snapshot", "stats"]
 

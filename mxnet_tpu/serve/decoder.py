@@ -62,13 +62,24 @@ def sample_tokens(logits, keys, positions, temps, top_k):
     sampled ids are the only thing the host reads back."""
     logits = logits.astype(jnp.float32)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    if top_k and top_k > 0:
-        kth = jax.lax.top_k(logits, int(top_k))[0][:, -1:]
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
-    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-    subkeys = jax.vmap(jax.random.fold_in)(keys, positions)
-    sampled = jax.vmap(jax.random.categorical)(subkeys, scaled)
-    return jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
+
+    def _sampled(operands):
+        lg, ks, pos, tp = operands
+        if top_k and top_k > 0:
+            kth = jax.lax.top_k(lg, int(top_k))[0][:, -1:]
+            lg = jnp.where(lg < kth, -jnp.inf, lg)
+        scaled = lg / jnp.maximum(tp, 1e-6)[:, None]
+        subkeys = jax.vmap(jax.random.fold_in)(ks, pos)
+        sampled = jax.vmap(jax.random.categorical)(subkeys, scaled)
+        return jnp.where(tp > 0, sampled.astype(jnp.int32), greedy)
+
+    # the categorical branch (top-k + per-row threefry fold/bits) is the
+    # expensive half; lax.cond skips it AT RUNTIME for all-greedy batches —
+    # the speculative verify samples S*K rows per round, so it saves k×
+    # what the plain step does
+    return jax.lax.cond(jnp.any(temps > 0), _sampled,
+                        lambda operands: greedy,
+                        (logits, keys, positions, temps))
 
 
 class GenerationStream:
@@ -87,6 +98,7 @@ class GenerationStream:
         self.seed = int(seed)
         self.priority = int(priority)
         self.tokens = []          # generated ids, in order
+        self._prompt_ids = None   # lazy python-int view for draft histories
         self._q = queue.Queue()
         self._done = threading.Event()
         self._error = None
@@ -95,6 +107,13 @@ class GenerationStream:
         # queue/pad/dispatch spans + per-token step attribution; read the
         # breakdown from stream.timing() when the stream completes
         self.trace = None
+
+    def prompt_ids(self):
+        """Prompt as a list of python ints, converted once — the draft
+        history path reads it every speculation round."""
+        if self._prompt_ids is None:
+            self._prompt_ids = [int(x) for x in self.prompt]
+        return self._prompt_ids
 
     # ------------------------------------------------------- producer side
     def _push(self, tok):
@@ -194,12 +213,37 @@ class GenerativeServer:
         zero steady-state retrace; the cache costs ~0.5× the bf16 bytes.
         The model must implement ``decode_step_fixed_quant`` (GPTModel
         does). fp8 modes require :func:`quantization.fp8_supported`.
+    draft : None, speculative draft object, or a draft model
+        Enables speculative decode: per scheduler tick the draft proposes
+        ``spec_k - 1`` tokens per slot and the target scores the whole
+        window in ONE wide verify dispatch (``decode_step_speculative``),
+        emitting 1..spec_k tokens. Pass ``serve.NGramDraft()`` (host-side
+        pattern matcher, zero extra dispatches), ``serve.ModelDraft(m)``
+        (a smaller same-API model, one multi-step dispatch per round), or
+        a bare model (wrapped in ``ModelDraft``). Greedy streams emit
+        byte-identical tokens to plain greedy decode; sampled streams emit
+        the same per-(seed, position) tokens as the plain path (the
+        deterministic-draft rejection-sampling identity — see
+        serve.speculative).
+    spec_k : int
+        Verify window width (tokens scored per verify dispatch) when a
+        ``draft`` is set; ``spec_k=1`` degenerates to plain decode through
+        the verify program. Static — compiled into the window shape.
+    prefill_chunk : None or int
+        Chunked prefill budget (pow2-rounded): prompts longer than this
+        fill their cache page in fixed ``prefill_chunk``-sized chunks, ONE
+        chunk per scheduler tick interleaved with decode steps, so a long
+        prompt never stalls in-flight streams for more than one chunk.
+        Chunked prompts bypass the prefix cache (partial pages are never
+        stored). Must be >= ``spec_k`` when both are set (in-flight
+        speculation windows must stay behind the chunk frontier).
     """
 
     def __init__(self, model, slots=8, top_k=0, eos_id=None,
                  max_wait_ms=1.0, max_queue=64, timeout_ms=30000.0,
                  prefix_cache=True, donate=None, name=None,
-                 metrics_port=None, quantize=None):
+                 metrics_port=None, quantize=None, draft=None, spec_k=4,
+                 prefill_chunk=None):
         self._quantize = quantize or None
         if self._quantize is not None:
             if not hasattr(model, "decode_step_fixed_quant"):
@@ -234,6 +278,44 @@ class GenerativeServer:
         self._prefill_fns = {}   # (tp, capacity) -> jitted prompt fill
         self._inject_fns = {}    # (tp, capacity) -> jitted prefix replay
         self._extract_fns = {}   # (tp, capacity) -> jitted page read-out
+        self._verify_fns = {}    # capacity -> jitted speculative verify
+        self._chunk_fns = {}     # (tc, capacity) -> jitted prefill chunk
+        # speculative decode: draft proposer + static verify window width
+        self.spec_k = max(1, int(spec_k))
+        if draft is not None and not hasattr(draft, "propose"):
+            from .speculative import ModelDraft
+
+            draft = ModelDraft(draft)   # a bare model: wrap it
+        self._draft = draft
+        if self._draft is not None:
+            if self._quantize is not None and not hasattr(
+                    model, "decode_step_speculative_quant"):
+                raise ServeError(
+                    "draft + quantize: model %s has no decode_step_"
+                    "speculative_quant" % type(model).__name__)
+            if not hasattr(model, "decode_step_speculative"):
+                raise ServeError(
+                    "draft: model %s has no decode_step_speculative — the "
+                    "wide-window verify protocol (see models.gpt.GPTModel)"
+                    % type(model).__name__)
+            self._draft.bind(self)
+        # speculation windows write K/V through valid+spec_k-1: capacity
+        # sizing must leave that margin past the generation budget or the
+        # clamped window write would fold back onto live positions
+        self._spec_margin = (self.spec_k - 1) if self._draft is not None \
+            else 0
+        # chunked prefill: pow2 chunk budget + in-flight chunk jobs
+        # (slot -> job dict); slots mid-chunk are owned but masked out of
+        # decode until their final chunk lands
+        self._prefill_chunk = None
+        if prefill_chunk is not None:
+            self._prefill_chunk = next_pow2(max(8, int(prefill_chunk)))
+            if self._draft is not None and self._prefill_chunk < self.spec_k:
+                raise ServeError(
+                    "prefill_chunk=%d < spec_k=%d: speculation windows "
+                    "must fit behind the chunk frontier"
+                    % (self._prefill_chunk, self.spec_k))
+        self._chunk_jobs = {}
         # device-side carried state beyond the cache: current input token
         # per slot, and the per-slot sampling controls
         self._tok = jnp.zeros((self.slots,), jnp.int32)
@@ -321,7 +403,8 @@ class GenerativeServer:
                                   priority)
         tmo = self.timeout_ms if timeout_ms is None else float(timeout_ms)
         # fail impossible requests at the door, not after a queue wait
-        self.cache.capacity_bucket(stream.prompt.size + stream.max_new_tokens)
+        self.cache.capacity_bucket(stream.prompt.size + stream.max_new_tokens
+                                   + self._spec_margin)
         if not self._batcher._worker or not self._batcher._worker.is_alive():
             self._batcher.start()
         from ..observability import new_trace
@@ -359,12 +442,15 @@ class GenerativeServer:
     # ------------------------------------------------------------ scheduler
     def step(self):
         """One scheduler tick: admit pending joins (prefill/inject, one
-        dispatch each), then run ONE fused decode step for the whole
-        in-flight batch and deliver each live slot's token. Returns the
-        number of slots decoded (0 = idle). The background loop calls this
-        continuously; tests call it directly for counter-exact assertions."""
+        dispatch each — or a chunk-job handoff for long prompts), run AT
+        MOST ONE prefill chunk, then run ONE fused decode step for the
+        whole in-flight batch and deliver each live slot's token(s).
+        Returns the number of slots progressed (0 = idle). The background
+        loop calls this continuously; tests call it directly for
+        counter-exact assertions."""
         self._admit_pending()
-        return self._decode_once()
+        chunked = self._chunk_once()
+        return self._decode_once() + chunked
 
     def _loop(self):
         while not self._stop_flag:
@@ -405,13 +491,29 @@ class GenerativeServer:
             # slot assignment (batcher queue + join handover)
             tr.add_span("queue", req.t_submit, t_join)
         t0_len = int(stream.prompt.size)
-        need = t0_len + stream.max_new_tokens
+        need = t0_len + stream.max_new_tokens + self._spec_margin
         self.cache.ensure_capacity(need)
+        if self._draft is not None:
+            self._draft.ensure_capacity()
+        key = np.asarray(jax.random.PRNGKey(stream.seed), np.uint32)
+        if (self._prefill_chunk is not None
+                and t0_len > self._prefill_chunk):
+            # chunked prefill: own the slot now, fill the page one chunk
+            # per tick (interleaved with decode by step()); the slot stays
+            # masked out of decode until the final chunk samples the first
+            # token. Bypasses the prefix cache — partial pages are never
+            # stored, and storing only whole ones would hold the very
+            # stall this path removes.
+            slot = self.cache.acquire(stream)
+            self._chunk_jobs[slot] = {
+                "req": req, "stream": stream, "pos": 0, "key": key,
+                "t_join": t_join}
+            self._ctl_dirty = True
+            return
         slot = self.cache.acquire(stream)
         tp = min(next_pow2(t0_len), self.cache.capacity)
         padded = np.zeros((1, tp), np.int32)
         padded[0, :t0_len] = stream.prompt
-        key = np.asarray(jax.random.PRNGKey(stream.seed), np.uint32)
         hit = self.prefix.get(stream.prompt) if self.prefix is not None \
             else None
         t_disp0 = time.perf_counter()
@@ -495,17 +597,24 @@ class GenerativeServer:
             # timed out in the same instant admission landed: roll back
             self.cache.release(slot)
             return
+        if self._draft is not None:
+            # draft cache fill for the new stream (one small dispatch for
+            # ModelDraft, free for NGramDraft) — a target prefix hit still
+            # pays this: the draft keeps no prefix cache
+            self._draft.join(slot, stream, padded, t0_len)
         self._slot_req[slot] = req
         self._remaining[slot] = stream.max_new_tokens
         self._keys[slot] = key
         self._temps[slot] = stream.temperature
         self._ctl_dirty = True
-        self.metrics.record_first_token((now - req.t_submit) * 1e3)
+        self.metrics.record_first_token((now - req.t_submit) * 1e3, t0_len)
         self._deliver(slot, first)
 
     # ------------------------------------------------------------- decoding
     def _decode_once(self):
-        active = self.cache.active_mask()
+        # slots mid-chunked-prefill are owned (admission can't reuse them)
+        # but not decodable yet — masked out until their final chunk
+        active = self.cache.active_mask(exclude=self._chunk_jobs)
         n_active = int(active.sum())
         if n_active == 0:
             return 0
@@ -514,6 +623,8 @@ class GenerativeServer:
             self._dev_temps = jnp.asarray(self._temps)
             self._dev_active = jnp.asarray(active)
             self._ctl_dirty = False
+        if self._draft is not None:
+            return self._speculate_once(active, n_active)
         fn = self._decode_fn(self.cache.capacity)
         params = [p.data()._data for p in self._plist]
         if self._quantize:
@@ -540,11 +651,172 @@ class GenerativeServer:
         self.cache.update(kcs, vcs, valid, kss, vss)
         self._tok = nxt
         dt = time.perf_counter() - t0
-        self.metrics.record_step(dt, n_active, n_active, self.slots)
+        self.metrics.record_step(dt, n_active, n_active, self.slots,
+                                 under_prefill=bool(self._chunk_jobs))
         now = time.perf_counter()
-        for slot in self.cache.active_slots:
-            self._deliver(slot, int(nxt_host[slot]), now, step_s=dt)
+        for slot in np.nonzero(active)[0]:
+            self._deliver(int(slot), int(nxt_host[slot]), now, step_s=dt)
         return n_active
+
+    def _speculate_once(self, active, n_active):
+        """One speculation round: draft proposes spec_k-1 tokens per slot
+        (0 or 1 dispatch), the target scores the whole window in ONE wide
+        verify dispatch, and each live slot receives its accepted prefix
+        plus the verify sample at the first mismatch (1..spec_k tokens).
+        Rejected draft positions need no device-side scrub: ``valid_len``
+        advances only past accepted tokens and the next window overwrites
+        the dead suffix in place."""
+        k = self.spec_k
+        draft = self._draft
+        if draft.needs_history:
+            hists = []
+            for s in range(self.slots):
+                o = self.cache.owner(s)
+                hists.append(
+                    o.prompt_ids() + o.tokens
+                    if (o is not None and active[s]) else [])
+            # host np array goes straight into the compiled call — the
+            # executable's own arg staging is the cheap C++ transfer path
+            # (an explicit jnp.asarray here costs a python device_put per
+            # round)
+            drafts = draft.propose(hists, k)
+        else:
+            drafts = draft.propose(None, k)
+        fn = self._verify_fn(self.cache.capacity)
+        params = [p.data()._data for p in self._plist]
+        if self._quantize:
+            args = (params, self.cache.k, self.cache.k_scale, self.cache.v,
+                    self.cache.v_scale, self.cache.valid, self._tok, drafts,
+                    self._dev_active, self._dev_keys, self._dev_temps)
+        else:
+            args = (params, self.cache.k, self.cache.v, self.cache.valid,
+                    self._tok, drafts, self._dev_active, self._dev_keys,
+                    self._dev_temps)
+        engine.dispatch_counter.bump()
+        engine.verify_dispatch_counter.bump()
+        t0 = time.perf_counter()
+        if profiler.is_running():
+            with profiler.decode_scope("verify%d" % k, self.slots, n_active):
+                out = fn(*args)
+        else:
+            out = fn(*args)
+        kss = vss = None
+        if self._quantize:
+            kcs, kss, vcs, vss, valid, nxt, emit, n_emit = out
+        else:
+            kcs, vcs, valid, nxt, emit, n_emit = out
+        # ONE batched host gather for both outputs (two np.asarray calls
+        # would sync the device twice per round)
+        emit_h, n_emit_h = jax.device_get((emit, n_emit))
+        self.cache.update(kcs, vcs, valid, kss, vss)
+        self._tok = nxt
+        dt = time.perf_counter() - t0
+        emitted = int(n_emit_h.sum())
+        self.metrics.record_step(dt, emitted, n_active, self.slots,
+                                 under_prefill=bool(self._chunk_jobs))
+        self.metrics.record_spec_round(n_active * (k - 1),
+                                       emitted - n_active)
+        now = time.perf_counter()
+        for slot in np.nonzero(active)[0]:
+            slot = int(slot)
+            stream = self.cache.owner(slot)
+            for tok in emit_h[slot, :n_emit_h[slot]]:
+                if self.cache.owner(slot) is not stream:
+                    break   # retired mid-window (EOS / budget / deadline)
+                self._deliver(slot, int(tok), now, step_s=dt)
+        return n_active
+
+    def _chunk_once(self):
+        """Run AT MOST one prefill chunk (FIFO across jobs): extract the
+        slot's page, run ``prefill_chunk`` prompt positions through the
+        wide-window step at offset ``pos``, write the page back — one
+        bounded dispatch, so in-flight decode never stalls longer than one
+        chunk. The final chunk samples the first token and activates the
+        slot."""
+        if not self._chunk_jobs:
+            return 0
+        slot, job = next(iter(self._chunk_jobs.items()))
+        req, stream = job["req"], job["stream"]
+        now = time.perf_counter()
+        if req.done() or req.expired(now):
+            del self._chunk_jobs[slot]
+            self.cache.release(slot)
+            self._ctl_dirty = True
+            err = ServeTimeout("timed out after %.1fms mid-prefill"
+                               % ((now - req.t_submit) * 1e3))
+            if req.finish(error=err):
+                stream._finish(err)
+                self.metrics.record_timeout()
+            with self._join_cond:
+                self._join_cond.notify_all()
+            return 1
+        tc = self._prefill_chunk
+        plen = int(stream.prompt.size)
+        pos0 = job["pos"]
+        seg = stream.prompt[pos0:pos0 + tc]
+        chunk = np.zeros((1, tc), np.int32)
+        chunk[0, :seg.size] = seg
+        fn = self._chunk_fn(tc, self.cache.capacity)
+        params = [p.data()._data for p in self._plist]
+        engine.dispatch_counter.bump()
+        scope = (profiler.decode_scope("chunk%d" % tc, self.slots,
+                                       self.cache.num_active)
+                 if profiler.is_running() else None)
+        try:
+            if scope is not None:
+                scope.__enter__()
+            if self._quantize:
+                kcs, kss, vcs, vss, valid, toks = fn(
+                    params, self.cache.k, self.cache.k_scale, self.cache.v,
+                    self.cache.v_scale, self.cache.valid, self._tok,
+                    jnp.asarray(chunk), jnp.int32(pos0), jnp.int32(plen),
+                    jnp.int32(slot), jnp.asarray(job["key"]),
+                    jnp.float32(stream.temperature))
+                self.cache.update(kcs, vcs, valid, kss, vss)
+            else:
+                kcs, vcs, valid, toks = fn(
+                    params, self.cache.k, self.cache.v, self.cache.valid,
+                    self._tok, jnp.asarray(chunk), jnp.int32(pos0),
+                    jnp.int32(plen), jnp.int32(slot),
+                    jnp.asarray(job["key"]),
+                    jnp.float32(stream.temperature))
+                self.cache.update(kcs, vcs, valid)
+        finally:
+            if scope is not None:
+                scope.__exit__(None, None, None)
+        self._tok = toks
+        self.metrics.record_chunk()
+        job["pos"] = pos0 + tc
+        if job["pos"] < plen:
+            return 1
+        # final chunk: the first token was sampled in-program — activate
+        del self._chunk_jobs[slot]
+        first = int(np.asarray(self._tok)[slot])
+        now = time.perf_counter()
+        if stream.trace is not None:
+            stream.trace.add_span("dispatch", job["t_join"], now,
+                                  kind="chunked_prefill")
+            stream.trace.tokens += 1
+        self.metrics.record_prefill()
+        if not req.finish(result=stream):
+            self.cache.release(slot)
+            self._ctl_dirty = True
+            return 1
+        if self._draft is not None:
+            tp = min(next_pow2(plen), self.cache.capacity)
+            padded = np.zeros((1, tp), np.int32)
+            padded[0, :plen] = stream.prompt
+            self._draft.join(slot, stream, padded, plen)
+        self._slot_req[slot] = req
+        self._remaining[slot] = stream.max_new_tokens
+        self._keys[slot] = job["key"]
+        self._temps[slot] = stream.temperature
+        self._ctl_dirty = True
+        self.metrics.record_first_token((now - req.t_submit) * 1e3, plen)
+        self._deliver(slot, first)
+        with self._join_cond:
+            self._join_cond.notify_all()
+        return 1
 
     def _deliver(self, slot, tok, now=None, step_s=None):
         """Hand one token to a slot's stream and retire the request when it
@@ -581,6 +853,8 @@ class GenerativeServer:
         self._slot_req[slot] = None
         self._temps[slot] = 0.0
         self._ctl_dirty = True
+        if self._draft is not None:
+            self._draft.release(slot)
         self.cache.release(slot)
         with self._join_cond:
             self._join_cond.notify_all()
@@ -653,6 +927,197 @@ class GenerativeServer:
 
         fn = self._jit(pure, donate=(1, 2, 3, 4), hint="step@c%d" % capacity)
         self._decode_fns[capacity] = fn
+        return fn
+
+    def _verify_fn(self, capacity):
+        """Speculative verify program: score the (current token + drafted)
+        k-window in one wide dispatch, sample every row at its own
+        sequence position with the slot's folded key, and accept the
+        longest prefix where the sample equals the draft — the first
+        mismatching row's sample IS the rejection-resample (exact for
+        deterministic drafts: the proposal is one-hot, so accept-w.p.-p(d)
+        and the residual distribution both collapse to 'sample from p,
+        keep on agreement'). Greedy rows therefore reproduce plain greedy
+        decode bit-for-bit; k=1 degenerates to the plain step."""
+        fn = self._verify_fns.get(capacity)
+        if fn is not None:
+            return fn
+        model, plist, top_k = self.model, self._plist, self.top_k
+        k = self.spec_k
+
+        def accept_emit(logits, valid, drafts, active, keys, temps):
+            S, K, V = logits.shape
+            # row j's token lands at sequence position valid+1+j — the
+            # same per-(seed, position) fold plain decode uses, so spec
+            # and plain streams sample identical tokens
+            pos = valid[:, None] + 1 + jnp.arange(K, dtype=jnp.int32)[None]
+            y = sample_tokens(jnp.reshape(logits, (S * K, V)),
+                              jnp.repeat(keys, K, axis=0),
+                              jnp.reshape(pos, (-1,)),
+                              jnp.repeat(temps, K), top_k)
+            y = jnp.reshape(y, (S, K))
+            if K > 1:
+                match = (y[:, :K - 1] == drafts).astype(jnp.int32)
+                al = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+            else:
+                al = jnp.zeros((S,), jnp.int32)
+            act = active > 0
+            n_emit = jnp.where(act, al + 1, 0)
+            emit = jnp.where(
+                (jnp.arange(K, dtype=jnp.int32)[None] <= al[:, None])
+                & act[:, None], y, 0)
+            nxt = jnp.where(
+                act, jnp.take_along_axis(y, al[:, None], axis=1)[:, 0], 0)
+            return valid + n_emit, nxt, emit, n_emit
+
+        if self._quantize:
+            def pure(params, kcs, kss, vcs, vss, valid, toks, drafts,
+                     active, keys, temps):
+                # trace-time bump: zero-steady-state-retrace proof (the
+                # verify DISPATCH count is engine.verify_dispatch_counter,
+                # bumped at the call site)
+                engine.decode_compile_counter.bump()
+                window = jnp.concatenate([toks[:, None], drafts], axis=1)
+                with _trace.trace_scope(jax.random.PRNGKey(0), False) as t:
+                    t.param_store = {id(p): a
+                                     for p, a in zip(plist, params)}
+                    logits, kcs, kss, vcs, vss = \
+                        model.decode_step_speculative_quant(
+                            _trace.F, window, kcs, kss, vcs, vss, valid)
+                valid, nxt, emit, n_emit = accept_emit(
+                    logits, valid, drafts, active, keys, temps)
+                return kcs, kss, vcs, vss, valid, nxt, emit, n_emit
+
+            fn = self._jit(pure, donate=(1, 2, 3, 4, 5, 6),
+                           hint="verify%d@c%d" % (k, capacity))
+            self._verify_fns[capacity] = fn
+            return fn
+
+        def pure(params, kcs, vcs, valid, toks, drafts, active, keys,
+                 temps):
+            # trace-time bump: zero-steady-state-retrace proof (the verify
+            # DISPATCH count is engine.verify_dispatch_counter, bumped at
+            # the call site)
+            engine.decode_compile_counter.bump()
+            window = jnp.concatenate([toks[:, None], drafts], axis=1)
+            with _trace.trace_scope(jax.random.PRNGKey(0), False) as t:
+                t.param_store = {id(p): a for p, a in zip(plist, params)}
+                logits, kcs, vcs = model.decode_step_speculative(
+                    _trace.F, window, kcs, vcs, valid)
+            valid, nxt, emit, n_emit = accept_emit(
+                logits, valid, drafts, active, keys, temps)
+            return kcs, vcs, valid, nxt, emit, n_emit
+
+        fn = self._jit(pure, donate=(1, 2, 3, 4),
+                       hint="verify%d@c%d" % (k, capacity))
+        self._verify_fns[capacity] = fn
+        return fn
+
+    def _chunk_fn(self, tc, capacity):
+        """Prefill-chunk program: slice the slot's page out of the shared
+        buffers, run ``tc`` prompt positions through the wide-window step
+        at offset ``pos0`` (``decode_step_speculative`` with a (1,) valid
+        vector — prefix attention + in-window causality + the per-row
+        window write are exactly the verify semantics), and write the page
+        back. The final chunk (pos0 + tc >= plen) samples the first token
+        at its true row and sets valid to the full prompt length;
+        non-final chunks park valid at the chunk frontier, so interleaved
+        decode garbage for this masked slot lands exactly where the next
+        chunk overwrites it."""
+        fn = self._chunk_fns.get((tc, capacity))
+        if fn is not None:
+            return fn
+        model, plist, top_k = self.model, self._plist, self.top_k
+        H, D = self.cache.heads, self.cache.head_dim
+        zero = jnp.int32(0)
+
+        def finish(logits, valid, toks, pos0, plen, slot, key, temp):
+            nvalid = jnp.minimum(pos0 + tc, plen)
+            valid = jax.lax.dynamic_update_slice(
+                valid, jnp.reshape(nvalid, (1,)), (slot,))
+            # first-token row (clamped: garbage until the final chunk,
+            # overwritten by it)
+            row = jnp.clip(plen - 1 - pos0, 0, tc - 1)
+            last = jnp.reshape(jax.lax.dynamic_slice(
+                logits, (zero, row, zero),
+                (1, 1, logits.shape[2])), (1, -1))
+            t0 = sample_tokens(last, key[None], plen[None], temp[None],
+                               top_k)
+            return valid, jax.lax.dynamic_update_slice(toks, t0, (slot,))
+
+        if self._quantize:
+            def pure(params, kcs, kss, vcs, vss, valid, toks, tokens, pos0,
+                     plen, slot, key, temp):
+                engine.decode_compile_counter.bump()
+                pk = [jax.lax.dynamic_slice(
+                    kc, (slot, zero, zero, zero), (1, H, capacity, D))
+                    for kc in kcs]
+                pv = [jax.lax.dynamic_slice(
+                    vc, (slot, zero, zero, zero), (1, H, capacity, D))
+                    for vc in vcs]
+                # fresh page scale on the first chunk (slot reuse must not
+                # inherit the previous stream's running max)
+                wipe = (pos0 == 0)
+
+                def slice_scale(s):
+                    sl = jax.lax.dynamic_slice(
+                        s, (slot, zero, zero, zero), (1, H, 1, 1))
+                    return jnp.where(wipe, jnp.zeros_like(sl), sl)
+
+                ps = [slice_scale(s) for s in kss]
+                qs = [slice_scale(s) for s in vss]
+                with _trace.trace_scope(jax.random.PRNGKey(0), False) as t:
+                    t.param_store = {id(p): a
+                                     for p, a in zip(plist, params)}
+                    logits, pk, ps, pv, qs = \
+                        model.decode_step_speculative_quant(
+                            _trace.F, tokens, pk, ps, pv, qs,
+                            jnp.reshape(pos0, (1,)))
+                kcs = [jax.lax.dynamic_update_slice(
+                    kc, p, (slot, zero, zero, zero))
+                    for kc, p in zip(kcs, pk)]
+                kss = [jax.lax.dynamic_update_slice(
+                    s0, s, (slot, zero, zero, zero))
+                    for s0, s in zip(kss, ps)]
+                vcs = [jax.lax.dynamic_update_slice(
+                    vc, p, (slot, zero, zero, zero))
+                    for vc, p in zip(vcs, pv)]
+                vss = [jax.lax.dynamic_update_slice(
+                    s0, s, (slot, zero, zero, zero))
+                    for s0, s in zip(vss, qs)]
+                valid, toks = finish(logits, valid, toks, pos0, plen, slot,
+                                     key, temp)
+                return kcs, kss, vcs, vss, valid, toks
+
+            fn = self._jit(pure, donate=(1, 2, 3, 4, 5, 6),
+                           hint="chunk%d@c%d" % (tc, capacity))
+            self._chunk_fns[(tc, capacity)] = fn
+            return fn
+
+        def pure(params, kcs, vcs, valid, toks, tokens, pos0, plen, slot,
+                 key, temp):
+            engine.decode_compile_counter.bump()
+            pk = [jax.lax.dynamic_slice(
+                kc, (slot, zero, zero, zero), (1, H, capacity, D))
+                for kc in kcs]
+            pv = [jax.lax.dynamic_slice(
+                vc, (slot, zero, zero, zero), (1, H, capacity, D))
+                for vc in vcs]
+            with _trace.trace_scope(jax.random.PRNGKey(0), False) as t:
+                t.param_store = {id(p): a for p, a in zip(plist, params)}
+                logits, pk, pv = model.decode_step_speculative(
+                    _trace.F, tokens, pk, pv, jnp.reshape(pos0, (1,)))
+            kcs = [jax.lax.dynamic_update_slice(
+                kc, p, (slot, zero, zero, zero)) for kc, p in zip(kcs, pk)]
+            vcs = [jax.lax.dynamic_update_slice(
+                vc, p, (slot, zero, zero, zero)) for vc, p in zip(vcs, pv)]
+            valid, toks = finish(logits, valid, toks, pos0, plen, slot,
+                                 key, temp)
+            return kcs, vcs, valid, toks
+
+        fn = self._jit(pure, donate=(1, 2, 3, 4),
+                       hint="chunk%d@c%d" % (tc, capacity))
+        self._chunk_fns[(tc, capacity)] = fn
         return fn
 
     @staticmethod
@@ -867,7 +1332,7 @@ class GenerativeServer:
         bumps ``engine.decode_compile_counter``."""
         need = max(int(max_tokens or 0),
                    max([int(b) for b in prompt_buckets], default=1) + 1)
-        self.cache.ensure_capacity(need)
+        self.cache.ensure_capacity(need + self._spec_margin)
         for b in prompt_buckets:
             stream = GenerationStream([1] * int(b), 1, 0.0, 0, 0)
             slot = self.cache.acquire(stream)
@@ -919,7 +1384,17 @@ class GenerativeServer:
                     self.cache.update(kcs, vcs, valid)
                 self._tok = toks
             self.cache.release(slot)
+        if self._draft is not None:
+            # draft-side programs (cache fill per prompt bucket + the
+            # k-unrolled propose step); the dummy decode below compiles
+            # the verify program through the normal speculation path
+            self._draft.warm([min(next_pow2(int(b)), self.cache.capacity)
+                              for b in prompt_buckets])
+        if (self._prefill_chunk is not None
+                and self.cache.capacity >= self._prefill_chunk):
+            self._warm_chunk()
         # one masked all-free decode dispatch compiles the step program
+        # (the verify program when a draft is configured)
         dummy = GenerationStream([1], 1, 0.0, 0, 0)
         slot = self.cache.acquire(dummy)
         if slot is not None:
@@ -928,6 +1403,35 @@ class GenerativeServer:
             if self.cache.owner(slot) is dummy:
                 self._retire(slot)
         return self
+
+    def _warm_chunk(self):
+        """Compile the chunked-prefill program on a throwaway slot (a
+        single final chunk: pos0=0, plen=chunk — same program every real
+        chunk reuses, only the scalar operands differ)."""
+        tc = self._prefill_chunk
+        dummy = GenerationStream([1] * tc, 1, 0.0, 0, 0)
+        slot = self.cache.acquire(dummy)
+        if slot is None:
+            return
+        fn = self._chunk_fn(tc, self.cache.capacity)
+        params = [p.data()._data for p in self._plist]
+        key = np.asarray(jax.random.PRNGKey(0), np.uint32)
+        chunk = np.zeros((1, tc), np.int32)
+        if self._quantize:
+            kcs, kss, vcs, vss, valid, toks = fn(
+                params, self.cache.k, self.cache.k_scale, self.cache.v,
+                self.cache.v_scale, self.cache.valid, self._tok,
+                jnp.asarray(chunk), jnp.int32(0), jnp.int32(tc),
+                jnp.int32(slot), jnp.asarray(key), jnp.float32(0.0))
+            self.cache.update(kcs, vcs, valid, kss, vss)
+        else:
+            kcs, vcs, valid, toks = fn(
+                params, self.cache.k, self.cache.v, self.cache.valid,
+                self._tok, jnp.asarray(chunk), jnp.int32(0), jnp.int32(tc),
+                jnp.int32(slot), jnp.asarray(key), jnp.float32(0.0))
+            self.cache.update(kcs, vcs, valid)
+        self._tok = toks
+        self.cache.release(slot)
 
     # ------------------------------------------------ snapshot interface
     def export_executables(self):
@@ -941,6 +1445,11 @@ class GenerativeServer:
             if c is not None:
                 out.append({"key": "decode@c%d" % cap, "kind": "decode",
                             "tp": 0, "capacity": int(cap), "compiled": c})
+        for cap, fn in sorted(self._verify_fns.items()):
+            c = fn.compiled_for()
+            if c is not None:
+                out.append({"key": "verify@c%d" % cap, "kind": "verify",
+                            "tp": 0, "capacity": int(cap), "compiled": c})
         for kind, fns in (("prefill", self._prefill_fns),
                           ("inject", self._inject_fns),
                           ("extract", self._extract_fns)):
@@ -950,6 +1459,15 @@ class GenerativeServer:
                     out.append({"key": "%s@t%dc%d" % (kind, tp, cap),
                                 "kind": kind, "tp": int(tp),
                                 "capacity": int(cap), "compiled": c})
+        # chunk programs key on (chunk_len, capacity) like prompt buckets
+        for (tc, cap), fn in sorted(self._chunk_fns.items()):
+            c = fn.compiled_for()
+            if c is not None:
+                out.append({"key": "chunk@t%dc%d" % (tc, cap),
+                            "kind": "chunk", "tp": int(tc),
+                            "capacity": int(cap), "compiled": c})
+        if self._draft is not None:
+            out.extend(self._draft.export_executables())
         return out
 
     def preload_executable(self, kind, tp, capacity, compiled):
@@ -959,12 +1477,23 @@ class GenerativeServer:
         first use (AotFn's recovery path)."""
         if kind == "decode":
             fn = self._decode_fn(capacity)
+        elif kind == "verify":
+            fn = self._verify_fn(capacity)
         elif kind == "prefill":
             fn = self._prefill_fn(tp, capacity)
         elif kind == "inject":
             fn = self._inject_fn(tp, capacity)
         elif kind == "extract":
             fn = self._extract_fn(tp, capacity)
+        elif kind == "chunk":
+            fn = self._chunk_fn(tp, capacity)
+        elif kind in ("draftstep", "draftfill"):
+            if self._draft is None:
+                raise ServeError(
+                    "snapshot carries %r programs but this server has no "
+                    "draft configured" % kind)
+            self._draft.preload_executable(kind, tp, capacity, compiled)
+            return
         else:
             raise ServeError("unknown snapshot program kind %r" % kind)
         fn.adopt(compiled)
@@ -993,6 +1522,12 @@ class GenerativeServer:
             prefix_entries=(len(self.prefix) if self.prefix is not None
                             else None),
             decode_compile_counter=engine.decode_compile_counter.count,
+            verify_dispatches=engine.verify_dispatch_counter.count,
+            spec_k=self.spec_k if self._draft is not None else None,
+            draft=(type(self._draft).__name__
+                   if self._draft is not None else None),
+            prefill_chunk=self._prefill_chunk,
+            chunk_queue_depth=len(self._chunk_jobs),
             quantize=self._quantize,
             kv_cache_bytes=self.cache.nbytes(),
             kv_cache_bytes_unquantized=self.cache.nbytes_unquantized(),

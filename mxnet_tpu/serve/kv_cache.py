@@ -155,12 +155,15 @@ class PagedKVCache:
     def num_active(self):
         return self.slots - len(self._free)
 
-    def active_mask(self):
+    def active_mask(self, exclude=()):
         """(slots,) int32 mask of live pages — a traced input of the decode
         program (free slots sample nothing and their valid_len holds), so
-        join/leave between steps never changes a shape."""
-        return np.asarray([0 if o is None else 1 for o in self._owner],
-                          np.int32)
+        join/leave between steps never changes a shape. ``exclude`` drops
+        acquired-but-not-yet-decodable pages (chunked prefill in flight):
+        the slot is owned, so admission can't reuse it, but decode must
+        treat it as free until its final chunk lands."""
+        return np.asarray([0 if (o is None or i in exclude) else 1
+                           for i, o in enumerate(self._owner)], np.int32)
 
     def update(self, k, v, valid, k_scale=None, v_scale=None):
         """Install the arrays a compiled step returned (the old buffers

@@ -164,32 +164,83 @@ class GenerativeMetrics(ServeMetrics):
         self._ttft_n = 0
         self._itl = [0.0] * self._window    # per decode step, ms
         self._itl_n = 0
+        self._itl_pf = [0.0] * self._window  # steps under chunked prefill
+        self._itl_pf_n = 0
         self.tokens = 0                     # generated tokens, all requests
         self.steps = 0                      # decode dispatches
         self.prefills = 0                   # whole-prompt forward dispatches
+        self.prefill_chunks = 0             # chunked-prefill dispatches
         self._decode_s = 0.0                # decode-active wall time
         self._active_slot_steps = 0         # live slots summed over steps
         self._slot_steps = 0                # padded slots summed over steps
+        # speculative decode: drafted = proposals offered to verify
+        # (active_slots × (k-1) per round), accepted = proposals the target
+        # kept — accepted/drafted is the accept rate the k-vs-overhead
+        # trade lives or dies by
+        self.spec_rounds = 0
+        self.drafted_tokens = 0
+        self.accepted_tokens = 0
+        # TTFT split by pow2 prompt-length bucket: long prompts have
+        # honest multi-chunk TTFTs and must not hide behind short-prompt
+        # medians (each bucket gets its own ring → per-bucket percentiles
+        # under `bucket=` labels in /metrics)
+        self._ttft_by_bucket = {}           # bucket(int) -> [ring, n]
 
-    def record_first_token(self, ms):
+    @staticmethod
+    def _pow2_bucket(n):
+        b = 1
+        while b < n:
+            b <<= 1
+        return b
+
+    def record_first_token(self, ms, prompt_len=None):
         with self._lock:
             self._ttft[self._ttft_n % self._window] = float(ms)
             self._ttft_n += 1
             self.tokens += 1   # the first token is sampled by prefill
+            if prompt_len is not None:
+                b = self._pow2_bucket(int(prompt_len))
+                ent = self._ttft_by_bucket.get(b)
+                if ent is None:
+                    # bounded: one ring per pow2 bucket, log2(max_length)
+                    # buckets total — not per-prompt state (GL006)
+                    ent = self._ttft_by_bucket[b] = [[0.0] * self._window, 0]
+                ent[0][ent[1] % self._window] = float(ms)
+                ent[1] += 1
 
     def record_prefill(self, n=1):
         with self._lock:
             self.prefills += n
 
-    def record_step(self, step_s, n_tokens, n_active, slots):
+    def record_chunk(self, n=1):
+        with self._lock:
+            self.prefill_chunks += n
+
+    def record_step(self, step_s, n_tokens, n_active, slots,
+                    under_prefill=False):
+        """One decode (or verify) dispatch: ``n_tokens`` emitted across
+        ``n_active`` live slots. ``under_prefill`` marks steps taken while
+        chunked prefills were in flight — their ITLs land in a separate
+        ``itl_prefill`` ring so the interference chunking is supposed to
+        bound is directly measurable."""
         with self._lock:
             self._itl[self._itl_n % self._window] = float(step_s) * 1e3
             self._itl_n += 1
+            if under_prefill:
+                self._itl_pf[self._itl_pf_n % self._window] = \
+                    float(step_s) * 1e3
+                self._itl_pf_n += 1
             self.steps += 1
             self.tokens += int(n_tokens)
             self._decode_s += float(step_s)
             self._active_slot_steps += int(n_active)
             self._slot_steps += int(slots)
+
+    def record_spec_round(self, drafted, accepted):
+        with self._lock:
+            self.spec_rounds += 1
+            self.drafted_tokens += int(drafted)
+            self.accepted_tokens += int(accepted)
 
     def snapshot(self):
         snap = super().snapshot()
@@ -198,14 +249,29 @@ class GenerativeMetrics(ServeMetrics):
                 "tokens": self.tokens,
                 "decode_steps": self.steps,
                 "prefills": self.prefills,
+                "prefill_chunks": self.prefill_chunks,
                 "tokens_per_s": (round(self.tokens / self._decode_s, 1)
                                  if self._decode_s > 0 else None),
                 "inflight_fill": (round(self._active_slot_steps
                                         / self._slot_steps, 4)
                                   if self._slot_steps else None),
+                "spec_rounds": self.spec_rounds,
+                "drafted_tokens": self.drafted_tokens,
+                "accepted_tokens": self.accepted_tokens,
+                "accept_rate": (round(self.accepted_tokens
+                                      / self.drafted_tokens, 4)
+                                if self.drafted_tokens else None),
             })
             snap.update(_ring_percentiles(
                 self._ttft, min(self._ttft_n, self._window), "ttft"))
             snap.update(_ring_percentiles(
                 self._itl, min(self._itl_n, self._window), "itl"))
+            snap.update(_ring_percentiles(
+                self._itl_pf, min(self._itl_pf_n, self._window),
+                "itl_prefill"))
+            snap["ttft_by_bucket"] = {
+                str(b): {
+                    k.replace("b_", ""): v for k, v in _ring_percentiles(
+                        ring, min(n, self._window), "b").items()}
+                for b, (ring, n) in sorted(self._ttft_by_bucket.items())}
         return snap

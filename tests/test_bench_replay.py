@@ -50,7 +50,18 @@ def test_cross_mode_substitution_is_unmistakable(tmp_path):
 
 def test_age_days_parses_and_clamps():
     sys.path.insert(0, REPO)
-    import bench
+    # import bench setdefaults JAX_COMPILATION_CACHE_DIR (+ TPU probe
+    # vars) into THIS pytest process's environ; later tests that spawn
+    # fresh-interpreter children (tests/test_costs.py cost gate) inherit
+    # the persistent-cache dir and crash deserializing entries written
+    # under a different XLA config. Import, then restore the environ.
+    saved = dict(os.environ)
+    try:
+        import bench
+    finally:
+        for k in set(os.environ) - set(saved):
+            del os.environ[k]
+        os.environ.update(saved)
     assert bench._age_days(None) is None
     assert bench._age_days("not-a-date") is None
     assert bench._age_days("2020-01-01T00:00:00Z") > 2000

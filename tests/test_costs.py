@@ -50,8 +50,16 @@ def _subprocess(argv, **env_extra):
     """Fresh-interpreter run. ``close_fds=False`` keeps the posix_spawn
     fast path (forking this heavily-threaded jax parent has crashed
     children with malloc-arena corruption under full-suite load), and a
-    signal-death (rc < 0) gets ONE retry — a wrong RESULT never does."""
+    signal-death (rc < 0) gets ONE retry — a wrong RESULT never does.
+
+    ``JAX_COMPILATION_CACHE_DIR`` is stripped: ``import bench`` anywhere
+    earlier in the session setdefaults it into this process's environ,
+    and a child deserializing executables the parent wrote under a
+    different XLA config dies with SIGSEGV/SIGABRT before main(). Cost
+    capture happens at trace time, so the replay gate loses nothing by
+    running cache-less."""
     env = dict(os.environ, JAX_PLATFORMS="cpu", **env_extra)
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
     for _ in range(2):
         r = subprocess.run([sys.executable] + argv, cwd=REPO, env=env,
                            capture_output=True, text=True, timeout=300,

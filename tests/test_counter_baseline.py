@@ -293,6 +293,36 @@ def test_dist_exchange_counters_match_artifact():
                row["steady_state_bucket_builds"])
 
 
+# ---------------------------------------------------------- specdecode
+def test_specdecode_artifact_pins():
+    """Speculative-decode gate (ISSUE 17): the committed artifact must
+    keep its acceptance numbers — tokens/s >= 1.5x plain at accept
+    >= 0.6 on the pinned latency-regime scenario, chunked-prefill
+    victim ITL p95 >= 2x better than whole-prompt prefill, and the
+    structural columns the speedup rests on (ONE verify dispatch per
+    round, zero steady-state recompiles). Wall-clock is measured by
+    tools/serve_bench.py --mode specdecode with the paired-step method;
+    re-timing it here would flake on a loaded CI host. The LIVE replay
+    of the 1-verify-per-round / zero-retrace / exact-parity contract is
+    tests/test_speculative.py::
+    test_spec_steady_state_dispatch_budget_watchdog_armed."""
+    art = _artifact("serve_specdecode_bench_quick.json")
+    row = _row(art, "nano GPT latency-regime specdecode (ngram draft, k=4)")
+    assert row["speedup"] >= 1.5, \
+        "committed specdecode speedup %.2f below the 1.5x acceptance bar" \
+        % row["speedup"]
+    assert min(row["speedup_all_reps"]) >= 1.5, \
+        "a paired rep fell below the 1.5x bar: %r" % row["speedup_all_reps"]
+    assert row["accept_rate"] >= 0.6
+    assert row["chunked_itl_p95_improvement"] >= 2.0, \
+        "committed chunked-prefill ITL improvement %.2fx below the 2x bar" \
+        % row["chunked_itl_p95_improvement"]
+    assert row["dispatches_per_round"] == 1
+    assert row["steady_state_recompiles"] == 0
+    assert row["verify_dispatches"] == row["spec_rounds"]
+    assert 1.0 <= row["tokens_per_verify_dispatch"] <= row["spec_k"]
+
+
 # ------------------------------------------------- artifact sanity gate
 @pytest.mark.parametrize("name,counter_cols", [
     ("opt_step_bench_quick.json", ["fused_dispatches_per_step"]),
@@ -322,6 +352,16 @@ def test_dist_exchange_counters_match_artifact():
     # tests/test_costs.py::test_cost_gate_replay_matches_committed_artifact
     ("cost_report_quick.json", ["tier", "programs", "flops",
                                 "bytes_accessed", "peak_hbm_bytes"]),
+    # speedup/accept/ITL-improvement bars + the 1-dispatch-per-round
+    # contract are pinned above in
+    # test_specdecode_counters_and_artifact_pins
+    ("serve_specdecode_bench_quick.json", ["spec_rounds",
+                                           "verify_dispatches",
+                                           "dispatches_per_round",
+                                           "tokens_per_verify_dispatch",
+                                           "accept_rate",
+                                           "steady_state_recompiles",
+                                           "chunked_itl_p95_improvement"]),
 ])
 def test_committed_artifacts_carry_counter_columns(name, counter_cols):
     """The gate only works while the artifacts keep their counter columns —

@@ -178,6 +178,22 @@ def main():
                              s["itl_p99_ms"], s["inflight_fill"],
                              s["in_flight"], s["slots"], s["capacity"],
                              s["prefix_hits"], s["prefix_misses"]))
+                if s.get("draft"):
+                    # speculative decode: the accept rate is THE health
+                    # number — a drop means the draft stopped predicting
+                    # the traffic and every round pays the wide verify
+                    # for ~1 token
+                    print("%-13s  spec: draft=%s k=%s rounds=%s accept=%s "
+                          "(%s/%s drafted) verify_dispatches=%s"
+                          % ("", s["draft"], s["spec_k"], s["spec_rounds"],
+                             s["accept_rate"], s["accepted_tokens"],
+                             s["drafted_tokens"], s["verify_dispatches"]))
+                if s.get("prefill_chunk"):
+                    print("%-13s  chunked prefill: chunk=%s chunks_run=%s "
+                          "in_queue=%s itl_under_prefill_p95=%s"
+                          % ("", s["prefill_chunk"], s["prefill_chunks"],
+                             s["chunk_queue_depth"],
+                             s["itl_prefill_p95_ms"]))
         else:
             print("live servers : none (snapshots appear while a "
                   "serve.ModelServer is alive)")

@@ -83,6 +83,7 @@ and the allowlist stay stable.
 from __future__ import annotations
 
 import ast
+import hashlib
 import json
 import os
 from typing import Dict, List, NamedTuple, Optional, Set, Tuple
@@ -905,10 +906,79 @@ def lint_source(src: str, path: str,
     return findings
 
 
+class _FileCache:
+    """Per-file findings cache keyed by (path, sha256(source)): repeated
+    ``lint_paths`` runs in one process (watch loops, the test suite's
+    multiple self-lint entry points) skip re-parsing unchanged files.
+
+    An entry stores the file's own findings PLUS the lock-order edge set
+    it contributed (linted against a fresh ConcurrencyLint, so the entry
+    is independent of what other files ran first); replay merges those
+    edges first-wins into the shared lock graph, so the cross-module
+    GL015 cycle check still sees every file's edges whether the file was
+    linted live or served from cache. Bounded, insertion-order eviction
+    (graphlint's own GL006 discipline — this module is stdlib-only, so
+    ``base.BoundedCache`` is out of reach)."""
+
+    def __init__(self, cap: int) -> None:
+        self.cap = max(1, int(cap))
+        self.hits = 0
+        self.misses = 0
+        self._store: Dict[Tuple[str, str], Tuple[tuple, dict]] = {}
+
+    def get(self, key):
+        entry = self._store.get(key)
+        if entry is None:
+            self.misses += 1
+        else:
+            self.hits += 1
+        return entry
+
+    def put(self, key, findings, edges) -> None:
+        while len(self._store) >= self.cap:
+            self._store.pop(next(iter(self._store)))
+        self._store[key] = (tuple(findings), dict(edges))
+
+    def stats(self) -> Dict[str, int]:
+        return {"entries": len(self._store), "cap": self.cap,
+                "hits": self.hits, "misses": self.misses}
+
+    def clear(self) -> None:
+        self._store.clear()
+        self.hits = self.misses = 0
+
+
+def _cache_cap(default: int = 512) -> int:
+    try:
+        return int(os.environ.get("MXNET_GRAPHLINT_CACHE_CAP", default))
+    except ValueError:
+        return default
+
+
+file_cache = _FileCache(_cache_cap())
+
+
+def _lint_file(src: str, rel: str, conc) -> List[Finding]:
+    """Lint one file through the cache, merging its lock-graph edges
+    (first-wins, matching ConcurrencyLint._edge) into the shared graph."""
+    key = (rel, hashlib.sha256(src.encode("utf-8")).hexdigest())
+    entry = file_cache.get(key)
+    if entry is None:
+        conc_own = _conc.ConcurrencyLint()
+        found = lint_source(src, rel, _conc_shared=conc_own)
+        file_cache.put(key, found, conc_own.edges)
+        entry = file_cache._store[key]
+    found, edges = entry
+    for edge, loc in edges.items():
+        conc.edges.setdefault(edge, loc)
+    return list(found)
+
+
 def lint_paths(paths, exclude=()) -> List[Finding]:
     """Lint .py files under ``paths`` (files or directories). Paths in
     findings are normalized to forward-slash relatives of the CWD when
-    possible, so output and allowlist keys are machine-independent."""
+    possible, so output and allowlist keys are machine-independent.
+    Unchanged files replay from ``file_cache`` (keyed by content hash)."""
     files: List[str] = []
     for p in paths:
         if os.path.isdir(p):
@@ -927,7 +997,7 @@ def lint_paths(paths, exclude=()) -> List[Finding]:
         rel = f if rel.startswith("..") else rel
         rel = rel.replace(os.sep, "/")
         with open(f, "r", encoding="utf-8") as fh:
-            findings.extend(lint_source(fh.read(), rel, _conc_shared=conc))
+            findings.extend(_lint_file(fh.read(), rel, conc))
     findings.extend(Finding(*t) for t in conc.finish())
     findings.sort(key=lambda x: (x.path, x.line, x.rule, x.msg))
     return findings

@@ -1,0 +1,843 @@
+"""hlolint — program-level static analysis over lowered StableHLO.
+
+graphlint (GL001-010) and racecheck (GL011-015) lint the *Python* that
+builds programs; this module lints the *programs*: the lowered StableHLO
+text every bulk window, tape replay, hybrid forward, serve bucket, and
+decode step already hands to ``observability/costs.py``. Relay's
+"optimization as verifiable pass" thesis applied one level down — to the
+compiled artifact itself: dtype upcasts, host transfers, undonated hot
+buffers, and convert-churn become deterministic CPU findings instead of
+after-the-fact bench regressions.
+
+Rules (GL020+ — the program stage of the GL numbering):
+
+* GL020 — unintended f32 widening in a low-precision program: a
+  ``stablehlo.convert`` from bf16/f16/int8 to f32/f64 feeding a
+  dot/reduce/convolution, inside a program whose *inputs* are
+  low-precision. Mixed-precision accumulation (bf16 operands straight
+  into a dot with a wider ``preferred_element_type``) does NOT fire —
+  only the explicit widen-then-compute pattern does.
+* GL021 — host round-trip inside a hot-tier program (serve / decode /
+  tape): infeed/outfeed/send/recv, or a custom_call whose target is a
+  host callback. One host hop inside a decode step serializes every
+  token.
+* GL022 — large undonated output: an output whose (shape, dtype) matches
+  a live, undonated input — the aliasing table says XLA must allocate a
+  fresh buffer every call where donation would reuse the input's.
+* GL023 — rank-expanding broadcast that multiplies bytes: a non-scalar
+  ``broadcast_in_dim`` whose result is both large and a big multiple of
+  its operand — the pattern that turns a per-head mask into a
+  per-slot-per-head materialized copy.
+* GL024 — convert-churn: a narrowing convert (quantize) whose value
+  reaches a widening convert back (dequantize) through data-movement
+  ops only — no intervening compute. The int8 KV path that quantizes a
+  page and immediately dequantizes it pays two converts per element per
+  step for nothing.
+* GL025 — dead or duplicate program outputs: the same SSA value returned
+  twice, or an input returned untouched — caller-side buffers and
+  tuple-packing for values the caller already has.
+
+Findings carry the program's tier / hint / content key, ``op_name``
+provenance recovered from the debug-form location table (the PR 13
+``named_scope`` plumbing), the rule-specific byte count, and — when the
+cost ledger has a profile for the program — its flops / bytes_accessed,
+so :func:`rank` orders output by what the finding actually costs, not
+alphabetically.
+
+Capture rides the existing cost-attribution seam: ``costs.
+record_compiled`` (the eager AotFn path) and ``costs.materialize`` (the
+lazy tracked-jit drain) call :func:`capture` with the lowered handle;
+the corpus is bounded (``MXNET_HLOLINT_CAP``) and the whole subsystem
+has a kill switch (``MXNET_HLOLINT=0``). Parsing is stdlib-only and this
+module imports nothing from the jax-backed package, so
+``tools/hlolint.py`` can load it standalone, exactly like graphlint.
+
+CI discipline mirrors graphlint: ``tools/hlolint.py --ci`` replays the
+pinned cost-report scenarios, lints every captured program, and fails on
+any finding not suppressed by ``tools/hlolint_allow.json`` (per-entry
+``why`` required) — and on any allowlist entry that no longer fires.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+from typing import NamedTuple
+
+RULES = {
+    "GL020": "unintended f32 widening in a low-precision program",
+    "GL021": "host round-trip inside a hot-tier program",
+    "GL022": "large output that could be donated but is not",
+    "GL023": "byte-multiplying broadcast materializing copies",
+    "GL024": "convert-churn: quantize->dequantize with no compute between",
+    "GL025": "dead or duplicate program output",
+}
+
+#: tiers whose programs sit on a per-request / per-token hot path
+HOT_TIERS = frozenset({"serve", "decode", "tape"})
+
+#: dtypes that mark a program as deliberately low-precision (GL020)
+LOW_PRECISION = frozenset({"bf16", "f16", "i8", "ui8", "i4", "ui4",
+                           "f8E4M3FN", "f8E5M2", "f8E4M3FNUZ", "f8E5M2FNUZ"})
+
+#: ops a quantized value can flow through without being "computed on"
+#: (GL024's no-intervening-compute condition)
+_PASSTHROUGH = frozenset({
+    "reshape", "transpose", "broadcast_in_dim", "slice", "dynamic_slice",
+    "dynamic_update_slice", "concatenate", "copy", "optimization_barrier",
+    "tuple", "get_tuple_element", "bitcast_convert",
+    # vmapped dynamic_update_slice lowers to scatter (overwrite region) —
+    # a cache write is still data movement, not compute
+    "scatter", "gather",
+})
+
+#: compute sinks a widening convert must feed for GL020 to fire
+_COMPUTE_SINKS = frozenset({
+    "dot_general", "dot", "convolution", "reduce", "reduce_window",
+})
+
+_ITEMSIZE = {
+    "f64": 8.0, "f32": 4.0, "f16": 2.0, "bf16": 2.0,
+    "f8E4M3FN": 1.0, "f8E5M2": 1.0, "f8E4M3FNUZ": 1.0, "f8E5M2FNUZ": 1.0,
+    "i64": 8.0, "ui64": 8.0, "i32": 4.0, "ui32": 4.0,
+    "i16": 2.0, "ui16": 2.0, "i8": 1.0, "ui8": 1.0,
+    "i4": 0.5, "ui4": 0.5, "i1": 1.0, "pred": 1.0,
+    "complex<f32>": 8.0, "complex<f64>": 16.0,
+}
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_enabled():
+    v = os.environ.get("MXNET_HLOLINT", "1").strip().lower()
+    return v not in ("0", "false", "off", "no")
+
+
+_enabled = _env_enabled()
+_lock = threading.Lock()
+_CAP = max(_env_int("MXNET_HLOLINT_CAP", 256), 1)
+#: outputs below this size are never worth a GL022 report (16 KiB —
+#: small enough to see a nano model's KV pages, big enough to skip
+#: scalar/logit outputs)
+DONATE_MIN_BYTES = _env_int("MXNET_HLOLINT_DONATE_MIN", 16 * 1024)
+#: GL023 thresholds: result size, operand size (excludes scalar splats),
+#: and the expansion factor the broadcast must reach
+BCAST_MIN_OUT = _env_int("MXNET_HLOLINT_BCAST_MIN_OUT", 256 * 1024)
+BCAST_MIN_IN = _env_int("MXNET_HLOLINT_BCAST_MIN_IN", 1024)
+BCAST_FACTOR = _env_int("MXNET_HLOLINT_BCAST_FACTOR", 8)
+
+_corpus = {}          # (tier, key) -> {"tier","hint","key","text"}
+_dropped = 0          # corpus entries evicted past the cap
+_errors = 0           # capture/parse failures swallowed
+
+
+def itemsize(dtype):
+    """Bytes per element for a StableHLO element type (1.0 fallback)."""
+    return _ITEMSIZE.get(dtype, 1.0)
+
+
+# ---------------------------------------------------------------- parsing
+class TType(NamedTuple):
+    """A parsed ``tensor<...>`` type: static shape, element type, bytes."""
+    shape: tuple
+    dtype: str
+    nbytes: int
+
+    def describe(self):
+        dims = "x".join(str(d) for d in self.shape) if self.shape else ""
+        return "tensor<%s>" % (dims + ("x" if dims else "") + self.dtype)
+
+
+class HloOp(NamedTuple):
+    """One SSA op: ``%r = dialect.name operands... : sig loc(...)``."""
+    line: int
+    result: str           # "" for ops with no result (return handled apart)
+    nresults: int
+    name: str             # full dialect name, e.g. "stablehlo.convert"
+    operands: tuple       # SSA value tokens, in order of appearance
+    result_types: tuple   # TType per result (may be empty if unparsable)
+    operand_types: tuple  # TTypes from the functional signature, or ()
+    loc: str              # raw loc payload ("#loc4", '"name"', "unknown")
+    target: str           # custom_call @target, else ""
+
+    @property
+    def short(self):
+        return self.name.rsplit(".", 1)[-1]
+
+
+class Arg(NamedTuple):
+    index: int
+    name: str             # "%arg0"
+    type: TType
+    alias_output: int     # tf.aliasing_output value, or -1 when undonated
+
+
+def _balanced(s, i, open_ch, close_ch):
+    """Index just past the bracket that closes ``s[i]`` (== open_ch)."""
+    depth = 0
+    for j in range(i, len(s)):
+        if s[j] == open_ch:
+            depth += 1
+        elif s[j] == close_ch:
+            depth -= 1
+            if depth == 0:
+                return j + 1
+    return len(s)
+
+
+def _split_top(s, sep=","):
+    """Split at top-level separators (outside (), [], {}, <>)."""
+    out, depth, start = [], 0, 0
+    for i, ch in enumerate(s):
+        if ch in "([{<":
+            depth += 1
+        elif ch in ")]}>":
+            depth -= 1
+        elif ch == sep and depth == 0:
+            out.append(s[start:i])
+            start = i + 1
+    out.append(s[start:])
+    return [p for p in (p.strip() for p in out) if p]
+
+
+def parse_type(tok):
+    """``tensor<4x8xbf16>`` -> TType((4, 8), "bf16", 64). Dynamic dims
+    (``?``) count as 1; non-tensor types get a zero-byte placeholder."""
+    tok = tok.strip()
+    if not tok.startswith("tensor<") or not tok.endswith(">"):
+        return TType((), tok, 0)
+    inner = tok[len("tensor<"):-1]
+    parts = inner.split("x")
+    dims = []
+    for p in parts:
+        if p.isdigit():
+            dims.append(int(p))
+        elif p == "?":
+            dims.append(1)
+        else:
+            break
+    dtype = "x".join(parts[len(dims):])
+    n = 1
+    for d in dims:
+        n *= d
+    return TType(tuple(dims), dtype, int(n * itemsize(dtype)))
+
+
+def _lead_type(s):
+    """The leading type token of an arg/result declaration."""
+    s = s.strip()
+    if s.startswith("tensor<"):
+        return s[:_balanced(s, len("tensor"), "<", ">")]
+    m = re.match(r"[!\w.]+(<[^>]*>)?", s)
+    return m.group(0) if m else s
+
+
+def _strip_loc(rest):
+    """Split a trailing ``loc(...)`` off an op line (payload may nest
+    parens: ``loc("name"(#loc3))``). Returns (rest, payload_or_empty)."""
+    i = rest.rfind(" loc(")
+    if i < 0:
+        return rest, ""
+    end = _balanced(rest, i + 4, "(", ")")
+    if rest[end:].strip():
+        return rest, ""          # not actually trailing
+    return rest[:i].rstrip(), rest[i + 5:end - 1]
+
+
+_LOCDEF_RE = re.compile(r"^(#\w+)\s*=\s*loc\((.*)\)\s*$")
+_OP_RE = re.compile(r"^\s*(?:(%[\w]+)(?::(\d+))?\s*=\s*)?"
+                    r"\"?([a-z_][\w$]*\.[\w.]+|call)\"?[\s(](.*)$")
+_RET_RE = re.compile(r"^\s*(?:func\.)?return\b\s*(.*)$")
+_SSA_RE = re.compile(r"%[\w#]+")
+_ALIAS_RE = re.compile(r"tf\.aliasing_output\s*=\s*(\d+)")
+_TARGET_RE = re.compile(r"@[\w.\-]+")
+
+
+class Program:
+    """A parsed StableHLO module: @main's args (with donation attrs),
+    ops, return values, and the debug location table."""
+
+    def __init__(self):
+        self.args = []          # [Arg]
+        self.ops = []           # [HloOp]
+        self.results = []       # [(value, TType or None)]
+        self.locs = {}          # "#locN" -> raw payload
+        self.defs = {}          # value -> (HloOp, result_index)
+        self.uses = {}          # value -> [HloOp]
+        self.argmap = {}        # "%arg0" -> Arg
+
+    # -- type lookup ------------------------------------------------------
+    def type_of(self, value):
+        """Result TType of an SSA value (def site first, then args)."""
+        hit = self.defs.get(value)
+        if hit is not None:
+            op, idx = hit
+            if idx < len(op.result_types):
+                return op.result_types[idx]
+            return op.result_types[0] if op.result_types else None
+        arg = self.argmap.get(value)
+        return arg.type if arg is not None else None
+
+    # -- provenance -------------------------------------------------------
+    def op_name(self, op):
+        """named_scope provenance of an op, recovered from its loc and
+        cleaned of the ``jit(...)`` wrapper components."""
+        return _clean_op_name(self._resolve_loc(op.loc, 0))
+
+    def _resolve_loc(self, payload, depth):
+        if depth > 8 or not payload:
+            return ""
+        payload = payload.strip()
+        if payload.startswith("#"):
+            return self._resolve_loc(self.locs.get(payload, ""), depth + 1)
+        if payload.startswith("fused["):
+            inner = payload[len("fused["):].rstrip("]")
+            first = _split_top(inner)
+            return self._resolve_loc(first[0], depth + 1) if first else ""
+        if payload.startswith('"'):
+            end = payload.find('"', 1)
+            if end < 0:
+                return ""
+            name = payload[1:end]
+            tail = payload[end + 1:]
+            if tail.startswith(":"):
+                return ""        # "file.py":line:col — positional, no name
+            return name
+        return ""                # unknown / callsite(...)
+
+
+def _clean_op_name(name):
+    """Drop the jit wrapper components, keeping user scopes + primitive:
+    ``jit(f)/jit(main)/blk/attn/dot_general`` -> ``blk/attn/dot_general``
+    (same cleaning tools/profile_hlo_map.py applies to op_name=)."""
+    if not name:
+        return ""
+    parts = [p for p in name.split("/")
+             if p and not (p.startswith("jit(") and p.endswith(")"))]
+    return "/".join(parts)
+
+
+def _parse_args(sig):
+    """Args (with donation attrs) from a joined func.func signature."""
+    i = sig.find("(")
+    if i < 0:
+        return []
+    end = _balanced(sig, i, "(", ")")
+    out = []
+    for idx, piece in enumerate(_split_top(sig[i + 1:end - 1])):
+        m = re.match(r"(%[\w]+)\s*:\s*(.*)$", piece)
+        if not m:
+            continue
+        name, rest = m.group(1), m.group(2)
+        ttype = parse_type(_lead_type(rest))
+        am = _ALIAS_RE.search(rest)
+        out.append(Arg(idx, name, ttype, int(am.group(1)) if am else -1))
+    return out
+
+
+def _parse_sig(rest):
+    """The trailing type signature of an op line: either
+    ``(op_types) -> result_types`` or a single shared type. Returns
+    (operand_types, result_types)."""
+    # last top-level " : " separates operands/attrs from the signature
+    depth, cut = 0, -1
+    for i, ch in enumerate(rest):
+        if ch in "([{<":
+            depth += 1
+        elif ch in ")]}>":
+            depth -= 1
+        elif ch == ":" and depth == 0:
+            cut = i
+    if cut < 0:
+        return (), ()
+    sig = rest[cut + 1:].strip()
+    if "->" not in sig:
+        t = parse_type(_lead_type(sig))
+        return (t,), (t,)
+    lhs, rhs = sig.split("->", 1)
+    lhs, rhs = lhs.strip(), rhs.strip()
+    if lhs.startswith("(") and lhs.endswith(")"):
+        lhs = lhs[1:-1]
+    if rhs.startswith("(") and rhs.endswith(")"):
+        rhs = rhs[1:-1]
+    opts = tuple(parse_type(_lead_type(p)) for p in _split_top(lhs))
+    rets = tuple(parse_type(_lead_type(p)) for p in _split_top(rhs))
+    return opts, rets
+
+
+def parse_program(text):
+    """Parse StableHLO pretty-form text into a :class:`Program`.
+
+    Tolerant by construction: unrecognized lines are skipped, region ops
+    (reduce/while bodies) parse as ordinary ops, and only @main's
+    signature and return are treated as the program boundary."""
+    prog = Program()
+    lines = text.splitlines()
+    # location table first — defs may sit above or below the module
+    for ln in lines:
+        m = _LOCDEF_RE.match(ln.strip())
+        if m:
+            prog.locs[m.group(1)] = m.group(2)
+
+    # find the entry function: @main, else the first func.func
+    start = -1
+    for i, ln in enumerate(lines):
+        if "func.func" in ln and "@main" in ln:
+            start = i
+            break
+    if start < 0:
+        for i, ln in enumerate(lines):
+            if "func.func" in ln:
+                start = i
+                break
+    if start < 0:
+        return prog
+
+    # join the (possibly wrapped) signature up to the body-opening brace
+    sig_parts, depth, seen = [], 0, False
+    body_at = start
+    for i in range(start, min(start + 256, len(lines))):
+        ln = lines[i]
+        sig_parts.append(ln.strip())
+        for ch in ln:
+            if ch == "(":
+                depth += 1
+                seen = True
+            elif ch == ")":
+                depth -= 1
+        if seen and depth == 0 and ln.rstrip().endswith("{"):
+            body_at = i
+            break
+    prog.args = _parse_args(" ".join(sig_parts))
+    prog.argmap = {a.name: a for a in prog.args}
+
+    # walk the body (brace-depth aware so we stop at @main's close)
+    brace = 1
+    ret_line = ""
+    for i in range(body_at + 1, len(lines)):
+        ln = lines[i]
+        stripped = ln.strip()
+        opened = ln.count("{")
+        closed = ln.count("}")
+        rm = _RET_RE.match(stripped)
+        if rm and brace == 1:
+            ret_line = rm.group(1)
+        else:
+            m = _OP_RE.match(stripped)
+            if m and not stripped.startswith("#"):
+                res, nres, name, rest = m.groups()
+                rest, loc = _strip_loc(rest)
+                operands = tuple(_SSA_RE.findall(rest))
+                opts, rets = _parse_sig(rest)
+                tm = _TARGET_RE.search(rest) if "custom_call" in name else None
+                op = HloOp(i + 1, res or "", int(nres or 1), name, operands,
+                           rets, opts, loc, tm.group(0) if tm else "")
+                prog.ops.append(op)
+                if res:
+                    n = int(nres or 1)
+                    if n == 1:
+                        prog.defs[res] = (op, 0)
+                    else:
+                        for k in range(n):
+                            prog.defs["%s#%d" % (res, k)] = (op, k)
+                        prog.defs[res] = (op, 0)
+                for v in operands:
+                    prog.uses.setdefault(v, []).append(op)
+        brace += opened - closed
+        if brace <= 0:
+            break
+
+    if ret_line:
+        rest, _ = _strip_loc(ret_line)
+        vals = _SSA_RE.findall(rest)
+        cut = rest.find(":")
+        types = []
+        if cut >= 0:
+            types = [parse_type(_lead_type(p))
+                     for p in _split_top(rest[cut + 1:])]
+        for i, v in enumerate(vals):
+            t = types[i] if i < len(types) else prog.type_of(v)
+            prog.results.append((v, t))
+    return prog
+
+
+# ------------------------------------------------------------------ rules
+class Finding(NamedTuple):
+    """One program-level finding, ledger-joined and rankable."""
+    rule: str
+    tier: str
+    hint: str
+    pkey: str             # program content key (16-hex), "" for raw text
+    scope: str            # stable detail for the allowlist key
+    msg: str
+    op: str               # offending op name ("stablehlo.convert", ...)
+    op_name: str          # named_scope provenance, may be ""
+    nbytes: int           # rule-specific byte count
+    cost_bytes: float     # program bytes_accessed from the cost ledger
+    cost_flops: float     # program flops from the cost ledger
+
+    @property
+    def key(self):
+        """Allowlist identity: program-key-free so it survives program
+        edits that keep tier/hint/scope (hints are human-stable)."""
+        return "%s:%s::%s::%s" % (self.tier, self.hint, self.rule,
+                                  self.scope)
+
+    def render(self):
+        where = self.op_name or self.op
+        cost = (", program MB=%.3f" % (self.cost_bytes / 1e6)
+                if self.cost_bytes else "")
+        return "%s:%s [%s] %s (%s, %d bytes%s)" % (
+            self.tier, self.hint, self.rule, self.msg, where,
+            self.nbytes, cost)
+
+    def as_dict(self):
+        d = self._asdict()
+        d["key"] = self.key
+        return d
+
+
+def _hit(rule, scope, msg, op=None, op_name="", nbytes=0):
+    return {"rule": rule, "scope": scope, "msg": msg,
+            "op": op.name if op is not None else "",
+            "op_name": op_name, "nbytes": int(nbytes)}
+
+
+def _rule_gl020(prog, tier):
+    """Widening convert feeding a compute sink in a low-precision
+    program."""
+    if not any(a.type.dtype in LOW_PRECISION for a in prog.args):
+        return []
+    out = []
+    for op in prog.ops:
+        if op.short != "convert" or not op.operands:
+            continue
+        src = prog.type_of(op.operands[0])
+        dst = op.result_types[0] if op.result_types else None
+        if src is None or dst is None:
+            continue
+        if src.dtype not in LOW_PRECISION or dst.dtype not in ("f32", "f64"):
+            continue
+        for use in prog.uses.get(op.result, ()):
+            if use.short in _COMPUTE_SINKS:
+                name = prog.op_name(use) or prog.op_name(op)
+                out.append(_hit(
+                    "GL020",
+                    name or "%s->%s" % (src.dtype, use.short),
+                    "convert %s->%s feeds %s — the program's inputs are "
+                    "%s; compute the sink in the narrow dtype (or "
+                    "accumulate via preferred_element_type) instead of "
+                    "widening the operand" % (src.dtype, dst.dtype,
+                                              use.short, src.dtype),
+                    use, name, dst.nbytes))
+                break
+    return out
+
+
+def _rule_gl021(prog, tier):
+    """Host transfers inside serve/decode/tape programs."""
+    if tier not in HOT_TIERS:
+        return []
+    out = []
+    for op in prog.ops:
+        short = op.short
+        hostish = short in ("infeed", "outfeed", "send", "recv")
+        if not hostish and short == "custom_call":
+            t = op.target.lower()
+            hostish = any(s in t for s in ("callback", "host", "infeed",
+                                           "outfeed", "transfer"))
+        if not hostish:
+            continue
+        nbytes = sum((prog.type_of(v) or TType((), "", 0)).nbytes
+                     for v in op.operands)
+        name = prog.op_name(op)
+        out.append(_hit(
+            "GL021", name or (op.target or short),
+            "host round-trip (%s%s) inside a %s-tier program — every "
+            "dispatch pays a device<->host sync" % (
+                short, " " + op.target if op.target else "", tier),
+            op, name, nbytes))
+    return out
+
+
+def _rule_gl022(prog, tier):
+    """Large outputs with a matching undonated input."""
+    aliased_to = {a.alias_output for a in prog.args if a.alias_output >= 0}
+    taken = set()
+    out = []
+    for i, (val, rt) in enumerate(prog.results):
+        if rt is None or rt.nbytes < DONATE_MIN_BYTES:
+            continue
+        if i in aliased_to or val in prog.argmap:
+            continue          # already donated / passthrough (GL025)
+        cand = None
+        for a in prog.args:
+            if (a.alias_output < 0 and a.index not in taken
+                    and a.type.shape == rt.shape
+                    and a.type.dtype == rt.dtype
+                    and a.name in prog.uses):
+                cand = a
+                break
+        if cand is None:
+            continue
+        taken.add(cand.index)
+        dop = prog.defs.get(val)
+        name = prog.op_name(dop[0]) if dop else ""
+        out.append(_hit(
+            "GL022", "out%d" % i,
+            "output %d (%s, %d bytes) matches undonated input %d (%s) — "
+            "donating it would alias the buffers instead of allocating "
+            "per call" % (i, rt.describe(), rt.nbytes, cand.index,
+                          cand.name),
+            dop[0] if dop else None, name, rt.nbytes))
+    return out
+
+
+def _rule_gl023(prog, tier):
+    """Byte-multiplying broadcasts that materialize expanded copies."""
+    out = []
+    for op in prog.ops:
+        if op.short != "broadcast_in_dim" or not op.operands:
+            continue
+        src = prog.type_of(op.operands[0])
+        dst = op.result_types[0] if op.result_types else None
+        if src is None or dst is None or src.nbytes <= 0:
+            continue
+        if (src.nbytes >= BCAST_MIN_IN
+                and dst.nbytes >= BCAST_MIN_OUT
+                and dst.nbytes >= BCAST_FACTOR * src.nbytes):
+            name = prog.op_name(op)
+            out.append(_hit(
+                "GL023", name or "%s->%s" % (src.describe(),
+                                             dst.describe()),
+                "broadcast_in_dim expands %s (%d bytes) to %s (%d bytes, "
+                "%dx) — restructure so the consumer broadcasts lazily "
+                "instead of materializing the copy" % (
+                    src.describe(), src.nbytes, dst.describe(), dst.nbytes,
+                    dst.nbytes // max(src.nbytes, 1)),
+                op, name, dst.nbytes))
+    return out
+
+
+def _rule_gl024(prog, tier):
+    """Quantize->dequantize round trips with only data movement between."""
+    out = []
+    seen_widen = set()
+    for op in prog.ops:
+        if op.short != "convert" or not op.operands or not op.result:
+            continue
+        src = prog.type_of(op.operands[0])
+        dst = op.result_types[0] if op.result_types else None
+        if src is None or dst is None:
+            continue
+        if itemsize(dst.dtype) >= itemsize(src.dtype):
+            continue          # only narrowing converts start a churn chain
+        frontier = [op.result]
+        visited = set(frontier)
+        while frontier:
+            v = frontier.pop()
+            for use in prog.uses.get(v, ()):
+                if use.short == "convert" and use.result_types:
+                    back = use.result_types[0]
+                    if (itemsize(back.dtype) >= itemsize(src.dtype)
+                            and use.result not in seen_widen):
+                        seen_widen.add(use.result)
+                        name = prog.op_name(use) or prog.op_name(op)
+                        out.append(_hit(
+                            "GL024", name or "%s->%s->%s" % (
+                                src.dtype, dst.dtype, back.dtype),
+                            "convert-churn: %s value quantized to %s is "
+                            "dequantized back to %s with no compute in "
+                            "between — keep the pre-quantization value "
+                            "live for the read instead of paying both "
+                            "converts" % (src.dtype, dst.dtype, back.dtype),
+                            use, name, back.nbytes))
+                elif use.short in _PASSTHROUGH and use.result \
+                        and use.result not in visited:
+                    visited.add(use.result)
+                    frontier.append(use.result)
+    return out
+
+
+def _rule_gl025(prog, tier):
+    """Duplicate or passthrough outputs."""
+    out = []
+    first = {}
+    for i, (val, rt) in enumerate(prog.results):
+        nbytes = rt.nbytes if rt else 0
+        if val in first:
+            out.append(_hit(
+                "GL025", "out%d" % i,
+                "output %d duplicates output %d (%s) — the caller "
+                "receives the same buffer twice" % (i, first[val], val),
+                None, "", nbytes))
+        else:
+            first[val] = i
+        if val in prog.argmap:
+            out.append(_hit(
+                "GL025", "out%d" % i,
+                "output %d returns input %s untouched — the caller "
+                "already holds this value" % (i, val),
+                None, "", nbytes))
+    return out
+
+
+_RULE_FNS = (_rule_gl020, _rule_gl021, _rule_gl022, _rule_gl023,
+             _rule_gl024, _rule_gl025)
+
+
+# ------------------------------------------------------------ lint + rank
+def lint_text(text, tier="jit", hint="", pkey="", cost=None):
+    """Lint one program's StableHLO text. ``cost`` is an optional ledger
+    row (dict with flops / bytes_accessed) used for ranking."""
+    prog = parse_program(text)
+    cost = cost or {}
+    cb = float(cost.get("bytes_accessed", 0.0) or 0.0)
+    cf = float(cost.get("flops", 0.0) or 0.0)
+    best = {}
+    for fn in _RULE_FNS:
+        for h in fn(prog, tier):
+            f = Finding(h["rule"], tier, hint, pkey, h["scope"], h["msg"],
+                        h["op"], h["op_name"], h["nbytes"], cb, cf)
+            prev = best.get((f.rule, f.scope))
+            if prev is None or f.nbytes > prev.nbytes:
+                best[(f.rule, f.scope)] = f
+    return rank(best.values())
+
+
+def rank(findings):
+    """Deterministic cost ranking: program bytes_accessed first, then the
+    finding's own byte count, then stable identity columns."""
+    return sorted(findings,
+                  key=lambda f: (-f.cost_bytes, -f.nbytes, f.tier, f.hint,
+                                 f.rule, f.scope, f.msg))
+
+
+# ---------------------------------------------------------------- capture
+def capture(tier, hint, key, lowered):
+    """Park one lowered program's text in the bounded corpus (called at
+    the costs seam). Prefers the debug-info asm — it carries the
+    ``loc("...")`` provenance table — and falls back to the plain lowered
+    text. Duck-typed: never imports jax."""
+    global _dropped, _errors
+    if not _enabled:
+        return
+    with _lock:
+        if (tier, key) in _corpus:
+            return
+    try:
+        try:
+            text = lowered.compiler_ir("stablehlo").operation.get_asm(
+                enable_debug_info=True)
+        except Exception:
+            text = lowered.as_text()
+    except Exception:
+        _errors += 1
+        return
+    with _lock:
+        if (tier, key) in _corpus:
+            return
+        if len(_corpus) >= _CAP:
+            _corpus.pop(next(iter(_corpus)))
+            _dropped += 1
+        _corpus[(tier, key)] = {"tier": tier, "hint": hint, "key": key,
+                                "text": text}
+
+
+def corpus():
+    """Captured programs as ``{(tier, key): entry}`` (shallow copy)."""
+    with _lock:
+        return dict(_corpus)
+
+
+def lint_corpus(profiles=None):
+    """Lint every captured program, joined against the cost ledger
+    (``costs.profiles()``-shaped: ``{"tier:key": rowdict}``)."""
+    profiles = profiles or {}
+    out = []
+    for (tier, key), entry in sorted(corpus().items()):
+        cost = profiles.get("%s:%s" % (tier, key))
+        out.extend(lint_text(entry["text"], tier=tier, hint=entry["hint"],
+                             pkey=key, cost=cost))
+    return rank(out)
+
+
+# --------------------------------------------------------------- allowlist
+def load_allowlist(path):
+    """``[{"id": finding-key, "why": non-empty}]`` -> {id: why}. Same
+    discipline as graphlint: an entry without a why is a hard error."""
+    if not os.path.exists(path):
+        return {}
+    with open(path) as fh:
+        entries = json.load(fh)
+    out = {}
+    for e in entries:
+        fid = e.get("id", "").strip()
+        why = e.get("why", "").strip()
+        if not fid:
+            raise ValueError("hlolint allowlist entry without an id: %r" % e)
+        if not why:
+            raise ValueError(
+                "hlolint allowlist entry %r lacks a why — every "
+                "suppression must be justified" % fid)
+        out[fid] = why
+    return out
+
+
+def split_allowed(findings, allow):
+    """(kept, suppressed, stale_ids): suppressed matched an allowlist
+    entry; stale entries matched nothing and must be pruned."""
+    kept, suppressed, hit = [], [], set()
+    for f in findings:
+        if f.key in allow:
+            suppressed.append(f)
+            hit.add(f.key)
+        else:
+            kept.append(f)
+    stale = sorted(set(allow) - hit)
+    return kept, suppressed, stale
+
+
+# ---------------------------------------------------------------- snapshot
+def snapshot_section(profiles=None, top=20):
+    """The ``snapshot()["hlolint"]`` section: bounded, JSON-able, ranked.
+    ``profiles`` is the cost ledger for ranking (the registry collector
+    passes ``costs.profiles()``; standalone callers may omit it)."""
+    findings = lint_corpus(profiles) if _enabled else []
+    counts = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    with _lock:
+        n, dropped, errors = len(_corpus), _dropped, _errors
+    return {"enabled": _enabled, "programs": n,
+            "findings": [f.as_dict() for f in findings[:top]],
+            "total_findings": len(findings), "counts": counts,
+            "dropped": dropped, "errors": errors}
+
+
+# ---------------------------------------------------------------- switches
+def enabled():
+    return _enabled
+
+
+def set_enabled(on=True):
+    """Runtime kill switch (also ``MXNET_HLOLINT=0`` at import). Programs
+    built while disabled are never retroactively captured."""
+    global _enabled
+    prev = _enabled
+    _enabled = bool(on)
+    return prev
+
+
+def reset():
+    """Test hook: drop the captured corpus."""
+    global _dropped, _errors
+    with _lock:
+        _corpus.clear()
+        _dropped = _errors = 0

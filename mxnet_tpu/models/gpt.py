@@ -99,15 +99,20 @@ class _CausalSelfAttention(HybridBlock):
     def step_cached_quant(self, F, x, k_cache, k_scale, v_cache, v_scale,
                           start):
         """:meth:`step_cached` against int8 KV pages: new K/V quantize on
-        write (``F.quant_cache_write`` keeps a running per-page-per-head
-        scale) and the full pages dequantize on read — XLA fuses the
-        int8→fp32 convert into the attention matmuls, so the cache lives in
-        HBM at half the bf16 bytes while shapes stay step-invariant.
-        Returns (out, k_cache', k_scale', v_cache', v_scale')."""
+        write and the fused write+read (``F.quant_cache_write_read``,
+        running per-page-per-head scale) hands attention the fp32 pages
+        directly from the pre-quantization values — no full-page
+        int8→fp32 convert per layer per step (the hlolint GL024 churn the
+        unfused quant_cache_write + dequant_cache pair pays). The cache
+        lives in HBM at half the bf16 bytes while shapes stay
+        step-invariant. Returns (out, k_cache', k_scale', v_cache',
+        v_scale')."""
         B, T, C = x.shape
         q, k_new, v_new = self._qkv_heads(F, x)
-        k_cache, k_scale = F.quant_cache_write(k_cache, k_scale, k_new, start)
-        v_cache, v_scale = F.quant_cache_write(v_cache, v_scale, v_new, start)
+        k_cache, k_scale, k_deq = F.quant_cache_write_read(
+            k_cache, k_scale, k_new, start)
+        v_cache, v_scale, v_deq = F.quant_cache_write_read(
+            v_cache, v_scale, v_new, start)
         cap = k_cache.shape[2]
         pos = F.reshape(F.arange(0, cap, dtype="int32"),
                         shape=(1, 1, 1, cap))
@@ -117,8 +122,7 @@ class _CausalSelfAttention(HybridBlock):
         else:  # (B,) per-slot positions
             limit = rows + F.reshape(start, shape=(-1, 1, 1, 1))
         mask = F.lesser_equal(pos, limit)
-        out = F.scaled_dot_attention(q, F.dequant_cache(k_cache, k_scale),
-                                     F.dequant_cache(v_cache, v_scale), mask)
+        out = F.scaled_dot_attention(q, k_deq, v_deq, mask)
         return (self.attn_out(self._merge_heads(F, out)),
                 k_cache, k_scale, v_cache, v_scale)
 

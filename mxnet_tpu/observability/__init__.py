@@ -231,9 +231,22 @@ def _collect_concurrency():
     return concurrency.runtime_stats()
 
 
+def _collect_hlolint():
+    # program-level StableHLO lint (analysis.hlolint, ISSUE 18): ranked
+    # findings over every program captured at the costs seam. Drains the
+    # lazy cost path first so the corpus is complete at scrape time, and
+    # joins the cost ledger so findings rank by real bytes
+    if costs.enabled():
+        costs.materialize()
+    from ..analysis import hlolint
+
+    return hlolint.snapshot_section(costs.profiles())
+
+
 registry.register_collector("engine", _collect_engine)
 registry.register_collector("concurrency", _collect_concurrency)
 registry.register_collector("costs", _collect_costs)
+registry.register_collector("hlolint", _collect_hlolint)
 registry.register_collector("dist", _collect_dist)
 registry.register_collector("quant", _collect_quant)
 registry.register_collector("caches", _collect_caches)

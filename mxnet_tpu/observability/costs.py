@@ -158,6 +158,18 @@ def _put(tier, key, hint, cols):
         return prof
 
 
+def _hlolint_capture(tier, hint, key, lowered):
+    """Hand the lowered program to the hlolint corpus (ISSUE 18): the
+    same seam that records costs also captures the StableHLO text for
+    program-level lint. Never raises into the record path; hlolint has
+    its own kill switch + bounded corpus."""
+    try:
+        from mxnet_tpu.analysis import hlolint
+        hlolint.capture(tier, hint, key, lowered)
+    except Exception:
+        pass
+
+
 def record_compiled(tier, hint, lowered, compiled):
     """EAGER record (cache.AotFn._acquire): the ``Compiled`` is already
     in hand, so profiling costs two XLA property reads and one hash."""
@@ -165,8 +177,9 @@ def record_compiled(tier, hint, lowered, compiled):
     if not _enabled:
         return None
     try:
-        return _put(tier, program_key(lowered.as_text()), hint,
-                    _analyze(compiled))
+        key = program_key(lowered.as_text())
+        _hlolint_capture(tier, hint, key, lowered)
+        return _put(tier, key, hint, _analyze(compiled))
     except Exception:
         _errors += 1
         return None
@@ -246,6 +259,7 @@ def materialize(limit=None):
         done += 1
         try:
             key = program_key(lowered.as_text())
+            _hlolint_capture(tier, hint, key, lowered)
             with _lock:
                 prof = _profiles.get((tier, key))
             if prof is not None:

@@ -295,6 +295,46 @@ def quant_cache_write(cache, scale, update, index):
     return out, new_scale
 
 
+@register_op("quant_cache_write_read", nondiff=True, n_outputs=3)
+def quant_cache_write_read(cache, scale, update, index):
+    """:func:`quant_cache_write` fused with the :func:`dequant_cache` read
+    of the page it just wrote, returning ``(new_cache, new_scale, deq)``
+    with ``deq`` (B, H, C, D) fp32 ready for attention.
+
+    The separate write-then-read pair is the hlolint GL024 convert churn:
+    the write quantizes the full page f32→int8 and the read immediately
+    converts the SAME page int8→f32 with nothing but the cache update in
+    between — two full-page converts per layer per step, which is what
+    caps int8 decode below units=256. Here the fp32 requant/quantize
+    values computed for the write are reused for the read, so the int8
+    round trip never happens. Bit-exact with the unfused pair: the
+    written values are integer-valued fp32 in [-127, 127], which int8
+    represents exactly, so ``deq == dequant_cache(new_cache, new_scale)``
+    to the last ulp."""
+    index = jnp.asarray(index, jnp.int32)
+    zero = jnp.int32(0)
+    update = update.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(update), axis=(2, 3), keepdims=True)
+    new_scale = jnp.maximum(scale, jnp.maximum(amax / 127.0, 1e-8))
+    ratio = scale / new_scale            # ≤ 1; 0 for never-written pages
+    requant = jnp.clip(jnp.round(cache.astype(jnp.float32) * ratio),
+                       -127, 127)
+    qupd = jnp.clip(jnp.round(update / new_scale), -127, 127)
+    if index.ndim == 0:
+        starts = (zero, zero, index, zero)
+        out = jax.lax.dynamic_update_slice(
+            requant.astype(jnp.int8), qupd.astype(jnp.int8), starts)
+        deq = jax.lax.dynamic_update_slice(requant, qupd, starts)
+    else:
+        def _dus(c, u, i):
+            return jax.lax.dynamic_update_slice(c, u, (zero, i, zero))
+
+        out = jax.vmap(_dus)(requant.astype(jnp.int8),
+                             qupd.astype(jnp.int8), index)
+        deq = jax.vmap(_dus)(requant, qupd, index)
+    return out, new_scale, deq * new_scale
+
+
 @register_op("dequant_cache", nondiff=True)
 def dequant_cache(cache, scale):
     """int8 KV pages → fp32 for attention: ``cache`` (B, H, C, D) int8 ×

@@ -34,6 +34,7 @@ iterators (``GenerationStream``).
 """
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
@@ -45,7 +46,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import _trace, engine, profiler
-from ..base import is_tpu_backend, next_pow2
+from ..base import next_pow2
 from .batcher import DynamicBatcher, ServeError, ServeTimeout
 from .kv_cache import CacheError, PagedKVCache, PrefixCache
 from .metrics import GenerativeMetrics
@@ -203,8 +204,13 @@ class GenerativeServer:
         Cache finished prefills keyed by the prompt's token hash; a repeat
         prompt injects the stored pages instead of re-running the forward.
     donate : bool or None
-        Donate cache/state buffers to the step programs (default: on for
-        TPU backends — the executor-pool donation discipline).
+        Donate cache/state buffers to the step programs (default: ON —
+        the executor-pool donation discipline; hlolint GL022 flags the
+        per-step KV page allocation the undonated programs would make).
+        Safe on every backend: ``cache.update()`` replaces the host
+        references after each call, so a donated-away buffer is never
+        re-read. ``MXNET_DECODE_DONATE=0`` force-disables (debugging
+        escape hatch: keeps step inputs alive for inspection).
     quantize : None or 'int8' / 'e4m3' / 'e5m2'
         Quantized serving: weight-quantize the model in place
         (``quantization.quantize_model`` — per-channel quantized matmuls
@@ -271,7 +277,14 @@ class GenerativeServer:
             quantize=self._quantize is not None)
         self.prefix = PrefixCache() if prefix_cache else None
         self.metrics = GenerativeMetrics(self.name)
-        self._donate = is_tpu_backend() if donate is None else bool(donate)
+        if donate is None:
+            # default ON everywhere (not just TPU): the step/prefill/
+            # inject programs overwrite their cache args wholesale, and
+            # cache.update() drops the stale references after every
+            # call, so aliasing input→output buffers is always safe and
+            # saves one KV-page allocation per dispatch (hlolint GL022)
+            donate = os.environ.get("MXNET_DECODE_DONATE", "1") != "0"
+        self._donate = bool(donate)
         # compiled-program caches: the pow2 bucketing bounds each at
         # log2(max) entries — the executor-pool discipline
         self._decode_fns = {}    # capacity -> jitted step

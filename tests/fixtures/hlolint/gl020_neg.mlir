@@ -1,0 +1,14 @@
+// Fixture: GL020 true negative — the dot_general runs in bf16; the only
+// f32 widening is of the RESULT on its way out (a reduction sink never
+// sees a widened operand).
+module @jit_step attributes {mhlo.num_replicas = 1 : i32} {
+  func.func public @main(%arg0: tensor<32x64xbf16> loc(unknown), %arg1: tensor<64x64xbf16> loc(unknown)) -> (tensor<32x64xf32> {jax.result_info = ""}) {
+    %0 = stablehlo.dot_general %arg0, %arg1, contracting_dims = [1] x [0], precision = [DEFAULT, DEFAULT] : (tensor<32x64xbf16>, tensor<64x64xbf16>) -> tensor<32x64xbf16> loc(#loc2)
+    %1 = stablehlo.convert %0 : (tensor<32x64xbf16>) -> tensor<32x64xf32> loc(#loc3)
+    return %1 : tensor<32x64xf32> loc(#loc)
+  } loc(#loc)
+} loc(#loc)
+#loc = loc(unknown)
+#loc1 = loc("decode.py":10:0)
+#loc2 = loc("jit(step)/jit(main)/attn0/dot_general"(#loc1))
+#loc3 = loc("jit(step)/jit(main)/attn0/convert_element_type"(#loc1))

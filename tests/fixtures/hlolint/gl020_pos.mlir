@@ -1,0 +1,15 @@
+// Fixture: GL020 true positive — bf16 inputs are widened to f32 and the
+// widened values feed a dot_general; the matmul should run in bf16 (or
+// accumulate via preferred_element_type) instead of paying f32 operands.
+module @jit_step attributes {mhlo.num_replicas = 1 : i32} {
+  func.func public @main(%arg0: tensor<32x64xbf16> loc(unknown), %arg1: tensor<64x64xbf16> loc(unknown)) -> (tensor<32x64xf32> {jax.result_info = ""}) {
+    %0 = stablehlo.convert %arg0 : (tensor<32x64xbf16>) -> tensor<32x64xf32> loc(#loc2)
+    %1 = stablehlo.convert %arg1 : (tensor<64x64xbf16>) -> tensor<64x64xf32> loc(#loc2)
+    %2 = stablehlo.dot_general %0, %1, contracting_dims = [1] x [0], precision = [DEFAULT, DEFAULT] : (tensor<32x64xf32>, tensor<64x64xf32>) -> tensor<32x64xf32> loc(#loc3)
+    return %2 : tensor<32x64xf32> loc(#loc)
+  } loc(#loc)
+} loc(#loc)
+#loc = loc(unknown)
+#loc1 = loc("decode.py":10:0)
+#loc2 = loc("jit(step)/jit(main)/attn0/convert_element_type"(#loc1))
+#loc3 = loc("jit(step)/jit(main)/attn0/dot_general"(#loc1))

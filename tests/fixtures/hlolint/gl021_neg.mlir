@@ -1,0 +1,14 @@
+// Fixture: GL021 true negative (lint as tier=decode) — device-only
+// compute; the only custom_call is a device-side kernel, not a host
+// transfer.
+module @jit_step attributes {mhlo.num_replicas = 1 : i32} {
+  func.func public @main(%arg0: tensor<4x8xf32> loc(unknown), %arg1: tensor<8x8xf32> loc(unknown)) -> (tensor<4x8xf32> {jax.result_info = ""}) {
+    %0 = stablehlo.custom_call @lu_pivots_to_permutation(%arg0) {api_version = 2 : i32} : (tensor<4x8xf32>) -> tensor<4x8xf32> loc(#loc2)
+    %1 = stablehlo.dot_general %0, %arg1, contracting_dims = [1] x [0], precision = [DEFAULT, DEFAULT] : (tensor<4x8xf32>, tensor<8x8xf32>) -> tensor<4x8xf32> loc(#loc3)
+    return %1 : tensor<4x8xf32> loc(#loc)
+  } loc(#loc)
+} loc(#loc)
+#loc = loc(unknown)
+#loc1 = loc("decode.py":22:0)
+#loc2 = loc("jit(step)/jit(main)/solver/custom_call"(#loc1))
+#loc3 = loc("jit(step)/jit(main)/proj/dot_general"(#loc1))

@@ -1,0 +1,14 @@
+// Fixture: GL021 true positive (lint as tier=decode) — a host python
+// callback custom_call inside a decode-tier program: every token step
+// pays a device<->host round trip.
+module @jit_step attributes {mhlo.num_replicas = 1 : i32} {
+  func.func public @main(%arg0: tensor<4x8xf32> loc(unknown)) -> (tensor<4x8xf32> {jax.result_info = ""}) {
+    %0 = stablehlo.custom_call @xla_python_cpu_callback(%arg0) {api_version = 2 : i32, has_side_effect = true} : (tensor<4x8xf32>) -> tensor<4x8xf32> loc(#loc2)
+    %1 = stablehlo.add %0, %arg0 : tensor<4x8xf32> loc(#loc3)
+    return %1 : tensor<4x8xf32> loc(#loc)
+  } loc(#loc)
+} loc(#loc)
+#loc = loc(unknown)
+#loc1 = loc("decode.py":22:0)
+#loc2 = loc("jit(step)/jit(main)/sampler/pure_callback"(#loc1))
+#loc3 = loc("jit(step)/jit(main)/sampler/add"(#loc1))

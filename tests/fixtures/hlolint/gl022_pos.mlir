@@ -1,0 +1,13 @@
+// Fixture: GL022 true positive — the updated 16 KiB cache output has a
+// same-shape same-dtype input (%arg0, read by the update) with no
+// tf.aliasing_output: donating it would alias instead of allocating.
+module @jit_step attributes {mhlo.num_replicas = 1 : i32} {
+  func.func public @main(%arg0: tensor<64x64xf32> loc(unknown), %arg1: tensor<1x64xf32> loc(unknown), %arg2: tensor<i32> loc(unknown)) -> (tensor<64x64xf32> {jax.result_info = ""}) {
+    %c = stablehlo.constant dense<0> : tensor<i32> loc(#loc)
+    %0 = stablehlo.dynamic_update_slice %arg0, %arg1, %arg2, %c : (tensor<64x64xf32>, tensor<1x64xf32>, tensor<i32>, tensor<i32>) -> tensor<64x64xf32> loc(#loc2)
+    return %0 : tensor<64x64xf32> loc(#loc)
+  } loc(#loc)
+} loc(#loc)
+#loc = loc(unknown)
+#loc1 = loc("decode.py":31:0)
+#loc2 = loc("jit(step)/jit(main)/cache/dynamic_update_slice"(#loc1))

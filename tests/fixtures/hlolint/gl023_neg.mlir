@@ -1,0 +1,14 @@
+// Fixture: GL023 true negative — broadcasting a 256-byte bias row is
+// free (below BCAST_MIN_IN): expanding tiny operands is how every bias
+// add lowers, not a bytes sink.
+module @jit_f attributes {mhlo.num_replicas = 1 : i32} {
+  func.func public @main(%arg0: tensor<1x64xf32> loc(unknown), %arg1: tensor<16x64x64xf32> {tf.aliasing_output = 0 : i32} loc(unknown)) -> (tensor<16x64x64xf32> {jax.result_info = ""}) {
+    %0 = stablehlo.broadcast_in_dim %arg0, dims = [1, 2] : (tensor<1x64xf32>) -> tensor<16x64x64xf32> loc(#loc2)
+    %1 = stablehlo.add %0, %arg1 : tensor<16x64x64xf32> loc(#loc3)
+    return %1 : tensor<16x64x64xf32> loc(#loc)
+  } loc(#loc)
+} loc(#loc)
+#loc = loc(unknown)
+#loc1 = loc("model.py":44:0)
+#loc2 = loc("jit(f)/jit(main)/bias/broadcast_in_dim"(#loc1))
+#loc3 = loc("jit(f)/jit(main)/bias/add"(#loc1))

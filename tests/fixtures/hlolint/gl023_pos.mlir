@@ -1,0 +1,14 @@
+// Fixture: GL023 true positive — a 4 KiB operand is broadcast to a
+// materialized 256 KiB copy (64x expansion) before the add; the
+// consumer should broadcast lazily instead.
+module @jit_f attributes {mhlo.num_replicas = 1 : i32} {
+  func.func public @main(%arg0: tensor<16x64xf32> loc(unknown), %arg1: tensor<16x64x64xf32> {tf.aliasing_output = 0 : i32} loc(unknown)) -> (tensor<16x64x64xf32> {jax.result_info = ""}) {
+    %0 = stablehlo.broadcast_in_dim %arg0, dims = [0, 1] : (tensor<16x64xf32>) -> tensor<16x64x64xf32> loc(#loc2)
+    %1 = stablehlo.add %0, %arg1 : tensor<16x64x64xf32> loc(#loc3)
+    return %1 : tensor<16x64x64xf32> loc(#loc)
+  } loc(#loc)
+} loc(#loc)
+#loc = loc(unknown)
+#loc1 = loc("model.py":44:0)
+#loc2 = loc("jit(f)/jit(main)/bias/broadcast_in_dim"(#loc1))
+#loc3 = loc("jit(f)/jit(main)/bias/add"(#loc1))

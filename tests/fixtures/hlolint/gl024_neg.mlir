@@ -1,0 +1,16 @@
+// Fixture: GL024 true negative — the quantized value is COMPUTED ON
+// (an int8 dot_general) before anything widens; the narrow round trip
+// bought real int8 compute, not churn.
+module @jit_step attributes {mhlo.num_replicas = 1 : i32} {
+  func.func public @main(%arg0: tensor<1x32xf32> loc(unknown), %arg1: tensor<32x32xi8> loc(unknown)) -> (tensor<1x32xf32> {jax.result_info = ""}) {
+    %0 = stablehlo.convert %arg0 : (tensor<1x32xf32>) -> tensor<1x32xi8> loc(#loc2)
+    %1 = stablehlo.dot_general %0, %arg1, contracting_dims = [1] x [0], precision = [DEFAULT, DEFAULT] : (tensor<1x32xi8>, tensor<32x32xi8>) -> tensor<1x32xi32> loc(#loc3)
+    %2 = stablehlo.convert %1 : (tensor<1x32xi32>) -> tensor<1x32xf32> loc(#loc4)
+    return %2 : tensor<1x32xf32> loc(#loc)
+  } loc(#loc)
+} loc(#loc)
+#loc = loc(unknown)
+#loc1 = loc("quant.py":17:0)
+#loc2 = loc("jit(step)/jit(main)/qmatmul/convert_element_type"(#loc1))
+#loc3 = loc("jit(step)/jit(main)/qmatmul/dot_general"(#loc1))
+#loc4 = loc("jit(step)/jit(main)/qmatmul/convert_element_type"(#loc1))

@@ -1,0 +1,17 @@
+// Fixture: GL024 true positive — values quantized f32->i8 flow through
+// the cache write (dynamic_update_slice, pure data movement) and are
+// immediately dequantized i8->f32: both converts are wasted.
+module @jit_step attributes {mhlo.num_replicas = 1 : i32} {
+  func.func public @main(%arg0: tensor<8x32xi8> loc(unknown), %arg1: tensor<1x32xf32> loc(unknown), %arg2: tensor<i32> loc(unknown)) -> (tensor<8x32xi8> {jax.result_info = "cache"}, tensor<8x32xf32> {jax.result_info = "deq"}) {
+    %c = stablehlo.constant dense<0> : tensor<i32> loc(#loc)
+    %0 = stablehlo.convert %arg1 : (tensor<1x32xf32>) -> tensor<1x32xi8> loc(#loc2)
+    %1 = stablehlo.dynamic_update_slice %arg0, %0, %arg2, %c : (tensor<8x32xi8>, tensor<1x32xi8>, tensor<i32>, tensor<i32>) -> tensor<8x32xi8> loc(#loc3)
+    %2 = stablehlo.convert %1 : (tensor<8x32xi8>) -> tensor<8x32xf32> loc(#loc4)
+    return %1, %2 : tensor<8x32xi8>, tensor<8x32xf32> loc(#loc)
+  } loc(#loc)
+} loc(#loc)
+#loc = loc(unknown)
+#loc1 = loc("quant.py":17:0)
+#loc2 = loc("jit(step)/jit(main)/quant_cache_write/convert_element_type"(#loc1))
+#loc3 = loc("jit(step)/jit(main)/quant_cache_write/dynamic_update_slice"(#loc1))
+#loc4 = loc("jit(step)/jit(main)/dequant_cache/convert_element_type"(#loc1))

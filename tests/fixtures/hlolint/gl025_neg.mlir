@@ -1,0 +1,12 @@
+// Fixture: GL025 true negative — two distinct computed outputs.
+module @jit_f attributes {mhlo.num_replicas = 1 : i32} {
+  func.func public @main(%arg0: tensor<4x8xf32> loc(unknown), %arg1: tensor<4x8xf32> loc(unknown)) -> (tensor<4x8xf32> {jax.result_info = "[0]"}, tensor<4x8xf32> {jax.result_info = "[1]"}) {
+    %0 = stablehlo.add %arg0, %arg1 : tensor<4x8xf32> loc(#loc2)
+    %1 = stablehlo.multiply %arg0, %arg1 : tensor<4x8xf32> loc(#loc3)
+    return %0, %1 : tensor<4x8xf32>, tensor<4x8xf32> loc(#loc)
+  } loc(#loc)
+} loc(#loc)
+#loc = loc(unknown)
+#loc1 = loc("model.py":9:0)
+#loc2 = loc("jit(f)/jit(main)/add"(#loc1))
+#loc3 = loc("jit(f)/jit(main)/mul"(#loc1))

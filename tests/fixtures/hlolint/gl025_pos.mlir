@@ -1,0 +1,12 @@
+// Fixture: GL025 true positive — output 1 duplicates output 0, and
+// output 2 returns an input untouched; the caller pays transfer and
+// bookkeeping for buffers it already holds.
+module @jit_f attributes {mhlo.num_replicas = 1 : i32} {
+  func.func public @main(%arg0: tensor<4x8xf32> loc(unknown), %arg1: tensor<4x8xf32> loc(unknown)) -> (tensor<4x8xf32> {jax.result_info = "[0]"}, tensor<4x8xf32> {jax.result_info = "[1]"}, tensor<4x8xf32> {jax.result_info = "[2]"}) {
+    %0 = stablehlo.add %arg0, %arg1 : tensor<4x8xf32> loc(#loc2)
+    return %0, %0, %arg1 : tensor<4x8xf32>, tensor<4x8xf32>, tensor<4x8xf32> loc(#loc)
+  } loc(#loc)
+} loc(#loc)
+#loc = loc(unknown)
+#loc1 = loc("model.py":9:0)
+#loc2 = loc("jit(f)/jit(main)/add"(#loc1))

@@ -352,6 +352,9 @@ def test_specdecode_artifact_pins():
     # tests/test_costs.py::test_cost_gate_replay_matches_committed_artifact
     ("cost_report_quick.json", ["tier", "programs", "flops",
                                 "bytes_accessed", "peak_hbm_bytes"]),
+    # per-scenario lint gate rows: replayed + asserted clean by
+    # tests/test_hlolint.py::test_pinned_scenarios_lint_ci_clean
+    ("hlolint_quick.json", ["tier", "programs", "findings", "suppressed"]),
     # speedup/accept/ITL-improvement bars + the 1-dispatch-per-round
     # contract are pinned above in
     # test_specdecode_counters_and_artifact_pins

@@ -265,6 +265,73 @@ def test_aval_and_program_caches_are_bounded():
         assert cache.cap > 0  # env-tunable (MXNET_*_CACHE_CAP / _CAP)
 
 
+# ----------------------------------------------- per-file findings cache
+
+
+_AB = ("import threading\n"
+       "import cacheb\n"
+       "_a_lock = threading.Lock()\n"
+       "def f():\n"
+       "    with _a_lock:\n"
+       "        with cacheb._b_lock:\n"
+       "            pass\n")
+_BA = ("import threading\n"
+       "import cachea\n"
+       "_b_lock = threading.Lock()\n"
+       "def g():\n"
+       "    with _b_lock:\n"
+       "        with cachea._a_lock:\n"
+       "            pass\n")
+
+
+def test_file_cache_replays_identical_findings(tmp_path):
+    """Second lint of unchanged files serves from the (path, sha256)
+    cache and yields byte-identical findings."""
+    p = tmp_path / "gl001ish.py"
+    p.write_text("class B:\n"
+                 "    def hybrid_forward(self, F, x):\n"
+                 "        return float(F.sum(x))\n")
+    gl.file_cache.clear()
+    first = gl.lint_paths([str(p)])
+    h0 = gl.file_cache.hits
+    second = gl.lint_paths([str(p)])
+    assert gl.file_cache.hits == h0 + 1
+    assert [f.render() for f in first] == [f.render() for f in second]
+    assert any(f.rule == "GL001" for f in second)
+    # content change under the same path misses (hash key, not mtime)
+    p.write_text("x = 1\n")
+    assert gl.lint_paths([str(p)]) == []
+
+
+def test_file_cache_replays_lock_graph_edges(tmp_path):
+    """The cross-module GL015 AB/BA cycle spans two files; a fully
+    cache-served run must still assemble the shared lock graph from the
+    stored per-file edge sets and fire the cycle check."""
+    (tmp_path / "cachea.py").write_text(_AB)
+    (tmp_path / "cacheb.py").write_text(_BA)
+    gl.file_cache.clear()
+    prev = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        first = gl.lint_paths(["cachea.py", "cacheb.py"])
+        assert gl.file_cache.misses >= 2
+        second = gl.lint_paths(["cachea.py", "cacheb.py"])
+    finally:
+        os.chdir(prev)
+    assert any(f.rule == "GL015" for f in first)
+    assert [f.render() for f in first] == [f.render() for f in second]
+
+
+def test_file_cache_is_bounded():
+    gl.file_cache.clear()
+    cap = gl.file_cache.cap
+    for i in range(cap + 5):
+        gl.file_cache.put(("f%d.py" % i, "h"), (), {})
+    assert len(gl.file_cache._store) == cap
+    assert ("f0.py", "h") not in gl.file_cache._store
+    gl.file_cache.clear()
+
+
 def test_sig_intern_cap_falls_back_to_eager(monkeypatch):
     """At the intern cap, NEW signatures bail to eager dispatch — results
     stay correct and the table stops growing (graphlint GL006). The

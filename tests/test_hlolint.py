@@ -1,0 +1,277 @@
+"""hlolint: fixture-proven StableHLO rules, the capture seam, ranking,
+the allowlist round trip, and the pinned-scenario CI gate.
+
+Every GL02x rule has one firing positive and one silent negative fixture
+under tests/fixtures/hlolint/ (hand-written in jax's pretty StableHLO
+form). The gate test replays the same four pinned builders the cost
+ledger pins and asserts the corpus lints clean against the committed
+allowlist — the program-level analogue of graphlint's repo self-lint.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from mxnet_tpu.analysis import hlolint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXDIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "fixtures", "hlolint")
+ALLOWLIST = os.path.join(REPO, "tools", "hlolint_allow.json")
+RULES = sorted(hlolint.RULES)  # GL020..GL025
+
+
+def _fixture(rule):
+    path = os.path.join(FIXDIR, "%s_pos.mlir" % rule.lower())
+    with open(path) as fh:
+        pos = fh.read()
+    with open(os.path.join(FIXDIR, "%s_neg.mlir" % rule.lower())) as fh:
+        neg = fh.read()
+    return pos, neg
+
+
+def _subprocess(argv, **env_extra):
+    """Fresh-interpreter run (test_costs.py discipline): close_fds=False
+    keeps posix_spawn, the parent's JAX_COMPILATION_CACHE_DIR is
+    stripped, and a signal-death gets ONE retry — a wrong result never
+    does."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **env_extra)
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    for _ in range(2):
+        r = subprocess.run([sys.executable] + argv, cwd=REPO, env=env,
+                           capture_output=True, text=True, timeout=300,
+                           close_fds=False)
+        if r.returncode >= 0:
+            return r
+    return r
+
+
+# ------------------------------------------------------------ rule fixtures
+
+
+@pytest.mark.parametrize("rule", RULES)
+def test_rule_true_positive(rule):
+    pos, _ = _fixture(rule)
+    got = {f.rule for f in hlolint.lint_text(pos, tier="decode",
+                                             hint="fixture")}
+    assert rule in got, "%s did not fire on its positive fixture" % rule
+
+
+@pytest.mark.parametrize("rule", RULES)
+def test_rule_true_negative(rule):
+    _, neg = _fixture(rule)
+    fs = [f for f in hlolint.lint_text(neg, tier="decode", hint="fixture")
+          if f.rule == rule]
+    assert fs == [], "false positives: %s" % [f.render() for f in fs]
+
+
+def test_hot_tier_rules_disarm_outside_hot_tiers():
+    """GL021 is a hot-tier rule: the same host callback in a jit-tier
+    program (a training step with a debug callback) is not a finding."""
+    pos, _ = _fixture("GL021")
+    assert [f for f in hlolint.lint_text(pos, tier="jit")
+            if f.rule == "GL021"] == []
+    assert any(f.rule == "GL021"
+               for f in hlolint.lint_text(pos, tier="serve"))
+
+
+def test_findings_carry_provenance_and_bytes():
+    """Findings surface the named_scope op provenance from the loc table
+    and a rule-specific byte count — the columns the snapshot ranks on."""
+    pos, _ = _fixture("GL020")
+    (f,) = [x for x in hlolint.lint_text(pos, tier="decode")
+            if x.rule == "GL020"]
+    assert f.op_name == "attn0/dot_general"
+    assert f.nbytes == 64 * 64 * 4   # the largest widened operand
+    assert "bf16" in f.msg
+
+
+# ------------------------------------------------------- ranking + identity
+
+
+def test_rank_is_deterministic_and_cost_first():
+    pos20, _ = _fixture("GL020")
+    pos23, _ = _fixture("GL023")
+    cheap = hlolint.lint_text(pos20, tier="decode", hint="a",
+                              cost={"bytes_accessed": 1e3})
+    dear = hlolint.lint_text(pos23, tier="decode", hint="b",
+                             cost={"bytes_accessed": 1e9})
+    merged = hlolint.rank(cheap + dear)
+    assert merged[0].hint == "b"          # costliest program first
+    assert merged == hlolint.rank(list(reversed(merged)))
+
+
+def test_finding_key_is_program_key_free():
+    """The allowlist key omits the program content hash, so an entry
+    survives program edits that keep tier/hint/scope."""
+    pos, _ = _fixture("GL022")
+    (f,) = [x for x in hlolint.lint_text(pos, tier="decode", hint="step@c32",
+                                         pkey="deadbeefdeadbeef")
+            if x.rule == "GL022"]
+    assert f.key == "decode:step@c32::GL022::out0"
+    assert "deadbeef" not in f.key
+
+
+# ----------------------------------------------------- allowlist round trip
+
+
+def test_allowlist_round_trip(tmp_path):
+    pos, _ = _fixture("GL022")
+    findings = [f for f in hlolint.lint_text(pos, tier="decode",
+                                             hint="step@c32")
+                if f.rule == "GL022"]
+    path = tmp_path / "allow.json"
+    path.write_text(json.dumps(
+        [{"id": findings[0].key, "why": "extract reads live pages"},
+         {"id": "decode:gone::GL022::out9", "why": "stale on purpose"}]))
+    allow = hlolint.load_allowlist(str(path))
+    kept, suppressed, stale = hlolint.split_allowed(findings, allow)
+    assert kept == [] and len(suppressed) == 1
+    assert stale == ["decode:gone::GL022::out9"]
+
+
+def test_allowlist_requires_why(tmp_path):
+    path = tmp_path / "allow.json"
+    path.write_text(json.dumps([{"id": "decode:x::GL022::out0", "why": ""}]))
+    with pytest.raises(ValueError, match="why"):
+        hlolint.load_allowlist(str(path))
+
+
+# ------------------------------------------------- capture seam (no jax)
+
+
+class _FakeLowered:
+    """Duck-typed stand-in for jax.stages.Lowered — capture() must never
+    import jax itself."""
+
+    def __init__(self, text):
+        self._text = text
+
+    def compiler_ir(self, dialect):
+        raise RuntimeError("no mlir here")   # forces the as_text fallback
+
+    def as_text(self):
+        return self._text
+
+
+def test_capture_corpus_and_kill_switch():
+    pos, _ = _fixture("GL025")
+    prev = hlolint.set_enabled(True)
+    try:
+        hlolint.reset()
+        hlolint.capture("decode", "step@c32", "k1", _FakeLowered(pos))
+        hlolint.capture("decode", "step@c32", "k1", _FakeLowered(pos))  # dedup
+        assert list(hlolint.corpus()) == [("decode", "k1")]
+        findings = hlolint.lint_corpus()
+        assert any(f.rule == "GL025" for f in findings)
+        sec = hlolint.snapshot_section()
+        assert sec["programs"] == 1 and sec["counts"]["GL025"] >= 1
+        assert sec["findings"][0]["key"].startswith("decode:step@c32::")
+        hlolint.set_enabled(False)
+        hlolint.capture("decode", "step@c32", "k2", _FakeLowered(pos))
+        assert ("decode", "k2") not in hlolint.corpus()
+        assert hlolint.snapshot_section()["findings"] == []
+    finally:
+        hlolint.set_enabled(prev)
+        hlolint.reset()
+
+
+def test_capture_is_bounded():
+    pos, _ = _fixture("GL025")
+    prev = hlolint.set_enabled(True)
+    try:
+        hlolint.reset()
+        for i in range(hlolint._CAP + 3):
+            hlolint.capture("jit", "h%d" % i, "k%d" % i, _FakeLowered(pos))
+        assert len(hlolint.corpus()) == hlolint._CAP
+        assert hlolint.snapshot_section()["dropped"] == 3
+    finally:
+        hlolint.set_enabled(prev)
+        hlolint.reset()
+
+
+def test_capture_swallows_broken_handles():
+    class _Broken:
+        def compiler_ir(self, dialect):
+            raise RuntimeError("boom")
+
+        def as_text(self):
+            raise RuntimeError("boom")
+
+    prev = hlolint.set_enabled(True)
+    try:
+        hlolint.reset()
+        hlolint.capture("jit", "h", "k", _Broken())
+        assert hlolint.corpus() == {}
+        assert hlolint.snapshot_section()["errors"] == 1
+    finally:
+        hlolint.set_enabled(prev)
+        hlolint.reset()
+
+
+# -------------------------------------------------------- the CI gate
+
+
+def test_pinned_scenarios_lint_ci_clean():
+    """tools/hlolint.py --ci, in process: the four pinned cost-report
+    builders' programs lint clean against the committed allowlist, with
+    no stale entries — the tier-1 perf-hygiene gate. Serving programs
+    donate their KV pages and the int8 decode step uses the fused
+    quant_cache_write_read, so GL022/GL024 stay silent at HEAD."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "hlolint_cli", os.path.join(REPO, "tools", "hlolint.py"))
+        cli = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(cli)
+        kept, suppressed, stale, rows = cli.run_ci()
+    finally:
+        sys.path.remove(os.path.join(REPO, "tools"))
+    assert kept == [], "non-allowlisted findings:\n%s" % "\n".join(
+        f.render() for f in kept)
+    assert stale == [], "stale allowlist entries: %s" % stale
+    by_case = {r["case"]: r for r in rows}
+    assert by_case["gpt_nano_decode"]["programs"] >= 5
+    assert sum(r["programs"] for r in rows) >= 10
+
+
+def test_seeded_bad_program_is_caught_in_fresh_process():
+    """Determinism end to end: a fresh interpreter builds a bf16 program
+    through the real base.jitted funnel with a forced f32 upcast feeding
+    the matmul; the capture seam parks it and hlolint flags GL020."""
+    code = (
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "from mxnet_tpu import base\n"
+        "from mxnet_tpu.observability import costs\n"
+        "from mxnet_tpu.analysis import hlolint\n"
+        "def bad_step(x, w):\n"
+        "    return jnp.dot(x.astype(jnp.float32), w.astype(jnp.float32))\n"
+        "f = base.jitted(bad_step, {})\n"
+        "x = jnp.asarray(np.ones((32, 64), np.float32), jnp.bfloat16)\n"
+        "w = jnp.asarray(np.ones((64, 64), np.float32), jnp.bfloat16)\n"
+        "f(x, w).block_until_ready()\n"
+        "costs.materialize()\n"
+        "fs = hlolint.lint_corpus(costs.profiles())\n"
+        "hits = [f for f in fs if f.rule == 'GL020']\n"
+        "assert hits, 'seeded f32 upcast not caught: %r' % fs\n"
+        "assert hits[0].cost_bytes > 0, 'ledger join missing'\n"
+        "print('CAUGHT=%s' % hits[0].key)\n")
+    r = _subprocess(["-c", code])
+    assert r.returncode == 0, r.stderr
+    caught = [l for l in r.stdout.splitlines() if l.startswith("CAUGHT=")]
+    assert caught and "GL020" in caught[0]
+
+
+@pytest.mark.slow  # same gate through the CLI in a fresh interpreter
+def test_cli_ci_mode_exits_zero(tmp_path):
+    out = tmp_path / "quick.json"
+    r = _subprocess([os.path.join(REPO, "tools", "hlolint.py"), "--ci",
+                     "--json", str(out)])
+    assert r.returncode == 0, r.stdout + r.stderr
+    rows = json.loads(out.read_text())["rows"]
+    assert {r_["case"] for r_ in rows} == {
+        "optstep", "chain50_tape", "serve_mlp64", "gpt_nano_decode"}

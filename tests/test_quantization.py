@@ -196,3 +196,26 @@ def test_quantize_model_grouped_conv_block():
     assert out.shape == ref.shape
     denom = np.abs(ref).max() + 1e-6
     assert np.abs(out - ref).max() / denom < 0.1
+
+
+def test_fused_quant_cache_write_read_is_bit_exact():
+    """quant_cache_write_read == quant_cache_write + dequant_cache to the
+    last bit (scalar AND per-row vector index): the fused op reuses the
+    fp32 requant values for the read, and integer-valued fp32 in
+    [-127, 127] round-trips int8 exactly. This pins the GL024 fix — the
+    fused read must never drift from the unfused pair it replaced."""
+    from mxnet_tpu.ops import attention as att
+
+    rng = np.random.RandomState(7)
+    for index in (0, 3, np.array([1, 5, 0, 3], np.int32)):
+        cache = rng.randint(-127, 128, (4, 2, 8, 16)).astype(np.int8)
+        scale = np.abs(rng.randn(4, 2, 1, 1)).astype(np.float32) + 0.01
+        update = (rng.randn(4, 2, 1, 16) * 3).astype(np.float32)
+        c1, s1 = att.quant_cache_write(cache, scale, update, index)
+        deq_ref = att.dequant_cache(c1, s1)
+        c2, s2, deq = att.quant_cache_write_read(cache, scale, update,
+                                                 index)
+        np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
+        np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+        np.testing.assert_array_equal(np.asarray(deq_ref),
+                                      np.asarray(deq))

@@ -394,3 +394,26 @@ print(json.dumps({"decode_compiles": engine.decode_compile_counter.count,
         % rec["decode_compiles"]
     assert rec["spec_k"] == 4 and rec["chunk"] == 8
     assert rec["tokens"] == ref
+
+
+# ------------------------------------------------------- donation default
+def test_decode_donation_defaults_on(model, monkeypatch):
+    """Cache/state buffers donate to the decode programs by DEFAULT on
+    every backend, not just TPU (the hlolint GL022 fix): cache.update()
+    replaces the host references after each dispatch, so aliasing is
+    always safe, and the pinned cost artifact's decode bytes/peak-HBM
+    columns (tools/cost_report_quick.json) assume it. The program-level
+    pin is tests/test_hlolint.py's CI gate — GL022 stays silent only
+    while the step/prefill/inject programs actually donate.
+    MXNET_DECODE_DONATE=0 is the debugging escape hatch."""
+    srv = mx.serve.GenerativeServer(model, slots=2, prefix_cache=False)
+    assert srv._donate is True
+    srv.stop()
+    monkeypatch.setenv("MXNET_DECODE_DONATE", "0")
+    off = mx.serve.GenerativeServer(model, slots=2, prefix_cache=False)
+    assert off._donate is False
+    off.stop()
+    explicit = mx.serve.GenerativeServer(model, slots=2,
+                                         prefix_cache=False, donate=True)
+    assert explicit._donate is True      # explicit arg beats the env knob
+    explicit.stop()

@@ -322,6 +322,28 @@ def main():
     except Exception as e:
         print("graphlint unavailable:", e)
 
+    print("----------HLO Lint----------")
+    # program-level static pass over the lowered StableHLO corpus
+    # (analysis.hlolint, captured at the costs seam); the pinned-scenario
+    # gate is tools/hlolint.py --ci, also run by the tier-1 suite
+    hs = snap.get("hlolint", {})
+    if hs:
+        print("capture      : %s (MXNET_HLOLINT), %d program(s), "
+              "%d dropped, %d error(s)"
+              % ("on" if hs.get("enabled") else "off", hs.get("programs", 0),
+                 hs.get("dropped", 0), hs.get("errors", 0)))
+        print("findings     : %d (%s)" % (
+            hs.get("total_findings", 0),
+            ", ".join("%s=%d" % kv
+                      for kv in sorted(hs.get("counts", {}).items()))
+            or "clean"))
+        for f in hs.get("findings", [])[:3]:
+            print("  %s [%s] %s (%s B)" % (f["key"], f["rule"],
+                                           (f["op_name"] or f["op"])[:40],
+                                           _fmt(f["nbytes"])))
+    else:
+        print("hlolint section unavailable")
+
     print("----------Concurrency----------")
     # racecheck runtime stage (analysis.concurrency): armed via
     # MXNET_LOCK_CHECK=1 + instrument_locks(); the lock-order graph and

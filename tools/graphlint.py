@@ -9,9 +9,10 @@ path:line:rule), so diffs against the committed allowlist are stable.
 
 ``--ci`` loads the allowlist (default ``tools/graphlint_allow.json``),
 prints only NON-allowlisted findings, and exits 1 if any exist (0 when
-clean). Stale allowlist entries (matching no current finding) are reported
-as warnings so the list can only shrink, never rot. The tier-1 suite runs
-this mode over ``mxnet_tpu/`` itself (tests/test_graphlint.py).
+clean). Stale allowlist entries (matching no current finding) also FAIL
+``--ci`` — a suppression that no longer fires must be pruned, so the list
+can only shrink, never rot. The tier-1 suite runs this mode over
+``mxnet_tpu/`` itself (tests/test_graphlint.py).
 
 Rule reference: ``python tools/graphlint.py --rules`` or
 ``mxnet_tpu/analysis/graphlint.py`` docstring.
@@ -85,9 +86,10 @@ def main(argv=None):
         if summary else "",
         ", %d allowlisted" % len(suppressed) if args.ci else ""))
     for sid in stale:
-        print("graphlint: WARNING stale allowlist entry (no longer fires): %s"
-              % sid)
-    return 1 if (args.ci and findings) else 0
+        print("graphlint: ERROR stale allowlist entry (no longer fires): %s"
+              " — prune it from %s" % (sid, os.path.relpath(args.allowlist,
+                                                            _REPO)))
+    return 1 if (args.ci and (findings or stale)) else 0
 
 
 if __name__ == "__main__":

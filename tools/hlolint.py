@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""hlolint CLI — program-level StableHLO lint over the pinned programs.
+
+Usage:
+    python tools/hlolint.py FILE.mlir [...]         # lint text files
+    python tools/hlolint.py --ci [--json OUT]       # the CI gate
+    python tools/hlolint.py --rules
+
+``--ci`` replays the four pinned cost-report scenarios (the same
+builders ``tools/cost_report.py --quick`` and the counter baseline use:
+the 160-tensor fused optimizer step, the chain50 compiled tape, the
+mlp64 serve buckets, the gpt_nano decode step), captures every program
+the funnel builds at the costs seam, lints the corpus with the cost
+ledger joined for ranking, and applies ``tools/hlolint_allow.json``
+(per-entry ``why`` required — graphlint's discipline). Exit 1 on any
+non-allowlisted finding OR any stale allowlist entry; the findings
+print ranked by program bytes, costliest first.
+
+``--json`` writes per-scenario rows ({case, tier, programs, findings,
+suppressed}) — committed as ``tools/hlolint_quick.json`` so the
+artifact-sanity gate (tests/test_counter_baseline.py) notices if the
+gate's columns ever disappear.
+
+File mode parses raw StableHLO/MLIR text (e.g. a dumped
+``lowered.as_text()``) without importing jax: pass ``--tier`` to lint it
+as a hot-tier program.
+"""
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+DEFAULT_ALLOWLIST = os.path.join(_REPO, "tools", "hlolint_allow.json")
+ARTIFACT = os.path.join(_REPO, "tools", "hlolint_quick.json")
+
+
+def _load_standalone():
+    """hlolint is stdlib-only: file mode loads it directly so the CLI
+    works (and stays fast) even where jax is absent/broken."""
+    spec = importlib.util.spec_from_file_location(
+        "hlolint_core", os.path.join(_REPO, "mxnet_tpu", "analysis",
+                                     "hlolint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_REPO, "tools", "%s.py" % name))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def run_ci_scenarios():
+    """Replay the pinned scenarios in-process, returning (hlolint module,
+    per-case corpus attribution). Importing the package here is the
+    point: the corpus fills through the live costs seam."""
+    from mxnet_tpu.analysis import hlolint
+    from mxnet_tpu.observability import costs
+
+    cr = _tool("cost_report")
+    cases = []
+    # drain programs a warm process already has pending at the costs
+    # seam — otherwise the first scenario's materialize() flushes them
+    # into its own delta and the gate lints someone else's programs
+    costs.materialize()
+    before = set(hlolint.corpus())
+    for fn in (cr.scenario_optstep, cr.scenario_chain50_tape,
+               cr.scenario_serve_mlp64, cr.scenario_gpt_nano_decode):
+        row = fn()
+        costs.materialize()
+        now = set(hlolint.corpus())
+        cases.append({"case": row["case"], "tier": row["tier"],
+                      "keys": sorted(now - before)})
+        before = now
+    return hlolint, costs, cases
+
+
+def run_ci(allowlist_path=DEFAULT_ALLOWLIST):
+    """The gate body, importable by tests: replay, lint, split. Returns
+    (kept, suppressed, stale, rows)."""
+    hlolint, costs, cases = run_ci_scenarios()
+    # the gate is defined over the replayed scenarios: when run_ci() is
+    # imported into an already-warm process (the test suite), the live
+    # corpus may hold programs other code captured — those belong to
+    # their own gates, not this one
+    scenario_keys = {tuple(k) for c in cases for k in c["keys"]}
+    findings = [f for f in hlolint.lint_corpus(costs.profiles())
+                if (f.tier, f.pkey) in scenario_keys]
+    allow = hlolint.load_allowlist(allowlist_path)
+    kept, suppressed, stale = hlolint.split_allowed(findings, allow)
+    by_key = {}
+    for f in findings:
+        by_key.setdefault((f.tier, f.pkey), []).append(f)
+    supp_keys = {f.key for f in suppressed}
+    rows = []
+    for c in cases:
+        fs = [f for k in c["keys"] for f in by_key.get(tuple(k), [])]
+        rows.append({"case": c["case"], "tier": c["tier"],
+                     "programs": len(c["keys"]),
+                     "findings": len([f for f in fs
+                                      if f.key not in supp_keys]),
+                     "suppressed": len([f for f in fs
+                                        if f.key in supp_keys])})
+    return kept, suppressed, stale, rows
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("files", nargs="*",
+                    help="StableHLO/MLIR text files to lint")
+    ap.add_argument("--ci", action="store_true",
+                    help="replay the pinned cost-report scenarios and gate "
+                         "on the allowlist")
+    ap.add_argument("--allowlist", default=DEFAULT_ALLOWLIST)
+    ap.add_argument("--tier", default="jit",
+                    help="tier to lint standalone files as (default jit; "
+                         "use serve/decode/tape to arm the hot-tier rules)")
+    ap.add_argument("--json", default=None,
+                    help="write per-scenario gate rows as JSON (commit as "
+                         "%s)" % os.path.relpath(ARTIFACT, _REPO))
+    ap.add_argument("--rules", action="store_true",
+                    help="print the rule table and exit")
+    args = ap.parse_args(argv)
+
+    if args.rules:
+        hl = _load_standalone()
+        for rid, desc in sorted(hl.RULES.items()):
+            print("%s  %s" % (rid, desc))
+        return 0
+
+    if not args.ci:
+        if not args.files:
+            ap.error("pass StableHLO files to lint, or --ci for the gate")
+        hl = _load_standalone()
+        total = 0
+        for path in args.files:
+            with open(path) as fh:
+                text = fh.read()
+            for f in hl.lint_text(text, tier=args.tier,
+                                  hint=os.path.basename(path)):
+                print(f.render())
+                total += 1
+        print("hlolint: %d finding%s in %d file%s"
+              % (total, "" if total == 1 else "s",
+                 len(args.files), "" if len(args.files) == 1 else "s"))
+        return 1 if total else 0
+
+    kept, suppressed, stale, rows = run_ci(args.allowlist)
+    for f in kept:
+        print(f.render())
+    counts = {}
+    for f in kept:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    print("hlolint: %d finding%s%s, %d allowlisted over %d programs" % (
+        len(kept), "" if len(kept) == 1 else "s",
+        " (%s)" % ", ".join("%s=%d" % kv for kv in sorted(counts.items()))
+        if counts else "",
+        len(suppressed), sum(r["programs"] for r in rows)))
+    for r in rows:
+        print("  %-16s tier=%-6s programs=%-3d findings=%d suppressed=%d"
+              % (r["case"], r["tier"], r["programs"], r["findings"],
+                 r["suppressed"]))
+    for sid in stale:
+        print("hlolint: ERROR stale allowlist entry (no longer fires): %s"
+              " — prune it from %s"
+              % (sid, os.path.relpath(args.allowlist, _REPO)))
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump({"schema": 1, "rows": rows}, fh, indent=1,
+                      sort_keys=True)
+        print("wrote %s" % args.json)
+    return 1 if (kept or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

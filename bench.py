@@ -708,7 +708,7 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     mode = args[0] if args else "bert"
     if mode in ("optstep", "imperative", "autograd", "serve", "decode",
-                "coldstart", "specdecode", "ir", "dist", "quant"):
+                "coldstart", "specdecode", "ir", "dist", "quant", "tune"):
         # host-dispatch microbenches (fused multi-tensor optimizer step;
         # lazy bulk imperative chain vs eager; compiled tape replay vs the
         # eager backward walk; dynamic-batched serving vs per-request
@@ -736,7 +736,10 @@ def main():
                 # int8 quantized decode: dispatch/retrace/KV/agreement on
                 # a trained gpt_nano + step-program throughput vs bf16 at
                 # a width where the lever engages (mxnet_tpu.quant)
-                "quant": "quant_bench.py"}[mode]
+                "quant": "quant_bench.py",
+                # cost-model-driven autotune search vs DEFAULT_PASSES on
+                # the pinned const-island scenarios (mxnet_tpu.ir.tune)
+                "tune": "tune_bench.py"}[mode]
         spec = importlib.util.spec_from_file_location(
             tool[:-3], os.path.join(_REPO, "tools", tool))
         m = importlib.util.module_from_spec(spec)

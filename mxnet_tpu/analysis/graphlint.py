@@ -113,6 +113,8 @@ RULES = {
     "GL009": "ad-hoc metric state outside mxnet_tpu/observability",
     "GL010": "ad-hoc graph-node class / hand-rolled cache key outside "
              "mxnet_tpu/ir",
+    "GL016": "hand-rolled magic tuning table (literal block/bucket "
+             "constants outside the tuned-config store)",
 }
 RULES.update(_conc.RULES)  # GL011–GL015: concurrency rules (racecheck)
 
@@ -139,6 +141,17 @@ _GL009_EXEMPT = ("mxnet_tpu/observability/",)
 # server-scoped and register through their owners)
 _GL009_METRIC_CLASSES = {"Counter", "Gauge", "Histogram", "ServeMetrics",
                          "GenerativeMetrics"}
+
+# paths structurally exempt from GL016: the autotuner itself (its
+# candidate grids ARE the search space, not a schedule pretending to be
+# tuned)
+_GL016_EXEMPT = ("mxnet_tpu/ir/tune.py",)
+
+# name evidence for a tuning table: block sizes / bucket sets — the two
+# schedule families ir.tune searches; a literal table under such a name
+# is a hand-authored schedule the search should own (allowlist the
+# deliberate defaults with a why)
+_GL016_NAME_MARKERS = ("BLOCK", "BUCKET")
 
 # concat-family callables whose self-referential use in a loop grows the
 # carried aval (GL007); numpy names are exempt (host accumulation)
@@ -335,6 +348,7 @@ class _ModuleLint:
             if isinstance(node, (ast.For, ast.While)):
                 self._check_growing_carried(node)
         self._check_module_caches()
+        self._check_tuning_tables()
         self.findings.sort(key=lambda f: (f.path, f.line, f.rule, f.msg))
         return self.findings
 
@@ -880,6 +894,41 @@ class _ModuleLint:
             self.add(node, "GL006",
                      "module-level cache %r grows without an eviction path "
                      "(cap it or use base.BoundedCache)" % name, name)
+
+    # ------------------------------------------------------------- GL016
+    def _check_tuning_tables(self):
+        """GL016: a MODULE-LEVEL literal table of block sizes / bucket
+        sets — a hand-authored schedule. Since ir.tune (ISSUE 19) those
+        numbers are search output: tuned tables live in the tuned-config
+        store / flash_blocks.json with tuned_by/swept_at provenance, not
+        in code. Deliberate cold-start defaults stay allowlisted with a
+        why (the allowlist keys on the table's NAME, like GL006/GL009,
+        so it survives refactors)."""
+        path = self.path.replace(os.sep, "/")
+        if any(x in path for x in _GL016_EXEMPT):
+            return
+        for node in self.tree.body:
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            name = node.targets[0].id
+            upper = name.upper()
+            if not any(m in upper for m in _GL016_NAME_MARKERS):
+                continue
+            if not isinstance(node.value, (ast.Dict, ast.List, ast.Tuple,
+                                           ast.Set)):
+                continue
+            n_nums = sum(1 for sub in ast.walk(node.value)
+                         if isinstance(sub, ast.Constant)
+                         and type(sub.value) in (int, float))
+            if n_nums < 2:
+                continue
+            self.add(node, "GL016",
+                     "module-level literal tuning table %r (%d numeric "
+                     "constants) — schedules are searched now: emit it "
+                     "from ir.tune / the tuned-config store, or allowlist "
+                     "the cold-start default with a why" % (name, n_nums),
+                     name)
 
 
 # ------------------------------------------------------------------ driver

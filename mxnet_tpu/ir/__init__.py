@@ -18,7 +18,7 @@ Relay's typed-IR + pass-pipeline design (arXiv 1810.00952) and TVM's
 one-artifact-per-graph lowering (arXiv 1802.04799), applied to this
 stack's three frontends.
 """
-from . import graph, lower, passes  # noqa: F401
+from . import graph, lower, passes, tune  # noqa: F401
 from .graph import (Graph, GraphBuilder, Node, UnsupportedGraph,  # noqa: F401
                     build_runner, canonical_key, canonicalize, from_symbol,
                     from_window, symbol_skeleton)
@@ -29,4 +29,4 @@ __all__ = ["Graph", "GraphBuilder", "Node", "UnsupportedGraph",
            "build_runner", "canonical_key", "canonicalize", "from_symbol",
            "from_window", "symbol_skeleton", "lower_forward", "prepare",
            "tape_program", "stats", "PassManager", "DEFAULT_PASSES",
-           "pass_stats", "graph", "passes", "lower"]
+           "pass_stats", "graph", "passes", "lower", "tune"]

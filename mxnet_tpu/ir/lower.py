@@ -35,7 +35,7 @@ _lock = threading.Lock()   # entry construction only — never the hit path
 # build accounting for tools/diagnose.py, tools/ir_bench.py and the
 # observability "ir" collector (fixed keys — GL006)
 _BUILD_STATS = {"graph_builds": 0, "program_builds": 0,
-                "last_build": None}
+                "tuned_builds": 0, "last_build": None}
 
 
 class IREntry:
@@ -47,8 +47,8 @@ class IREntry:
                  "nodes_canonical", "nodes_final", "edges_canonical",
                  "edges_final")
 
-    def __init__(self, key, cgraph):
-        final, leaf_sel, slot_fwd = _p.optimize(cgraph)
+    def __init__(self, key, cgraph, pm=None):
+        final, leaf_sel, slot_fwd = _p.optimize(cgraph, pm)
         self.key = key
         self.graph = final
         self.leaf_sel = leaf_sel      # final program arg j -> canonical leaf
@@ -58,6 +58,22 @@ class IREntry:
         self.nodes_final = final.n_nodes
         self.edges_canonical = cgraph.n_edges
         self.edges_final = final.n_edges
+
+
+def _tuned_pm(key):
+    """The autotuned PassManager for this canonical key, or None for
+    ``DEFAULT_PASSES``. Lazy and exception-guarded: the tuned-config
+    store is an optimization, never a lowering dependency — a missing
+    or broken store must lower exactly like the pre-tuner repo."""
+    import sys
+
+    t = sys.modules.get("mxnet_tpu.ir.tune")
+    if t is None:
+        from . import tune as t  # first lookup pays the import; ~ms
+    try:
+        return t.pass_manager_for(key)
+    except Exception:
+        return None
 
 
 def _counter(kind):
@@ -79,10 +95,14 @@ def prepare(raw_graph):
         with _lock:
             ent = base._IR_CACHE.get(key)
             if ent is None:
-                ent = base._IR_CACHE[key] = IREntry(key, canon.graph)
+                pm = _tuned_pm(key)
+                ent = base._IR_CACHE[key] = IREntry(key, canon.graph, pm)
                 _BUILD_STATS["graph_builds"] += 1
+                if pm is not None:
+                    _BUILD_STATS["tuned_builds"] += 1
                 _BUILD_STATS["last_build"] = {
                     "key": key[:16],
+                    "tuned": pm is not None,
                     "nodes_captured": raw_graph.n_nodes,
                     "nodes_canonical": ent.nodes_canonical,
                     "nodes_final": ent.nodes_final,
@@ -173,4 +193,5 @@ def reset_stats():
     """Test/bench hook: zero the build tallies (cache stays warm)."""
     _BUILD_STATS["graph_builds"] = 0
     _BUILD_STATS["program_builds"] = 0
+    _BUILD_STATS["tuned_builds"] = 0
     _BUILD_STATS["last_build"] = None

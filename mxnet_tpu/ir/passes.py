@@ -217,7 +217,7 @@ def _fold(work):
             v = np.asarray(v)
         except Exception:
             continue  # not host-evaluable: leave it to runtime
-        if v.size > _FOLD_MAX_ELEMS:
+        if v.size > getattr(work, "fold_max_elems", _FOLD_MAX_ELEMS):
             continue
         const[bases[i]] = v
     if not const:
@@ -530,16 +530,41 @@ class PassManager:
     ``Graph -> Graph`` form (pass-unit tests, user experimentation);
     :meth:`run_work` is the map-tracking form lowering uses. The
     pipeline is deterministic: same input graph → same output graph,
-    byte-identical canonical keys (tests assert it)."""
+    byte-identical canonical keys (tests assert it).
 
-    def __init__(self, passes=DEFAULT_PASSES):
+    The config surface (``passes`` ordering + ``fold_max_elems``) is the
+    autotuner's search space: ``ir.tune`` persists winning configs as
+    :meth:`config` dicts and rebuilds them with :meth:`from_config`, and
+    lowering consults the tuned-config store before falling back to
+    ``PassManager()`` (= ``DEFAULT_PASSES``)."""
+
+    def __init__(self, passes=DEFAULT_PASSES, fold_max_elems=None):
         unknown = [p for p in passes if p not in _PASS_FNS]
         if unknown:
             raise ValueError("unknown IR passes %s (have %s)"
                              % (unknown, sorted(_PASS_FNS)))
         self.passes = tuple(passes)
+        # None = the process default (MXNET_IR_FOLD_MAX_ELEMS); a tuned
+        # config pins an explicit cap so the fold decision travels with
+        # the config, not the environment
+        self.fold_max_elems = (None if fold_max_elems is None
+                               else int(fold_max_elems))
+
+    def config(self):
+        """JSON-serializable config dict (the tuned-store entry body)."""
+        cfg = {"passes": list(self.passes)}
+        if self.fold_max_elems is not None:
+            cfg["fold_max_elems"] = self.fold_max_elems
+        return cfg
+
+    @classmethod
+    def from_config(cls, cfg):
+        return cls(passes=tuple(cfg.get("passes", DEFAULT_PASSES)),
+                   fold_max_elems=cfg.get("fold_max_elems"))
 
     def run_work(self, work):
+        if self.fold_max_elems is not None:
+            work.fold_max_elems = self.fold_max_elems
         for name in self.passes:
             before = work.graph()
             rewrites = _PASS_FNS[name](work)

@@ -243,6 +243,18 @@ def _collect_hlolint():
     return hlolint.snapshot_section(costs.profiles())
 
 
+def _collect_tune():
+    # IR autotuner (ir.tune): search telemetry + tuned-config store
+    # shape. Same never-force-load rule as dist/quant — tuning telemetry
+    # only appears once something actually imported the tuner.
+    import sys
+
+    t = sys.modules.get("mxnet_tpu.ir.tune")
+    if t is None:
+        return {"subsystem": "not loaded"}
+    return t.stats()
+
+
 registry.register_collector("engine", _collect_engine)
 registry.register_collector("concurrency", _collect_concurrency)
 registry.register_collector("costs", _collect_costs)
@@ -255,6 +267,7 @@ registry.register_collector("serve", _collect_serve)
 registry.register_collector("profiler", _collect_profiler)
 registry.register_collector("ops", _collect_ops)
 registry.register_collector("ir", _collect_ir)
+registry.register_collector("tune", _collect_tune)
 registry.register_collector("watchdog", watchdog.snapshot)
 registry.register_collector(
     "tracing", lambda: {"enabled": tracing_enabled()})

@@ -373,6 +373,71 @@ MIN_LEN = None
 _BLOCKS_ARTIFACT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "flash_blocks.json")
 
+# provenance of the loaded artifact (tuned_by/swept_at/backend/source) —
+# empty until _load_block_artifact succeeds; diagnose and the interim
+# warning read it
+_ARTIFACT_META = {}
+
+_INTERIM_WARNED = False
+
+
+def _warn_if_interim():
+    """Warn ONCE per process when serving from an interim table — either
+    no artifact loaded at all, or one whose ``swept_at`` is null (hand-
+    authored placeholder, never measured on hardware). Tuned tables from
+    flash_sweep --apply or ir.tune.tune_flash_blocks carry a timestamp
+    and stay silent."""
+    global _INTERIM_WARNED
+    if _INTERIM_WARNED:
+        return
+    if _ARTIFACT_META.get("swept_at"):
+        return
+    _INTERIM_WARNED = True
+    import warnings
+
+    warnings.warn(
+        "flash_attention is serving an INTERIM block table (%s) — blocks "
+        "were never measured on this hardware; run tools/flash_sweep.py "
+        "--apply or ir.tune.tune_flash_blocks(apply=True) to tune them"
+        % (_ARTIFACT_META.get("source") or "built-in fallback"))
+
+
+def write_block_artifact(blocks, source, swept_at=None, tuned_by=None,
+                         backend=None, min_len=None, note=None, path=None):
+    """THE writer for flash_blocks.json — flash_sweep --apply and
+    ir.tune.tune_flash_blocks both emit through here, so the two formats
+    cannot diverge. Validates the table shape, writes atomically
+    (tmp + os.replace), reloads the live BLOCK_DEFAULTS, and returns the
+    artifact dict."""
+    table = {}
+    for seq, blk in dict(blocks).items():
+        bq, bk = int(blk[0]), int(blk[1])
+        if bq <= 0 or bk <= 0:
+            raise ValueError("non-positive block pair %r for seq %r"
+                             % (blk, seq))
+        table[str(int(seq))] = [bq, bk]
+    if not table:
+        raise ValueError("refusing to write an empty block table")
+    if "0" not in table:
+        raise ValueError("block table needs a catch-all '0' row")
+    artifact = {
+        "blocks": {k: table[k] for k in sorted(table, key=int)},
+        "min_len": int(min_len) if min_len is not None else None,
+        "source": source,
+        "tuned_by": tuned_by,
+        "swept_at": swept_at,
+        "backend": backend,
+        "note": note,
+    }
+    out = path or _BLOCKS_ARTIFACT
+    tmp = out + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(artifact, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, out)
+    _load_block_artifact(out)
+    return artifact
+
 
 def _load_block_artifact(path=None):
     """Replace BLOCK_DEFAULTS with the committed hardware-sweep winners.
@@ -385,7 +450,7 @@ def _load_block_artifact(path=None):
     untuned table is exactly the failure that must not be quiet (ADVICE
     r4). An explicit ``path`` argument raises on any failure: the caller
     asked for that file specifically."""
-    global BLOCK_DEFAULTS, MIN_LEN
+    global BLOCK_DEFAULTS, MIN_LEN, _ARTIFACT_META, _INTERIM_WARNED
     explicit = path is not None
     path = path or _BLOCKS_ARTIFACT
     if not os.path.exists(path):
@@ -413,6 +478,13 @@ def _load_block_artifact(path=None):
     # reset too: a reloaded artifact without min_len must not leave a stale
     # crossover from a superseded sweep paired with the new block table
     MIN_LEN = raw["min_len"] if isinstance(raw.get("min_len"), int) else None
+    # fixed-key provenance record (replaced whole on every load, GL006-safe)
+    _ARTIFACT_META = dict(
+        {k: raw.get(k) for k in
+         ("source", "tuned_by", "swept_at", "backend", "note")},
+        path=path)
+    # a freshly tuned table may land mid-process: re-arm the interim check
+    _INTERIM_WARNED = False
     return True
 
 
@@ -439,6 +511,8 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None,
     masked AND their blocks skipped entirely, forward and backward)."""
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
+    if block_q is None or block_k is None:
+        _warn_if_interim()  # explicit blocks aren't served from the table
     Tq, Tk = q.shape[2], k.shape[2]
     # bucket each axis by ITS length: cross-attention (short queries, long
     # keys) must not take the long-seq row's block_q

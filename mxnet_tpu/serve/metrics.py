@@ -45,6 +45,16 @@ class ServeMetrics:
         self.batches = 0                  # dispatched batches
         self.batched_rows = 0             # real rows across batches
         self.bucket_rows = 0              # padded bucket rows across batches
+        self.pad_rows = 0                 # bucket_rows - batched_rows, running
+        self.row_bytes = None             # bytes per input row (server-set)
+        # measured traffic shape — the autotuner's input (ir.tune
+        # fit_buckets) and the pad-waste evidence pow2 defaults hide.
+        # Both maps are bounded: request sizes are capped by the largest
+        # admissible bucket and batches land on configured buckets only,
+        # so keys ≤ max_bucket / len(buckets) — not per-request state
+        # (GL006)
+        self._request_rows = {}           # rows(int) -> request count
+        self._bucket_hist = {}            # bucket -> {batches, rows, pad_rows}
         self._queue_depth = 0
         # profiler 'C' counters are created lazily so importing serve never
         # touches profiler state; events are only emitted while it runs
@@ -61,9 +71,12 @@ class ServeMetrics:
             }
         return self._prof
 
-    def record_admit(self, n=1):
+    def record_admit(self, n=1, rows=None):
         with self._lock:
             self.requests += n
+            if rows is not None:
+                r = int(rows)
+                self._request_rows[r] = self._request_rows.get(r, 0) + 1
 
     def record_queue_depth(self, depth):
         with self._lock:
@@ -94,6 +107,20 @@ class ServeMetrics:
             self.batches += 1
             self.batched_rows += int(n_real)
             self.bucket_rows += int(bucket)
+            self.pad_rows += max(0, int(bucket) - int(n_real))
+            h = self._bucket_hist.get(int(bucket))
+            if h is None:
+                h = self._bucket_hist[int(bucket)] = {
+                    "batches": 0, "rows": 0, "pad_rows": 0}
+            h["batches"] += 1
+            h["rows"] += int(n_real)
+            h["pad_rows"] += max(0, int(bucket) - int(n_real))
+
+    def request_rows(self):
+        """Measured request-size histogram ``{rows: count}`` — what
+        ``ir.tune.fit_buckets`` fits bucket sets to."""
+        with self._lock:
+            return dict(self._request_rows)
 
     def record_latency(self, ms):
         with self._lock:
@@ -130,6 +157,13 @@ class ServeMetrics:
                 "mean_batch_size": (round(self.batched_rows / self.batches, 2)
                                     if self.batches else None),
                 "latency_window": min(self._lat_n, self._window),
+                "pad_rows_total": self.pad_rows,
+                "pad_waste_bytes": (self.pad_rows * self.row_bytes
+                                    if self.row_bytes else None),
+                "request_rows": {str(r): c for r, c in
+                                 sorted(self._request_rows.items())},
+                "bucket_hist": {str(b): dict(h) for b, h in
+                                sorted(self._bucket_hist.items())},
             }
             snap.update(self._percentiles())
         return snap

@@ -131,7 +131,17 @@ class ModelServer:
         self._specs = [(tuple(shape), np.dtype(dt))
                        for shape, dt in input_specs]
         self.timeout_ms = float(timeout_ms)
+        # rebuild ingredients for retune_buckets (pool + batcher rewire)
+        self._devices = devices
+        self._donate = donate
+        self._max_wait_ms = max_wait_ms
+        self._max_queue = max_queue
         self.metrics = ServeMetrics(self.name)
+        # bytes one request row occupies across all inputs: turns the
+        # metrics pad-row count into pad-waste bytes
+        self.metrics.row_bytes = sum(
+            int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+            for shape, dt in self._specs)
         self._pool = _block_pool(model, devices, self.buckets, donate)
         self._batcher = DynamicBatcher(
             self._dispatch, max_batch=self.buckets[-1],
@@ -194,6 +204,45 @@ class ModelServer:
         if self.metrics_http is not None:
             self.metrics_http.close()
             self.metrics_http = None
+
+    def retune_buckets(self, buckets=None, max_buckets=6):
+        """Rebuild the server on a new bucket set — the apply step of
+        serve-bucket autotuning. With ``buckets=None`` the set is fit to
+        this server's MEASURED request-size histogram
+        (``ir.tune.fit_buckets`` over ``metrics.request_rows()``) instead
+        of the blind pow2 default. Drains in-flight work, compiles the
+        new bucket programs (warmup), rewires the batcher, and resumes if
+        the server was running. Counters and histograms carry over — the
+        next fit sees all traffic ever served."""
+        if buckets is None:
+            from ..ir import tune as _tune
+
+            hist = self.metrics.request_rows()
+            if not hist:
+                raise ServeError(
+                    "no request-size history to fit buckets to — serve "
+                    "traffic first or pass buckets= explicitly")
+            buckets = _tune.fit_buckets(hist, max_buckets=max_buckets,
+                                        max_size=self.buckets[-1])
+        new = tuple(sorted(set(int(b) for b in buckets)))
+        if not new:
+            raise ServeError("retune_buckets needs a non-empty bucket set")
+        if new == self.buckets:
+            return self
+        was_started = self._started
+        if was_started:
+            self.stop()
+        self.buckets = new
+        self._pool = _block_pool(self.model, self._devices, self.buckets,
+                                 self._donate)
+        self._batcher = DynamicBatcher(
+            self._dispatch, max_batch=self.buckets[-1],
+            max_wait_ms=self._max_wait_ms, max_queue=self._max_queue,
+            num_dispatchers=self._pool.num_replicas, metrics=self.metrics)
+        self.warmup()
+        if was_started:
+            self.start()
+        return self
 
     def __enter__(self):
         return self.start()

@@ -1,0 +1,24 @@
+"""GL016 negatives: tuned tables loaded from artifacts, single scalars,
+non-tuning names, and function-local candidate grids."""
+import json
+
+
+def _load(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+# loaded from a provenance-carrying artifact, not a literal in code
+BLOCK_DEFAULTS = _load("flash_blocks.json")
+
+# a single scalar is a knob, not a schedule table
+BLOCK_ALIGN = 128
+
+# numeric literal table under a non-tuning name
+SHAPE_DEFAULTS = {0: (256, 512)}
+
+
+def candidates(seq):
+    # function-local grids are search inputs, not a hand-authored winner
+    block_grid = [(128, 128), (256, 256), (512, 512)]
+    return [b for b in block_grid if b[0] <= seq]

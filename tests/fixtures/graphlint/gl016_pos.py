@@ -1,0 +1,15 @@
+"""GL016 positives: module-level literal tuning tables — hand-authored
+schedules that should be search output (ir.tune / the tuned-config
+store), not code."""
+
+BLOCK_DEFAULTS = {  # expect: GL016
+    0: (256, 512),
+    1024: (512, 512),
+}
+
+DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32)  # expect: GL016
+
+ATTN_BLOCK_TABLE = [  # expect: GL016
+    [128, 256],
+    [256, 512],
+]

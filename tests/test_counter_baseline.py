@@ -365,6 +365,14 @@ def test_specdecode_artifact_pins():
                                            "accept_rate",
                                            "steady_state_recompiles",
                                            "chunked_itl_p95_improvement"]),
+    # speedup bar + ledger direction + zero-retrace are pinned (and the
+    # deterministic columns replayed) by tests/test_tune.py::
+    # test_tune_bench_artifact_pins_and_replay
+    ("tune_bench_quick.json", ["candidates", "candidates_pruned",
+                               "candidates_timed", "speedup",
+                               "ledger_bytes_improved",
+                               "ledger_peak_hbm_improved",
+                               "steady_state_recompiles"]),
 ])
 def test_committed_artifacts_carry_counter_columns(name, counter_cols):
     """The gate only works while the artifacts keep their counter columns —

@@ -293,6 +293,37 @@ def main():
                  _fmt(row.get("kv_cache_bytes", 0)),
                  _fmt(row.get("total_bytes"))))
 
+    print("----------Autotuning----------")
+    # cost-model-driven schedule search (ir.tune): tuned-config store
+    # shape, lower-path hit/miss, and the last search's budget — attach
+    # when a topology retunes every process (store path unset?) or a
+    # tuned config is suspected of a regression
+    tn = snap.get("tune", {})
+    if tn.get("subsystem") == "not loaded":
+        print("tuner        : subsystem not loaded (import mxnet_tpu.ir.tune)")
+    elif tn:
+        st = tn.get("store", {})
+        print("store        : %s, %d entrie(s) (MXNET_TUNE_STORE / "
+              "MXNET_COMP_CACHE_DIR)"
+              % (st.get("path") or "in-memory only", st.get("entries", 0)))
+        for key in st.get("keys", [])[:6]:
+            print("  entry      : %s" % key)
+        print("lower lookups: %d tuned hit(s), %d default fallback(s)"
+              % (tn.get("store_hits", 0), tn.get("store_misses", 0)))
+        print("searches     : %d run(s), %d candidate(s), %d pruned by "
+              "cost ledger, %d timed, %d parity reject(s), %d install(s)"
+              % (tn.get("searches", 0), tn.get("candidates", 0),
+                 tn.get("pruned", 0), tn.get("timed", 0),
+                 tn.get("parity_rejects", 0), tn.get("installs", 0)))
+        if tn.get("last_search"):
+            ls = tn["last_search"]
+            print("last search  : %s… %d candidate(s) → %d timed @ %d "
+                  "pair(s), winner %s"
+                  % (ls["key"], ls["candidates"], ls["timed"], ls["pairs"],
+                     ls["winner"] or "none (defaults kept)"))
+    else:
+        print("tune section unavailable")
+
     print("----------Graphlint Summary----------")
     # tracing-hygiene static pass over the package (tools/graphlint.py);
     # anything non-allowlisted here also fails the tier-1 suite

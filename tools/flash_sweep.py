@@ -239,10 +239,6 @@ def apply_winners(rows, source, measured_at=None):
     blocks = {str(s): [w["block_q"], w["block_k"]]
               for s, w in winners.items()}
     blocks["0"] = blocks[str(min(winners))]
-    art = {"blocks": blocks, "source": source,
-           "swept_at": measured_at,
-           "note": "winners by min fwd_bwd_ms per seq; written by "
-                   "tools/flash_sweep.py --apply"}
     # measured flash-vs-dense crossover: the gate is a single threshold
     # (seq >= min_len), so the only SOUND value is the start of a suffix of
     # swept seqs where flash wins consistently — taking the first isolated
@@ -263,16 +259,27 @@ def apply_winners(rows, source, measured_at=None):
                for t in compared if t >= s):
             min_len = s
             break
-    if compared and min_len is not None:
-        art["min_len"] = min_len
-    elif compared:
+    if compared and min_len is None:
         print("flash beat dense at no consistent seq suffix %s; "
               "min_len not written (static gate stays)" % (compared,))
-    tmp = fa._BLOCKS_ARTIFACT + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(art, f, indent=1, sort_keys=True)
-        f.write("\n")
-    os.replace(tmp, fa._BLOCKS_ARTIFACT)  # atomic: never a half-written table
+    # write through the SHARED artifact writer (also used by
+    # ir.tune.tune_flash_blocks) so the two tuning paths cannot diverge
+    # on format; it validates, writes atomically, and reloads the live
+    # table
+    try:
+        import jax
+        backend = jax.default_backend()
+    except Exception:
+        backend = None
+    fa.write_block_artifact(
+        {int(s): b for s, b in blocks.items()},
+        source=source,
+        swept_at=measured_at,
+        tuned_by="tools/flash_sweep.py --apply",
+        backend=backend,
+        min_len=min_len,
+        note="winners by min fwd_bwd_ms per seq; written by "
+             "tools/flash_sweep.py --apply")
     print("applied block winners to %s: %s" % (fa._BLOCKS_ARTIFACT, blocks))
     return 0
 

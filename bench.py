@@ -708,7 +708,8 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     mode = args[0] if args else "bert"
     if mode in ("optstep", "imperative", "autograd", "serve", "decode",
-                "coldstart", "specdecode", "ir", "dist", "quant", "tune"):
+                "coldstart", "specdecode", "ir", "dist", "quant", "tune",
+                "fleet"):
         # host-dispatch microbenches (fused multi-tensor optimizer step;
         # lazy bulk imperative chain vs eager; compiled tape replay vs the
         # eager backward walk; dynamic-batched serving vs per-request
@@ -739,7 +740,11 @@ def main():
                 "quant": "quant_bench.py",
                 # cost-model-driven autotune search vs DEFAULT_PASSES on
                 # the pinned const-island scenarios (mxnet_tpu.ir.tune)
-                "tune": "tune_bench.py"}[mode]
+                "tune": "tune_bench.py",
+                # multi-process replica fleet: kill -9 drill, SLO
+                # autoscale p99, zero-downtime hot swap, warm spawn,
+                # prefix migration (mxnet_tpu.serve.fleet)
+                "fleet": "fleet_bench.py"}[mode]
         spec = importlib.util.spec_from_file_location(
             tool[:-3], os.path.join(_REPO, "tools", tool))
         m = importlib.util.module_from_spec(spec)
@@ -751,8 +756,10 @@ def main():
             argv += ["--mode", mode]
         if iters := next((f.split("=", 1)[1] for f in flags
                           if f.startswith("--iters=")), None):
-            # dist_bench counts training steps, not timing iterations
-            argv += ["--steps" if mode == "dist" else "--iters", iters]
+            # dist_bench counts training steps, fleet_bench counts
+            # requests per wave — neither times fixed iterations
+            argv += [{"dist": "--steps",
+                      "fleet": "--requests"}.get(mode, "--iters"), iters]
         raise SystemExit(m.main(argv))
     if mode != "all" and mode not in MODES:
         # validate BEFORE the probe/replay machinery: a typo must abort

@@ -115,6 +115,8 @@ RULES = {
              "mxnet_tpu/ir",
     "GL016": "hand-rolled magic tuning table (literal block/bucket "
              "constants outside the tuned-config store)",
+    "GL017": "process spawn/kill outside the fleet layer (serve.fleet / "
+             "serve.worker / tools own replica lifecycle)",
 }
 RULES.update(_conc.RULES)  # GL011–GL015: concurrency rules (racecheck)
 
@@ -152,6 +154,20 @@ _GL016_EXEMPT = ("mxnet_tpu/ir/tune.py",)
 # is a hand-authored schedule the search should own (allowlist the
 # deliberate defaults with a why)
 _GL016_NAME_MARKERS = ("BLOCK", "BUCKET")
+
+# paths structurally exempt from GL017: the fleet layer itself (spawning
+# and killing replicas is its JOB) and tools/ (benches, launchers)
+_GL017_EXEMPT = ("mxnet_tpu/serve/fleet.py", "mxnet_tpu/serve/worker.py",
+                 "tools/")
+
+# process-lifecycle callables: ``os.<attr>`` / ``subprocess.<attr>`` calls
+# (or a bare ``Popen(...)`` from ``from subprocess import Popen``) outside
+# the fleet layer scatter replica lifecycle across the codebase — workers
+# leak, kill -9 drills miss them, and the router can't account for them
+_GL017_OS_CALLS = {"kill", "killpg", "fork", "forkpty", "system", "popen",
+                   "spawnv", "spawnl", "execv", "execve"}
+_GL017_SUBPROCESS_CALLS = {"Popen", "run", "call", "check_call",
+                           "check_output"}
 
 # concat-family callables whose self-referential use in a loop grows the
 # carried aval (GL007); numpy names are exempt (host accumulation)
@@ -349,6 +365,7 @@ class _ModuleLint:
                 self._check_growing_carried(node)
         self._check_module_caches()
         self._check_tuning_tables()
+        self._check_process_lifecycle()
         self.findings.sort(key=lambda f: (f.path, f.line, f.rule, f.msg))
         return self.findings
 
@@ -929,6 +946,50 @@ class _ModuleLint:
                      "from ir.tune / the tuned-config store, or allowlist "
                      "the cold-start default with a why" % (name, n_nums),
                      name)
+
+    # ------------------------------------------------------------- GL017
+    def _check_process_lifecycle(self):
+        """GL017: spawning or signalling OS processes outside the fleet
+        layer. Since serve.fleet (ISSUE 20) replica lifecycle has one
+        owner — FleetRouter spawns serve.worker subprocesses, accounts
+        for them, and reaps them; a stray ``subprocess.run``/``os.kill``
+        elsewhere creates processes no router tracks (leaked on crash,
+        invisible to the kill-9 drill, unreaped zombies). The allowlist
+        keys on the ENCLOSING DEF, so a deliberate site (engine's native
+        lib build) survives line churn."""
+        path = self.path.replace(os.sep, "/")
+        if any(x in path for x in _GL017_EXEMPT):
+            return
+
+        def visit(node, scope):
+            for child in ast.iter_child_nodes(node):
+                sub = scope
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    sub = (child.name if scope == "<module>"
+                           else "%s.%s" % (scope, child.name))
+                if isinstance(child, ast.Call):
+                    called = None
+                    fn = child.func
+                    if isinstance(fn, ast.Attribute) and \
+                            isinstance(fn.value, ast.Name):
+                        if (fn.value.id == "os"
+                                and fn.attr in _GL017_OS_CALLS) or \
+                           (fn.value.id == "subprocess"
+                                and fn.attr in _GL017_SUBPROCESS_CALLS):
+                            called = "%s.%s" % (fn.value.id, fn.attr)
+                    elif isinstance(fn, ast.Name) and fn.id == "Popen":
+                        called = "Popen"
+                    if called is not None:
+                        self.add(child, "GL017",
+                                 "%s outside the fleet layer — replica "
+                                 "lifecycle belongs to serve.fleet/"
+                                 "serve.worker (or tools/); allowlist "
+                                 "deliberate sites with a why" % called,
+                                 scope)
+                visit(child, sub)
+
+        visit(self.tree, "<module>")
 
 
 # ------------------------------------------------------------------ driver

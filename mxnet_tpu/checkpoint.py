@@ -56,6 +56,62 @@ def load_arrays(path):
             for k, v in load_npz_exact(path).items()}
 
 
+class SwapError(RuntimeError):
+    """A pushed checkpoint does not structurally match the live model —
+    the weight hot-swap is refused and the old weights keep serving."""
+
+
+def validate_swap(block, params_file):
+    """Structural gate for zero-downtime weight hot-swap: the pushed
+    checkpoint must carry EXACTLY the live model's parameter tree — same
+    structural names (aliases accepted, as save_parameters(deduplicate)
+    writes), same shapes, same dtypes. Anything else (missing params,
+    extra params, reshaped layers, an fp32 file pushed at a quantized
+    server whose live tree is qweight/w_scale pages) raises ``SwapError``
+    listing every divergence, and the caller keeps serving the old
+    weights. Matching shapes/dtypes are what make the flip free: the
+    compiled bucket programs keep their signatures, so swap is a pointer
+    flip, never a retrace.
+
+    Returns ``{structural_name: numpy array}`` for the flip."""
+    from .util import load_npz_exact
+
+    params = block._collect_params_with_prefix()
+    loaded = load_npz_exact(params_file)
+    by_id = {}
+    for name, p in params.items():
+        by_id.setdefault(id(p), []).append(name)
+    problems, picked, used = [], {}, set()
+    for name, p in params.items():
+        key = name if name in loaded else next(
+            (a for a in by_id[id(p)] if a in loaded), None)
+        if key is None:
+            problems.append("missing %r" % name)
+            continue
+        used.add(key)
+        arr = loaded[key]
+        live = p.data()
+        if tuple(arr.shape) != tuple(live.shape):
+            problems.append("reshaped %r: file %s vs live %s"
+                            % (name, tuple(arr.shape), tuple(live.shape)))
+        elif np.dtype(arr.dtype) != np.dtype(live.dtype):
+            problems.append("dtype %r: file %s vs live %s"
+                            % (name, np.dtype(arr.dtype),
+                               np.dtype(live.dtype)))
+        else:
+            picked[name] = arr
+    for key in sorted(set(loaded) - used):
+        problems.append("extra %r" % key)
+    if problems:
+        raise SwapError(
+            "checkpoint %r rejected (%d problem%s): %s — old weights keep "
+            "serving" % (params_file, len(problems),
+                         "" if len(problems) == 1 else "s",
+                         "; ".join(problems[:8])
+                         + ("; ..." if len(problems) > 8 else "")))
+    return picked
+
+
 def save_for_serving(prefix, block, epoch=0, input_names=("data",),
                      input_shapes=None):
     """Export a hybridized block in the serving layout — ``prefix-symbol.json``

@@ -36,6 +36,8 @@ from .batcher import (DynamicBatcher, ServeError, ServerBusy,  # noqa: F401
 from .decoder import GenerationStream, GenerativeServer  # noqa: F401
 from .executor_pool import (BucketedExecutor, PoolError,  # noqa: F401
                             symbol_infer_fn)
+from .fleet import (Autoscaler, FleetRouter, WorkerGone,  # noqa: F401
+                    WorkerHandle, WorkerSpec)
 from .kv_cache import CacheError, PagedKVCache, PrefixCache  # noqa: F401
 from .metrics import GenerativeMetrics, ServeMetrics  # noqa: F401
 from .server import DEFAULT_BUCKETS, ModelServer  # noqa: F401
@@ -45,6 +47,8 @@ __all__ = ["ModelServer", "GenerativeServer", "GenerationStream",
            "BucketedExecutor", "DynamicBatcher", "PagedKVCache",
            "PrefixCache", "CacheError", "ServeMetrics", "GenerativeMetrics",
            "NGramDraft", "ModelDraft",
+           "FleetRouter", "Autoscaler", "WorkerSpec", "WorkerHandle",
+           "WorkerGone",
            "ServeError", "ServerBusy", "ServeTimeout", "PoolError",
            "DEFAULT_BUCKETS", "load", "snapshot", "stats"]
 

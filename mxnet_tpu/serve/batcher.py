@@ -116,6 +116,10 @@ class DynamicBatcher:
         self._inflight = threading.Semaphore(self._num_dispatchers)
         self._pool = ThreadPoolExecutor(self._num_dispatchers,
                                         thread_name_prefix="serve-dispatch")
+        # requests claimed by a dispatcher but not yet finished — the set
+        # stop() sweeps so a process exiting mid-drain can never strand a
+        # caller blocked on result() (guarded by _cond)
+        self._claimed = set()
 
     # ------------------------------------------------------------ lifecycle
     def start(self):
@@ -134,15 +138,22 @@ class DynamicBatcher:
             self._worker.start()
         return self
 
-    def stop(self, drain=True, timeout_s=5.0):
+    def stop(self, drain=True, timeout_s=5.0, reason="server stopped"):
         """Stop the worker and tear down the dispatcher pool.
 
         drain=True lets the worker dispatch what is already queued before
         exiting; drain=False rejects the queue immediately. Either way the
         worker join is bounded by ``timeout_s`` and anything still queued
         after it is rejected with ServeError — stop() never strands a
-        caller blocked on ``result()``. Idempotent; start() after stop()
-        builds a fresh pool, so repeated cycles leak no threads."""
+        caller blocked on ``result()``. Requests a dispatcher had already
+        CLAIMED when the bound expired (the process-exit-mid-drain window:
+        a dispatch wedged past the join timeout used to leave its riders
+        with no terminal error) are swept with a typed
+        ``ServeError("worker retired: ...")`` — a fleet router reads that
+        as retryable and re-lands the request on a sibling replica.
+        ``reason`` names who stopped us in every rejection. Idempotent;
+        start() after stop() builds a fresh pool, so repeated cycles leak
+        no threads."""
         with self._cond:
             self._stop = True
             if not drain:
@@ -152,7 +163,7 @@ class DynamicBatcher:
             else:
                 pending = []
             self._cond.notify_all()
-        err = ServeError("server stopped")
+        err = ServeError(reason)
         for r in pending:
             r.finish(error=err)
         worker, self._worker = self._worker, None
@@ -169,8 +180,30 @@ class DynamicBatcher:
         pool, self._pool = self._pool, None
         if pool is not None:
             # dispatchers hold requests whose callers may be blocked on
-            # result(): wait for in-flight work, never for new work
-            pool.shutdown(wait=True)
+            # result(): give in-flight work a bounded window to finish
+            # naturally, then SWEEP — shutdown(wait=True) with a wedged
+            # dispatch would block stop() forever and the process would
+            # exit mid-drain with the riders stranded
+            pool.shutdown(wait=False)
+            deadline = time.perf_counter() + timeout_s
+            while time.perf_counter() < deadline:
+                with self._cond:
+                    if not self._claimed:
+                        break
+                time.sleep(0.005)
+            with self._cond:
+                stranded, self._claimed = list(self._claimed), set()
+            retired = ServeError("worker retired: %s" % reason)
+            for r in stranded:
+                # idempotent finish: a dispatch that completes late is a
+                # harmless no-op against this terminal error
+                r.finish(error=retired)
+            # bounded join so a clean stop leaves zero serve-dispatch
+            # threads behind (test_concurrency's cycle pin); a wedged
+            # dispatch past the bound stays a daemon and is abandoned
+            join_by = time.perf_counter() + max(0.5, timeout_s / 2.0)
+            for t in list(getattr(pool, "_threads", ())):
+                t.join(timeout=max(0.0, join_by - time.perf_counter()))
 
     # ------------------------------------------------------------ admission
     def submit(self, inputs, n_rows, timeout_ms=None, priority=0,
@@ -291,6 +324,8 @@ class DynamicBatcher:
         try:
             self._dispatch_fn(batch, rows)
         finally:
+            with self._cond:
+                self._claimed.difference_update(batch)
             self._inflight.release()
 
     def _loop(self):
@@ -315,4 +350,6 @@ class DynamicBatcher:
                     req.finish(error=err)
                 self._inflight.release()
                 return
+            with self._cond:
+                self._claimed.update(batch)
             pool.submit(self._run_dispatch, batch, rows)

@@ -271,6 +271,11 @@ class GenerativeServer:
         self.eos_id = eos_id
         self.timeout_ms = float(timeout_ms)
         self._plist = list(model.collect_params().values())
+        # hot-swap seam: every dispatch snapshots the param list under
+        # this lock (_params), and swap_parameters writes under it — a
+        # decode step sees all-old or all-new weights, never a mix
+        self._params_lock = threading.Lock()
+        self._swap_epoch = 0
         self.cache = PagedKVCache(
             spec["layers"], spec["heads"], spec["head_dim"], self.slots,
             spec["max_length"], dtype=spec["dtype"],
@@ -363,7 +368,8 @@ class GenerativeServer:
         if self._metrics_port is not None and self.metrics_http is None:
             from ..observability import MetricsHTTPServer
 
-            self.metrics_http = MetricsHTTPServer(self._metrics_port)
+            self.metrics_http = MetricsHTTPServer(self._metrics_port,
+                                                  health_fn=self.health)
         if self._loop_thread is None or not self._loop_thread.is_alive():
             self._stop_flag = False
             self._loop_thread = threading.Thread(
@@ -371,7 +377,7 @@ class GenerativeServer:
             self._loop_thread.start()
         return self
 
-    def stop(self, timeout_s=5.0):
+    def stop(self, timeout_s=5.0, reason="server stopped"):
         """Stop the scheduler loop, reject everything in flight, and tear
         the dispatcher pool down. The loop join is bounded by
         ``timeout_s``; active slots are retired and the join queue is
@@ -385,14 +391,14 @@ class GenerativeServer:
         loop, self._loop_thread = self._loop_thread, None
         if loop is not None:
             loop.join(timeout=timeout_s)
-        self._batcher.stop(drain=False, timeout_s=timeout_s)
+        self._batcher.stop(drain=False, timeout_s=timeout_s, reason=reason)
         for slot in self.cache.active_slots:
-            self._retire(slot, error=ServeError("server stopped"))
+            self._retire(slot, error=ServeError(reason))
         with self._join_cond:
             pending = list(self._join_q)
             self._join_q.clear()
         for req in pending:
-            err = ServeError("server stopped")
+            err = ServeError(reason)
             if req.finish(error=err):
                 req.inputs._finish(err)
         if self.metrics_http is not None:
@@ -404,6 +410,86 @@ class GenerativeServer:
 
     def __exit__(self, *a):
         self.stop()
+
+    # ------------------------------------------------------------ hot swap
+    def _params(self):
+        """Per-dispatch param snapshot — the seam swap_parameters flips
+        through (one coherent weight set per compiled call)."""
+        with self._params_lock:
+            return [p.data()._data for p in self._plist]
+
+    def swap_parameters(self, params_file):
+        """Zero-downtime weight hot-swap for the generative server:
+        structural validation (``checkpoint.validate_swap`` — a mismatched
+        tree, including quantized qweight/w_scale pages, is rejected with
+        the old weights still serving), then an atomic flip under the
+        per-dispatch param lock. The prefix cache is flushed — its stored
+        KV pages were computed by the OLD weights; in-flight streams keep
+        their already-written pages and finish (continuity over purity:
+        no request is dropped by a swap). Returns the new swap epoch."""
+        from ..checkpoint import validate_swap
+        from ..ndarray import NDArray
+
+        picked = validate_swap(self.model, params_file)
+        params = self.model._collect_params_with_prefix()
+        staged = {n: NDArray(jnp.asarray(a)) for n, a in picked.items()}
+        with self._params_lock:
+            for name, arr in staged.items():
+                params[name].set_data(arr)
+            self._swap_epoch += 1
+        if self.prefix is not None:
+            self.prefix._store.clear()
+        return self._swap_epoch
+
+    # ----------------------------------------------------------- fleet
+    def tokens_in_flight(self):
+        """Gauge: tokens still owed across live slots + queued admissions
+        (each queued request owes at least its max_new_tokens=… budget is
+        unknown until join, so queued requests count 1 row each via the
+        batcher queue) — the router's least-loaded score component."""
+        owed = sum(self._remaining[s] for s in self.cache.active_slots)
+        return int(owed)
+
+    def health(self):
+        """Cheap liveness payload for ``/health`` (and the fleet router's
+        per-pick scrape): warm flag + load gauges, no ring sorts."""
+        tif = self.tokens_in_flight()
+        self.metrics.record_tokens_in_flight(tif)
+        return {"warm": bool(self._decode_fns or self._prefill_fns),
+                "running": (self._loop_thread is not None
+                            and self._loop_thread.is_alive()),
+                "kind": "generative",
+                "queue_depth": self._batcher.queue_depth(),
+                "in_flight": self.cache.num_active,
+                "tokens_in_flight": tif,
+                "swap_epoch": self._swap_epoch}
+
+    def export_prefixes(self):
+        """Read the prefix cache out as host arrays for cross-process
+        migration: [(tokens, k_stack, v_stack, prompt_len, last_logits)].
+        The retirement path: a draining worker exports, the sibling that
+        inherits its sessions imports, and multi-turn conversations keep
+        their KV pages across the retire."""
+        if self.prefix is None:
+            return []
+        out = []
+        for key, ent in list(self.prefix._store.items()):
+            k_stack, v_stack, plen, last = ent
+            out.append((np.asarray(key, np.int32), k_stack, v_stack,
+                        int(plen), last))
+        return out
+
+    def import_prefixes(self, entries):
+        """Adopt migrated prefix entries (see export_prefixes). Stored
+        host-side; the next prompt hit injects them through the compiled
+        inject program like any locally-computed prefix."""
+        if self.prefix is None:
+            return 0
+        n = 0
+        for tokens, k_stack, v_stack, plen, last in entries:
+            self.prefix.put(tokens, k_stack, v_stack, plen, last)
+            n += 1
+        return n
 
     # ------------------------------------------------------------ admission
     def submit(self, prompt, max_new_tokens=16, temperature=0.0, seed=0,
@@ -564,7 +650,7 @@ class GenerativeServer:
                         jnp.asarray(key), jnp.float32(stream.temperature))
             else:
                 fn = self._prefill_fn(tp, self.cache.capacity)
-                params = [p.data()._data for p in self._plist]
+                params = self._params()
                 if self._quantize:
                     kcs, kss, vcs, vss, valid, toks, last = fn(
                         params, self.cache.k, self.cache.k_scale,
@@ -639,7 +725,7 @@ class GenerativeServer:
         if self._draft is not None:
             return self._speculate_once(active, n_active)
         fn = self._decode_fn(self.cache.capacity)
-        params = [p.data()._data for p in self._plist]
+        params = self._params()
         if self._quantize:
             args = (params, self.cache.k, self.cache.k_scale, self.cache.v,
                     self.cache.v_scale, self.cache.valid, self._tok,
@@ -696,7 +782,7 @@ class GenerativeServer:
         else:
             drafts = draft.propose(None, k)
         fn = self._verify_fn(self.cache.capacity)
-        params = [p.data()._data for p in self._plist]
+        params = self._params()
         if self._quantize:
             args = (params, self.cache.k, self.cache.k_scale, self.cache.v,
                     self.cache.v_scale, self.cache.valid, self._tok, drafts,
@@ -770,7 +856,7 @@ class GenerativeServer:
         chunk = np.zeros((1, tc), np.int32)
         chunk[0, :seg.size] = seg
         fn = self._chunk_fn(tc, self.cache.capacity)
-        params = [p.data()._data for p in self._plist]
+        params = self._params()
         engine.dispatch_counter.bump()
         scope = (profiler.decode_scope("chunk%d" % tc, self.slots,
                                        self.cache.num_active)
@@ -1353,7 +1439,7 @@ class GenerativeServer:
                 break
             tp = min(next_pow2(int(b)), self.cache.capacity)
             fn = self._prefill_fn(tp, self.cache.capacity)
-            params = [p.data()._data for p in self._plist]
+            params = self._params()
             key = np.asarray(jax.random.PRNGKey(0), np.uint32)
             padded = np.zeros((1, tp), np.int32)
             if self._quantize:
@@ -1427,7 +1513,7 @@ class GenerativeServer:
         if slot is None:
             return
         fn = self._chunk_fn(tc, self.cache.capacity)
-        params = [p.data()._data for p in self._plist]
+        params = self._params()
         key = np.asarray(jax.random.PRNGKey(0), np.uint32)
         chunk = np.zeros((1, tc), np.int32)
         if self._quantize:
@@ -1528,6 +1614,8 @@ class GenerativeServer:
             slots=self.slots,
             capacity=self.cache.capacity,
             in_flight=self.cache.num_active,
+            tokens_in_flight=self.tokens_in_flight(),
+            swap_epoch=self._swap_epoch,
             cache_migrations=self.cache.migrations,
             prefix_hits=self.prefix.hits if self.prefix is not None else None,
             prefix_misses=(self.prefix.misses if self.prefix is not None

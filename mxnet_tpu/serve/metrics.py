@@ -56,6 +56,10 @@ class ServeMetrics:
         self._request_rows = {}           # rows(int) -> request count
         self._bucket_hist = {}            # bucket -> {batches, rows, pad_rows}
         self._queue_depth = 0
+        # work the server has admitted but not yet completed — with
+        # queue_depth, the two gauges a fleet router scrapes per pick
+        # (cheap /health reads, never a full snapshot parse)
+        self._tokens_in_flight = 0
         # profiler 'C' counters are created lazily so importing serve never
         # touches profiler state; events are only emitted while it runs
         self._prof = None
@@ -83,6 +87,20 @@ class ServeMetrics:
             self._queue_depth = depth
         if profiler.is_running():
             self._counters()["queue"].set_value(depth)
+
+    def record_tokens_in_flight(self, n):
+        """Gauge: tokens (generative) or rows (batch serving) admitted but
+        not yet delivered — the load score a least-loaded router sums with
+        queue depth."""
+        with self._lock:
+            self._tokens_in_flight = int(n)
+
+    def load_gauges(self):
+        """The two router-scraped gauges as a tiny dict — what the worker's
+        ``/health`` endpoint embeds (no percentile sort, no history walk)."""
+        with self._lock:
+            return {"queue_depth": self._queue_depth,
+                    "tokens_in_flight": self._tokens_in_flight}
 
     def record_shed(self, n=1):
         with self._lock:
@@ -151,6 +169,7 @@ class ServeMetrics:
                 "errors": self.errors,
                 "batches": self.batches,
                 "queue_depth": self._queue_depth,
+                "tokens_in_flight": self._tokens_in_flight,
                 "batch_fill_ratio": (round(self.batched_rows
                                            / self.bucket_rows, 4)
                                      if self.bucket_rows else None),

@@ -35,9 +35,15 @@ from .executor_pool import BucketedExecutor, symbol_infer_fn
 DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32)
 
 
-def _block_pool(model, devices, buckets, donate):
+def _block_pool(model, devices, buckets, donate, params_lock=None):
     """Adapt a gluon block to (fn, params_fn): SymbolBlocks route through
-    their stored graph, hybrid blocks through serving_fn's pure trace."""
+    their stored graph, hybrid blocks through serving_fn's pure trace.
+
+    ``params_lock`` is the weight hot-swap seam: params_fn (read once per
+    dispatch) snapshots the whole list under it, and ``swap_parameters``
+    writes every param under the same lock — a dispatch therefore sees
+    all-old or all-new weights, never a mix, with zero pause in between
+    (the lock is held for a list comprehension, not a compile)."""
     from ..gluon.block import SymbolBlock
 
     if isinstance(model, SymbolBlock):
@@ -53,8 +59,13 @@ def _block_pool(model, devices, buckets, donate):
         fn, _ = model.serving_fn()
         plist = list(model.collect_params().values())
 
-    def params_fn():
-        return [p.data()._data for p in plist]
+    if params_lock is None:
+        def params_fn():
+            return [p.data()._data for p in plist]
+    else:
+        def params_fn():
+            with params_lock:
+                return [p.data()._data for p in plist]
 
     return BucketedExecutor(fn, params_fn, buckets=buckets, devices=devices,
                             donate=donate, name=type(model).__name__)
@@ -142,7 +153,12 @@ class ModelServer:
         self.metrics.row_bytes = sum(
             int(np.prod(shape, dtype=np.int64)) * dt.itemsize
             for shape, dt in self._specs)
-        self._pool = _block_pool(model, devices, self.buckets, donate)
+        # hot-swap seam: params_fn reads and swap_parameters writes under
+        # this lock, so every dispatch sees one coherent weight set
+        self._params_lock = threading.Lock()
+        self._swap_epoch = 0
+        self._pool = _block_pool(model, devices, self.buckets, donate,
+                                 self._params_lock)
         self._batcher = DynamicBatcher(
             self._dispatch, max_batch=self.buckets[-1],
             max_wait_ms=max_wait_ms, max_queue=max_queue,
@@ -188,22 +204,66 @@ class ModelServer:
         if self._metrics_port is not None and self.metrics_http is None:
             from ..observability import MetricsHTTPServer
 
-            self.metrics_http = MetricsHTTPServer(self._metrics_port)
+            self.metrics_http = MetricsHTTPServer(self._metrics_port,
+                                                  health_fn=self.health)
         self._started = True
         return self
 
-    def stop(self, drain=True, timeout_s=5.0):
+    def stop(self, drain=True, timeout_s=5.0, reason="server stopped"):
         """Stop serving. drain=True dispatches what is already queued
         before shutdown; drain=False rejects it immediately. Dispatcher
         and worker joins are bounded by ``timeout_s``, any request still
-        queued afterwards is rejected (never stranded), and start() after
+        queued OR claimed afterwards is rejected typed (never stranded —
+        mid-drain strands sweep to ``ServeError("worker retired: ...")``
+        so a fleet router retries them on a sibling), and start() after
         stop() rebuilds the dispatcher pool — repeated cycles leak no
         threads (pinned by tests/test_concurrency.py)."""
         self._started = False
-        self._batcher.stop(drain=drain, timeout_s=timeout_s)
+        self._batcher.stop(drain=drain, timeout_s=timeout_s, reason=reason)
         if self.metrics_http is not None:
             self.metrics_http.close()
             self.metrics_http = None
+
+    def health(self):
+        """Cheap liveness payload for the ``/health`` endpoint (and the
+        fleet router's per-pick scrape): warmup-complete flag plus the two
+        load gauges — no percentile sorts, no device reads."""
+        queue = self._batcher.queue_depth()
+        self.metrics.record_tokens_in_flight(queue)
+        return {"warm": bool(self._pool.row_aligned),
+                "running": self._started,
+                "kind": "model",
+                "queue_depth": queue,
+                "tokens_in_flight": queue,
+                "swap_epoch": self._swap_epoch}
+
+    def swap_parameters(self, params_file):
+        """Zero-downtime weight hot-swap: validate ``params_file``
+        structurally against the live parameter tree
+        (``checkpoint.validate_swap`` — missing/extra/reshaped/re-dtyped
+        params, including quantized qweight/w_scale pages, raise SwapError
+        with the OLD weights untouched), then flip every parameter
+        atomically under the params_fn lock the pool reads per dispatch.
+        In-flight batches finish on the weights they snapshotted; the next
+        dispatch serves the new ones. Same shapes/dtypes = same compiled
+        signatures: no retrace, no dropped requests. Returns the new swap
+        epoch."""
+        from ..checkpoint import validate_swap
+
+        import jax.numpy as jnp
+
+        from ..ndarray import NDArray
+
+        picked = validate_swap(self.model, params_file)
+        params = self.model._collect_params_with_prefix()
+        # stage host→device transfers BEFORE taking the lock: the flip
+        # itself is a pointer rebind per param, microseconds under traffic
+        staged = {n: NDArray(jnp.asarray(a)) for n, a in picked.items()}
+        with self._params_lock:
+            for name, arr in staged.items():
+                params[name].set_data(arr)
+            self._swap_epoch += 1
+        return self._swap_epoch
 
     def retune_buckets(self, buckets=None, max_buckets=6):
         """Rebuild the server on a new bucket set — the apply step of
@@ -234,7 +294,7 @@ class ModelServer:
             self.stop()
         self.buckets = new
         self._pool = _block_pool(self.model, self._devices, self.buckets,
-                                 self._donate)
+                                 self._donate, self._params_lock)
         self._batcher = DynamicBatcher(
             self._dispatch, max_batch=self.buckets[-1],
             max_wait_ms=self._max_wait_ms, max_queue=self._max_queue,

@@ -59,6 +59,32 @@ def save_npz_exact(filename, arrays):
             os.remove(tmp)
 
 
+def dumps_npz_exact(arrays):
+    """In-memory :func:`save_npz_exact` — same bf16/fp8 sidecar encoding,
+    returns the npz bytes. The fleet wire codec: worker ``/predict`` bodies
+    and responses and prefix-cache migration payloads travel as one npz
+    blob, so exotic dtypes cross process boundaries exactly."""
+    import io
+
+    import numpy as _np
+    enc = {}
+    for k, v in arrays.items():
+        v = _np.asarray(v)
+        if not _npy_native(v.dtype):
+            enc[_NPZ_DTYPE_PREFIX + k] = _np.asarray(v.dtype.name)
+            v = v.view(_np.dtype("u%d" % v.dtype.itemsize))
+        enc[k] = v
+    buf = io.BytesIO()
+    _np.savez(buf, **enc)
+    return buf.getvalue()
+
+
+def loads_npz_exact(data):
+    """Decode :func:`dumps_npz_exact` bytes (np.load reads file-likes)."""
+    import io
+    return load_npz_exact(io.BytesIO(data))
+
+
 def load_npz_exact(filename):
     """dict[name → np.ndarray] with EXACT dtypes restored (the read side of
     :func:`save_npz_exact`). Also repairs legacy files that stored bfloat16

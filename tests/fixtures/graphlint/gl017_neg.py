@@ -1,0 +1,28 @@
+"""GL017 negatives: non-process os/subprocess usage, lookalike names on
+other objects, and thread (not process) lifecycle."""
+import os
+import threading
+
+
+def env_and_paths(d):
+    # os file/env calls are not process lifecycle
+    os.makedirs(d, exist_ok=True)
+    return os.environ.get("JAX_PLATFORMS"), os.path.join(d, "x")
+
+
+def lookalike(conn):
+    # .run/.kill on arbitrary objects is not subprocess/os
+    conn.run("SELECT 1")
+    conn.kill()
+
+
+def worker_thread(fn):
+    # threads are in-process: the fleet rule is about OS processes
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+    return t
+
+
+def pid_bookkeeping():
+    # reading pids is observability, not lifecycle
+    return os.getpid()

@@ -1,0 +1,31 @@
+"""GL017 positives: process spawn/kill outside the fleet layer — replica
+lifecycle scattered where no router tracks, drills, or reaps it."""
+import os
+import signal
+import subprocess
+from subprocess import Popen
+
+
+def launch_helper(argv):
+    return subprocess.Popen(argv)  # expect: GL017
+
+
+def launch_bare(argv):
+    return Popen(argv)  # expect: GL017
+
+
+def run_build(cmd):
+    subprocess.run(cmd, check=True)  # expect: GL017
+
+
+def hard_stop(pid):
+    os.kill(pid, signal.SIGKILL)  # expect: GL017
+
+
+def double_up():
+    return os.fork()  # expect: GL017
+
+
+class Supervisor:
+    def restart(self, cmd):
+        subprocess.check_call(cmd)  # expect: GL017

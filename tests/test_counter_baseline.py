@@ -323,6 +323,61 @@ def test_specdecode_artifact_pins():
     assert 1.0 <= row["tokens_per_verify_dispatch"] <= row["spec_k"]
 
 
+# --------------------------------------------------------------- fleet
+def test_fleet_artifact_pins():
+    """Fleet gate (ISSUE 20): the committed artifact must keep the
+    acceptance counters — kill -9 mid-wave costs zero failed requests
+    beyond the victim's in-flight (and those are retried), autoscale-out
+    actually landed a second replica AND improved p99 by eliminating
+    sheds, hot-swap mid-traffic dropped zero requests with zero torn
+    (neither-old-nor-new) outputs, a snapshot-warm spawn reached its
+    first request with zero compiles under an armed watchdog, and a
+    retired replica's prefix entries migrated and HIT on the session's
+    next turn. Wall-clock columns are context, not gated. The live
+    replays are tests/test_fleet.py (kill -9, swap rejections) — this
+    file stays cheap, subprocess spawns belong there."""
+    art = _artifact("fleet_bench_quick.json")
+
+    row = _row(art, "kill9_drill")
+    assert row["failed"] == 0, \
+        "committed kill -9 drill lost %d requests" % row["failed"]
+    assert row["ok"] == row["requests"]
+    assert row["workers_lost"] == 1 and row["workers_left"] == 1
+
+    row = _row(art, "scale_out_p99")
+    assert row["autoscaled"] is True and row["workers_after"] == 2, \
+        "committed scale-out row never actually autoscaled"
+    assert row["failed"] == 0
+    assert row["shed_retries_before"] > 0, \
+        "single replica never shed — the scenario measured nothing"
+    assert row["shed_retries_after"] == 0, \
+        "the scaled pair still sheds (%d)" % row["shed_retries_after"]
+    assert row["p99_after_ms"] < row["p99_before_ms"], \
+        "autoscale-out did not improve p99 (%.1f -> %.1f ms)" \
+        % (row["p99_before_ms"], row["p99_after_ms"])
+
+    row = _row(art, "hot_swap_mid_traffic")
+    assert row["dropped"] == 0 and row["mixed_outputs"] == 0, \
+        "hot swap dropped %d / tore %d responses" \
+        % (row["dropped"], row["mixed_outputs"])
+    assert row["old_model_responses"] > 0 and \
+        row["new_model_responses"] > 0
+    assert row["replicas_swapped"] == 2 and row["swap_epochs"] == [1, 1]
+
+    row = _row(art, "warm_spawn")
+    assert row["warm_compiles"] == 0, \
+        "snapshot-warm spawn compiled %d programs" % row["warm_compiles"]
+    assert row["watchdog_armed"] is True and row["watchdog_retraces"] == 0
+    assert row["first_request_ok"] is True
+
+    row = _row(art, "session_affinity")
+    assert row["prefix_hits_on_pinned"] >= 1
+    assert row["migrated_entries"] == 1
+    assert row["hit_on_migrated_prefix"] == 1, \
+        "the migrated prefix entry was not hit after retirement"
+    assert row["tokens_stable_across_migration"] is True
+
+
 # ------------------------------------------------- artifact sanity gate
 @pytest.mark.parametrize("name,counter_cols", [
     ("opt_step_bench_quick.json", ["fused_dispatches_per_step"]),
@@ -358,6 +413,10 @@ def test_specdecode_artifact_pins():
     # speedup/accept/ITL-improvement bars + the 1-dispatch-per-round
     # contract are pinned above in
     # test_specdecode_counters_and_artifact_pins
+    # fleet acceptance counters (failed, autoscaled, mixed_outputs,
+    # warm_compiles, migrated hits) are pinned above in
+    # test_fleet_artifact_pins; rows carry disjoint columns so the
+    # shared sanity gate only checks presence per-case there
     ("serve_specdecode_bench_quick.json", ["spec_rounds",
                                            "verify_dispatches",
                                            "dispatches_per_round",

@@ -1,0 +1,218 @@
+"""serve.fleet — multi-process replica fleet (ISSUE 20).
+
+Covers the fleet contract surface that is cheap enough for tier-1:
+
+* the worker /health endpoint (warmup flag + the two load gauges the
+  router scores on, plus the draining flag that takes a replica out of
+  rotation while it finishes in-flight work);
+* the mid-drain strand fix: requests a dispatcher already CLAIMED when
+  ``DynamicBatcher.stop()``'s bound expires are swept with a typed
+  ``ServeError("worker retired: ...")`` instead of stranding the caller;
+* the hot-swap structural gate, both in-process (missing / extra /
+  reshaped / re-dtyped params) and against a FRESH quantized subprocess
+  (an fp32 checkpoint pushed at a live qweight/w_scale tree → 409, old
+  weights keep serving, swap epoch untouched);
+* the kill -9 drill (zero failed requests beyond nothing — the victim's
+  in-flight work is retried on the sibling) and multi-model multiplexing
+  over one router.
+
+The heavier end-to-end numbers (autoscale p99, warm-spawn zero-compile,
+prefix migration) are produced by tools/fleet_bench.py and gated against
+the committed artifact in tests/test_counter_baseline.py.
+"""
+import importlib.util
+import os
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.checkpoint import SwapError
+from mxnet_tpu.serve import FleetRouter, WorkerHandle, WorkerSpec
+from mxnet_tpu.serve.batcher import DynamicBatcher, ServeError, ServerBusy
+from mxnet_tpu.serve.worker import ServeWorker
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+FACTORY = os.path.join(TOOLS, "fleet_factory.py")
+
+
+def _tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(TOOLS, "%s.py" % name))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _x():
+    return np.random.default_rng(0).standard_normal((16,)).astype(np.float32)
+
+
+# ------------------------------------------------------------ /health
+def test_worker_health_gauges_predict_and_drain():
+    """The worker's single port carries the fleet surface: /health reports
+    warm + the two load gauges + draining; /predict round-trips npz; a
+    drained replica 503s new work and scores None (router skips it)."""
+    ff = _tool("fleet_factory")
+    worker = ServeWorker(ff.model_server(), port=0)
+    try:
+        h = WorkerHandle("127.0.0.1", worker.port)
+        health = h.health()
+        assert health["warm"] is True
+        assert health["kind"] == "model"
+        assert health["draining"] is False
+        assert health["queue_depth"] == 0
+        assert health["tokens_in_flight"] == 0
+        assert health["swap_epoch"] == 0
+        assert h.load_score() == 0
+
+        x = _x()
+        y = np.asarray(h.predict([x]))
+        ref = np.asarray(worker.server.predict(x))
+        assert np.allclose(y, ref, atol=1e-6)
+
+        gauges = h.drain()
+        assert gauges["draining"] is True
+        assert h.health()["draining"] is True
+        with pytest.raises(ServerBusy):
+            h.predict([x])
+        assert h.load_score() is None
+    finally:
+        worker.close()
+
+
+# --------------------------------------------------- mid-drain strand fix
+def test_batcher_stop_sweeps_claimed_requests():
+    """A dispatch wedged past stop()'s bound used to strand its riders
+    with no terminal error; they must be swept with the typed retirement
+    error a fleet router reads as retryable."""
+    release = threading.Event()
+    claimed = threading.Event()
+
+    def wedged(requests, total_rows):
+        claimed.set()
+        release.wait(timeout=10.0)  # never finish()es within stop()'s bound
+
+    b = DynamicBatcher(wedged, max_batch=4, max_wait_ms=0.5, max_queue=8)
+    b.start()
+    req = b.submit((np.zeros((1,), np.float32),), 1)
+    assert claimed.wait(timeout=5.0)
+    t0 = time.perf_counter()
+    b.stop(drain=True, timeout_s=0.3, reason="replica going away")
+    assert time.perf_counter() - t0 < 5.0  # bounded, not wait-forever
+    with pytest.raises(ServeError, match="worker retired: replica going"):
+        req.result(timeout_s=1.0)
+    release.set()
+
+
+# ------------------------------------------------- hot-swap rejections
+def test_hot_swap_rejection_matrix_in_process():
+    """Every structural divergence — missing, extra, reshaped, re-dtyped —
+    must be rejected BEFORE any weight is touched: epoch stays 0 and the
+    old outputs keep serving; only the matching checkpoint flips."""
+    ff = _tool("fleet_factory")
+    x = _x()
+    with ff.model_server() as srv:
+        ref = np.asarray(srv.predict(x))
+        with tempfile.TemporaryDirectory() as td:
+            good = os.path.join(td, "v2.params")
+            ff._mlp(salt=1).save_parameters(good)
+            with np.load(good) as z:
+                arrays = {k: z[k] for k in z.files}
+            wkey = next(k for k in sorted(arrays) if arrays[k].ndim == 2)
+
+            def ckpt(name, arrs):
+                path = os.path.join(td, name)
+                with open(path, "wb") as f:
+                    np.savez(f, **arrs)
+                return path
+
+            missing = {k: v for k, v in arrays.items() if k != wkey}
+            extra = dict(arrays, not_a_param=np.zeros((3,), np.float32))
+            reshaped = dict(arrays)
+            reshaped[wkey] = np.zeros(
+                (arrays[wkey].shape[0] + 1, arrays[wkey].shape[1]),
+                np.float32)
+            redtyped = dict(arrays)
+            redtyped[wkey] = arrays[wkey].astype(np.float16)
+
+            for name, arrs, why in (("missing.params", missing, "missing"),
+                                    ("extra.params", extra, "extra"),
+                                    ("reshaped.params", reshaped,
+                                     "reshaped"),
+                                    ("redtyped.params", redtyped, "dtype")):
+                with pytest.raises(SwapError, match=why):
+                    srv.swap_parameters(ckpt(name, arrs))
+                assert srv.health()["swap_epoch"] == 0
+                assert np.allclose(np.asarray(srv.predict(x)), ref,
+                                   atol=1e-6), \
+                    "%s: rejected swap disturbed the live weights" % name
+
+            assert srv.swap_parameters(good) == 1
+            assert not np.allclose(np.asarray(srv.predict(x)), ref,
+                                   atol=1e-4)
+
+
+def test_hot_swap_rejects_fp32_at_quantized_subprocess():
+    """The quantized pin, in a FRESH process: a replica serving int8
+    (live tree = qweight/w_scale pages) must 409 an fp32 checkpoint and
+    keep serving its old weights — no half-dequantized flip."""
+    ff = _tool("fleet_factory")
+    with tempfile.TemporaryDirectory() as td:
+        fp32 = os.path.join(td, "fp32.params")
+        ff._mlp().save_parameters(fp32)
+        with open(fp32, "rb") as f:
+            blob = f.read()
+        h = WorkerHandle.spawn(
+            WorkerSpec(factory="%s:model_server_int8" % FACTORY))
+        try:
+            assert h.health()["warm"] is True
+            x = _x()
+            y0 = np.asarray(h.predict([x]))
+            with pytest.raises(SwapError, match="rejected"):
+                h.swap(blob)
+            assert h.health()["swap_epoch"] == 0
+            assert np.allclose(np.asarray(h.predict([x])), y0, atol=1e-6)
+        finally:
+            h.shutdown()
+            h.reap()
+
+
+# ---------------------------------------------------------- kill -9 drill
+def test_kill9_mid_wave_zero_failed_requests():
+    """SIGKILL one of two replicas mid-wave: the router turns the victim's
+    connection failures into sibling retries, so the wave completes with
+    zero failed requests and exactly one worker lost."""
+    fb = _tool("fleet_bench")
+    row = fb.run_kill9(requests=16, kill_at=0.3)
+    assert row["failed"] == 0, \
+        "kill -9 cost %d requests beyond the victim" % row["failed"]
+    assert row["ok"] == row["requests"] == 16
+    assert row["workers_lost"] == 1
+    assert row["workers_left"] == 1
+
+
+# ------------------------------------------------------------ multi-model
+def test_multi_model_multiplexing_one_router():
+    """Two pools (different weights) behind one router: requests route by
+    model name and answer with their own pool's outputs."""
+    ff = _tool("fleet_factory")
+    wa = ServeWorker(ff.model_server(), port=0)
+    wb = ServeWorker(ff.model_server_v2(), port=0)
+    try:
+        router = FleetRouter()
+        router.adopt(WorkerHandle("127.0.0.1", wa.port), model="a")
+        router.adopt(WorkerHandle("127.0.0.1", wb.port), model="b")
+        assert router.models() == ["a", "b"]
+        x = _x()
+        ya = np.asarray(router.predict(x, model="a"))
+        yb = np.asarray(router.predict(x, model="b"))
+        assert np.allclose(ya, np.asarray(wa.server.predict(x)), atol=1e-6)
+        assert np.allclose(yb, np.asarray(wb.server.predict(x)), atol=1e-6)
+        assert not np.allclose(ya, yb, atol=1e-4)
+    finally:
+        wa.close()
+        wb.close()

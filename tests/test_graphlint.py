@@ -101,9 +101,11 @@ def test_allowlist_is_small_and_justified():
     # TapeNode/Symbol + the two front-memo keys over the IR canonical
     # key), 7 are the GL011 single-writer decoder tables (mutated
     # only on the serve-decode loop thread, validated at runtime by the
-    # armed race probes), and 2 are the GL016 cold-start tuning defaults
+    # armed race probes), 2 are the GL016 cold-start tuning defaults
     # (the interim flash block row and the pow2 serve buckets that exist
-    # only to bootstrap the measured histograms ir.tune fits from) —
+    # only to bootstrap the measured histograms ir.tune fits from), and
+    # 1 is the GL017 deliberate process site (engine's synchronous
+    # native-lib make at import — no long-lived child to track) —
     # each carries a why naming the constraint
     assert len(entries) <= 46, "allowlist grew to %d entries" % len(entries)
     for e in entries:

@@ -198,6 +198,39 @@ def main():
             print("live servers : none (snapshots appear while a "
                   "serve.ModelServer is alive)")
 
+    print("----------Fleet----------")
+    # serve.fleet: the router lives in the caller's process and its workers
+    # are subprocesses, so there is no cross-process registry to scrape —
+    # report the committed acceptance artifact (tools/fleet_bench_quick
+    # .json, regenerated by `python bench.py fleet --smoke`) instead
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "fleet_bench_quick.json")) as fh:
+            frows = {r["case"]: r for r in json.load(fh)["rows"]}
+        k9, so = frows["kill9_drill"], frows["scale_out_p99"]
+        hs, ws = frows["hot_swap_mid_traffic"], frows["warm_spawn"]
+        af = frows["session_affinity"]
+        print("kill -9 drill: %d/%d ok, failed=%d, retries=%d (artifact)"
+              % (k9["ok"], k9["requests"], k9["failed"],
+                 k9["router_retries"]))
+        print("autoscale    : %d->%d workers, sheds %d->%d, "
+              "p99 %.1f->%.1fms"
+              % (so["workers_before"], so["workers_after"],
+                 so["shed_retries_before"], so["shed_retries_after"],
+                 so["p99_before_ms"], so["p99_after_ms"]))
+        print("hot swap     : dropped=%d mixed=%d across %d replica(s)"
+              % (hs["dropped"], hs["mixed_outputs"],
+                 hs["replicas_swapped"]))
+        print("warm spawn   : %d compile(s), %d retrace(s), %.2fs to ready"
+              % (ws["warm_compiles"], ws["watchdog_retraces"],
+                 ws["spawn_to_ready_s"]))
+        print("affinity     : %d migrated prefix entrie(s), %d hit(s) "
+              "after retirement"
+              % (af["migrated_entries"], af["hit_on_migrated_prefix"]))
+    except (OSError, KeyError, ValueError) as e:
+        print("artifact     : unavailable (%s) — run `python bench.py "
+              "fleet --smoke`" % e)
+
     print("----------Distributed----------")
     # mxnet_tpu.dist: the overlapped gradient exchange (bucket dispatches
     # vs bucket-program builds — a steady-state build delta means the

@@ -1,0 +1,99 @@
+"""Worker factories for serve.fleet subprocesses (tests + tools/fleet_bench).
+
+``python -m mxnet_tpu.serve.worker --factory tools/fleet_factory.py:NAME``
+resolves these by file path (tools/ is not a package). Every factory pins
+its weights deterministically (crc32-seeded per parameter name), so all
+replicas of a pool serve IDENTICAL models — a request retried on a sibling
+after a kill -9 returns the same answer the dead worker would have.
+"""
+import zlib
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+
+FEAT = 16
+CLASSES = 10
+
+
+def _det_weights(net, salt=0):
+    """Overwrite every parameter with a crc32(name)-seeded draw — stable
+    across processes (str hash() is not) and across spawn order."""
+    for name, p in sorted(net.collect_params().items()):
+        rng = np.random.default_rng(zlib.crc32(name.encode()) + salt)
+        a = rng.standard_normal(p.shape).astype(np.float32) * 0.1
+        p.set_data(nd.array(a, dtype=p.dtype))
+
+
+def _mlp(salt=0):
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(24, activation="relu"))
+        net.add(gluon.nn.Dense(CLASSES))
+    net.initialize()
+    net(nd.array(np.zeros((1, FEAT), np.float32)))  # materialize shapes
+    _det_weights(net, salt=salt)
+    net.hybridize()
+    return net
+
+
+def model_server():
+    """Plain batch-serving replica: small buckets, roomy queue."""
+    return mx.serve.ModelServer(_mlp(), [((FEAT,), "float32")],
+                                buckets=(1, 2, 4), max_wait_ms=1.0,
+                                max_queue=64, timeout_ms=30000.0)
+
+
+def model_server_tiny_queue():
+    """The single-replica ceiling: a 4-deep admission queue sheds under
+    any real wave — what the scale-out scenario adds a sibling to fix."""
+    return mx.serve.ModelServer(_mlp(), [((FEAT,), "float32")],
+                                buckets=(1, 2, 4), max_wait_ms=1.0,
+                                max_queue=4, timeout_ms=30000.0)
+
+
+def model_server_slow_tiny_queue():
+    """Tiny queue PLUS ~20ms of simulated device time per batch — on a
+    1-core CI box the real model is too fast to ever fill a queue, so the
+    scale-out scenario would measure nothing. The sleep stands in for
+    accelerator latency; shedding and queueing behave as on real load."""
+    import time
+
+    srv = model_server_tiny_queue()
+    orig = srv._batcher._dispatch_fn
+
+    def slow(requests, total_rows):
+        time.sleep(0.02)
+        return orig(requests, total_rows)
+
+    srv._batcher._dispatch_fn = slow
+    return srv
+
+
+def model_server_int8():
+    """int8-quantized replica: the live tree is qweight/w_scale pages, so
+    an fp32 checkpoint pushed at it must be rejected structurally (409)."""
+    return mx.serve.ModelServer(_mlp(), [((FEAT,), "float32")],
+                                buckets=(1, 2, 4), max_wait_ms=1.0,
+                                max_queue=64, timeout_ms=30000.0,
+                                quantize="int8")
+
+
+def model_server_v2():
+    """Same architecture, different weights — the hot-swap 'new build'."""
+    return mx.serve.ModelServer(_mlp(salt=1), [((FEAT,), "float32")],
+                                buckets=(1, 2, 4), max_wait_ms=1.0,
+                                max_queue=64, timeout_ms=30000.0)
+
+
+def generative_server():
+    """Tiny GPT decode replica (slots=2) with the prefix cache on — the
+    session-affinity / prefix-migration scenarios run against this."""
+    from mxnet_tpu.models.gpt import gpt_nano
+
+    m = gpt_nano()
+    m.initialize()
+    _det_weights(m)
+    return mx.serve.GenerativeServer(m, slots=2, max_wait_ms=1.0,
+                                     timeout_ms=60000.0)
